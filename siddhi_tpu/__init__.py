@@ -1,0 +1,45 @@
+"""siddhi_tpu — a TPU-native streaming & Complex Event Processing framework.
+
+A from-scratch re-design (NOT a port) of the capabilities of the reference
+Siddhi engine (/root/reference, Java): SiddhiQL compiles to a columnar,
+batched dataflow whose hot path is a fused JAX/XLA step function per query,
+with per-key state held in dense ``[num_keys, ...]`` device arrays instead of
+per-key heap objects behind thread-locals.
+
+Public API surface mirrors the reference's (``SiddhiManager``
+-> ``SiddhiAppRuntime`` -> ``InputHandler`` / ``StreamCallback`` /
+``QueryCallback``; reference: siddhi-core ``SiddhiManager.java:49``,
+``SiddhiAppRuntime.java``, ``stream/input/InputHandler.java``).
+"""
+
+# Millisecond epoch timestamps need int64; enable x64 before any jax use.
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SiddhiManager",
+    "StreamCallback",
+    "QueryCallback",
+    "Event",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy to keep `import siddhi_tpu.compiler` light and cycle-free.
+    if name == "SiddhiManager":
+        from siddhi_tpu.core.manager import SiddhiManager
+        return SiddhiManager
+    if name == "StreamCallback":
+        from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
+        return StreamCallback
+    if name == "QueryCallback":
+        from siddhi_tpu.core.query.callback import QueryCallback
+        return QueryCallback
+    if name == "Event":
+        from siddhi_tpu.core.event import Event
+        return Event
+    raise AttributeError(name)

@@ -1,0 +1,78 @@
+"""Top-level SiddhiApp IR container.
+
+Mirrors reference ``query-api SiddhiApp.java`` — holds all definitions and
+execution elements in declaration order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from siddhi_tpu.query_api.annotations import Annotation
+from siddhi_tpu.query_api.definitions import (
+    AggregationDefinition,
+    FunctionDefinition,
+    StreamDefinition,
+    TableDefinition,
+    TriggerDefinition,
+    WindowDefinition,
+)
+from siddhi_tpu.query_api.execution import Partition, Query
+
+
+@dataclass
+class SiddhiApp:
+    annotations: List[Annotation] = field(default_factory=list)
+    stream_definitions: Dict[str, StreamDefinition] = field(default_factory=dict)
+    table_definitions: Dict[str, TableDefinition] = field(default_factory=dict)
+    window_definitions: Dict[str, WindowDefinition] = field(default_factory=dict)
+    trigger_definitions: Dict[str, TriggerDefinition] = field(default_factory=dict)
+    aggregation_definitions: Dict[str, AggregationDefinition] = field(default_factory=dict)
+    function_definitions: Dict[str, FunctionDefinition] = field(default_factory=dict)
+    # Queries and partitions in declaration order.
+    execution_elements: List[object] = field(default_factory=list)
+
+    @property
+    def name(self) -> Optional[str]:
+        # `@app:name('X')` is stored as Annotation(name='app:name',
+        # elements=[(None, 'X')]) (cf. reference SiddhiAppParser.java:91).
+        for a in self.annotations:
+            if a.name.lower() in ("app:name", "name"):
+                return a.element(None) or a.element("name")
+        return None
+
+    def app_annotation(self, key: str) -> Optional[Annotation]:
+        """Find `@app:<key>(...)` (e.g. playback, async, statistics)."""
+        for a in self.annotations:
+            if a.name.lower() == f"app:{key.lower()}":
+                return a
+        return None
+
+    @property
+    def queries(self) -> List[Query]:
+        return [e for e in self.execution_elements if isinstance(e, Query)]
+
+    @property
+    def partitions(self) -> List[Partition]:
+        return [e for e in self.execution_elements if isinstance(e, Partition)]
+
+    def define_stream(self, d: StreamDefinition) -> "SiddhiApp":
+        self.stream_definitions[d.id] = d
+        return self
+
+    def define_table(self, d: TableDefinition) -> "SiddhiApp":
+        self.table_definitions[d.id] = d
+        return self
+
+    def define_window(self, d: WindowDefinition) -> "SiddhiApp":
+        self.window_definitions[d.id] = d
+        return self
+
+    def add_query(self, q: Query) -> "SiddhiApp":
+        self.execution_elements.append(q)
+        return self
+
+    def add_partition(self, p: Partition) -> "SiddhiApp":
+        self.execution_elements.append(p)
+        return self

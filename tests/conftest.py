@@ -1,0 +1,13 @@
+"""Test harness config.
+
+Multi-chip code paths are tested on a virtual 8-device CPU mesh (the driver
+separately dry-runs the multichip path); env vars must be set before jax
+first import, hence here at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

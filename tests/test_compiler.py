@@ -1,0 +1,296 @@
+"""Compiler tests: SiddhiQL text -> IR.
+
+Modeled on the reference's compiler test style
+(``siddhi-query-compiler/src/test/``): parse app strings, assert IR shape.
+"""
+
+import pytest
+
+from siddhi_tpu.compiler import SiddhiCompiler, SiddhiParserException
+from siddhi_tpu.query_api import (
+    AttrType,
+    Compare,
+    Constant,
+    CountStateElement,
+    EveryStateElement,
+    EventOutputRate,
+    Filter,
+    InsertIntoStream,
+    JoinInputStream,
+    LogicalStateElement,
+    NextStateElement,
+    Partition,
+    Query,
+    SingleInputStream,
+    StateInputStream,
+    StreamStateElement,
+    TimeOutputRate,
+    ValuePartitionType,
+    Variable,
+    Window,
+)
+from siddhi_tpu.query_api.execution import AbsentStreamStateElement, StateInputStreamType
+
+
+def test_define_stream():
+    app = SiddhiCompiler.parse(
+        "define stream StockStream (symbol string, price float, volume long);"
+    )
+    d = app.stream_definitions["StockStream"]
+    assert [a.name for a in d.attributes] == ["symbol", "price", "volume"]
+    assert [a.type for a in d.attributes] == [AttrType.STRING, AttrType.FLOAT, AttrType.LONG]
+
+
+def test_app_name_annotation():
+    app = SiddhiCompiler.parse(
+        "@app:name('Test1') define stream S (a int);"
+    )
+    assert app.name == "Test1"
+
+
+def test_filter_query():
+    app = SiddhiCompiler.parse(
+        """
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from StockStream[price > 100]
+        select symbol, price
+        insert into OutStream;
+        """
+    )
+    q = app.queries[0]
+    assert q.name == "query1"
+    s = q.input_stream
+    assert isinstance(s, SingleInputStream)
+    assert s.stream_id == "StockStream"
+    assert isinstance(s.handlers[0], Filter)
+    cond = s.handlers[0].expression
+    assert isinstance(cond, Compare) and cond.operator == ">"
+    assert [oa.name for oa in q.selector.selection_list] == ["symbol", "price"]
+    assert isinstance(q.output_stream, InsertIntoStream)
+    assert q.output_stream.target_id == "OutStream"
+
+
+def test_window_group_by_having():
+    app = SiddhiCompiler.parse(
+        """
+        define stream StockStream (symbol string, price float, volume long);
+        from StockStream#window.length(5)
+        select symbol, avg(price) as avgPrice
+        group by symbol
+        having avgPrice > 50.0
+        insert expired events into OutStream;
+        """
+    )
+    q = app.queries[0]
+    w = q.input_stream.handlers[0]
+    assert isinstance(w, Window) and w.name == "length"
+    assert isinstance(w.parameters[0], Constant) and w.parameters[0].value == 5
+    assert q.selector.group_by_list[0].attribute_name == "symbol"
+    assert q.selector.having is not None
+    assert q.output_stream.output_event_type == "expired"
+
+
+def test_time_windows_and_rates():
+    app = SiddhiCompiler.parse(
+        """
+        define stream S (a string, b double);
+        from S#window.timeBatch(1 sec)
+        select a, count() as c
+        group by a
+        output all every 2 sec
+        insert into Out;
+        from S#window.time(1 min 30 sec)
+        select a
+        output first every 5 events
+        insert into Out2;
+        """
+    )
+    q0, q1 = app.queries
+    assert q0.input_stream.handlers[0].parameters[0].value == 1000
+    assert isinstance(q0.output_rate, TimeOutputRate) and q0.output_rate.value == 2000
+    assert q1.input_stream.handlers[0].parameters[0].value == 90_000
+    assert isinstance(q1.output_rate, EventOutputRate)
+    assert q1.output_rate.type == "first" and q1.output_rate.value == 5
+
+
+def test_join_query():
+    app = SiddhiCompiler.parse(
+        """
+        define stream StockStream (symbol string, price float);
+        define stream TwitterStream (symbol string, tweet string);
+        from StockStream#window.time(10 sec) as S
+          join TwitterStream#window.length(100) as T
+          on S.symbol == T.symbol
+        select S.symbol, T.tweet, S.price
+        insert into OutStream;
+        """
+    )
+    q = app.queries[0]
+    j = q.input_stream
+    assert isinstance(j, JoinInputStream)
+    assert j.left.stream_id == "StockStream" and j.left.stream_reference_id == "S"
+    assert j.right.stream_id == "TwitterStream"
+    assert isinstance(j.on_compare, Compare)
+
+
+def test_pattern_query():
+    app = SiddhiCompiler.parse(
+        """
+        define stream A (v int); define stream B (v int);
+        from every e1=A -> e2=B[e2.v > e1.v] within 5 sec
+        select e1.v as v1, e2.v as v2
+        insert into Out;
+        """
+    )
+    q = app.queries[0]
+    st = q.input_stream
+    assert isinstance(st, StateInputStream)
+    assert st.state_type == StateInputStreamType.PATTERN
+    assert st.within == 5000
+    root = st.state_element
+    assert isinstance(root, NextStateElement)
+    assert isinstance(root.state, EveryStateElement)
+    first = root.state.state
+    assert isinstance(first, StreamStateElement)
+    assert first.stream.stream_reference_id == "e1"
+    second = root.next
+    assert isinstance(second, StreamStateElement)
+    assert second.stream.stream_reference_id == "e2"
+    assert isinstance(second.stream.handlers[0], Filter)
+
+
+def test_sequence_and_count():
+    app = SiddhiCompiler.parse(
+        """
+        define stream A (v int); define stream B (v int);
+        from every e1=A, e2=B<2:5>
+        select e1.v as v1
+        insert into Out;
+        """
+    )
+    st = app.queries[0].input_stream
+    assert st.state_type == StateInputStreamType.SEQUENCE
+    nxt = st.state_element
+    assert isinstance(nxt, NextStateElement)
+    cnt = nxt.next
+    assert isinstance(cnt, CountStateElement)
+    assert cnt.min_count == 2 and cnt.max_count == 5
+
+
+def test_logical_and_absent_pattern():
+    app = SiddhiCompiler.parse(
+        """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from e1=A and e2=B -> not C for 2 sec
+        select e1.v as v1
+        insert into Out;
+        """
+    )
+    st = app.queries[0].input_stream
+    root = st.state_element
+    assert isinstance(root, NextStateElement)
+    assert isinstance(root.state, LogicalStateElement)
+    assert root.state.type == "and"
+    absent = root.next
+    assert isinstance(absent, AbsentStreamStateElement)
+    assert absent.waiting_time == 2000
+
+
+def test_partition():
+    app = SiddhiCompiler.parse(
+        """
+        define stream StockStream (symbol string, price float);
+        partition with (symbol of StockStream)
+        begin
+            from StockStream select symbol, price insert into #Inner;
+            from #Inner select symbol insert into Out;
+        end;
+        """
+    )
+    p = app.partitions[0]
+    assert isinstance(p, Partition)
+    assert isinstance(p.partition_types[0], ValuePartitionType)
+    assert len(p.queries) == 2
+    assert p.queries[1].input_stream.is_inner_stream
+    assert p.queries[0].output_stream.is_inner_stream
+
+
+def test_table_and_trigger_and_window_defs():
+    app = SiddhiCompiler.parse(
+        """
+        @primaryKey('symbol')
+        define table StockTable (symbol string, price float);
+        define trigger FiveSec at every 5 sec;
+        define window SW (symbol string, price float) time(1 min) output all events;
+        """
+    )
+    assert "StockTable" in app.table_definitions
+    assert app.table_definitions["StockTable"].annotations[0].name == "primaryKey"
+    assert app.trigger_definitions["FiveSec"].at_every == 5000
+    w = app.window_definitions["SW"]
+    assert w.window.name == "time" and w.window.parameters[0].value == 60_000
+
+
+def test_aggregation_definition():
+    app = SiddhiCompiler.parse(
+        """
+        define stream TradeStream (symbol string, price double, ts long);
+        define aggregation TradeAgg
+        from TradeStream
+        select symbol, avg(price) as avgPrice, sum(price) as total
+        group by symbol
+        aggregate by ts every sec ... year;
+        """
+    )
+    d = app.aggregation_definitions["TradeAgg"]
+    assert d.aggregate_attribute.attribute_name == "ts"
+    assert d.time_period.operator == "range"
+    assert len(d.time_period.durations) == 2
+
+
+def test_update_delete_outputs():
+    app = SiddhiCompiler.parse(
+        """
+        define stream S (symbol string, price float);
+        define table T (symbol string, price float);
+        from S update T set T.price = S.price on T.symbol == S.symbol;
+        from S delete T on T.symbol == S.symbol;
+        from S update or insert into T on T.symbol == S.symbol;
+        """
+    )
+    assert len(app.queries) == 3
+
+
+def test_env_var_substitution(monkeypatch):
+    monkeypatch.setenv("STREAM_NAME", "Foo")
+    src = SiddhiCompiler.update_variables("define stream ${STREAM_NAME} (a int);")
+    app = SiddhiCompiler.parse(src)
+    assert "Foo" in app.stream_definitions
+
+
+def test_parse_error_has_location():
+    with pytest.raises(SiddhiParserException) as err:
+        SiddhiCompiler.parse("define stream S (a int°);")
+    assert "line" in str(err.value)
+
+
+def test_math_and_bool_expressions():
+    app = SiddhiCompiler.parse(
+        """
+        define stream S (a int, b int, c bool);
+        from S[(a + b * 2 - 1) % 3 == 0 and (not c or b <= 4)]
+        select a * 2 as a2, ifThenElse(c, 'y', 'n') as flag
+        insert into Out;
+        """
+    )
+    q = app.queries[0]
+    assert len(q.selector.selection_list) == 2
+
+
+def test_on_demand_query_parse():
+    q = SiddhiCompiler.parse_on_demand_query(
+        "from StockTable on price > 5.0 select symbol, price"
+    )
+    assert q.input_store.store_id == "StockTable"
+    assert q.type == "find"
