@@ -1,0 +1,150 @@
+"""SiddhiAppRuntime: one running app — junctions, query runtimes, callbacks.
+
+Mirror of reference ``core/SiddhiAppRuntime.java`` /
+``SiddhiAppRuntimeImpl.java`` and the assembly logic of
+``util/parser/SiddhiAppParser.java:91-212`` +
+``util/SiddhiAppRuntimeBuilder.java``: reads @app annotations (playback,
+async, statistics), materializes a StreamJunction per stream definition,
+plans each query, auto-defines insert-into target streams
+(``OutputParser``), and wires callbacks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+from siddhi_tpu.core.context import SiddhiAppContext, SiddhiContext
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.plan.query_planner import plan_query
+from siddhi_tpu.core.query.callback import QueryCallback
+from siddhi_tpu.core.query.ratelimit import create_rate_limiter
+from siddhi_tpu.core.query.runtime import QueryRuntime
+from siddhi_tpu.core.stream.input.input_handler import InputHandler, InputManager
+from siddhi_tpu.core.stream.junction import StreamJunction
+from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
+from siddhi_tpu.query_api.annotations import find_annotation
+from siddhi_tpu.query_api.definitions import Attribute, StreamDefinition
+from siddhi_tpu.query_api.execution import InsertIntoStream, Partition, Query
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+
+class SiddhiAppRuntime:
+    def __init__(self, siddhi_app: SiddhiApp, siddhi_context: SiddhiContext):
+        self.siddhi_app = siddhi_app
+        self.name = siddhi_app.name or f"siddhi-app-{id(siddhi_app):x}"
+        self.app_context = SiddhiAppContext(siddhi_context, self.name)
+        self._barrier = threading.RLock()
+        self.stream_definitions: Dict[str, StreamDefinition] = dict(siddhi_app.stream_definitions)
+        self.junctions: Dict[str, StreamJunction] = {}
+        self.query_runtimes: Dict[str, QueryRuntime] = {}
+        self._stream_callback_adapters: List = []
+        self._started = False
+
+        # @app:playback (reference SiddhiAppParser.java:171-212)
+        if siddhi_app.app_annotation("playback") is not None:
+            self.app_context.playback = True
+            self.app_context.timestamp_generator.playback = True
+        if siddhi_app.app_annotation("enforceOrder") is not None:
+            self.app_context.enforce_order = True
+
+        for sid, sdef in self.stream_definitions.items():
+            self._create_junction(sdef)
+
+        self.input_manager = InputManager(self.app_context, self.junctions, self._barrier)
+
+        q_index = 0
+        for element in siddhi_app.execution_elements:
+            if isinstance(element, Query):
+                q_index += 1
+                self._add_query(element, q_index)
+            elif isinstance(element, Partition):
+                raise SiddhiAppValidationException("partitions land in M3")
+
+    # ------------------------------------------------------------ assembly
+
+    def _create_junction(self, sdef: StreamDefinition) -> StreamJunction:
+        j = StreamJunction(sdef, self.app_context)
+        async_ann = find_annotation(sdef.annotations, "async")
+        if async_ann is not None:
+            buffer_size = int(async_ann.element("buffer.size") or 1024)
+            batch_size = int(async_ann.element("batch.size") or 256)
+            j.enable_async(buffer_size, batch_size)
+        self.junctions[sdef.id] = j
+        return j
+
+    def _add_query(self, query: Query, index: int):
+        query_name = query.name or f"query_{index}"
+        runtime = plan_query(query, query_name, self.app_context, self.stream_definitions)
+
+        out = query.output_stream
+        if isinstance(out, InsertIntoStream):
+            target = out.target_id
+            if target not in self.stream_definitions:
+                # auto-define the output stream (reference OutputParser)
+                sdef = StreamDefinition(
+                    id=target,
+                    attributes=[Attribute(n, t) for n, t in runtime.output_attrs],
+                )
+                self.stream_definitions[target] = sdef
+                self._create_junction(sdef)
+            runtime.output_junction = self.junctions[target]
+        elif out is not None:
+            raise SiddhiAppValidationException("table outputs (delete/update) land in M3")
+
+        runtime.rate_limiter = create_rate_limiter(query.output_rate, runtime.send_to_callbacks)
+
+        input_stream_id = query.input_stream.unique_stream_id
+        self.junctions[input_stream_id].subscribe(runtime)
+        self.query_runtimes[query_name] = runtime
+
+    # ------------------------------------------------------------- API
+
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        return self.input_manager.get_input_handler(stream_id)
+
+    # Java-style alias
+    getInputHandler = get_input_handler
+
+    def add_callback(self, id_: str, callback):
+        """addCallback(streamId, StreamCallback) or (queryName, QueryCallback)
+        — reference SiddhiAppRuntimeImpl overloads."""
+        if isinstance(callback, StreamCallback):
+            if id_ not in self.junctions:
+                raise SiddhiAppValidationException(f"stream '{id_}' is not defined")
+            callback.stream_id = id_
+            self.junctions[id_].subscribe(callback)
+            self._stream_callback_adapters.append(callback)
+        elif isinstance(callback, QueryCallback):
+            if id_ not in self.query_runtimes:
+                raise SiddhiAppValidationException(f"query '{id_}' not found")
+            callback.query_name = id_
+            self.query_runtimes[id_].query_callbacks.append(callback)
+        else:
+            raise TypeError(f"unsupported callback type {type(callback)}")
+
+    addCallback = add_callback
+
+    def start(self):
+        if self._started:
+            return
+        self._started = True
+        for j in self.junctions.values():
+            j.start_processing()
+        scheduler = self.app_context.scheduler
+        for qr in self.query_runtimes.values():
+            if qr.rate_limiter is not None:
+                qr.rate_limiter.start(scheduler)
+
+    def shutdown(self):
+        for qr in self.query_runtimes.values():
+            if qr.rate_limiter is not None:
+                qr.rate_limiter.stop()
+        for j in self.junctions.values():
+            j.stop_processing()
+        self._started = False
+
+    @property
+    def query_names(self) -> List[str]:
+        return list(self.query_runtimes)

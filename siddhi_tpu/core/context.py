@@ -1,0 +1,83 @@
+"""Dependency-injection contexts threaded through the runtime.
+
+Mirror of the reference's ``SiddhiContext`` (per-manager),
+``SiddhiAppContext`` (per-app: executors, snapshot service, playback clock,
+root timestamp) and ``SiddhiQueryContext`` (per-query state-holder factory)
+— ``core/config/*.java``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from siddhi_tpu.core.event import StringDictionary
+
+
+class SiddhiContext:
+    """Per-SiddhiManager shared services (reference ``SiddhiContext.java``)."""
+
+    def __init__(self):
+        self.extensions: Dict[str, type] = {}
+        self.persistence_store = None
+        self.incremental_persistence_store = None
+        self.config_manager = None
+        self.attributes: Dict[str, object] = {}
+
+
+class TimestampGenerator:
+    """Event/wall clock (reference ``util/timestamp/TimestampGeneratorImpl.java:31``):
+    live mode returns wall time; playback mode returns the last event
+    timestamp (+ configurable idle increment handled by the scheduler)."""
+
+    def __init__(self):
+        self.playback = False
+        self._last_event_ts: int = -1
+        self._increment_listeners = []
+
+    def current_time(self) -> int:
+        if self.playback and self._last_event_ts >= 0:
+            return self._last_event_ts
+        return int(time.time() * 1000)
+
+    def set_current_timestamp(self, ts: int):
+        if ts > self._last_event_ts:
+            self._last_event_ts = ts
+            for listener in self._increment_listeners:
+                listener(ts)
+
+    def add_time_change_listener(self, fn):
+        self._increment_listeners.append(fn)
+
+
+class SiddhiAppContext:
+    """Per-app context (reference ``core/config/SiddhiAppContext.java``)."""
+
+    def __init__(self, siddhi_context: SiddhiContext, name: str):
+        self.siddhi_context = siddhi_context
+        self.name = name
+        self.timestamp_generator = TimestampGenerator()
+        self.string_dictionary = StringDictionary()
+        self.snapshot_service = None
+        self.scheduler = None
+        self.statistics_manager = None
+        self.playback = False
+        self.enforce_order = False
+        self.root_metrics_level = "OFF"
+        # key-capacity defaults for dense state (padded, grows by recompile)
+        self.initial_key_capacity = 16
+
+
+@dataclass
+class SiddhiQueryContext:
+    """Per-query context (reference ``core/config/SiddhiQueryContext.java``)."""
+
+    siddhi_app_context: SiddhiAppContext = None
+    name: str = ""
+    partitioned: bool = False
+    _state_counter: int = field(default=0)
+
+    def generate_state_id(self) -> str:
+        self._state_counter += 1
+        return f"{self.name}-s{self._state_counter}"

@@ -1,0 +1,174 @@
+"""Event model: user-facing Event rows and columnar batches.
+
+Replaces the reference's pooled row objects and linked-list chunks
+(``core/event/Event.java``, ``event/stream/StreamEvent.java:37-57``,
+``event/ComplexEventChunk.java:62-232``) with a struct-of-arrays design:
+each stream batch is one numpy (host) / jax (device) array per attribute
+plus timestamp, event-type and validity columns. The linked-list surgery of
+``ComplexEventChunk`` becomes mask updates; the CURRENT/EXPIRED/TIMER/RESET
+event types (``ComplexEvent.Type``) become an i8 column.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.ops.types import dtype_of
+from siddhi_tpu.query_api.definitions import AbstractDefinition, AttrType
+
+# ComplexEvent.Type (reference event/ComplexEvent.java)
+CURRENT = 0
+EXPIRED = 1
+TIMER = 2
+RESET = 3
+
+TYPE_NAMES = {CURRENT: "CURRENT", EXPIRED: "EXPIRED", TIMER: "TIMER", RESET: "RESET"}
+
+
+@dataclass
+class Event:
+    """User-facing event (reference ``core/event/Event.java``)."""
+
+    timestamp: int = -1
+    data: Sequence = field(default_factory=list)
+    is_expired: bool = False  # kept for API parity with the reference
+
+    def __repr__(self):
+        return f"Event{{timestamp={self.timestamp}, data={list(self.data)}, isExpired={self.is_expired}}}"
+
+
+class StringDictionary:
+    """App-global string <-> int32 id dictionary.
+
+    Strings never reach the device: group keys, symbols etc. travel as dense
+    ids (the TPU answer to per-event string group-key building in reference
+    ``GroupByKeyGenerator.java:37``). The dictionary only grows, so encoded
+    ids (including ones baked into compiled constants) stay stable.
+    """
+
+    NULL_ID = -1
+
+    def __init__(self):
+        self._to_id: Dict[str, int] = {}
+        self._to_str: List[str] = []
+
+    def encode(self, s: Optional[str]) -> int:
+        if s is None:
+            return self.NULL_ID
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def decode(self, i: int) -> Optional[str]:
+        if i < 0:
+            return None
+        return self._to_str[i]
+
+    def __len__(self):
+        return len(self._to_str)
+
+
+def _pad_len(n: int, minimum: int = 8) -> int:
+    """Pad batch length to a power of two to bound jit recompiles."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class HostBatch:
+    """Columnar batch on host (numpy), convertible to device cols dict.
+
+    Column keys: attribute names (optionally prefixed by the planner), plus
+    reserved ``__ts__`` (i64), ``__type__`` (i8), ``__valid__`` (bool) and
+    per-attribute null masks under ``<key>?``.
+    """
+
+    def __init__(self, cols: Dict[str, np.ndarray]):
+        self.cols = cols
+
+    @property
+    def size(self) -> int:
+        return int(self.cols[VALID_KEY].sum())
+
+    @property
+    def capacity(self) -> int:
+        return self.cols[VALID_KEY].shape[0]
+
+    @staticmethod
+    def from_events(
+        events: Sequence[Event],
+        definition: AbstractDefinition,
+        dictionary: StringDictionary,
+        pad_to: Optional[int] = None,
+        event_type: int = CURRENT,
+    ) -> "HostBatch":
+        n = len(events)
+        b = pad_to if pad_to is not None else _pad_len(n)
+        cols: Dict[str, np.ndarray] = {
+            TS_KEY: np.zeros(b, np.int64),
+            TYPE_KEY: np.full(b, event_type, np.int8),
+            VALID_KEY: np.zeros(b, bool),
+        }
+        cols[VALID_KEY][:n] = True
+        for i, ev in enumerate(events):
+            cols[TS_KEY][i] = ev.timestamp
+        for pos, attr in enumerate(definition.attributes):
+            dtype = dtype_of(attr.type)
+            arr = np.zeros(b, dtype)
+            mask = np.zeros(b, bool)
+            has_null = False
+            for i, ev in enumerate(events):
+                v = ev.data[pos]
+                if v is None:
+                    mask[i] = True
+                    has_null = True
+                elif attr.type == AttrType.STRING:
+                    arr[i] = dictionary.encode(v)
+                else:
+                    arr[i] = v
+            cols[attr.name] = arr
+            if has_null:
+                cols[attr.name + "?"] = mask
+        return HostBatch(cols)
+
+    def to_events(
+        self,
+        attr_order: Sequence[tuple],  # [(key, AttrType), ...]
+        dictionary: StringDictionary,
+        types_wanted: Optional[Sequence[int]] = None,
+    ) -> List[Event]:
+        """Decode valid rows into Events (optionally filtered by type)."""
+        valid = self.cols[VALID_KEY]
+        types = self.cols[TYPE_KEY]
+        ts = self.cols[TS_KEY]
+        out: List[Event] = []
+        idx = np.nonzero(valid)[0]
+        for i in idx:
+            t = int(types[i])
+            if types_wanted is not None and t not in types_wanted:
+                continue
+            data = []
+            for key, attr_type in attr_order:
+                mask = self.cols.get(key + "?")
+                if mask is not None and mask[i]:
+                    data.append(None)
+                    continue
+                v = self.cols[key][i]
+                if attr_type == AttrType.STRING:
+                    data.append(dictionary.decode(int(v)))
+                elif attr_type == AttrType.BOOL:
+                    data.append(bool(v))
+                elif attr_type in (AttrType.INT, AttrType.LONG):
+                    data.append(int(v))
+                else:
+                    data.append(float(v))
+            out.append(Event(timestamp=int(ts[i]), data=data, is_expired=(t == EXPIRED)))
+        return out

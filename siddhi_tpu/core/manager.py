@@ -1,0 +1,52 @@
+"""SiddhiManager: top-level API — app registry + shared context.
+
+Mirror of reference ``core/SiddhiManager.java:49`` (createSiddhiAppRuntime
+:80-96, setExtension:213, persistence-store injection:167, shutdown:270-300).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+from siddhi_tpu.compiler import SiddhiCompiler
+from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
+from siddhi_tpu.core.context import SiddhiContext
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+
+class SiddhiManager:
+    def __init__(self):
+        self.siddhi_context = SiddhiContext()
+        self.app_runtimes: Dict[str, SiddhiAppRuntime] = {}
+
+    def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        if isinstance(app, str):
+            app = SiddhiCompiler.parse(SiddhiCompiler.update_variables(app))
+        runtime = SiddhiAppRuntime(app, self.siddhi_context)
+        self.app_runtimes[runtime.name] = runtime
+        runtime.start()
+        return runtime
+
+    createSiddhiAppRuntime = create_siddhi_app_runtime
+
+    def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
+        return self.app_runtimes.get(name)
+
+    def set_extension(self, name: str, clazz: type):
+        """Register a custom extension (reference SiddhiManager.java:213)."""
+        self.siddhi_context.extensions[name] = clazz
+
+    setExtension = set_extension
+
+    def set_persistence_store(self, store):
+        self.siddhi_context.persistence_store = store
+
+    setPersistenceStore = set_persistence_store
+
+    def set_config_manager(self, config_manager):
+        self.siddhi_context.config_manager = config_manager
+
+    def shutdown(self):
+        for rt in list(self.app_runtimes.values()):
+            rt.shutdown()
+        self.app_runtimes.clear()

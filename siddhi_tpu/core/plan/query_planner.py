@@ -1,0 +1,95 @@
+"""Query planner: query-api Query -> QueryRuntime with a jitted step.
+
+The compile-time counterpart of reference ``util/parser/QueryParser.java:90``
++ ``SingleInputStreamParser.java:82-160`` (handler chain assembly) — but the
+"chain" here is a fused device function, not linked processor objects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from siddhi_tpu.core.context import SiddhiAppContext
+from siddhi_tpu.core.plan.resolvers import SingleStreamResolver
+from siddhi_tpu.core.plan.selector_plan import plan_selector
+from siddhi_tpu.core.query.runtime import GroupKeyer, QueryRuntime
+from siddhi_tpu.ops.expressions import CompileError, compile_condition, compile_expr
+from siddhi_tpu.query_api.definitions import StreamDefinition
+from siddhi_tpu.query_api.execution import (
+    Filter,
+    Query,
+    SingleInputStream,
+    StreamFunction,
+    Window,
+)
+
+
+def plan_query(
+    query: Query,
+    query_name: str,
+    app_context: SiddhiAppContext,
+    definitions: Dict[str, StreamDefinition],
+) -> QueryRuntime:
+    input_stream = query.input_stream
+    if not isinstance(input_stream, SingleInputStream):
+        raise CompileError(
+            f"query '{query_name}': join/pattern/sequence planning lands in M4/M5 "
+            f"(got {type(input_stream).__name__})"
+        )
+    stream_id = input_stream.unique_stream_id
+    if stream_id not in definitions:
+        raise CompileError(f"query '{query_name}': stream '{stream_id}' is not defined")
+    input_def = definitions[stream_id]
+    dictionary = app_context.string_dictionary
+    resolver = SingleStreamResolver(
+        input_def, dictionary, ref_id=input_stream.stream_reference_id, synthetic={}
+    )
+
+    filters = []
+    window_stage = None
+    batch_mode = False
+    for handler in input_stream.handlers:
+        if isinstance(handler, Filter):
+            if window_stage is not None:
+                raise CompileError("post-window filters land with window support (M2)")
+            filters.append(compile_condition(handler.expression, resolver))
+        elif isinstance(handler, Window):
+            from siddhi_tpu.ops.windows import create_window_stage  # cycle-free
+
+            if window_stage is not None:
+                raise CompileError("only one #window per stream is allowed")
+            window_stage = create_window_stage(handler, input_def, resolver, app_context)
+            batch_mode = window_stage.batch_mode
+        elif isinstance(handler, StreamFunction):
+            raise CompileError(f"stream function '{handler.name}' not yet implemented")
+
+    output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
+    selector_plan = plan_selector(
+        selector=query.selector,
+        input_attrs=[(a.name, a.type) for a in input_def.attributes],
+        resolver=resolver,
+        output_event_type=output_event_type,
+        batch_mode=batch_mode,
+        dictionary=dictionary,
+    )
+    selector_plan.num_keys = app_context.initial_key_capacity
+
+    keyer = None
+    if selector_plan.group_by:
+        fns = []
+        for var in query.selector.group_by_list:
+            fn, t = compile_expr(var, resolver)
+            fns.append((fn, t))
+        keyer = GroupKeyer(fns)
+
+    runtime = QueryRuntime(
+        name=query_name,
+        app_context=app_context,
+        input_definition=input_def,
+        filters=filters,
+        window_stage=window_stage,
+        selector_plan=selector_plan,
+        keyer=keyer,
+        dictionary=dictionary,
+    )
+    return runtime

@@ -1,0 +1,251 @@
+"""Selector planning: select/group by/having/order by/limit -> device stage.
+
+The compile-time analog of reference ``SelectorParser.java`` +
+``QuerySelector.java``: aggregator call sites in the selection are split out
+(reference ``ExpressionParser`` detects aggregators via extension holders),
+computed by segmented scans (``ops/aggregators.py``), and the remaining
+scalar expressions become fused projections.
+
+Semantics reproduced (``QuerySelector.processGroupBy``/``processInBatch*``):
+- every CURRENT/EXPIRED row updates aggregators and yields an output row;
+- RESET rows reset all group states and yield nothing;
+- TIMER rows are dropped;
+- currentOn/expiredOn filtering, then `having`;
+- batch chunks (from batch windows) keep only the last row per group
+  (``processInBatchGroupBy``) or overall (``processInBatchNoGroupBy``);
+- order by / offset / limit apply per output chunk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.plan.resolvers import OutputColsResolver
+from siddhi_tpu.ops import aggregators as agg_ops
+from siddhi_tpu.ops.expressions import (
+    TS_KEY,
+    TYPE_KEY,
+    VALID_KEY,
+    CompileError,
+    Resolver,
+    compile_condition,
+    compile_expr,
+)
+from siddhi_tpu.query_api.definitions import AttrType
+from siddhi_tpu.query_api.execution import Selector
+from siddhi_tpu.query_api.expressions import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    Divide,
+    Expression,
+    IsNull,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    Variable,
+)
+
+CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
+GK_KEY = "__gk__"
+FLUSH_KEY = "__flush__"
+
+
+def _rewrite_aggregators(expr: Expression, specs: List[agg_ops.AggSpec], resolver: Resolver) -> Expression:
+    """Replace aggregator calls with synthetic Variables bound to scan
+    output columns (the split the reference does in ExpressionParser when it
+    routes AttributeFunctions to AttributeAggregatorExecutors)."""
+    if isinstance(expr, AttributeFunction) and not expr.namespace \
+            and expr.name.lower() in agg_ops.supported_aggregators():
+        kind = expr.name.lower()
+        if expr.parameters:
+            arg_f, arg_t = compile_expr(expr.parameters[0], resolver)
+        else:
+            arg_f, arg_t = None, None
+        if kind != "count" and arg_f is None:
+            raise CompileError(f"{kind}() requires an argument")
+        out_key = f"__agg{len(specs)}__"
+        out_type = agg_ops.agg_result_type(kind, arg_t)
+        specs.append(agg_ops.AggSpec(kind=kind, arg_fn=arg_f, arg_type=arg_t,
+                                     out_key=out_key, out_type=out_type))
+        return Variable(attribute_name=out_key)
+    for attr_name in ("left", "right", "expression"):
+        child = getattr(expr, attr_name, None)
+        if isinstance(child, Expression):
+            setattr(expr, attr_name, _rewrite_aggregators(child, specs, resolver))
+    if isinstance(expr, AttributeFunction):
+        expr.parameters = [_rewrite_aggregators(p, specs, resolver) for p in expr.parameters]
+    return expr
+
+
+@dataclass
+class SelectorPlan:
+    """Compiled selector; `apply` is traced inside the query step."""
+
+    specs: List[agg_ops.AggSpec]
+    projections: List[Tuple[str, Callable, AttrType]]  # (out name, fn, type)
+    output_attrs: List[Tuple[str, AttrType]]
+    having_fn: Optional[Callable]
+    group_by: bool
+    group_key_exprs: List
+    current_on: bool
+    expired_on: bool
+    batch_mode: bool          # upstream emits batch chunks (batch windows)
+    order_by: List[Tuple[str, bool]]  # (out col, descending)
+    limit: Optional[int]
+    offset: Optional[int]
+    num_keys: int = 16
+
+    @property
+    def contains_aggregator(self) -> bool:
+        return bool(self.specs)
+
+    def init_state(self) -> dict:
+        return agg_ops.init_agg_state(self.specs, self.num_keys)
+
+    def apply(self, state: dict, cols: dict, ctx: dict):
+        xp = ctx["xp"]
+        if self.specs:
+            state, cols = agg_ops.apply_aggregators(self.specs, state, cols, ctx, self.num_keys)
+
+        out: Dict[str, object] = {
+            TS_KEY: cols[TS_KEY],
+            TYPE_KEY: cols[TYPE_KEY],
+            VALID_KEY: cols[VALID_KEY],
+            GK_KEY: cols.get(GK_KEY, jnp.zeros_like(cols[TS_KEY], dtype=jnp.int32)),
+        }
+        if FLUSH_KEY in cols:
+            out[FLUSH_KEY] = cols[FLUSH_KEY]
+        B = cols[TS_KEY].shape[0]
+        for name, fn, _t in self.projections:
+            v, m = fn(cols, ctx)
+            v = xp.asarray(v)
+            if v.ndim == 0:
+                v = xp.broadcast_to(v, (B,))
+            out[name] = v
+            if m is not None:
+                out[name + "?"] = m
+
+        types = cols[TYPE_KEY]
+        valid = cols[VALID_KEY]
+        type_ok = ((types == CURRENT) & self.current_on) | ((types == EXPIRED) & self.expired_on)
+        valid = valid & type_ok
+        if self.having_fn is not None:
+            valid = valid & self.having_fn(out, ctx)
+
+        if self.batch_mode and (self.contains_aggregator or self.group_by):
+            # keep only the last valid row per (flush epoch, group)
+            gk = out[GK_KEY] if self.group_by else jnp.zeros(B, jnp.int32)
+            flush = out.get(FLUSH_KEY, jnp.zeros(B, jnp.int32))
+            combo = flush.astype(jnp.int64) * jnp.int64(self.num_keys + 1) + gk.astype(jnp.int64)
+            combo = jnp.where(valid, combo, jnp.int64(2**62))  # invalid rows last
+            order = jnp.argsort(combo, stable=True)
+            combo_sorted = combo[order]
+            seg_last = jnp.concatenate([combo_sorted[1:] != combo_sorted[:-1], jnp.ones(1, bool)])
+            is_last_sorted = valid[order] & seg_last
+            valid = jnp.zeros(B, bool).at[order].set(is_last_sorted)
+
+        out[VALID_KEY] = valid
+
+        if self.order_by:
+            # jnp.lexsort: last key is the primary sort key
+            keys = []
+            for col, desc in reversed(self.order_by):
+                k = out[col]
+                if k.dtype == jnp.bool_:
+                    k = k.astype(jnp.int32)
+                keys.append(-k if desc else k)
+            keys.append(jnp.where(valid, 0, 1))  # valid rows first (primary)
+            order = jnp.lexsort(keys)
+            out = {k: v[order] for k, v in out.items()}
+            valid = out[VALID_KEY]
+
+        if self.limit is not None or self.offset is not None:
+            rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
+            lo = self.offset or 0
+            keep = rank >= lo
+            if self.limit is not None:
+                keep = keep & (rank < lo + self.limit)
+            out[VALID_KEY] = valid & keep
+
+        return state, out
+
+
+def _lexsort(keys):
+    order = jnp.argsort(keys[-1], stable=True)
+    for k in reversed(keys[:-1]):
+        order = order[jnp.argsort(k[order], stable=True)]
+    return order
+
+
+def plan_selector(
+    selector: Selector,
+    input_attrs: List[Tuple[str, AttrType]],
+    resolver: Resolver,
+    output_event_type: str,
+    batch_mode: bool,
+    dictionary,
+) -> SelectorPlan:
+    specs: List[agg_ops.AggSpec] = []
+
+    selections: List[Tuple[str, Expression]] = []
+    if selector.select_all or not selector.selection_list:
+        for name, _t in input_attrs:
+            selections.append((name, Variable(attribute_name=name)))
+    else:
+        for oa in selector.selection_list:
+            selections.append((oa.name, oa.expression))
+
+    projections = []
+    output_attrs: List[Tuple[str, AttrType]] = []
+    for name, expr in selections:
+        rewritten = _rewrite_aggregators(expr, specs, resolver)
+        # synthetic agg columns resolve through the same resolver
+        _augment_synthetic(resolver, specs)
+        fn, t = compile_expr(rewritten, resolver)
+        projections.append((name, fn, t))
+        output_attrs.append((name, t))
+
+    having_fn = None
+    out_resolver = OutputColsResolver(output_attrs, dictionary, fallback=resolver)
+    if selector.having is not None:
+        having = _rewrite_aggregators(selector.having, specs, resolver)
+        _augment_synthetic(resolver, specs)
+        having_fn = compile_condition(having, out_resolver)
+
+    order_by = []
+    for ob in selector.order_by_list:
+        ref = out_resolver.resolve(ob.variable)
+        order_by.append((ref.key, ob.order == "desc"))
+
+    current_on = output_event_type in ("current", "all")
+    expired_on = output_event_type in ("expired", "all")
+
+    return SelectorPlan(
+        specs=specs,
+        projections=projections,
+        output_attrs=output_attrs,
+        having_fn=having_fn,
+        group_by=bool(selector.group_by_list),
+        group_key_exprs=list(selector.group_by_list),
+        current_on=current_on,
+        expired_on=expired_on,
+        batch_mode=batch_mode,
+        order_by=order_by,
+        limit=selector.limit,
+        offset=selector.offset,
+    )
+
+
+def _augment_synthetic(resolver, specs):
+    synthetic = getattr(resolver, "synthetic", None)
+    if synthetic is not None:
+        for s in specs:
+            synthetic[s.out_key] = s.out_type

@@ -1,0 +1,19 @@
+"""QueryCallback: user hook on a query's output.
+
+Mirror of reference ``core/query/output/callback/QueryCallback.java``:
+``receive(timestamp, inEvents, removeEvents)`` where inEvents are CURRENT
+outputs and removeEvents are EXPIRED outputs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from siddhi_tpu.core.event import Event
+
+
+class QueryCallback:
+    query_name: str = ""
+
+    def receive(self, timestamp: int, in_events: Optional[List[Event]], remove_events: Optional[List[Event]]):
+        raise NotImplementedError

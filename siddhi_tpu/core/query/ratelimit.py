@@ -1,0 +1,130 @@
+"""Output rate limiters (host side).
+
+Mirror of reference ``query/output/ratelimit/**`` (19 classes): pass-through,
+first/last/all per N events, first/last/all per time period, and snapshot
+emitters. Rate limiting operates on decoded output chunks between the
+selector and the callbacks (``OutputRateLimiter.sendToCallBacks:64-108``).
+
+Time-based limiters are driven by the app scheduler (wall clock in live
+mode, event time in playback) — they register a periodic trigger.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.query_api.execution import (
+    EventOutputRate,
+    OutputRate,
+    SnapshotOutputRate,
+    TimeOutputRate,
+)
+
+
+class OutputRateLimiter:
+    def __init__(self, send: Callable[[List[Event]], None]):
+        self._send = send
+
+    def process(self, events: List[Event]):
+        raise NotImplementedError
+
+    def start(self, scheduler=None):
+        pass
+
+    def stop(self):
+        pass
+
+
+class PassThroughRateLimiter(OutputRateLimiter):
+    """``PassThroughOutputRateLimiter`` — no limiting."""
+
+    def process(self, events: List[Event]):
+        if events:
+            self._send(events)
+
+
+class EventRateLimiter(OutputRateLimiter):
+    """all/first/last every N events (reference
+    ``ratelimit/event/{All,First,Last}PerEventOutputRateLimiter``)."""
+
+    def __init__(self, send, value: int, kind: str):
+        super().__init__(send)
+        self.value = value
+        self.kind = kind
+        self._counter = 0
+        self._pending: List[Event] = []
+
+    def process(self, events: List[Event]):
+        out: List[Event] = []
+        for ev in events:
+            self._counter += 1
+            if self.kind == "first":
+                if self._counter == 1:
+                    out.append(ev)
+            elif self.kind == "last":
+                self._pending = [ev]
+            else:
+                self._pending.append(ev)
+            if self._counter == self.value:
+                self._counter = 0
+                if self.kind in ("all", "last"):
+                    out.extend(self._pending)
+                    self._pending = []
+        if out:
+            self._send(out)
+
+
+class TimeRateLimiter(OutputRateLimiter):
+    """all/first/last every T ms, flushed by a scheduler tick (reference
+    ``ratelimit/time/*PerTimeOutputRateLimiter``)."""
+
+    def __init__(self, send, value: int, kind: str):
+        super().__init__(send)
+        self.value = value
+        self.kind = kind
+        self._pending: List[Event] = []
+        self._sent_first = False
+        self._scheduler = None
+        self._job = None
+
+    def start(self, scheduler=None):
+        self._scheduler = scheduler
+        if scheduler is not None:
+            self._job = scheduler.schedule_periodic(self.value, self._tick)
+
+    def stop(self):
+        if self._scheduler is not None and self._job is not None:
+            self._scheduler.cancel(self._job)
+
+    def _tick(self, _ts: int):
+        if self.kind == "first":
+            self._sent_first = False
+            return
+        if self._pending:
+            out, self._pending = self._pending, []
+            self._send(out)
+
+    def process(self, events: List[Event]):
+        if self.kind == "first":
+            if not self._sent_first and events:
+                self._sent_first = True
+                self._send(events[:1])
+        elif self.kind == "last":
+            if events:
+                self._pending = [events[-1]]
+        else:
+            self._pending.extend(events)
+
+
+def create_rate_limiter(rate: Optional[OutputRate], send) -> OutputRateLimiter:
+    if rate is None:
+        return PassThroughRateLimiter(send)
+    if isinstance(rate, EventOutputRate):
+        return EventRateLimiter(send, rate.value, rate.type)
+    if isinstance(rate, TimeOutputRate):
+        return TimeRateLimiter(send, rate.value, rate.type)
+    if isinstance(rate, SnapshotOutputRate):
+        # snapshot limiter re-emits the full last-known output every T
+        return TimeRateLimiter(send, rate.value, "last")
+    raise NotImplementedError(f"rate {rate!r}")

@@ -1,0 +1,208 @@
+"""QueryRuntime: host driver for one compiled query.
+
+The counterpart of the reference's receiver->processor-chain->selector
+->rate-limiter->callback assembly (``QueryParser.java:90-283``,
+``ProcessStreamReceiver.java:74-184``), inverted for TPU: the junction hands
+the runtime a chunk of events, the runtime packs them into a padded columnar
+batch, computes group-key ids host-side (dense dictionary — the analog of
+``GroupByKeyGenerator.java:37`` string keys), runs the jitted device step
+(filters + windows + selector fused by XLA), and decodes valid output rows
+back to Events for rate limiting and callbacks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.event import CURRENT, EXPIRED, Event, HostBatch, StringDictionary
+from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
+from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
+from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
+
+
+class GroupKeyer:
+    """Host-side (group-by or partition) key dictionary: maps tuples of
+    key-expression values to dense ids used to index ``[K, ...]`` state."""
+
+    def __init__(self, fns: List[Tuple[Callable, AttrType]]):
+        self._fns = fns
+        self._map: Dict[tuple, int] = {}
+        # fast path: single string attribute -> LUT from dict id to key id
+        self._single_string = len(fns) == 1 and fns[0][1] == AttrType.STRING
+        self._lut = np.full(64, -1, np.int32)
+
+    def __len__(self):
+        return len(self._map)
+
+    def __call__(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
+        ctx = {"xp": np}
+        valid = cols[VALID_KEY]
+        B = valid.shape[0]
+        gk = np.zeros(B, np.int32)
+        if self._single_string:
+            v, _m = self._fns[0][0](cols, ctx)
+            ids = np.asarray(v, np.int64)
+            top = int(ids.max(initial=0)) + 1
+            if top > self._lut.shape[0]:
+                grown = np.full(max(top, 2 * self._lut.shape[0]), -1, np.int32)
+                grown[: self._lut.shape[0]] = self._lut
+                self._lut = grown
+            for sid in np.unique(ids[valid]):
+                if self._lut[sid] < 0:
+                    self._lut[sid] = self._map.setdefault((int(sid),), len(self._map))
+            np.take(self._lut, ids, out=gk)
+            gk[~valid] = 0
+            return gk
+        vals = []
+        for fn, _t in self._fns:
+            v, _m = fn(cols, ctx)
+            vals.append(np.broadcast_to(np.asarray(v), (B,)))
+        for i in np.nonzero(valid)[0]:
+            key = tuple(x[i].item() for x in vals)
+            gk[i] = self._map.setdefault(key, len(self._map))
+        return gk
+
+
+class QueryRuntime(Receiver):
+    def __init__(
+        self,
+        name: str,
+        app_context,
+        input_definition: StreamDefinition,
+        filters: List[Callable],
+        window_stage,               # ops stage or None (M2)
+        selector_plan: SelectorPlan,
+        keyer: Optional[GroupKeyer],
+        dictionary: StringDictionary,
+    ):
+        self.name = name
+        self.app_context = app_context
+        self.input_definition = input_definition
+        self.filters = filters
+        self.window_stage = window_stage
+        self.selector_plan = selector_plan
+        self.keyer = keyer
+        self.dictionary = dictionary
+        self.rate_limiter: Optional[OutputRateLimiter] = None
+        self.query_callbacks: List = []
+        self.output_junction: Optional[StreamJunction] = None
+        self._state: Optional[dict] = None
+        self._step = None
+        self._batch_capacity: Optional[int] = None
+        self.on_error: Optional[Callable] = None
+
+    # ---------------------------------------------------------------- state
+
+    @property
+    def output_attrs(self) -> List[Tuple[str, AttrType]]:
+        return self.selector_plan.output_attrs
+
+    def _init_state(self) -> dict:
+        state = {"sel": self.selector_plan.init_state()}
+        if self.window_stage is not None:
+            state["win"] = self.window_stage.init_state(self.selector_plan.num_keys)
+        return state
+
+    def _ensure_capacity(self):
+        """Grow dense key capacity (pow2) when the key dictionary outgrows
+        it; state rows are preserved, step re-jitted on the new shapes."""
+        if self.keyer is None:
+            return
+        needed = max(len(self.keyer), 1)
+        k = self.selector_plan.num_keys
+        if needed <= k:
+            return
+        while k < needed:
+            k *= 2
+        old_state = self._state
+        self.selector_plan.num_keys = k
+        if self.window_stage is not None:
+            self.window_stage.num_keys = k
+        new_state = self._init_state()
+        if old_state is not None:
+            self._state = jax.tree_util.tree_map(_copy_prefix, new_state, old_state)
+        else:
+            self._state = new_state
+        self._step = None  # re-jit
+
+    def _make_step(self):
+        filters = list(self.filters)
+        sel = self.selector_plan
+        win = self.window_stage
+
+        def step(state, cols, current_time):
+            ctx = {"xp": jnp, "current_time": current_time}
+            cols = dict(cols)
+            valid = cols[VALID_KEY]
+            timer = cols[TYPE_KEY] == 2
+            for f in filters:
+                valid = valid & (f(cols, ctx) | timer)
+            cols[VALID_KEY] = valid
+            new_state = dict(state)
+            if win is not None:
+                new_state["win"], cols = win.apply(state["win"], cols, ctx)
+            new_state["sel"], out = sel.apply(state["sel"], cols, ctx)
+            return new_state, out
+
+        return jax.jit(step, donate_argnums=0)
+
+    # ----------------------------------------------------------- processing
+
+    def receive(self, events: List[Event]):
+        batch = HostBatch.from_events(events, self.input_definition, self.dictionary)
+        self.process_batch(batch)
+
+    def process_batch(self, batch: HostBatch):
+        cols = batch.cols
+        if self.keyer is not None:
+            gk = self.keyer(cols)
+            cols[GK_KEY] = gk
+            self._ensure_capacity()
+        else:
+            cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
+        if self._state is None:
+            self._state = self._init_state()
+        if self._step is None:
+            self._step = self._make_step()
+        now = np.int64(self.app_context.timestamp_generator.current_time())
+        self._state, out = self._step(self._state, cols, now)
+        out_host = {k: np.asarray(v) for k, v in out.items()}
+        self._emit(HostBatch(out_host))
+
+    def _emit(self, out: HostBatch):
+        if out.size == 0:
+            return
+        events = out.to_events(self.output_attrs, self.dictionary)
+        if self.rate_limiter is not None:
+            self.rate_limiter.process(events)
+        else:
+            self.send_to_callbacks(events)
+
+    def send_to_callbacks(self, events: List[Event]):
+        if not events:
+            return
+        if self.output_junction is not None:
+            # EXPIRED -> CURRENT on re-publish (InsertIntoStreamCallback.java:52-55)
+            repub = [
+                Event(timestamp=e.timestamp, data=e.data) if e.is_expired else e
+                for e in events
+            ]
+            self.output_junction.send_events(repub)
+        for cb in self.query_callbacks:
+            in_events = [e for e in events if not e.is_expired] or None
+            remove_events = [e for e in events if e.is_expired] or None
+            cb.receive(events[0].timestamp, in_events, remove_events)
+
+
+def _copy_prefix(new, old):
+    """Copy old state into the (larger) new buffer along the key axis."""
+    if new.shape == old.shape:
+        return old
+    sl = tuple(slice(0, s) for s in old.shape)
+    return new.at[sl].set(old)

@@ -1,0 +1,64 @@
+"""InputHandler / InputManager: API entry for pushing events.
+
+Mirror of reference ``core/stream/input/InputHandler.java:59`` (``send``
+variants set the playback clock then forward into the junction) and
+``InputManager.java``. The snapshot quiesce gate (``InputEntryValve`` +
+``ThreadBarrier``) is a host-side RLock here.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.stream.junction import StreamJunction
+
+
+class InputHandler:
+    def __init__(self, stream_id: str, junction: StreamJunction, app_context, barrier: threading.RLock):
+        self.stream_id = stream_id
+        self.junction = junction
+        self.app_context = app_context
+        self._barrier = barrier
+
+    def send(self, *args):
+        """send(data_list) | send(ts, data_list) | send(Event) | send([Event,...])"""
+        tsg = self.app_context.timestamp_generator
+        if len(args) == 1:
+            a = args[0]
+            if isinstance(a, Event):
+                events = [a]
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Event):
+                events = list(a)
+            else:
+                events = [Event(timestamp=tsg.current_time(), data=list(a))]
+        elif len(args) == 2 and isinstance(args[0], int):
+            events = [Event(timestamp=args[0], data=list(args[1]))]
+        else:
+            raise TypeError(f"unsupported send arguments: {args!r}")
+        for ev in events:
+            if ev.timestamp < 0:
+                ev.timestamp = tsg.current_time()
+            tsg.set_current_timestamp(ev.timestamp)
+        with self._barrier:  # snapshot quiesce gate (ThreadBarrier.java:30-36)
+            self.junction.send_events(events)
+
+
+class InputManager:
+    """Reference ``core/stream/input/InputManager.java``."""
+
+    def __init__(self, app_context, junctions: Dict[str, StreamJunction], barrier: threading.RLock):
+        self.app_context = app_context
+        self._junctions = junctions
+        self._barrier = barrier
+        self._handlers: Dict[str, InputHandler] = {}
+
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        h = self._handlers.get(stream_id)
+        if h is None:
+            if stream_id not in self._junctions:
+                raise KeyError(f"stream '{stream_id}' is not defined")
+            h = InputHandler(stream_id, self._junctions[stream_id], self.app_context, self._barrier)
+            self._handlers[stream_id] = h
+        return h

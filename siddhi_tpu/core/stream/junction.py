@@ -1,0 +1,118 @@
+"""StreamJunction: per-stream pub/sub bus.
+
+Mirror of reference ``core/stream/StreamJunction.java``: each defined stream
+gets a junction; producers publish event chunks, receivers (query input
+processors, stream callbacks, sinks) subscribe. Sync mode fans out directly
+(``StreamJunction.java:175-178``); ``@Async`` buffering is a host-side queue
++ worker thread (the Disruptor's role, ``:276-313``) — see
+``enable_async``. ``@OnError(action='STREAM')`` fault routing
+(``:368-430``) publishes failed events + error into the shadow ``!stream``
+junction.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+from typing import List, Optional
+
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.query_api.definitions import StreamDefinition
+
+log = logging.getLogger(__name__)
+
+
+class Receiver:
+    """Subscriber interface (reference StreamJunction.Receiver)."""
+
+    def receive(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class StreamJunction:
+    def __init__(self, definition: StreamDefinition, app_context, fault_junction: Optional["StreamJunction"] = None):
+        self.definition = definition
+        self.app_context = app_context
+        self.receivers: List[Receiver] = []
+        self.fault_junction = fault_junction
+        self.on_error_action = "LOG"  # LOG | STREAM (from @OnError)
+        self._async = False
+        self._queue: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._batch_size = 256
+        self._running = False
+
+    def subscribe(self, receiver: Receiver):
+        if receiver not in self.receivers:
+            self.receivers.append(receiver)
+
+    def enable_async(self, buffer_size: int = 1024, batch_size: int = 256):
+        """@Async: decouple producers via a bounded queue + one worker that
+        re-batches up to batch_size (the role of StreamHandler.java:57-71)."""
+        self._async = True
+        self._batch_size = batch_size
+        self._queue = queue.Queue(maxsize=buffer_size)
+
+    def start_processing(self):
+        self._running = True
+        if self._async:
+            self._worker = threading.Thread(target=self._drain, daemon=True,
+                                            name=f"junction-{self.definition.id}")
+            self._worker.start()
+
+    def stop_processing(self):
+        self._running = False
+        if self._worker is not None:
+            self._queue.put(None)
+            self._worker.join(timeout=5)
+            self._worker = None
+
+    def send_events(self, events: List[Event]):
+        if not events:
+            return
+        if self._async and self._running:
+            self._queue.put(events)
+        else:
+            self._deliver(events)
+
+    def _drain(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            batch = list(item)
+            # re-batch pending chunks up to batch_size
+            while len(batch) < self._batch_size:
+                try:
+                    more = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if more is None:
+                    self._deliver(batch)
+                    return
+                batch.extend(more)
+            self._deliver(batch)
+
+    def _deliver(self, events: List[Event]):
+        for r in self.receivers:
+            try:
+                r.receive(events)
+            except Exception as e:  # noqa: BLE001 — fault-stream routing
+                self.handle_error(events, e)
+
+    def handle_error(self, events: List[Event], e: Exception):
+        if self.on_error_action == "STREAM" and self.fault_junction is not None:
+            # fault stream schema = original attrs + _error (reference
+            # FaultStreamEventConverter)
+            fault_events = [
+                Event(timestamp=ev.timestamp, data=list(ev.data) + [str(e)]) for ev in events
+            ]
+            self.fault_junction.send_events(fault_events)
+        else:
+            log.error(
+                "error processing events in stream '%s': %s\n%s",
+                self.definition.id, e, traceback.format_exc(),
+            )
+            raise e

@@ -1,0 +1,23 @@
+"""StreamCallback: user hook receiving all events of a stream.
+
+Mirror of reference ``core/stream/output/StreamCallback.java`` — subscribe
+to a junction, override ``receive``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.stream.junction import Receiver
+
+
+class StreamCallback(Receiver):
+    stream_id: str = ""
+
+    def receive(self, events: List[Event]):
+        raise NotImplementedError
+
+    # parity helper with reference's to Event[] signature
+    def receive_events(self, events: List[Event]):
+        self.receive(events)

@@ -1,0 +1,9 @@
+"""Pure compute kernels: expression lowering, windows, aggregators, NFA, join.
+
+This package replaces the reference's per-event interpreter/executor layer
+(siddhi-core ``core/executor/**``, ``query/processor/**``,
+``query/selector/**``) with columnar, trace-friendly functions over batch
+arrays. Every function here is dual-backend: it takes ``xp`` (numpy or
+jax.numpy) so the same lowering serves host-side pre-processing and the
+jitted device step.
+"""

@@ -1,0 +1,304 @@
+"""Attribute aggregators as segmented prefix scans over dense keyed state.
+
+Replaces the reference's per-(group,aggregator) State objects updated one
+event at a time (``query/selector/attribute/aggregator/*.java``, 13 files;
+state addressing via thread-local flows, ``PartitionStateHolder.java:43-48``)
+with:
+
+- per-aggregator state tuples of ``[K]`` arrays (K = padded key capacity);
+- one **segmented associative scan** per batch that reproduces the exact
+  sequential semantics: CURRENT -> processAdd, EXPIRED -> processRemove,
+  RESET -> all-group reset (``AttributeAggregatorExecutor.processReset``
+  calls ``cleanGroupByStates()``), with the per-event running value emitted
+  for every event, as ``QuerySelector.processGroupBy`` does.
+
+The scan sorts the batch by (group, position), pre-folds persistent state
+into each group's first row, marks segment starts / in-batch RESET epochs as
+"blocked" rows, runs ``lax.associative_scan`` with the aggregator's combine
+op, and scatters the last-row-per-group values back into the state.
+
+Invertible aggregators (sum/count/avg/stdDev/and/or) encode EXPIRED as
+negative deltas. min/max over windows that emit EXPIRED events need the
+ring-recompute path (``ops/windows.py``); without expired input they are
+plain monoid scans here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from siddhi_tpu.ops import types as T
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.query_api.definitions import AttrType
+
+CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
+
+_NEG_INF = {jnp.int32: np.iinfo(np.int32).min, jnp.int64: np.iinfo(np.int64).min}
+
+
+@dataclass
+class AggSpec:
+    """One aggregator call site in the selection list."""
+
+    kind: str                      # 'sum' | 'count' | 'avg' | ...
+    arg_fn: Optional[Callable]     # compiled arg expr fn(cols, ctx) -> (v, mask); None for count()
+    arg_type: Optional[AttrType]
+    out_key: str                   # synthetic output column name (__agg<i>__)
+    out_type: AttrType = AttrType.DOUBLE
+
+    # filled by the planner:
+    @property
+    def slots(self) -> int:
+        return _AGG_DEFS[self.kind].slots
+
+
+@dataclass
+class _AggDef:
+    slots: int
+    combine: str  # 'add' | 'min' | 'max'
+
+
+_AGG_DEFS = {
+    "sum": _AggDef(1, "add"),
+    "count": _AggDef(1, "add"),
+    "avg": _AggDef(2, "add"),        # (sum, count)
+    "stddev": _AggDef(3, "add"),     # (sum, sumsq, count)
+    "and": _AggDef(1, "add"),        # false-count
+    "or": _AggDef(1, "add"),         # true-count
+    "min": _AggDef(1, "min"),
+    "max": _AggDef(1, "max"),
+    "minforever": _AggDef(1, "min"),
+    "maxforever": _AggDef(1, "max"),
+}
+
+
+def agg_result_type(kind: str, arg_type: Optional[AttrType]) -> AttrType:
+    """Return types per the reference aggregators (e.g. sum: LONG for
+    int/long input, DOUBLE for float/double — ``SumAttributeAggregatorExecutor``;
+    avg/stdDev always DOUBLE; min/max preserve the input type)."""
+    if kind == "count":
+        return AttrType.LONG
+    if kind in ("avg", "stddev"):
+        return AttrType.DOUBLE
+    if kind == "sum":
+        if arg_type in (AttrType.INT, AttrType.LONG):
+            return AttrType.LONG
+        return AttrType.DOUBLE
+    if kind in ("and", "or"):
+        return AttrType.BOOL
+    if kind in ("min", "max", "minforever", "maxforever"):
+        return arg_type
+    raise KeyError(kind)
+
+
+def supported_aggregators() -> Tuple[str, ...]:
+    return tuple(_AGG_DEFS)
+
+
+def _identity(kind: str, dtype) -> np.ndarray:
+    d = _AGG_DEFS[kind]
+    if d.combine == "add":
+        return np.zeros((), dtype)
+    if d.combine == "min":
+        return np.asarray(np.inf if np.issubdtype(dtype, np.floating) else np.iinfo(dtype).max, dtype)
+    return np.asarray(-np.inf if np.issubdtype(dtype, np.floating) else np.iinfo(dtype).min, dtype)
+
+
+def _slot_dtype(spec: AggSpec):
+    """Accumulation dtype: Java accumulates sums in long/double."""
+    d = _AGG_DEFS[spec.kind]
+    if d.combine == "add":
+        if spec.kind in ("count", "and", "or"):
+            return np.int64
+        if spec.kind == "sum" and spec.arg_type in (AttrType.INT, AttrType.LONG):
+            return np.int64
+        return np.float64
+    return T.dtype_of(spec.arg_type)
+
+
+def init_agg_state(specs: List[AggSpec], num_keys: int) -> dict:
+    """State pytree: per spec a [slots, K] array (plus a seen-flag per key)."""
+    state = {}
+    for i, spec in enumerate(specs):
+        dtype = _slot_dtype(spec)
+        init = _identity(spec.kind, dtype)
+        state[f"a{i}"] = jnp.broadcast_to(jnp.asarray(init), (spec.slots, num_keys)).astype(dtype)
+    return state
+
+
+def _deltas(spec: AggSpec, cols, ctx, xp):
+    """Per-event delta tuple [slots, B] + identity substitution for
+    non-participating rows (invalid / TIMER / RESET / null arg)."""
+    types = cols[TYPE_KEY]
+    valid = cols[VALID_KEY]
+    is_cur = valid & (types == CURRENT)
+    is_exp = valid & (types == EXPIRED)
+    dtype = _slot_dtype(spec)
+    ident = jnp.asarray(_identity(spec.kind, dtype))
+
+    if spec.arg_fn is not None:
+        v, null_mask = spec.arg_fn(cols, ctx)
+        v = xp.asarray(v).astype(dtype)
+        if null_mask is not None:
+            # null arguments leave the state untouched (reference aggregators
+            # guard `if (data == null) return currentValue()`)
+            is_cur = is_cur & ~null_mask
+            is_exp = is_exp & ~null_mask
+    else:
+        v = None
+
+    k = spec.kind
+    if k == "sum":
+        d = xp.where(is_cur, v, xp.where(is_exp, -v, ident))
+        return d[None, :]
+    if k == "count":
+        d = xp.where(is_cur, 1, xp.where(is_exp, -1, 0)).astype(dtype)
+        return d[None, :]
+    if k == "avg":
+        sgn = xp.where(is_cur, 1.0, xp.where(is_exp, -1.0, 0.0))
+        return xp.stack([sgn * v, sgn])
+    if k == "stddev":
+        sgn = xp.where(is_cur, 1.0, xp.where(is_exp, -1.0, 0.0))
+        return xp.stack([sgn * v, sgn * v * v, sgn])
+    if k == "and":
+        # false-count (reference AndAttributeAggregatorExecutor)
+        is_false = ~v.astype(bool)
+        d = (xp.where(is_cur & is_false, 1, 0) - xp.where(is_exp & is_false, 1, 0)).astype(dtype)
+        return d[None, :]
+    if k == "or":
+        is_true = v.astype(bool)
+        d = (xp.where(is_cur & is_true, 1, 0) - xp.where(is_exp & is_true, 1, 0)).astype(dtype)
+        return d[None, :]
+    if k in ("min", "max"):
+        d = xp.where(is_cur, v, ident)
+        return d[None, :]
+    if k in ("minforever", "maxforever"):
+        # forever variants also fold EXPIRED events in (processRemove updates
+        # the same way — reference MaxForeverAttributeAggregatorExecutor)
+        d = xp.where(is_cur | is_exp, v, ident)
+        return d[None, :]
+    raise KeyError(k)
+
+
+def _combine(kind: str):
+    c = _AGG_DEFS[kind].combine
+    if c == "add":
+        return lambda a, b: a + b
+    if c == "min":
+        return jnp.minimum
+    return jnp.maximum
+
+
+def _output(spec: AggSpec, slots, ctx):
+    """Running value -> (value, null_mask) per the reference return rules."""
+    xp = ctx["xp"]
+    k = spec.kind
+    if k in ("sum", "count"):
+        return slots[0], None
+    if k == "avg":
+        s, c = slots[0], slots[1]
+        empty = c == 0
+        v = s / xp.where(empty, 1.0, c)
+        return v, empty  # avg over empty -> null (AvgAttributeAggregatorStateDouble)
+    if k == "stddev":
+        s, sq, c = slots
+        empty = c == 0
+        n = xp.where(empty, 1.0, c)
+        mean = s / n
+        var = xp.maximum(sq / n - mean * mean, 0.0)
+        return xp.sqrt(var), empty
+    if k == "and":
+        return slots[0] == 0, None
+    if k == "or":
+        return slots[0] > 0, None
+    # min/max family: every output row folds at least its own value, so the
+    # running value is well-defined wherever an output is emitted.
+    return slots[0], None
+
+
+def apply_aggregators(specs: List[AggSpec], state: dict, cols: dict, ctx: dict,
+                      num_keys: int) -> Tuple[dict, dict]:
+    """Run all aggregator scans for one batch.
+
+    Requires cols['__gk__'] (int32 group ids; all-zero when no group-by).
+    Adds per-spec output columns spec.out_key (+ '?' null masks) with the
+    post-event running value for every row. Returns (new_state, cols).
+    """
+    xp = ctx["xp"]
+    gk = cols["__gk__"]
+    valid = cols[VALID_KEY]
+    types = cols[TYPE_KEY]
+    B = gk.shape[0]
+
+    participates = valid & ((types == CURRENT) | (types == EXPIRED))
+    is_reset = valid & (types == RESET)
+    any_reset = jnp.any(is_reset)
+
+    # sort rows by group; pad/invalid rows go last (gk = num_keys)
+    sort_gk = jnp.where(participates | is_reset, gk, num_keys).astype(jnp.int32)
+    # RESET rows apply to ALL groups: they act through the epoch counter, so
+    # exclude them from any single group's run (sort them to the end too).
+    sort_gk = jnp.where(is_reset, num_keys, sort_gk)
+    order = jnp.argsort(sort_gk, stable=True)
+    inv_order = jnp.argsort(order, stable=True)
+
+    gk_sorted = sort_gk[order]
+    pos_sorted = order  # original positions, ascending within each group
+    epoch = jnp.cumsum(is_reset.astype(jnp.int32))  # epoch AFTER position i resets
+    # epoch id of each row = number of resets strictly before it
+    epoch_before = epoch - is_reset.astype(jnp.int32)
+    epoch_sorted = epoch_before[order]
+    final_epoch = epoch[B - 1]
+
+    prev_same_group = jnp.concatenate([jnp.zeros(1, bool), gk_sorted[1:] == gk_sorted[:-1]])
+    prev_same_epoch = jnp.concatenate([jnp.zeros(1, bool), epoch_sorted[1:] == epoch_sorted[:-1]])
+    blocked = ~(prev_same_group & prev_same_epoch)  # segment starts
+    # state folds in only at a group's first row in epoch 0
+    fold_state = blocked & (epoch_sorted == 0) & (gk_sorted < num_keys)
+
+    last_of_group = jnp.concatenate([gk_sorted[1:] != gk_sorted[:-1], jnp.ones(1, bool)])
+    in_final_epoch = epoch_sorted == final_epoch
+
+    new_state = dict(state)
+    cols = dict(cols)
+    for i, spec in enumerate(specs):
+        key = f"a{i}"
+        st = state[key]  # [slots, K]
+        deltas = _deltas(spec, cols, ctx, xp)  # [slots, B]
+        deltas_sorted = deltas[:, order]
+        comb = _combine(spec.kind)
+        safe_gk = jnp.minimum(gk_sorted, num_keys - 1)
+        folded = comb(st[:, safe_gk], deltas_sorted)
+        vals = jnp.where(fold_state[None, :], folded, deltas_sorted)
+
+        def scan_op(a, b):
+            ab, av = a
+            bb, bv = b
+            return (ab | bb, jnp.where(bb, bv, comb(av, bv)))
+
+        _, scanned = lax.associative_scan(scan_op, (blocked, vals), axis=-1)
+
+        # per-row running values back in original row order
+        out = scanned[:, inv_order]
+
+        # new persistent state: all-init on any RESET, then last-row-per-group
+        # values for groups active in the final epoch
+        dtype = st.dtype
+        ident = jnp.asarray(_identity(spec.kind, np.dtype(dtype)))
+        base = jnp.where(any_reset, jnp.broadcast_to(ident, st.shape).astype(dtype), st)
+        upd_mask = last_of_group & in_final_epoch & (gk_sorted < num_keys)
+        scatter_idx = jnp.where(upd_mask, gk_sorted, num_keys)  # drop non-updates
+        new_state[key] = base.at[:, scatter_idx].set(scanned, mode="drop")
+
+        value, null_mask = _output(spec, [out[s] for s in range(spec.slots)], ctx)
+        value = value.astype(T.dtype_of(spec.out_type))
+        cols[spec.out_key] = value
+        if null_mask is not None:
+            cols[spec.out_key + "?"] = null_mask
+    return new_state, cols

@@ -1,0 +1,407 @@
+"""Expression compiler: query-api expression AST -> columnar functions.
+
+Replaces the reference's interpreted executor tree (``core/executor/**``:
+``ExpressionExecutor.execute(ComplexEvent)`` called per event per node,
+built by ``util/parser/ExpressionParser.java``) with a one-time lowering to
+vectorized ops over batch columns. Under jit the whole tree fuses into the
+surrounding step function.
+
+Null semantics follow the reference executors:
+- comparisons with a null operand are false (e.g.
+  ``EqualCompareConditionExpressionExecutor.java`` null guards);
+- arithmetic with a null operand is null (``DivideExpressionExecutorInt.java:43``);
+- and/or treat null conditions as false; ``isNull``/``coalesce``/``default``
+  observe nullness.
+
+A compiled node is ``fn(cols, ctx) -> (value, null_mask_or_None)`` where
+``cols`` maps column keys to arrays and ``ctx`` carries the backend module
+(``ctx['xp']``), the batch timestamps key and scalars like current time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.ops import types as T
+from siddhi_tpu.query_api.definitions import AttrType
+from siddhi_tpu.query_api.expressions import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    Constant,
+    Divide,
+    Expression,
+    InOp,
+    IsNull,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    TimeConstant,
+    Variable,
+)
+
+# Reserved column keys present in every device batch.
+TS_KEY = "__ts__"
+TYPE_KEY = "__type__"
+VALID_KEY = "__valid__"
+
+
+@dataclass
+class ColumnRef:
+    key: str
+    type: AttrType
+
+
+class Resolver:
+    """Maps Variables to batch columns. Query planners subclass this
+    (single-stream, join two-sided, pattern state) — the analog of meta-event
+    position resolution in reference ``QueryParserHelper.updateVariablePosition``."""
+
+    def resolve(self, var: Variable) -> ColumnRef:
+        raise NotImplementedError
+
+    def encode_string(self, s: str) -> int:
+        raise NotImplementedError
+
+
+class CompileError(Exception):
+    pass
+
+
+Compiled = Tuple[Callable, AttrType]
+
+
+def _const(value, attr_type: AttrType) -> Compiled:
+    def fn(cols, ctx):
+        return value, None
+
+    return fn, attr_type
+
+
+def compile_expr(expr: Expression, resolver: Resolver) -> Compiled:
+    """Lower `expr`; returns (fn, result_type)."""
+    if isinstance(expr, Constant):
+        if expr.type == AttrType.STRING:
+            return _const(np.int32(resolver.encode_string(expr.value)), AttrType.STRING)
+        return _const(np.asarray(expr.value, dtype=T.dtype_of(expr.type))[()], expr.type)
+    if isinstance(expr, TimeConstant):
+        return _const(np.int64(expr.value), AttrType.LONG)
+    if isinstance(expr, Variable):
+        ref = resolver.resolve(expr)
+        key, mask_key = ref.key, ref.key + "?"
+
+        def fn(cols, ctx):
+            return cols[key], cols.get(mask_key)
+
+        return fn, ref.type
+    if isinstance(expr, (Add, Subtract, Multiply, Divide, Mod)):
+        return _compile_math(expr, resolver)
+    if isinstance(expr, Compare):
+        return _compile_compare(expr, resolver)
+    if isinstance(expr, And):
+        lf, lt = compile_expr(expr.left, resolver)
+        rf, rt = compile_expr(expr.right, resolver)
+        _require_bool(lt, rt)
+
+        def fn(cols, ctx):
+            lv, lm = lf(cols, ctx)
+            rv, rm = rf(cols, ctx)
+            return _false_if_null(ctx, lv, lm) & _false_if_null(ctx, rv, rm), None
+
+        return fn, AttrType.BOOL
+    if isinstance(expr, Or):
+        lf, lt = compile_expr(expr.left, resolver)
+        rf, rt = compile_expr(expr.right, resolver)
+        _require_bool(lt, rt)
+
+        def fn(cols, ctx):
+            lv, lm = lf(cols, ctx)
+            rv, rm = rf(cols, ctx)
+            return _false_if_null(ctx, lv, lm) | _false_if_null(ctx, rv, rm), None
+
+        return fn, AttrType.BOOL
+    if isinstance(expr, Not):
+        inner_f, inner_t = compile_expr(expr.expression, resolver)
+        _require_bool(inner_t)
+
+        def fn(cols, ctx):
+            v, m = inner_f(cols, ctx)
+            return ~_false_if_null(ctx, v, m), None
+
+        return fn, AttrType.BOOL
+    if isinstance(expr, IsNull):
+        inner_f, _t = compile_expr(expr.expression, resolver)
+
+        def fn(cols, ctx):
+            v, m = inner_f(cols, ctx)
+            xp = ctx["xp"]
+            if m is None:
+                return xp.zeros(_shape_of(xp, v, cols), dtype=bool), None
+            return m, None
+
+        return fn, AttrType.BOOL
+    if isinstance(expr, AttributeFunction):
+        return _compile_function(expr, resolver)
+    if isinstance(expr, InOp):
+        raise CompileError("'in <table>' is compiled by the table planner, not here")
+    raise CompileError(f"cannot compile expression {expr!r}")
+
+
+def compile_condition(expr: Expression, resolver: Resolver) -> Callable:
+    """Boolean condition: fn(cols, ctx) -> bool array (nulls -> False)."""
+    f, t = compile_expr(expr, resolver)
+    if t != AttrType.BOOL:
+        raise CompileError(f"filter condition must be bool, got {t}")
+
+    def fn(cols, ctx):
+        v, m = f(cols, ctx)
+        return _false_if_null(ctx, v, m)
+
+    return fn
+
+
+def _shape_of(xp, v, cols):
+    shape = getattr(v, "shape", ())
+    if shape:
+        return shape
+    return cols[TS_KEY].shape
+
+
+def _false_if_null(ctx, value, mask):
+    if mask is None:
+        return value
+    return value & ~mask
+
+
+def _require_bool(*ts: AttrType):
+    for t in ts:
+        if t != AttrType.BOOL:
+            raise CompileError(f"expected bool operand, got {t}")
+
+
+def _compile_math(expr, resolver) -> Compiled:
+    lf, lt = compile_expr(expr.left, resolver)
+    rf, rt = compile_expr(expr.right, resolver)
+    out_t = T.promote(lt, rt)
+    dtype = T.dtype_of(out_t)
+    op = type(expr).__name__
+
+    def fn(cols, ctx):
+        xp = ctx["xp"]
+        lv, lm = lf(cols, ctx)
+        rv, rm = rf(cols, ctx)
+        a = xp.asarray(lv).astype(dtype)
+        b = xp.asarray(rv).astype(dtype)
+        if op == "Add":
+            v = a + b
+        elif op == "Subtract":
+            v = a - b
+        elif op == "Multiply":
+            v = a * b
+        elif op == "Divide":
+            v = T.java_div(xp, a, b, out_t)
+        else:
+            v = T.java_mod(xp, a, b, out_t)
+        mask = _or_masks(xp, lm, rm)
+        return v, mask
+
+    return fn, out_t
+
+
+def _or_masks(xp, a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+def _compile_compare(expr: Compare, resolver) -> Compiled:
+    lf, lt = compile_expr(expr.left, resolver)
+    rf, rt = compile_expr(expr.right, resolver)
+    op = expr.operator
+    if AttrType.STRING in (lt, rt) or AttrType.BOOL in (lt, rt):
+        # Strings are dictionary ids; only ==/!= defined (the reference has
+        # only EqualCompareConditionExpressionExecutorStringString /
+        # BoolBool — no ordering executors for these types).
+        if op not in ("==", "!=") or lt != rt:
+            raise CompileError(f"'{op}' not defined between {lt} and {rt}")
+    else:
+        T.promote(lt, rt)  # validates numeric
+
+    def fn(cols, ctx):
+        xp = ctx["xp"]
+        lv, lm = lf(cols, ctx)
+        rv, rm = rf(cols, ctx)
+        if op == "<":
+            v = lv < rv
+        elif op == "<=":
+            v = lv <= rv
+        elif op == ">":
+            v = lv > rv
+        elif op == ">=":
+            v = lv >= rv
+        elif op == "==":
+            v = lv == rv
+        else:
+            v = lv != rv
+        mask = _or_masks(xp, lm, rm)
+        # null comparison -> false (reference null guards return false)
+        return _false_if_null(ctx, v, mask), None
+
+    return fn, AttrType.BOOL
+
+
+# ------------------------------------------------------------- functions
+
+_TYPE_NAMES = {
+    "string": AttrType.STRING,
+    "int": AttrType.INT,
+    "long": AttrType.LONG,
+    "float": AttrType.FLOAT,
+    "double": AttrType.DOUBLE,
+    "bool": AttrType.BOOL,
+}
+
+
+def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
+    name = (f"{expr.namespace}:{expr.name}" if expr.namespace else expr.name).lower()
+    args = expr.parameters
+
+    if name in ("cast", "convert"):
+        # cast(x, 'double') — reference Cast/ConvertFunctionExecutor
+        src_f, src_t = compile_expr(args[0], resolver)
+        if not isinstance(args[1], Constant) or args[1].type != AttrType.STRING:
+            raise CompileError(f"{name}() target type must be a string constant")
+        target = _TYPE_NAMES[args[1].value.lower()]
+        if AttrType.STRING in (src_t, target) and src_t != target:
+            raise CompileError("string<->numeric cast runs host-side; not supported on device yet")
+        dtype = T.dtype_of(target)
+
+        def fn(cols, ctx):
+            v, m = src_f(cols, ctx)
+            return ctx["xp"].asarray(v).astype(dtype), m
+
+        return fn, target
+
+    if name == "ifthenelse":
+        cond_f = compile_condition(args[0], resolver)
+        then_f, then_t = compile_expr(args[1], resolver)
+        else_f, else_t = compile_expr(args[2], resolver)
+        out_t = then_t if then_t == else_t else T.promote(then_t, else_t)
+        dtype = T.dtype_of(out_t)
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            c = cond_f(cols, ctx)
+            tv, tm = then_f(cols, ctx)
+            ev, em = else_f(cols, ctx)
+            v = xp.where(c, xp.asarray(tv).astype(dtype), xp.asarray(ev).astype(dtype))
+            if tm is None and em is None:
+                return v, None
+            zeros = xp.zeros(_shape_of(xp, v, cols), dtype=bool)
+            m = xp.where(c, tm if tm is not None else zeros, em if em is not None else zeros)
+            return v, m
+
+        return fn, out_t
+
+    if name == "coalesce":
+        compiled = [compile_expr(a, resolver) for a in args]
+        out_t = compiled[0][1]
+        for _, t in compiled[1:]:
+            if t != out_t:
+                raise CompileError("coalesce() arguments must share one type")
+        dtype = T.dtype_of(out_t)
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            v, m = compiled[0][0](cols, ctx)
+            v = xp.asarray(v).astype(dtype)
+            if m is None:
+                return v, None
+            for f, _t in compiled[1:]:
+                nv, nm = f(cols, ctx)
+                v = xp.where(m, xp.asarray(nv).astype(dtype), v)
+                if nm is None:
+                    m = xp.zeros_like(m)
+                    break
+                m = m & nm
+            return v, m
+
+        return fn, out_t
+
+    if name == "default":
+        src_f, src_t = compile_expr(args[0], resolver)
+        dft_f, dft_t = compile_expr(args[1], resolver)
+        if src_t != dft_t:
+            raise CompileError("default() value type must match attribute type")
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            v, m = src_f(cols, ctx)
+            if m is None:
+                return v, None
+            dv, _dm = dft_f(cols, ctx)
+            return xp.where(m, dv, v), None
+
+        return fn, src_t
+
+    if name in ("maximum", "minimum"):
+        compiled = [compile_expr(a, resolver) for a in args]
+        out_t = compiled[0][1]
+        for _, t in compiled[1:]:
+            out_t = T.promote(out_t, t)
+        dtype = T.dtype_of(out_t)
+        is_max = name == "maximum"
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            v, m = compiled[0][0](cols, ctx)
+            v = xp.asarray(v).astype(dtype)
+            for f, _t in compiled[1:]:
+                nv, nm = f(cols, ctx)
+                nv = xp.asarray(nv).astype(dtype)
+                v = xp.maximum(v, nv) if is_max else xp.minimum(v, nv)
+                m = _or_masks(xp, m, nm)
+            return v, m
+
+        return fn, out_t
+
+    if name.startswith("instanceof"):
+        target = {"instanceofboolean": AttrType.BOOL, "instanceofstring": AttrType.STRING,
+                  "instanceofinteger": AttrType.INT, "instanceoflong": AttrType.LONG,
+                  "instanceoffloat": AttrType.FLOAT, "instanceofdouble": AttrType.DOUBLE}[name]
+        src_f, src_t = compile_expr(args[0], resolver)
+        matches = src_t == target
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            v, m = src_f(cols, ctx)
+            shape = _shape_of(xp, v, cols)
+            res = xp.full(shape, matches, dtype=bool)
+            if m is not None:
+                res = res & ~m  # null is not an instance of anything
+            return res, None
+
+        return fn, AttrType.BOOL
+
+    if name == "eventtimestamp":
+        def fn(cols, ctx):
+            return cols[TS_KEY], None
+
+        return fn, AttrType.LONG
+
+    if name == "currenttimemillis":
+        def fn(cols, ctx):
+            # host pump injects batch-receive wall time (scalar broadcast)
+            return ctx["current_time"], None
+
+        return fn, AttrType.LONG
+
+    raise CompileError(f"unknown function '{name}'")

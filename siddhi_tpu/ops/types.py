@@ -1,0 +1,69 @@
+"""Type system: Siddhi attribute types -> dtypes, Java numeric semantics.
+
+The reference implements one executor class per (op, type-pair)
+(``executor/math/**``, ``executor/condition/compare/**``); here the same
+semantics are a handful of dtype-promotion rules applied at trace time.
+
+Java semantics preserved:
+- numeric promotion int < long < float < double (e.g.
+  ``AddExpressionExecutorDouble.java``);
+- ``/`` on int/long truncates toward zero (``DivideExpressionExecutorInt.java:49``);
+- ``%`` takes the sign of the dividend (Java ``%``);
+- string ordering comparisons do not exist (only equal/notEqual have
+  StringString executors — ``compare/equal/EqualCompareConditionExpressionExecutorStringString.java``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from siddhi_tpu.query_api.definitions import AttrType
+
+# STRING columns are dictionary-encoded int32 ids (host-side dictionary);
+# OBJECT columns never reach the device.
+DTYPES = {
+    AttrType.STRING: np.int32,
+    AttrType.INT: np.int32,
+    AttrType.LONG: np.int64,
+    AttrType.FLOAT: np.float32,
+    AttrType.DOUBLE: np.float64,
+    AttrType.BOOL: np.bool_,
+}
+
+_NUMERIC_ORDER = [AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE]
+
+
+def dtype_of(t: AttrType):
+    return DTYPES[t]
+
+
+def is_numeric(t: AttrType) -> bool:
+    return t in _NUMERIC_ORDER
+
+
+def promote(a: AttrType, b: AttrType) -> AttrType:
+    """Java binary numeric promotion."""
+    if not is_numeric(a) or not is_numeric(b):
+        raise TypeError(f"cannot apply arithmetic to {a} and {b}")
+    return _NUMERIC_ORDER[max(_NUMERIC_ORDER.index(a), _NUMERIC_ORDER.index(b))]
+
+
+def cast_to(xp, arr, t: AttrType):
+    return arr.astype(dtype_of(t))
+
+
+def java_div(xp, a, b, t: AttrType):
+    """Division with Java semantics for the promoted type `t`."""
+    if t in (AttrType.FLOAT, AttrType.DOUBLE):
+        return a / b
+    # int/long: truncate toward zero (numpy // floors, Java truncates)
+    q = xp.abs(a) // xp.abs(b)
+    return (xp.sign(a) * xp.sign(b) * q).astype(dtype_of(t))
+
+
+def java_mod(xp, a, b, t: AttrType):
+    """% with Java semantics (sign of the dividend)."""
+    if t in (AttrType.FLOAT, AttrType.DOUBLE):
+        return xp.fmod(a, b)
+    r = xp.abs(a) % xp.abs(b)
+    return (xp.sign(a) * r).astype(dtype_of(t))

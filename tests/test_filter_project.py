@@ -1,0 +1,167 @@
+"""M0 golden tests: filter + project end-to-end through the public API.
+
+Style mirrors the reference's black-box behavioral tests
+(``query/filter/FilterTestCase1.java``): SiddhiQL in, events in, assert
+outputs via callbacks.
+"""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
+
+
+class CollectingStreamCallback(StreamCallback):
+    def __init__(self):
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+class CollectingQueryCallback(QueryCallback):
+    def __init__(self):
+        self.in_events = []
+        self.remove_events = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.in_events.extend(in_events)
+        if remove_events:
+            self.remove_events.extend(remove_events)
+
+
+def test_filter_and_project():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from StockStream[price > 100.0]
+        select symbol, price
+        insert into OutStream;
+        """
+    )
+    cb = CollectingStreamCallback()
+    rt.add_callback("OutStream", cb)
+    h = rt.get_input_handler("StockStream")
+    h.send(100, ["IBM", 150.0, 10])
+    h.send(101, ["WSO2", 55.0, 20])
+    h.send(102, ["GOOG", 120.5, 30])
+    assert [e.data for e in cb.events] == [["IBM", 150.0], ["GOOG", 120.5]]
+    assert [e.timestamp for e in cb.events] == [100, 102]
+    manager.shutdown()
+
+
+def test_query_callback_current_events():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (a int, b int);
+        @info(name = 'q')
+        from S[a > b] select a + b as total, a - b as diff insert into Out;
+        """
+    )
+    qcb = CollectingQueryCallback()
+    rt.add_callback("q", qcb)
+    h = rt.get_input_handler("S")
+    h.send([5, 3])
+    h.send([1, 9])
+    h.send([7, 2])
+    assert [e.data for e in qcb.in_events] == [[8, 2], [9, 5]]
+    manager.shutdown()
+
+
+def test_chained_queries():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        from S[v > 0] select v * 2 as v2 insert into Mid;
+        from Mid[v2 > 10] select v2 insert into Out;
+        """
+    )
+    cb = CollectingStreamCallback()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    for v in [1, 4, 6, -2, 10]:
+        h.send([v])
+    assert [e.data for e in cb.events] == [[12], [20]]
+    manager.shutdown()
+
+
+def test_bool_and_string_conditions():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (symbol string, price double, active bool);
+        from S[symbol == 'IBM' and active == true and not (price < 10.0)]
+        select symbol, price insert into Out;
+        """
+    )
+    cb = CollectingStreamCallback()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send(["IBM", 50.0, True])
+    h.send(["IBM", 5.0, True])
+    h.send(["WSO2", 50.0, True])
+    h.send(["IBM", 50.0, False])
+    assert [e.data for e in cb.events] == [["IBM", 50.0]]
+    manager.shutdown()
+
+
+def test_arithmetic_java_semantics():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (a int, b int);
+        from S select a / b as q, a % b as r insert into Out;
+        """
+    )
+    cb = CollectingStreamCallback()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send([-7, 2])
+    h.send([7, 2])
+    # Java: -7/2 == -3 (truncation), -7%2 == -1 (dividend sign)
+    assert cb.events[0].data == [-3, -1]
+    assert cb.events[1].data == [3, 1]
+    manager.shutdown()
+
+
+def test_ifthenelse_and_functions():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v double);
+        from S select ifThenElse(v > 0.0, 'pos', 'neg') as sign,
+                      maximum(v, 10.0) as mx,
+                      cast(v, 'int') as vi
+        insert into Out;
+        """
+    )
+    cb = CollectingStreamCallback()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send([25.5])
+    h.send([-3.0])
+    assert cb.events[0].data == ["pos", 25.5, 25]
+    assert cb.events[1].data == ["neg", 10.0, -3]
+    manager.shutdown()
+
+
+def test_event_order_preserved_in_batch_send():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        from S[v % 2 == 0] select v insert into Out;
+        """
+    )
+    cb = CollectingStreamCallback()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    from siddhi_tpu.core.event import Event
+
+    h.send([Event(timestamp=i, data=[i]) for i in range(20)])
+    assert [e.data[0] for e in cb.events] == list(range(0, 20, 2))
+    manager.shutdown()
