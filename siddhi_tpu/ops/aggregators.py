@@ -280,9 +280,11 @@ def apply_aggregators(specs: List[AggSpec], state: dict, cols: dict, ctx: dict,
         def scan_op(a, b):
             ab, av = a
             bb, bv = b
-            return (ab | bb, jnp.where(bb, bv, comb(av, bv)))
+            return (ab | bb, jnp.where(bb[:, None], bv, comb(av, bv)))
 
-        _, scanned = lax.associative_scan(scan_op, (blocked, vals), axis=-1)
+        # scan along the batch axis: flags [B], values [B, slots]
+        _, scanned_bs = lax.associative_scan(scan_op, (blocked, vals.T), axis=0)
+        scanned = scanned_bs.T  # [slots, B]
 
         # per-row running values back in original row order
         out = scanned[:, inv_order]

@@ -1,0 +1,173 @@
+"""M1 golden tests: group-by + running aggregators (no window).
+
+Mirrors the style of reference ``query/aggregator/*TestCase.java`` — running
+aggregates per event, per group, exactly as the sequential engine computes
+them.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
+
+
+class Collect(StreamCallback):
+    def __init__(self):
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def run_app(app, stream, rows, out="Out"):
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(app)
+    cb = Collect()
+    rt.add_callback(out, cb)
+    h = rt.get_input_handler(stream)
+    for r in rows:
+        h.send(r)
+    manager.shutdown()
+    return [e.data for e in cb.events]
+
+
+def test_running_sum_count_avg_per_group():
+    out = run_app(
+        """
+        define stream S (symbol string, price double);
+        from S select symbol, sum(price) as total, count() as c, avg(price) as a
+        group by symbol insert into Out;
+        """,
+        "S",
+        [["IBM", 10.0], ["WSO2", 5.0], ["IBM", 30.0], ["IBM", 2.0], ["WSO2", 1.0]],
+    )
+    assert out == [
+        ["IBM", 10.0, 1, 10.0],
+        ["WSO2", 5.0, 1, 5.0],
+        ["IBM", 40.0, 2, 20.0],
+        ["IBM", 42.0, 3, 14.0],
+        ["WSO2", 6.0, 2, 3.0],
+    ]
+
+
+def test_sum_int_returns_long_and_no_groupby():
+    out = run_app(
+        """
+        define stream S (v int);
+        from S select sum(v) as s, min(v) as mn, max(v) as mx insert into Out;
+        """,
+        "S",
+        [[5], [3], [9]],
+    )
+    assert out == [[5, 5, 5], [8, 3, 5], [17, 3, 9]]
+
+
+def test_batch_send_running_aggregates():
+    # several events of the same group inside ONE device batch must still
+    # produce sequential running values (segmented scan semantics)
+    from siddhi_tpu.core.event import Event
+
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (symbol string, v int);
+        from S select symbol, sum(v) as s group by symbol insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send([
+        Event(timestamp=1, data=["A", 1]),
+        Event(timestamp=2, data=["B", 10]),
+        Event(timestamp=3, data=["A", 2]),
+        Event(timestamp=4, data=["A", 3]),
+        Event(timestamp=5, data=["B", 20]),
+    ])
+    assert [e.data for e in cb.events] == [
+        ["A", 1], ["B", 10], ["A", 3], ["A", 6], ["B", 30],
+    ]
+    manager.shutdown()
+
+
+def test_having_on_aggregate():
+    out = run_app(
+        """
+        define stream S (symbol string, price double);
+        from S select symbol, avg(price) as ap group by symbol
+        having ap > 10.0 insert into Out;
+        """,
+        "S",
+        [["A", 5.0], ["A", 25.0], ["B", 50.0], ["A", 2.0]],
+    )
+    # running avg: A:5 (no), A:15 (yes), B:50 (yes), A:~10.67 (yes)
+    assert out[0] == ["A", 15.0]
+    assert out[1] == ["B", 50.0]
+    assert out[2][0] == "A" and abs(out[2][1] - 32.0 / 3) < 1e-9
+
+
+def test_stddev_and_bool_aggregators():
+    out = run_app(
+        """
+        define stream S (v double, f bool);
+        from S select stdDev(v) as sd, and(f) as allf, or(f) as anyf insert into Out;
+        """,
+        "S",
+        [[2.0, True], [4.0, True], [6.0, False]],
+    )
+    assert out[0][0] == 0.0 and out[0][1] is True and out[0][2] is True
+    assert out[1][0] == 1.0
+    assert out[2][1] is False and out[2][2] is True
+    # population stddev of (2,4,6) = sqrt(8/3)
+    assert abs(out[2][0] - (8.0 / 3.0) ** 0.5) < 1e-9
+
+
+def test_many_groups_capacity_growth():
+    rows = [[f"sym{i % 50}", float(i)] for i in range(200)]
+    out = run_app(
+        """
+        define stream S (symbol string, v double);
+        from S select symbol, count() as c group by symbol insert into Out;
+        """,
+        "S",
+        rows,
+    )
+    # each of the 50 symbols appears 4 times; counts go 1..4
+    assert len(out) == 200
+    assert out[-1] == ["sym49", 4]
+    assert out[49] == ["sym49", 1]
+    assert out[50] == ["sym0", 2]
+
+
+def test_group_by_multiple_attributes():
+    out = run_app(
+        """
+        define stream S (a string, b int, v int);
+        from S select a, b, sum(v) as s group by a, b insert into Out;
+        """,
+        "S",
+        [["x", 1, 10], ["x", 2, 20], ["x", 1, 5], ["y", 1, 7]],
+    )
+    assert out == [["x", 1, 10], ["x", 2, 20], ["x", 1, 15], ["y", 1, 7]]
+
+
+def test_limit_and_offset_and_orderby():
+    from siddhi_tpu.core.event import Event
+
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (symbol string, v int);
+        from S select symbol, v order by v desc limit 2 insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send([
+        Event(timestamp=1, data=["a", 3]),
+        Event(timestamp=1, data=["b", 9]),
+        Event(timestamp=1, data=["c", 5]),
+    ])
+    assert [e.data for e in cb.events] == [["b", 9], ["c", 5]]
+    manager.shutdown()
