@@ -24,6 +24,7 @@ from siddhi_tpu.core.query.runtime import QueryRuntime
 from siddhi_tpu.core.stream.input.input_handler import InputHandler, InputManager
 from siddhi_tpu.core.stream.junction import StreamJunction
 from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
+from siddhi_tpu.core.util.scheduler import Scheduler
 from siddhi_tpu.query_api.annotations import find_annotation
 from siddhi_tpu.query_api.definitions import Attribute, StreamDefinition
 from siddhi_tpu.query_api.execution import InsertIntoStream, Partition, Query
@@ -48,6 +49,7 @@ class SiddhiAppRuntime:
             self.app_context.timestamp_generator.playback = True
         if siddhi_app.app_annotation("enforceOrder") is not None:
             self.app_context.enforce_order = True
+        self.app_context.scheduler = Scheduler(self.app_context)
 
         for sid, sdef in self.stream_definitions.items():
             self._create_junction(sdef)
@@ -94,6 +96,7 @@ class SiddhiAppRuntime:
             raise SiddhiAppValidationException("table outputs (delete/update) land in M3")
 
         runtime.rate_limiter = create_rate_limiter(query.output_rate, runtime.send_to_callbacks)
+        runtime.scheduler = self.app_context.scheduler
 
         input_stream_id = query.input_stream.unique_stream_id
         self.junctions[input_stream_id].subscribe(runtime)
@@ -143,6 +146,8 @@ class SiddhiAppRuntime:
                 qr.rate_limiter.stop()
         for j in self.junctions.values():
             j.stop_processing()
+        if self.app_context.scheduler is not None:
+            self.app_context.scheduler.shutdown()
         self._started = False
 
     @property

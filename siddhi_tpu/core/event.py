@@ -123,20 +123,19 @@ class HostBatch:
         for pos, attr in enumerate(definition.attributes):
             dtype = dtype_of(attr.type)
             arr = np.zeros(b, dtype)
+            # null masks are always present so device column sets (and jit
+            # shapes) stay static whether or not a batch contains nulls
             mask = np.zeros(b, bool)
-            has_null = False
             for i, ev in enumerate(events):
                 v = ev.data[pos]
                 if v is None:
                     mask[i] = True
-                    has_null = True
                 elif attr.type == AttrType.STRING:
                     arr[i] = dictionary.encode(v)
                 else:
                     arr[i] = v
             cols[attr.name] = arr
-            if has_null:
-                cols[attr.name + "?"] = mask
+            cols[attr.name + "?"] = mask
         return HostBatch(cols)
 
     def to_events(

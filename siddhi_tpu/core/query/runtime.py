@@ -12,13 +12,14 @@ back to Events for rate limiting and callbacks.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from siddhi_tpu.core.event import CURRENT, EXPIRED, Event, HostBatch, StringDictionary
+from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, StringDictionary
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
 from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
@@ -92,9 +93,10 @@ class QueryRuntime(Receiver):
         self.rate_limiter: Optional[OutputRateLimiter] = None
         self.query_callbacks: List = []
         self.output_junction: Optional[StreamJunction] = None
+        self.scheduler = None  # set by the app runtime when timers are needed
         self._state: Optional[dict] = None
         self._step = None
-        self._batch_capacity: Optional[int] = None
+        self._lock = threading.RLock()  # per-query lock (QueryParser.java:159-215)
         self.on_error: Optional[Callable] = None
 
     # ---------------------------------------------------------------- state
@@ -106,7 +108,7 @@ class QueryRuntime(Receiver):
     def _init_state(self) -> dict:
         state = {"sel": self.selector_plan.init_state()}
         if self.window_stage is not None:
-            state["win"] = self.window_stage.init_state(self.selector_plan.num_keys)
+            state["win"] = self.window_stage.init_state()
         return state
 
     def _ensure_capacity(self):
@@ -122,8 +124,6 @@ class QueryRuntime(Receiver):
             k *= 2
         old_state = self._state
         self.selector_plan.num_keys = k
-        if self.window_stage is not None:
-            self.window_stage.num_keys = k
         new_state = self._init_state()
         if old_state is not None:
             self._state = jax.tree_util.tree_map(_copy_prefix, new_state, old_state)
@@ -145,9 +145,18 @@ class QueryRuntime(Receiver):
                 valid = valid & (f(cols, ctx) | timer)
             cols[VALID_KEY] = valid
             new_state = dict(state)
+            notify = None
+            overflow = None
             if win is not None:
                 new_state["win"], cols = win.apply(state["win"], cols, ctx)
+                cols = dict(cols)
+                notify = cols.pop("__notify__", None)
+                overflow = cols.pop("__overflow__", None)
             new_state["sel"], out = sel.apply(state["sel"], cols, ctx)
+            if notify is not None:
+                out["__notify__"] = notify
+            if overflow is not None:
+                out["__overflow__"] = overflow
             return new_state, out
 
         return jax.jit(step, donate_argnums=0)
@@ -158,22 +167,43 @@ class QueryRuntime(Receiver):
         batch = HostBatch.from_events(events, self.input_definition, self.dictionary)
         self.process_batch(batch)
 
+    def process_timer(self, ts: int):
+        """Inject a TIMER chunk (the role of Scheduler.sendTimerEvents +
+        EntryValveProcessor in the reference)."""
+        batch = HostBatch.from_events(
+            [Event(timestamp=int(ts), data=[_zero_value(a.type) for a in self.input_definition.attributes])],
+            self.input_definition,
+            self.dictionary,
+        )
+        batch.cols[TYPE_KEY][...] = TIMER_TYPE
+        self.process_batch(batch)
+
     def process_batch(self, batch: HostBatch):
-        cols = batch.cols
-        if self.keyer is not None:
-            gk = self.keyer(cols)
-            cols[GK_KEY] = gk
-            self._ensure_capacity()
-        else:
-            cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
-        if self._state is None:
-            self._state = self._init_state()
-        if self._step is None:
-            self._step = self._make_step()
-        now = np.int64(self.app_context.timestamp_generator.current_time())
-        self._state, out = self._step(self._state, cols, now)
-        out_host = {k: np.asarray(v) for k, v in out.items()}
-        self._emit(HostBatch(out_host))
+        with self._lock:
+            cols = batch.cols
+            if self.keyer is not None:
+                gk = self.keyer(cols)
+                cols[GK_KEY] = gk
+                self._ensure_capacity()
+            else:
+                cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
+            if self._state is None:
+                self._state = self._init_state()
+            if self._step is None:
+                self._step = self._make_step()
+            now = np.int64(self.app_context.timestamp_generator.current_time())
+            self._state, out = self._step(self._state, cols, now)
+            out_host = {k: np.asarray(v) for k, v in out.items()}
+            overflow = out_host.pop("__overflow__", None)
+            if overflow is not None and int(overflow) > 0:
+                raise RuntimeError(
+                    f"query '{self.name}': window buffer capacity exceeded — "
+                    f"raise app window capacity (app_context.window_capacity)"
+                )
+            notify = out_host.pop("__notify__", None)
+            self._emit(HostBatch(out_host))
+        if notify is not None and int(notify) >= 0 and self.scheduler is not None:
+            self.scheduler.notify_at(int(notify), self.process_timer)
 
     def _emit(self, out: HostBatch):
         if out.size == 0:
@@ -198,6 +228,14 @@ class QueryRuntime(Receiver):
             in_events = [e for e in events if not e.is_expired] or None
             remove_events = [e for e in events if e.is_expired] or None
             cb.receive(events[0].timestamp, in_events, remove_events)
+
+
+def _zero_value(attr_type: AttrType):
+    if attr_type == AttrType.STRING:
+        return ""
+    if attr_type == AttrType.BOOL:
+        return False
+    return 0
 
 
 def _copy_prefix(new, old):

@@ -1,0 +1,585 @@
+"""Window processors as ring-buffer tensor stages.
+
+Replaces the reference's window processor classes
+(``query/processor/stream/window/*.java``, 27 classes / 6,866 LoC of
+per-event queue surgery) with columnar ring buffers + masked emission.
+Exact semantics reproduced per window (event order, CURRENT/EXPIRED/RESET
+interleaving, timestamps patched to processing time where the reference
+does so):
+
+- length  (``LengthWindowProcessor.java:106-142``): sliding; when full each
+  arrival emits [EXPIRED(oldest, ts=now), CURRENT] in that order.
+- time    (``TimeWindowProcessor.java:133-168``): expired drained before
+  each event with ts set to now; TIMER chunks consumed; notifyAt(ts+t).
+- externalTime (``ExternalTimeWindowProcessor``): like time but the cutoff
+  advances with each event's own timestamp; no timers; expired keep ts.
+- lengthBatch (``LengthBatchWindowProcessor.java:153-260``): flush at exact
+  count boundaries (possibly mid-chunk): [EXPIRED(prev batch, ts=now),
+  RESET, CURRENT batch...] per flush.
+- timeBatch  (``TimeBatchWindowProcessor.java:263-345``): flush check once
+  per chunk; the arriving chunk's rows join the flushing batch; order
+  [EXPIRED(prev, ts=now), RESET, CURRENT...].
+- batch   (``BatchWindowProcessor``): every chunk is its own batch; expired
+  = previous chunk.
+
+A stage is ``apply(state, cols, ctx) -> (state, out_cols)``, traced inside
+the query's jitted step; output capacity is a static function of the input
+batch size. Stages needing timers return ``__notify__`` (next wanted wake
+time, -1 if none) for the host scheduler; bounded buffers report
+``__overflow__`` so the host can raise instead of silently dropping.
+
+Emission order is produced by one order-key sort. The unified key scheme,
+with STRIDE = Wc + B + 4:
+  ring-expired item j  (drains before row r): key r*STRIDE + j
+  in-batch expired of row i (before row r):   key r*STRIDE + Wc + i
+  current row i:                              key i*STRIDE + Wc + B + 2
+so expired events always precede the current event they are drained before,
+FIFO order among them, exactly as ``insertBeforeCurrent`` produces.
+
+Windows are per-query instances (K=1) exactly as in the reference, where
+group-by does NOT partition a window — only `partition with` does (M3 vmaps
+these stages over the partition-key axis).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY, CompileError
+from siddhi_tpu.query_api.definitions import AttrType
+from siddhi_tpu.query_api.execution import Window
+from siddhi_tpu.query_api.expressions import Constant, TimeConstant
+
+CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
+NOTIFY_KEY = "__notify__"
+OVERFLOW_KEY = "__overflow__"
+FLUSH_KEY = "__flush__"
+
+_BIG = jnp.int64(2**62)
+
+
+def _data_keys(cols: Dict) -> List[str]:
+    return sorted(
+        k for k in cols
+        if k not in (TYPE_KEY, VALID_KEY, NOTIFY_KEY, OVERFLOW_KEY, FLUSH_KEY)
+    )
+
+
+def _zero_rows(cols: Dict, n: int):
+    return {k: jnp.zeros((n,), cols[k].dtype) for k in _data_keys(cols)}
+
+
+def _order_emit(parts) -> Tuple[Dict, jnp.ndarray]:
+    """Concatenate (data_cols, types, valid, order_key) groups and sort by
+    order key with invalid rows last. Returns (out_cols, sorted_keys)."""
+    keys = _data_keys(parts[0][0])
+    data = {k: jnp.concatenate([p[0][k] for p in parts]) for k in keys}
+    types = jnp.concatenate([p[1] for p in parts])
+    valid = jnp.concatenate([p[2] for p in parts])
+    okey = jnp.concatenate([p[3] for p in parts])
+    okey = jnp.where(valid, okey, _BIG)
+    order = jnp.argsort(okey, stable=True)
+    out = {k: v[order] for k, v in data.items()}
+    out[TYPE_KEY] = types[order]
+    out[VALID_KEY] = valid[order]
+    return out, okey[order]
+
+
+def _insert_ranks(valid_cur):
+    """(rank per valid row, total inserts) — rank = segmented arrival index."""
+    rank = jnp.cumsum(valid_cur.astype(jnp.int64)) - 1
+    n_ins = jnp.sum(valid_cur.astype(jnp.int64))
+    return rank, n_ins
+
+
+class WindowStage:
+    batch_mode = False
+    needs_scheduler = False
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        raise NotImplementedError
+
+    def apply(self, state: dict, cols: Dict, ctx: Dict):
+        raise NotImplementedError
+
+
+def _const_param(window: Window, i: int, name: str):
+    if i >= len(window.parameters):
+        raise CompileError(f"{window.name} window missing parameter '{name}'")
+    p = window.parameters[i]
+    if isinstance(p, TimeConstant):
+        return int(p.value)
+    if isinstance(p, Constant):
+        return p.value
+    raise CompileError(f"{window.name} window parameter '{name}' must be a constant")
+
+
+# ------------------------------------------------------------------ length
+
+class LengthWindowStage(WindowStage):
+    """Sliding length window."""
+
+    def __init__(self, length: int, col_specs: Dict[str, np.dtype]):
+        if length <= 0:
+            raise CompileError("length window needs a positive length")
+        self.length = length
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        W = self.length
+        buf = {k: jnp.zeros((W,), dt) for k, dt in self.col_specs.items()}
+        return {"buf": buf, "total": jnp.int64(0)}
+
+    def apply(self, state, cols, ctx):
+        W = self.length
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+
+        total0 = state["total"]
+        rank, n_ins = _insert_ranks(valid_cur)
+        seq = total0 + rank  # global per-window sequence of each inserted row
+
+        # rank -> original row index (for evictees inserted earlier this batch)
+        rank_to_row = jnp.zeros((B,), jnp.int32).at[
+            jnp.where(valid_cur, rank, B).astype(jnp.int32)
+        ].set(jnp.arange(B, dtype=jnp.int32), mode="drop")
+
+        evicts = valid_cur & (seq >= W)
+        evict_seq = seq - W
+        from_batch = evict_seq >= total0
+        ring_slot = (evict_seq % W).astype(jnp.int32)
+        batch_row = rank_to_row[jnp.clip(evict_seq - total0, 0, B - 1).astype(jnp.int32)]
+
+        expired = {}
+        for k in keys:
+            ring_v = state["buf"][k][ring_slot]
+            expired[k] = jnp.where(from_batch, cols[k][batch_row], ring_v)
+        expired[TS_KEY] = jnp.broadcast_to(now, (B,))  # LengthWindowProcessor:120
+
+        # ring update: write the last min(W, n_ins) inserted rows (unique slots)
+        write = valid_cur & (rank >= n_ins - W)
+        slot = jnp.where(write, (seq % W).astype(jnp.int32), W)
+        new_buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop") for k in state["buf"]}
+
+        idx = jnp.arange(B, dtype=jnp.int64)
+        parts = [
+            (expired, jnp.full((B,), EXPIRED, jnp.int8), evicts, 2 * idx),
+            ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, 2 * idx + 1),
+        ]
+        out, _ = _order_emit(parts)
+        return {"buf": new_buf, "total": total0 + n_ins}, out
+
+
+# -------------------------------------------------------------------- time
+
+class TimeWindowStage(WindowStage):
+    """Sliding time window; ``external=True`` drives the cutoff from event
+    timestamps (externalTime) instead of the runtime clock."""
+
+    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int,
+                 external: bool = False):
+        self.time_ms = time_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+        self.external = external
+        self.needs_scheduler = not external
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        buf = {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}
+        return {"buf": buf, "total": jnp.int64(0), "expired_upto": jnp.int64(0)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        t = jnp.int64(self.time_ms)
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        ts = cols[TS_KEY]
+        now = jnp.int64(ctx["current_time"])
+        STRIDE = jnp.int64(Wc + B + 4)
+
+        total0 = state["total"]
+        exp0 = state["expired_upto"]
+
+        # FIFO view: item j holds sequence exp0 + j (arrival timestamps are
+        # monotone, so expiry always removes a FIFO prefix)
+        fifo_seq = exp0 + jnp.arange(Wc, dtype=jnp.int64)
+        occupied = fifo_seq < total0
+        fifo_slot = (fifo_seq % Wc).astype(jnp.int32)
+        ring_ts = state["buf"][TS_KEY][fifo_slot]
+
+        if self.external:
+            # cutoff for row i: ts_i - t (running max for safety)
+            run_max = lax.cummax(jnp.where(valid_cur, ts, jnp.int64(-(2**62))))
+            final_cutoff = run_max[B - 1] - t
+            expire_ring = occupied & (ring_ts <= final_cutoff)
+            # first row whose cutoff covers item j
+            covers = (run_max[None, :] - t) >= ring_ts[:, None]  # [Wc, B]
+            first_row = jnp.where(
+                jnp.any(covers, axis=1), jnp.argmax(covers, axis=1), 0
+            ).astype(jnp.int64)
+            exp_ts_ring = ring_ts  # externalTime keeps original timestamps
+        else:
+            expire_ring = occupied & (ring_ts + t <= now)
+            first_row = jnp.zeros((Wc,), jnp.int64)  # all drain before row 0
+            exp_ts_ring = jnp.broadcast_to(now, (Wc,))
+
+        n_exp_ring = jnp.sum(expire_ring.astype(jnp.int64))
+
+        # within-batch expiry: row i's clone expires before a later row r
+        if self.external:
+            nxt = _first_later_covering(ts, valid_cur, t)  # [B] (B if none)
+            batch_exp = valid_cur & (nxt < B)
+            exp_ts_batch = ts
+        else:
+            nxt = _next_valid_index(valid_cur)
+            batch_exp = valid_cur & (ts + t <= now) & (nxt < B)
+            exp_ts_batch = jnp.broadcast_to(now, (B,))
+
+        idx = jnp.arange(B, dtype=jnp.int64)
+        ring_okey = first_row * STRIDE + jnp.arange(Wc, dtype=jnp.int64)
+        batch_okey = nxt.astype(jnp.int64) * STRIDE + Wc + idx
+        cur_okey = idx * STRIDE + Wc + B + 2
+
+        ring_rows = {k: state["buf"][k][fifo_slot] for k in state["buf"]}
+        ring_rows[TS_KEY] = jnp.where(expire_ring, exp_ts_ring, ring_rows[TS_KEY])
+        batch_exp_rows = {k: cols[k] for k in keys}
+        batch_exp_rows[TS_KEY] = exp_ts_batch
+
+        parts = [
+            (ring_rows, jnp.full((Wc,), EXPIRED, jnp.int8), expire_ring, ring_okey),
+            (batch_exp_rows, jnp.full((B,), EXPIRED, jnp.int8), batch_exp, batch_okey),
+            ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, cur_okey),
+        ]
+        out, _ = _order_emit(parts)
+
+        # ring update: append inserted rows, advance the expired prefix
+        rank, n_ins = _insert_ranks(valid_cur)
+        seq = total0 + rank
+        write = valid_cur & (rank >= n_ins - Wc)
+        slot = jnp.where(write, (seq % Wc).astype(jnp.int32), Wc)
+        new_buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop") for k in state["buf"]}
+        new_total = total0 + n_ins
+        n_batch_exp = jnp.sum(batch_exp.astype(jnp.int64))
+        new_exp = exp0 + n_exp_ring + n_batch_exp
+
+        live = new_total - new_exp
+        out[OVERFLOW_KEY] = (live > Wc).astype(jnp.int32)
+        if self.external:
+            out[NOTIFY_KEY] = jnp.int64(-1)
+        else:
+            fifo2 = new_exp + jnp.arange(Wc, dtype=jnp.int64)
+            occ2 = fifo2 < new_total
+            ts2 = new_buf[TS_KEY][(fifo2 % Wc).astype(jnp.int32)]
+            nxt_notify = jnp.min(jnp.where(occ2, ts2 + t, _BIG))
+            out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt_notify, jnp.int64(-1))
+
+        return {"buf": new_buf, "total": new_total, "expired_upto": new_exp}, out
+
+
+def _next_valid_index(valid):
+    """For each i: the smallest valid index j > i (B if none)."""
+    B = valid.shape[0]
+    idx = jnp.where(valid, jnp.arange(B, dtype=jnp.int64), jnp.int64(2 * B))
+    suffix_min = lax.cummin(idx[::-1])[::-1]
+    nxt = jnp.concatenate([suffix_min[1:], jnp.full((1,), 2 * B, jnp.int64)])
+    return jnp.minimum(nxt, B)
+
+
+def _first_later_covering(ts, valid, t):
+    """First valid row j > i with ts_j >= ts_i + t (B if none)."""
+    B = ts.shape[0]
+    idx = jnp.arange(B)
+    later = (idx[None, :] > idx[:, None]) & valid[None, :]
+    ge = later & (ts[None, :] >= ts[:, None] + t)
+    return jnp.where(jnp.any(ge, axis=1), jnp.argmax(ge, axis=1), B)
+
+
+# ------------------------------------------------------------- lengthBatch
+
+class LengthBatchWindowStage(WindowStage):
+    """Tumbling count window; flushes exactly at count boundaries, possibly
+    several times within one device batch. Each flush emits
+    [EXPIRED(prev flush, ts=now), RESET, CURRENT rows]."""
+
+    batch_mode = True
+
+    def __init__(self, length: int, col_specs: Dict[str, np.dtype], expired_needed: bool = True):
+        if length <= 0:
+            raise CompileError("lengthBatch window needs a positive length")
+        self.length = length
+        self.col_specs = col_specs
+        self.expired_needed = expired_needed
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        W = self.length
+        zero = lambda: {k: jnp.zeros((W,), dt) for k, dt in self.col_specs.items()}  # noqa: E731
+        return {"cur": zero(), "prev": zero(),
+                "count": jnp.int64(0), "prev_count": jnp.int64(0)}
+
+    def apply(self, state, cols, ctx):
+        W = self.length
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+
+        count0 = state["count"]
+        rank, n_ins = _insert_ranks(valid_cur)
+        seq = count0 + rank               # position in the accumulating stream
+        total_after = count0 + n_ins
+        n_flush = total_after // W
+        flush_id = seq // W               # which flush a row's CURRENT belongs to
+        pos_in_flush = seq % W
+
+        # per-flush emission spans: flush f occupies [f*S, (f+1)*S):
+        #   expired block at +0..W-1, RESET at +W, currents at +W+1..2W
+        S = jnp.int64(2 * W + 2)
+        lead = jnp.arange(W, dtype=jnp.int64)
+
+        parts = []
+        if self.expired_needed:
+            # pre-step prev flush expires in flush 0
+            prev_valid = (lead < state["prev_count"]) & (n_flush > 0)
+            prev_rows = {k: state["prev"][k][lead.astype(jnp.int32)] for k in state["prev"]}
+            prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+            parts.append((prev_rows, jnp.full((W,), EXPIRED, jnp.int8), prev_valid, lead))
+            # leftover buffered rows (in flush 0) expire in flush 1
+            lead_exp_valid = (lead < count0) & (n_flush > 1)
+            lead_exp = {k: state["cur"][k][lead.astype(jnp.int32)] for k in state["cur"]}
+            lead_exp[TS_KEY] = jnp.where(lead_exp_valid, now, lead_exp[TS_KEY])
+            parts.append((lead_exp, jnp.full((W,), EXPIRED, jnp.int8), lead_exp_valid, S + lead))
+            # batch rows of flush f expire in flush f+1
+            bexp_valid = valid_cur & (flush_id + 1 < n_flush)
+            bexp = {k: cols[k] for k in keys}
+            bexp[TS_KEY] = jnp.where(bexp_valid, now, cols[TS_KEY])
+            parts.append((bexp, jnp.full((B,), EXPIRED, jnp.int8), bexp_valid,
+                          (flush_id + 1) * S + pos_in_flush))
+
+        n_reset_cap = B // W + 2
+        ridx = jnp.arange(n_reset_cap, dtype=jnp.int64)
+        reset_valid = ridx < n_flush
+        reset_rows = _zero_rows(cols, n_reset_cap)
+        reset_rows[TS_KEY] = jnp.where(reset_valid, now, jnp.int64(0))
+        parts.append((reset_rows, jnp.full((n_reset_cap,), RESET, jnp.int8),
+                      reset_valid, ridx * S + W))
+
+        # currents: leftover buffer rows flush in flush 0...
+        lead_valid = (lead < count0) & (n_flush > 0)
+        lead_rows = {k: state["cur"][k][lead.astype(jnp.int32)] for k in state["cur"]}
+        parts.append((lead_rows, jnp.full((W,), CURRENT, jnp.int8), lead_valid, W + 1 + lead))
+        # ...batch rows of completed flushes flush now
+        emitted_now = valid_cur & (flush_id < n_flush)
+        parts.append(({k: cols[k] for k in keys}, jnp.full((B,), CURRENT, jnp.int8),
+                      emitted_now, flush_id * S + W + 1 + pos_in_flush))
+
+        out, okeys = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.where(okeys == _BIG, 0, okeys // S).astype(jnp.int32)
+
+        # state update: remainder rows -> cur buffer
+        keep_old = n_flush == 0
+        rem_slot_val = jnp.where(keep_old, seq, seq - n_flush * W)
+        is_rem = valid_cur & (flush_id == n_flush)
+        slot = jnp.where(is_rem, rem_slot_val.astype(jnp.int32), W)
+        new_cur = {}
+        for k in state["cur"]:
+            base = jnp.where(keep_old, state["cur"][k], jnp.zeros_like(state["cur"][k]))
+            new_cur[k] = base.at[slot].set(cols[k], mode="drop")
+        new_count = total_after - n_flush * W
+
+        # prev buffer <- rows of the last completed flush
+        last_flush = n_flush - 1
+        in_last = valid_cur & (flush_id == last_flush)
+        lead_in_last = (lead < count0) & (n_flush == 1)
+        pslot_lead = jnp.where(lead_in_last, lead.astype(jnp.int32), W)
+        pslot_batch = jnp.where(in_last, pos_in_flush.astype(jnp.int32), W)
+        new_prev = {}
+        for k in state["prev"]:
+            base = jnp.where(n_flush > 0, jnp.zeros_like(state["prev"][k]), state["prev"][k])
+            base = base.at[pslot_lead].set(state["cur"][k], mode="drop")
+            base = base.at[pslot_batch].set(cols[k], mode="drop")
+            new_prev[k] = base
+        new_prev_count = jnp.where(n_flush > 0, jnp.int64(W), state["prev_count"])
+
+        return {"cur": new_cur, "prev": new_prev,
+                "count": new_count, "prev_count": new_prev_count}, out
+
+
+# --------------------------------------------------------------- timeBatch
+
+class TimeBatchWindowStage(WindowStage):
+    """Tumbling time window; flush check once per chunk (arriving rows join
+    the flushing batch), exactly as the reference processes chunks."""
+
+    batch_mode = True
+    needs_scheduler = True
+
+    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int,
+                 expired_needed: bool = True, start_time: int = -1):
+        self.time_ms = time_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+        self.expired_needed = expired_needed
+        self.start_time = start_time
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        zero = lambda: {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}  # noqa: E731
+        return {"cur": zero(), "prev": zero(),
+                "count": jnp.int64(0), "prev_count": jnp.int64(0),
+                "next_emit": jnp.int64(-1)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        t = jnp.int64(self.time_ms)
+        keys = _data_keys(cols)
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+
+        # boundary init on first chunk (TimeBatchWindowProcessor:266-276)
+        next_emit0 = state["next_emit"]
+        if self.start_time >= 0:
+            st = jnp.int64(self.start_time)
+            init_emit = now + (t - ((now - st) % t))
+        else:
+            init_emit = now + t
+        next_emit = jnp.where(next_emit0 < 0, init_emit, next_emit0)
+        send = now >= next_emit
+        next_emit = jnp.where(send, next_emit + t, next_emit)
+
+        count0 = state["count"]
+        rank, n_ins = _insert_ranks(valid_cur)
+        slot = jnp.where(valid_cur, (count0 + rank).astype(jnp.int32), Wc)
+        cur_buf = {k: state["cur"][k].at[slot].set(cols[k], mode="drop") for k in state["cur"]}
+        count = count0 + n_ins
+
+        widx = jnp.arange(Wc, dtype=jnp.int64)
+        parts = []
+        if self.expired_needed:
+            prev_valid = (widx < state["prev_count"]) & send
+            prev_rows = {k: state["prev"][k][widx.astype(jnp.int32)] for k in state["prev"]}
+            prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+            parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid, widx))
+        reset_rows = _zero_rows(cols, 1)
+        reset_rows[TS_KEY] = jnp.broadcast_to(now, (1,))
+        parts.append((reset_rows, jnp.full((1,), RESET, jnp.int8),
+                      jnp.broadcast_to(send & (count > 0), (1,)), jnp.full((1,), Wc, jnp.int64)))
+        cur_valid = (widx < count) & send
+        cur_rows = {k: cur_buf[k][widx.astype(jnp.int32)] for k in cur_buf}
+        parts.append((cur_rows, jnp.full((Wc,), CURRENT, jnp.int8), cur_valid, Wc + 1 + widx))
+        out, _ = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
+
+        zero_count = jnp.int64(0)
+        new_state = {
+            "cur": {k: jnp.where(send, jnp.zeros_like(v), v) for k, v in cur_buf.items()},
+            "prev": {k: jnp.where(send, cur_buf[k], state["prev"][k]) for k in state["prev"]},
+            "count": jnp.where(send, zero_count, count),
+            "prev_count": jnp.where(send, count, state["prev_count"]),
+            "next_emit": next_emit,
+        }
+        out[NOTIFY_KEY] = next_emit
+        out[OVERFLOW_KEY] = (count > Wc).astype(jnp.int32)
+        return new_state, out
+
+
+# ------------------------------------------------------------------- batch
+
+class BatchWindowStage(WindowStage):
+    """`#window.batch()`: each chunk is its own batch; the previous chunk
+    expires first (``BatchWindowProcessor``)."""
+
+    batch_mode = True
+
+    def __init__(self, col_specs: Dict[str, np.dtype], capacity: int, expired_needed: bool = True):
+        self.col_specs = col_specs
+        self.capacity = capacity
+        self.expired_needed = expired_needed
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        prev = {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}
+        return {"prev": prev, "prev_count": jnp.int64(0)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        any_cur = jnp.any(valid_cur)
+
+        widx = jnp.arange(Wc, dtype=jnp.int64)
+        parts = []
+        if self.expired_needed:
+            prev_valid = (widx < state["prev_count"]) & any_cur
+            prev_rows = {k: state["prev"][k][widx.astype(jnp.int32)] for k in state["prev"]}
+            prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+            parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid, widx))
+        reset_rows = _zero_rows(cols, 1)
+        reset_rows[TS_KEY] = jnp.broadcast_to(now, (1,))
+        parts.append((reset_rows, jnp.full((1,), RESET, jnp.int8),
+                      jnp.broadcast_to(any_cur & (state["prev_count"] > 0), (1,)),
+                      jnp.full((1,), Wc, jnp.int64)))
+        idx = jnp.arange(B, dtype=jnp.int64)
+        parts.append(({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, Wc + 1 + idx))
+        out, _ = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
+
+        rank, n_ins = _insert_ranks(valid_cur)
+        slot = jnp.where(valid_cur, rank.astype(jnp.int32), Wc)
+        new_prev = {}
+        for k in state["prev"]:
+            base = jnp.where(any_cur, jnp.zeros_like(state["prev"][k]), state["prev"][k])
+            new_prev[k] = base.at[slot].set(cols[k], mode="drop")
+        new_count = jnp.where(any_cur, n_ins, state["prev_count"])
+        out[OVERFLOW_KEY] = (n_ins > Wc).astype(jnp.int32)
+        return {"prev": new_prev, "prev_count": new_count}, out
+
+
+# ----------------------------------------------------------------- factory
+
+def create_window_stage(window: Window, input_def, resolver, app_context) -> WindowStage:
+    """Build a window stage from a ``#window.<name>(params)`` handler — the
+    factory role of reference ``SingleInputStreamParser.generateProcessor``
+    plus each window's ``init`` validation."""
+    from siddhi_tpu.ops.types import dtype_of
+
+    name = window.name.lower()
+    col_specs: Dict[str, np.dtype] = {}
+    for a in input_def.attributes:
+        col_specs[a.name] = dtype_of(a.type)
+        col_specs[a.name + "?"] = np.bool_
+    col_specs[TS_KEY] = np.int64
+    col_specs["__gk__"] = np.int32
+
+    capacity = getattr(app_context, "window_capacity", 4096)
+
+    if name == "length":
+        return LengthWindowStage(int(_const_param(window, 0, "length")), col_specs)
+    if name == "lengthbatch":
+        return LengthBatchWindowStage(int(_const_param(window, 0, "length")), col_specs)
+    if name == "time":
+        return TimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
+    if name == "externaltime":
+        # externalTime(tsAttr, time) — expiry driven by the event timestamps
+        return TimeWindowStage(int(_const_param(window, 1, "time")), col_specs, capacity,
+                               external=True)
+    if name == "timebatch":
+        start_time = -1
+        if len(window.parameters) >= 2:
+            p2 = window.parameters[1]
+            if isinstance(p2, Constant) and p2.type in (AttrType.INT, AttrType.LONG):
+                start_time = int(p2.value)
+        return TimeBatchWindowStage(int(_const_param(window, 0, "time")), col_specs,
+                                    capacity, start_time=start_time)
+    if name == "batch":
+        return BatchWindowStage(col_specs, capacity)
+    raise CompileError(f"window '{window.name}' is not implemented yet")

@@ -1,0 +1,274 @@
+"""M2 golden tests: window processors.
+
+Mirrors reference ``query/window/*TestCase.java`` behaviors: emission order
+(EXPIRED-before-CURRENT for sliding, [expired, reset, current] flushes for
+batch windows), batch-window single-output-per-flush with aggregators, and
+playback-driven time windows (``PlaybackTestCase.java`` is the determinism
+device).
+"""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.query.callback import QueryCallback
+from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
+
+
+class Collect(StreamCallback):
+    def __init__(self):
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.in_events = []
+        self.remove_events = []
+        self.chunks = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        self.chunks.append((timestamp, in_events, remove_events))
+        if in_events:
+            self.in_events.extend(in_events)
+        if remove_events:
+            self.remove_events.extend(remove_events)
+
+
+def test_length_window_sliding_expiry():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (symbol string, v int);
+        @info(name='q')
+        from S#window.length(2) select symbol, v insert all events into Out;
+        """
+    )
+    q = QCollect()
+    rt.add_callback("q", q)
+    h = rt.get_input_handler("S")
+    for i, sym in enumerate(["a", "b", "c", "d"]):
+        h.send(100 + i, [sym, i])
+    assert [e.data for e in q.in_events] == [["a", 0], ["b", 1], ["c", 2], ["d", 3]]
+    # window of 2: c evicts a, d evicts b
+    assert [e.data for e in q.remove_events] == [["a", 0], ["b", 1]]
+    manager.shutdown()
+
+
+def test_length_window_running_avg():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (price double);
+        from S#window.length(3) select avg(price) as ap insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    for p in [10.0, 20.0, 30.0, 40.0]:
+        h.send([p])
+    # running avg over sliding window of 3:
+    # 10; (10+20)/2; (10+20+30)/3; after expiry of 10: (20+30+40)/3
+    assert [e.data[0] for e in cb.events] == [10.0, 15.0, 20.0, 30.0]
+    manager.shutdown()
+
+
+def test_length_window_batch_send_interleaving():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        from S#window.length(2) select v insert all events into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=i, data=[i]) for i in range(5)])
+    # per-arrival interleave (EXPIRED re-published as CURRENT on the stream):
+    # 0,1 fill; then [exp 0, cur 2], [exp 1, cur 3], [exp 2, cur 4]
+    assert [e.data[0] for e in cb.events] == [0, 1, 0, 2, 1, 3, 2, 4]
+    manager.shutdown()
+
+
+def test_length_batch_window_flushes():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        @info(name='q')
+        from S#window.lengthBatch(3) select v insert all events into Out;
+        """
+    )
+    q = QCollect()
+    rt.add_callback("q", q)
+    h = rt.get_input_handler("S")
+    for i in range(7):
+        h.send([i])
+    # flush 1 after v=2: currents 0,1,2 ; flush 2 after v=5: expired 0,1,2 + currents 3,4,5
+    assert [e.data[0] for e in q.in_events] == [0, 1, 2, 3, 4, 5]
+    assert [e.data[0] for e in q.remove_events] == [0, 1, 2]
+    manager.shutdown()
+
+
+def test_length_batch_sum_single_output_per_flush():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        from S#window.lengthBatch(3) select sum(v) as total insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    for i in range(1, 10):
+        h.send([i])
+    # one output per flush: 1+2+3, 4+5+6, 7+8+9
+    assert [e.data[0] for e in cb.events] == [6, 15, 24]
+    manager.shutdown()
+
+
+def test_length_batch_multiple_flushes_in_one_chunk():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        from S#window.lengthBatch(2) select sum(v) as total insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=i, data=[i]) for i in [1, 2, 3, 4, 5]])
+    # flushes [1,2] and [3,4] happen inside one device batch; 5 buffered
+    assert [e.data[0] for e in cb.events] == [3, 7]
+    h.send([Event(timestamp=9, data=[6])])
+    assert [e.data[0] for e in cb.events] == [3, 7, 11]
+    manager.shutdown()
+
+
+def test_time_batch_playback():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        @app:playback
+        define stream S (symbol string, v int);
+        from S#window.timeBatch(1 sec) select symbol, sum(v) as total insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 1])
+    h.send(1100, ["A", 2])
+    assert cb.events == []  # nothing until the boundary
+    h.send(2100, ["B", 5])  # crossing 2000 flushes the first batch
+    assert [e.data for e in cb.events] == [["A", 3]]
+    h.send(3200, ["C", 7])  # crossing 3000 flushes [B,5]
+    assert [e.data for e in cb.events] == [["A", 3], ["B", 5]]
+    manager.shutdown()
+
+
+def test_time_window_playback_expiry():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        @app:playback
+        define stream S (symbol string, v int);
+        @info(name='q')
+        from S#window.time(1 sec) select symbol, v insert all events into Out;
+        """
+    )
+    q = QCollect()
+    rt.add_callback("q", q)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 1])
+    assert [e.data for e in q.in_events] == [["A", 1]]
+    assert q.remove_events == []
+    h.send(2500, ["B", 2])  # timer at 2000 fires first, expiring A
+    assert [e.data for e in q.remove_events] == [["A", 1]]
+    assert [e.data for e in q.in_events] == [["A", 1], ["B", 2]]
+    manager.shutdown()
+
+
+def test_time_window_running_sum_with_expiry():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        @app:playback
+        define stream S (v int);
+        from S#window.time(1 sec) select sum(v) as s insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send(1000, [10])
+    h.send(1500, [20])
+    h.send(2200, [30])  # 10 expired at 2000 (before this event)
+    assert [e.data[0] for e in cb.events] == [10, 30, 50]
+    manager.shutdown()
+
+
+def test_external_time_window():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (ts long, v int);
+        @info(name='q')
+        from S#window.externalTime(ts, 1 sec) select v insert all events into Out;
+        """
+    )
+    q = QCollect()
+    rt.add_callback("q", q)
+    h = rt.get_input_handler("S")
+    h.send(1000, [1000, 1])
+    h.send(1500, [1500, 2])
+    h.send(2100, [2100, 3])  # evicts the ts=1000 event (1000 + 1000 <= 2100)
+    assert [e.data[-1] for e in q.in_events] == [1, 2, 3]
+    assert [e.data[-1] for e in q.remove_events] == [1]
+    manager.shutdown()
+
+
+def test_batch_window():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        @info(name='q')
+        from S#window.batch() select v insert all events into Out;
+        """
+    )
+    q = QCollect()
+    rt.add_callback("q", q)
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=1, data=[1]), Event(timestamp=1, data=[2])])
+    h.send([Event(timestamp=2, data=[3])])
+    assert [e.data[0] for e in q.in_events] == [1, 2, 3]
+    # second chunk expires the first
+    assert [e.data[0] for e in q.remove_events] == [1, 2]
+    manager.shutdown()
+
+
+def test_post_window_having_on_window_agg():
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (symbol string, price double);
+        from S#window.length(2)
+        select symbol, avg(price) as ap
+        group by symbol
+        having ap > 10.0
+        insert into Out;
+        """
+    )
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send(["A", 5.0])     # avg 5 -> filtered
+    h.send(["A", 30.0])    # avg 17.5 -> out
+    h.send(["A", 40.0])    # 5 expires: avg (30+40)/2=35 -> expired row dropped (current only), current avg 35
+    assert [e.data for e in cb.events] == [["A", 17.5], ["A", 35.0]]
+    manager.shutdown()
