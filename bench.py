@@ -1,0 +1,106 @@
+"""Benchmark: events/sec on the BASELINE.json north-star shape —
+10k-key length-window -> avg aggregation (config #2/#3 family).
+
+Mirrors the reference harness pattern
+(``SimpleFilterSingleQueryPerformance.java:44-56``: pump events, count
+outputs, report events/sec per epoch). The JVM baseline cannot be run in
+this image (no Java); ``vs_baseline`` is measured against the estimate
+recorded below, derived from the reference's single-threaded per-event hot
+path (expression-interpreter + per-event window clone + string group keys;
+see BASELINE.md). Update it with a measured JVM number when available.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Estimated JVM StreamRuntime throughput on the same query shape
+# (10k-key windowed agg, single-threaded InputHandler.send loop).
+JVM_BASELINE_EVENTS_PER_SEC = 1.0e6
+
+NUM_KEYS = 10_000
+WINDOW = 1_000
+BATCH = 8_192
+WARMUP_BATCHES = 3
+MEASURE_SECONDS = 10.0
+
+_APP = """
+define stream StockStream (symbol string, price float, volume long);
+@info(name = 'bench')
+from StockStream#window.length({W})
+select symbol, avg(price) as avgPrice, sum(volume) as totalVolume
+group by symbol
+insert into OutStream;
+""".format(W=WINDOW)
+
+
+def main():
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import HostBatch
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(_APP)
+    rt.start()
+    q = rt.query_runtimes["bench"]
+    q.selector_plan.num_keys = 16_384  # >= NUM_KEYS, pow2
+
+    rng = np.random.default_rng(0)
+
+    def make_batch(i):
+        cols = {
+            TS_KEY: np.arange(i * BATCH, (i + 1) * BATCH, dtype=np.int64),
+            TYPE_KEY: np.zeros(BATCH, np.int8),
+            VALID_KEY: np.ones(BATCH, bool),
+            "symbol": rng.integers(0, NUM_KEYS, BATCH, dtype=np.int64),
+            "symbol?": np.zeros(BATCH, bool),
+            "price": rng.random(BATCH, np.float32) * 100.0,
+            "price?": np.zeros(BATCH, bool),
+            "volume": rng.integers(1, 1000, BATCH, dtype=np.int64),
+            "volume?": np.zeros(BATCH, bool),
+            GK_KEY: rng.integers(0, NUM_KEYS, BATCH).astype(np.int32),
+        }
+        return cols
+
+    state = q._init_state()
+    step = jax.jit(q.build_step_fn(), donate_argnums=0)
+    now = np.int64(0)
+
+    batches = [make_batch(i) for i in range(8)]
+    for i in range(WARMUP_BATCHES):
+        state, out = step(state, batches[i % len(batches)], now)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    n_events = 0
+    i = 0
+    while True:
+        state, out = step(state, batches[i % len(batches)], now)
+        n_events += BATCH
+        i += 1
+        if i % 50 == 0:
+            jax.block_until_ready(state)
+            if time.perf_counter() - t0 >= MEASURE_SECONDS:
+                break
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    eps = n_events / dt
+
+    print(json.dumps({
+        "metric": "events_per_sec_10k_key_length1000_avg",
+        "value": round(eps, 1),
+        "unit": "events/sec/chip",
+        "vs_baseline": round(eps / JVM_BASELINE_EVENTS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

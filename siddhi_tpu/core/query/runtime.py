@@ -132,6 +132,13 @@ class QueryRuntime(Receiver):
         self._step = None  # re-jit
 
     def _make_step(self):
+        return jax.jit(self.build_step_fn(), donate_argnums=0)
+
+    def build_step_fn(self):
+        """The pure (state, cols, now) -> (state', out) device function for
+        this query — jit-compiled by `_make_step`, also exported raw for
+        sharded execution (siddhi_tpu.parallel) and the driver's
+        compile-check (`__graft_entry__.entry`)."""
         filters = list(self.filters)
         sel = self.selector_plan
         win = self.window_stage
@@ -159,7 +166,7 @@ class QueryRuntime(Receiver):
                 out["__overflow__"] = overflow
             return new_state, out
 
-        return jax.jit(step, donate_argnums=0)
+        return step
 
     # ----------------------------------------------------------- processing
 

@@ -1,0 +1,17 @@
+from siddhi_tpu.parallel.mesh import (
+    batch_shardings,
+    force_host_devices,
+    key_axis_sharding,
+    make_mesh,
+    shard_query_step,
+    state_shardings,
+)
+
+__all__ = [
+    "batch_shardings",
+    "force_host_devices",
+    "key_axis_sharding",
+    "make_mesh",
+    "shard_query_step",
+    "state_shardings",
+]

@@ -1,0 +1,118 @@
+"""Key-space sharding of query state over a TPU device mesh.
+
+The reference scales by partitioning *state* across threads in one JVM
+(``partition/PartitionStreamReceiver.java:96-135``, per-key state maps in
+``util/snapshot/state/PartitionStateHolder.java:43-48``). The TPU-native
+equivalent: keyed state lives in dense ``[..., K, ...]`` arrays, and K is
+sharded across chips over a 1-D ``Mesh`` axis (ICI). Event batches are
+sharded along the batch axis; XLA inserts the all-to-all/psum collectives
+needed to scatter rows into the owning shard — there is no hand-written
+NCCL/MPI analog (SURVEY.md §2.13, §5.8).
+
+Multi-host: the same code runs under ``jax.distributed`` with a mesh that
+spans hosts; shardings are expressed only via ``NamedSharding``, so the
+DCN/ICI split is the compiler's job.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+KEY_AXIS = "keys"
+
+
+def force_host_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU platform for sharding tests.
+
+    Env vars alone are not enough: plugin platforms (e.g. the axon TPU
+    tunnel) may call ``jax.config.update("jax_platforms", ...)`` at
+    interpreter start, which overrides ``JAX_PLATFORMS``. This resets the
+    platform to cpu and re-initializes backends with the host-device-count
+    flag applied.
+    """
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses
+    jax.config.update("jax_platforms", "cpu")
+    from jax.extend.backend import clear_backends
+
+    clear_backends()  # must precede the device-count update (guarded)
+    jax.config.update("jax_num_cpu_devices", n)
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = KEY_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def key_axis_sharding(mesh: Mesh, arr_ndim: int, key_axis_index: int) -> NamedSharding:
+    """Shard one array along its key axis, replicate the rest."""
+    spec = [None] * arr_ndim
+    spec[key_axis_index] = KEY_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def state_shardings(state, mesh: Mesh, num_keys: int):
+    """Pytree of shardings for a query-state pytree.
+
+    Only keyed state is sharded: selector/aggregator arrays (under the
+    ``"sel"`` subtree, shape ``[slots, K]``) and partitioned window state
+    (under ``"win"`` with a leading ``K`` axis) split along K. Global
+    (unkeyed) window ring buffers and scalars are replicated — sharding a
+    global ring along its ring axis would put every window write on a
+    collective."""
+    replicated = NamedSharding(mesh, P())
+
+    def one(path, leaf):
+        if not hasattr(leaf, "shape"):
+            return replicated
+        top = path[0].key if path and hasattr(path[0], "key") else None
+        for i, s in enumerate(leaf.shape):
+            if s == num_keys and (top == "sel" or (top == "win" and i == 0)):
+                return key_axis_sharding(mesh, leaf.ndim, i)
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(one, state)
+
+
+def batch_shardings(cols, mesh: Mesh):
+    """Shard every [B, ...] column along the batch axis."""
+
+    def one(leaf):
+        return NamedSharding(mesh, P(KEY_AXIS, *([None] * (leaf.ndim - 1)))) if leaf.ndim else NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(one, cols)
+
+
+def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
+    """Jit a QueryRuntime's step with its keyed state sharded over ``mesh``.
+
+    Returns ``(jitted_step, sharded_state)``. The batch stays replicated in
+    this wrapper (scatter-heavy segment reductions into K-sharded state are
+    the collective-bound part; replicating the small event batch keeps the
+    all-to-all off the hot path). For B-sharded ingestion use
+    ``batch_shardings`` explicitly.
+    """
+    num_keys = runtime.selector_plan.num_keys
+    if runtime._state is None:
+        runtime._state = runtime._init_state()
+    step = runtime.build_step_fn()
+    st_sh = state_shardings(runtime._state, mesh, num_keys)
+    state = jax.device_put(runtime._state, st_sh)
+    jitted = jax.jit(
+        step,
+        in_shardings=(st_sh, None, None),
+        donate_argnums=(0,) if donate else (),
+    )
+    # hand the runtime the sharded timeline so junction-fed batches
+    # (QueryRuntime.process_batch) and direct jitted() callers share state
+    runtime._state = state
+    runtime._step = jitted
+    return jitted, state

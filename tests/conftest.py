@@ -7,13 +7,15 @@ first import, hence here at conftest import time.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
 # Persistent jit cache: the suite compiles many small step functions; cache
 # them across runs.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
+# Plugin platforms (the axon TPU tunnel) override JAX_PLATFORMS via
+# jax.config.update at interpreter start, so env vars alone don't stick —
+# force the virtual 8-device CPU platform through the config API.
+from siddhi_tpu.parallel.mesh import force_host_devices  # noqa: E402
+
+force_host_devices(8)
