@@ -57,12 +57,14 @@ class SiddhiAppRuntime:
         self.input_manager = InputManager(self.app_context, self.junctions, self._barrier)
 
         q_index = 0
+        p_index = 0
         for element in siddhi_app.execution_elements:
             if isinstance(element, Query):
                 q_index += 1
                 self._add_query(element, q_index)
             elif isinstance(element, Partition):
-                raise SiddhiAppValidationException("partitions land in M3")
+                p_index += 1
+                q_index = self._add_partition(element, p_index, q_index)
 
     # ------------------------------------------------------------ assembly
 
@@ -76,22 +78,82 @@ class SiddhiAppRuntime:
         self.junctions[sdef.id] = j
         return j
 
-    def _add_query(self, query: Query, index: int):
+    def _add_partition(self, partition: Partition, p_index: int, q_index: int) -> int:
+        """Assemble a ``partition with (...) begin ... end`` block — the
+        role of reference ``util/parser/PartitionParser.java`` +
+        ``partition/PartitionRuntimeImpl.java``, with per-key processor
+        instances replaced by dense-keyed state (ops/keyed_windows.py)."""
+        from siddhi_tpu.core.partition import (
+            PartitionContext,
+            RangePartitionKeyer,
+            ValuePartitionKeyer,
+        )
+        from siddhi_tpu.core.plan.resolvers import SingleStreamResolver
+        from siddhi_tpu.ops.expressions import compile_condition, compile_expr
+        from siddhi_tpu.query_api.execution import RangePartitionType, ValuePartitionType
+
+        pctx = PartitionContext(p_index)
+        for ptype in partition.partition_types:
+            sid = ptype.stream_id
+            if sid not in self.stream_definitions:
+                raise SiddhiAppValidationException(
+                    f"partition with (... of {sid}): stream '{sid}' is not defined"
+                )
+            resolver = SingleStreamResolver(
+                self.stream_definitions[sid], self.app_context.string_dictionary
+            )
+            if isinstance(ptype, ValuePartitionType):
+                fn, t = compile_expr(ptype.expression, resolver)
+                pctx.keyers[sid] = ValuePartitionKeyer([(fn, t)], pctx.keyspace)
+            elif isinstance(ptype, RangePartitionType):
+                conds = [
+                    (rc.partition_key, compile_condition(rc.condition, resolver))
+                    for rc in ptype.conditions
+                ]
+                pctx.keyers[sid] = RangePartitionKeyer(conds)
+            else:
+                raise SiddhiAppValidationException(f"unknown partition type {ptype!r}")
+
+        for query in partition.queries:
+            q_index += 1
+            self._add_query(query, q_index, partition_ctx=pctx)
+        return q_index
+
+    def _add_query(self, query: Query, index: int, partition_ctx=None):
         query_name = query.name or f"query_{index}"
-        runtime = plan_query(query, query_name, self.app_context, self.stream_definitions)
+        definitions = dict(self.stream_definitions)
+        if partition_ctx is not None:
+            definitions.update(partition_ctx.inner_definitions)
+        runtime = plan_query(query, query_name, self.app_context, definitions,
+                             partition_ctx=partition_ctx)
 
         out = query.output_stream
         if isinstance(out, InsertIntoStream):
             target = out.target_id
-            if target not in self.stream_definitions:
-                # auto-define the output stream (reference OutputParser)
-                sdef = StreamDefinition(
-                    id=target,
-                    attributes=[Attribute(n, t) for n, t in runtime.output_attrs],
-                )
-                self.stream_definitions[target] = sdef
-                self._create_junction(sdef)
-            runtime.output_junction = self.junctions[target]
+            if partition_ctx is not None and out.is_inner_stream:
+                # '#stream' scoped to this partition; events carry pk ids
+                inner_id = "#" + target
+                if inner_id not in partition_ctx.inner_definitions:
+                    sdef = StreamDefinition(
+                        id=inner_id,
+                        attributes=[Attribute(n, t) for n, t in runtime.output_attrs],
+                    )
+                    partition_ctx.inner_definitions[inner_id] = sdef
+                    partition_ctx.inner_junctions[inner_id] = StreamJunction(
+                        sdef, self.app_context
+                    )
+                runtime.output_junction = partition_ctx.inner_junctions[inner_id]
+                runtime.attach_pk = True
+            else:
+                if target not in self.stream_definitions:
+                    # auto-define the output stream (reference OutputParser)
+                    sdef = StreamDefinition(
+                        id=target,
+                        attributes=[Attribute(n, t) for n, t in runtime.output_attrs],
+                    )
+                    self.stream_definitions[target] = sdef
+                    self._create_junction(sdef)
+                runtime.output_junction = self.junctions[target]
         elif out is not None:
             raise SiddhiAppValidationException("table outputs (delete/update) land in M3")
 
@@ -99,7 +161,15 @@ class SiddhiAppRuntime:
         runtime.scheduler = self.app_context.scheduler
 
         input_stream_id = query.input_stream.unique_stream_id
-        self.junctions[input_stream_id].subscribe(runtime)
+        if partition_ctx is not None and query.input_stream.is_inner_stream:
+            if input_stream_id not in partition_ctx.inner_junctions:
+                raise SiddhiAppValidationException(
+                    f"inner stream '{input_stream_id}' is consumed before any query "
+                    f"in this partition produces it"
+                )
+            partition_ctx.inner_junctions[input_stream_id].subscribe(runtime)
+        else:
+            self.junctions[input_stream_id].subscribe(runtime)
         self.query_runtimes[query_name] = runtime
 
     # ------------------------------------------------------------- API

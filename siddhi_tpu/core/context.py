@@ -67,6 +67,10 @@ class SiddhiAppContext:
         self.root_metrics_level = "OFF"
         # key-capacity defaults for dense state (padded, grows by recompile)
         self.initial_key_capacity = 16
+        # ring-buffer capacity for unbounded (time-based) windows
+        self.window_capacity = 4096
+        # per-key ring capacity for time windows inside partitions
+        self.partition_window_capacity = 256
 
 
 @dataclass

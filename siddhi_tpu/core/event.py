@@ -36,6 +36,10 @@ class Event:
     timestamp: int = -1
     data: Sequence = field(default_factory=list)
     is_expired: bool = False  # kept for API parity with the reference
+    # partition-key id for events flowing through inner '#streams' (the
+    # analog of the reference's ThreadLocal partition flow id,
+    # SiddhiAppContext.java:55). None outside partitions.
+    pk: Optional[int] = None
 
     def __repr__(self):
         return f"Event{{timestamp={self.timestamp}, data={list(self.data)}, isExpired={self.is_expired}}}"
@@ -143,11 +147,14 @@ class HostBatch:
         attr_order: Sequence[tuple],  # [(key, AttrType), ...]
         dictionary: StringDictionary,
         types_wanted: Optional[Sequence[int]] = None,
+        pk_key: Optional[str] = None,
     ) -> List[Event]:
-        """Decode valid rows into Events (optionally filtered by type)."""
+        """Decode valid rows into Events (optionally filtered by type).
+        ``pk_key`` names a partition-id column to attach as Event.pk."""
         valid = self.cols[VALID_KEY]
         types = self.cols[TYPE_KEY]
         ts = self.cols[TS_KEY]
+        pk_col = self.cols.get(pk_key) if pk_key is not None else None
         out: List[Event] = []
         idx = np.nonzero(valid)[0]
         for i in idx:
@@ -169,5 +176,8 @@ class HostBatch:
                     data.append(int(v))
                 else:
                     data.append(float(v))
-            out.append(Event(timestamp=int(ts[i]), data=data, is_expired=(t == EXPIRED)))
+            ev = Event(timestamp=int(ts[i]), data=data, is_expired=(t == EXPIRED))
+            if pk_col is not None:
+                ev.pk = int(pk_col[i])
+            out.append(ev)
         return out

@@ -1,0 +1,7 @@
+from siddhi_tpu.core.partition.partition import (
+    PartitionContext,
+    RangePartitionKeyer,
+    ValuePartitionKeyer,
+)
+
+__all__ = ["PartitionContext", "RangePartitionKeyer", "ValuePartitionKeyer"]

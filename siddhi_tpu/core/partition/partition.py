@@ -1,0 +1,168 @@
+"""Partition keyers: host-side partition-key evaluation.
+
+The counterpart of reference ``partition/PartitionStreamReceiver.java:96-135``
++ ``partition/executor/{Value,Range}PartitionExecutor.java`` — but instead of
+routing events into per-key inner junction instances, rows get a dense
+partition-key id column (``PK_KEY``) and all keys are processed by one device
+step over ``[K, ...]`` state (see ``ops/keyed_windows.py``).
+
+Reference semantics preserved:
+- value partition: key = value of the expression; a null key drops the event
+  (``ValuePartitionExecutor.execute`` returns null on NPE and the chunked
+  receive path skips null keys);
+- range partition: one copy of the event per matching range condition, in
+  range-declaration order; events matching no range are dropped.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core.event import CURRENT, _pad_len
+from siddhi_tpu.ops.expressions import TYPE_KEY, VALID_KEY
+from siddhi_tpu.query_api.definitions import AttrType
+
+
+class PartitionKeySpace:
+    """Shared partition-key dictionary: key tuple -> dense id. One per
+    partition block — two streams partitioned by equal values land in the
+    same partition instance (reference keys are strings compared across
+    streams)."""
+
+    def __init__(self):
+        self._map: Dict[tuple, int] = {}
+        self._reverse: List[tuple] = []
+
+    def id_of(self, key: tuple) -> int:
+        i = self._map.setdefault(key, len(self._map))
+        if i == len(self._reverse):
+            self._reverse.append(key)
+        return i
+
+    def __len__(self):
+        return len(self._map)
+
+    def snapshot(self) -> dict:
+        return {"map": dict(self._map)}
+
+    def restore(self, snap: dict):
+        self._map = dict(snap["map"])
+        self._reverse = [None] * len(self._map)
+        for k, i in self._map.items():
+            self._reverse[i] = k
+
+
+class ValuePartitionKeyer:
+    """``partition with (expr of Stream)``: tuple of expression values ->
+    dense pk id via the partition's shared key space."""
+
+    def __init__(self, fns: List[Tuple[Callable, AttrType]], keyspace: PartitionKeySpace):
+        self._fns = fns
+        self._keyspace = keyspace
+
+    def __len__(self):
+        return max(len(self._keyspace), 1)
+
+    @property
+    def static_keys(self) -> Optional[int]:
+        return None  # dynamic key space
+
+    def apply(self, cols: Dict[str, np.ndarray]):
+        """Returns (cols, pk_ids). Null-key CURRENT rows are invalidated;
+        non-CURRENT rows (TIMER) pass through with pk 0."""
+        ctx = {"xp": np}
+        valid = cols[VALID_KEY]
+        is_cur = valid & (cols[TYPE_KEY] == CURRENT)
+        B = valid.shape[0]
+        pk = np.zeros(B, np.int32)
+        vals = []
+        null_masks = []
+        for fn, _t in self._fns:
+            v, m = fn(cols, ctx)
+            vals.append(np.broadcast_to(np.asarray(v), (B,)))
+            null_masks.append(np.broadcast_to(np.asarray(m), (B,)) if m is not None else None)
+        drop = np.zeros(B, bool)
+        for i in np.nonzero(is_cur)[0]:
+            if any(m is not None and m[i] for m in null_masks):
+                drop[i] = True
+                continue
+            key = tuple(x[i].item() for x in vals)
+            pk[i] = self._keyspace.id_of(key)
+        if drop.any():
+            cols = dict(cols)
+            cols[VALID_KEY] = valid & ~drop
+        return cols, pk
+
+
+class RangePartitionKeyer:
+    """``partition with (cond as 'label' or ... of Stream)``: pk id = range
+    index (static key space). Rows are duplicated per matching range."""
+
+    def __init__(self, conditions: List[Tuple[str, Callable]]):
+        self._conditions = conditions  # [(label, condition fn)]
+
+    def __len__(self):
+        return len(self._conditions)
+
+    @property
+    def static_keys(self) -> Optional[int]:
+        return len(self._conditions)
+
+    def apply(self, cols: Dict[str, np.ndarray]):
+        """Expand rows: a CURRENT row matching R ranges becomes R rows (in
+        range order, reference PartitionStreamReceiver copy loop); rows
+        matching none are dropped. TIMER/other rows are kept once (pk 0)."""
+        ctx = {"xp": np}
+        valid = cols[VALID_KEY]
+        is_cur = valid & (cols[TYPE_KEY] == CURRENT)
+        B = valid.shape[0]
+        masks = np.zeros((B, len(self._conditions)), bool)
+        for r, (_label, fn) in enumerate(self._conditions):
+            masks[:, r] = np.asarray(fn(cols, ctx)) & is_cur
+        keep_once = valid & ~is_cur  # TIMER etc. — not range-matched
+
+        rows_cur, rngs = np.nonzero(masks)          # row-major: event order kept
+        rows_other = np.nonzero(keep_once)[0]
+        rows = np.concatenate([rows_cur, rows_other])
+        pk_out = np.concatenate([rngs, np.zeros(len(rows_other), np.int64)]).astype(np.int32)
+        order = np.argsort(rows, kind="stable")
+        rows, pk_out = rows[order], pk_out[order]
+
+        n = len(rows)
+        cap = _pad_len(max(n, 1))
+        out: Dict[str, np.ndarray] = {}
+        for k, v in cols.items():
+            arr = np.zeros(cap, v.dtype)
+            arr[:n] = v[rows]
+            out[k] = arr
+        out[VALID_KEY] = np.zeros(cap, bool)
+        out[VALID_KEY][:n] = True  # selected rows are valid by construction
+        pk = np.zeros(cap, np.int32)
+        pk[:n] = pk_out
+        return out, pk
+
+    def snapshot(self) -> dict:
+        return {}
+
+    def restore(self, snap: dict):
+        pass
+
+
+class PartitionContext:
+    """Planning context for one ``partition ... begin ... end`` block:
+    the per-stream keyers plus the partition's inner-stream ('#stream')
+    definitions and junctions (reference PartitionRuntimeImpl holds inner
+    junctions per partition, here one junction whose events carry pk ids)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.keyspace = PartitionKeySpace()
+        self.keyers: Dict[str, object] = {}      # outer stream id -> keyer
+        self.inner_definitions: Dict[str, object] = {}   # '#X' -> StreamDefinition
+        self.inner_junctions: Dict[str, object] = {}     # '#X' -> StreamJunction
+
+    def num_keys(self) -> int:
+        static = [k.static_keys for k in self.keyers.values() if k.static_keys]
+        return max(max(static, default=0), len(self.keyspace), 1)

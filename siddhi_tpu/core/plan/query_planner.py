@@ -29,6 +29,7 @@ def plan_query(
     query_name: str,
     app_context: SiddhiAppContext,
     definitions: Dict[str, StreamDefinition],
+    partition_ctx=None,
 ) -> QueryRuntime:
     input_stream = query.input_stream
     if not isinstance(input_stream, SingleInputStream):
@@ -45,6 +46,19 @@ def plan_query(
         input_def, dictionary, ref_id=input_stream.stream_reference_id, synthetic={}
     )
 
+    partition_keyer = None
+    carried_pk = False
+    if partition_ctx is not None:
+        if input_stream.is_inner_stream:
+            carried_pk = True  # '#stream' rows carry their pk id
+        elif stream_id in partition_ctx.keyers:
+            partition_keyer = partition_ctx.keyers[stream_id]
+        else:
+            raise CompileError(
+                f"query '{query_name}': stream '{stream_id}' is consumed inside a "
+                f"partition but has no partition-with clause and is not an inner stream"
+            )
+
     filters = []
     window_stage = None
     batch_mode = False
@@ -54,11 +68,16 @@ def plan_query(
                 raise CompileError("post-window filters land with window support (M2)")
             filters.append(compile_condition(handler.expression, resolver))
         elif isinstance(handler, Window):
-            from siddhi_tpu.ops.windows import create_window_stage  # cycle-free
-
             if window_stage is not None:
                 raise CompileError("only one #window per stream is allowed")
-            window_stage = create_window_stage(handler, input_def, resolver, app_context)
+            if partition_ctx is not None:
+                from siddhi_tpu.ops.keyed_windows import create_keyed_window_stage
+
+                window_stage = create_keyed_window_stage(handler, input_def, resolver, app_context)
+            else:
+                from siddhi_tpu.ops.windows import create_window_stage  # cycle-free
+
+                window_stage = create_window_stage(handler, input_def, resolver, app_context)
             batch_mode = window_stage.batch_mode
         elif isinstance(handler, StreamFunction):
             raise CompileError(f"stream function '{handler.name}' not yet implemented")
@@ -91,5 +110,8 @@ def plan_query(
         selector_plan=selector_plan,
         keyer=keyer,
         dictionary=dictionary,
+        partition_ctx=partition_ctx,
+        partition_keyer=partition_keyer,
+        carried_pk=carried_pk,
     )
     return runtime

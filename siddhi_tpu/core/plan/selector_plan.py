@@ -27,6 +27,7 @@ import numpy as np
 from siddhi_tpu.core.plan.resolvers import OutputColsResolver
 from siddhi_tpu.ops import aggregators as agg_ops
 from siddhi_tpu.ops.expressions import (
+    PK_KEY,
     TS_KEY,
     TYPE_KEY,
     VALID_KEY,
@@ -123,6 +124,8 @@ class SelectorPlan:
         }
         if FLUSH_KEY in cols:
             out[FLUSH_KEY] = cols[FLUSH_KEY]
+        if PK_KEY in cols:
+            out[PK_KEY] = cols[PK_KEY]  # partition id rides along to the edge
         B = cols[TS_KEY].shape[0]
         for name, fn, _t in self.projections:
             v, m = fn(cols, ctx)

@@ -23,7 +23,7 @@ from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, 
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
 from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
-from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
 from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
 
 
@@ -41,12 +41,15 @@ class GroupKeyer:
     def __len__(self):
         return len(self._map)
 
-    def __call__(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
+    def __call__(self, cols: Dict[str, np.ndarray], pk: Optional[np.ndarray] = None) -> np.ndarray:
+        """Group ids for a batch; when ``pk`` is given the dictionary key is
+        (partition key, group-by values) — reference state addressing is
+        ``[partitionFlowId][groupByFlowId]`` (PartitionStateHolder.java:43-48)."""
         ctx = {"xp": np}
         valid = cols[VALID_KEY]
         B = valid.shape[0]
         gk = np.zeros(B, np.int32)
-        if self._single_string:
+        if pk is None and self._single_string:
             v, _m = self._fns[0][0](cols, ctx)
             ids = np.asarray(v, np.int64)
             top = int(ids.max(initial=0)) + 1
@@ -65,7 +68,7 @@ class GroupKeyer:
             v, _m = fn(cols, ctx)
             vals.append(np.broadcast_to(np.asarray(v), (B,)))
         for i in np.nonzero(valid)[0]:
-            key = tuple(x[i].item() for x in vals)
+            key = ((int(pk[i]),) if pk is not None else ()) + tuple(x[i].item() for x in vals)
             gk[i] = self._map.setdefault(key, len(self._map))
         return gk
 
@@ -81,6 +84,9 @@ class QueryRuntime(Receiver):
         selector_plan: SelectorPlan,
         keyer: Optional[GroupKeyer],
         dictionary: StringDictionary,
+        partition_ctx=None,
+        partition_keyer=None,
+        carried_pk: bool = False,
     ):
         self.name = name
         self.app_context = app_context
@@ -90,12 +96,21 @@ class QueryRuntime(Receiver):
         self.selector_plan = selector_plan
         self.keyer = keyer
         self.dictionary = dictionary
+        # partition support (reference partition/PartitionRuntimeImpl.java)
+        self.partition_ctx = partition_ctx
+        self.partition_keyer = partition_keyer
+        self.carried_pk = carried_pk      # input is an inner '#stream': rows carry pk
+        self.attach_pk = False            # output goes to an inner '#stream'
+        self._win_keys = 1
+        if partition_ctx is not None:
+            self._win_keys = max(_pow2(partition_ctx.num_keys()), 16)
         self.rate_limiter: Optional[OutputRateLimiter] = None
         self.query_callbacks: List = []
         self.output_junction: Optional[StreamJunction] = None
         self.scheduler = None  # set by the app runtime when timers are needed
         self._state: Optional[dict] = None
         self._step = None
+        self._shard_mesh = None  # set by parallel.mesh.shard_query_step
         self._lock = threading.RLock()  # per-query lock (QueryParser.java:159-215)
         self.on_error: Optional[Callable] = None
 
@@ -108,28 +123,45 @@ class QueryRuntime(Receiver):
     def _init_state(self) -> dict:
         state = {"sel": self.selector_plan.init_state()}
         if self.window_stage is not None:
-            state["win"] = self.window_stage.init_state()
+            state["win"] = self.window_stage.init_state(self._win_keys)
         return state
 
+    def _needed_sel_keys(self) -> int:
+        if self.keyer is not None:
+            return max(len(self.keyer), 1)
+        if self.partition_ctx is not None:
+            return self.partition_ctx.num_keys()
+        return 1
+
     def _ensure_capacity(self):
-        """Grow dense key capacity (pow2) when the key dictionary outgrows
-        it; state rows are preserved, step re-jitted on the new shapes."""
-        if self.keyer is None:
-            return
-        needed = max(len(self.keyer), 1)
+        """Grow dense key capacity (pow2) when a key dictionary outgrows
+        it; state rows are preserved (keyed buffers are laid out so prefix
+        copy keeps per-key alignment), step re-jitted on the new shapes."""
+        grew = False
+        needed = self._needed_sel_keys()
         k = self.selector_plan.num_keys
-        if needed <= k:
+        if needed > k:
+            self.selector_plan.num_keys = _pow2(needed, start=k)
+            grew = True
+        if self.partition_ctx is not None:
+            needed_w = self.partition_ctx.num_keys()
+            if needed_w > self._win_keys:
+                self._win_keys = _pow2(needed_w, start=self._win_keys)
+                grew = True
+        if not grew:
             return
-        while k < needed:
-            k *= 2
         old_state = self._state
-        self.selector_plan.num_keys = k
         new_state = self._init_state()
         if old_state is not None:
             self._state = jax.tree_util.tree_map(_copy_prefix, new_state, old_state)
         else:
             self._state = new_state
         self._step = None  # re-jit
+        if self._shard_mesh is not None:
+            # re-establish key-axis sharding on the grown state
+            from siddhi_tpu.parallel.mesh import shard_query_step
+
+            shard_query_step(self, self._shard_mesh)
 
     def _make_step(self):
         return jax.jit(self.build_step_fn(), donate_argnums=0)
@@ -172,6 +204,11 @@ class QueryRuntime(Receiver):
 
     def receive(self, events: List[Event]):
         batch = HostBatch.from_events(events, self.input_definition, self.dictionary)
+        if self.carried_pk:
+            pk = np.zeros(batch.capacity, np.int32)
+            for i, e in enumerate(events):
+                pk[i] = e.pk or 0
+            batch.cols[PK_KEY] = pk
         self.process_batch(batch)
 
     def process_timer(self, ts: int):
@@ -188,12 +225,25 @@ class QueryRuntime(Receiver):
     def process_batch(self, batch: HostBatch):
         with self._lock:
             cols = batch.cols
+            partitioned = self.partition_ctx is not None
+            pk = None
+            if partitioned:
+                if self.carried_pk:
+                    pk = cols.get(PK_KEY)
+                    if pk is None:
+                        pk = np.zeros(batch.capacity, np.int32)
+                elif self.partition_keyer is not None:
+                    cols, pk = self.partition_keyer.apply(cols)
+                    batch = HostBatch(cols)
+                cols[PK_KEY] = np.asarray(pk, np.int32)
             if self.keyer is not None:
-                gk = self.keyer(cols)
-                cols[GK_KEY] = gk
-                self._ensure_capacity()
+                cols[GK_KEY] = self.keyer(cols, pk=pk if partitioned else None)
+            elif partitioned:
+                cols[GK_KEY] = cols[PK_KEY]
             else:
                 cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
+            if partitioned or self.keyer is not None:
+                self._ensure_capacity()
             if self._state is None:
                 self._state = self._init_state()
             if self._step is None:
@@ -203,9 +253,14 @@ class QueryRuntime(Receiver):
             out_host = {k: np.asarray(v) for k, v in out.items()}
             overflow = out_host.pop("__overflow__", None)
             if overflow is not None and int(overflow) > 0:
+                knob = (
+                    "app_context.partition_window_capacity"
+                    if self.partition_ctx is not None
+                    else "app_context.window_capacity"
+                )
                 raise RuntimeError(
                     f"query '{self.name}': window buffer capacity exceeded — "
-                    f"raise app window capacity (app_context.window_capacity)"
+                    f"raise {knob} before creating the runtime"
                 )
             notify = out_host.pop("__notify__", None)
             self._emit(HostBatch(out_host))
@@ -215,7 +270,10 @@ class QueryRuntime(Receiver):
     def _emit(self, out: HostBatch):
         if out.size == 0:
             return
-        events = out.to_events(self.output_attrs, self.dictionary)
+        events = out.to_events(
+            self.output_attrs, self.dictionary,
+            pk_key=PK_KEY if self.attach_pk else None,
+        )
         if self.rate_limiter is not None:
             self.rate_limiter.process(events)
         else:
@@ -227,7 +285,7 @@ class QueryRuntime(Receiver):
         if self.output_junction is not None:
             # EXPIRED -> CURRENT on re-publish (InsertIntoStreamCallback.java:52-55)
             repub = [
-                Event(timestamp=e.timestamp, data=e.data) if e.is_expired else e
+                Event(timestamp=e.timestamp, data=e.data, pk=e.pk) if e.is_expired else e
                 for e in events
             ]
             self.output_junction.send_events(repub)
@@ -243,6 +301,13 @@ def _zero_value(attr_type: AttrType):
     if attr_type == AttrType.BOOL:
         return False
     return 0
+
+
+def _pow2(needed: int, start: int = 16) -> int:
+    k = max(start, 1)
+    while k < needed:
+        k *= 2
+    return k
 
 
 def _copy_prefix(new, old):

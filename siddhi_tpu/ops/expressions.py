@@ -50,6 +50,7 @@ from siddhi_tpu.query_api.expressions import (
 TS_KEY = "__ts__"
 TYPE_KEY = "__type__"
 VALID_KEY = "__valid__"
+PK_KEY = "__pk__"  # partition-key id column (dense, host-computed)
 
 
 @dataclass

@@ -1,0 +1,244 @@
+"""Per-partition-key window stages: dense ``[K, W]`` ring-buffer tensors.
+
+Inside ``partition with (...)`` each partition instance owns an independent
+window in the reference (one processor object per key, created lazily by
+``PartitionRuntimeImpl.initPartition``, ``partition/PartitionRuntimeImpl.java:346-365``).
+Here all keys share one state tensor: buffers are flattened ``[K*W]`` arrays
+(key ``k`` owns slots ``[k*W, (k+1)*W)``) so capacity growth along the key
+axis is a prefix copy, and one batch updates every key's window with
+gather/scatter — no per-key loop, no vmap over K.
+
+Semantics match the unkeyed stages in ``ops/windows.py`` applied per key:
+- keyed length: sliding; when key k's window is full, each arrival on k
+  emits [EXPIRED(oldest of k, ts=now), CURRENT] (``LengthWindowProcessor``).
+- keyed time: sliding; each key's FIFO drains entries older than t before
+  the batch; TIMER chunks drain all keys (``TimeWindowProcessor``).
+
+The partition key id column is ``PK_KEY`` (host-computed, dense ids).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY, CompileError
+from siddhi_tpu.ops.windows import (
+    CURRENT,
+    EXPIRED,
+    NOTIFY_KEY,
+    OVERFLOW_KEY,
+    WindowStage,
+    _BIG,
+    _data_keys,
+    _order_emit,
+)
+
+
+
+def _per_key_layout(pk, valid_cur, num_keys: int):
+    """Group batch rows by key: returns (order, inv_order, occ, counts,
+    start_pos) where occ[i] is row i's arrival rank within its key this
+    batch, counts is [K] per-key insert count, and start_pos[i] is the
+    sorted-array position of the first row of row i's key."""
+    B = pk.shape[0]
+    safe_pk = jnp.where(valid_cur, pk, num_keys).astype(jnp.int32)
+    order = jnp.argsort(safe_pk, stable=True)
+    inv_order = jnp.argsort(order, stable=True)
+    pk_sorted = safe_pk[order]
+    sidx = jnp.arange(B, dtype=jnp.int64)
+    seg_start = jnp.concatenate([jnp.ones(1, bool), pk_sorted[1:] != pk_sorted[:-1]])
+    start_pos_sorted = lax.cummax(jnp.where(seg_start, sidx, jnp.int64(-1)))
+    occ_sorted = sidx - start_pos_sorted
+    occ = occ_sorted[inv_order]
+    start_pos = start_pos_sorted[inv_order]
+    counts = jnp.zeros(num_keys + 1, jnp.int64).at[safe_pk].add(1)[:num_keys]
+    return order, inv_order, occ, counts, start_pos
+
+
+class KeyedLengthWindowStage(WindowStage):
+    """Sliding length window per partition key."""
+
+    keyed = True
+
+    def __init__(self, length: int, col_specs: Dict[str, np.dtype]):
+        if length <= 0:
+            raise CompileError("length window needs a positive length")
+        self.length = length
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        W = self.length
+        buf = {k: jnp.zeros((num_keys * W,), dt) for k, dt in self.col_specs.items()}
+        return {"buf": buf, "total": jnp.zeros((num_keys,), jnp.int64)}
+
+    def apply(self, state, cols, ctx):
+        W = self.length
+        K = state["total"].shape[0]
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        pk = jnp.clip(cols[PK_KEY].astype(jnp.int64), 0, K - 1)
+
+        order, _inv, occ, counts, start_pos = _per_key_layout(pk, valid_cur, K)
+
+        total0 = state["total"][pk]            # per-row prior count of its key
+        seq = total0 + occ                     # per-key arrival sequence
+        evicts = valid_cur & (seq >= W)
+        evict_seq = seq - W
+
+        # evictee inserted earlier in this same batch?
+        from_batch = evict_seq >= total0
+        batch_sorted_pos = jnp.clip(start_pos + (evict_seq - total0), 0, B - 1)
+        batch_row = order[batch_sorted_pos]
+        flat = jnp.clip(pk * W + evict_seq % W, 0, K * W - 1)
+
+        expired = {}
+        for k in keys:
+            ring_v = state["buf"][k][flat]
+            expired[k] = jnp.where(from_batch, cols[k][batch_row], ring_v)
+        expired[TS_KEY] = jnp.broadcast_to(now, (B,))  # LengthWindowProcessor:120
+
+        # write the last min(W, n_key) arrivals of each key (unique slots)
+        write = valid_cur & (occ >= counts[pk] - W)
+        slot = jnp.where(write, pk * W + seq % W, jnp.int64(K * W)).astype(jnp.int64)
+        new_buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop") for k in state["buf"]}
+
+        idx = jnp.arange(B, dtype=jnp.int64)
+        parts = [
+            (expired, jnp.full((B,), EXPIRED, jnp.int8), evicts, 2 * idx),
+            ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, 2 * idx + 1),
+        ]
+        out, _ = _order_emit(parts)
+        return {"buf": new_buf, "total": state["total"] + counts}, out
+
+
+class KeyedTimeWindowStage(WindowStage):
+    """Sliding time window per partition key (live clock driven). Each key
+    keeps a FIFO ring of capacity ``Wc``; expiry scans the ``[K, Wc]`` ring
+    (arrival order per key is timestamp-monotone, so the expired set is a
+    FIFO prefix per key)."""
+
+    keyed = True
+    needs_scheduler = True
+
+    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int):
+        self.time_ms = time_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        buf = {k: jnp.zeros((num_keys * Wc,), dt) for k, dt in self.col_specs.items()}
+        return {
+            "buf": buf,
+            "total": jnp.zeros((num_keys,), jnp.int64),
+            "expired_upto": jnp.zeros((num_keys,), jnp.int64),
+        }
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        K = state["total"].shape[0]
+        t = jnp.int64(self.time_ms)
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        ts = cols[TS_KEY]
+        pk = jnp.clip(cols[PK_KEY].astype(jnp.int64), 0, K - 1)
+        # order keys: all ring expirees (0..K*Wc-1) drain before the batch;
+        # then per batch row r: same-key in-batch expirees at BASE+r*STRIDE+i,
+        # r's own CURRENT at BASE+r*STRIDE+B+1.
+        STRIDE = jnp.int64(B + 2)
+        BASE = jnp.int64(K * Wc)
+
+        total0 = state["total"]          # [K]
+        exp0 = state["expired_upto"]     # [K]
+
+        # [K, Wc] FIFO view of every key's ring
+        j = jnp.arange(Wc, dtype=jnp.int64)
+        fifo_seq = exp0[:, None] + j[None, :]
+        occupied = fifo_seq < total0[:, None]
+        fifo_flat = (jnp.arange(K, dtype=jnp.int64)[:, None] * Wc + fifo_seq % Wc)
+        ring_ts = state["buf"][TS_KEY][fifo_flat]
+        expire_ring = occupied & (ring_ts + t <= now)
+        n_exp_per_key = jnp.sum(expire_ring.astype(jnp.int64), axis=1)
+
+        # within-batch expiry: a row whose ts is already older than the
+        # cutoff expires before the next CURRENT row of the same key
+        order, inv, occ, counts, start_pos = _per_key_layout(pk, valid_cur, K)
+        B_idx = jnp.arange(B, dtype=jnp.int64)
+        # next valid row of the same key (in original coords; B if none)
+        nxt_sorted_pos = start_pos + occ + 1
+        has_next = (occ + 1) < counts[pk]
+        nxt = jnp.where(has_next, order[jnp.clip(nxt_sorted_pos, 0, B - 1)], B)
+        batch_exp = valid_cur & (ts + t <= now) & (nxt < B)
+
+        ring_rows = {k: state["buf"][k][fifo_flat.reshape(-1)] for k in state["buf"]}
+        ring_rows[TS_KEY] = jnp.where(expire_ring.reshape(-1), now, ring_rows[TS_KEY])
+        batch_exp_rows = {k: cols[k] for k in keys}
+        batch_exp_rows[TS_KEY] = jnp.broadcast_to(now, (B,))
+
+        ring_okey = jnp.arange(K * Wc, dtype=jnp.int64)
+        batch_okey = BASE + nxt * STRIDE + B_idx
+        cur_okey = BASE + B_idx * STRIDE + B + 1
+
+        parts = [
+            (ring_rows, jnp.full((K * Wc,), EXPIRED, jnp.int8), expire_ring.reshape(-1), ring_okey),
+            (batch_exp_rows, jnp.full((B,), EXPIRED, jnp.int8), batch_exp, batch_okey),
+            ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, cur_okey),
+        ]
+        out, _ = _order_emit(parts)
+
+        # append inserts per key
+        seq = total0[pk] + occ
+        write = valid_cur & (occ >= counts[pk] - Wc)
+        slot = jnp.where(write, pk * Wc + seq % Wc, jnp.int64(K * Wc))
+        new_buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop") for k in state["buf"]}
+        n_batch_exp_per_key = jnp.zeros(K + 1, jnp.int64).at[
+            jnp.where(batch_exp, pk, K)
+        ].add(1)[:K]
+        new_total = total0 + counts
+        new_exp = exp0 + n_exp_per_key + n_batch_exp_per_key
+
+        live = new_total - new_exp
+        out[OVERFLOW_KEY] = jnp.any(live > Wc).astype(jnp.int32)
+
+        fifo2 = new_exp[:, None] + j[None, :]
+        occ2 = fifo2 < new_total[:, None]
+        flat2 = jnp.arange(K, dtype=jnp.int64)[:, None] * Wc + fifo2 % Wc
+        ts2 = new_buf[TS_KEY][flat2]
+        nxt_notify = jnp.min(jnp.where(occ2, ts2 + t, _BIG))
+        out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt_notify, jnp.int64(-1))
+        return {"buf": new_buf, "total": new_total, "expired_upto": new_exp}, out
+
+
+def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:
+    """Keyed (partitioned) window factory. Capacity per key comes from
+    ``app_context.partition_window_capacity``."""
+    from siddhi_tpu.ops.types import dtype_of
+    from siddhi_tpu.ops.windows import _const_param
+
+    name = window.name.lower()
+    col_specs: Dict[str, np.dtype] = {}
+    for a in input_def.attributes:
+        col_specs[a.name] = dtype_of(a.type)
+        col_specs[a.name + "?"] = np.bool_
+    col_specs[TS_KEY] = np.int64
+    col_specs["__gk__"] = np.int32
+    col_specs[PK_KEY] = np.int32
+
+    capacity = getattr(app_context, "partition_window_capacity", 256)
+
+    if name == "length":
+        return KeyedLengthWindowStage(int(_const_param(window, 0, "length")), col_specs)
+    if name == "time":
+        return KeyedTimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
+    raise CompileError(
+        f"window '{window.name}' inside a partition is not implemented yet "
+        f"(keyed variants exist for: length, time)"
+    )

@@ -59,24 +59,37 @@ def key_axis_sharding(mesh: Mesh, arr_ndim: int, key_axis_index: int) -> NamedSh
     return NamedSharding(mesh, P(*spec))
 
 
-def state_shardings(state, mesh: Mesh, num_keys: int):
+def state_shardings(state, mesh: Mesh, num_keys: int, win_keys: int = 1):
     """Pytree of shardings for a query-state pytree.
 
     Only keyed state is sharded: selector/aggregator arrays (under the
     ``"sel"`` subtree, shape ``[slots, K]``) and partitioned window state
-    (under ``"win"`` with a leading ``K`` axis) split along K. Global
-    (unkeyed) window ring buffers and scalars are replicated — sharding a
+    (under ``"win"``: per-key rows ``[Kw]`` or flat ring buffers
+    ``[Kw*W]`` — key-contiguous layout, so an even split along axis 0 is a
+    split along keys) split across the mesh. Global (unkeyed, ``win_keys``
+    == 1) window ring buffers and scalars are replicated — sharding a
     global ring along its ring axis would put every window write on a
     collective."""
     replicated = NamedSharding(mesh, P())
+    n_dev = mesh.devices.size
 
     def one(path, leaf):
         if not hasattr(leaf, "shape"):
             return replicated
         top = path[0].key if path and hasattr(path[0], "key") else None
-        for i, s in enumerate(leaf.shape):
-            if s == num_keys and (top == "sel" or (top == "win" and i == 0)):
-                return key_axis_sharding(mesh, leaf.ndim, i)
+        if top == "sel":
+            for i, s in enumerate(leaf.shape):
+                if s == num_keys:
+                    return key_axis_sharding(mesh, leaf.ndim, i)
+        if (
+            top == "win"
+            and win_keys > 1
+            and leaf.ndim >= 1
+            and leaf.shape[0] % win_keys == 0
+            and leaf.shape[0] % n_dev == 0
+            and win_keys % n_dev == 0
+        ):
+            return key_axis_sharding(mesh, leaf.ndim, 0)
         return replicated
 
     return jax.tree_util.tree_map_with_path(one, state)
@@ -104,7 +117,8 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
     if runtime._state is None:
         runtime._state = runtime._init_state()
     step = runtime.build_step_fn()
-    st_sh = state_shardings(runtime._state, mesh, num_keys)
+    st_sh = state_shardings(runtime._state, mesh, num_keys,
+                            win_keys=getattr(runtime, "_win_keys", 1))
     state = jax.device_put(runtime._state, st_sh)
     jitted = jax.jit(
         step,
@@ -112,7 +126,10 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
         donate_argnums=(0,) if donate else (),
     )
     # hand the runtime the sharded timeline so junction-fed batches
-    # (QueryRuntime.process_batch) and direct jitted() callers share state
+    # (QueryRuntime.process_batch) and direct jitted() callers share state;
+    # remember the mesh so capacity growth re-establishes the sharding
+    # (QueryRuntime._ensure_capacity re-invokes this function)
     runtime._state = state
     runtime._step = jitted
+    runtime._shard_mesh = mesh
     return jitted, state

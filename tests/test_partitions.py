@@ -1,0 +1,186 @@
+"""Partition tests — modeled on reference
+``siddhi-core/src/test/java/io/siddhi/core/query/partition/PartitionTestCase1.java``
+(value partitions, range partitions, inner streams, partitioned windows).
+"""
+
+import threading
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+        self.lock = threading.Lock()
+
+    def receive(self, events):
+        with self.lock:
+            self.events.extend(events)
+
+
+def run_app(app, sends, out_stream="OutStream"):
+    """sends: list of (stream_id, [event rows])"""
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out_stream, collector)
+    handlers = {}
+    for sid, rows in sends:
+        if sid not in handlers:
+            handlers[sid] = runtime.get_input_handler(sid)
+        for row in rows:
+            handlers[sid].send(row)
+    manager.shutdown()
+    return collector.events
+
+
+def test_value_partition_count_per_key():
+    # separate aggregator state per partition key (PartitionTestCase1 style)
+    app = """
+        define stream StockStream (symbol string, price float, volume int);
+        partition with (symbol of StockStream)
+        begin
+            @info(name = 'query1')
+            from StockStream
+            select symbol, count() as cnt
+            insert into OutStream;
+        end;
+    """
+    events = run_app(app, [("StockStream", [
+        ["IBM", 10.0, 100],
+        ["WSO2", 20.0, 100],
+        ["IBM", 30.0, 100],
+        ["IBM", 40.0, 100],
+        ["WSO2", 50.0, 100],
+    ])])
+    got = [(e.data[0], e.data[1]) for e in events]
+    assert got == [("IBM", 1), ("WSO2", 1), ("IBM", 2), ("IBM", 3), ("WSO2", 2)]
+
+
+def test_value_partition_sum_independent_state():
+    app = """
+        define stream StockStream (symbol string, price float);
+        partition with (symbol of StockStream)
+        begin
+            from StockStream
+            select symbol, sum(price) as total
+            insert into OutStream;
+        end;
+    """
+    events = run_app(app, [("StockStream", [
+        ["A", 1.0], ["B", 10.0], ["A", 2.0], ["B", 20.0],
+    ])])
+    got = [(e.data[0], e.data[1]) for e in events]
+    assert got == [("A", 1.0), ("B", 10.0), ("A", 3.0), ("B", 30.0)]
+
+
+def test_partitioned_length_window_avg():
+    # per-key sliding window: each key's window evicts independently
+    app = """
+        define stream StockStream (symbol string, price float);
+        partition with (symbol of StockStream)
+        begin
+            from StockStream#window.length(2)
+            select symbol, avg(price) as avgPrice
+            insert into OutStream;
+        end;
+    """
+    events = run_app(app, [("StockStream", [
+        ["A", 1.0], ["A", 3.0], ["B", 100.0], ["A", 5.0], ["B", 200.0],
+    ])])
+    got = [(e.data[0], e.data[1]) for e in events]
+    # A: avg(1)=1, avg(1,3)=2, avg(3,5)=4 (1 evicted); B: avg(100)=100, avg(100,200)=150
+    assert got == [("A", 1.0), ("A", 2.0), ("B", 100.0), ("A", 4.0), ("B", 150.0)]
+
+
+def test_partition_group_by_combined_keys():
+    # group by inside a partition: state per (partition key, group key)
+    app = """
+        define stream TradeStream (symbol string, side string, qty int);
+        partition with (symbol of TradeStream)
+        begin
+            from TradeStream
+            select symbol, side, sum(qty) as total
+            group by side
+            insert into OutStream;
+        end;
+    """
+    events = run_app(app, [("TradeStream", [
+        ["A", "buy", 1], ["A", "sell", 2], ["B", "buy", 10], ["A", "buy", 4], ["B", "buy", 20],
+    ])])
+    got = [(e.data[0], e.data[1], e.data[2]) for e in events]
+    assert got == [("A", "buy", 1), ("A", "sell", 2), ("B", "buy", 10),
+                   ("A", "buy", 5), ("B", "buy", 30)]
+
+
+def test_range_partition():
+    # reference PartitionTestCase1.testPartitionQuery range style:
+    # copies per matching range, drop non-matching
+    app = """
+        define stream StockStream (symbol string, price float);
+        partition with (price < 100 as 'cheap' or price >= 100 as 'pricey' of StockStream)
+        begin
+            from StockStream
+            select symbol, count() as cnt
+            insert into OutStream;
+        end;
+    """
+    events = run_app(app, [("StockStream", [
+        ["A", 50.0], ["B", 150.0], ["C", 60.0],
+    ])])
+    got = [(e.data[0], e.data[1]) for e in events]
+    assert got == [("A", 1), ("B", 1), ("C", 2)]
+
+
+def test_inner_stream_carries_partition():
+    # reference testPartitionQuery11-ish: chained queries over '#inner'
+    app = """
+        define stream StockStream (symbol string, price float);
+        partition with (symbol of StockStream)
+        begin
+            from StockStream
+            select symbol, price * 2 as doubled
+            insert into #Mid;
+
+            from #Mid
+            select symbol, sum(doubled) as total
+            insert into OutStream;
+        end;
+    """
+    events = run_app(app, [("StockStream", [
+        ["A", 1.0], ["B", 10.0], ["A", 2.0],
+    ])])
+    got = [(e.data[0], e.data[1]) for e in events]
+    assert got == [("A", 2.0), ("B", 20.0), ("A", 6.0)]
+
+
+def test_partitioned_time_window(monkeypatch):
+    # playback-driven keyed time window: per-key expiry
+    app = """
+        @app:playback
+        define stream S (symbol string, v int);
+        partition with (symbol of S)
+        begin
+            from S#window.time(100)
+            select symbol, sum(v) as total
+            insert into OutStream;
+        end;
+    """
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback("OutStream", collector)
+    h = runtime.get_input_handler("S")
+    h.send(1000, ["A", 1])
+    h.send(1010, ["B", 10])
+    h.send(1050, ["A", 2])
+    # at 1200 both of A's events and B's are expired; new arrival sums alone
+    h.send(1200, ["A", 4])
+    h.send(1210, ["B", 40])
+    manager.shutdown()
+    got = [(e.data[0], e.data[1]) for e in collector.events]
+    assert got[:3] == [("A", 1), ("B", 10), ("A", 3)]
+    # after expiry, running sums drop back
+    assert ("A", 4) in got[3:]
+    assert ("B", 40) in got[3:]
