@@ -160,8 +160,14 @@ class SiddhiAppRuntime:
         runtime.rate_limiter = create_rate_limiter(query.output_rate, runtime.send_to_callbacks)
         runtime.scheduler = self.app_context.scheduler
 
-        input_stream_id = query.input_stream.unique_stream_id
-        if partition_ctx is not None and query.input_stream.is_inner_stream:
+        from siddhi_tpu.query_api.execution import StateInputStream
+
+        if isinstance(query.input_stream, StateInputStream):
+            # pattern/sequence: one proxy receiver per consumed stream
+            for sid, proxy in runtime.make_proxies().items():
+                self.junctions[sid].subscribe(proxy)
+        elif partition_ctx is not None and query.input_stream.is_inner_stream:
+            input_stream_id = query.input_stream.unique_stream_id
             if input_stream_id not in partition_ctx.inner_junctions:
                 raise SiddhiAppValidationException(
                     f"inner stream '{input_stream_id}' is consumed before any query "
@@ -169,7 +175,7 @@ class SiddhiAppRuntime:
                 )
             partition_ctx.inner_junctions[input_stream_id].subscribe(runtime)
         else:
-            self.junctions[input_stream_id].subscribe(runtime)
+            self.junctions[query.input_stream.unique_stream_id].subscribe(runtime)
         self.query_runtimes[query_name] = runtime
 
     # ------------------------------------------------------------- API

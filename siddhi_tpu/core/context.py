@@ -71,6 +71,8 @@ class SiddhiAppContext:
         self.window_capacity = 4096
         # per-key ring capacity for time windows inside partitions
         self.partition_window_capacity = 256
+        # pending-match slot capacity per key for pattern/sequence queries
+        self.nfa_slots = 32
 
 
 @dataclass

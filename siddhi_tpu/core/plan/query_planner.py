@@ -19,9 +19,100 @@ from siddhi_tpu.query_api.execution import (
     Filter,
     Query,
     SingleInputStream,
+    StateInputStream,
     StreamFunction,
     Window,
 )
+
+
+def plan_nfa_query(
+    query: Query,
+    query_name: str,
+    app_context: SiddhiAppContext,
+    definitions: Dict[str, StreamDefinition],
+    partition_ctx=None,
+):
+    """Plan a pattern/sequence query: linearized NFA plan + compiled side
+    filters + selector over capture columns (reference
+    ``StateInputStreamParser.java:76-210`` + ``SelectorParser``)."""
+    from siddhi_tpu.core.query.nfa_runtime import NFAQueryRuntime
+    from siddhi_tpu.ops.expressions import compile_condition
+    from siddhi_tpu.ops.nfa import (
+        NFAOutputResolver,
+        NFASideResolver,
+        NFAStage,
+        assign_indexed_captures,
+        build_nfa_plan,
+    )
+
+    state_stream: StateInputStream = query.input_stream
+    dictionary = app_context.string_dictionary
+    plan = build_nfa_plan(state_stream, definitions, app_context.nfa_slots)
+
+    # size indexed capture storage (e1[i].attr) from every expression that
+    # can reference captures: side filters, selections, having
+    idx_exprs = [e for st in plan.steps for side in st.sides for e in side.filter_exprs]
+    idx_exprs += [oa.expression for oa in query.selector.selection_list]
+    if query.selector.having is not None:
+        idx_exprs.append(query.selector.having)
+    assign_indexed_captures(plan, idx_exprs)
+
+    for st in plan.steps:
+        for side in st.sides:
+            if side.filter_exprs:
+                resolver = NFASideResolver(side, plan, dictionary)
+                conds = [compile_condition(e, resolver) for e in side.filter_exprs]
+
+                def combined(ev, ctx, _conds=conds):
+                    r = _conds[0](ev, ctx)
+                    for c in _conds[1:]:
+                        r = r & c(ev, ctx)
+                    return r
+
+                side.cond = combined
+
+    if query.selector.select_all or not query.selector.selection_list:
+        raise CompileError(
+            f"query '{query_name}': pattern/sequence queries need an explicit "
+            f"select list (e.g. select e1.price, e2.price)"
+        )
+    if query.selector.group_by_list:
+        raise CompileError(
+            f"query '{query_name}': group by on pattern queries is not supported yet"
+        )
+
+    out_resolver = NFAOutputResolver(plan, dictionary)
+    output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
+    selector_plan = plan_selector(
+        selector=query.selector,
+        input_attrs=[],
+        resolver=out_resolver,
+        output_event_type=output_event_type,
+        batch_mode=False,
+        dictionary=dictionary,
+    )
+    selector_plan.num_keys = app_context.initial_key_capacity
+
+    stream_keyers = {}
+    if partition_ctx is not None:
+        for sid in plan.stream_ids:
+            if sid not in partition_ctx.keyers:
+                raise CompileError(
+                    f"query '{query_name}': pattern stream '{sid}' is consumed "
+                    f"inside a partition but has no partition-with clause"
+                )
+            stream_keyers[sid] = partition_ctx.keyers[sid]
+
+    return NFAQueryRuntime(
+        name=query_name,
+        app_context=app_context,
+        stage=NFAStage(plan),
+        input_defs={sid: definitions[sid] for sid in plan.stream_ids},
+        stream_keyers=stream_keyers,
+        selector_plan=selector_plan,
+        dictionary=dictionary,
+        partition_ctx=partition_ctx,
+    )
 
 
 def plan_query(
@@ -32,9 +123,11 @@ def plan_query(
     partition_ctx=None,
 ) -> QueryRuntime:
     input_stream = query.input_stream
+    if isinstance(input_stream, StateInputStream):
+        return plan_nfa_query(query, query_name, app_context, definitions, partition_ctx)
     if not isinstance(input_stream, SingleInputStream):
         raise CompileError(
-            f"query '{query_name}': join/pattern/sequence planning lands in M4/M5 "
+            f"query '{query_name}': join planning lands in M5 "
             f"(got {type(input_stream).__name__})"
         )
     stream_id = input_stream.unique_stream_id

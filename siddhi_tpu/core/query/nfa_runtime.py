@@ -1,0 +1,150 @@
+"""Host driver for pattern/sequence (NFA) queries.
+
+The counterpart of the reference's pattern receivers + state runtime
+(``query/input/stream/state/receiver/*.java``, ``StateStreamRuntime.java``):
+one runtime subscribes to every junction the pattern consumes (via
+``StreamProxy`` receivers); each arriving chunk runs that stream's jitted
+NFA transition (``ops/nfa.py``) fused with the query's selector stage.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.event import Event, HostBatch
+from siddhi_tpu.core.plan.selector_plan import GK_KEY
+from siddhi_tpu.core.query.runtime import QueryRuntime
+from siddhi_tpu.core.stream.junction import Receiver
+from siddhi_tpu.ops.expressions import PK_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.ops.nfa import NFAStage
+from siddhi_tpu.query_api.definitions import StreamDefinition
+
+
+class StreamProxy(Receiver):
+    """Per-input-stream junction subscriber for one NFA query (the role of
+    PatternSingle/SequenceSingleProcessStreamReceiver)."""
+
+    def __init__(self, runtime: "NFAQueryRuntime", stream_id: str,
+                 definition: StreamDefinition):
+        self.runtime = runtime
+        self.stream_id = stream_id
+        self.definition = definition
+
+    def receive(self, events: List[Event]):
+        batch = HostBatch.from_events(events, self.definition, self.runtime.dictionary)
+        self.runtime.process_stream_batch(self.stream_id, batch)
+
+
+class NFAQueryRuntime(QueryRuntime):
+    def __init__(
+        self,
+        name: str,
+        app_context,
+        stage: NFAStage,
+        input_defs: Dict[str, StreamDefinition],
+        stream_keyers: Dict[str, object],
+        selector_plan,
+        dictionary,
+        partition_ctx=None,
+    ):
+        super().__init__(
+            name=name,
+            app_context=app_context,
+            input_definition=None,
+            filters=[],
+            window_stage=None,
+            selector_plan=selector_plan,
+            keyer=None,
+            dictionary=dictionary,
+            partition_ctx=partition_ctx,
+        )
+        self.stage = stage
+        self.input_defs = input_defs
+        self.stream_keyers = stream_keyers  # stream id -> partition keyer|None
+        self._steps: Dict[str, object] = {}
+
+    # -------------------------------------------------------------- wiring
+
+    def make_proxies(self) -> Dict[str, StreamProxy]:
+        return {
+            sid: StreamProxy(self, sid, self.input_defs[sid])
+            for sid in self.stage.plan.stream_ids
+        }
+
+    # --------------------------------------------------------------- state
+
+    def _init_state(self) -> dict:
+        return {
+            "sel": self.selector_plan.init_state(),
+            "nfa": self.stage.init_state(self._win_keys),
+        }
+
+    def _ensure_capacity(self):
+        before = (self.selector_plan.num_keys, self._win_keys)
+        super()._ensure_capacity()
+        if (self.selector_plan.num_keys, self._win_keys) != before:
+            self._steps.clear()
+
+    def build_stream_step_fn(self, stream_id: str):
+        """Pure (state, cols, now) -> (state', out) for one input stream —
+        the NFA transition fused with the selector stage."""
+        stage = self.stage
+        sel = self.selector_plan
+
+        def step(state, cols, current_time):
+            ctx = {"xp": jnp, "current_time": current_time}
+            new_nfa, out_cols = stage.apply_stream(stream_id, state["nfa"], cols, ctx)
+            out_cols = dict(out_cols)
+            overflow = out_cols.pop("__overflow__", None)
+            new_sel, out = sel.apply(state["sel"], out_cols, ctx)
+            if overflow is not None:
+                out["__overflow__"] = overflow
+            return {"nfa": new_nfa, "sel": new_sel}, out
+
+        return step
+
+    def build_step_fn(self):
+        # single-step export (driver compile checks): first stream's step
+        return self.build_stream_step_fn(self.stage.plan.stream_ids[0])
+
+    # ----------------------------------------------------------- processing
+
+    def process_stream_batch(self, stream_id: str, batch: HostBatch):
+        with self._lock:
+            cols = batch.cols
+            partitioned = self.partition_ctx is not None
+            if partitioned:
+                keyer = self.stream_keyers.get(stream_id)
+                if keyer is not None:
+                    cols, pk = keyer.apply(cols)
+                    cols[PK_KEY] = np.asarray(pk, np.int32)
+                else:
+                    cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
+                cols[GK_KEY] = cols[PK_KEY]
+            else:
+                cols[GK_KEY] = np.zeros(cols[VALID_KEY].shape[0], np.int32)
+            if partitioned:
+                self._ensure_capacity()
+            if self._state is None:
+                self._state = self._init_state()
+            step = self._steps.get(stream_id)
+            if step is None:
+                step = jax.jit(self.build_stream_step_fn(stream_id), donate_argnums=0)
+                self._steps[stream_id] = step
+            now = np.int64(self.app_context.timestamp_generator.current_time())
+            self._state, out = step(self._state, cols, now)
+            out_host = {k: np.asarray(v) for k, v in out.items()}
+            overflow = out_host.pop("__overflow__", None)
+            if overflow is not None and int(overflow) > 0:
+                raise RuntimeError(
+                    f"query '{self.name}': pattern match-slot capacity exceeded — "
+                    f"raise app_context.nfa_slots before creating the runtime"
+                )
+            self._emit(HostBatch(out_host))
+
+    def receive(self, events: List[Event]):  # pragma: no cover — proxies only
+        raise RuntimeError("NFA queries receive through per-stream proxies")

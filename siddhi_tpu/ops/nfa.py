@@ -1,0 +1,793 @@
+"""NFA pattern/sequence engine over dense per-key match-slot tensors.
+
+Replaces the reference's pending-state-event lists
+(``query/input/stream/state/StreamPreStateProcessor.java:364-403`` — a
+sequential scan of a linked list of partial matches per incoming event) with
+fixed-capacity slot tensors:
+
+    active  [K, S] bool      — slot holds a partial match
+    stepi   [K, S] int32     — pattern position the slot is resting at
+    bits    [K, S] int32     — matched-sides mask for logical and/or steps
+    sts     [K, S] int64     — first-event timestamp (drives `within`)
+    capdone [K, S] int32     — bitmask of capture-ids already filled
+    caps    {c<cid>__<col>: [K, S]} — captured attribute values per ref
+            (count refs also keep per-index slots c<cid>i<i>__<col> and an
+             occurrence counter c<cid>__#n)
+
+K = partition keys (1 when unpartitioned), S = slot capacity. One device
+step processes a whole batch: rows are grouped per key (`_per_key_layout`)
+and a ``lax.while_loop`` runs one *round* per same-key occurrence — rows in
+a round have distinct keys, so each round's slot updates are one parallel
+gather/scatter over every key at once. Pending-match scans across 10k keys
+become a single [B, S] mask computation.
+
+Semantics reproduced (reference file:line):
+- PATTERN keeps pending matches across non-matching events; SEQUENCE kills
+  every pending match an event fails to extend
+  (``StreamPreStateProcessor.java:382-395``).
+- ``every`` re-arms the start state for every event
+  (``addEveryState``:230-247); without it the start arms exactly once.
+- ``within`` expires partial matches lazily against the triggering event's
+  timestamp (``isExpired``:118, ``expireEvents``:326).
+- Count states ``e<min:max>`` accumulate into ONE partial match (no
+  per-event forking — ``CountPatternTestCase.testQuery1`` expects a single
+  match for 3 accumulated events); once ``min`` is reached the match is
+  eligible for the next step, and min-0 count steps are skippable
+  (``testQuery7``: B alone matches ``A<0:5> -> B``). Unindexed references
+  (``e1.price``) read the **last** captured event
+  (``StateEvent.getStreamEvent``: CURRENT walks to chain end,
+  ``event/state/StateEvent.java:152-156``); ``e1[i].price`` reads
+  occurrence i (null when fewer were captured).
+- Logical ``and``/``or`` match sides in any order
+  (``LogicalPreStateProcessor``).
+
+Known gaps (reported as CompileError): absent (`not ... for`) states,
+mid-pattern `every`, `e[last]` indexing, an event forking one slot down two
+paths at once (same-stream adjacent steps where both could consume it —
+the furthest-advanced transition wins here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from siddhi_tpu.ops.expressions import (
+    PK_KEY,
+    TS_KEY,
+    TYPE_KEY,
+    VALID_KEY,
+    ColumnRef,
+    CompileError,
+    Resolver,
+)
+from siddhi_tpu.ops.keyed_windows import _per_key_layout
+from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
+from siddhi_tpu.query_api.execution import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EveryStateElement,
+    LogicalStateElement,
+    NextStateElement,
+    StateInputStream,
+    StateInputStreamType,
+    StreamStateElement,
+)
+from siddhi_tpu.query_api.expressions import Expression, Variable
+
+CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
+ANY_MAX = 2 ** 30
+
+
+# --------------------------------------------------------------------- plan
+
+
+@dataclass
+class CaptureSpec:
+    """One capturable stream reference (``e1=...``)."""
+
+    cid: int
+    ref_id: Optional[str]
+    stream_id: str
+    definition: StreamDefinition
+    is_count: bool = False
+    n_idx: int = 0               # indexed slots kept (max referenced idx + 1)
+
+
+@dataclass
+class SideSpec:
+    """One stream-consuming side of a step (logical steps have two)."""
+
+    capture: CaptureSpec
+    filter_exprs: list = field(default_factory=list)  # query-api Expressions
+    cond: Optional[Callable] = None                   # compiled later
+    bit: int = 1
+
+
+@dataclass
+class StepSpec:
+    index: int
+    kind: str                    # 'stream' | 'count' | 'and' | 'or'
+    sides: List[SideSpec]
+    min_count: int = 1
+    max_count: int = 1
+
+    @property
+    def full_bits(self) -> int:
+        return (1 << len(self.sides)) - 1
+
+    @property
+    def skippable(self) -> bool:
+        return self.kind == "count" and self.min_count == 0
+
+
+@dataclass
+class NFAPlan:
+    steps: List[StepSpec]
+    captures: List[CaptureSpec]
+    every: bool
+    sequence: bool
+    within: Optional[int]        # milliseconds, whole-pattern
+    slots: int
+    stream_ids: List[str]        # unique consumed stream ids, stable order
+
+    @property
+    def last_step(self) -> int:
+        return len(self.steps) - 1
+
+
+def _flatten_chain(el) -> List:
+    if isinstance(el, NextStateElement):
+        if el.within is not None:
+            raise CompileError(
+                "`within` on a parenthesized sub-pattern is not supported yet "
+                "— apply it to the whole pattern"
+            )
+        return _flatten_chain(el.state) + _flatten_chain(el.next)
+    return [el]
+
+
+def build_nfa_plan(
+    state_stream: StateInputStream,
+    definitions: Dict[str, StreamDefinition],
+    slots: int,
+) -> NFAPlan:
+    """Linearize the state-element tree into step specs (the role of
+    ``StateInputStreamParser.java:76-210`` building the InnerStateRuntime
+    tree — flat here because the chain is executed as step indices)."""
+    every = False
+    within = state_stream.within
+    root = state_stream.state_element
+    if isinstance(root, EveryStateElement):
+        # `every (...) within t` scopes the whole pattern here
+        every = True
+        if root.within is not None:
+            within = root.within if within is None else min(within, root.within)
+        root = root.state
+    elements = _flatten_chain(root)
+    if elements and isinstance(elements[0], EveryStateElement):
+        every = True
+        ev0 = elements[0]
+        if ev0.within is not None and len(elements) > 1:
+            raise CompileError(
+                "`within` scoped to the first pattern element is not supported "
+                "yet — apply it to the whole pattern"
+            )
+        if ev0.within is not None:
+            within = ev0.within if within is None else min(within, ev0.within)
+        elements = _flatten_chain(ev0.state) + elements[1:]
+    # `every` deeper in the chain needs mid-pattern re-arming (reference
+    # EveryInnerStateRuntime) — not supported yet
+    for el in elements:
+        if isinstance(el, EveryStateElement):
+            raise CompileError(
+                "`every` is only supported wrapping the whole pattern or its "
+                "first element"
+            )
+        if el.within is not None:
+            raise CompileError(
+                "per-element `within` is not supported yet — apply it to the "
+                "whole pattern"
+            )
+
+    captures: List[CaptureSpec] = []
+    steps: List[StepSpec] = []
+
+    def make_capture(stream_el: StreamStateElement, is_count: bool) -> SideSpec:
+        s = stream_el.stream
+        sid = s.stream_id
+        if sid not in definitions:
+            raise CompileError(f"pattern stream '{sid}' is not defined")
+        cap = CaptureSpec(
+            cid=len(captures),
+            ref_id=s.stream_reference_id,
+            stream_id=sid,
+            definition=definitions[sid],
+            is_count=is_count,
+        )
+        captures.append(cap)
+        filters = []
+        from siddhi_tpu.query_api.execution import Filter
+
+        for h in s.handlers:
+            if isinstance(h, Filter):
+                filters.append(h.expression)
+            else:
+                raise CompileError(
+                    "only [filter] handlers are allowed on pattern streams"
+                )
+        return SideSpec(capture=cap, filter_exprs=filters)
+
+    for el in elements:
+        idx = len(steps)
+        if isinstance(el, AbsentStreamStateElement):
+            raise CompileError("absent patterns (`not ... for`) land next")
+        if isinstance(el, CountStateElement):
+            side = make_capture(el.state, is_count=True)
+            mn = el.min_count if el.min_count != CountStateElement.ANY else 0
+            mx = el.max_count if el.max_count != CountStateElement.ANY else ANY_MAX
+            steps.append(StepSpec(index=idx, kind="count", sides=[side],
+                                  min_count=mn, max_count=mx))
+        elif isinstance(el, LogicalStateElement):
+            if isinstance(el.stream1, AbsentStreamStateElement) or isinstance(
+                el.stream2, AbsentStreamStateElement
+            ):
+                raise CompileError("absent logical patterns land next")
+            side1 = make_capture(el.stream1, is_count=False)
+            side2 = make_capture(el.stream2, is_count=False)
+            side1.bit, side2.bit = 1, 2
+            steps.append(StepSpec(index=idx, kind=el.type, sides=[side1, side2]))
+        elif isinstance(el, StreamStateElement):
+            side = make_capture(el, is_count=False)
+            steps.append(StepSpec(index=idx, kind="stream", sides=[side]))
+        else:
+            raise CompileError(f"unsupported state element {type(el).__name__}")
+
+    stream_ids: List[str] = []
+    for st in steps:
+        for side in st.sides:
+            if side.capture.stream_id not in stream_ids:
+                stream_ids.append(side.capture.stream_id)
+
+    return NFAPlan(
+        steps=steps,
+        captures=captures,
+        every=every,
+        sequence=state_stream.state_type == StateInputStreamType.SEQUENCE,
+        within=within,
+        slots=slots,
+        stream_ids=stream_ids,
+    )
+
+
+def _walk_expressions(expr, visit):
+    if expr is None:
+        return
+    visit(expr)
+    for attr_name in ("left", "right", "expression"):
+        child = getattr(expr, attr_name, None)
+        if isinstance(child, Expression):
+            _walk_expressions(child, visit)
+    params = getattr(expr, "parameters", None)
+    if params:
+        for p in params:
+            _walk_expressions(p, visit)
+
+
+def assign_indexed_captures(plan: NFAPlan, exprs: List) -> None:
+    """Scan expressions for ``e1[i].attr`` references and size each
+    capture's indexed storage (reference keeps the full StreamEvent chain;
+    here only statically-referenced indices are materialized)."""
+
+    def visit(e):
+        if not isinstance(e, Variable) or e.stream_index is None:
+            return
+        if not isinstance(e.stream_index, int):
+            raise CompileError(
+                f"event index '{e.stream_index}' is not supported yet "
+                f"(only e[<int>])"
+            )
+        for cap in plan.captures:
+            if e.stream_id in (cap.ref_id, cap.stream_id):
+                if cap.is_count:  # non-count refs hold a single event
+                    cap.n_idx = max(cap.n_idx, e.stream_index + 1)
+                return
+        raise CompileError(f"unknown pattern reference '{e.stream_id}'")
+
+    for expr in exprs:
+        _walk_expressions(expr, visit)
+
+
+# ----------------------------------------------------------------- columns
+
+
+def cap_col(cid: int, attr: str) -> str:
+    return f"c{cid}__{attr}"
+
+
+def cap_idx_col(cid: int, i: int, attr: str) -> str:
+    return f"c{cid}i{i}__{attr}"
+
+
+def cap_cnt_col(cid: int) -> str:
+    return f"c{cid}__#n"
+
+
+def _resolve_cap(plan: NFAPlan, var: Variable) -> Optional[Tuple[CaptureSpec, object]]:
+    sid = var.stream_id
+    for cap in plan.captures:
+        if sid is not None and sid not in (cap.ref_id, cap.stream_id):
+            continue
+        try:
+            attr = cap.definition.attribute(var.attribute_name)
+        except Exception:
+            continue
+        return cap, attr
+    return None
+
+
+def _cap_ref(plan: NFAPlan, var: Variable) -> Optional[ColumnRef]:
+    got = _resolve_cap(plan, var)
+    if got is None:
+        return None
+    cap, attr = got
+    if var.stream_index is not None:
+        if not isinstance(var.stream_index, int):
+            raise CompileError("only e[<int>] indexing is supported yet")
+        if var.stream_index >= max(cap.n_idx, 1) and cap.is_count:
+            raise CompileError(
+                f"index {var.stream_index} out of the capture's sized range"
+            )
+        if not cap.is_count and var.stream_index != 0:
+            raise CompileError("only count states capture multiple events")
+        if cap.is_count:
+            return ColumnRef(cap_idx_col(cap.cid, var.stream_index, attr.name), attr.type)
+    return ColumnRef(cap_col(cap.cid, attr.name), attr.type)
+
+
+class NFASideResolver(Resolver):
+    """Resolve variables inside a step-side filter: the side's own stream
+    attributes read the current event; references to other captures read
+    capture columns (last event by default, e[i] for indexed)."""
+
+    def __init__(self, side: SideSpec, plan: NFAPlan, dictionary):
+        self.side = side
+        self.plan = plan
+        self.dictionary = dictionary
+
+    def resolve(self, var: Variable) -> ColumnRef:
+        sid = var.stream_id
+        cap = self.side.capture
+        own = sid is None or sid == cap.ref_id or (cap.ref_id is None and sid == cap.stream_id)
+        if own and var.stream_index is None:
+            try:
+                attr = cap.definition.attribute(var.attribute_name)
+                return ColumnRef(attr.name, attr.type)
+            except Exception:
+                if sid is not None:
+                    raise
+        ref = _cap_ref(self.plan, var)
+        if ref is not None:
+            return ref
+        raise CompileError(
+            f"cannot resolve '{(sid + '.') if sid else ''}{var.attribute_name}' "
+            f"in pattern filter"
+        )
+
+    def encode_string(self, s: str) -> int:
+        return self.dictionary.encode(s)
+
+
+class NFAOutputResolver(Resolver):
+    """Resolve selector variables of a pattern query against capture
+    columns (``e1.price``, ``e1[0].price``, or bare stream names)."""
+
+    def __init__(self, plan: NFAPlan, dictionary):
+        self.plan = plan
+        self.dictionary = dictionary
+        self.synthetic: Dict[str, AttrType] = {}
+
+    def resolve(self, var: Variable) -> ColumnRef:
+        if var.attribute_name in self.synthetic and var.stream_id is None:
+            return ColumnRef(var.attribute_name, self.synthetic[var.attribute_name])
+        ref = _cap_ref(self.plan, var)
+        if ref is not None:
+            return ref
+        raise CompileError(
+            f"cannot resolve '{(var.stream_id + '.') if var.stream_id else ''}"
+            f"{var.attribute_name}' in pattern selector"
+        )
+
+    def encode_string(self, s: str) -> int:
+        return self.dictionary.encode(s)
+
+
+# ------------------------------------------------------------ device stage
+
+
+def _cap_state_cols(plan: NFAPlan) -> Dict[str, np.dtype]:
+    """State columns for captured values (value + null-mask per attribute,
+    per capture; indexed slots and an occurrence counter for counts)."""
+    from siddhi_tpu.ops.types import dtype_of
+
+    cols: Dict[str, np.dtype] = {}
+    for cap in plan.captures:
+        for a in cap.definition.attributes:
+            cols[cap_col(cap.cid, a.name)] = dtype_of(a.type)
+            cols[cap_col(cap.cid, a.name) + "?"] = np.bool_
+            for i in range(cap.n_idx):
+                cols[cap_idx_col(cap.cid, i, a.name)] = dtype_of(a.type)
+                cols[cap_idx_col(cap.cid, i, a.name) + "?"] = np.bool_
+        cols[cap_col(cap.cid, TS_KEY)] = np.int64
+        if cap.is_count:
+            cols[cap_cnt_col(cap.cid)] = np.int32
+    return cols
+
+
+class NFAStage:
+    """Device NFA: per-input-stream step functions over shared slot state."""
+
+    def __init__(self, plan: NFAPlan):
+        self.plan = plan
+        self.cap_cols = _cap_state_cols(plan)
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        K, S = num_keys, self.plan.slots
+        state = {
+            "active": jnp.zeros((K, S), bool),
+            "stepi": jnp.zeros((K, S), jnp.int32),
+            "bits": jnp.zeros((K, S), jnp.int32),
+            "sts": jnp.zeros((K, S), jnp.int64),
+            "capdone": jnp.zeros((K, S), jnp.int32),
+            "consumed": jnp.zeros((K,), bool),
+            "nfa_overflow": jnp.int32(0),
+        }
+        for name, dt in self.cap_cols.items():
+            state[name] = jnp.zeros((K, S), dt)
+        return state
+
+    # ............................................ static eligibility chains
+
+    def _advance_sources(self, j: int) -> List[int]:
+        """Resting positions p < j a slot can advance from when step j's
+        event arrives: walk back across count steps; positions before a
+        count with min > 0 are unreachable."""
+        out = []
+        p = j - 1
+        while p >= 0:
+            st = self.plan.steps[p]
+            if st.kind != "count":
+                break
+            out.append(p)
+            if st.min_count != 0:
+                break
+            p -= 1
+        return out
+
+    def _fresh_ok(self, j: int) -> bool:
+        """A fresh (unstarted) match can begin at step j iff every earlier
+        step is a skippable min-0 count."""
+        return all(self.plan.steps[p].skippable for p in range(j))
+
+    # .................................................. one stream's step
+
+    def apply_stream(self, stream_id: str, state: dict, cols: dict, ctx: dict):
+        """Process one batch arriving on ``stream_id``; returns
+        (new_state, out_cols) where out_cols is a flattened [B*(S+1)] match
+        emission (capture columns + __ts__/__type__/__valid__/__gk__)."""
+        plan = self.plan
+        S = plan.slots
+        L = plan.last_step
+        K = state["consumed"].shape[0]
+        B = cols[VALID_KEY].shape[0]
+        ts = cols[TS_KEY]
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        pk = jnp.clip(cols.get(PK_KEY, jnp.zeros(B, jnp.int32)).astype(jnp.int32), 0, K - 1)
+
+        _o, _i, occ, _c, _s = _per_key_layout(pk, valid_cur, K)
+        n_rounds = jnp.max(jnp.where(valid_cur, occ, -1)) + 1
+
+        # ops consuming this stream, in step order
+        ops: List[Tuple[StepSpec, SideSpec]] = [
+            (st, side)
+            for st in plan.steps
+            for side in st.sides
+            if side.capture.stream_id == stream_id
+        ]
+        in_def = ops[0][1].capture.definition if ops else None
+        cap_names = list(self.cap_cols)
+
+        def capture_current(CP, CD, mask2d, cap: CaptureSpec, reset_counter: bool):
+            """Write the current event into a capture (last + indexed slot +
+            counter) for slots selected by mask2d [B,S]."""
+            cid = cap.cid
+            for a in cap.definition.attributes:
+                n = cap_col(cid, a.name)
+                CP[n] = jnp.where(mask2d, cols[a.name][:, None], CP[n])
+                CP[n + "?"] = jnp.where(mask2d, cols[a.name + "?"][:, None], CP[n + "?"])
+            n = cap_col(cid, TS_KEY)
+            CP[n] = jnp.where(mask2d, ts[:, None], CP[n])
+            if cap.is_count:
+                cnt_n = cap_cnt_col(cid)
+                before = jnp.where(reset_counter, 0, CP[cnt_n])
+                for i in range(cap.n_idx):
+                    sel = mask2d & (before == i)
+                    for a in cap.definition.attributes:
+                        ni = cap_idx_col(cid, i, a.name)
+                        CP[ni] = jnp.where(sel, cols[a.name][:, None], CP[ni])
+                        CP[ni + "?"] = jnp.where(sel, cols[a.name + "?"][:, None],
+                                                 CP[ni + "?"])
+                CP[cnt_n] = jnp.where(mask2d, before + 1, CP[cnt_n])
+            CD = jnp.where(mask2d, CD | (1 << cid), CD)
+            return CP, CD
+
+        def round_body(carry):
+            (r, active, stepi, bits, sts, capdone, consumed, caps,
+             out_valid, out_caps, overflow) = carry
+            m = valid_cur & (occ == r)
+            rows_pk = jnp.where(m, pk, K)
+
+            A = active[pk]
+            ST = stepi[pk]
+            BT = bits[pk]
+            T0 = sts[pk]
+            CD = capdone[pk]
+            CP = {n: caps[n][pk] for n in cap_names}
+            CONS = consumed[pk]
+
+            if plan.within is not None:
+                A = A & ~(A & (ts[:, None] > T0 + jnp.int64(plan.within)))
+
+            # eval dict: current attrs [B,1], captures [B,S]
+            ev = dict(CP)
+            if in_def is not None:
+                for a in in_def.attributes:
+                    ev[a.name] = cols[a.name][:, None]
+                    ev[a.name + "?"] = cols[a.name + "?"][:, None]
+            ev[TS_KEY] = ts[:, None]
+
+            # ---- phase 1: match masks against pre-event state; the
+            # furthest-advanced op wins a slot (no per-event forking)
+            win = jnp.full((B, S), -1, jnp.int32)
+            conds: List[jnp.ndarray] = []
+            at_masks: List[jnp.ndarray] = []
+            adv_masks: List[jnp.ndarray] = []
+            for oi, (st, side) in enumerate(ops):
+                j = st.index
+                cond = side.cond(ev, ctx) if side.cond is not None \
+                    else jnp.ones((B, 1), bool)
+                cond = jnp.broadcast_to(cond, (B, S))
+                conds.append(cond)
+                at = A & (ST == j) & m[:, None] & cond
+                if st.kind == "count":
+                    cnt = CP[cap_cnt_col(side.capture.cid)]
+                    at = at & (cnt < st.max_count)
+                elif st.kind in ("and", "or"):
+                    # a side is consumed once (LogicalPreStateProcessor):
+                    # an already-matched side must not re-match/overwrite
+                    at = at & ((BT & side.bit) == 0)
+                adv = jnp.zeros((B, S), bool)
+                for p in self._advance_sources(j):
+                    src_cap = plan.steps[p].sides[0].capture
+                    pc = CP[cap_cnt_col(src_cap.cid)]
+                    adv = adv | (A & (ST == p) & (pc >= plan.steps[p].min_count))
+                adv = adv & m[:, None] & cond
+                at_masks.append(at)
+                adv_masks.append(adv)
+                win = jnp.where(at | adv, oi, win)
+
+            matched = win >= 0
+
+            # ---- phase 2: apply the winning transition per slot
+            A2, ST2, BT2, CD2 = A, ST, BT, CD
+            CP2 = dict(CP)
+            emit = jnp.zeros((B, S), bool)
+            kill = jnp.zeros((B, S), bool)
+            for oi, (st, side) in enumerate(ops):
+                j = st.index
+                eff_at = at_masks[oi] & (win == oi)
+                eff_adv = adv_masks[oi] & (win == oi)
+                eff = eff_at | eff_adv
+                cap = side.capture
+                if st.kind == "count":
+                    # entering resets the counter; absorbing continues it
+                    CP2, CD2 = capture_current(CP2, CD2, eff, cap,
+                                               reset_counter=False)
+                    # (adv into a count step: counter starts fresh — reset
+                    # happens because a newly-advanced slot's counter was
+                    # zeroed when it advanced; fresh slots start at zero)
+                    ST2 = jnp.where(eff, j, ST2)
+                    if j == L:
+                        cnt_after = CP2[cap_cnt_col(cap.cid)]
+                        emit = emit | (eff & (cnt_after >= st.min_count))
+                elif st.kind == "stream":
+                    CP2, CD2 = capture_current(CP2, CD2, eff, cap,
+                                               reset_counter=False)
+                    if j == L:
+                        emit = emit | eff
+                        kill = kill | eff
+                    else:
+                        ST2 = jnp.where(eff, j + 1, ST2)
+                        BT2 = jnp.where(eff, 0, BT2)
+                else:  # and / or
+                    CP2, CD2 = capture_current(CP2, CD2, eff, cap,
+                                               reset_counter=False)
+                    bt2 = BT | side.bit
+                    full = ((bt2 & st.full_bits) == st.full_bits) \
+                        if st.kind == "and" else jnp.ones((B, S), bool)
+                    done = eff & full
+                    if j == L:
+                        emit = emit | done
+                        kill = kill | done
+                    else:
+                        ST2 = jnp.where(done, j + 1, ST2)
+                    BT2 = jnp.where(eff & ~done, bt2,
+                                    jnp.where(done, 0, BT2))
+                    ST2 = jnp.where(eff & ~full, j, ST2)
+
+            if plan.sequence:
+                kill = kill | (m[:, None] & A & ~matched)
+            A2 = A2 & ~kill
+
+            emit = emit & m[:, None]
+            ov2 = {n: jnp.where(emit, CP2[n], out_caps[n][:, :S]) for n in cap_names}
+            new_out_valid = out_valid.at[:, :S].set(out_valid[:, :S] | emit)
+            out_cd = jnp.where(emit, CD2, out_caps["__capdone__"][:, :S])
+
+            # ---- fresh starts
+            every_ok = plan.every | ~CONS
+            fresh_any = jnp.zeros((B,), bool)
+            direct = jnp.zeros((B,), bool)
+            direct_op = jnp.full((B,), -1, jnp.int32)
+            fresh_reqs: List[Tuple[jnp.ndarray, int, int, SideSpec]] = []
+            for oi, (st, side) in enumerate(ops):
+                j = st.index
+                if not self._fresh_ok(j):
+                    continue
+                f = m & every_ok & conds[oi][:, 0]
+                if st.kind == "count":
+                    if j == L and 1 >= st.min_count:
+                        direct = direct | f
+                        direct_op = jnp.where(f & (direct_op < 0), oi, direct_op)
+                    if j < L or 1 < st.max_count:
+                        fresh_reqs.append((f, j, 0, side))       # park at j
+                elif st.kind == "stream":
+                    if j == L:
+                        direct = direct | f
+                        direct_op = jnp.where(f & (direct_op < 0), oi, direct_op)
+                    else:
+                        fresh_reqs.append((f, j + 1, 0, side))   # rest past j
+                else:  # logical
+                    full0 = st.kind == "or"
+                    if full0 and j == L:
+                        direct = direct | f
+                        direct_op = jnp.where(f & (direct_op < 0), oi, direct_op)
+                    elif full0:
+                        fresh_reqs.append((f, j + 1, 0, side))
+                    else:
+                        fresh_reqs.append((f, j, side.bit, side))
+                fresh_any = fresh_any | f
+
+            new_out_valid = new_out_valid.at[:, S].set(new_out_valid[:, S] | direct)
+
+            # ---- allocate fresh slots
+            NF = len(fresh_reqs)
+            if NF:
+                req = jnp.stack([fr[0] for fr in fresh_reqs], axis=1)  # [B,NF]
+                free = ~A2
+                n_free = jnp.sum(free, axis=1)
+                fs = jnp.argsort(
+                    jnp.where(free, jnp.arange(S)[None, :],
+                              S + jnp.arange(S)[None, :]), axis=1)
+                rank = jnp.cumsum(req.astype(jnp.int32), axis=1) - 1
+                can = req & (rank < n_free[:, None])
+                overflow = overflow + jnp.sum(req & ~can).astype(jnp.int32)
+                slot_of = jnp.where(
+                    can, jnp.take_along_axis(fs, jnp.clip(rank, 0, S - 1), axis=1), S)
+                bidx = jnp.arange(B)
+                for k, (f, step_val, bits_val, side) in enumerate(fresh_reqs):
+                    slot = slot_of[:, k]
+                    cap = side.capture
+                    onehot = jnp.zeros((B, S + 1), bool).at[bidx, slot].set(
+                        True)[:, :S]
+                    A2 = A2 | onehot
+                    ST2 = jnp.where(onehot, step_val, ST2)
+                    BT2 = jnp.where(onehot, bits_val, BT2)
+                    T0 = jnp.where(onehot, ts[:, None], T0)
+                    # zero the new slot's captures, then capture the event
+                    for n in cap_names:
+                        CP2[n] = jnp.where(onehot, jnp.zeros((), CP2[n].dtype),
+                                           CP2[n])
+                    CD2 = jnp.where(onehot, 0, CD2)
+                    CP2, CD2 = capture_current(CP2, CD2, onehot, cap,
+                                               reset_counter=False)
+
+            consumed2 = consumed.at[rows_pk].set(
+                jnp.where(m, CONS | fresh_any | direct, CONS), mode="drop")
+
+            # ---- direct-emission column (fresh match completing instantly)
+            ov3 = {}
+            for n in cap_names:
+                col_S = out_caps[n][:, S]
+                for oi, (st, side) in enumerate(ops):
+                    cap = side.capture
+                    dm = direct & (direct_op == oi)
+                    base = None
+                    if n == cap_col(cap.cid, TS_KEY):
+                        col_S = jnp.where(dm, ts, col_S)
+                    elif n == cap_cnt_col(cap.cid) if cap.is_count else False:
+                        col_S = jnp.where(dm, 1, col_S)
+                    elif n.startswith(f"c{cap.cid}__"):
+                        base = n[len(f"c{cap.cid}__"):]
+                    elif n.startswith(f"c{cap.cid}i0__"):
+                        base = n[len(f"c{cap.cid}i0__"):]
+                    if base is not None and base in cols:
+                        col_S = jnp.where(dm, cols[base], col_S)
+                ov3[n] = jnp.concatenate([ov2[n], col_S[:, None]], axis=1)
+            direct_cd = out_caps["__capdone__"][:, S]
+            for oi, (st, side) in enumerate(ops):
+                dm = direct & (direct_op == oi)
+                direct_cd = jnp.where(dm, jnp.int32(1 << side.capture.cid), direct_cd)
+            ov3["__capdone__"] = jnp.concatenate([out_cd, direct_cd[:, None]], axis=1)
+
+            # ---- scatter views back (rows in this round only)
+            def put(dst, view):
+                return dst.at[rows_pk].set(view, mode="drop")
+
+            return (r + 1, put(active, A2), put(stepi, ST2), put(bits, BT2),
+                    put(sts, T0), put(capdone, CD2), consumed2,
+                    {n: put(caps[n], CP2[n]) for n in cap_names},
+                    new_out_valid, ov3, overflow)
+
+        out_valid0 = jnp.zeros((B, S + 1), bool)
+        out_caps0 = {n: jnp.zeros((B, S + 1), dt) for n, dt in self.cap_cols.items()}
+        out_caps0["__capdone__"] = jnp.zeros((B, S + 1), jnp.int32)
+
+        carry0 = (jnp.int32(0), state["active"], state["stepi"], state["bits"],
+                  state["sts"], state["capdone"], state["consumed"],
+                  {n: state[n] for n in cap_names},
+                  out_valid0, out_caps0, state["nfa_overflow"])
+
+        res = lax.while_loop(lambda c: c[0] < n_rounds, round_body, carry0)
+        (_r, active2, stepi2, bits2, sts2, capdone2, consumed2, caps2,
+         out_valid, out_caps, overflow2) = res
+
+        new_state = dict(state)
+        new_state.update(active=active2, stepi=stepi2, bits=bits2, sts=sts2,
+                         capdone=capdone2, consumed=consumed2,
+                         nfa_overflow=overflow2)
+        for n in cap_names:
+            new_state[n] = caps2[n]
+
+        # ---- flatten [B, S+1] emissions row-major (event order, slot order)
+        N = B * (S + 1)
+        out: Dict[str, jnp.ndarray] = {}
+        capdone_flat = out_caps["__capdone__"].reshape(N)
+        for cap in self.plan.captures:
+            got = (capdone_flat & (1 << cap.cid)) != 0
+            cnt_flat = out_caps[cap_cnt_col(cap.cid)].reshape(N) if cap.is_count else None
+            for a in cap.definition.attributes:
+                n = cap_col(cap.cid, a.name)
+                out[n] = out_caps[n].reshape(N)
+                out[n + "?"] = out_caps[n + "?"].reshape(N) | ~got
+                for i in range(cap.n_idx):
+                    ni = cap_idx_col(cap.cid, i, a.name)
+                    out[ni] = out_caps[ni].reshape(N)
+                    out[ni + "?"] = (out_caps[ni + "?"].reshape(N) | ~got
+                                     | (cnt_flat <= i))
+            n = cap_col(cap.cid, TS_KEY)
+            out[n] = out_caps[n].reshape(N)
+            if cap.is_count:
+                out[cap_cnt_col(cap.cid)] = cnt_flat
+        out[VALID_KEY] = out_valid.reshape(N)
+        out[TS_KEY] = jnp.repeat(ts, S + 1)
+        out[TYPE_KEY] = jnp.zeros(N, jnp.int8)  # matches emit as CURRENT
+        out["__gk__"] = jnp.repeat(cols.get("__gk__", pk), S + 1)
+        if PK_KEY in cols:
+            out[PK_KEY] = jnp.repeat(cols[PK_KEY], S + 1)
+        out["__overflow__"] = (overflow2 > state["nfa_overflow"]).astype(jnp.int32)
+        return new_state, out

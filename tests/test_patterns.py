@@ -1,0 +1,262 @@
+"""Pattern/sequence (NFA) tests — expectations mirror the reference corpus:
+``query/pattern/{PatternTestCase,EveryPatternTestCase,CountPatternTestCase,
+LogicalPatternTestCase}.java`` and ``query/sequence/*``.
+"""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+STREAMS = """
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+def test_simple_pattern_non_every():
+    # PatternTestCase.testQuery1 style: e1 -> e2[price > e1.price], one match
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] -> e2=Stream2[price>e1.price]
+        select e1.symbol as s1, e2.symbol as s2, e1.price as p1, e2.price as p2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 55.5, 100])
+    s2.send(["IBM", 54.0, 100])     # not > 55.5
+    s2.send(["IBM", 57.5, 100])     # match
+    s1.send(["GOOG", 70.0, 100])    # non-every: no re-arm
+    s2.send(["MSFT", 80.0, 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("WSO2", "IBM", 55.5, 57.5)]
+
+
+def test_every_pattern_multiple_pending():
+    # EveryPatternTestCase: every A -> B matches once per pending A
+    m, rt, c = build(STREAMS + """
+        from every e1=Stream1[price>20] -> e2=Stream2[price>20]
+        select e1.price as p1, e2.price as p2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 25.0, 1])
+    s1.send(["B", 35.0, 1])
+    s2.send(["X", 45.0, 1])   # completes both pendings
+    s1.send(["C", 26.0, 1])
+    s2.send(["Y", 46.0, 1])   # completes only the new one
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [(25.0, 45.0), (26.0, 46.0), (35.0, 45.0)]
+
+
+def test_pattern_within_expiry():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from every e1=Stream1[price>20] -> e2=Stream2[price>20] within 100 milliseconds
+        select e1.price as p1, e2.price as p2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1000, ["A", 25.0, 1])
+    s2.send(1200, ["X", 45.0, 1])   # expired (200 > 100)
+    s1.send(1300, ["B", 26.0, 1])
+    s2.send(1350, ["Y", 46.0, 1])   # within
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(26.0, 46.0)]
+
+
+def test_count_pattern_accumulates_single_match():
+    # CountPatternTestCase.testQuery1: <2:5> accumulates into ONE match
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>20]
+        select e1[0].price as p0, e1[1].price as p1, e1[2].price as p2,
+               e1[3].price as p3, e2.price as pb
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 25.5, 100])
+    s1.send(["GOOG", 47.5, 100])
+    s1.send(["GOOG", 13.75, 100])    # fails filter, accumulation keeps going
+    s1.send(["GOOG", 47.75, 100])
+    s2.send(["IBM", 45.75, 100])     # one match with all 3 accumulated
+    s2.send(["IBM", 55.75, 100])     # consumed: no second match
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(25.5, 47.5, 47.75, None, 45.75)]
+
+
+def test_count_pattern_min_not_reached_keeps_accumulating():
+    # CountPatternTestCase.testQuery3: B before min is ignored (pattern)
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>20]
+        select e1[0].price as p0, e1[1].price as p1, e2.price as pb
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 25.5, 100])
+    s2.send(["IBM", 45.75, 100])     # count=1 < 2: no match, pending kept
+    s1.send(["GOOG", 47.75, 100])
+    s2.send(["IBM", 55.75, 100])     # now matches
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(25.5, 47.75, 55.75)]
+
+
+def test_count_pattern_min_zero_skippable():
+    # CountPatternTestCase.testQuery7: <0:5> -> B matches on B alone
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>20]
+        select e1[0].price as p0, e1[1].price as p1, e2.price as pb
+        insert into OutStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["IBM", 45.75, 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(None, None, 45.75)]
+
+
+def test_count_pattern_max_stops_absorbing():
+    # CountPatternTestCase.testQuery5: only first 5 events absorbed
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>20]
+        select e1[0].price as p0, e1[3].price as p3, e1[4].price as p4, e2.price as pb
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    for p in [25.5, 47.5, 23.75, 24.75, 25.75, 27.5]:
+        s1.send(["G", p, 100])
+    s2.send(["IBM", 45.75, 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(25.5, 24.75, 25.75, 45.75)]
+
+
+def test_count_filter_referencing_indexed():
+    # CountPatternTestCase.testQuery6: e2 filter uses e1[1].price
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>e1[1].price]
+        select e1[0].price as p0, e1[1].price as p1, e2.price as pb
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 25.5, 100])
+    s1.send(["GOOG", 47.5, 100])
+    s2.send(["IBM", 45.75, 100])     # 45.75 < 47.5: no
+    s2.send(["IBM", 55.75, 100])     # match
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(25.5, 47.5, 55.75)]
+
+
+def test_logical_and_pattern():
+    # LogicalPatternTestCase: e1=A and e2=B (either order) -> match
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] and e2=Stream2[price>20]
+        select e1.price as p1, e2.price as p2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["IBM", 45.0, 1])       # B first
+    s1.send(["WSO2", 25.0, 1])      # A completes
+    s1.send(["X", 30.0, 1])         # consumed: nothing more
+    s2.send(["Y", 50.0, 1])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(25.0, 45.0)]
+
+
+def test_logical_or_pattern():
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>100] or e2=Stream2[price>100]
+        select e1.price as p1, e2.price as p2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 50.0, 1])         # fails filter
+    s2.send(["B", 150.0, 1])        # or-side matches alone
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(None, 150.0)]
+
+
+def test_sequence_kills_non_contiguous():
+    # SequenceTestCase: e1, e2 requires immediate succession
+    m, rt, c = build("""
+        define stream S (symbol string, price float);
+        from every e1=S[price>20], e2=S[price>e1.price]
+        select e1.price as p1, e2.price as p2
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 30.0])
+    h.send(["B", 25.0])   # fails e2 (not > 30); kills the pending; starts own
+    h.send(["C", 40.0])   # completes (25, 40)
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(25.0, 40.0)]
+
+
+def test_pattern_chain_three_steps():
+    m, rt, c = build("""
+        define stream S (k string, v int);
+        from every e1=S[v==1] -> e2=S[v==2] -> e3=S[v==3]
+        select e1.k as k1, e2.k as k2, e3.k as k3
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    for k, v in [("a", 1), ("x", 5), ("b", 2), ("c", 3), ("d", 1), ("e", 2), ("f", 3)]:
+        h.send([k, v])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("a", "b", "c"), ("d", "e", "f")]
+
+
+def test_partitioned_pattern():
+    # the benchmark shape: every A -> B within, partitioned by key
+    m, rt, c = build("@app:playback " + """
+        define stream A (k string, v int);
+        define stream B (k string, v int);
+        partition with (k of A, k of B)
+        begin
+            from every e1=A -> e2=B[v > e1.v] within 5 sec
+            select e1.k as k, e1.v as v1, e2.v as v2
+            insert into OutStream;
+        end;
+    """)
+    ha = rt.get_input_handler("A")
+    hb = rt.get_input_handler("B")
+    ha.send(1000, ["k1", 10])
+    ha.send(1001, ["k2", 20])
+    hb.send(1002, ["k1", 15])       # k1 match
+    hb.send(1003, ["k2", 5])        # fails condition
+    hb.send(1004, ["k2", 25])       # k2 match
+    hb.send(9000, ["k1", 99])       # within expired for any k1 pending
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [("k1", 10, 15), ("k2", 20, 25)]
