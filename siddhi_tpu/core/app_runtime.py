@@ -160,12 +160,16 @@ class SiddhiAppRuntime:
         runtime.rate_limiter = create_rate_limiter(query.output_rate, runtime.send_to_callbacks)
         runtime.scheduler = self.app_context.scheduler
 
-        from siddhi_tpu.query_api.execution import StateInputStream
+        from siddhi_tpu.query_api.execution import JoinInputStream, StateInputStream
 
         if isinstance(query.input_stream, StateInputStream):
             # pattern/sequence: one proxy receiver per consumed stream
             for sid, proxy in runtime.make_proxies().items():
                 self.junctions[sid].subscribe(proxy)
+        elif isinstance(query.input_stream, JoinInputStream):
+            proxies = runtime.make_proxies()
+            self.junctions[query.input_stream.left.unique_stream_id].subscribe(proxies["left"])
+            self.junctions[query.input_stream.right.unique_stream_id].subscribe(proxies["right"])
         elif partition_ctx is not None and query.input_stream.is_inner_stream:
             input_stream_id = query.input_stream.unique_stream_id
             if input_stream_id not in partition_ctx.inner_junctions:

@@ -16,13 +16,123 @@ from siddhi_tpu.core.query.runtime import GroupKeyer, QueryRuntime
 from siddhi_tpu.ops.expressions import CompileError, compile_condition, compile_expr
 from siddhi_tpu.query_api.definitions import StreamDefinition
 from siddhi_tpu.query_api.execution import (
+    EventTrigger,
     Filter,
+    JoinInputStream,
+    JoinType,
     Query,
     SingleInputStream,
     StateInputStream,
     StreamFunction,
     Window,
 )
+
+
+def plan_join_query(
+    query: Query,
+    query_name: str,
+    app_context: SiddhiAppContext,
+    definitions: Dict[str, StreamDefinition],
+    partition_ctx=None,
+):
+    """Plan a two-stream window join (reference
+    ``JoinInputStreamParser.java:200-348`` + ``JoinProcessor.java``)."""
+    from siddhi_tpu.core.query.join_runtime import JoinQueryRuntime, JoinResolver, JoinSide
+    from siddhi_tpu.ops.windows import PassthroughWindowStage, create_window_stage
+
+    if partition_ctx is not None:
+        raise CompileError(
+            f"query '{query_name}': joins inside partitions are not supported yet"
+        )
+    join: JoinInputStream = query.input_stream
+    if join.within is not None or join.per is not None:
+        raise CompileError(
+            f"query '{query_name}': `within`/`per` join clauses apply to "
+            f"aggregation joins, which are not supported yet"
+        )
+    dictionary = app_context.string_dictionary
+
+    def build_side(key: str, s: SingleInputStream) -> JoinSide:
+        sid = s.unique_stream_id
+        if sid not in definitions:
+            raise CompileError(f"query '{query_name}': stream '{sid}' is not defined")
+        sdef = definitions[sid]
+        resolver = SingleStreamResolver(sdef, dictionary, ref_id=s.stream_reference_id)
+        filters = []
+        window_stage = None
+        for h in s.handlers:
+            if isinstance(h, Filter):
+                if window_stage is not None:
+                    raise CompileError("post-window filters on join sides are not supported")
+                filters.append(compile_condition(h.expression, resolver))
+            elif isinstance(h, Window):
+                if window_stage is not None:
+                    raise CompileError("only one #window per join side is allowed")
+                window_stage = create_window_stage(h, sdef, resolver, app_context)
+            else:
+                raise CompileError(f"stream function '{h.name}' on a join side is not supported")
+        if window_stage is None:
+            from siddhi_tpu.ops.windows import window_col_specs
+
+            window_stage = PassthroughWindowStage(window_col_specs(sdef))
+        triggers = (
+            join.trigger == EventTrigger.ALL
+            or (join.trigger == EventTrigger.LEFT and key == "left")
+            or (join.trigger == EventTrigger.RIGHT and key == "right")
+        )
+        outer = (
+            (join.type == JoinType.LEFT_OUTER_JOIN and key == "left")
+            or (join.type == JoinType.RIGHT_OUTER_JOIN and key == "right")
+            or join.type == JoinType.FULL_OUTER_JOIN
+        )
+        return JoinSide(
+            key=key,
+            stream_id=sdef.id,
+            ref_id=s.stream_reference_id,
+            definition=sdef,
+            window_stage=window_stage,
+            filters=filters,
+            triggers=triggers,
+            outer=outer,
+        )
+
+    left = build_side("left", join.left)
+    right = build_side("right", join.right)
+    resolver = JoinResolver(left, right, dictionary)
+
+    on_cond = None
+    if join.on_compare is not None:
+        on_cond = compile_condition(join.on_compare, resolver)
+
+    if query.selector.group_by_list:
+        raise CompileError(
+            f"query '{query_name}': group by on join queries is not supported yet"
+        )
+    if query.selector.select_all or not query.selector.selection_list:
+        raise CompileError(
+            f"query '{query_name}': join queries need an explicit select list"
+        )
+
+    output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
+    selector_plan = plan_selector(
+        selector=query.selector,
+        input_attrs=[],
+        resolver=resolver,
+        output_event_type=output_event_type,
+        batch_mode=False,
+        dictionary=dictionary,
+    )
+    selector_plan.num_keys = app_context.initial_key_capacity
+
+    return JoinQueryRuntime(
+        name=query_name,
+        app_context=app_context,
+        left=left,
+        right=right,
+        on_cond=on_cond,
+        selector_plan=selector_plan,
+        dictionary=dictionary,
+    )
 
 
 def plan_nfa_query(
@@ -125,10 +235,12 @@ def plan_query(
     input_stream = query.input_stream
     if isinstance(input_stream, StateInputStream):
         return plan_nfa_query(query, query_name, app_context, definitions, partition_ctx)
+    if isinstance(input_stream, JoinInputStream):
+        return plan_join_query(query, query_name, app_context, definitions, partition_ctx)
     if not isinstance(input_stream, SingleInputStream):
         raise CompileError(
-            f"query '{query_name}': join planning lands in M5 "
-            f"(got {type(input_stream).__name__})"
+            f"query '{query_name}': unsupported input stream "
+            f"{type(input_stream).__name__}"
         )
     stream_id = input_stream.unique_stream_id
     if stream_id not in definitions:

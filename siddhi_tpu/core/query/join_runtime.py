@@ -1,0 +1,246 @@
+"""Host driver for two-stream window joins.
+
+The counterpart of reference ``query/input/stream/join/JoinProcessor.java``
++ ``JoinInputStreamParser.java``: each side owns a window stage; an arriving
+chunk is inserted into its own window first (pre-join forwards, trigger
+false — ``JoinInputStreamParser.java:344``), then every row the window
+emits (CURRENT and EXPIRED) probes the other side's buffer with the
+compiled `on` condition (post-join trigger — ``:348``,
+``JoinProcessor.execute:107-170``) as one masked [N, W] broadcast compare.
+Outer sides emit a null-padded row when nothing matches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.event import Event, HostBatch
+from siddhi_tpu.core.plan.selector_plan import GK_KEY
+from siddhi_tpu.core.query.runtime import QueryRuntime
+from siddhi_tpu.core.stream.junction import Receiver
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY, ColumnRef, CompileError, Resolver
+from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
+from siddhi_tpu.query_api.expressions import Variable
+
+CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
+
+
+@dataclass
+class JoinSide:
+    key: str                     # 'left' | 'right'
+    stream_id: str
+    ref_id: Optional[str]
+    definition: StreamDefinition
+    window_stage: object
+    filters: List[Callable]
+    triggers: bool               # unidirectional: does this side emit?
+    outer: bool                  # emit null-padded row when no match
+
+    @property
+    def prefix(self) -> str:
+        return "l__" if self.key == "left" else "r__"
+
+
+class JoinResolver(Resolver):
+    """Resolve selector/on-condition variables to prefixed joined columns."""
+
+    def __init__(self, left: JoinSide, right: JoinSide, dictionary):
+        self.sides = [left, right]
+        self.dictionary = dictionary
+        self.synthetic: Dict[str, AttrType] = {}
+
+    def resolve(self, var: Variable) -> ColumnRef:
+        if var.attribute_name in self.synthetic and var.stream_id is None:
+            return ColumnRef(var.attribute_name, self.synthetic[var.attribute_name])
+        sid = var.stream_id
+        matches = []
+        for side in self.sides:
+            if sid is not None and sid not in (side.ref_id, side.stream_id):
+                continue
+            try:
+                attr = side.definition.attribute(var.attribute_name)
+            except Exception:
+                continue
+            matches.append((side, attr))
+        if not matches:
+            raise CompileError(
+                f"cannot resolve '{(sid + '.') if sid else ''}{var.attribute_name}' "
+                f"in join query"
+            )
+        if len(matches) > 1:
+            # self-joins: the raw stream id matches both sides too
+            raise CompileError(
+                f"'{(sid + '.') if sid else ''}{var.attribute_name}' is ambiguous "
+                f"between the join sides — qualify it with the `as` reference"
+            )
+        side, attr = matches[0]
+        return ColumnRef(side.prefix + attr.name, attr.type)
+
+    def encode_string(self, s: str) -> int:
+        return self.dictionary.encode(s)
+
+
+class JoinSideProxy(Receiver):
+    def __init__(self, runtime: "JoinQueryRuntime", side_key: str):
+        self.runtime = runtime
+        self.side_key = side_key
+
+    def receive(self, events: List[Event]):
+        side = self.runtime.sides[self.side_key]
+        batch = HostBatch.from_events(events, side.definition, self.runtime.dictionary)
+        self.runtime.process_side_batch(self.side_key, batch)
+
+
+class JoinQueryRuntime(QueryRuntime):
+    def __init__(self, name, app_context, left: JoinSide, right: JoinSide,
+                 on_cond: Optional[Callable], selector_plan, dictionary):
+        super().__init__(
+            name=name,
+            app_context=app_context,
+            input_definition=None,
+            filters=[],
+            window_stage=None,
+            selector_plan=selector_plan,
+            keyer=None,
+            dictionary=dictionary,
+        )
+        self.sides = {"left": left, "right": right}
+        self.on_cond = on_cond
+        self._steps: Dict[str, object] = {}
+        # stable per-side timer callbacks so the scheduler's
+        # (id(target), ts) dedup holds across batches
+        self._timer_cbs = {
+            k: (lambda ts, sk=k: self._timer(sk, ts)) for k in ("left", "right")
+        }
+
+    def make_proxies(self) -> Dict[str, JoinSideProxy]:
+        return {k: JoinSideProxy(self, k) for k in ("left", "right")}
+
+    def _init_state(self) -> dict:
+        return {
+            "sel": self.selector_plan.init_state(),
+            "lwin": self.sides["left"].window_stage.init_state(),
+            "rwin": self.sides["right"].window_stage.init_state(),
+        }
+
+    def build_side_step_fn(self, side_key: str):
+        side = self.sides[side_key]
+        other = self.sides["right" if side_key == "left" else "left"]
+        win_key = "lwin" if side_key == "left" else "rwin"
+        other_key = "rwin" if side_key == "left" else "lwin"
+        sel = self.selector_plan
+        on_cond = self.on_cond
+        filters = side.filters
+
+        def step(state, cols, current_time):
+            ctx = {"xp": jnp, "current_time": current_time}
+            cols = dict(cols)
+            valid = cols[VALID_KEY]
+            timer = cols[TYPE_KEY] == TIMER
+            for f in filters:
+                valid = valid & (f(cols, ctx) | timer)
+            cols[VALID_KEY] = valid
+            new_state = dict(state)
+            new_win, wout = side.window_stage.apply(state[win_key], cols, ctx)
+            new_state[win_key] = new_win
+            wout = dict(wout)
+            notify = wout.pop("__notify__", None)
+            overflow = wout.pop("__overflow__", None)
+            wout.pop("__flush__", None)
+
+            N = wout[VALID_KEY].shape[0]
+            probe_cols, probe_valid = other.window_stage.contents(state[other_key])
+            W = probe_valid.shape[0]
+
+            # joined eval dict: this side [N,1], other side [1,W]
+            ev: Dict[str, jnp.ndarray] = {}
+            for a in side.definition.attributes:
+                ev[side.prefix + a.name] = wout[a.name][:, None]
+                ev[side.prefix + a.name + "?"] = wout[a.name + "?"][:, None]
+            for a in other.definition.attributes:
+                ev[other.prefix + a.name] = probe_cols[a.name][None, :]
+                ev[other.prefix + a.name + "?"] = probe_cols[a.name + "?"][None, :]
+            ev[TS_KEY] = wout[TS_KEY][:, None]
+
+            row_live = wout[VALID_KEY] & ((wout[TYPE_KEY] == CURRENT) | (wout[TYPE_KEY] == EXPIRED))
+            if side.triggers:
+                cond = on_cond(ev, ctx) if on_cond is not None else jnp.ones((N, W), bool)
+                cond = jnp.broadcast_to(cond, (N, W))
+                match = row_live[:, None] & probe_valid[None, :] & cond
+            else:
+                match = jnp.zeros((N, W), bool)
+
+            # column W carries the one-sided row: outer no-match + RESET
+            no_match = row_live & ~jnp.any(match, axis=1) & side.outer & side.triggers
+            one_sided = no_match | (wout[VALID_KEY] & (wout[TYPE_KEY] == RESET))
+
+            NW = N * (W + 1)
+            joined: Dict[str, jnp.ndarray] = {}
+            for a in side.definition.attributes:
+                v = jnp.broadcast_to(wout[a.name][:, None], (N, W + 1))
+                mk = jnp.broadcast_to(wout[a.name + "?"][:, None], (N, W + 1))
+                joined[side.prefix + a.name] = v.reshape(NW)
+                joined[side.prefix + a.name + "?"] = mk.reshape(NW)
+            for a in other.definition.attributes:
+                v = jnp.concatenate(
+                    [jnp.broadcast_to(probe_cols[a.name][None, :], (N, W)),
+                     jnp.zeros((N, 1), probe_cols[a.name].dtype)], axis=1)
+                mk = jnp.concatenate(
+                    [jnp.broadcast_to(probe_cols[a.name + "?"][None, :], (N, W)),
+                     jnp.ones((N, 1), bool)], axis=1)
+                joined[other.prefix + a.name] = v.reshape(NW)
+                joined[other.prefix + a.name + "?"] = mk.reshape(NW)
+            joined[VALID_KEY] = jnp.concatenate(
+                [match, one_sided[:, None]], axis=1).reshape(NW)
+            joined[TS_KEY] = jnp.repeat(wout[TS_KEY], W + 1)
+            joined[TYPE_KEY] = jnp.repeat(wout[TYPE_KEY], W + 1)
+            joined[GK_KEY] = jnp.zeros(NW, jnp.int32)
+
+            new_state["sel"], out = sel.apply(state["sel"], joined, ctx)
+            if notify is not None:
+                out["__notify__"] = notify
+            if overflow is not None:
+                out["__overflow__"] = overflow
+            return new_state, out
+
+        return step
+
+    def build_step_fn(self):
+        return self.build_side_step_fn("left")
+
+    def process_side_batch(self, side_key: str, batch: HostBatch):
+        with self._lock:
+            batch.cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
+            if self._state is None:
+                self._state = self._init_state()
+            step = self._steps.get(side_key)
+            if step is None:
+                step = jax.jit(self.build_side_step_fn(side_key), donate_argnums=0)
+                self._steps[side_key] = step
+            notify = self._finish_device_batch(
+                step, batch.cols,
+                "join window capacity exceeded — raise app_context.window_capacity")
+        if notify is not None and self.scheduler is not None:
+            self.scheduler.notify_at(notify, self._timer_cbs[side_key])
+
+    def _timer(self, side_key: str, ts: int):
+        side = self.sides[side_key]
+        from siddhi_tpu.core.event import TIMER as TIMER_TYPE
+        from siddhi_tpu.core.query.runtime import _zero_value
+
+        batch = HostBatch.from_events(
+            [Event(timestamp=int(ts),
+                   data=[_zero_value(a.type) for a in side.definition.attributes])],
+            side.definition,
+            self.dictionary,
+        )
+        batch.cols[TYPE_KEY][...] = TIMER_TYPE
+        self.process_side_batch(side_key, batch)
+
+    def receive(self, events: List[Event]):  # pragma: no cover — proxies only
+        raise RuntimeError("join queries receive through per-side proxies")

@@ -135,16 +135,9 @@ class NFAQueryRuntime(QueryRuntime):
             if step is None:
                 step = jax.jit(self.build_stream_step_fn(stream_id), donate_argnums=0)
                 self._steps[stream_id] = step
-            now = np.int64(self.app_context.timestamp_generator.current_time())
-            self._state, out = step(self._state, cols, now)
-            out_host = {k: np.asarray(v) for k, v in out.items()}
-            overflow = out_host.pop("__overflow__", None)
-            if overflow is not None and int(overflow) > 0:
-                raise RuntimeError(
-                    f"query '{self.name}': pattern match-slot capacity exceeded — "
-                    f"raise app_context.nfa_slots before creating the runtime"
-                )
-            self._emit(HostBatch(out_host))
+            self._finish_device_batch(
+                step, cols,
+                "pattern match-slot capacity exceeded — raise app_context.nfa_slots")
 
     def receive(self, events: List[Event]):  # pragma: no cover — proxies only
         raise RuntimeError("NFA queries receive through per-stream proxies")

@@ -248,24 +248,33 @@ class QueryRuntime(Receiver):
                 self._state = self._init_state()
             if self._step is None:
                 self._step = self._make_step()
-            now = np.int64(self.app_context.timestamp_generator.current_time())
-            self._state, out = self._step(self._state, cols, now)
-            out_host = {k: np.asarray(v) for k, v in out.items()}
-            overflow = out_host.pop("__overflow__", None)
-            if overflow is not None and int(overflow) > 0:
-                knob = (
-                    "app_context.partition_window_capacity"
-                    if self.partition_ctx is not None
-                    else "app_context.window_capacity"
-                )
-                raise RuntimeError(
-                    f"query '{self.name}': window buffer capacity exceeded — "
-                    f"raise {knob} before creating the runtime"
-                )
-            notify = out_host.pop("__notify__", None)
-            self._emit(HostBatch(out_host))
-        if notify is not None and int(notify) >= 0 and self.scheduler is not None:
-            self.scheduler.notify_at(int(notify), self.process_timer)
+            knob = (
+                "app_context.partition_window_capacity"
+                if self.partition_ctx is not None
+                else "app_context.window_capacity"
+            )
+            notify = self._finish_device_batch(
+                self._step, cols, f"window buffer capacity exceeded — raise {knob}")
+        if notify is not None and self.scheduler is not None:
+            self.scheduler.notify_at(notify, self.process_timer)
+
+    def _finish_device_batch(self, step, cols, overflow_msg: str) -> Optional[int]:
+        """Run the jitted step, raise on overflow, emit outputs; returns the
+        wanted timer wake time (or None). Shared tail of every query
+        runtime's batch processing (single-stream, NFA, join)."""
+        now = np.int64(self.app_context.timestamp_generator.current_time())
+        self._state, out = step(self._state, cols, now)
+        out_host = {k: np.asarray(v) for k, v in out.items()}
+        overflow = out_host.pop("__overflow__", None)
+        if overflow is not None and int(overflow) > 0:
+            raise RuntimeError(
+                f"query '{self.name}': {overflow_msg} before creating the runtime"
+            )
+        notify = out_host.pop("__notify__", None)
+        self._emit(HostBatch(out_host))
+        if notify is not None and int(notify) >= 0:
+            return int(notify)
+        return None
 
     def _emit(self, out: HostBatch):
         if out.size == 0:

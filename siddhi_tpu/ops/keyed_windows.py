@@ -220,17 +220,10 @@ class KeyedTimeWindowStage(WindowStage):
 def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:
     """Keyed (partitioned) window factory. Capacity per key comes from
     ``app_context.partition_window_capacity``."""
-    from siddhi_tpu.ops.types import dtype_of
-    from siddhi_tpu.ops.windows import _const_param
+    from siddhi_tpu.ops.windows import _const_param, window_col_specs
 
     name = window.name.lower()
-    col_specs: Dict[str, np.dtype] = {}
-    for a in input_def.attributes:
-        col_specs[a.name] = dtype_of(a.type)
-        col_specs[a.name + "?"] = np.bool_
-    col_specs[TS_KEY] = np.int64
-    col_specs["__gk__"] = np.int32
-    col_specs[PK_KEY] = np.int32
+    col_specs = window_col_specs(input_def, extra=(PK_KEY,))
 
     capacity = getattr(app_context, "partition_window_capacity", 256)
 

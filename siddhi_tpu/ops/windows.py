@@ -106,6 +106,36 @@ class WindowStage:
     def apply(self, state: dict, cols: Dict, ctx: Dict):
         raise NotImplementedError
 
+    def contents(self, state: dict):
+        """(cols [W], valid [W]) view of the currently-held events — the
+        probe surface for joins (the role of FindableProcessor.find on
+        window processors, reference ``JoinProcessor.java:134-147``)."""
+        raise CompileError(
+            f"{type(self).__name__} cannot be probed (used as a join side)"
+        )
+
+
+class PassthroughWindowStage(WindowStage):
+    """A bare (window-less) join side: events flow through and probe the
+    other window, but nothing is retained — the reference's
+    ``EmptyWindowProcessor`` behavior."""
+
+    def __init__(self, col_specs: Dict[str, np.dtype]):
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        return {"empty": jnp.zeros((1,), jnp.int32)}
+
+    def apply(self, state, cols, ctx):
+        out = {k: cols[k] for k in _data_keys(cols)}
+        out[TYPE_KEY] = cols[TYPE_KEY]
+        out[VALID_KEY] = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        return state, out
+
+    def contents(self, state):
+        cols = {k: jnp.zeros((1,), dt) for k, dt in self.col_specs.items()}
+        return cols, jnp.zeros((1,), bool)
+
 
 def _const_param(window: Window, i: int, name: str):
     if i >= len(window.parameters):
@@ -174,6 +204,10 @@ class LengthWindowStage(WindowStage):
         ]
         out, _ = _order_emit(parts)
         return {"buf": new_buf, "total": total0 + n_ins}, out
+
+    def contents(self, state):
+        valid = jnp.arange(self.length, dtype=jnp.int64) < state["total"]
+        return dict(state["buf"]), valid
 
 
 # -------------------------------------------------------------------- time
@@ -282,6 +316,15 @@ class TimeWindowStage(WindowStage):
             out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt_notify, jnp.int64(-1))
 
         return {"buf": new_buf, "total": new_total, "expired_upto": new_exp}, out
+
+    def contents(self, state):
+        Wc = self.capacity
+        total = state["total"]
+        # slot j holds the newest sequence s < total with s % Wc == j
+        j = jnp.arange(Wc, dtype=jnp.int64)
+        s_j = total - 1 - ((total - 1 - j) % Wc)
+        valid = (total > 0) & (s_j >= 0) & (s_j >= state["expired_upto"])
+        return dict(state["buf"]), valid
 
 
 def _next_valid_index(valid):
@@ -411,6 +454,10 @@ class LengthBatchWindowStage(WindowStage):
         return {"cur": new_cur, "prev": new_prev,
                 "count": new_count, "prev_count": new_prev_count}, out
 
+    def contents(self, state):
+        valid = jnp.arange(self.length, dtype=jnp.int64) < state["count"]
+        return dict(state["cur"]), valid
+
 
 # --------------------------------------------------------------- timeBatch
 
@@ -489,6 +536,10 @@ class TimeBatchWindowStage(WindowStage):
         out[OVERFLOW_KEY] = (count > Wc).astype(jnp.int32)
         return new_state, out
 
+    def contents(self, state):
+        valid = jnp.arange(self.capacity, dtype=jnp.int64) < state["count"]
+        return dict(state["cur"]), valid
+
 
 # ------------------------------------------------------------------- batch
 
@@ -543,22 +594,35 @@ class BatchWindowStage(WindowStage):
         out[OVERFLOW_KEY] = (n_ins > Wc).astype(jnp.int32)
         return {"prev": new_prev, "prev_count": new_count}, out
 
+    def contents(self, state):
+        valid = jnp.arange(self.capacity, dtype=jnp.int64) < state["prev_count"]
+        return dict(state["prev"]), valid
+
 
 # ----------------------------------------------------------------- factory
 
-def create_window_stage(window: Window, input_def, resolver, app_context) -> WindowStage:
-    """Build a window stage from a ``#window.<name>(params)`` handler — the
-    factory role of reference ``SingleInputStreamParser.generateProcessor``
-    plus each window's ``init`` validation."""
+def window_col_specs(input_def, extra: Tuple[str, ...] = ()) -> Dict[str, np.dtype]:
+    """Column dtypes a window ring buffer must carry for a stream: every
+    attribute + its null mask, the timestamp, and reserved id columns."""
     from siddhi_tpu.ops.types import dtype_of
 
-    name = window.name.lower()
     col_specs: Dict[str, np.dtype] = {}
     for a in input_def.attributes:
         col_specs[a.name] = dtype_of(a.type)
         col_specs[a.name + "?"] = np.bool_
     col_specs[TS_KEY] = np.int64
     col_specs["__gk__"] = np.int32
+    for name in extra:
+        col_specs[name] = np.int32
+    return col_specs
+
+
+def create_window_stage(window: Window, input_def, resolver, app_context) -> WindowStage:
+    """Build a window stage from a ``#window.<name>(params)`` handler — the
+    factory role of reference ``SingleInputStreamParser.generateProcessor``
+    plus each window's ``init`` validation."""
+    name = window.name.lower()
+    col_specs = window_col_specs(input_def)
 
     capacity = getattr(app_context, "window_capacity", 4096)
 

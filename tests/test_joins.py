@@ -1,0 +1,131 @@
+"""Join tests — expectations mirror the reference ``query/join/*`` corpus
+(JoinTestCase: window joins, outer joins, unidirectional)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+STREAMS = """
+    define stream StockStream (symbol string, price float);
+    define stream TwitterStream (user string, company string);
+"""
+
+
+def test_length_window_join():
+    # JoinTestCase style: both sides keep windows; each event probes the other
+    m, rt, c = build(STREAMS + """
+        from StockStream#window.length(10) join TwitterStream#window.length(10)
+        on StockStream.symbol == TwitterStream.company
+        select StockStream.symbol as symbol, TwitterStream.user as user, price
+        insert into OutStream;
+    """)
+    hs = rt.get_input_handler("StockStream")
+    ht = rt.get_input_handler("TwitterStream")
+    hs.send(["IBM", 100.0])
+    ht.send(["alice", "IBM"])       # joins with buffered IBM
+    ht.send(["bob", "GOOG"])        # no match
+    hs.send(["GOOG", 200.0])        # joins with buffered bob tweet
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("IBM", "alice", 100.0), ("GOOG", "bob", 200.0)]
+
+
+def test_join_multiple_matches():
+    m, rt, c = build(STREAMS + """
+        from StockStream#window.length(10) join TwitterStream#window.length(10)
+        on StockStream.symbol == TwitterStream.company
+        select TwitterStream.user as user, price
+        insert into OutStream;
+    """)
+    hs = rt.get_input_handler("StockStream")
+    ht = rt.get_input_handler("TwitterStream")
+    ht.send(["alice", "IBM"])
+    ht.send(["bob", "IBM"])
+    hs.send(["IBM", 100.0])          # matches both tweets
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [("alice", 100.0), ("bob", 100.0)]
+
+
+def test_left_outer_join():
+    m, rt, c = build(STREAMS + """
+        from StockStream#window.length(10) left outer join TwitterStream#window.length(10)
+        on StockStream.symbol == TwitterStream.company
+        select symbol, user, price
+        insert into OutStream;
+    """)
+    hs = rt.get_input_handler("StockStream")
+    ht = rt.get_input_handler("TwitterStream")
+    hs.send(["IBM", 100.0])          # no tweets yet -> (IBM, null)
+    ht.send(["alice", "IBM"])        # right event joins buffered stock
+    hs.send(["IBM", 110.0])          # now matches alice
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("IBM", None, 100.0), ("IBM", "alice", 100.0), ("IBM", "alice", 110.0)]
+
+
+def test_unidirectional_join():
+    # only the left side triggers output; right events just fill the window
+    m, rt, c = build(STREAMS + """
+        from StockStream#window.length(10) unidirectional join TwitterStream#window.length(10)
+        on StockStream.symbol == TwitterStream.company
+        select symbol, user
+        insert into OutStream;
+    """)
+    hs = rt.get_input_handler("StockStream")
+    ht = rt.get_input_handler("TwitterStream")
+    ht.send(["alice", "IBM"])        # right: no output
+    hs.send(["IBM", 100.0])          # left triggers
+    ht.send(["bob", "IBM"])          # right: silent again
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("IBM", "alice")]
+
+
+def test_self_join_with_refs():
+    m, rt, c = build("""
+        define stream S (k string, v int);
+        from S#window.length(5) as a join S#window.length(5) as b
+        on a.v < b.v
+        select a.v as v1, b.v as v2
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["x", 1])
+    h.send(["y", 5])    # a=5 probes b window {1,5}: 5<nothing... a side: v=5 vs {1}: no (5<1 F); b side: buffered a {1}: 1<5 -> (1,5)
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [(1, 5)]
+
+
+def test_time_window_join_playback():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from StockStream#window.time(10 sec) join TwitterStream#window.length(100)
+        on StockStream.symbol == TwitterStream.company
+        select symbol, user, price
+        insert into OutStream;
+    """)
+    hs = rt.get_input_handler("StockStream")
+    ht = rt.get_input_handler("TwitterStream")
+    hs.send(1000, ["IBM", 100.0])
+    ht.send(2000, ["alice", "IBM"])          # within 10s: match
+    ht.send(20000, ["bob", "IBM"])           # stock expired from time window
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("IBM", "alice", 100.0)]
