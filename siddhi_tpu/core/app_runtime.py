@@ -26,7 +26,7 @@ from siddhi_tpu.core.stream.junction import StreamJunction
 from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
 from siddhi_tpu.core.util.scheduler import Scheduler
 from siddhi_tpu.query_api.annotations import find_annotation
-from siddhi_tpu.query_api.definitions import Attribute, StreamDefinition
+from siddhi_tpu.query_api.definitions import Attribute, AttrType, StreamDefinition
 from siddhi_tpu.query_api.execution import InsertIntoStream, Partition, Query
 from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
@@ -54,7 +54,39 @@ class SiddhiAppRuntime:
         for sid, sdef in self.stream_definitions.items():
             self._create_junction(sdef)
 
+        # tables, named windows, triggers (reference
+        # SiddhiAppRuntimeBuilder.defineTable/defineWindow/defineTrigger)
+        from siddhi_tpu.core.table import InMemoryTable
+        from siddhi_tpu.core.trigger import TriggerRuntime
+        from siddhi_tpu.core.window import NamedWindowRuntime
+
+        dictionary = self.app_context.string_dictionary
+        self.tables: Dict[str, InMemoryTable] = {
+            tid: InMemoryTable(tdef, dictionary)
+            for tid, tdef in siddhi_app.table_definitions.items()
+        }
+        self.named_windows: Dict[str, NamedWindowRuntime] = {}
+        for wid, wdef in siddhi_app.window_definitions.items():
+            w = NamedWindowRuntime(wdef, self.app_context, dictionary)
+            w.scheduler = self.app_context.scheduler
+            self.named_windows[wid] = w
+        self.app_context.tables = self.tables
+        self.app_context.named_windows = self.named_windows
+
+        self.trigger_runtimes: List[TriggerRuntime] = []
+        for tid, tdef in siddhi_app.trigger_definitions.items():
+            sdef = StreamDefinition(
+                id=tid, attributes=[Attribute("triggered_time", AttrType.LONG)])
+            self.stream_definitions[tid] = sdef
+            junction = self._create_junction(sdef)
+            self.trigger_runtimes.append(
+                TriggerRuntime(tdef, junction, self.app_context,
+                               barrier=self._barrier))
+
         self.input_manager = InputManager(self.app_context, self.junctions, self._barrier)
+        # first send() starts the app lazily, AFTER callbacks are attached —
+        # at-start triggers then fire with subscribers in place
+        self.input_manager.ensure_started = self.start
 
         q_index = 0
         p_index = 0
@@ -122,13 +154,58 @@ class SiddhiAppRuntime:
     def _add_query(self, query: Query, index: int, partition_ctx=None):
         query_name = query.name or f"query_{index}"
         definitions = dict(self.stream_definitions)
+        for wid, w in self.named_windows.items():
+            definitions[wid] = w.definition
+        for tid, t in self.tables.items():
+            definitions[tid] = t.definition
         if partition_ctx is not None:
             definitions.update(partition_ctx.inner_definitions)
+
+        from siddhi_tpu.query_api.execution import SingleInputStream
+
+        if (
+            isinstance(query.input_stream, SingleInputStream)
+            and query.input_stream.unique_stream_id in self.tables
+        ):
+            raise SiddhiAppValidationException(
+                f"'{query.input_stream.stream_id}' is a table — consume it via a "
+                f"join or an on-demand query (runtime.query(...))"
+            )
         runtime = plan_query(query, query_name, self.app_context, definitions,
                              partition_ctx=partition_ctx)
 
+        from siddhi_tpu.core.query.output_callbacks import create_table_callback
+        from siddhi_tpu.query_api.execution import (
+            DeleteStream,
+            UpdateOrInsertStream,
+            UpdateStream,
+        )
+
         out = query.output_stream
-        if isinstance(out, InsertIntoStream):
+        if isinstance(out, (DeleteStream, UpdateStream, UpdateOrInsertStream)):
+            if out.target_id not in self.tables:
+                raise SiddhiAppValidationException(
+                    f"'{out.target_id}' is not a defined table"
+                )
+            runtime.output_action = create_table_callback(
+                out, self.tables[out.target_id], query_name, runtime.output_attrs,
+                self.app_context.string_dictionary)
+        elif isinstance(out, InsertIntoStream) and out.target_id in self.tables \
+                and not out.is_inner_stream:
+            runtime.output_action = create_table_callback(
+                out, self.tables[out.target_id], query_name, runtime.output_attrs,
+                self.app_context.string_dictionary)
+        elif isinstance(out, InsertIntoStream) and out.target_id in self.named_windows \
+                and not out.is_inner_stream:
+            w = self.named_windows[out.target_id]
+            if len(runtime.output_attrs) != len(w.definition.attributes):
+                raise SiddhiAppValidationException(
+                    f"insert into window '{out.target_id}': query outputs "
+                    f"{len(runtime.output_attrs)} attributes, window has "
+                    f"{len(w.definition.attributes)}"
+                )
+            runtime.output_junction = w
+        elif isinstance(out, InsertIntoStream):
             target = out.target_id
             if partition_ctx is not None and out.is_inner_stream:
                 # '#stream' scoped to this partition; events carry pk ids
@@ -155,7 +232,8 @@ class SiddhiAppRuntime:
                     self._create_junction(sdef)
                 runtime.output_junction = self.junctions[target]
         elif out is not None:
-            raise SiddhiAppValidationException("table outputs (delete/update) land in M3")
+            raise SiddhiAppValidationException(
+                f"unsupported output action {type(out).__name__}")
 
         runtime.rate_limiter = create_rate_limiter(query.output_rate, runtime.send_to_callbacks)
         runtime.scheduler = self.app_context.scheduler
@@ -167,9 +245,13 @@ class SiddhiAppRuntime:
             for sid, proxy in runtime.make_proxies().items():
                 self.junctions[sid].subscribe(proxy)
         elif isinstance(query.input_stream, JoinInputStream):
+            # store (table/window) sides have no proxy; named-window stream
+            # sides would need emission-driven triggering (not supported)
             proxies = runtime.make_proxies()
-            self.junctions[query.input_stream.left.unique_stream_id].subscribe(proxies["left"])
-            self.junctions[query.input_stream.right.unique_stream_id].subscribe(proxies["right"])
+            for side_key, s in (("left", query.input_stream.left),
+                                ("right", query.input_stream.right)):
+                if side_key in proxies:
+                    self.junctions[s.unique_stream_id].subscribe(proxies[side_key])
         elif partition_ctx is not None and query.input_stream.is_inner_stream:
             input_stream_id = query.input_stream.unique_stream_id
             if input_stream_id not in partition_ctx.inner_junctions:
@@ -178,6 +260,9 @@ class SiddhiAppRuntime:
                     f"in this partition produces it"
                 )
             partition_ctx.inner_junctions[input_stream_id].subscribe(runtime)
+        elif query.input_stream.unique_stream_id in self.named_windows:
+            # `from W`: consume the named window's emissions
+            self.named_windows[query.input_stream.unique_stream_id].out_junction.subscribe(runtime)
         else:
             self.junctions[query.input_stream.unique_stream_id].subscribe(runtime)
         self.query_runtimes[query_name] = runtime
@@ -219,8 +304,12 @@ class SiddhiAppRuntime:
         for qr in self.query_runtimes.values():
             if qr.rate_limiter is not None:
                 qr.rate_limiter.start(scheduler)
+        for tr in self.trigger_runtimes:
+            tr.start()
 
     def shutdown(self):
+        for tr in self.trigger_runtimes:
+            tr.stop()
         for qr in self.query_runtimes.values():
             if qr.rate_limiter is not None:
                 qr.rate_limiter.stop()
@@ -229,6 +318,17 @@ class SiddhiAppRuntime:
         if self.app_context.scheduler is not None:
             self.app_context.scheduler.shutdown()
         self._started = False
+
+    # ------------------------------------------------------ on-demand API
+
+    def query(self, on_demand_query: str) -> List[Event]:
+        """Run an ad-hoc (store) query against a table or named window —
+        reference ``SiddhiAppRuntimeImpl.query`` +
+        ``util/parser/OnDemandQueryParser.java``."""
+        from siddhi_tpu.core.query.on_demand import run_on_demand_query
+
+        with self._barrier:
+            return run_on_demand_query(on_demand_query, self)
 
     @property
     def query_names(self) -> List[str]:

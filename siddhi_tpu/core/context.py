@@ -73,6 +73,9 @@ class SiddhiAppContext:
         self.partition_window_capacity = 256
         # pending-match slot capacity per key for pattern/sequence queries
         self.nfa_slots = 32
+        # shared stores, filled by SiddhiAppRuntime during assembly
+        self.tables = {}
+        self.named_windows = {}
 
 
 @dataclass

@@ -124,6 +124,8 @@ class HostBatch:
         cols[VALID_KEY][:n] = True
         for i, ev in enumerate(events):
             cols[TS_KEY][i] = ev.timestamp
+            if ev.is_expired:
+                cols[TYPE_KEY][i] = EXPIRED
         for pos, attr in enumerate(definition.attributes):
             dtype = dtype_of(attr.type)
             arr = np.zeros(b, dtype)

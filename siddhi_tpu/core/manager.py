@@ -22,9 +22,10 @@ class SiddhiManager:
     def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
         if isinstance(app, str):
             app = SiddhiCompiler.parse(SiddhiCompiler.update_variables(app))
+        # Not auto-started: callers attach callbacks first, then start()
+        # (reference flow); InputManager starts lazily on first handler use.
         runtime = SiddhiAppRuntime(app, self.siddhi_context)
         self.app_runtimes[runtime.name] = runtime
-        runtime.start()
         return runtime
 
     createSiddhiAppRuntime = create_siddhi_app_runtime

@@ -54,6 +54,23 @@ def plan_join_query(
 
     def build_side(key: str, s: SingleInputStream) -> JoinSide:
         sid = s.unique_stream_id
+        tables = getattr(app_context, "tables", {})
+        named_windows = getattr(app_context, "named_windows", {})
+        if sid in tables or sid in named_windows:
+            # probe-only shared store (reference TableWindowProcessor /
+            # WindowWindowProcessor as the findable join side)
+            store = tables.get(sid) or named_windows[sid]
+            sdef = store.definition
+            if s.handlers:
+                raise CompileError(
+                    f"query '{query_name}': handlers on the {sid} store join "
+                    f"side are not supported"
+                )
+            return JoinSide(
+                key=key, stream_id=sid, ref_id=s.stream_reference_id,
+                definition=sdef, window_stage=None, filters=[],
+                triggers=False, outer=False, store=store,
+            )
         if sid not in definitions:
             raise CompileError(f"query '{query_name}': stream '{sid}' is not defined")
         sdef = definitions[sid]
@@ -98,6 +115,11 @@ def plan_join_query(
 
     left = build_side("left", join.left)
     right = build_side("right", join.right)
+    if left.store is not None and right.store is not None:
+        raise CompileError(
+            f"query '{query_name}': at least one join side must be a stream "
+            f"(both '{left.stream_id}' and '{right.stream_id}' are stores)"
+        )
     resolver = JoinResolver(left, right, dictionary)
 
     on_cond = None

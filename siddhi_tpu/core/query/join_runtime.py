@@ -36,10 +36,13 @@ class JoinSide:
     stream_id: str
     ref_id: Optional[str]
     definition: StreamDefinition
-    window_stage: object
+    window_stage: object         # None for shared-store (table/window) sides
     filters: List[Callable]
     triggers: bool               # unidirectional: does this side emit?
     outer: bool                  # emit null-padded row when no match
+    # shared probe-only store (InMemoryTable / NamedWindowRuntime): its
+    # contents() is fetched per batch and passed as a non-donated jit arg
+    store: object = None
 
     @property
     def prefix(self) -> str:
@@ -119,14 +122,20 @@ class JoinQueryRuntime(QueryRuntime):
         }
 
     def make_proxies(self) -> Dict[str, JoinSideProxy]:
-        return {k: JoinSideProxy(self, k) for k in ("left", "right")}
+        # store sides (tables/windows) produce no events — no proxy
+        return {
+            k: JoinSideProxy(self, k)
+            for k in ("left", "right")
+            if self.sides[k].store is None
+        }
 
     def _init_state(self) -> dict:
-        return {
-            "sel": self.selector_plan.init_state(),
-            "lwin": self.sides["left"].window_stage.init_state(),
-            "rwin": self.sides["right"].window_stage.init_state(),
-        }
+        state = {"sel": self.selector_plan.init_state()}
+        if self.sides["left"].store is None:
+            state["lwin"] = self.sides["left"].window_stage.init_state()
+        if self.sides["right"].store is None:
+            state["rwin"] = self.sides["right"].window_stage.init_state()
+        return state
 
     def build_side_step_fn(self, side_key: str):
         side = self.sides[side_key]
@@ -137,7 +146,9 @@ class JoinQueryRuntime(QueryRuntime):
         on_cond = self.on_cond
         filters = side.filters
 
-        def step(state, cols, current_time):
+        other_is_store = other.store is not None
+
+        def step(state, probe_cols, probe_valid, cols, current_time):
             ctx = {"xp": jnp, "current_time": current_time}
             cols = dict(cols)
             valid = cols[VALID_KEY]
@@ -154,7 +165,8 @@ class JoinQueryRuntime(QueryRuntime):
             wout.pop("__flush__", None)
 
             N = wout[VALID_KEY].shape[0]
-            probe_cols, probe_valid = other.window_stage.contents(state[other_key])
+            if not other_is_store:
+                probe_cols, probe_valid = other.window_stage.contents(state[other_key])
             W = probe_valid.shape[0]
 
             # joined eval dict: this side [N,1], other side [1,W]
@@ -218,12 +230,21 @@ class JoinQueryRuntime(QueryRuntime):
             batch.cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
             if self._state is None:
                 self._state = self._init_state()
-            step = self._steps.get(side_key)
-            if step is None:
-                step = jax.jit(self.build_side_step_fn(side_key), donate_argnums=0)
-                self._steps[side_key] = step
+            jitted = self._steps.get(side_key)
+            if jitted is None:
+                jitted = jax.jit(self.build_side_step_fn(side_key), donate_argnums=0)
+                self._steps[side_key] = jitted
+            other = self.sides["right" if side_key == "left" else "left"]
+            if other.store is not None:
+                probe_cols, probe_valid = other.store.contents()
+            else:  # placeholders; the step reads its own state instead
+                probe_cols, probe_valid = {}, jnp.zeros((1,), bool)
+
+            def call(st, cols, now):
+                return jitted(st, probe_cols, probe_valid, cols, now)
+
             notify = self._finish_device_batch(
-                step, batch.cols,
+                call, batch.cols,
                 "join window capacity exceeded — raise app_context.window_capacity")
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self._timer_cbs[side_key])

@@ -1,0 +1,97 @@
+"""On-demand (store) queries against tables and named windows.
+
+Mirror of reference ``util/parser/OnDemandQueryParser.java`` (589 LoC of
+find/insert/delete/update runtime assembly): the store's current contents
+become one columnar batch, the `on` condition is a vectorized mask, and
+the selector (aggregations, group by, having, order/limit) runs the same
+device stage as streaming queries — recompiled per call shape, cached by
+jit."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.compiler import SiddhiCompiler
+from siddhi_tpu.core.event import CURRENT, Event, HostBatch
+from siddhi_tpu.core.plan.resolvers import SingleStreamResolver
+from siddhi_tpu.core.plan.selector_plan import GK_KEY, plan_selector
+from siddhi_tpu.core.table.in_memory_table import TBL_PREFIX, TableConditionResolver
+from siddhi_tpu.ops.expressions import (
+    TS_KEY,
+    TYPE_KEY,
+    VALID_KEY,
+    CompileError,
+    compile_condition,
+)
+from siddhi_tpu.query_api.execution import OnDemandQuery, ReturnStream
+
+
+def run_on_demand_query(source: str, app_runtime) -> List[Event]:
+    oq: OnDemandQuery = SiddhiCompiler.parse_on_demand_query(source)
+    store_id = oq.input_store.store_id
+    dictionary = app_runtime.app_context.string_dictionary
+
+    table = app_runtime.tables.get(store_id)
+    window = app_runtime.named_windows.get(store_id)
+    if table is not None:
+        definition = table.definition
+        cols, valid = table.contents()
+    elif window is not None:
+        definition = window.definition
+        cols, valid = window.contents()
+    else:
+        raise CompileError(
+            f"'{store_id}' is not a defined table or window (aggregation store "
+            f"queries land with incremental aggregation)"
+        )
+
+    if oq.type != "find" or not isinstance(oq.output_stream, (ReturnStream, type(None))):
+        raise CompileError(
+            "only `select ... return`-style (find) on-demand queries are "
+            "supported yet — stream-driven insert/delete/update cover mutation"
+        )
+
+    C = valid.shape[0]
+    match = valid
+    if oq.input_store.on_condition is not None:
+        resolver = TableConditionResolver(definition, None, dictionary)
+        cond = compile_condition(oq.input_store.on_condition, resolver)
+        ev = {TBL_PREFIX + k: v[None, :] for k, v in cols.items()}
+        ev[TS_KEY] = cols[TS_KEY][None, :]
+        m = jnp.broadcast_to(cond(ev, {"xp": jnp}), (1, C))[0]
+        match = match & m
+
+    sel_cols = {k: v for k, v in cols.items()}
+    sel_cols[VALID_KEY] = match
+    sel_cols[TYPE_KEY] = jnp.zeros(C, jnp.int8)
+    sel_cols[GK_KEY] = jnp.zeros(C, jnp.int32)
+
+    sel_resolver = SingleStreamResolver(
+        definition, dictionary, ref_id=oq.input_store.store_reference_id,
+        synthetic={})
+    plan = plan_selector(
+        selector=oq.selector,
+        input_attrs=[(a.name, a.type) for a in definition.attributes],
+        resolver=sel_resolver,
+        output_event_type="current",
+        batch_mode=False,
+        dictionary=dictionary,
+    )
+    if plan.group_by:
+        # group ids from the key expressions over store contents (host side)
+        from siddhi_tpu.core.query.runtime import GroupKeyer
+        from siddhi_tpu.ops.expressions import compile_expr
+
+        fns = [compile_expr(v, sel_resolver) for v in oq.selector.group_by_list]
+        keyer = GroupKeyer(fns)
+        host_cols = {k: np.asarray(v) for k, v in sel_cols.items()}
+        sel_cols[GK_KEY] = jnp.asarray(keyer(host_cols))
+        plan.num_keys = max(16, len(keyer))
+
+    state = plan.init_state()
+    _state, out = plan.apply(state, sel_cols, {"xp": jnp, "current_time": jnp.int64(0)})
+    out_host = {k: np.asarray(v) for k, v in out.items()}
+    return HostBatch(out_host).to_events(plan.output_attrs, dictionary)

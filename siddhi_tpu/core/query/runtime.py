@@ -107,6 +107,7 @@ class QueryRuntime(Receiver):
         self.rate_limiter: Optional[OutputRateLimiter] = None
         self.query_callbacks: List = []
         self.output_junction: Optional[StreamJunction] = None
+        self.output_action: Optional[Callable] = None  # table ops etc.
         self.scheduler = None  # set by the app runtime when timers are needed
         self._state: Optional[dict] = None
         self._step = None
@@ -291,7 +292,9 @@ class QueryRuntime(Receiver):
     def send_to_callbacks(self, events: List[Event]):
         if not events:
             return
-        if self.output_junction is not None:
+        if self.output_action is not None:
+            self.output_action(events)
+        elif self.output_junction is not None:
             # EXPIRED -> CURRENT on re-publish (InsertIntoStreamCallback.java:52-55)
             repub = [
                 Event(timestamp=e.timestamp, data=e.data, pk=e.pk) if e.is_expired else e

@@ -16,14 +16,18 @@ from siddhi_tpu.core.stream.junction import StreamJunction
 
 
 class InputHandler:
-    def __init__(self, stream_id: str, junction: StreamJunction, app_context, barrier: threading.RLock):
+    def __init__(self, stream_id: str, junction: StreamJunction, app_context, barrier: threading.RLock,
+                 ensure_started=None):
         self.stream_id = stream_id
         self.junction = junction
         self.app_context = app_context
         self._barrier = barrier
+        self._ensure_started = ensure_started
 
     def send(self, *args):
         """send(data_list) | send(ts, data_list) | send(Event) | send([Event,...])"""
+        if self._ensure_started is not None:
+            self._ensure_started()
         tsg = self.app_context.timestamp_generator
         if len(args) == 1:
             a = args[0]
@@ -53,12 +57,14 @@ class InputManager:
         self._junctions = junctions
         self._barrier = barrier
         self._handlers: Dict[str, InputHandler] = {}
+        self.ensure_started = None  # set by SiddhiAppRuntime (lazy app start)
 
     def get_input_handler(self, stream_id: str) -> InputHandler:
         h = self._handlers.get(stream_id)
         if h is None:
             if stream_id not in self._junctions:
                 raise KeyError(f"stream '{stream_id}' is not defined")
-            h = InputHandler(stream_id, self._junctions[stream_id], self.app_context, self._barrier)
+            h = InputHandler(stream_id, self._junctions[stream_id], self.app_context, self._barrier,
+                             ensure_started=lambda: self.ensure_started and self.ensure_started())
             self._handlers[stream_id] = h
         return h

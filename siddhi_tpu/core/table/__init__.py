@@ -1,0 +1,6 @@
+from siddhi_tpu.core.table.in_memory_table import (
+    InMemoryTable,
+    TableConditionResolver,
+)
+
+__all__ = ["InMemoryTable", "TableConditionResolver"]

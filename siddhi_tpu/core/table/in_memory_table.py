@@ -1,0 +1,239 @@
+"""In-memory table: a device-resident columnar store.
+
+Replaces the reference's ``InMemoryTable`` + ``IndexEventHolder`` (hash
+primary-key map, per-attribute TreeMap indexes, compiled
+``CollectionExecutor`` scans — ``table/holder/IndexEventHolder.java:60-80``,
+``util/collection/executor/*.java``) with one dense ``[C]`` column set and
+an occupancy mask: every lookup/update/delete evaluates its compiled
+condition as a masked ``[B, C]`` broadcast compare — the vectorized
+equivalent of an index probe, with no pointer-chasing. Capacity doubles by
+prefix copy when full.
+
+``@primaryKey``/``@index`` annotations are accepted (they shape reference
+semantics only through lookup performance, which is uniform here).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.event import CURRENT, Event, HostBatch, StringDictionary
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY, ColumnRef, CompileError, Resolver
+from siddhi_tpu.query_api.definitions import AttrType, TableDefinition
+from siddhi_tpu.query_api.expressions import Variable
+
+TBL_PREFIX = "t__"
+EV_PREFIX = "s__"
+
+
+class TableConditionResolver(Resolver):
+    """Resolve an `on` condition over (table row, triggering event).
+    Unqualified names bind to the triggering event first (the reference
+    test idiom is ``on StockTable.symbol == symbol`` — table side
+    qualified, event side bare), then to the table (on-demand queries have
+    no event side)."""
+
+    def __init__(self, table_def, event_def, dictionary,
+                 event_ref: Optional[str] = None):
+        self.table_def = table_def
+        self.event_def = event_def  # may be None (on-demand queries)
+        self.dictionary = dictionary
+        self.event_ref = event_ref
+
+    def resolve(self, var: Variable) -> ColumnRef:
+        sid = var.stream_id
+        if sid == self.table_def.id:
+            attr = self.table_def.attribute(var.attribute_name)
+            return ColumnRef(TBL_PREFIX + attr.name, attr.type)
+        if self.event_def is not None and (
+            sid is None or sid in (self.event_def.id, self.event_ref)
+        ):
+            try:
+                attr = self.event_def.attribute(var.attribute_name)
+                return ColumnRef(EV_PREFIX + attr.name, attr.type)
+            except Exception:
+                if sid is not None:
+                    raise
+        if sid is None:
+            attr = self.table_def.attribute(var.attribute_name)
+            return ColumnRef(TBL_PREFIX + attr.name, attr.type)
+        raise CompileError(
+            f"cannot resolve '{(sid + '.') if sid else ''}{var.attribute_name}' "
+            f"in table condition"
+        )
+
+    def encode_string(self, s: str) -> int:
+        return self.dictionary.encode(s)
+
+
+class InMemoryTable:
+    def __init__(self, definition: TableDefinition, dictionary: StringDictionary,
+                 capacity: int = 1024):
+        from siddhi_tpu.ops.windows import window_col_specs
+
+        self.definition = definition
+        self.dictionary = dictionary
+        self.col_specs = window_col_specs(definition)
+        self.capacity = capacity
+        self.state = self._zero_state(capacity)
+        self._lock = threading.RLock()
+
+    def _zero_state(self, cap: int) -> dict:
+        return {
+            "cols": {n: jnp.zeros((cap,), dt) for n, dt in self.col_specs.items()},
+            "valid": jnp.zeros((cap,), bool),
+        }
+
+    # ----------------------------------------------------------- capacity
+
+    @property
+    def count(self) -> int:
+        return int(np.asarray(self.state["valid"]).sum())
+
+    def _ensure_room(self, n: int):
+        needed = self.count + n
+        cap = self.capacity
+        if needed <= cap:
+            return
+        while cap < needed:
+            cap *= 2
+        new = self._zero_state(cap)
+        new["cols"] = {
+            n_: new["cols"][n_].at[: self.capacity].set(self.state["cols"][n_])
+            for n_ in new["cols"]
+        }
+        new["valid"] = new["valid"].at[: self.capacity].set(self.state["valid"])
+        self.state = new
+        self.capacity = cap
+
+    # ------------------------------------------------------- contents/probe
+
+    def contents(self) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+        with self._lock:
+            return dict(self.state["cols"]), self.state["valid"]
+
+    # ------------------------------------------------------------- actions
+
+    def insert(self, batch: HostBatch):
+        """Insert the batch's valid rows into free slots (arrival order)."""
+        with self._lock:
+            n = batch.size
+            if n == 0:
+                return
+            self._ensure_room(n)
+            cols, valid, st = batch.cols, batch.cols[VALID_KEY], self.state
+            C = self.capacity
+            free = ~st["valid"]
+            fs = jnp.argsort(jnp.where(free, jnp.arange(C), C + jnp.arange(C)))
+            rank = jnp.cumsum(np.asarray(valid, bool)) - 1
+            slot = jnp.where(valid, fs[jnp.clip(rank, 0, C - 1)], C)
+            new_cols = {}
+            for name in st["cols"]:
+                src = cols.get(name)
+                if src is None:
+                    src = np.zeros(valid.shape[0], self.col_specs[name])
+                new_cols[name] = st["cols"][name].at[slot].set(jnp.asarray(src), mode="drop")
+            self.state = {
+                "cols": new_cols,
+                "valid": st["valid"].at[slot].set(True, mode="drop"),
+            }
+
+    def _match(self, cond: Optional[Callable], ev_cols: Optional[dict], ctx: dict):
+        """[B, C] match matrix of condition over (event, table row)."""
+        tcols, tvalid = self.contents()
+        ev = {}
+        B = 1
+        if ev_cols is not None:
+            B = ev_cols[VALID_KEY].shape[0]
+            for k, v in ev_cols.items():
+                ev[EV_PREFIX + k] = jnp.asarray(v)[:, None]
+        for k, v in tcols.items():
+            ev[TBL_PREFIX + k] = v[None, :]
+        ev[TS_KEY] = ev.get(EV_PREFIX + TS_KEY, jnp.zeros((B, 1), jnp.int64))
+        C = tvalid.shape[0]
+        m = cond(ev, ctx) if cond is not None else jnp.ones((B, C), bool)
+        m = jnp.broadcast_to(m, (B, C)) & tvalid[None, :]
+        if ev_cols is not None:
+            m = m & jnp.asarray(ev_cols[VALID_KEY], bool)[:, None]
+        return m
+
+    def delete(self, cond: Optional[Callable], batch: Optional[HostBatch]):
+        with self._lock:
+            ctx = {"xp": jnp}
+            m = self._match(cond, batch.cols if batch is not None else None, ctx)
+            self.state = {
+                "cols": self.state["cols"],
+                "valid": self.state["valid"] & ~jnp.any(m, axis=0),
+            }
+
+    def update(self, cond: Optional[Callable], assignments, batch: Optional[HostBatch]):
+        """assignments: [(table col name, compiled expr over ev/table cols)].
+        When several events match one row, the last event wins (reference
+        processes the chunk in order)."""
+        with self._lock:
+            ctx = {"xp": jnp}
+            ev_cols = batch.cols if batch is not None else None
+            m = self._match(cond, ev_cols, ctx)
+            B, C = m.shape
+            ev = {}
+            if ev_cols is not None:
+                for k, v in ev_cols.items():
+                    ev[EV_PREFIX + k] = jnp.asarray(v)[:, None]
+            for k, v in self.state["cols"].items():
+                ev[TBL_PREFIX + k] = v[None, :]
+            # winning (last matching) event per table row; B when none
+            ridx = jnp.arange(B, dtype=jnp.int32)
+            win = jnp.max(jnp.where(m, ridx[:, None] + 1, 0), axis=0) - 1  # [C]
+            hit = win >= 0
+            wsafe = jnp.clip(win, 0, B - 1)
+            new_cols = dict(self.state["cols"])
+            for col_name, fn, _t in assignments:
+                v, mask = fn(ev, ctx)
+                v = jnp.broadcast_to(jnp.asarray(v), (B, C))
+                val = v[wsafe, jnp.arange(C)]
+                new_cols[col_name] = jnp.where(hit, val, new_cols[col_name])
+                if mask is not None:
+                    mk = jnp.broadcast_to(jnp.asarray(mask), (B, C))[wsafe, jnp.arange(C)]
+                else:
+                    mk = jnp.zeros(C, bool)
+                new_cols[col_name + "?"] = jnp.where(
+                    hit, mk, new_cols[col_name + "?"])
+            self.state = {"cols": new_cols, "valid": self.state["valid"]}
+            return m
+
+    def update_or_insert(self, cond, assignments, batch: HostBatch):
+        """Sequential semantics per event: an inserted row is visible to the
+        later events of the same chunk (reference UpdateOrInsertReducer
+        processes the chunk in order). The vectorized update handles the
+        common all-match case; only unmatched events fall back to
+        one-at-a-time processing."""
+        with self._lock:
+            m = self.update(cond, assignments, batch)
+            unmatched = ~np.asarray(jnp.any(m, axis=1)) & np.asarray(
+                batch.cols[VALID_KEY], bool)
+            if not unmatched.any():
+                return
+            host = {k: np.asarray(v) for k, v in batch.cols.items()}
+            for i in np.nonzero(unmatched)[0]:
+                row = {k: v[i : i + 1] for k, v in host.items()}
+                row[VALID_KEY] = np.ones(1, bool)
+                single = HostBatch(row)
+                m1 = self.update(cond, assignments, single)
+                if not bool(np.asarray(jnp.any(m1))):
+                    self.insert(single)
+
+    # ------------------------------------------------------------ decoding
+
+    def all_events(self) -> List[Event]:
+        cols, valid = self.contents()
+        host = {k: np.asarray(v) for k, v in cols.items()}
+        host[VALID_KEY] = np.asarray(valid)
+        host[TYPE_KEY] = np.zeros(valid.shape[0], np.int8)
+        batch = HostBatch(host)
+        return batch.to_events(
+            [(a.name, a.type) for a in self.definition.attributes], self.dictionary)

@@ -1,0 +1,3 @@
+from siddhi_tpu.core.trigger.trigger import TriggerRuntime
+
+__all__ = ["TriggerRuntime"]

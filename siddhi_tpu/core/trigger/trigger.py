@@ -1,0 +1,48 @@
+"""Triggers: ``define trigger T at ('start' | every <time> | '<cron>')``.
+
+Mirror of reference ``core/trigger/{StartTrigger,PeriodicTrigger.java:36,
+CronTrigger.java:46}``: a trigger defines a stream ``T (triggered_time
+long)`` and publishes one event per firing. Cron expressions need a cron
+engine (quartz in the reference) and are not supported yet.
+"""
+
+from __future__ import annotations
+
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.ops.expressions import CompileError
+from siddhi_tpu.query_api.definitions import TriggerDefinition
+
+
+class TriggerRuntime:
+    def __init__(self, definition: TriggerDefinition, junction, app_context,
+                 barrier=None):
+        if definition.cron is not None:
+            raise CompileError(
+                f"trigger '{definition.id}': cron triggers are not supported yet"
+            )
+        self.definition = definition
+        self.junction = junction
+        self.app_context = app_context
+        self._barrier = barrier  # the app's quiesce gate (InputEntryValve role)
+        self._job = None
+
+    def start(self):
+        scheduler = self.app_context.scheduler
+        if self.definition.at_start:
+            ts = self.app_context.timestamp_generator.current_time()
+            self._fire(ts)
+        elif self.definition.at_every is not None and scheduler is not None:
+            self._job = scheduler.schedule_periodic(self.definition.at_every, self._fire)
+
+    def stop(self):
+        if self._job is not None and self.app_context.scheduler is not None:
+            self.app_context.scheduler.cancel(self._job)
+            self._job = None
+
+    def _fire(self, ts: int):
+        events = [Event(timestamp=int(ts), data=[int(ts)])]
+        if self._barrier is not None:
+            with self._barrier:
+                self.junction.send_events(events)
+        else:
+            self.junction.send_events(events)

@@ -1,0 +1,3 @@
+from siddhi_tpu.core.window.named_window import NamedWindowRuntime
+
+__all__ = ["NamedWindowRuntime"]

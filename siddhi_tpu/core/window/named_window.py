@@ -1,0 +1,103 @@
+"""Named windows: ``define window W (...) <window>(...)``.
+
+Mirror of reference ``core/window/Window.java:65``: one shared window
+instance; producers ``insert into W``, consumers ``from W`` receive its
+emissions (CURRENT/EXPIRED per the definition's ``output`` clause), and
+joins probe its buffer. Here the window is a device stage with shared
+state; subscriber queries read the emission stream through the window's
+output junction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.event import CURRENT, EXPIRED, RESET, TIMER, Event, HostBatch
+from siddhi_tpu.core.plan.resolvers import SingleStreamResolver
+from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
+from siddhi_tpu.ops.expressions import TYPE_KEY, VALID_KEY
+from siddhi_tpu.query_api.definitions import WindowDefinition
+
+
+class NamedWindowRuntime(Receiver):
+    def __init__(self, definition: WindowDefinition, app_context, dictionary):
+        from siddhi_tpu.ops.windows import create_window_stage
+
+        self.definition = definition
+        self.app_context = app_context
+        self.dictionary = dictionary
+        resolver = SingleStreamResolver(definition, dictionary)
+        self.stage = create_window_stage(definition.window, definition, resolver,
+                                         app_context)
+        self.state = self.stage.init_state()
+        self.out_junction = StreamJunction(definition, app_context)
+        self.scheduler = None
+        self._step = None
+        self._lock = threading.RLock()
+
+    def contents(self):
+        """Probe surface for joins (reference WindowWindowProcessor.find)."""
+        with self._lock:
+            return self.stage.contents(self.state)
+
+    def _make_step(self):
+        stage = self.stage
+
+        def step(state, cols, now):
+            ctx = {"xp": jnp, "current_time": now}
+            return stage.apply(state, cols, ctx)
+
+        # NOT donated: probe readers (joins, on-demand queries) hold
+        # references to the state buffers between steps
+        return jax.jit(step)
+
+    def receive(self, events: List[Event]):
+        batch = HostBatch.from_events(events, self.definition, self.dictionary)
+        self._process(batch)
+
+    # queries `insert into W` treat the window as their output junction
+    send_events = receive
+
+    def process_timer(self, ts: int):
+        from siddhi_tpu.core.query.runtime import _zero_value
+
+        batch = HostBatch.from_events(
+            [Event(timestamp=int(ts),
+                   data=[_zero_value(a.type) for a in self.definition.attributes])],
+            self.definition, self.dictionary)
+        batch.cols[TYPE_KEY][...] = TIMER
+        self._process(batch)
+
+    def _process(self, batch: HostBatch):
+        with self._lock:
+            batch.cols["__gk__"] = np.zeros(batch.capacity, np.int32)
+            if self._step is None:
+                self._step = self._make_step()
+            now = np.int64(self.app_context.timestamp_generator.current_time())
+            self.state, out = self._step(self.state, batch.cols, now)
+            out_host = {k: np.asarray(v) for k, v in out.items()}
+            overflow = out_host.pop("__overflow__", None)
+            if overflow is not None and int(overflow) > 0:
+                raise RuntimeError(
+                    f"window '{self.definition.id}': buffer capacity exceeded — "
+                    f"raise app_context.window_capacity before creating the runtime"
+                )
+            notify = out_host.pop("__notify__", None)
+            out_host.pop("__flush__", None)
+            types_wanted = {
+                "current": (CURRENT,),
+                "expired": (EXPIRED,),
+                "all": (CURRENT, EXPIRED),
+            }[self.definition.output_event_type]
+            events = HostBatch(out_host).to_events(
+                [(a.name, a.type) for a in self.definition.attributes],
+                self.dictionary, types_wanted=types_wanted)
+        if events:
+            self.out_junction.send_events(events)
+        if notify is not None and int(notify) >= 0 and self.scheduler is not None:
+            self.scheduler.notify_at(int(notify), self.process_timer)

@@ -1,0 +1,169 @@
+"""Table / named-window / trigger / on-demand-query tests — modeled on the
+reference ``query/table/*``, ``core/window/*`` and ``query/trigger/*``
+corpora."""
+
+import time
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out=None):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    if out:
+        runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+def test_insert_and_on_demand_find():
+    m, rt, _ = build("""
+        define stream StockStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+    """)
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 55.5, 100])
+    h.send(["IBM", 75.5, 200])
+    rows = rt.query("from StockTable select symbol, price, volume")
+    got = sorted(tuple(e.data) for e in rows)
+    assert got == [("IBM", 75.5, 200), ("WSO2", 55.5, 100)]
+    # condition + aggregation
+    rows = rt.query("from StockTable on price > 60 select count() as c")
+    assert rows[0].data == [1]
+    m.shutdown()
+
+
+def test_delete_from_table():
+    m, rt, _ = build("""
+        define stream StockStream (symbol string, price float);
+        define stream DeleteStream (symbol string);
+        define table StockTable (symbol string, price float);
+        from StockStream insert into StockTable;
+        from DeleteStream delete StockTable on StockTable.symbol == symbol;
+    """)
+    rt.get_input_handler("StockStream").send(["WSO2", 55.5])
+    rt.get_input_handler("StockStream").send(["IBM", 75.5])
+    rt.get_input_handler("DeleteStream").send(["WSO2"])
+    rows = rt.query("from StockTable select symbol")
+    assert [e.data for e in rows] == [["IBM"]]
+    m.shutdown()
+
+
+def test_update_table():
+    m, rt, _ = build("""
+        define stream UpdateStockStream (symbol string, price float);
+        define stream StockStream (symbol string, price float);
+        define table StockTable (symbol string, price float);
+        from StockStream insert into StockTable;
+        from UpdateStockStream
+        update StockTable set StockTable.price = price
+        on StockTable.symbol == symbol;
+    """)
+    rt.get_input_handler("StockStream").send(["WSO2", 55.5])
+    rt.get_input_handler("StockStream").send(["IBM", 75.5])
+    rt.get_input_handler("UpdateStockStream").send(["IBM", 100.5])
+    rows = rt.query("from StockTable select symbol, price")
+    assert sorted(tuple(e.data) for e in rows) == [("IBM", 100.5), ("WSO2", 55.5)]
+    m.shutdown()
+
+
+def test_update_or_insert():
+    m, rt, _ = build("""
+        define stream S (symbol string, price float);
+        define table T (symbol string, price float);
+        from S update or insert into T set T.price = price
+        on T.symbol == symbol;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.5])       # insert
+    h.send(["B", 2.5])       # insert
+    h.send(["A", 9.5])       # update
+    rows = rt.query("from T select symbol, price")
+    assert sorted(tuple(e.data) for e in rows) == [("A", 9.5), ("B", 2.5)]
+    m.shutdown()
+
+
+def test_join_with_table():
+    m, rt, c = build("""
+        define stream StockStream (symbol string, price float);
+        define stream CheckStream (symbol string);
+        define table StockTable (symbol string, price float);
+        from StockStream insert into StockTable;
+        from CheckStream join StockTable
+        on CheckStream.symbol == StockTable.symbol
+        select CheckStream.symbol as symbol, StockTable.price as price
+        insert into OutStream;
+    """, out="OutStream")
+    rt.get_input_handler("StockStream").send(["WSO2", 55.5])
+    rt.get_input_handler("CheckStream").send(["WSO2"])
+    rt.get_input_handler("CheckStream").send(["IBM"])     # no match
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("WSO2", 55.5)]
+
+
+def test_named_window_shared():
+    # two queries aggregate over one shared window's emissions
+    m, rt, c = build("""
+        define stream S (symbol string, price float);
+        define window W (symbol string, price float) length(2) output all events;
+        from S insert into W;
+        from W select symbol, sum(price) as total insert into OutStream;
+    """, out="OutStream")
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    h.send(["A", 2.0])
+    h.send(["A", 4.0])    # window slides: 1.0 expires -> total 6-1=... sum over window = 2+4
+    m.shutdown()
+    totals = [e.data[1] for e in c.events if not e.is_expired]
+    assert totals[:2] == [1.0, 3.0]
+    assert totals[-1] == 6.0  # CURRENT for 4.0 arrives after expired 1.0
+    got_final = [e.data[1] for e in c.events][-1]
+    assert got_final == 6.0
+
+
+def test_named_window_join():
+    m, rt, c = build("""
+        define stream S (symbol string, price float);
+        define stream Check (symbol string);
+        define window W (symbol string, price float) length(10) output all events;
+        from S insert into W;
+        from Check join W on Check.symbol == W.symbol
+        select Check.symbol as symbol, W.price as price
+        insert into OutStream;
+    """, out="OutStream")
+    rt.get_input_handler("S").send(["X", 7.5])
+    rt.get_input_handler("Check").send(["X"])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("X", 7.5)]
+
+
+def test_trigger_at_start():
+    m, rt, c = build("""
+        define trigger T at 'start';
+        from T select triggered_time insert into OutStream;
+    """, out="OutStream")
+    rt.start()
+    m.shutdown()
+    assert len(c.events) == 1
+    assert isinstance(c.events[0].data[0], int)
+
+
+def test_trigger_periodic():
+    m, rt, c = build("""
+        define trigger T at every 100 milliseconds;
+        from T select triggered_time insert into OutStream;
+    """, out="OutStream")
+    rt.start()
+    time.sleep(0.45)
+    m.shutdown()
+    assert len(c.events) >= 2
