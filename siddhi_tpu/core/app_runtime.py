@@ -31,10 +31,20 @@ from siddhi_tpu.query_api.execution import InsertIntoStream, Partition, Query
 from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
 
+def _default_app_name(siddhi_app: SiddhiApp) -> str:
+    """Deterministic fallback name so snapshots of the same (unnamed) app
+    text restore across process restarts."""
+    import hashlib
+
+    # dataclass reprs are deterministic and cover definitions, queries and
+    # expressions — distinct apps hash apart, identical text hashes equal
+    return "siddhi-app-" + hashlib.md5(repr(siddhi_app).encode()).hexdigest()[:12]
+
+
 class SiddhiAppRuntime:
     def __init__(self, siddhi_app: SiddhiApp, siddhi_context: SiddhiContext):
         self.siddhi_app = siddhi_app
-        self.name = siddhi_app.name or f"siddhi-app-{id(siddhi_app):x}"
+        self.name = siddhi_app.name or _default_app_name(siddhi_app)
         self.app_context = SiddhiAppContext(siddhi_context, self.name)
         self._barrier = threading.RLock()
         self.stream_definitions: Dict[str, StreamDefinition] = dict(siddhi_app.stream_definitions)
@@ -88,6 +98,7 @@ class SiddhiAppRuntime:
         # at-start triggers then fire with subscribers in place
         self.input_manager.ensure_started = self.start
 
+        self.partition_contexts: List = []
         q_index = 0
         p_index = 0
         for element in siddhi_app.execution_elements:
@@ -125,6 +136,7 @@ class SiddhiAppRuntime:
         from siddhi_tpu.query_api.execution import RangePartitionType, ValuePartitionType
 
         pctx = PartitionContext(p_index)
+        self.partition_contexts.append(pctx)
         for ptype in partition.partition_types:
             sid = ptype.stream_id
             if sid not in self.stream_definitions:
@@ -245,13 +257,18 @@ class SiddhiAppRuntime:
             for sid, proxy in runtime.make_proxies().items():
                 self.junctions[sid].subscribe(proxy)
         elif isinstance(query.input_stream, JoinInputStream):
-            # store (table/window) sides have no proxy; named-window stream
-            # sides would need emission-driven triggering (not supported)
+            # table sides have no proxy; named-window sides subscribe to the
+            # window's emission junction, stream sides to their junction
             proxies = runtime.make_proxies()
             for side_key, s in (("left", query.input_stream.left),
                                 ("right", query.input_stream.right)):
-                if side_key in proxies:
-                    self.junctions[s.unique_stream_id].subscribe(proxies[side_key])
+                if side_key not in proxies:
+                    continue
+                sid = s.unique_stream_id
+                if sid in self.named_windows:
+                    self.named_windows[sid].out_junction.subscribe(proxies[side_key])
+                else:
+                    self.junctions[sid].subscribe(proxies[side_key])
         elif partition_ctx is not None and query.input_stream.is_inner_stream:
             input_stream_id = query.input_stream.unique_stream_id
             if input_stream_id not in partition_ctx.inner_junctions:
@@ -295,17 +312,18 @@ class SiddhiAppRuntime:
     addCallback = add_callback
 
     def start(self):
-        if self._started:
-            return
-        self._started = True
-        for j in self.junctions.values():
-            j.start_processing()
-        scheduler = self.app_context.scheduler
-        for qr in self.query_runtimes.values():
-            if qr.rate_limiter is not None:
-                qr.rate_limiter.start(scheduler)
-        for tr in self.trigger_runtimes:
-            tr.start()
+        with self._barrier:  # lazy start can race concurrent first sends
+            if self._started:
+                return
+            self._started = True
+            for j in self.junctions.values():
+                j.start_processing()
+            scheduler = self.app_context.scheduler
+            for qr in self.query_runtimes.values():
+                if qr.rate_limiter is not None:
+                    qr.rate_limiter.start(scheduler)
+            for tr in self.trigger_runtimes:
+                tr.start()
 
     def shutdown(self):
         for tr in self.trigger_runtimes:
@@ -318,6 +336,47 @@ class SiddhiAppRuntime:
         if self.app_context.scheduler is not None:
             self.app_context.scheduler.shutdown()
         self._started = False
+
+    # ---------------------------------------------------- persistence API
+
+    @property
+    def persistence(self):
+        from siddhi_tpu.core.util.snapshot import PersistenceManager
+
+        if getattr(self, "_persistence", None) is None:
+            self._persistence = PersistenceManager(self)
+        return self._persistence
+
+    def persist(self) -> str:
+        """Checkpoint all state to the configured persistence store;
+        returns the revision id (reference SiddhiAppRuntimeImpl.persist:677)."""
+        return self.persistence.persist()
+
+    def restore_revision(self, revision: str):
+        self.persistence.restore_revision(revision)
+
+    restoreRevision = restore_revision
+
+    def restore_last_revision(self):
+        return self.persistence.restore_last_revision()
+
+    restoreLastRevision = restore_last_revision
+
+    def clear_all_revisions(self):
+        self.persistence.clear_all_revisions()
+
+    def snapshot(self) -> bytes:
+        """Raw state snapshot bytes (reference SiddhiAppRuntime.snapshot)."""
+        from siddhi_tpu.core.util.snapshot import SnapshotService
+
+        with self._barrier:
+            return SnapshotService(self).full_snapshot()
+
+    def restore(self, snapshot: bytes):
+        from siddhi_tpu.core.util.snapshot import SnapshotService
+
+        with self._barrier:
+            SnapshotService(self).restore(snapshot)
 
     # ------------------------------------------------------ on-demand API
 

@@ -47,6 +47,21 @@ class SiddhiManager:
     def set_config_manager(self, config_manager):
         self.siddhi_context.config_manager = config_manager
 
+    def persist_all(self):
+        """Persist every app (reference SiddhiManager.persist)."""
+        for rt in self.app_runtimes.values():
+            rt.persist()
+
+    persistAll = persist_all
+
+    def restore_last_state(self):
+        """Restore every app from its last revision (reference
+        SiddhiManager.restoreLastState:292-300)."""
+        for rt in self.app_runtimes.values():
+            rt.restore_last_revision()
+
+    restoreLastState = restore_last_state
+
     def shutdown(self):
         for rt in list(self.app_runtimes.values()):
             rt.shutdown()

@@ -57,8 +57,9 @@ def plan_join_query(
         tables = getattr(app_context, "tables", {})
         named_windows = getattr(app_context, "named_windows", {})
         if sid in tables or sid in named_windows:
-            # probe-only shared store (reference TableWindowProcessor /
-            # WindowWindowProcessor as the findable join side)
+            # shared store side (reference TableWindowProcessor /
+            # WindowWindowProcessor as the findable join side); named
+            # windows also trigger with their emission stream, tables can't
             store = tables.get(sid) or named_windows[sid]
             sdef = store.definition
             if s.handlers:
@@ -66,10 +67,24 @@ def plan_join_query(
                     f"query '{query_name}': handlers on the {sid} store join "
                     f"side are not supported"
                 )
+            is_window = sid in named_windows
+            stage = None
+            if is_window:
+                from siddhi_tpu.ops.windows import (
+                    PassthroughWindowStage as _PT,
+                    window_col_specs as _wcs,
+                )
+
+                stage = _PT(_wcs(sdef), pass_expired=True)
+            triggers = is_window and (
+                join.trigger == EventTrigger.ALL
+                or (join.trigger == EventTrigger.LEFT and key == "left")
+                or (join.trigger == EventTrigger.RIGHT and key == "right")
+            )
             return JoinSide(
                 key=key, stream_id=sid, ref_id=s.stream_reference_id,
-                definition=sdef, window_stage=None, filters=[],
-                triggers=False, outer=False, store=store,
+                definition=sdef, window_stage=stage, filters=[],
+                triggers=triggers, outer=False, store=store,
             )
         if sid not in definitions:
             raise CompileError(f"query '{query_name}': stream '{sid}' is not defined")
@@ -115,10 +130,10 @@ def plan_join_query(
 
     left = build_side("left", join.left)
     right = build_side("right", join.right)
-    if left.store is not None and right.store is not None:
+    if left.window_stage is None and right.window_stage is None:
         raise CompileError(
-            f"query '{query_name}': at least one join side must be a stream "
-            f"(both '{left.stream_id}' and '{right.stream_id}' are stores)"
+            f"query '{query_name}': a join needs an event-driven side — both "
+            f"'{left.stream_id}' and '{right.stream_id}' are tables"
         )
     resolver = JoinResolver(left, right, dictionary)
 

@@ -122,18 +122,19 @@ class JoinQueryRuntime(QueryRuntime):
         }
 
     def make_proxies(self) -> Dict[str, JoinSideProxy]:
-        # store sides (tables/windows) produce no events — no proxy
+        # table sides produce no events — no proxy; named-window sides get
+        # one (subscribed to the window's emission junction)
         return {
             k: JoinSideProxy(self, k)
             for k in ("left", "right")
-            if self.sides[k].store is None
+            if self.sides[k].window_stage is not None
         }
 
     def _init_state(self) -> dict:
         state = {"sel": self.selector_plan.init_state()}
-        if self.sides["left"].store is None:
+        if self.sides["left"].window_stage is not None:
             state["lwin"] = self.sides["left"].window_stage.init_state()
-        if self.sides["right"].store is None:
+        if self.sides["right"].window_stage is not None:
             state["rwin"] = self.sides["right"].window_stage.init_state()
         return state
 

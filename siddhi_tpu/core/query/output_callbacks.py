@@ -117,10 +117,27 @@ class UpdateTableCallback(_ConditionedTableCallback):
 
 
 class UpdateOrInsertTableCallback(UpdateTableCallback):
+    def __init__(self, table, query_name, output_attrs, on_condition, update_set,
+                 dictionary):
+        super().__init__(table, query_name, output_attrs, on_condition, update_set,
+                         dictionary)
+        # unmatched events insert positionally, like `insert into`
+        if len(output_attrs) != len(table.definition.attributes):
+            raise CompileError(
+                f"update or insert into '{table.definition.id}': query outputs "
+                f"{len(output_attrs)} attributes, table has "
+                f"{len(table.definition.attributes)}"
+            )
+        self.insert_mapping = [
+            (tattr.name, oname)
+            for tattr, (oname, _t) in zip(table.definition.attributes, output_attrs)
+        ]
+
     def __call__(self, events: List[Event]):
         batch = self._batch(events)
         if batch is not None:
-            self.table.update_or_insert(self.cond, self.assignments, batch)
+            self.table.update_or_insert(self.cond, self.assignments, batch,
+                                        insert_mapping=self.insert_mapping)
 
 
 def create_table_callback(out, table, query_name, output_attrs, dictionary):

@@ -206,12 +206,15 @@ class InMemoryTable:
             self.state = {"cols": new_cols, "valid": self.state["valid"]}
             return m
 
-    def update_or_insert(self, cond, assignments, batch: HostBatch):
+    def update_or_insert(self, cond, assignments, batch: HostBatch,
+                         insert_mapping=None):
         """Sequential semantics per event: an inserted row is visible to the
         later events of the same chunk (reference UpdateOrInsertReducer
         processes the chunk in order). The vectorized update handles the
         common all-match case; only unmatched events fall back to
-        one-at-a-time processing."""
+        one-at-a-time processing. ``insert_mapping`` is the positional
+        (table attr <- event col) pairing used when an unmatched event is
+        inserted (reference inserts by position, like `insert into`)."""
         with self._lock:
             m = self.update(cond, assignments, batch)
             unmatched = ~np.asarray(jnp.any(m, axis=1)) & np.asarray(
@@ -225,6 +228,13 @@ class InMemoryTable:
                 single = HostBatch(row)
                 m1 = self.update(cond, assignments, single)
                 if not bool(np.asarray(jnp.any(m1))):
+                    if insert_mapping is not None:
+                        ins = {TS_KEY: row[TS_KEY], TYPE_KEY: row.get(TYPE_KEY, np.zeros(1, np.int8)),
+                               VALID_KEY: row[VALID_KEY]}
+                        for table_attr, ev_col in insert_mapping:
+                            ins[table_attr] = row[ev_col]
+                            ins[table_attr + "?"] = row.get(ev_col + "?", np.zeros(1, bool))
+                        single = HostBatch(ins)
                     self.insert(single)
 
     # ------------------------------------------------------------ decoding

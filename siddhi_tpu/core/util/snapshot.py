@@ -1,0 +1,183 @@
+"""SnapshotService: checkpoint/restore of a whole app's state.
+
+Mirror of reference ``util/snapshot/SnapshotService.java:51-800`` + the
+``persist()/restoreRevision/restoreLastRevision`` lifecycle
+(``SiddhiAppRuntimeImpl.java:677-755``), redesigned for dense state: the
+hierarchical map-of-State-objects walk becomes one pytree per query
+(device arrays -> numpy), plus the host-side key dictionaries (string
+dictionary, group keyers, partition key spaces) and the shared stores
+(tables, named windows). The app barrier quiesces input during both
+operations (the ThreadBarrier role, ``util/ThreadBarrier.java``).
+
+The wire format is a versioned pickle of numpy arrays — intentionally not
+the reference's JDK serialization (impl-private there too, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _to_host(tree):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _to_device(tree):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: jnp.asarray(x), tree)
+
+
+class SnapshotService:
+    def __init__(self, app_runtime):
+        self.app_runtime = app_runtime
+
+    # ------------------------------------------------------------ capture
+
+    def full_snapshot(self) -> bytes:
+        rt = self.app_runtime
+        dictionary = rt.app_context.string_dictionary
+        queries = {}
+        for name, q in rt.query_runtimes.items():
+            with q._lock:
+                queries[name] = {
+                    "state": _to_host(q._state) if q._state is not None else None,
+                    "sel_keys": q.selector_plan.num_keys,
+                    "win_keys": q._win_keys,
+                    "keyer_map": dict(q.keyer._map) if q.keyer is not None else None,
+                }
+        tables = {}
+        for tid, t in rt.tables.items():
+            with t._lock:
+                tables[tid] = {"state": _to_host(t.state), "capacity": t.capacity}
+        windows = {}
+        for wid, w in rt.named_windows.items():
+            with w._lock:
+                windows[wid] = _to_host(w.state)
+        partitions = [p.keyspace.snapshot() for p in rt.partition_contexts]
+        obj = {
+            "version": FORMAT_VERSION,
+            "app": rt.name,
+            "strings": list(dictionary._to_str),
+            "queries": queries,
+            "tables": tables,
+            "windows": windows,
+            "partitions": partitions,
+        }
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    # ------------------------------------------------------------ restore
+
+    def restore(self, data: bytes):
+        obj = pickle.loads(data)
+        if obj.get("version") != FORMAT_VERSION:
+            raise ValueError(
+                f"snapshot format {obj.get('version')} is not supported "
+                f"(expected {FORMAT_VERSION})"
+            )
+        rt = self.app_runtime
+        if obj.get("app") != rt.name:
+            raise ValueError(
+                f"snapshot belongs to app '{obj.get('app')}', not '{rt.name}' — "
+                f"name apps with @app:name for stable restore identities"
+            )
+        dictionary = rt.app_context.string_dictionary
+        # the fresh runtime's compile-time dictionary entries are a prefix of
+        # the snapshot's (same app text parses in the same order)
+        strings = obj["strings"]
+        if strings[: len(dictionary._to_str)] != dictionary._to_str[:len(strings)]:
+            raise ValueError(
+                "snapshot belongs to a different app (string dictionaries diverge)"
+            )
+        dictionary._to_str = list(strings)
+        dictionary._to_id = {s: i for i, s in enumerate(strings)}
+
+        for snap, pctx in zip(obj["partitions"], rt.partition_contexts):
+            pctx.keyspace.restore(snap)
+
+        for name, qsnap in obj["queries"].items():
+            q = rt.query_runtimes.get(name)
+            if q is None:
+                raise ValueError(f"snapshot has unknown query '{name}'")
+            with q._lock:
+                q.selector_plan.num_keys = qsnap["sel_keys"]
+                q._win_keys = qsnap["win_keys"]
+                q._state = _to_device(qsnap["state"]) if qsnap["state"] is not None else None
+                if q.keyer is not None and qsnap["keyer_map"] is not None:
+                    q.keyer._map = dict(qsnap["keyer_map"])
+                    q.keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
+                q._step = None
+                if hasattr(q, "_steps"):
+                    q._steps.clear()
+
+        for tid, tsnap in obj["tables"].items():
+            t = rt.tables.get(tid)
+            if t is None:
+                raise ValueError(f"snapshot has unknown table '{tid}'")
+            with t._lock:
+                t.state = _to_device(tsnap["state"])
+                t.capacity = tsnap["capacity"]
+
+        for wid, wsnap in obj["windows"].items():
+            w = rt.named_windows.get(wid)
+            if w is None:
+                raise ValueError(f"snapshot has unknown window '{wid}'")
+            with w._lock:
+                w.state = _to_device(wsnap)
+                w._step = None
+
+
+class PersistenceManager:
+    """persist/restore lifecycle against the configured store (reference
+    SiddhiAppRuntimeImpl.persist:677 / restoreRevision:719)."""
+
+    def __init__(self, app_runtime):
+        self.app_runtime = app_runtime
+        self.snapshot_service = SnapshotService(app_runtime)
+
+    def _store(self):
+        store = self.app_runtime.app_context.siddhi_context.persistence_store
+        if store is None:
+            raise RuntimeError(
+                "no persistence store configured — call "
+                "SiddhiManager.set_persistence_store(...) first"
+            )
+        return store
+
+    def persist(self) -> str:
+        rt = self.app_runtime
+        store = self._store()
+        with rt._barrier:  # quiesce inputs (ThreadBarrier)
+            data = self.snapshot_service.full_snapshot()
+        revision = f"{int(time.time() * 1000):020d}_{rt.name}"
+        store.save(rt.name, revision, data)
+        return revision
+
+    def restore_revision(self, revision: str):
+        rt = self.app_runtime
+        store = self._store()
+        data = store.load(rt.name, revision)
+        if data is None:
+            raise KeyError(f"revision '{revision}' not found for app '{rt.name}'")
+        with rt._barrier:
+            self.snapshot_service.restore(data)
+
+    def restore_last_revision(self) -> Optional[str]:
+        rt = self.app_runtime
+        store = self._store()
+        rev = store.get_last_revision(rt.name)
+        if rev is not None:
+            self.restore_revision(rev)
+        return rev
+
+    def clear_all_revisions(self):
+        self._store().clear_all_revisions(self.app_runtime.name)

@@ -116,12 +116,14 @@ class WindowStage:
 
 
 class PassthroughWindowStage(WindowStage):
-    """A bare (window-less) join side: events flow through and probe the
-    other window, but nothing is retained — the reference's
-    ``EmptyWindowProcessor`` behavior."""
+    """A join side that retains nothing itself: a bare (window-less) stream
+    side (reference ``EmptyWindowProcessor``; CURRENT only), or a named
+    window's emission stream (``pass_expired=True``: the shared window
+    already emitted typed CURRENT/EXPIRED events)."""
 
-    def __init__(self, col_specs: Dict[str, np.dtype]):
+    def __init__(self, col_specs: Dict[str, np.dtype], pass_expired: bool = False):
         self.col_specs = col_specs
+        self.pass_expired = pass_expired
 
     def init_state(self, num_keys: int = 1) -> dict:
         return {"empty": jnp.zeros((1,), jnp.int32)}
@@ -129,7 +131,10 @@ class PassthroughWindowStage(WindowStage):
     def apply(self, state, cols, ctx):
         out = {k: cols[k] for k in _data_keys(cols)}
         out[TYPE_KEY] = cols[TYPE_KEY]
-        out[VALID_KEY] = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        live = cols[TYPE_KEY] == CURRENT
+        if self.pass_expired:
+            live = live | (cols[TYPE_KEY] == EXPIRED)
+        out[VALID_KEY] = cols[VALID_KEY] & live
         return state, out
 
     def contents(self, state):

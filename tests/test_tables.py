@@ -167,3 +167,49 @@ def test_trigger_periodic():
     time.sleep(0.45)
     m.shutdown()
     assert len(c.events) >= 2
+
+
+def test_named_window_side_triggers_join():
+    # reference semantics: events arriving into a named window trigger the
+    # join too (WindowWindowProcessor side is event-driven)
+    m, rt, c = build("""
+        define stream S (symbol string, price float);
+        define stream Check (symbol string);
+        define window W (symbol string, price float) length(10) output all events;
+        from S insert into W;
+        from Check#window.length(10) join W on Check.symbol == W.symbol
+        select Check.symbol as symbol, W.price as price
+        insert into OutStream;
+    """, out="OutStream")
+    rt.get_input_handler("Check").send(["X"])      # nothing in W yet
+    rt.get_input_handler("S").send(["X", 7.5])     # W emission triggers join
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("X", 7.5)]
+
+
+def test_table_table_join_rejected():
+    import pytest
+    from siddhi_tpu.ops.expressions import CompileError
+    m = SiddhiManager()
+    with pytest.raises(CompileError):
+        m.create_siddhi_app_runtime("""
+            define table T1 (a int); define table T2 (a int);
+            from T1 join T2 on T1.a == T2.a select T1.a as a insert into O;
+        """)
+    m.shutdown()
+
+
+def test_update_or_insert_renamed_attrs():
+    # insert fallback maps positionally even when names differ
+    m, rt, _ = build("""
+        define stream S (sym string, pr float);
+        define table T (symbol string, price float);
+        from S update or insert into T set T.price = pr on T.symbol == sym;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["B", 1.5])
+    h.send(["Q", 9.0])
+    h.send(["B", 4.5])
+    rows = rt.query("from T select symbol, price")
+    assert sorted(tuple(e.data) for e in rows) == [("B", 4.5), ("Q", 9.0)]
+    m.shutdown()
