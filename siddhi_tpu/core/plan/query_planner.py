@@ -101,6 +101,10 @@ def plan_join_query(
                 if window_stage is not None:
                     raise CompileError("only one #window per join side is allowed")
                 window_stage = create_window_stage(h, sdef, resolver, app_context)
+                if getattr(window_stage, "host_mode", False):
+                    raise CompileError(
+                        f"window '{h.name}' as a join side is not supported yet"
+                    )
             else:
                 raise CompileError(f"stream function '{h.name}' on a join side is not supported")
         if window_stage is None:
@@ -303,14 +307,15 @@ def plan_query(
 
     filters = []
     window_stage = None
+    host_window = None
     batch_mode = False
     for handler in input_stream.handlers:
         if isinstance(handler, Filter):
-            if window_stage is not None:
-                raise CompileError("post-window filters land with window support (M2)")
+            if window_stage is not None or host_window is not None:
+                raise CompileError("post-window filters are not supported yet")
             filters.append(compile_condition(handler.expression, resolver))
         elif isinstance(handler, Window):
-            if window_stage is not None:
+            if window_stage is not None or host_window is not None:
                 raise CompileError("only one #window per stream is allowed")
             if partition_ctx is not None:
                 from siddhi_tpu.ops.keyed_windows import create_keyed_window_stage
@@ -321,6 +326,9 @@ def plan_query(
 
                 window_stage = create_window_stage(handler, input_def, resolver, app_context)
             batch_mode = window_stage.batch_mode
+            if getattr(window_stage, "host_mode", False):
+                host_window = window_stage
+                window_stage = None
         elif isinstance(handler, StreamFunction):
             raise CompileError(f"stream function '{handler.name}' not yet implemented")
 
@@ -356,4 +364,5 @@ def plan_query(
         partition_keyer=partition_keyer,
         carried_pk=carried_pk,
     )
+    runtime.host_window = host_window
     return runtime

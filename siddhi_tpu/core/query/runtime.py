@@ -104,6 +104,7 @@ class QueryRuntime(Receiver):
         self._win_keys = 1
         if partition_ctx is not None:
             self._win_keys = max(_pow2(partition_ctx.num_keys()), 16)
+        self.host_window = None   # map/comparator windows (ops/host_windows)
         self.rate_limiter: Optional[OutputRateLimiter] = None
         self.query_callbacks: List = []
         self.output_junction: Optional[StreamJunction] = None
@@ -172,7 +173,8 @@ class QueryRuntime(Receiver):
         this query — jit-compiled by `_make_step`, also exported raw for
         sharded execution (siddhi_tpu.parallel) and the driver's
         compile-check (`__graft_entry__.entry`)."""
-        filters = list(self.filters)
+        # host windows already applied the filters before their stage
+        filters = [] if self.host_window is not None else list(self.filters)
         sel = self.selector_plan
         win = self.window_stage
 
@@ -225,6 +227,17 @@ class QueryRuntime(Receiver):
 
     def process_batch(self, batch: HostBatch):
         with self._lock:
+            notify_host = None
+            if self.host_window is not None:
+                now_h = int(self.app_context.timestamp_generator.current_time())
+                ctx = {"xp": np, "current_time": now_h}
+                cols = batch.cols
+                valid = cols[VALID_KEY]
+                timer = cols[TYPE_KEY] == TIMER_TYPE
+                for f in self.filters:
+                    valid = valid & (np.asarray(f(cols, ctx)) | timer)
+                cols[VALID_KEY] = valid
+                batch, notify_host = self.host_window.process(batch, now_h)
             cols = batch.cols
             partitioned = self.partition_ctx is not None
             pk = None
@@ -256,6 +269,8 @@ class QueryRuntime(Receiver):
             )
             notify = self._finish_device_batch(
                 self._step, cols, f"window buffer capacity exceeded — raise {knob}")
+        if notify_host is not None:
+            notify = notify_host if notify is None else min(notify, notify_host)
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self.process_timer)
 

@@ -22,7 +22,9 @@ from typing import List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: named-window entries became {'host','data'} wrappers, queries gained
+# 'host_window'
+FORMAT_VERSION = 2
 
 
 def _to_host(tree):
@@ -54,6 +56,8 @@ class SnapshotService:
                     "sel_keys": q.selector_plan.num_keys,
                     "win_keys": q._win_keys,
                     "keyer_map": dict(q.keyer._map) if q.keyer is not None else None,
+                    "host_window": (q.host_window.snapshot()
+                                    if q.host_window is not None else None),
                 }
         tables = {}
         for tid, t in rt.tables.items():
@@ -62,7 +66,10 @@ class SnapshotService:
         windows = {}
         for wid, w in rt.named_windows.items():
             with w._lock:
-                windows[wid] = _to_host(w.state)
+                if w.host_mode:
+                    windows[wid] = {"host": True, "data": w.stage.snapshot()}
+                else:
+                    windows[wid] = {"host": False, "data": _to_host(w.state)}
         partitions = [p.keyspace.snapshot() for p in rt.partition_contexts]
         obj = {
             "version": FORMAT_VERSION,
@@ -115,6 +122,8 @@ class SnapshotService:
                 if q.keyer is not None and qsnap["keyer_map"] is not None:
                     q.keyer._map = dict(qsnap["keyer_map"])
                     q.keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
+                if q.host_window is not None and qsnap.get("host_window") is not None:
+                    q.host_window.restore(qsnap["host_window"])
                 q._step = None
                 if hasattr(q, "_steps"):
                     q._steps.clear()
@@ -132,8 +141,11 @@ class SnapshotService:
             if w is None:
                 raise ValueError(f"snapshot has unknown window '{wid}'")
             with w._lock:
-                w.state = _to_device(wsnap)
-                w._step = None
+                if wsnap.get("host"):
+                    w.stage.restore(wsnap["data"])
+                else:
+                    w.state = _to_device(wsnap["data"])
+                    w._step = None
 
 
 class PersistenceManager:

@@ -34,7 +34,8 @@ class NamedWindowRuntime(Receiver):
         resolver = SingleStreamResolver(definition, dictionary)
         self.stage = create_window_stage(definition.window, definition, resolver,
                                          app_context)
-        self.state = self.stage.init_state()
+        self.host_mode = getattr(self.stage, "host_mode", False)
+        self.state = None if self.host_mode else self.stage.init_state()
         self.out_junction = StreamJunction(definition, app_context)
         self.scheduler = None
         self._step = None
@@ -43,6 +44,8 @@ class NamedWindowRuntime(Receiver):
     def contents(self):
         """Probe surface for joins (reference WindowWindowProcessor.find)."""
         with self._lock:
+            if self.host_mode:
+                return self.stage.contents()
             return self.stage.contents(self.state)
 
     def _make_step(self):
@@ -76,18 +79,23 @@ class NamedWindowRuntime(Receiver):
     def _process(self, batch: HostBatch):
         with self._lock:
             batch.cols["__gk__"] = np.zeros(batch.capacity, np.int32)
-            if self._step is None:
-                self._step = self._make_step()
             now = np.int64(self.app_context.timestamp_generator.current_time())
-            self.state, out = self._step(self.state, batch.cols, now)
-            out_host = {k: np.asarray(v) for k, v in out.items()}
-            overflow = out_host.pop("__overflow__", None)
+            if self.host_mode:
+                out_batch, notify = self.stage.process(batch, int(now))
+                out_host = dict(out_batch.cols)
+                overflow = None
+            else:
+                if self._step is None:
+                    self._step = self._make_step()
+                self.state, out = self._step(self.state, batch.cols, now)
+                out_host = {k: np.asarray(v) for k, v in out.items()}
+                overflow = out_host.pop("__overflow__", None)
+                notify = out_host.pop("__notify__", None)
             if overflow is not None and int(overflow) > 0:
                 raise RuntimeError(
                     f"window '{self.definition.id}': buffer capacity exceeded — "
                     f"raise app_context.window_capacity before creating the runtime"
                 )
-            notify = out_host.pop("__notify__", None)
             out_host.pop("__flush__", None)
             types_wanted = {
                 "current": (CURRENT,),

@@ -1,0 +1,419 @@
+"""Host-side window stages: map/comparator-driven windows.
+
+Sort, frequent (Misra-Gries), lossyFrequent and session windows are
+key/comparator bookkeeping over small collections — per-event hash-map
+mutations with no batch parallelism to exploit, exactly the shape the
+reference implements with Java maps (``SortWindowProcessor.java:50-78``,
+``FrequentWindowProcessor.java:117-180``, ``LossyFrequentWindowProcessor``,
+``SessionWindowProcessor``). They run on the host over the decoded batch
+(the device step then fuses only the selector); throughput-critical windows
+(length/time/batch families) stay device-side.
+
+Interface: ``process(batch, now) -> (HostBatch, notify_ts|None)`` with the
+same CURRENT/EXPIRED emission contracts as the device stages.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY, CompileError
+
+CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
+
+
+def _row(cols: Dict[str, np.ndarray], i: int) -> dict:
+    return {k: v[i] for k, v in cols.items()}
+
+
+def _emit(rows: List[dict], col_specs: Dict[str, np.dtype]) -> "HostBatch":
+    from siddhi_tpu.core.event import HostBatch, _pad_len
+
+    n = len(rows)
+    cap = _pad_len(max(n, 1))
+    out = {k: np.zeros(cap, dt) for k, dt in col_specs.items()}
+    out[VALID_KEY] = np.zeros(cap, bool)
+    out[TYPE_KEY] = np.zeros(cap, np.int8)
+    for i, r in enumerate(rows):
+        out[VALID_KEY][i] = True
+        for k, v in r.items():
+            if k in out:
+                out[k][i] = v
+    return HostBatch(out)
+
+
+class HostWindowStage:
+    host_mode = True
+    batch_mode = False
+    needs_scheduler = False
+
+    def __init__(self, col_specs: Dict[str, np.dtype]):
+        # emission columns: data cols + ts/type/valid
+        self.col_specs = dict(col_specs)
+        self.col_specs[TS_KEY] = np.int64
+        self.col_specs[TYPE_KEY] = np.int8
+        self.col_specs[VALID_KEY] = np.bool_
+
+    def process(self, batch, now: int):
+        raise NotImplementedError
+
+    def contents(self):
+        """Numpy (cols, valid) probe surface for joins."""
+        rows = self._held_rows()
+        b = _emit(rows, self.col_specs)
+        return b.cols, b.cols[VALID_KEY]
+
+    def _held_rows(self) -> List[dict]:
+        raise NotImplementedError
+
+    # persistence hooks
+    def snapshot(self) -> dict:
+        raise NotImplementedError
+
+    def restore(self, snap: dict):
+        raise NotImplementedError
+
+
+class SortWindowStage(HostWindowStage):
+    """``sort(length, attr[, 'asc'|'desc', attr, ...])``: keeps the
+    `length` least events under the comparator; overflow evicts the
+    greatest as EXPIRED (``SortWindowProcessor.java:63-77``)."""
+
+    def __init__(self, length: int, sort_keys: List[Tuple[str, bool, bool]],
+                 col_specs, dictionary):
+        super().__init__(col_specs)
+        self.length = length
+        self.sort_keys = sort_keys  # [(col, descending, is_string)]
+        self.dictionary = dictionary
+        self._window: List[dict] = []
+
+    def _sort_window(self):
+        # stable multi-pass sort supports per-key direction for any type;
+        # string columns compare by decoded value, not dictionary id
+        for col, desc, is_str in reversed(self.sort_keys):
+            if is_str:
+                self._window.sort(
+                    key=lambda r, c=col: self.dictionary.decode(int(r[c])) or "",
+                    reverse=desc)
+            else:
+                self._window.sort(key=lambda r, c=col: r[c], reverse=desc)
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        out_rows: List[dict] = []
+        for i in np.nonzero(cols[VALID_KEY])[0]:
+            if cols[TYPE_KEY][i] != CURRENT:
+                continue
+            row = _row(cols, i)
+            self._window.append(row)
+            if len(self._window) > self.length:
+                self._sort_window()
+                evicted = dict(self._window.pop())
+                evicted[TS_KEY] = now
+                evicted[TYPE_KEY] = EXPIRED
+                out_rows.append(evicted)
+            cur = dict(row)
+            cur[TYPE_KEY] = CURRENT
+            out_rows.append(cur)
+        return _emit(out_rows, self.col_specs), None
+
+    def _held_rows(self):
+        return self._window
+
+    def snapshot(self):
+        return {"window": [dict(r) for r in self._window]}
+
+    def restore(self, snap):
+        self._window = [dict(r) for r in snap["window"]]
+
+
+class FrequentWindowStage(HostWindowStage):
+    """Misra-Gries heavy hitters (``FrequentWindowProcessor.java:117-180``):
+    keeps events of the `count` most frequent attribute combinations;
+    displaced combinations emit their last event as EXPIRED; events whose
+    new combination finds no room are dropped."""
+
+    def __init__(self, count: int, key_cols: List[str], col_specs):
+        super().__init__(col_specs)
+        self.count = count
+        self.key_cols = key_cols
+        self._events: Dict[tuple, dict] = {}
+        self._counts: Dict[tuple, int] = {}
+
+    def _key(self, row) -> tuple:
+        return tuple(row[c].item() if hasattr(row[c], "item") else row[c]
+                     for c in self.key_cols)
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        out_rows: List[dict] = []
+        for i in np.nonzero(cols[VALID_KEY])[0]:
+            if cols[TYPE_KEY][i] != CURRENT:
+                continue
+            row = _row(cols, i)
+            key = self._key(row)
+            if key in self._events:
+                self._events[key] = row
+                self._counts[key] += 1
+                cur = dict(row)
+                cur[TYPE_KEY] = CURRENT
+                out_rows.append(cur)
+            else:
+                self._events[key] = row
+                if len(self._events) > self.count:
+                    # decrement every OTHER tracked count; zeros fall out
+                    for k in list(self._counts):
+                        c = self._counts[k] - 1
+                        if c == 0:
+                            del self._counts[k]
+                            expired = dict(self._events.pop(k))
+                            expired[TS_KEY] = now
+                            expired[TYPE_KEY] = EXPIRED
+                            out_rows.append(expired)
+                        else:
+                            self._counts[k] = c
+                    if len(self._events) > self.count:
+                        del self._events[key]  # no room: drop the event
+                    else:
+                        self._counts[key] = 1
+                        cur = dict(row)
+                        cur[TYPE_KEY] = CURRENT
+                        out_rows.append(cur)
+                else:
+                    self._counts[key] = 1
+                    cur = dict(row)
+                    cur[TYPE_KEY] = CURRENT
+                    out_rows.append(cur)
+        return _emit(out_rows, self.col_specs), None
+
+    def _held_rows(self):
+        return list(self._events.values())
+
+    def snapshot(self):
+        return {"events": {k: dict(v) for k, v in self._events.items()},
+                "counts": dict(self._counts)}
+
+    def restore(self, snap):
+        self._events = {k: dict(v) for k, v in snap["events"].items()}
+        self._counts = dict(snap["counts"])
+
+
+class LossyFrequentWindowStage(HostWindowStage):
+    """Lossy counting (``LossyFrequentWindowProcessor``): emits the event
+    as CURRENT when its combination's count passes (support - error) *
+    total; per-bucket pruning drops low-frequency combinations as
+    EXPIRED."""
+
+    def __init__(self, support: float, error: float, key_cols: List[str], col_specs):
+        super().__init__(col_specs)
+        self.support = support
+        self.error = error
+        self.width = max(int(np.ceil(1.0 / error)), 1)
+        self.key_cols = key_cols
+        self._events: Dict[tuple, dict] = {}
+        self._counts: Dict[tuple, Tuple[int, int]] = {}  # key -> (count, bucket)
+        self._total = 0
+        self._bucket = 1
+
+    def _key(self, row) -> tuple:
+        return tuple(row[c].item() if hasattr(row[c], "item") else row[c]
+                     for c in self.key_cols)
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        out_rows: List[dict] = []
+        for i in np.nonzero(cols[VALID_KEY])[0]:
+            if cols[TYPE_KEY][i] != CURRENT:
+                continue
+            row = _row(cols, i)
+            self._total += 1
+            if self._total != 1:
+                self._bucket = int(np.ceil(self._total / self.width))
+            key = self._key(row)
+            if key in self._events:
+                self._events[key] = row
+                c, b = self._counts[key]
+                self._counts[key] = (c + 1, b)
+            else:
+                self._events[key] = row
+                self._counts[key] = (1, self._bucket - 1)
+            c, _b = self._counts[key]
+            if c >= (self.support - self.error) * self._total:
+                cur = dict(row)
+                cur[TYPE_KEY] = CURRENT
+                out_rows.append(cur)
+            # bucket-boundary pruning
+            if self._total % self.width == 0:
+                for k in list(self._counts):
+                    c, b = self._counts[k]
+                    if c + b <= self._bucket:
+                        del self._counts[k]
+                        expired = dict(self._events.pop(k))
+                        expired[TS_KEY] = now
+                        expired[TYPE_KEY] = EXPIRED
+                        out_rows.append(expired)
+        return _emit(out_rows, self.col_specs), None
+
+    def _held_rows(self):
+        return list(self._events.values())
+
+    def snapshot(self):
+        return {"events": {k: dict(v) for k, v in self._events.items()},
+                "counts": dict(self._counts), "total": self._total,
+                "bucket": self._bucket}
+
+    def restore(self, snap):
+        self._events = {k: dict(v) for k, v in snap["events"].items()}
+        self._counts = dict(snap["counts"])
+        self._total = snap["total"]
+        self._bucket = snap["bucket"]
+
+
+class SessionWindowStage(HostWindowStage):
+    """``session(gap[, key])``: events pass through as CURRENT and join
+    their key's open session; a session with no events for `gap` expires —
+    its events emit as one EXPIRED chunk (``SessionWindowProcessor``
+    without allowedLatency)."""
+
+    needs_scheduler = True
+
+    def __init__(self, gap_ms: int, key_col: Optional[str], col_specs):
+        super().__init__(col_specs)
+        self.gap_ms = gap_ms
+        self.key_col = key_col
+        self._sessions: Dict[object, dict] = {}  # key -> {last, rows}
+
+    def _key(self, row):
+        if self.key_col is None:
+            return ""
+        v = row[self.key_col]
+        return v.item() if hasattr(v, "item") else v
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        out_rows: List[dict] = []
+        # expire idle sessions first
+        for k in list(self._sessions):
+            s = self._sessions[k]
+            if now - s["last"] >= self.gap_ms:
+                for r in s["rows"]:
+                    expired = dict(r)
+                    expired[TS_KEY] = now
+                    expired[TYPE_KEY] = EXPIRED
+                    out_rows.append(expired)
+                del self._sessions[k]
+        for i in np.nonzero(cols[VALID_KEY])[0]:
+            if cols[TYPE_KEY][i] != CURRENT:
+                continue
+            row = _row(cols, i)
+            ts = int(cols[TS_KEY][i])
+            key = self._key(row)
+            s = self._sessions.get(key)
+            if s is not None and ts - s["last"] >= self.gap_ms:
+                for r in s["rows"]:
+                    expired = dict(r)
+                    expired[TS_KEY] = now
+                    expired[TYPE_KEY] = EXPIRED
+                    out_rows.append(expired)
+                s = None
+            if s is None:
+                s = {"last": ts, "rows": []}
+                self._sessions[key] = s
+            s["last"] = max(s["last"], ts)
+            s["rows"].append(row)
+            cur = dict(row)
+            cur[TYPE_KEY] = CURRENT
+            out_rows.append(cur)
+        notify = None
+        if self._sessions:
+            notify = min(s["last"] for s in self._sessions.values()) + self.gap_ms
+        return _emit(out_rows, self.col_specs), notify
+
+    def _held_rows(self):
+        return [r for s in self._sessions.values() for r in s["rows"]]
+
+    def snapshot(self):
+        return {"sessions": {k: {"last": s["last"], "rows": [dict(r) for r in s["rows"]]}
+                             for k, s in self._sessions.items()}}
+
+    def restore(self, snap):
+        self._sessions = {
+            k: {"last": s["last"], "rows": [dict(r) for r in s["rows"]]}
+            for k, s in snap["sessions"].items()
+        }
+
+
+def create_host_window_stage(window, input_def, resolver, app_context) -> HostWindowStage:
+    from siddhi_tpu.ops.types import dtype_of
+    from siddhi_tpu.ops.windows import _const_param
+    from siddhi_tpu.query_api.expressions import Constant, Variable
+
+    name = window.name.lower()
+    col_specs: Dict[str, np.dtype] = {}
+    for a in input_def.attributes:
+        col_specs[a.name] = dtype_of(a.type)
+        col_specs[a.name + "?"] = np.bool_
+    col_specs["__gk__"] = np.int32
+    col_specs["__pk__"] = np.int32
+
+    if name == "sort":
+        from siddhi_tpu.query_api.definitions import AttrType
+
+        length = int(_const_param(window, 0, "length"))
+        sort_keys: List[Tuple[str, bool, bool]] = []
+        i = 1
+        params = window.parameters
+        while i < len(params):
+            p = params[i]
+            if not isinstance(p, Variable):
+                raise CompileError("sort window expects attribute parameters")
+            attr = input_def.attribute(p.attribute_name)
+            desc = False
+            if i + 1 < len(params) and isinstance(params[i + 1], Constant) \
+                    and str(params[i + 1].value).lower() in ("asc", "desc"):
+                desc = str(params[i + 1].value).lower() == "desc"
+                i += 1
+            sort_keys.append((attr.name, desc, attr.type == AttrType.STRING))
+            i += 1
+        if not sort_keys:
+            raise CompileError("sort window needs at least one sort attribute")
+        return SortWindowStage(length, sort_keys, col_specs, resolver.dictionary)
+
+    if name == "frequent":
+        count = int(_const_param(window, 0, "count"))
+        key_cols = [input_def.attribute(p.attribute_name).name
+                    for p in window.parameters[1:]]
+        if not key_cols:
+            key_cols = [a.name for a in input_def.attributes]
+        return FrequentWindowStage(count, key_cols, col_specs)
+
+    if name == "lossyfrequent":
+        support = float(_const_param(window, 0, "support"))
+        error = support / 10.0
+        if len(window.parameters) >= 2 and isinstance(window.parameters[1], Constant) \
+                and not isinstance(window.parameters[1].value, str):
+            error = float(window.parameters[1].value)
+            rest = window.parameters[2:]
+        else:
+            rest = window.parameters[1:]
+        key_cols = [input_def.attribute(p.attribute_name).name
+                    for p in rest if isinstance(p, Variable)]
+        if not key_cols:
+            key_cols = [a.name for a in input_def.attributes]
+        return LossyFrequentWindowStage(support, error, key_cols, col_specs)
+
+    if name == "session":
+        gap = int(_const_param(window, 0, "gap"))
+        key_col = None
+        if len(window.parameters) >= 2:
+            p = window.parameters[1]
+            if isinstance(p, Variable):
+                key_col = input_def.attribute(p.attribute_name).name
+            else:
+                raise CompileError(
+                    "session allowedLatency is not supported yet")
+        return SessionWindowStage(gap, key_col, col_specs)
+
+    raise CompileError(f"host window '{window.name}' is not implemented")

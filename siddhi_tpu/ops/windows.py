@@ -604,6 +604,316 @@ class BatchWindowStage(WindowStage):
         return dict(state["prev"]), valid
 
 
+# -------------------------------------------------------------- timeLength
+
+class TimeLengthWindowStage(WindowStage):
+    """Sliding window bounded by time AND count
+    (``TimeLengthWindowProcessor``): entries older than t drain on timers;
+    when the window holds `length` live entries, each arrival evicts the
+    oldest. Both evictions are FIFO-prefix drops, so one ring of exactly
+    ``length`` slots suffices. Within-batch time expiry (playback jumps
+    inside one chunk) is deferred to the immediately-scheduled timer.
+    """
+
+    needs_scheduler = True
+
+    def __init__(self, time_ms: int, length: int, col_specs: Dict[str, np.dtype]):
+        if length <= 0:
+            raise CompileError("timeLength window needs a positive length")
+        self.time_ms = time_ms
+        self.length = length
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        L = self.length
+        buf = {k: jnp.zeros((L,), dt) for k, dt in self.col_specs.items()}
+        return {"buf": buf, "total": jnp.int64(0), "expired_upto": jnp.int64(0)}
+
+    def apply(self, state, cols, ctx):
+        L = self.length
+        t = jnp.int64(self.time_ms)
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        now = jnp.int64(ctx["current_time"])
+        STRIDE = jnp.int64(L + B + 4)
+
+        total0 = state["total"]
+        exp0 = state["expired_upto"]
+
+        # ---- time drain (FIFO prefix), before the batch
+        j = jnp.arange(L, dtype=jnp.int64)
+        fifo_seq = exp0 + j
+        occupied = fifo_seq < total0
+        fifo_slot = (fifo_seq % L).astype(jnp.int32)
+        ring_ts = state["buf"][TS_KEY][fifo_slot]
+        time_exp = occupied & (ring_ts + t <= now)
+        n_time = jnp.sum(time_exp.astype(jnp.int64))
+        exp1 = exp0 + n_time
+        live0 = total0 - exp1
+
+        # ---- length evictions per insert: insert rank r evicts FIFO entry
+        # j = live0 + r - L (when >= 0); entry seq exp1 + j
+        rank, n_ins = _insert_ranks(valid_cur)
+        n_len = jnp.clip(live0 + n_ins - L, 0, n_ins)
+        rank_to_row = jnp.zeros((B,), jnp.int32).at[
+            jnp.where(valid_cur, rank, B).astype(jnp.int32)
+        ].set(jnp.arange(B, dtype=jnp.int32), mode="drop")
+
+        lev_seq = exp1 + j                       # candidate eviction seqs
+        lev = (j < n_len) & (lev_seq < total0 + n_ins)
+        from_batch = lev_seq >= total0
+        batch_row = rank_to_row[jnp.clip(lev_seq - total0, 0, B - 1).astype(jnp.int32)]
+        lev_slot = (lev_seq % L).astype(jnp.int32)
+        # eviction j precedes the row of insert rank r = L - live0 + j
+        lev_rank = jnp.clip(L - live0 + j, 0, B - 1)
+        lev_row = rank_to_row[lev_rank.astype(jnp.int32)].astype(jnp.int64)
+
+        time_rows = {k: state["buf"][k][fifo_slot] for k in state["buf"]}
+        time_rows[TS_KEY] = jnp.where(time_exp, now, time_rows[TS_KEY])
+        lev_rows = {}
+        for k in state["buf"]:
+            ring_v = state["buf"][k][lev_slot]
+            lev_rows[k] = jnp.where(from_batch, cols[k][batch_row], ring_v)
+        lev_rows[TS_KEY] = jnp.broadcast_to(now, (L,))
+
+        idx = jnp.arange(B, dtype=jnp.int64)
+        parts = [
+            (time_rows, jnp.full((L,), EXPIRED, jnp.int8), time_exp, j),
+            (lev_rows, jnp.full((L,), EXPIRED, jnp.int8), lev, lev_row * STRIDE + L + j),
+            ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur,
+             idx * STRIDE + L + B + 2),
+        ]
+        out, _ = _order_emit(parts)
+
+        # ---- ring update: write the last min(L, n_ins) inserts
+        seq = total0 + rank
+        write = valid_cur & (rank >= n_ins - L)
+        slot = jnp.where(write, (seq % L).astype(jnp.int32), L)
+        new_buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop")
+                   for k in state["buf"]}
+        new_total = total0 + n_ins
+        new_exp = exp1 + n_len
+
+        fifo2 = new_exp + j
+        occ2 = fifo2 < new_total
+        ts2 = new_buf[TS_KEY][(fifo2 % L).astype(jnp.int32)]
+        nxt = jnp.min(jnp.where(occ2, ts2 + t, _BIG))
+        out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt, jnp.int64(-1))
+        return {"buf": new_buf, "total": new_total, "expired_upto": new_exp}, out
+
+    def contents(self, state):
+        L = self.length
+        total = state["total"]
+        j = jnp.arange(L, dtype=jnp.int64)
+        s_j = total - 1 - ((total - 1 - j) % L)
+        valid = (total > 0) & (s_j >= 0) & (s_j >= state["expired_upto"])
+        return dict(state["buf"]), valid
+
+
+# ------------------------------------------------------------------- delay
+
+class DelayWindowStage(WindowStage):
+    """``delay(t)``: events are held for t, then released downstream as
+    CURRENT with the release time as timestamp
+    (``DelayWindowProcessor.java:135-143``). Nothing is emitted on arrival."""
+
+    needs_scheduler = True
+
+    def __init__(self, delay_ms: int, col_specs: Dict[str, np.dtype], capacity: int):
+        self.delay_ms = delay_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        buf = {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}
+        return {"buf": buf, "total": jnp.int64(0), "released_upto": jnp.int64(0)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        d = jnp.int64(self.delay_ms)
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        now = jnp.int64(ctx["current_time"])
+
+        total0 = state["total"]
+        rel0 = state["released_upto"]
+        j = jnp.arange(Wc, dtype=jnp.int64)
+        fifo_seq = rel0 + j
+        occupied = fifo_seq < total0
+        fifo_slot = (fifo_seq % Wc).astype(jnp.int32)
+        ring_ts = state["buf"][TS_KEY][fifo_slot]
+        release = occupied & (ring_ts + d <= now)
+        n_rel = jnp.sum(release.astype(jnp.int64))
+
+        rel_rows = {k: state["buf"][k][fifo_slot] for k in state["buf"]}
+        rel_rows[TS_KEY] = jnp.where(release, now, rel_rows[TS_KEY])
+        out, _ = _order_emit([
+            (rel_rows, jnp.full((Wc,), CURRENT, jnp.int8), release, j),
+        ])
+
+        rank, n_ins = _insert_ranks(valid_cur)
+        seq = total0 + rank
+        write = valid_cur & (rank >= n_ins - Wc)
+        slot = jnp.where(write, (seq % Wc).astype(jnp.int32), Wc)
+        new_buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop")
+                   for k in state["buf"]}
+        new_total = total0 + n_ins
+        new_rel = rel0 + n_rel
+
+        out[OVERFLOW_KEY] = (new_total - new_rel > Wc).astype(jnp.int32)
+        fifo2 = new_rel + j
+        occ2 = fifo2 < new_total
+        ts2 = new_buf[TS_KEY][(fifo2 % Wc).astype(jnp.int32)]
+        nxt = jnp.min(jnp.where(occ2, ts2 + d, _BIG))
+        out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt, jnp.int64(-1))
+        return {"buf": new_buf, "total": new_total, "released_upto": new_rel}, out
+
+
+# -------------------------------------------------------- externalTimeBatch
+
+class ExternalTimeBatchWindowStage(WindowStage):
+    """Tumbling batches by an event-time attribute
+    (``ExternalTimeBatchWindowProcessor``): when an event's time crosses the
+    window end, the accumulated batch flushes as CURRENT (previous batch as
+    EXPIRED + RESET) and the window slides by whole multiples of t. Several
+    flushes can happen inside one chunk."""
+
+    batch_mode = True
+
+    def __init__(self, ts_fn, time_ms: int, col_specs: Dict[str, np.dtype],
+                 capacity: int, start_time: int = -1):
+        self.ts_fn = ts_fn          # compiled expr for the time attribute
+        self.time_ms = time_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+        self.start_time = start_time
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        zero = lambda: {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}  # noqa: E731
+        return {"cur": zero(), "prev": zero(),
+                "count": jnp.int64(0), "prev_count": jnp.int64(0),
+                "end": jnp.int64(-1)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        t = jnp.int64(self.time_ms)
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+
+        tsv, _m = self.ts_fn(cols, ctx)
+        tsv = jnp.asarray(tsv).astype(jnp.int64)
+        tsv = jnp.broadcast_to(tsv, (B,))
+
+        # window end: first event initializes it (startTime anchors the grid)
+        first_ts = jnp.max(jnp.where(
+            valid_cur & (jnp.cumsum(valid_cur) == 1), tsv, jnp.int64(0)))
+        if self.start_time >= 0:
+            st = jnp.int64(self.start_time)
+            init_end = first_ts - jnp.maximum(first_ts - st, 0) % t + t
+        else:
+            init_end = first_ts + t
+        end0 = jnp.where(state["end"] < 0, init_end, state["end"])
+
+        # window index per row relative to end0 (0 = accumulating window),
+        # monotone-ized against out-of-order timestamps
+        raw_b = jnp.where(tsv >= end0, (tsv - end0) // t + 1, 0)
+        b_i = lax.cummax(jnp.where(valid_cur, raw_b, jnp.int64(0)))
+        n_flush = b_i[B - 1]
+
+        count0 = state["count"]
+        rank, n_ins = _insert_ranks(valid_cur)
+        pos = rank  # arrival position among the batch's inserts
+
+        # flush-k span layout (k >= 1): expired [0, Wc+B), RESET at Wc+B,
+        # currents [Wc+B+1, 2Wc+2B+1)
+        S = jnp.int64(2 * Wc + 2 * B + 2)
+        RESET_OFF = jnp.int64(Wc + B)
+        CUR_OFF = jnp.int64(Wc + B + 1)
+        lead = jnp.arange(Wc, dtype=jnp.int64)
+        parts = []
+        # prev state buffer expires at flush 1
+        prev_valid = (lead < state["prev_count"]) & (n_flush > 0)
+        prev_rows = {k: state["prev"][k][lead.astype(jnp.int32)] for k in state["prev"]}
+        prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+        parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid, S + lead))
+        # carry-over cur buffer (window 0): CURRENT at flush 1, EXPIRED at flush 2
+        carry_valid = (lead < count0) & (n_flush > 0)
+        carry_rows = {k: state["cur"][k][lead.astype(jnp.int32)] for k in state["cur"]}
+        parts.append((carry_rows, jnp.full((Wc,), CURRENT, jnp.int8), carry_valid,
+                      S + CUR_OFF + lead))
+        carry_exp_valid = (lead < count0) & (n_flush > 1)
+        carry_exp = dict(carry_rows)
+        carry_exp[TS_KEY] = jnp.where(carry_exp_valid, now, carry_exp[TS_KEY])
+        parts.append((carry_exp, jnp.full((Wc,), EXPIRED, jnp.int8), carry_exp_valid,
+                      2 * S + lead))
+        # batch rows of window k: CURRENT at flush k+1, EXPIRED at flush k+2
+        cur_valid = valid_cur & (b_i < n_flush)
+        parts.append(({k: cols[k] for k in keys}, jnp.full((B,), CURRENT, jnp.int8),
+                      cur_valid, (b_i + 1) * S + CUR_OFF + Wc + pos))
+        bexp_valid = valid_cur & (b_i + 1 < n_flush)
+        bexp = {k: cols[k] for k in keys}
+        bexp[TS_KEY] = jnp.where(bexp_valid, now, cols[TS_KEY])
+        parts.append((bexp, jnp.full((B,), EXPIRED, jnp.int8), bexp_valid,
+                      (b_i + 2) * S + Wc + pos))
+        # one RESET per flush, between that flush's expired and currents
+        n_reset_cap = B + 2
+        ridx = jnp.arange(n_reset_cap, dtype=jnp.int64)
+        reset_valid = (ridx >= 1) & (ridx <= n_flush)
+        reset_rows = _zero_rows(cols, n_reset_cap)
+        reset_rows[TS_KEY] = jnp.where(reset_valid, now, jnp.int64(0))
+        parts.append((reset_rows, jnp.full((n_reset_cap,), RESET, jnp.int8),
+                      reset_valid, ridx * S + RESET_OFF))
+
+        out, okeys = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.where(okeys == _BIG, 0, okeys // S).astype(jnp.int32)
+
+        # ---- state update
+        keep_old = n_flush == 0
+        is_rem = valid_cur & (b_i == n_flush)          # open window rows
+        rem_rank = jnp.cumsum(is_rem.astype(jnp.int64)) - 1
+        base_cnt = jnp.where(keep_old, count0, 0)
+        slot = jnp.where(is_rem, (base_cnt + rem_rank).astype(jnp.int32), Wc)
+        new_cur = {}
+        for k in state["cur"]:
+            base = jnp.where(keep_old, state["cur"][k], jnp.zeros_like(state["cur"][k]))
+            new_cur[k] = base.at[slot].set(cols[k], mode="drop")
+        n_rem = jnp.sum(is_rem.astype(jnp.int64))
+        new_count = base_cnt + n_rem
+
+        # prev <- window n_flush-1 (carry buffer if n_flush == 1 and no batch
+        # rows in window 0... both can contribute: carry + batch B==0 rows)
+        in_last = valid_cur & (b_i == n_flush - 1) & (n_flush > 0)
+        last_rank = jnp.cumsum(in_last.astype(jnp.int64)) - 1
+        carry_in_last = (lead < count0) & (n_flush == 1)
+        pslot_carry = jnp.where(carry_in_last, lead.astype(jnp.int32), Wc)
+        n_carry_last = jnp.where(n_flush == 1, count0, 0)
+        pslot_batch = jnp.where(in_last, (n_carry_last + last_rank).astype(jnp.int32), Wc)
+        new_prev = {}
+        for k in state["prev"]:
+            base = jnp.where(n_flush > 0, jnp.zeros_like(state["prev"][k]), state["prev"][k])
+            base = base.at[pslot_carry].set(state["cur"][k], mode="drop")
+            base = base.at[pslot_batch].set(cols[k], mode="drop")
+            new_prev[k] = base
+        n_last = jnp.sum(in_last.astype(jnp.int64)) + n_carry_last
+        new_prev_count = jnp.where(n_flush > 0, n_last, state["prev_count"])
+
+        any_first = jnp.any(valid_cur)
+        new_end = jnp.where(state["end"] < 0,
+                            jnp.where(any_first, end0 + n_flush * t, jnp.int64(-1)),
+                            end0 + n_flush * t)
+        out[OVERFLOW_KEY] = ((new_count > Wc) | (new_prev_count > Wc)).astype(jnp.int32)
+        return {"cur": new_cur, "prev": new_prev, "count": new_count,
+                "prev_count": new_prev_count, "end": new_end}, out
+
+    def contents(self, state):
+        valid = jnp.arange(self.capacity, dtype=jnp.int64) < state["count"]
+        return dict(state["cur"]), valid
+
+
 # ----------------------------------------------------------------- factory
 
 def window_col_specs(input_def, extra: Tuple[str, ...] = ()) -> Dict[str, np.dtype]:
@@ -651,4 +961,31 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
                                     capacity, start_time=start_time)
     if name == "batch":
         return BatchWindowStage(col_specs, capacity)
+    if name == "timelength":
+        return TimeLengthWindowStage(int(_const_param(window, 0, "time")),
+                                     int(_const_param(window, 1, "length")), col_specs)
+    if name == "delay":
+        return DelayWindowStage(int(_const_param(window, 0, "delay")), col_specs, capacity)
+    if name == "externaltimebatch":
+        # externalTimeBatch(tsAttr, time[, startTime[, timeout]])
+        from siddhi_tpu.ops.expressions import compile_expr
+
+        ts_fn, _t = compile_expr(window.parameters[0], resolver)
+        start_time = -1
+        if len(window.parameters) >= 3:
+            p = window.parameters[2]
+            if not isinstance(p, (Constant, TimeConstant)):
+                raise CompileError(
+                    "externalTimeBatch startTime must be a constant")
+            start_time = int(p.value)
+        if len(window.parameters) >= 4:
+            raise CompileError(
+                "externalTimeBatch timeout parameter is not supported yet")
+        return ExternalTimeBatchWindowStage(
+            ts_fn, int(_const_param(window, 1, "time")), col_specs, capacity,
+            start_time=start_time)
+    if name in ("sort", "frequent", "lossyfrequent", "session"):
+        from siddhi_tpu.ops.host_windows import create_host_window_stage
+
+        return create_host_window_stage(window, input_def, resolver, app_context)
     raise CompileError(f"window '{window.name}' is not implemented yet")
