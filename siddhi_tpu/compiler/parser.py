@@ -837,8 +837,14 @@ class Parser:
             if self.accept_kw("on"):
                 store.on_condition = self.parse_expression()
             if self.accept_kw("within"):
-                store.within = (self.parse_time_constant()
-                                if self.at_time_constant() else self.parse_expression())
+                first = (self.parse_time_constant()
+                         if self.at_time_constant() else self.parse_expression())
+                if self.accept_op(","):
+                    second = (self.parse_time_constant()
+                              if self.at_time_constant() else self.parse_expression())
+                    store.within = (first, second)  # start, end
+                else:
+                    store.within = first
                 if self.accept_kw("per"):
                     store.per = self.parse_expression()
             q.input_store = store

@@ -1,0 +1,3 @@
+from siddhi_tpu.core.aggregation.incremental import IncrementalAggregationRuntime
+
+__all__ = ["IncrementalAggregationRuntime"]

@@ -83,6 +83,16 @@ class SiddhiAppRuntime:
         self.app_context.tables = self.tables
         self.app_context.named_windows = self.named_windows
 
+        # incremental aggregations (reference AggregationParser/-Runtime)
+        from siddhi_tpu.core.aggregation import IncrementalAggregationRuntime
+
+        self.aggregations: Dict[str, IncrementalAggregationRuntime] = {}
+        for aid, adef in siddhi_app.aggregation_definitions.items():
+            agg = IncrementalAggregationRuntime(
+                adef, self.app_context, dictionary, self.stream_definitions)
+            self.junctions[agg.input_stream_id].subscribe(agg)
+            self.aggregations[aid] = agg
+
         self.trigger_runtimes: List[TriggerRuntime] = []
         for tid, tdef in siddhi_app.trigger_definitions.items():
             sdef = StreamDefinition(

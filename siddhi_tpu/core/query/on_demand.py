@@ -29,6 +29,62 @@ from siddhi_tpu.ops.expressions import (
 from siddhi_tpu.query_api.execution import OnDemandQuery, ReturnStream
 
 
+def _aggregation_contents(agg, oq: OnDemandQuery, dictionary):
+    """Synthesize the stitched per-duration rows of an incremental
+    aggregation as a columnar batch (reference OnDemandQuery `within ...
+    per ...` against an aggregation)."""
+    from siddhi_tpu.core.aggregation.incremental import parse_duration_name
+    from siddhi_tpu.ops.types import dtype_of
+    from siddhi_tpu.query_api.definitions import AttrType
+    from siddhi_tpu.query_api.expressions import Constant, TimeConstant
+
+    store = oq.input_store
+    if store.per is None:
+        raise CompileError(
+            f"aggregation '{agg.definition.id}' queries need `per '<duration>'`")
+    if not isinstance(store.per, Constant) or not isinstance(store.per.value, str):
+        raise CompileError("`per` must be a duration string constant")
+    duration = parse_duration_name(store.per.value)
+
+    within = None
+    w = store.within
+    if w is not None:
+        def _ms(x):
+            if isinstance(x, (Constant, TimeConstant)) and not isinstance(
+                getattr(x, "value", None), str
+            ):
+                return int(x.value)
+            raise CompileError(
+                "within bounds must be millisecond epoch constants "
+                "(string date patterns are not supported yet)")
+
+        if isinstance(w, tuple):
+            within = (_ms(w[0]), _ms(w[1]))
+        else:
+            raise CompileError("within needs `start, end` bounds for aggregations")
+
+    definition = agg.output_definition()
+    rows = agg.rows(duration, within)
+    n = len(rows)
+    cap = max(n, 1)
+    cols = {}
+    for pos, attr in enumerate(definition.attributes):
+        dt = dtype_of(attr.type)
+        arr = np.zeros(cap, dt)
+        mask = np.zeros(cap, bool)
+        for i, r in enumerate(rows):
+            v = r[pos]
+            if v is None:
+                mask[i] = True
+            else:
+                arr[i] = v
+        cols[attr.name] = jnp.asarray(arr)
+        cols[attr.name + "?"] = jnp.asarray(mask)
+    cols[TS_KEY] = cols[definition.attributes[0].name]  # AGG_TIMESTAMP
+    valid = jnp.asarray(np.arange(cap) < n)
+    return definition, cols, valid
+
+
 def run_on_demand_query(source: str, app_runtime) -> List[Event]:
     oq: OnDemandQuery = SiddhiCompiler.parse_on_demand_query(source)
     store_id = oq.input_store.store_id
@@ -36,17 +92,17 @@ def run_on_demand_query(source: str, app_runtime) -> List[Event]:
 
     table = app_runtime.tables.get(store_id)
     window = app_runtime.named_windows.get(store_id)
+    agg = app_runtime.aggregations.get(store_id)
     if table is not None:
         definition = table.definition
         cols, valid = table.contents()
     elif window is not None:
         definition = window.definition
         cols, valid = window.contents()
+    elif agg is not None:
+        definition, cols, valid = _aggregation_contents(agg, oq, dictionary)
     else:
-        raise CompileError(
-            f"'{store_id}' is not a defined table or window (aggregation store "
-            f"queries land with incremental aggregation)"
-        )
+        raise CompileError(f"'{store_id}' is not a defined table/window/aggregation")
 
     if oq.type != "find" or not isinstance(oq.output_stream, (ReturnStream, type(None))):
         raise CompileError(

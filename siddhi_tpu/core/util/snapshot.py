@@ -71,6 +71,7 @@ class SnapshotService:
                 else:
                     windows[wid] = {"host": False, "data": _to_host(w.state)}
         partitions = [p.keyspace.snapshot() for p in rt.partition_contexts]
+        aggregations = {aid: a.snapshot() for aid, a in rt.aggregations.items()}
         obj = {
             "version": FORMAT_VERSION,
             "app": rt.name,
@@ -79,6 +80,7 @@ class SnapshotService:
             "tables": tables,
             "windows": windows,
             "partitions": partitions,
+            "aggregations": aggregations,
         }
         return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
 
@@ -135,6 +137,12 @@ class SnapshotService:
             with t._lock:
                 t.state = _to_device(tsnap["state"])
                 t.capacity = tsnap["capacity"]
+
+        for aid, asnap in obj.get("aggregations", {}).items():
+            a = rt.aggregations.get(aid)
+            if a is None:
+                raise ValueError(f"snapshot has unknown aggregation '{aid}'")
+            a.restore(asnap)
 
         for wid, wsnap in obj["windows"].items():
             w = rt.named_windows.get(wid)

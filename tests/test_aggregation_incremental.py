@@ -1,0 +1,86 @@
+"""Incremental aggregation tests — modeled on reference
+``aggregation/AggregationTestCase`` patterns (define aggregation, on-demand
+within/per queries)."""
+
+from siddhi_tpu import SiddhiManager
+
+
+APP = """
+    define stream TradeStream (symbol string, price double, volume long, ts long);
+    define aggregation TradeAgg
+    from TradeStream
+    select symbol, sum(price) as total, avg(price) as avgPrice, count() as n
+    group by symbol
+    aggregate by ts every sec ... year;
+"""
+
+
+def _mk():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    h = rt.get_input_handler("TradeStream")
+    # two seconds worth of trades, two symbols
+    h.send(["A", 10.0, 1, 1000])
+    h.send(["A", 20.0, 1, 1500])
+    h.send(["B", 5.0, 1, 1700])
+    h.send(["A", 40.0, 1, 2200])
+    return m, rt
+
+
+def test_seconds_granularity():
+    m, rt = _mk()
+    rows = rt.query(
+        "from TradeAgg within 0L, 100000L per 'seconds' "
+        "select AGG_TIMESTAMP, symbol, total, n")
+    got = sorted(tuple(e.data) for e in rows)
+    assert got == [
+        (1000, "A", 30.0, 2),
+        (1000, "B", 5.0, 1),
+        (2000, "A", 40.0, 1),
+    ]
+    m.shutdown()
+
+
+def test_coarser_granularity_and_avg():
+    m, rt = _mk()
+    rows = rt.query(
+        "from TradeAgg within 0L, 100000L per 'hours' "
+        "select symbol, total, avgPrice, n")
+    got = sorted(tuple(e.data) for e in rows)
+    assert got == [("A", 70.0, 70.0 / 3, 3), ("B", 5.0, 5.0, 1)]
+    m.shutdown()
+
+
+def test_within_filters_buckets():
+    m, rt = _mk()
+    rows = rt.query(
+        "from TradeAgg within 2000L, 3000L per 'seconds' select symbol, total")
+    got = [tuple(e.data) for e in rows]
+    assert got == [("A", 40.0)]
+    m.shutdown()
+
+
+def test_on_demand_condition_and_aggregation():
+    m, rt = _mk()
+    rows = rt.query(
+        "from TradeAgg on symbol == 'A' within 0L, 100000L per 'seconds' "
+        "select sum(total) as grand")
+    assert rows[-1].data == [70.0]
+    m.shutdown()
+
+
+def test_aggregation_snapshot_roundtrip():
+    m, rt = _mk()
+    snap = rt.snapshot()
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(APP)
+    rt2.start()
+    rt2.restore(snap)
+    h2 = rt2.get_input_handler("TradeStream")
+    h2.send(["A", 100.0, 1, 2500])
+    rows = rt2.query(
+        "from TradeAgg within 0L, 100000L per 'years' select symbol, total")
+    got = sorted(tuple(e.data) for e in rows)
+    assert got == [("A", 170.0), ("B", 5.0)]
+    m.shutdown()
+    m2.shutdown()
