@@ -82,7 +82,9 @@ def bucket_starts(ts_ms: np.ndarray, duration: Duration) -> np.ndarray:
 
 class _BaseSpec:
     """One base accumulator column (reference BaseIncrementalValueStore
-    fields): kind in sum/count/min/max; `out` names the stored column."""
+    fields): kind in sum/count/min/max; `out` names the stored column.
+    ``arg_fn`` supplies both the value and the null mask — null rows leave
+    the base untouched (reference incremental aggregators skip nulls)."""
 
     def __init__(self, kind: str, arg_fn, out: str, out_type: AttrType):
         self.kind = kind
@@ -91,6 +93,10 @@ class _BaseSpec:
         self.out_type = out_type
 
     def fold(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
         if self.kind in ("sum", "count"):
             return a + b
         return min(a, b) if self.kind == "min" else max(a, b)
@@ -179,7 +185,10 @@ class IncrementalAggregationRuntime(Receiver):
                 self.outputs.append(_OutSpec(name, "count", [base], AttrType.LONG))
             elif kind == "avg":
                 bs = self._base(f"sum@{name}", arg_fn, AttrType.DOUBLE)
-                bc = self._base("count", None, AttrType.LONG)
+                # avg counts only non-null argument rows, so its count base
+                # carries the argument (for the null mask), unlike count()
+                bc = self._base(f"cnt@{name}", arg_fn, AttrType.LONG,
+                                kind="count")
                 self.outputs.append(_OutSpec(name, "avg", [bs, bc], AttrType.DOUBLE))
             elif kind == "sum":
                 t = AttrType.LONG if arg_t in (AttrType.INT, AttrType.LONG) else AttrType.DOUBLE
@@ -195,9 +204,10 @@ class IncrementalAggregationRuntime(Receiver):
             d: {} for d in self.durations
         }
 
-    def _base(self, key: str, arg_fn, out_type) -> str:
+    def _base(self, key: str, arg_fn, out_type, kind: Optional[str] = None) -> str:
         if key not in self.bases:
-            kind = key.split("@")[0]
+            if kind is None:
+                kind = key.split("@")[0]
             self.bases[key] = _BaseSpec(kind, arg_fn, key, out_type)
         return key
 
@@ -222,12 +232,19 @@ class IncrementalAggregationRuntime(Receiver):
             v, _m = fn(cols, ctx)
             groups.append(np.broadcast_to(np.asarray(v), valid.shape))
         base_vals = {}
+        base_null = {}
         for key, spec in self.bases.items():
             if spec.arg_fn is None:
                 base_vals[key] = np.ones(valid.shape, np.int64)
+                base_null[key] = None
             else:
-                v, _m = spec.arg_fn(cols, ctx)
-                base_vals[key] = np.broadcast_to(np.asarray(v), valid.shape)
+                v, m = spec.arg_fn(cols, ctx)
+                if spec.kind == "count":
+                    base_vals[key] = np.ones(valid.shape, np.int64)
+                else:
+                    base_vals[key] = np.broadcast_to(np.asarray(v), valid.shape)
+                base_null[key] = (np.broadcast_to(np.asarray(m), valid.shape)
+                                  if m is not None else None)
 
         base_keys = list(self.bases)
         with self._lock:
@@ -239,13 +256,13 @@ class IncrementalAggregationRuntime(Receiver):
                     g = tuple(x[i].item() for x in groups)
                     slot = dstore.setdefault(b, {}).get(g)
                     if slot is None:
-                        dstore[b][g] = [
-                            base_vals[k][i].item() for k in base_keys
-                        ]
-                    else:
-                        for j, k in enumerate(base_keys):
-                            slot[j] = self.bases[k].fold(slot[j],
-                                                         base_vals[k][i].item())
+                        slot = dstore[b][g] = [None] * len(base_keys)
+                    for j, k in enumerate(base_keys):
+                        nm = base_null[k]
+                        if nm is not None and nm[i]:
+                            continue  # null arg leaves the base untouched
+                        slot[j] = self.bases[k].fold(slot[j],
+                                                     base_vals[k][i].item())
 
     # -------------------------------------------------------------- query
 
@@ -285,10 +302,12 @@ class IncrementalAggregationRuntime(Receiver):
                             gi = [a.name for a in self.group_attrs].index(o.bases[0])
                             row.append(g[gi])
                         elif o.kind == "avg":
-                            c = by_key[o.bases[1]]
-                            row.append(by_key[o.bases[0]] / c if c else None)
+                            s, c = by_key[o.bases[0]], by_key[o.bases[1]]
+                            row.append(s / c if (c and s is not None) else None)
+                        elif o.kind == "count":
+                            row.append(by_key[o.bases[0]] or 0)
                         else:
-                            row.append(by_key[o.bases[0]])
+                            row.append(by_key[o.bases[0]])  # None -> null output
                     onames = {o.name for o in self.outputs}
                     for gi, a in enumerate(self.group_attrs):
                         if a.name not in onames:
@@ -301,6 +320,7 @@ class IncrementalAggregationRuntime(Receiver):
     def snapshot(self) -> dict:
         with self._lock:
             return {
+                "base_keys": list(self.bases),
                 "store": {
                     d.value: {b: {g: list(v) for g, v in groups.items()}
                               for b, groups in dstore.items()}
@@ -309,10 +329,25 @@ class IncrementalAggregationRuntime(Receiver):
             }
 
     def restore(self, snap: dict):
+        # realign slot lists by base-key name so snapshots survive base
+        # layout changes (e.g. avg gaining a cnt@ base)
+        snap_keys = snap.get("base_keys")
+        cur_keys = list(self.bases)
+        if snap_keys is None or snap_keys == cur_keys:
+            remap = None
+        else:
+            remap = [snap_keys.index(k) if k in snap_keys else -1
+                     for k in cur_keys]
+
+        def realign(v):
+            if remap is None:
+                return list(v)
+            return [v[j] if j >= 0 else None for j in remap]
+
         with self._lock:
             self.store = {
                 parse_duration_name(dv): {
-                    int(b): {tuple(g) if isinstance(g, (list, tuple)) else (g,): list(v)
+                    int(b): {tuple(g) if isinstance(g, (list, tuple)) else (g,): realign(v)
                              for g, v in groups.items()}
                     for b, groups in dstore.items()
                 }

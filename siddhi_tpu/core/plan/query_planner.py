@@ -139,6 +139,14 @@ def plan_join_query(
             f"query '{query_name}': a join needs an event-driven side — both "
             f"'{left.stream_id}' and '{right.stream_id}' are tables"
         )
+    if not (left.triggers or right.triggers):
+        # e.g. `unidirectional` pointing at a table side: compiles in the
+        # reference only because tables can't trigger there either — here we
+        # reject instead of building a query that can never emit
+        raise CompileError(
+            f"query '{query_name}': no join side can trigger output — the "
+            f"unidirectional/trigger side must be a stream or named window"
+        )
     resolver = JoinResolver(left, right, dictionary)
 
     on_cond = None

@@ -15,6 +15,7 @@ the reference's JDK serialization (impl-private there too, SURVEY.md §7).
 
 from __future__ import annotations
 
+import itertools
 import pickle
 import time
 from typing import List, Optional
@@ -24,7 +25,9 @@ import numpy as np
 
 # v2: named-window entries became {'host','data'} wrappers, queries gained
 # 'host_window'
-FORMAT_VERSION = 2
+# v3: aggregation snapshots carry base_keys (avg gained per-output cnt@
+# bases; positional slot lists would misalign against v2 snapshots)
+FORMAT_VERSION = 3
 
 
 def _to_host(tree):
@@ -155,6 +158,39 @@ class SnapshotService:
                     w.state = _to_device(wsnap["data"])
                     w._step = None
 
+        self._rearm_schedulers()
+
+    def _rearm_schedulers(self):
+        """Re-arm expiry timers on restored time-driven stages (the
+        reference re-schedules on restore; without this, in live mode
+        restored held events would wait for the next arrival to expire).
+        One immediate TIMER step per stage drains anything already due and
+        re-requests the stage's next wake time via ``__notify__``."""
+        rt = self.app_runtime
+        scheduler = rt.app_context.scheduler
+        if scheduler is None:
+            return
+        now = int(rt.app_context.timestamp_generator.current_time())
+        for q in rt.query_runtimes.values():
+            if getattr(q, "_state", None) is None:
+                continue
+            sides = getattr(q, "sides", None)
+            if sides is not None:  # join runtime: per-side timer callbacks
+                for sk, side in sides.items():
+                    if side.window_stage is not None and side.window_stage.needs_scheduler:
+                        scheduler.notify_at(now, q._timer_cbs[sk])
+                continue
+            win = getattr(q, "window_stage", None)
+            host = getattr(q, "host_window", None)
+            needs = (win is not None and win.needs_scheduler) or (
+                host is not None and getattr(host, "needs_scheduler", False))
+            if needs:
+                scheduler.notify_at(now, q.process_timer)
+        for w in rt.named_windows.values():
+            stage_needs = getattr(w.stage, "needs_scheduler", False)
+            if stage_needs:
+                scheduler.notify_at(now, w.process_timer)
+
 
 class PersistenceManager:
     """persist/restore lifecycle against the configured store (reference
@@ -173,12 +209,15 @@ class PersistenceManager:
             )
         return store
 
+    _seq = itertools.count()  # ms collisions must not overwrite snapshots
+
     def persist(self) -> str:
         rt = self.app_runtime
         store = self._store()
         with rt._barrier:  # quiesce inputs (ThreadBarrier)
             data = self.snapshot_service.full_snapshot()
-        revision = f"{int(time.time() * 1000):020d}_{rt.name}"
+        # sortable: ms prefix, then a process-monotonic counter
+        revision = f"{int(time.time() * 1000):020d}_{next(self._seq):06d}_{rt.name}"
         store.save(rt.name, revision, data)
         return revision
 

@@ -84,3 +84,30 @@ def test_aggregation_snapshot_roundtrip():
     assert got == [("A", 170.0), ("B", 5.0)]
     m.shutdown()
     m2.shutdown()
+
+
+def test_null_arguments_skip_bases():
+    # null attribute values must not fold into sum/min/avg bases (reference
+    # incremental aggregators skip nulls); min must not corrupt to 0, avg
+    # must not count null rows
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream T (symbol string, price double, ts long);
+        define aggregation NullAgg
+        from T
+        select symbol, sum(price) as total, avg(price) as avgP,
+               min(price) as mn, count() as n
+        group by symbol
+        aggregate by ts every sec;
+    """)
+    h = rt.get_input_handler("T")
+    h.send(["A", 10.0, 1000])
+    h.send(["A", None, 1200])
+    h.send(["A", 30.0, 1400])
+    rows = rt.query(
+        "from NullAgg within 0L, 100000L per 'seconds' "
+        "select symbol, total, avgP, mn, n")
+    got = [tuple(e.data) for e in rows]
+    # count() counts all 3 rows; the value bases saw only 10 and 30
+    assert got == [("A", 40.0, 20.0, 10.0, 3)]
+    m.shutdown()

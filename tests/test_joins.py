@@ -129,3 +129,22 @@ def test_time_window_join_playback():
     m.shutdown()
     got = [tuple(e.data) for e in c.events]
     assert got == [("IBM", "alice", 100.0)]
+
+
+def test_table_only_trigger_side_rejected():
+    # a join whose only triggering side is a table can never emit — reject
+    # at compile time instead of building a dead query
+    import pytest
+
+    from siddhi_tpu.ops.expressions import CompileError
+
+    m = SiddhiManager()
+    with pytest.raises(CompileError, match="trigger"):
+        m.create_siddhi_app_runtime("""
+            define stream S (symbol string, price float);
+            define table T (symbol string, ref float);
+            from T unidirectional join S#window.length(10) on T.symbol == S.symbol
+            select T.symbol as symbol, S.price as price
+            insert into Out;
+        """)
+    m.shutdown()

@@ -1,0 +1,144 @@
+"""Micro-profile of the north-star bench step on the real chip.
+
+Breaks the 10k-key length(1000)->avg query step into stages and measures
+each, plus dtype micro-benchmarks (int64 vs int32 sort, f64 vs f32 scan) to
+quantify the x64-emulation tax on TPU v5e. Informs PERF.md.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def timeit(fn, *args, n=50, warmup=5):
+    import jax
+
+    for _ in range(warmup):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+
+    NUM_KEYS, WINDOW, BATCH = 10_000, 1_000, 8_192
+    APP = """
+    define stream StockStream (symbol string, price float, volume long);
+    @info(name = 'bench')
+    from StockStream#window.length({W})
+    select symbol, avg(price) as avgPrice, sum(volume) as totalVolume
+    group by symbol
+    insert into OutStream;
+    """.format(W=WINDOW)
+
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(APP)
+    rt.start()
+    q = rt.query_runtimes["bench"]
+    q.selector_plan.num_keys = 16_384
+
+    rng = np.random.default_rng(0)
+    cols = {
+        TS_KEY: np.arange(BATCH, dtype=np.int64),
+        TYPE_KEY: np.zeros(BATCH, np.int8),
+        VALID_KEY: np.ones(BATCH, bool),
+        "symbol": rng.integers(0, NUM_KEYS, BATCH, dtype=np.int64),
+        "symbol?": np.zeros(BATCH, bool),
+        "price": rng.random(BATCH, np.float32) * 100.0,
+        "price?": np.zeros(BATCH, bool),
+        "volume": rng.integers(1, 1000, BATCH, dtype=np.int64),
+        "volume?": np.zeros(BATCH, bool),
+        GK_KEY: rng.integers(0, NUM_KEYS, BATCH).astype(np.int32),
+    }
+    state = q._init_state()
+    now = np.int64(0)
+
+    # 1. full step
+    step = jax.jit(q.build_step_fn())
+    t = timeit(lambda: step(state, cols, now))
+    print(f"full step:            {t*1e3:8.3f} ms   ({BATCH/t/1e6:6.2f} M events/s)")
+
+    # 2. window stage only
+    win = q.window_stage
+    ctx = {"xp": jnp, "current_time": now}
+    wstate = state["win"]
+
+    @jax.jit
+    def win_only(ws, cols):
+        return win.apply(ws, dict(cols), {"xp": jnp, "current_time": jnp.int64(0)})
+
+    t = timeit(lambda: win_only(wstate, cols))
+    print(f"window stage only:    {t*1e3:8.3f} ms")
+
+    # 3. selector only on the window's output shape (2B rows)
+    _, wout = win_only(wstate, cols)
+    wout = {k: np.asarray(v) for k, v in wout.items()}
+    wout.pop("__notify__", None)
+    wout.pop("__overflow__", None)
+    sel = q.selector_plan
+    sstate = state["sel"]
+
+    @jax.jit
+    def sel_only(ss, cols):
+        return sel.apply(ss, dict(cols), {"xp": jnp, "current_time": jnp.int64(0)})
+
+    t = timeit(lambda: sel_only(sstate, wout))
+    print(f"selector only (2B):   {t*1e3:8.3f} ms")
+
+    # --- dtype micro-benchmarks
+    N = 2 * BATCH
+    k64 = jnp.asarray(rng.integers(0, 1 << 40, N), jnp.int64)
+    k32 = jnp.asarray(rng.integers(0, 1 << 30, N), jnp.int32)
+    s64 = jax.jit(jnp.argsort)
+    t = timeit(lambda: s64(k64)); print(f"argsort int64 [{N}]: {t*1e3:8.3f} ms")
+    t = timeit(lambda: s64(k32)); print(f"argsort int32 [{N}]: {t*1e3:8.3f} ms")
+
+    v64 = jnp.asarray(rng.random(N), jnp.float64)
+    v32 = v64.astype(jnp.float32)
+    cs = jax.jit(lambda x: jnp.cumsum(x))
+    t = timeit(lambda: cs(v64)); print(f"cumsum f64 [{N}]:    {t*1e3:8.3f} ms")
+    t = timeit(lambda: cs(v32)); print(f"cumsum f32 [{N}]:    {t*1e3:8.3f} ms")
+
+    from jax import lax
+
+    def seg_scan(blocked, vals):
+        def op(a, b):
+            ab, av = a
+            bb, bv = b
+            return (ab | bb, jnp.where(bb[:, None], bv, av + bv))
+        return lax.associative_scan(op, (blocked, vals), axis=0)
+
+    blocked = jnp.asarray(rng.random(N) < 0.3)
+    vals64 = jnp.asarray(rng.random((N, 2)), jnp.float64)
+    vals32 = vals64.astype(jnp.float32)
+    ss = jax.jit(seg_scan)
+    t = timeit(lambda: ss(blocked, vals64)); print(f"assoc_scan f64:      {t*1e3:8.3f} ms")
+    t = timeit(lambda: ss(blocked, vals32)); print(f"assoc_scan f32:      {t*1e3:8.3f} ms")
+
+    # scatter-add f32 [K]
+    K = 16_384
+    tgt64 = jnp.zeros(K, jnp.float64)
+    tgt32 = jnp.zeros(K, jnp.float32)
+    idx = jnp.asarray(cols[GK_KEY])
+    val32 = jnp.asarray(rng.random(BATCH), jnp.float32)
+    sc = jax.jit(lambda t_, i, v: t_.at[i].add(v))
+    t = timeit(lambda: sc(tgt64, idx, val32.astype(jnp.float64)))
+    print(f"scatter-add f64 [K]: {t*1e3:8.3f} ms")
+    t = timeit(lambda: sc(tgt32, idx, val32))
+    print(f"scatter-add f32 [K]: {t*1e3:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Fixed-overhead vs batch-size scaling of the north-star step."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def timeit(fn, *args, n=30, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    # 1. dispatch overhead: tiny jit op in steady loop
+    tiny = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    t = timeit(lambda: tiny(x), n=200)
+    print(f"tiny jit call:        {t*1e3:8.3f} ms")
+
+    # bigger elementwise op to estimate real compute scaling
+    big = jax.jit(lambda x: x * 2 + 1)
+    for size in (1 << 14, 1 << 20, 1 << 24):
+        xb = jnp.zeros((size,), jnp.float32)
+        t = timeit(lambda: big(xb), n=50)
+        print(f"elementwise f32 [{size:>9}]: {t*1e3:8.3f} ms")
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+
+    NUM_KEYS, WINDOW = 10_000, 1_000
+    APP = """
+    define stream StockStream (symbol string, price float, volume long);
+    @info(name = 'bench')
+    from StockStream#window.length({W})
+    select symbol, avg(price) as avgPrice, sum(volume) as totalVolume
+    group by symbol
+    insert into OutStream;
+    """.format(W=WINDOW)
+
+    rng = np.random.default_rng(0)
+    for BATCH in (8_192, 32_768, 131_072, 524_288):
+        manager = SiddhiManager()
+        rt = manager.create_siddhi_app_runtime(APP)
+        rt.start()
+        q = rt.query_runtimes["bench"]
+        q.selector_plan.num_keys = 16_384
+        cols = {
+            TS_KEY: np.arange(BATCH, dtype=np.int64),
+            TYPE_KEY: np.zeros(BATCH, np.int8),
+            VALID_KEY: np.ones(BATCH, bool),
+            "symbol": rng.integers(0, NUM_KEYS, BATCH, dtype=np.int64),
+            "symbol?": np.zeros(BATCH, bool),
+            "price": rng.random(BATCH, np.float32) * 100.0,
+            "price?": np.zeros(BATCH, bool),
+            "volume": rng.integers(1, 1000, BATCH, dtype=np.int64),
+            "volume?": np.zeros(BATCH, bool),
+            GK_KEY: rng.integers(0, NUM_KEYS, BATCH).astype(np.int32),
+        }
+        state = q._init_state()
+        step = jax.jit(q.build_step_fn())
+        now = np.int64(0)
+        t = timeit(lambda: step(state, cols, now), n=20)
+        print(f"full step B={BATCH:>7}: {t*1e3:8.3f} ms   ({BATCH/t/1e6:7.2f} M events/s)")
+
+
+if __name__ == "__main__":
+    main()
