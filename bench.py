@@ -1,33 +1,40 @@
-"""Benchmark: events/sec on the BASELINE.json north-star shape —
-10k-key length-window -> avg aggregation (config #2/#3 family).
+"""Benchmark: the BASELINE.json north-star shapes on one chip.
 
-Mirrors the reference harness pattern
-(``SimpleFilterSingleQueryPerformance.java:44-56``: pump events, count
-outputs, report events/sec per epoch). The JVM baseline cannot be run in
-this image (no Java); ``vs_baseline`` is measured against the estimate
-recorded below, derived from the reference's single-threaded per-event hot
-path (expression-interpreter + per-event window clone + string group keys;
-see BASELINE.md). Update it with a measured JVM number when available.
+Headline: events/sec on the 10k-key length(1000) -> avg/sum group-by
+aggregation (BASELINE.json config #2/#3 family), measured against the
+MEASURED single-threaded event-at-a-time native baseline
+(tools/baseline_cpp/baseline.cpp — no JVM exists in this image; the C++
+stand-in reproduces the reference hot path's per-event cost structure and
+is, if anything, faster than the JVM it proxies, so vs_baseline is
+conservative). Also measured and reported inside the same JSON line:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- e2e_events_per_sec: the same query driven through the REAL ingest path
+  (InputHandler.send_columns -> StreamJunction -> QueryRuntime ->
+  StreamCallback), not a pre-packed device loop;
+- nfa_p99_ms / nfa_events_per_sec: per-batch latency of BASELINE.json
+  config #4 (`every e1=A -> e2=B[e2.v>e1.v] within 5 sec` over 10k
+  partition keys), p99 over the measured batches.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-# Estimated JVM StreamRuntime throughput on the same query shape
-# (10k-key windowed agg, single-threaded InputHandler.send loop).
-JVM_BASELINE_EVENTS_PER_SEC = 1.0e6
+# Measured on this host: tools/baseline_cpp/baseline.cpp, g++ -O2, 20M
+# events (single-threaded event-at-a-time engine with the reference's
+# per-event cost structure). See BASELINE.md.
+MEASURED_BASELINE_EPS = 8.5e6
 
 NUM_KEYS = 10_000
 WINDOW = 1_000
-BATCH = 8_192
-WARMUP_BATCHES = 3
-MEASURE_SECONDS = 10.0
+BATCH = int(os.environ.get("BENCH_BATCH", 65_536))
+MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", 10.0))
 
 _APP = """
 define stream StockStream (symbol string, price float, volume long);
@@ -39,11 +46,12 @@ insert into OutStream;
 """.format(W=WINDOW)
 
 
-def main():
+def bench_device():
+    """Device-path throughput: pre-staged columnar batches through the
+    fused query step (the selector/keyer warmed to full key capacity)."""
     import jax
 
     from siddhi_tpu import SiddhiManager
-    from siddhi_tpu.core.event import HostBatch
     from siddhi_tpu.core.plan.selector_plan import GK_KEY
     from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
 
@@ -51,31 +59,31 @@ def main():
     rt = manager.create_siddhi_app_runtime(_APP)
     rt.start()
     q = rt.query_runtimes["bench"]
-    q.selector_plan.num_keys = 16_384  # >= NUM_KEYS, pow2
+    q.selector_plan.num_keys = 16_384  # >= NUM_KEYS, pow2: no growth re-jits
 
     rng = np.random.default_rng(0)
 
     def make_batch(i):
-        cols = {
+        sym = rng.integers(0, NUM_KEYS, BATCH, dtype=np.int64)
+        return {
             TS_KEY: np.arange(i * BATCH, (i + 1) * BATCH, dtype=np.int64),
             TYPE_KEY: np.zeros(BATCH, np.int8),
             VALID_KEY: np.ones(BATCH, bool),
-            "symbol": rng.integers(0, NUM_KEYS, BATCH, dtype=np.int64),
+            "symbol": sym,
             "symbol?": np.zeros(BATCH, bool),
-            "price": rng.random(BATCH, np.float32) * 100.0,
+            "price": (rng.random(BATCH) * 100.0).astype(np.float32),
             "price?": np.zeros(BATCH, bool),
             "volume": rng.integers(1, 1000, BATCH, dtype=np.int64),
             "volume?": np.zeros(BATCH, bool),
-            GK_KEY: rng.integers(0, NUM_KEYS, BATCH).astype(np.int32),
+            GK_KEY: sym.astype(np.int32),
         }
-        return cols
 
     state = q._init_state()
     step = jax.jit(q.build_step_fn(), donate_argnums=0)
     now = np.int64(0)
+    batches = [jax.device_put(make_batch(i)) for i in range(4)]
 
-    batches = [make_batch(i) for i in range(8)]
-    for i in range(WARMUP_BATCHES):
+    for i in range(3):
         state, out = step(state, batches[i % len(batches)], now)
     jax.block_until_ready(state)
 
@@ -86,19 +94,164 @@ def main():
         state, out = step(state, batches[i % len(batches)], now)
         n_events += BATCH
         i += 1
-        if i % 50 == 0:
+        if i % 20 == 0:
             jax.block_until_ready(state)
             if time.perf_counter() - t0 >= MEASURE_SECONDS:
                 break
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
-    eps = n_events / dt
+    manager.shutdown()
+    return n_events / dt
 
+
+def bench_e2e():
+    """End-to-end: InputHandler.send_columns -> junction -> query ->
+    StreamCallback (columnar), mirroring the reference harness methodology
+    (SimpleFilterSingleQueryPerformance.java: pump, count outputs,
+    events/sec) with the framework's bulk ingestion API."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(_APP)
+
+    class Counter(StreamCallback):
+        n = 0
+
+        def receive_batch(self, batch, junction):
+            Counter.n += batch.size
+
+        def receive(self, events):
+            Counter.n += len(events)
+
+    rt.add_callback("OutStream", Counter())
+    h = rt.get_input_handler("StockStream")
+    q = rt.query_runtimes["bench"]
+    q.selector_plan.num_keys = 16_384
+    # register the symbol strings once so pre-encoded int ids decode cleanly
+    dic = rt.app_context.string_dictionary
+    for i in range(NUM_KEYS):
+        dic.encode(f"S{i}")
+
+    rng = np.random.default_rng(1)
+    B = BATCH
+
+    def make_cols(i):
+        return {
+            "symbol": rng.integers(0, NUM_KEYS, B, dtype=np.int64),
+            "price": (rng.random(B) * 100.0).astype(np.float32),
+            "volume": rng.integers(1, 1000, B, dtype=np.int64),
+        }, np.arange(i * B, (i + 1) * B, dtype=np.int64)
+
+    # warm: register every key (single growth), compile the step
+    warm_sym = np.arange(NUM_KEYS, dtype=np.int64)
+    h.send_columns({"symbol": warm_sym,
+                    "price": np.ones(NUM_KEYS, np.float32),
+                    "volume": np.ones(NUM_KEYS, np.int64)},
+                   timestamps=np.zeros(NUM_KEYS, np.int64))
+    pre = [make_cols(i + 1) for i in range(4)]
+    h.send_columns(pre[0][0], timestamps=pre[0][1])
+
+    t0 = time.perf_counter()
+    n = 0
+    i = 0
+    while time.perf_counter() - t0 < MEASURE_SECONDS:
+        cols, ts = pre[i % len(pre)]
+        h.send_columns(cols, timestamps=ts)
+        n += B
+        i += 1
+    dt = time.perf_counter() - t0
+    manager.shutdown()
+    assert Counter.n > 0
+    return n / dt
+
+
+def bench_nfa_p99():
+    """Config #4: `every e1=A -> e2=B[e2.v > e1.v] within 5 sec` over 10k
+    partition keys; per-batch latency (ms) through the full host path,
+    p99 over measured batches; plus aggregate events/sec."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    app = """
+    @app:playback
+    define stream AStream (k string, v double);
+    define stream BStream (k string, v double);
+    partition with (k of AStream, k of BStream)
+    begin
+      @info(name = 'nfa')
+      from every e1=AStream -> e2=BStream[e2.v > e1.v] within 5 sec
+      select e1.v as v1, e2.v as v2
+      insert into MatchStream;
+    end;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(app)
+
+    class Counter(StreamCallback):
+        n = 0
+
+        def receive_batch(self, batch, junction):
+            Counter.n += batch.size
+
+        def receive(self, events):
+            Counter.n += len(events)
+
+    rt.add_callback("MatchStream", Counter())
+    ha = rt.get_input_handler("AStream")
+    hb = rt.get_input_handler("BStream")
+
+    rng = np.random.default_rng(2)
+    B = 1024
+
+    # warm: register all 10k partition keys in one batch (single growth),
+    # then compile both stream steps at the MEASURED batch shape so no
+    # compile lands inside the timing window
+    warm_keys = np.array([f"K{i}" for i in range(NUM_KEYS)], dtype=object)
+    ts0 = np.full(NUM_KEYS, 1_000, np.int64)
+    ha.send_columns({"k": warm_keys, "v": np.zeros(NUM_KEYS)}, timestamps=ts0)
+    hb.send_columns({"k": warm_keys, "v": np.ones(NUM_KEYS)}, timestamps=ts0 + 1)
+    wk = np.array([f"K{i}" for i in range(B)], dtype=object)
+    wts = np.full(B, 2_000, np.int64)
+    ha.send_columns({"k": wk, "v": np.zeros(B)}, timestamps=wts)
+    hb.send_columns({"k": wk, "v": np.ones(B)}, timestamps=wts + 1)
+
+    lat = []
+    n = 0
+    t_ms = 10_000
+    t_end = time.perf_counter() + MEASURE_SECONDS
+    while time.perf_counter() < t_end:
+        keys = rng.integers(0, NUM_KEYS, B)
+        ka = np.array([f"K{i}" for i in keys], dtype=object)
+        va = rng.random(B) * 100.0
+        ts = np.full(B, t_ms, np.int64)
+        t0 = time.perf_counter()
+        ha.send_columns({"k": ka, "v": va}, timestamps=ts)
+        hb.send_columns({"k": ka, "v": va + 1.0}, timestamps=ts + 1)
+        lat.append((time.perf_counter() - t0) * 1000.0 / 2)  # per batch
+        n += 2 * B
+        t_ms += 10
+    manager.shutdown()
+    assert Counter.n > 0
+    lat = np.sort(np.asarray(lat))
+    p99 = float(lat[min(len(lat) - 1, int(len(lat) * 0.99))])
+    total_t = float(np.sum(lat) * 2 / 1000.0)
+    return p99, n / total_t
+
+
+def main():
+    eps_device = bench_device()
+    eps_e2e = bench_e2e()
+    nfa_p99_ms, nfa_eps = bench_nfa_p99()
     print(json.dumps({
         "metric": "events_per_sec_10k_key_length1000_avg",
-        "value": round(eps, 1),
+        "value": round(eps_device, 1),
         "unit": "events/sec/chip",
-        "vs_baseline": round(eps / JVM_BASELINE_EVENTS_PER_SEC, 3),
+        "vs_baseline": round(eps_device / MEASURED_BASELINE_EPS, 3),
+        "baseline_events_per_sec": MEASURED_BASELINE_EPS,
+        "baseline_source": "tools/baseline_cpp (measured; no JVM in image)",
+        "e2e_events_per_sec": round(eps_e2e, 1),
+        "nfa_p99_ms_per_batch": round(nfa_p99_ms, 3),
+        "nfa_events_per_sec": round(nfa_eps, 1),
+        "batch": BATCH,
     }))
 
 

@@ -59,6 +59,13 @@ class SiddhiAppRuntime:
             self.app_context.timestamp_generator.playback = True
         if siddhi_app.app_annotation("enforceOrder") is not None:
             self.app_context.enforce_order = True
+        prec = siddhi_app.app_annotation("precision")
+        if prec is not None:
+            v = (prec.element() or "").lower()
+            if v not in ("exact", "fast"):
+                raise SiddhiAppValidationException(
+                    "@app:precision must be 'exact' or 'fast'")
+            self.app_context.precision = v
         self.app_context.scheduler = Scheduler(self.app_context)
 
         for sid, sdef in self.stream_definitions.items():

@@ -73,9 +73,27 @@ class SiddhiAppContext:
         self.partition_window_capacity = 256
         # pending-match slot capacity per key for pattern/sequence queries
         self.nfa_slots = 32
+        # device numeric precision: 'exact' = 64-bit accumulators (matches
+        # the reference's double math bit-for-bit; CPU default), 'fast' =
+        # 32-bit on-device (TPU default — v5e emulates 64-bit in software).
+        # Overridable with @app:precision('exact'|'fast').
+        self.precision = _default_precision()
+        # fold window evictions into invertible aggregator deltas where the
+        # query shape allows (ops/fused_agg.py); off = always-generic path
+        self.enable_fusion = True
         # shared stores, filled by SiddhiAppRuntime during assembly
         self.tables = {}
         self.named_windows = {}
+
+
+def _default_precision() -> str:
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover — backend probing must never fail
+        return "exact"
+    return "exact" if backend == "cpu" else "fast"
 
 
 @dataclass

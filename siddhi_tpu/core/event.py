@@ -75,8 +75,41 @@ class StringDictionary:
             return None
         return self._to_str[i]
 
+    def encode_array(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized dictionary encoding: unique the batch once, dict-probe
+        only the unique strings, then inverse-map (np.unique + LUT — the
+        batched answer to per-event string keys,
+        ``GroupByKeyGenerator.java:37``). Nones encode to NULL_ID."""
+        arr = np.asarray(values, object)
+        null = np.array([v is None for v in arr], bool)
+        if null.any():
+            arr = arr.copy()
+            arr[null] = ""  # np.unique cannot compare None against str
+        uniq, inv = np.unique(arr, return_inverse=True)
+        ids = np.fromiter((self.encode(str(u)) for u in uniq),
+                          np.int64, len(uniq))
+        out = ids[inv]
+        out[null] = self.NULL_ID
+        return out
+
     def __len__(self):
         return len(self._to_str)
+
+
+def encode_key_tuples(arrays, rows: np.ndarray, id_of) -> np.ndarray:
+    """Dense ids for key tuples taken row-wise from ``arrays`` at ``rows``:
+    structured-array ``np.unique`` preserves each column's dtype, and the
+    Python dictionary (``id_of``) is probed once per *unique* tuple — the
+    shared batched keying used by GroupKeyer and ValuePartitionKeyer."""
+    B = arrays[0].shape[0]
+    rec = np.empty(B, dtype=[(f"k{i}", a.dtype) for i, a in enumerate(arrays)])
+    for i, a in enumerate(arrays):
+        rec[f"k{i}"] = a
+    uniq, inv = np.unique(rec[rows], return_inverse=True)
+    lut = np.empty(len(uniq), np.int32)
+    for u_i in range(len(uniq)):
+        lut[u_i] = id_of(tuple(x.item() for x in uniq[u_i]))
+    return lut[inv]
 
 
 def _pad_len(n: int, minimum: int = 8) -> int:
@@ -122,24 +155,87 @@ class HostBatch:
             VALID_KEY: np.zeros(b, bool),
         }
         cols[VALID_KEY][:n] = True
-        for i, ev in enumerate(events):
-            cols[TS_KEY][i] = ev.timestamp
-            if ev.is_expired:
-                cols[TYPE_KEY][i] = EXPIRED
+        if n:
+            cols[TS_KEY][:n] = np.fromiter(
+                (ev.timestamp for ev in events), np.int64, n)
+            expired = np.fromiter((ev.is_expired for ev in events), bool, n)
+            if expired.any():
+                cols[TYPE_KEY][:n][expired] = EXPIRED
+        rows = [ev.data for ev in events]
+        encode = dictionary.encode
         for pos, attr in enumerate(definition.attributes):
             dtype = dtype_of(attr.type)
             arr = np.zeros(b, dtype)
             # null masks are always present so device column sets (and jit
             # shapes) stay static whether or not a batch contains nulls
             mask = np.zeros(b, bool)
-            for i, ev in enumerate(events):
-                v = ev.data[pos]
-                if v is None:
-                    mask[i] = True
-                elif attr.type == AttrType.STRING:
-                    arr[i] = dictionary.encode(v)
+            if n:
+                if attr.type == AttrType.STRING:
+                    vals = [
+                        StringDictionary.NULL_ID if r[pos] is None else encode(r[pos])
+                        for r in rows
+                    ]
+                    arr[:n] = vals
+                    mask[:n] = np.asarray(vals, np.int64) == StringDictionary.NULL_ID
+                    arr[:n][mask[:n]] = 0
                 else:
-                    arr[i] = v
+                    zero = False if attr.type == AttrType.BOOL else 0
+                    vals = [zero if r[pos] is None else r[pos] for r in rows]
+                    arr[:n] = vals
+                    nulls = [i for i, r in enumerate(rows) if r[pos] is None]
+                    if nulls:
+                        mask[nulls] = True
+            cols[attr.name] = arr
+            cols[attr.name + "?"] = mask
+        return HostBatch(cols)
+
+    @staticmethod
+    def from_columns(
+        data: Dict[str, np.ndarray],
+        definition: AbstractDefinition,
+        dictionary: StringDictionary,
+        timestamps: Optional[np.ndarray] = None,
+        default_ts: int = 0,
+        pad_to: Optional[int] = None,
+    ) -> "HostBatch":
+        """Zero-copy-ish columnar ingestion — the TPU-native fast path that
+        skips per-event objects entirely. ``data`` maps attribute names to
+        arrays (strings may be numpy object/str arrays, encoded here, or
+        pre-encoded int ids). ``<name>?`` null-mask arrays are optional."""
+        first = next(iter(data.values()))
+        n = len(first)
+        b = pad_to if pad_to is not None else _pad_len(n)
+        cols: Dict[str, np.ndarray] = {
+            TYPE_KEY: np.full(b, CURRENT, np.int8),
+            VALID_KEY: np.zeros(b, bool),
+        }
+        cols[VALID_KEY][:n] = True
+        ts = np.zeros(b, np.int64)
+        if timestamps is not None:
+            ts[:n] = np.asarray(timestamps, np.int64)[:n]
+        else:
+            ts[:n] = default_ts
+        cols[TS_KEY] = ts
+        for attr in definition.attributes:
+            if attr.name not in data:
+                raise KeyError(f"column '{attr.name}' missing from batch")
+            src = np.asarray(data[attr.name])
+            dtype = dtype_of(attr.type)
+            arr = np.zeros(b, dtype)
+            mask = np.zeros(b, bool)
+            if attr.type == AttrType.STRING and not np.issubdtype(src.dtype, np.integer):
+                ids = dictionary.encode_array(src)[:n]
+                mask[:n] = ids == StringDictionary.NULL_ID
+                arr[:n] = np.where(mask[:n], 0, ids)
+            elif attr.type == AttrType.STRING:
+                ids = np.asarray(src[:n], np.int64)
+                mask[:n] = ids < 0  # pre-encoded: negative = null
+                arr[:n] = np.where(mask[:n], 0, ids)
+            else:
+                arr[:n] = src[:n]
+            user_mask = data.get(attr.name + "?")
+            if user_mask is not None:
+                mask[:n] |= np.asarray(user_mask, bool)[:n]
             cols[attr.name] = arr
             cols[attr.name + "?"] = mask
         return HostBatch(cols)
@@ -153,33 +249,44 @@ class HostBatch:
     ) -> List[Event]:
         """Decode valid rows into Events (optionally filtered by type).
         ``pk_key`` names a partition-id column to attach as Event.pk."""
-        valid = self.cols[VALID_KEY]
-        types = self.cols[TYPE_KEY]
-        ts = self.cols[TS_KEY]
+        valid = np.asarray(self.cols[VALID_KEY])
+        types = np.asarray(self.cols[TYPE_KEY])
+        ts = np.asarray(self.cols[TS_KEY])
         pk_col = self.cols.get(pk_key) if pk_key is not None else None
-        out: List[Event] = []
-        idx = np.nonzero(valid)[0]
-        for i in idx:
-            t = int(types[i])
-            if types_wanted is not None and t not in types_wanted:
-                continue
-            data = []
-            for key, attr_type in attr_order:
-                mask = self.cols.get(key + "?")
-                if mask is not None and mask[i]:
-                    data.append(None)
-                    continue
-                v = self.cols[key][i]
-                if attr_type == AttrType.STRING:
-                    data.append(dictionary.decode(int(v)))
-                elif attr_type == AttrType.BOOL:
-                    data.append(bool(v))
-                elif attr_type in (AttrType.INT, AttrType.LONG):
-                    data.append(int(v))
-                else:
-                    data.append(float(v))
-            ev = Event(timestamp=int(ts[i]), data=data, is_expired=(t == EXPIRED))
-            if pk_col is not None:
-                ev.pk = int(pk_col[i])
-            out.append(ev)
+        keep = valid
+        if types_wanted is not None:
+            keep = keep & np.isin(types, list(types_wanted))
+        idx = np.nonzero(keep)[0]
+        if idx.size == 0:
+            return []
+        # decode per column (vectorized), then zip rows — no per-cell
+        # dispatch on dtype inside the row loop
+        col_lists: List[list] = []
+        for key, attr_type in attr_order:
+            vals = np.asarray(self.cols[key])[idx]
+            if attr_type == AttrType.STRING:
+                lst = [dictionary.decode(int(v)) for v in vals]
+            elif attr_type == AttrType.BOOL:
+                lst = [bool(v) for v in vals]
+            elif attr_type in (AttrType.INT, AttrType.LONG):
+                lst = vals.astype(np.int64).tolist()
+            else:
+                lst = vals.astype(np.float64).tolist()
+            mask = self.cols.get(key + "?")
+            if mask is not None:
+                mvals = np.asarray(mask)[idx]
+                if mvals.any():
+                    lst = [None if m else v for v, m in zip(lst, mvals)]
+            col_lists.append(lst)
+        ts_l = ts[idx].tolist()
+        exp_l = (types[idx] == EXPIRED).tolist()
+        rows = zip(*col_lists) if col_lists else ([] for _ in idx)
+        out = [
+            Event(timestamp=t, data=list(r), is_expired=e)
+            for t, e, r in zip(ts_l, exp_l, rows)
+        ]
+        if pk_col is not None:
+            pks = np.asarray(pk_col)[idx].tolist()
+            for ev, p in zip(out, pks):
+                ev.pk = int(p)
         return out

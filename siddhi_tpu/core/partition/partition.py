@@ -78,18 +78,19 @@ class ValuePartitionKeyer:
         B = valid.shape[0]
         pk = np.zeros(B, np.int32)
         vals = []
-        null_masks = []
+        drop = np.zeros(B, bool)
         for fn, _t in self._fns:
             v, m = fn(cols, ctx)
             vals.append(np.broadcast_to(np.asarray(v), (B,)))
-            null_masks.append(np.broadcast_to(np.asarray(m), (B,)) if m is not None else None)
-        drop = np.zeros(B, bool)
-        for i in np.nonzero(is_cur)[0]:
-            if any(m is not None and m[i] for m in null_masks):
-                drop[i] = True
-                continue
-            key = tuple(x[i].item() for x in vals)
-            pk[i] = self._keyspace.id_of(key)
+            if m is not None:
+                drop |= np.broadcast_to(np.asarray(m), (B,)) & is_cur
+        keyed = np.nonzero(is_cur & ~drop)[0]
+        if keyed.size:
+            # vectorized dictionary encoding (shared helper — unique the key
+            # tuples once, probe the Python keyspace only per unique)
+            from siddhi_tpu.core.event import encode_key_tuples
+
+            pk[keyed] = encode_key_tuples(vals, keyed, self._keyspace.id_of)
         if drop.any():
             cols = dict(cols)
             cols[VALID_KEY] = valid & ~drop

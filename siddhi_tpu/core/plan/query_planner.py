@@ -359,6 +359,24 @@ def plan_query(
             fns.append((fn, t))
         keyer = GroupKeyer(fns)
 
+    # fuse window eviction into invertible aggregator deltas when the query
+    # shape qualifies (plain stream input, CURRENT-only output) — the hot
+    # path for windowed aggregation (see ops/fused_agg.py)
+    if (
+        window_stage is not None
+        and partition_ctx is None
+        and getattr(app_context, "enable_fusion", True)
+        and stream_id not in getattr(app_context, "named_windows", {})
+    ):
+        from siddhi_tpu.ops.fused_agg import plan_fused_window
+        from siddhi_tpu.ops.windows import LengthWindowStage
+
+        if isinstance(window_stage, LengthWindowStage):
+            fused = plan_fused_window(
+                "length", [window_stage.length], selector_plan, app_context)
+            if fused is not None:
+                window_stage = fused
+
     runtime = QueryRuntime(
         name=query_name,
         app_context=app_context,

@@ -103,17 +103,22 @@ class SelectorPlan:
     limit: Optional[int]
     offset: Optional[int]
     num_keys: int = 16
+    # a fused upstream stage (ops/fused_agg.py) already computed the
+    # aggregate columns; skip the scans and just project/filter
+    precomputed: bool = False
 
     @property
     def contains_aggregator(self) -> bool:
         return bool(self.specs)
 
     def init_state(self) -> dict:
+        if self.precomputed:
+            return {}
         return agg_ops.init_agg_state(self.specs, self.num_keys)
 
     def apply(self, state: dict, cols: dict, ctx: dict):
         xp = ctx["xp"]
-        if self.specs:
+        if self.specs and not self.precomputed:
             state, cols = agg_ops.apply_aggregators(self.specs, state, cols, ctx, self.num_keys)
 
         out: Dict[str, object] = {

@@ -63,13 +63,22 @@ class GroupKeyer:
             np.take(self._lut, ids, out=gk)
             gk[~valid] = 0
             return gk
-        vals = []
+        # general path: vectorized dictionary encoding (shared helper —
+        # unique the key tuples once per batch, probe the dict per NEW
+        # unique only)
+        from siddhi_tpu.core.event import encode_key_tuples
+
+        arrays = []
+        if pk is not None:
+            arrays.append(np.asarray(pk))
         for fn, _t in self._fns:
             v, _m = fn(cols, ctx)
-            vals.append(np.broadcast_to(np.asarray(v), (B,)))
-        for i in np.nonzero(valid)[0]:
-            key = ((int(pk[i]),) if pk is not None else ()) + tuple(x[i].item() for x in vals)
-            gk[i] = self._map.setdefault(key, len(self._map))
+            arrays.append(np.broadcast_to(np.asarray(v), (B,)))
+        vidx = np.nonzero(valid)[0]
+        if vidx.size == 0:
+            return gk
+        gk[vidx] = encode_key_tuples(
+            arrays, vidx, lambda key: self._map.setdefault(key, len(self._map)))
         return gk
 
 
@@ -214,6 +223,13 @@ class QueryRuntime(Receiver):
             batch.cols[PK_KEY] = pk
         self.process_batch(batch)
 
+    def receive_batch(self, batch: HostBatch, junction=None):
+        """Columnar fast path from StreamJunction.send_batch — no Event
+        objects on ingest."""
+        if self.carried_pk and PK_KEY not in batch.cols:
+            batch.cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
+        self.process_batch(batch)
+
     def process_timer(self, ts: int):
         """Inject a TIMER chunk (the role of Scheduler.sendTimerEvents +
         EntryValveProcessor in the reference)."""
@@ -294,6 +310,24 @@ class QueryRuntime(Receiver):
 
     def _emit(self, out: HostBatch):
         if out.size == 0:
+            return
+        from siddhi_tpu.core.query.ratelimit import PassThroughRateLimiter
+
+        if (
+            (self.rate_limiter is None
+             or type(self.rate_limiter) is PassThroughRateLimiter)
+            and self.output_action is None
+            and not self.query_callbacks
+            and self.output_junction is not None
+            and not self.attach_pk
+            and hasattr(self.output_junction, "send_batch")
+        ):
+            # columnar re-publish: no Event materialization between queries
+            cols = dict(out.cols)
+            t = cols[TYPE_KEY]
+            # EXPIRED -> CURRENT on re-publish (InsertIntoStreamCallback.java:52-55)
+            cols[TYPE_KEY] = np.where(t == EXPIRED, CURRENT, t).astype(np.int8)
+            self.output_junction.send_batch(HostBatch(cols))
             return
         events = out.to_events(
             self.output_attrs, self.dictionary,

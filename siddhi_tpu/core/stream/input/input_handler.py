@@ -48,6 +48,30 @@ class InputHandler:
         with self._barrier:  # snapshot quiesce gate (ThreadBarrier.java:30-36)
             self.junction.send_events(events)
 
+    def send_columns(self, data, timestamps=None):
+        """Columnar bulk ingestion — the TPU-native fast path: one numpy
+        array per attribute (strings as str arrays or pre-encoded int ids),
+        optional per-row timestamps. Skips Event objects entirely; receivers
+        that understand batches consume them directly."""
+        import numpy as np
+
+        from siddhi_tpu.core.event import HostBatch
+
+        if self._ensure_started is not None:
+            self._ensure_started()
+        tsg = self.app_context.timestamp_generator
+        now = tsg.current_time()
+        batch = HostBatch.from_columns(
+            data, self.junction.definition,
+            self.app_context.string_dictionary,
+            timestamps=timestamps, default_ts=now)
+        if timestamps is not None:
+            ts_arr = np.asarray(timestamps, np.int64)
+            if ts_arr.size:
+                tsg.set_current_timestamp(int(ts_arr.max()))
+        with self._barrier:
+            self.junction.send_batch(batch)
+
 
 class InputManager:
     """Reference ``core/stream/input/InputManager.java``."""

@@ -30,6 +30,12 @@ class Receiver:
     def receive(self, events: List[Event]):
         raise NotImplementedError
 
+    def receive_batch(self, batch, junction: "StreamJunction"):
+        """Columnar fast path: receivers that can consume a HostBatch
+        directly override this; the default decodes to Events (so every
+        receiver keeps working when a producer uses the bulk API)."""
+        self.receive(junction.decode_events(batch))
+
 
 class StreamJunction:
     def __init__(self, definition: StreamDefinition, app_context, fault_junction: Optional["StreamJunction"] = None):
@@ -77,11 +83,40 @@ class StreamJunction:
         else:
             self._deliver(events)
 
+    def decode_events(self, batch) -> List[Event]:
+        return batch.to_events(
+            [(a.name, a.type) for a in self.definition.attributes],
+            self.app_context.string_dictionary,
+        )
+
+    def send_batch(self, batch):
+        """Columnar publish (no Event objects). @Async junctions enqueue the
+        batch behind any pending event chunks (producer ordering is kept);
+        it is delivered as one unit — already a batch."""
+        if self._async and self._running:
+            self._queue.put(batch)
+        else:
+            self._deliver_batch(batch)
+
+    def _deliver_batch(self, batch):
+        from siddhi_tpu.core.event import HostBatch
+
+        for r in self.receivers:
+            # receivers mutate batch.cols in place (filters, key columns) —
+            # hand each its own dict so mutations don't leak across
+            try:
+                r.receive_batch(HostBatch(dict(batch.cols)), self)
+            except Exception as e:  # noqa: BLE001 — fault-stream routing
+                self.handle_error(self.decode_events(batch), e)
+
     def _drain(self):
         while True:
             item = self._queue.get()
             if item is None:
                 return
+            if not isinstance(item, list):  # columnar HostBatch: one unit
+                self._deliver_batch(item)
+                continue
             batch = list(item)
             # re-batch pending chunks up to batch_size
             while len(batch) < self._batch_size:
@@ -92,8 +127,14 @@ class StreamJunction:
                 if more is None:
                     self._deliver(batch)
                     return
+                if not isinstance(more, list):
+                    self._deliver(batch)
+                    self._deliver_batch(more)
+                    batch = None
+                    break
                 batch.extend(more)
-            self._deliver(batch)
+            if batch is not None:
+                self._deliver(batch)
 
     def _deliver(self, events: List[Event]):
         for r in self.receivers:

@@ -1,0 +1,282 @@
+"""Fused sliding-window aggregation: window eviction folded into
+invertible-aggregator deltas.
+
+The unfused pipeline materializes [EXPIRED(oldest), CURRENT] pairs per
+arrival (2B rows), sorts them into emission order, and runs the selector's
+segmented scans over all 2B rows (``ops/windows.py`` + ``ops/aggregators.py``
+— mirroring ``LengthWindowProcessor.java:106-142`` + ``QuerySelector.java:207-269``).
+When the query only consumes CURRENT outputs and every aggregator is
+invertible (sum/count/avg/stdDev/and/or — all add-combine), the expired rows
+exist *only* to feed negative deltas into the aggregators. This stage skips
+materializing them entirely:
+
+- one output row per arriving CURRENT event, carrying the post-event running
+  aggregate per group — bit-identical (in exact mode) to what the unfused
+  selector computes for the CURRENT rows;
+- the window ring stores each aggregator's *delta tuple* (not raw attribute
+  values), so eviction is a gather + negate;
+- per-group base state is re-derived from the ring every step (one [W]→[K]
+  scatter-add), so there is NO persistent float accumulator to drift and the
+  snapshot is just the ring;
+- ONE int32 sort of the interleaved (evict, insert) delta stream orders the
+  segmented prefix sums; everything else is cumsum/gather/scatter.
+
+Device dtypes are 32-bit under the app's "fast" precision mode (TPU default
+— v5e emulates 64-bit) and 64-bit under "exact" (CPU/test default), where
+outputs match the unfused pipeline exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from siddhi_tpu.ops import aggregators as agg_ops
+from siddhi_tpu.ops import types as T
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.query_api.definitions import AttrType
+
+CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
+GK_KEY = "__gk__"
+
+# aggregators whose EXPIRED contribution is a negated delta (add-combine)
+INVERTIBLE = ("sum", "count", "avg", "stddev", "and", "or")
+
+
+def fusable_specs(specs: List[agg_ops.AggSpec]) -> bool:
+    return bool(specs) and all(s.kind in INVERTIBLE for s in specs)
+
+
+def _spec_slot_names(i: int, spec: agg_ops.AggSpec) -> List[str]:
+    return [f"s{i}_{j}" for j in range(spec.slots)]
+
+
+class FusedSlidingAggStage:
+    """``#window.length(W)`` (+ filters upstream) straight into invertible
+    group-by aggregators. Slots into the query step where a window stage
+    normally goes; its output already carries the aggregate columns, so the
+    selector runs in precomputed mode (projection/having only).
+    """
+
+    batch_mode = False
+    needs_scheduler = False
+    host_mode = False
+    fused = True
+
+    def __init__(self, length: int, specs: List[agg_ops.AggSpec],
+                 num_keys_ref, exact: bool):
+        self.length = length
+        self.specs = specs
+        # selector_plan is the live owner of the padded key capacity (pow2
+        # growth re-jits the step); read it at trace time
+        self._num_keys_ref = num_keys_ref
+        self.exact = exact
+        self.fdtype = jnp.float64 if exact else jnp.float32
+
+    @property
+    def num_keys(self) -> int:
+        return self._num_keys_ref()
+
+    def _slot_dtypes(self) -> List[np.dtype]:
+        """Accumulation dtype per slot column. Exact mode matches the
+        generic path's accumulators (``agg_ops._slot_dtype``): int64 for
+        count/and/or and integer sums, float64 otherwise — so long sums
+        beyond 2^53 stay exact. Fast mode is f32 throughout."""
+        out: List[np.dtype] = []
+        for spec in self.specs:
+            if not self.exact:
+                out.extend([np.dtype(np.float32)] * spec.slots)
+                continue
+            k = spec.kind
+            if k in ("count", "and", "or"):
+                out.append(np.dtype(np.int64))
+            elif k == "sum" and spec.arg_type in (AttrType.INT, AttrType.LONG):
+                out.append(np.dtype(np.int64))
+            elif k == "avg":
+                out.extend([np.dtype(np.float64), np.dtype(np.int64)])
+            elif k == "stddev":
+                out.extend([np.dtype(np.float64)] * 2 + [np.dtype(np.int64)])
+            else:
+                out.append(np.dtype(np.float64))
+        return out
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        W = self.length
+        names = [n for i, s in enumerate(self.specs)
+                 for n in _spec_slot_names(i, s)]
+        state = {n: jnp.zeros((W,), dt)
+                 for n, dt in zip(names, self._slot_dtypes())}
+        state["rgk"] = jnp.zeros((W,), jnp.int32)
+        state["fill"] = jnp.int32(0)   # occupied ring slots (<= W)
+        state["head"] = jnp.int32(0)   # next write slot
+        return state
+
+    def _deltas(self, cols, ctx) -> List[jnp.ndarray]:
+        """Per-slot-column [B] delta arrays (0 for null/non-participating
+        rows), in spec order. CURRENT sign; eviction negates."""
+        xp = ctx["xp"]
+        valid = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        B = valid.shape[0]
+        parts = []
+        dtypes = self._slot_dtypes()
+
+        def emit(ok, val):
+            dt = dtypes[len(parts)]
+            parts.append(xp.where(ok, xp.asarray(val).astype(dt), 0).astype(dt))
+
+        for spec in self.specs:
+            if spec.arg_fn is not None:
+                v, null_mask = spec.arg_fn(cols, ctx)
+                v = xp.broadcast_to(xp.asarray(v), (B,))
+                ok = valid if null_mask is None else (valid & ~null_mask)
+            else:
+                v, ok = None, valid
+            k = spec.kind
+            if k == "sum":
+                emit(ok, v)
+            elif k == "count":
+                emit(ok, xp.ones((B,)))
+            elif k == "avg":
+                emit(ok, v)
+                emit(ok, xp.ones((B,)))
+            elif k == "stddev":
+                emit(ok, v)
+                emit(ok, v.astype(self.fdtype) * v.astype(self.fdtype))
+                emit(ok, xp.ones((B,)))
+            elif k == "and":
+                emit(ok & ~v.astype(bool), xp.ones((B,)))
+            elif k == "or":
+                emit(ok & v.astype(bool), xp.ones((B,)))
+            else:  # pragma: no cover — fusable_specs() gates construction
+                raise AssertionError(k)
+        return parts
+
+    def apply(self, state: dict, cols: Dict, ctx: Dict):
+        W = self.length
+        K = self.num_keys
+        B = cols[VALID_KEY].shape[0]
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        gk = cols[GK_KEY].astype(jnp.int32)
+
+        slot_names = [n for i, s in enumerate(self.specs)
+                      for n in _spec_slot_names(i, s)]
+        rgk = state["rgk"]
+        fill0 = state["fill"]
+        head0 = state["head"]
+
+        deltas = self._deltas(cols, ctx)                   # per-column [B]
+
+        # arrival ranks (i32 — stream position never enters the math)
+        rank = jnp.cumsum(valid_cur.astype(jnp.int32)) - 1
+        n_ins = jnp.sum(valid_cur.astype(jnp.int32))
+
+        # rank -> batch row (for same-batch evictions when n_ins > W)
+        rank_to_row = jnp.zeros((B,), jnp.int32).at[
+            jnp.where(valid_cur, rank, B)
+        ].set(jnp.arange(B, dtype=jnp.int32), mode="drop")
+
+        # insert r evicts FIFO entry e = fill0 + r - W (>= 0); entries
+        # 0..fill0-1 live in the ring starting at tail, >= fill0 are this
+        # batch's own inserts
+        evicts = valid_cur & (fill0 + rank >= W)
+        e_idx = fill0 + rank - W
+        from_batch = e_idx >= fill0
+        tail = (head0 - fill0) % W
+        ring_slot = (tail + jnp.clip(e_idx, 0, W - 1)) % W
+        batch_row = rank_to_row[jnp.clip(e_idx - fill0, 0, B - 1)]
+
+        evict_gk = jnp.where(from_batch, gk[batch_row], rgk[ring_slot])
+
+        # ---- interleaved delta stream: evict_i at 2i, insert_i at 2i+1
+        d_gk = jnp.stack([evict_gk, gk], axis=1).reshape(2 * B)
+        d_live = jnp.stack([evicts, valid_cur], axis=1).reshape(2 * B)
+
+        # one sort keyed (group, position); int32 when the range fits
+        if K * (2 * B + 1) < 2 ** 31:
+            key = jnp.where(d_live, d_gk, K) * jnp.int32(2 * B + 1) \
+                + jnp.arange(2 * B, dtype=jnp.int32)
+        else:
+            key = jnp.where(d_live, d_gk, K).astype(jnp.int64) \
+                * jnp.int64(2 * B + 1) + jnp.arange(2 * B, dtype=jnp.int64)
+        order = jnp.argsort(key)
+        gk_sorted = d_gk[order]
+        seg_start = jnp.concatenate(
+            [jnp.ones(1, bool), gk_sorted[1:] != gk_sorted[:-1]])
+        idx2b = jnp.arange(2 * B, dtype=jnp.int32)
+        start_of = lax.cummax(jnp.where(seg_start, idx2b, 0))
+        occ = jnp.arange(W, dtype=jnp.int32) < fill0
+        base_idx = jnp.where(occ, rgk, K)
+        gk_clip = jnp.minimum(gk_sorted, K)
+
+        # per slot column (dtypes differ: int64 counts/int-sums in exact
+        # mode): interleave, permute, segmented prefix via cumsum, plus the
+        # group's base re-derived from the pre-batch ring (exact — no
+        # persistent accumulator to drift across batches)
+        ins_running: List[jnp.ndarray] = []
+        for j, n in enumerate(slot_names):
+            ring_col = state[n]
+            d = deltas[j]
+            ev = jnp.where(from_batch, d[batch_row], ring_col[ring_slot])
+            col = jnp.stack([-ev, d], axis=1).reshape(2 * B)
+            col = jnp.where(d_live, col, 0)
+            cs = jnp.cumsum(col[order])
+            ex = cs - col[order]
+            running = cs - ex[start_of]
+            base = jnp.zeros((K + 1,), ring_col.dtype).at[base_idx].add(
+                jnp.where(occ, ring_col, 0), mode="drop")
+            running = running + base[gk_clip]
+            back = jnp.zeros_like(running).at[order].set(running)
+            ins_running.append(back.reshape(B, 2)[:, 1])
+
+        out = {k: cols[k] for k in cols if k not in (VALID_KEY,)}
+        out[VALID_KEY] = valid_cur
+        # per-spec output columns from the running slot tuples
+        col_i = 0
+        for i, spec in enumerate(self.specs):
+            slots = [ins_running[col_i + j] for j in range(spec.slots)]
+            col_i += spec.slots
+            value, null_mask = agg_ops._output(spec, slots, ctx)
+            value = jnp.asarray(value)
+            out[spec.out_key] = value.astype(T.dtype_of(spec.out_type))
+            if null_mask is not None:
+                out[spec.out_key + "?"] = null_mask
+
+        # ---- ring update: write the last min(W, n_ins) inserts
+        write = valid_cur & (rank >= n_ins - W)
+        slot = jnp.where(write, (head0 + rank) % W, W)
+        new_state = dict(state)
+        for j, n in enumerate(slot_names):
+            new_state[n] = state[n].at[slot].set(deltas[j], mode="drop")
+        new_state["rgk"] = rgk.at[slot].set(gk, mode="drop")
+        new_state["fill"] = jnp.minimum(fill0 + n_ins, W)
+        new_state["head"] = (head0 + n_ins) % W
+        return new_state, out
+
+    def contents(self, state):  # pragma: no cover
+        from siddhi_tpu.ops.expressions import CompileError
+
+        raise CompileError(
+            "a fused aggregation window cannot be probed as a join side")
+
+
+def plan_fused_window(window_name: str, window_params, selector_plan,
+                      app_context) -> Optional[FusedSlidingAggStage]:
+    """Return a fused stage when the (window, selector) pair qualifies:
+    sliding length window, all aggregators invertible, CURRENT-only output,
+    no batch semantics. Otherwise None (generic path)."""
+    if window_name.lower() != "length":
+        return None
+    sel = selector_plan
+    if sel.expired_on or not sel.current_on:
+        return None
+    if not fusable_specs(sel.specs):
+        return None
+    length = int(window_params[0])
+    exact = getattr(app_context, "precision", "exact") == "exact"
+    stage = FusedSlidingAggStage(
+        length, sel.specs, num_keys_ref=lambda: sel.num_keys, exact=exact)
+    sel.precomputed = True
+    return stage

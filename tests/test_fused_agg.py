@@ -1,0 +1,158 @@
+"""Differential tests: the fused sliding-window aggregation stage
+(ops/fused_agg.py) must produce exactly what the generic
+window->selector pipeline produces for CURRENT outputs (exact mode).
+"""
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collect(StreamCallback):
+    def __init__(self):
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+APP = """
+    define stream S (symbol string, price double, volume long);
+    @info(name = 'q')
+    from S#window.length({W})
+    select symbol, sum(price) as total, avg(price) as avgP, count() as n,
+           stdDev(price) as sd
+    group by symbol
+    insert into Out;
+"""
+
+
+def _run_planned(app, rows, fusion: bool, batches=None):
+    """Plan with fusion on/off by flipping the flag BEFORE runtime build."""
+    from siddhi_tpu.core import context as ctx_mod
+
+    orig = ctx_mod.SiddhiAppContext.__init__
+
+    def patched(self, siddhi_context, name):
+        orig(self, siddhi_context, name)
+        self.enable_fusion = fusion
+
+    ctx_mod.SiddhiAppContext.__init__ = patched
+    try:
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        q = rt.query_runtimes["q"]
+        from siddhi_tpu.ops.fused_agg import FusedSlidingAggStage
+
+        assert isinstance(q.window_stage, FusedSlidingAggStage) == fusion
+        cb = Collect()
+        rt.add_callback("Out", cb)
+        h = rt.get_input_handler("S")
+        if batches is None:
+            for r in rows:
+                h.send(r)
+        else:
+            from siddhi_tpu.core.event import Event
+
+            i = 0
+            for sz in batches:
+                h.send([Event(timestamp=1000 + i + j, data=rows[i + j])
+                        for j in range(sz)])
+                i += sz
+        m.shutdown()
+        return [e.data for e in cb.events]
+    finally:
+        ctx_mod.SiddhiAppContext.__init__ = orig
+
+
+def test_fused_matches_generic_small_window():
+    # window smaller than the batch: same-batch evictions exercised
+    rng = np.random.default_rng(7)
+    rows = [[f"S{rng.integers(0, 3)}", float(rng.integers(1, 20)), int(rng.integers(1, 9))]
+            for _ in range(40)]
+    app = APP.format(W=5)
+    fused = _run_planned(app, rows, fusion=True, batches=[13, 1, 26])
+    generic = _run_planned(app, rows, fusion=False, batches=[13, 1, 26])
+    assert len(fused) == len(generic) == 40
+    for f, g in zip(fused, generic):
+        assert f[0] == g[0] and f[3] == g[3]
+        np.testing.assert_allclose(f[1], g[1], rtol=1e-12)
+        np.testing.assert_allclose(f[2], g[2], rtol=1e-12)
+        np.testing.assert_allclose(f[4], g[4], rtol=1e-9, atol=1e-9)
+
+
+def test_fused_matches_generic_many_keys():
+    rng = np.random.default_rng(11)
+    rows = [[f"K{rng.integers(0, 40)}", float(rng.standard_normal() * 10), 1]
+            for _ in range(120)]
+    app = APP.format(W=50)
+    fused = _run_planned(app, rows, fusion=True, batches=[64, 56])
+    generic = _run_planned(app, rows, fusion=False, batches=[64, 56])
+    assert len(fused) == len(generic)
+    for f, g in zip(fused, generic):
+        assert f[0] == g[0] and f[3] == g[3]
+        np.testing.assert_allclose(f[1], g[1], rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(f[2], g[2], rtol=1e-9, atol=1e-9)
+
+
+def test_fused_null_args_and_having():
+    app = """
+        define stream S (symbol string, price double, volume long);
+        @info(name = 'q')
+        from S#window.length(3)
+        select symbol, sum(price) as total, avg(price) as avgP
+        group by symbol
+        having total > 5.0
+        insert into Out;
+    """
+    rows = [["A", 10.0, 1], ["A", None, 1], ["A", 30.0, 1], ["A", 2.0, 1],
+            ["A", 1.0, 1]]
+    fused = _run_planned(app, rows, fusion=True)
+    generic = _run_planned(app, rows, fusion=False)
+    assert fused == generic
+
+
+def test_fused_no_group_by():
+    app = """
+        define stream S (symbol string, price double, volume long);
+        @info(name = 'q')
+        from S#window.length(4)
+        select sum(price) as total, count() as n
+        insert into Out;
+    """
+    rows = [["A", float(v), 1] for v in [1, 2, 3, 4, 5, 6, 7]]
+    fused = _run_planned(app, rows, fusion=True, batches=[7])
+    generic = _run_planned(app, rows, fusion=False, batches=[7])
+    assert fused == generic
+    assert fused[-1] == [4.0 + 5 + 6 + 7, 4]
+
+
+def test_min_max_not_fused():
+    # min/max are not invertible — the generic ring path must stay in place
+    from siddhi_tpu.ops.fused_agg import FusedSlidingAggStage
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double);
+        @info(name = 'q')
+        from S#window.length(3) select symbol, min(price) as mn
+        group by symbol insert into Out;
+    """)
+    q = rt.query_runtimes["q"]
+    assert not isinstance(q.window_stage, FusedSlidingAggStage)
+    m.shutdown()
+
+
+def test_expired_consumers_not_fused():
+    from siddhi_tpu.ops.fused_agg import FusedSlidingAggStage
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double);
+        @info(name = 'q')
+        from S#window.length(3) select symbol, sum(price) as s
+        group by symbol insert all events into Out;
+    """)
+    q = rt.query_runtimes["q"]
+    assert not isinstance(q.window_stage, FusedSlidingAggStage)
+    m.shutdown()

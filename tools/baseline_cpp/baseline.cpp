@@ -1,0 +1,147 @@
+// Measured event-at-a-time baseline for the north-star benchmark shape:
+//   10k-key  #window.length(1000)  ->  avg(price), sum(volume)  group by symbol
+//
+// There is no JVM in this image, so this C++ program stands in for the
+// reference's single-threaded StreamRuntime hot path, reproducing its
+// per-event cost structure (SURVEY.md §3.2):
+//   - one heap event object per arrival (StreamEventFactory.newInstance)
+//   - window insert + clone-to-EXPIRED + deque surgery
+//     (LengthWindowProcessor.java:106-142)
+//   - string group key build per event (GroupByKeyGenerator.java:37)
+//   - hash-map lookup to the per-group aggregator state
+//     (PartitionStateHolder-style map addressing)
+//   - virtual execute() per aggregator per event (QuerySelector.java:207-269)
+//
+// Native C++ is a conservative stand-in: it is, if anything, FASTER than the
+// JVM on this pointer-chasing workload, so speedups reported against it
+// understate the speedup against the real reference.
+//
+// Build: g++ -O2 -std=c++17 -o baseline baseline.cpp
+// Run:   ./baseline [num_events]   (prints events/sec)
+
+#include <chrono>
+#include <cstdio>
+#include <cstdint>
+#include <deque>
+#include <random>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+struct StreamEvent {
+    int64_t ts;
+    // Object[] outputData equivalent: boxed attribute cells
+    std::string symbol;
+    double price;
+    int64_t volume;
+    int type;  // 0 CURRENT, 1 EXPIRED
+};
+
+struct AttributeAggregator {
+    virtual void processAdd(const StreamEvent& e) = 0;
+    virtual void processRemove(const StreamEvent& e) = 0;
+    virtual double currentValue() const = 0;
+    virtual ~AttributeAggregator() = default;
+};
+
+struct AvgAggregator : AttributeAggregator {
+    double sum = 0.0;
+    int64_t count = 0;
+    void processAdd(const StreamEvent& e) override { sum += e.price; count++; }
+    void processRemove(const StreamEvent& e) override { sum -= e.price; count--; }
+    double currentValue() const override { return count ? sum / count : 0.0; }
+};
+
+struct SumAggregator : AttributeAggregator {
+    int64_t total = 0;
+    void processAdd(const StreamEvent& e) override { total += e.volume; }
+    void processRemove(const StreamEvent& e) override { total -= e.volume; }
+    double currentValue() const override { return (double)total; }
+};
+
+struct GroupState {
+    AvgAggregator avg;
+    SumAggregator sum;
+};
+
+int main(int argc, char** argv) {
+    const int64_t N = argc > 1 ? atoll(argv[1]) : 20'000'000LL;
+    const int NUM_KEYS = 10'000;
+    const size_t WINDOW = 1'000;
+
+    std::vector<std::string> symbols;
+    symbols.reserve(NUM_KEYS);
+    for (int i = 0; i < NUM_KEYS; i++) symbols.push_back("SYM" + std::to_string(i));
+
+    std::mt19937_64 rng(0);
+    std::uniform_int_distribution<int> key_dist(0, NUM_KEYS - 1);
+    std::uniform_real_distribution<double> price_dist(0.0, 100.0);
+    std::uniform_int_distribution<int64_t> vol_dist(1, 1000);
+
+    // pre-generate inputs so generation cost stays out of the measurement
+    // (the reference harness reads fields prepared before the loop)
+    const int POOL = 1 << 16;
+    std::vector<int> keys(POOL);
+    std::vector<double> prices(POOL);
+    std::vector<int64_t> vols(POOL);
+    for (int i = 0; i < POOL; i++) {
+        keys[i] = key_dist(rng);
+        prices[i] = price_dist(rng);
+        vols[i] = vol_dist(rng);
+    }
+
+    std::deque<StreamEvent*> window;  // SnapshotableStreamEventQueue role
+    std::unordered_map<std::string, GroupState*> groups;  // keyed state map
+    groups.reserve(NUM_KEYS * 2);
+
+    volatile double sink = 0.0;  // consume outputs (QueryCallback role)
+    auto t0 = std::chrono::steady_clock::now();
+
+    for (int64_t i = 0; i < N; i++) {
+        const int slot = (int)(i & (POOL - 1));
+        // StreamEventFactory.newInstance + converter
+        StreamEvent* ev = new StreamEvent{ i, symbols[keys[slot]],
+                                           prices[slot], vols[slot], 0 };
+
+        // LengthWindowProcessor: when full, oldest leaves as EXPIRED first
+        StreamEvent* expired = nullptr;
+        if (window.size() == WINDOW) {
+            expired = window.front();
+            window.pop_front();
+            expired->type = 1;
+        }
+        window.push_back(ev);
+
+        // QuerySelector.processGroupBy: EXPIRED then CURRENT, each builds
+        // the string group key, resolves state, updates, emits a row
+        if (expired) {
+            std::string gkey = expired->symbol;  // key string built per event
+            auto it = groups.find(gkey);
+            GroupState* st = it->second;
+            st->avg.processRemove(*expired);
+            st->sum.processRemove(*expired);
+            sink += st->avg.currentValue() + st->sum.currentValue();
+            delete expired;
+        }
+        {
+            std::string gkey = ev->symbol;
+            auto it = groups.find(gkey);
+            GroupState* st;
+            if (it == groups.end()) {
+                st = new GroupState();
+                groups.emplace(gkey, st);
+            } else {
+                st = it->second;
+            }
+            st->avg.processAdd(*ev);
+            st->sum.processAdd(*ev);
+            sink += st->avg.currentValue() + st->sum.currentValue();
+        }
+    }
+
+    auto t1 = std::chrono::steady_clock::now();
+    double secs = std::chrono::duration<double>(t1 - t0).count();
+    printf("{\"baseline_events_per_sec\": %.1f, \"events\": %lld, \"secs\": %.2f, \"sink\": %.3g}\n",
+           N / secs, (long long)N, secs, (double)sink);
+    return 0;
+}

@@ -52,7 +52,7 @@ def main():
     """.format(W=WINDOW)
 
     rng = np.random.default_rng(0)
-    for BATCH in (8_192, 32_768, 131_072, 524_288):
+    for BATCH in (8_192, 32_768, 131_072):
         manager = SiddhiManager()
         rt = manager.create_siddhi_app_runtime(APP)
         rt.start()

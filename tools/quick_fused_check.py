@@ -1,0 +1,52 @@
+import time
+
+import numpy as np
+
+t00 = time.time()
+from siddhi_tpu import SiddhiManager  # noqa: E402
+from siddhi_tpu.core.plan.selector_plan import GK_KEY  # noqa: E402
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY  # noqa: E402
+import jax  # noqa: E402
+
+APP = """
+define stream StockStream (symbol string, price float, volume long);
+@info(name = 'bench')
+from StockStream#window.length(1000)
+select symbol, avg(price) as avgPrice, sum(volume) as totalVolume
+group by symbol
+insert into OutStream;
+"""
+
+m = SiddhiManager()
+rt = m.create_siddhi_app_runtime(APP)
+print("created", round(time.time() - t00, 1), flush=True)
+q = rt.query_runtimes["bench"]
+q.selector_plan.num_keys = 16384
+from siddhi_tpu.ops.fused_agg import FusedSlidingAggStage  # noqa: E402
+
+print("fused?", isinstance(q.window_stage, FusedSlidingAggStage), flush=True)
+B = 1024
+rng = np.random.default_rng(0)
+sym = rng.integers(0, 10000, B, dtype=np.int64)
+cols = {
+    TS_KEY: np.arange(B, dtype=np.int64),
+    TYPE_KEY: np.zeros(B, np.int8),
+    VALID_KEY: np.ones(B, bool),
+    "symbol": sym, "symbol?": np.zeros(B, bool),
+    "price": np.ones(B, np.float32), "price?": np.zeros(B, bool),
+    "volume": np.ones(B, np.int64), "volume?": np.zeros(B, bool),
+    GK_KEY: sym.astype(np.int32),
+}
+state = q._init_state()
+step = jax.jit(q.build_step_fn(), donate_argnums=0)
+t0 = time.time()
+state, out = step(state, cols, np.int64(0))
+jax.block_until_ready(state)
+print("first step", round(time.time() - t0, 1), flush=True)
+t0 = time.time()
+for _ in range(50):
+    state, out = step(state, cols, np.int64(0))
+jax.block_until_ready(state)
+print("per-step ms:", round((time.time() - t0) * 20, 2), flush=True)
+m.shutdown()
+print("done", flush=True)

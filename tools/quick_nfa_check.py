@@ -1,0 +1,65 @@
+import time
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+@app:playback
+define stream AStream (k string, v double);
+define stream BStream (k string, v double);
+partition with (k of AStream, k of BStream)
+begin
+  @info(name = 'nfa')
+  from every e1=AStream -> e2=BStream[e2.v > e1.v] within 5 sec
+  select e1.v as v1, e2.v as v2
+  insert into MatchStream;
+end;
+"""
+
+NUM_KEYS = 10_000
+m = SiddhiManager()
+rt = m.create_siddhi_app_runtime(APP)
+
+
+class Counter(StreamCallback):
+    n = 0
+
+    def receive_batch(self, batch, junction):
+        Counter.n += batch.size
+
+    def receive(self, events):
+        Counter.n += len(events)
+
+
+rt.add_callback("MatchStream", Counter())
+ha = rt.get_input_handler("AStream")
+hb = rt.get_input_handler("BStream")
+
+warm_keys = np.array([f"K{i}" for i in range(NUM_KEYS)], dtype=object)
+ts0 = np.full(NUM_KEYS, 1_000, np.int64)
+t0 = time.time()
+ha.send_columns({"k": warm_keys, "v": np.zeros(NUM_KEYS)}, timestamps=ts0)
+print("warm A (compile):", round(time.time() - t0, 1), flush=True)
+t0 = time.time()
+hb.send_columns({"k": warm_keys, "v": np.ones(NUM_KEYS)}, timestamps=ts0 + 1)
+print("warm B (compile):", round(time.time() - t0, 1), flush=True)
+
+rng = np.random.default_rng(2)
+B = 1024
+t_ms = 10_000
+for it in range(5):
+    keys = rng.integers(0, NUM_KEYS, B)
+    ka = np.array([f"K{i}" for i in keys], dtype=object)
+    va = rng.random(B) * 100.0
+    ts = np.full(B, t_ms, np.int64)
+    t0 = time.time()
+    ha.send_columns({"k": ka, "v": va}, timestamps=ts)
+    ta = time.time() - t0
+    t0 = time.time()
+    hb.send_columns({"k": ka, "v": va + 1.0}, timestamps=ts + 1)
+    tb = time.time() - t0
+    print(f"batch {it}: A {ta*1000:.1f} ms, B {tb*1000:.1f} ms", flush=True)
+    t_ms += 10
+print("matches:", Counter.n, flush=True)
+m.shutdown()
