@@ -170,8 +170,10 @@ class FusedSlidingAggStage:
         deltas = self._deltas(cols, ctx)                   # per-column [B]
 
         # arrival ranks (i32 — stream position never enters the math)
-        rank = jnp.cumsum(valid_cur.astype(jnp.int32)) - 1
-        n_ins = jnp.sum(valid_cur.astype(jnp.int32))
+        # pin i32: under x64, sum/cumsum otherwise promote to i64 and the
+        # step's output avals stop matching init_state (double compile)
+        rank = jnp.cumsum(valid_cur, dtype=jnp.int32) - 1
+        n_ins = jnp.sum(valid_cur, dtype=jnp.int32)
 
         # rank -> batch row (for same-batch evictions when n_ins > W)
         rank_to_row = jnp.zeros((B,), jnp.int32).at[
