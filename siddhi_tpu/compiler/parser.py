@@ -611,27 +611,21 @@ class Parser:
         return self.parse_state_source(sep, state_type)
 
     def parse_state_source(self, sep: str, state_type) -> StateElement:
-        """One pattern source: logical / count / absent / plain stream."""
-        if self.accept_kw("not"):
-            absent = self.parse_absent_stream()
-            if self.accept_kw("and"):
-                other = self.parse_standard_state_stream()
-                return LogicalStateElement(stream1=absent, type="and", stream2=other)
-            if self.accept_kw("for"):
-                absent.waiting_time = self.parse_time_constant().value
-                return absent
-            self.error("absent pattern requires 'and <stream>' or 'for <time>'")
-        first = self.parse_standard_state_stream()
+        """One pattern source: logical / count / absent / plain stream.
+        Absent sides (``not X [for t]``) may pair with present or absent
+        sides through and/or (reference SiddhiQL.g4 absent_pattern_source /
+        logical_absent_stateful_source)."""
+        first = self.parse_maybe_absent_stream()
         t = self.peek()
         if t.is_kw("and", "or"):
             op = self.next().text.lower()
-            if self.accept_kw("not"):
-                absent = self.parse_absent_stream()
-                if op != "and":
-                    self.error("'or not' is not a valid logical pattern")
-                return LogicalStateElement(stream1=first, type="and", stream2=absent)
-            second = self.parse_standard_state_stream()
+            second = self.parse_maybe_absent_stream()
             return LogicalStateElement(stream1=first, type=op, stream2=second)
+        if isinstance(first, AbsentStreamStateElement):
+            if first.waiting_time is None:
+                self.error(
+                    "absent pattern requires 'for <time>' or an and/or pairing")
+            return first
         # count / regex quantifiers
         if t.is_op("<"):
             return self.parse_count_suffix(first)
@@ -683,6 +677,15 @@ class Parser:
         stream.stream_reference_id = ref
         el = StreamStateElement(stream=stream)
         return el
+
+    def parse_maybe_absent_stream(self) -> StreamStateElement:
+        """Either ``not X [for t]`` or a plain (possibly captured) stream."""
+        if self.accept_kw("not"):
+            absent = self.parse_absent_stream()
+            if self.accept_kw("for"):
+                absent.waiting_time = self.parse_time_constant().value
+            return absent
+        return self.parse_standard_state_stream()
 
     def parse_absent_stream(self) -> AbsentStreamStateElement:
         stream = self.parse_single_input_stream()

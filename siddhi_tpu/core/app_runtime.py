@@ -339,6 +339,8 @@ class SiddhiAppRuntime:
             for qr in self.query_runtimes.values():
                 if qr.rate_limiter is not None:
                     qr.rate_limiter.start(scheduler)
+                if hasattr(qr, "arm_initial"):
+                    qr.arm_initial()  # head-absent patterns wait from start
             for tr in self.trigger_runtimes:
                 tr.start()
 

@@ -214,6 +214,7 @@ def plan_nfa_query(
     idx_exprs += [oa.expression for oa in query.selector.selection_list]
     if query.selector.having is not None:
         idx_exprs.append(query.selector.having)
+    idx_exprs += list(query.selector.group_by_list)
     assign_indexed_captures(plan, idx_exprs)
 
     for st in plan.steps:
@@ -234,10 +235,6 @@ def plan_nfa_query(
         raise CompileError(
             f"query '{query_name}': pattern/sequence queries need an explicit "
             f"select list (e.g. select e1.price, e2.price)"
-        )
-    if query.selector.group_by_list:
-        raise CompileError(
-            f"query '{query_name}': group by on pattern queries is not supported yet"
         )
 
     out_resolver = NFAOutputResolver(plan, dictionary)
@@ -262,6 +259,16 @@ def plan_nfa_query(
                 )
             stream_keyers[sid] = partition_ctx.keyers[sid]
 
+    # group-by over capture columns: a host keyer runs between the NFA
+    # emission and the selector step (GroupByKeyGenerator.java:37)
+    out_keyer = None
+    if query.selector.group_by_list:
+        fns = []
+        for var in query.selector.group_by_list:
+            fn, t = compile_expr(var, out_resolver)
+            fns.append((fn, t))
+        out_keyer = GroupKeyer(fns)
+
     return NFAQueryRuntime(
         name=query_name,
         app_context=app_context,
@@ -271,6 +278,7 @@ def plan_nfa_query(
         selector_plan=selector_plan,
         dictionary=dictionary,
         partition_ctx=partition_ctx,
+        out_keyer=out_keyer,
     )
 
 

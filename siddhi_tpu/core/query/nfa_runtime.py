@@ -5,6 +5,12 @@ The counterpart of the reference's pattern receivers + state runtime
 one runtime subscribes to every junction the pattern consumes (via
 ``StreamProxy`` receivers); each arriving chunk runs that stream's jitted
 NFA transition (``ops/nfa.py``) fused with the query's selector stage.
+
+Absent (`not ... for t`) deadlines additionally drive a scheduler loop:
+every device step reports the earliest pending deadline (``__notify__``),
+the scheduler wakes the runtime at that time, and ``process_timer`` runs a
+jitted all-keys deadline sweep (``NFAStage.apply_timer``) — the role of the
+reference's ``Scheduler`` + ``AbsentStreamPreStateProcessor`` timer chain.
 """
 
 from __future__ import annotations
@@ -19,7 +25,7 @@ from siddhi_tpu.core.event import Event, HostBatch
 from siddhi_tpu.core.plan.selector_plan import GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime
 from siddhi_tpu.core.stream.junction import Receiver
-from siddhi_tpu.ops.expressions import PK_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
 from siddhi_tpu.ops.nfa import NFAStage
 from siddhi_tpu.query_api.definitions import StreamDefinition
 
@@ -38,6 +44,9 @@ class StreamProxy(Receiver):
         batch = HostBatch.from_events(events, self.definition, self.runtime.dictionary)
         self.runtime.process_stream_batch(self.stream_id, batch)
 
+    def receive_batch(self, batch: HostBatch, junction=None):
+        self.runtime.process_stream_batch(self.stream_id, batch)
+
 
 class NFAQueryRuntime(QueryRuntime):
     def __init__(
@@ -50,6 +59,7 @@ class NFAQueryRuntime(QueryRuntime):
         selector_plan,
         dictionary,
         partition_ctx=None,
+        out_keyer=None,
     ):
         super().__init__(
             name=name,
@@ -58,7 +68,7 @@ class NFAQueryRuntime(QueryRuntime):
             filters=[],
             window_stage=None,
             selector_plan=selector_plan,
-            keyer=None,
+            keyer=out_keyer,          # group-by over capture columns
             dictionary=dictionary,
             partition_ctx=partition_ctx,
         )
@@ -66,6 +76,8 @@ class NFAQueryRuntime(QueryRuntime):
         self.input_defs = input_defs
         self.stream_keyers = stream_keyers  # stream id -> partition keyer|None
         self._steps: Dict[str, object] = {}
+        self._timer_step = None
+        self._sel_step = None
 
     # -------------------------------------------------------------- wiring
 
@@ -88,22 +100,119 @@ class NFAQueryRuntime(QueryRuntime):
         super()._ensure_capacity()
         if (self.selector_plan.num_keys, self._win_keys) != before:
             self._steps.clear()
+            self._timer_step = None
+            self._sel_step = None
+
+    def arm_initial(self):
+        """Arm key 0's head wait at app start (reference: absent pre-state
+        processors schedule their first deadline when the runtime starts —
+        ``AbsentStreamPreStateProcessor.java`` partitionCreated/start)."""
+        plan = self.stage.plan
+        arm_j = plan.arm_step()
+        if arm_j is None or self.partition_ctx is not None:
+            return
+        with self._lock:
+            if self._state is None:
+                self._state = self._init_state()
+            nfa = {k: np.asarray(v) for k, v in self._state["nfa"].items()}
+            if nfa["armed"][0]:
+                return
+            # playback timelines have no wall origin: arm at t=0 so the
+            # head wait is counted from the timeline start
+            now = 0 if self.app_context.playback else int(
+                self.app_context.timestamp_generator.current_time())
+            nfa["armed"] = nfa["armed"].copy()
+            nfa["armed"][0] = True
+            nfa["active"] = nfa["active"].copy()
+            nfa["active"][0, 0] = True
+            nfa["stepi"] = nfa["stepi"].copy()
+            nfa["stepi"][0, 0] = arm_j
+            nfa["sts"] = nfa["sts"].copy()
+            nfa["sts"][0, 0] = now
+            st = plan.steps[arm_j]
+            next_dl = None
+            if st.kind == "absent":
+                nfa["adl"] = nfa["adl"].copy()
+                nfa["adl"][0, 0] = now + st.wait_ms
+                next_dl = now + st.wait_ms
+            else:
+                for side in st.sides:
+                    if side.absent and side.wait_ms is not None:
+                        key = "adl" if side.bit == 1 else "adl2"
+                        nfa[key] = nfa[key].copy()
+                        nfa[key][0, 0] = now + side.wait_ms
+                        dl = now + side.wait_ms
+                        next_dl = dl if next_dl is None else min(next_dl, dl)
+            for g, (a, b, t) in enumerate(plan.scopes):
+                if a == arm_j and plan.steps[arm_j].waitish:
+                    col = f"wts{g}"
+                    nfa[col] = nfa[col].copy()
+                    nfa[col][0, 0] = now
+                    nfa["capdone"] = nfa["capdone"].copy()
+                    nfa["capdone"][0, 0] |= plan.scope_bit(g)
+            self._state["nfa"] = {k: jnp.asarray(v) for k, v in nfa.items()}
+        if next_dl is not None and self.scheduler is not None:
+            self.scheduler.notify_at(int(next_dl), self.process_timer)
+
+    # ---------------------------------------------------------- step builds
 
     def build_stream_step_fn(self, stream_id: str):
         """Pure (state, cols, now) -> (state', out) for one input stream —
-        the NFA transition fused with the selector stage."""
+        the NFA transition fused with the selector stage (unless a host
+        group-by keyer has to run between them)."""
         stage = self.stage
         sel = self.selector_plan
+        split = self.keyer is not None
 
         def step(state, cols, current_time):
             ctx = {"xp": jnp, "current_time": current_time}
             new_nfa, out_cols = stage.apply_stream(stream_id, state["nfa"], cols, ctx)
             out_cols = dict(out_cols)
             overflow = out_cols.pop("__overflow__", None)
+            notify = out_cols.pop("__notify__", None)
+            if split:
+                out_cols["__overflow__"] = overflow
+                out_cols["__notify__"] = notify
+                return {"nfa": new_nfa, "sel": state["sel"]}, out_cols
             new_sel, out = sel.apply(state["sel"], out_cols, ctx)
             if overflow is not None:
                 out["__overflow__"] = overflow
+            if notify is not None:
+                out["__notify__"] = notify
             return {"nfa": new_nfa, "sel": new_sel}, out
+
+        return step
+
+    def build_timer_step_fn(self):
+        stage = self.stage
+        sel = self.selector_plan
+        split = self.keyer is not None
+
+        def step(state, now):
+            ctx = {"xp": jnp, "current_time": now}
+            new_nfa, out_cols = stage.apply_timer(state["nfa"], now, ctx)
+            out_cols = dict(out_cols)
+            overflow = out_cols.pop("__overflow__", None)
+            notify = out_cols.pop("__notify__", None)
+            if split:
+                out_cols["__overflow__"] = overflow
+                out_cols["__notify__"] = notify
+                return {"nfa": new_nfa, "sel": state["sel"]}, out_cols
+            new_sel, out = sel.apply(state["sel"], out_cols, ctx)
+            if overflow is not None:
+                out["__overflow__"] = overflow
+            if notify is not None:
+                out["__notify__"] = notify
+            return {"nfa": new_nfa, "sel": new_sel}, out
+
+        return step
+
+    def _sel_step_fn(self):
+        sel = self.selector_plan
+
+        def step(sel_state, cols, current_time):
+            ctx = {"xp": jnp, "current_time": current_time}
+            return sel.apply(sel_state, cols, ctx)
 
         return step
 
@@ -135,9 +244,52 @@ class NFAQueryRuntime(QueryRuntime):
             if step is None:
                 step = jax.jit(self.build_stream_step_fn(stream_id), donate_argnums=0)
                 self._steps[stream_id] = step
-            self._finish_device_batch(
-                step, cols,
-                "pattern match-slot capacity exceeded — raise app_context.nfa_slots")
+            notify = self._run_nfa_step(lambda: step(
+                self._state, cols,
+                np.int64(self.app_context.timestamp_generator.current_time())))
+        if notify is not None and self.scheduler is not None:
+            self.scheduler.notify_at(notify, self.process_timer)
+
+    def process_timer(self, ts: int):
+        with self._lock:
+            if self._state is None:
+                self._state = self._init_state()
+            if self._timer_step is None:
+                self._timer_step = jax.jit(self.build_timer_step_fn(),
+                                           donate_argnums=0)
+            notify = self._run_nfa_step(
+                lambda: self._timer_step(self._state, np.int64(ts)))
+        if notify is not None and self.scheduler is not None:
+            self.scheduler.notify_at(notify, self.process_timer)
+
+    def _run_nfa_step(self, run) -> int | None:
+        """Run a jitted NFA step; when a group-by keyer splits the pipeline,
+        key the NFA emissions host-side and run the selector step after."""
+        self._state, out = run()
+        out_host = {k: np.asarray(v) for k, v in out.items()}
+        overflow = out_host.pop("__overflow__", None)
+        if overflow is not None and int(overflow) > 0:
+            raise RuntimeError(
+                f"query '{self.name}': pattern match-slot capacity exceeded — "
+                f"raise app_context.nfa_slots before creating the runtime"
+            )
+        notify = out_host.pop("__notify__", None)
+        if self.keyer is not None:
+            pk = out_host.get(PK_KEY) if self.partition_ctx is not None else None
+            out_host[GK_KEY] = self.keyer(out_host, pk=pk)
+            self._ensure_capacity()
+            if self._sel_step is None:
+                self._sel_step = jax.jit(self._sel_step_fn(), donate_argnums=0)
+            now = np.int64(self.app_context.timestamp_generator.current_time())
+            new_sel, sel_out = self._sel_step(self._state["sel"], out_host, now)
+            self._state["sel"] = new_sel
+            out_host = {k: np.asarray(v) for k, v in sel_out.items()}
+            out_host.pop("__notify__", None)
+            out_host.pop("__overflow__", None)
+        self._emit(HostBatch(out_host))
+        if notify is not None and int(notify) >= 0:
+            return int(notify)
+        return None
 
     def receive(self, events: List[Event]):  # pragma: no cover — proxies only
         raise RuntimeError("NFA queries receive through per-stream proxies")

@@ -8,8 +8,12 @@ fixed-capacity slot tensors:
     active  [K, S] bool      — slot holds a partial match
     stepi   [K, S] int32     — pattern position the slot is resting at
     bits    [K, S] int32     — matched-sides mask for logical and/or steps
+    vbits   [K, S] int32     — violated absent sides of a logical step
     sts     [K, S] int64     — first-event timestamp (drives `within`)
+    adl/adl2 [K, S] int64    — absent-side deadlines (`not X for t`)
+    wts<g>  [K, S] int64     — per-`within`-scope start timestamps
     capdone [K, S] int32     — bitmask of capture-ids already filled
+                               (top bits flag started within-scopes)
     caps    {c<cid>__<col>: [K, S]} — captured attribute values per ref
             (count refs also keep per-index slots c<cid>i<i>__<col> and an
              occurrence counter c<cid>__#n)
@@ -27,8 +31,22 @@ Semantics reproduced (reference file:line):
   (``StreamPreStateProcessor.java:382-395``).
 - ``every`` re-arms the start state for every event
   (``addEveryState``:230-247); without it the start arms exactly once.
+  Mid-chain ``every`` marks the wrapped element *sticky*: a slot resting at
+  a sticky step never advances itself — each match forks an advanced child
+  (reference EveryInnerStateRuntime re-initialisation).
 - ``within`` expires partial matches lazily against the triggering event's
-  timestamp (``isExpired``:118, ``expireEvents``:326).
+  timestamp (``isExpired``:118, ``expireEvents``:326); sub-pattern
+  ``(...) within t`` scopes clock from the scope's first captured event
+  (reference WithinStateElement / StateInputStream.java:61-75).
+- Absent states (``not X [filter] for t`` — reference
+  ``AbsentStreamPreStateProcessor.java``): a slot *waits* at the absent
+  step with a deadline; a matching event before the deadline kills the
+  wait (violation), the deadline passing advances it. Deadlines fire
+  lazily against same-key traffic and eagerly via the scheduler's TIMER
+  sweep (``apply_timer``). Logical steps may have absent sides with or
+  without ``for`` (``LogicalPreStateProcessor``): without a wait the
+  absent side is satisfied-unless-violated; with a wait it completes at
+  its deadline.
 - Count states ``e<min:max>`` accumulate into ONE partial match (no
   per-event forking — ``CountPatternTestCase.testQuery1`` expects a single
   match for 3 accumulated events); once ``min`` is reached the match is
@@ -41,10 +59,10 @@ Semantics reproduced (reference file:line):
 - Logical ``and``/``or`` match sides in any order
   (``LogicalPreStateProcessor``).
 
-Known gaps (reported as CompileError): absent (`not ... for`) states,
-mid-pattern `every`, `e[last]` indexing, an event forking one slot down two
-paths at once (same-stream adjacent steps where both could consume it —
-the furthest-advanced transition wins here).
+Known gaps (reported as CompileError): `e[last]` indexing, absent states
+inside SEQUENCE queries (the reference forbids them too), an event forking
+one slot down two non-sticky paths at once (the furthest-advanced
+transition wins here).
 """
 
 from __future__ import annotations
@@ -81,6 +99,7 @@ from siddhi_tpu.query_api.expressions import Expression, Variable
 
 CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
 ANY_MAX = 2 ** 30
+FAR_FUTURE = jnp.int64(2 ** 62)
 
 
 # --------------------------------------------------------------------- plan
@@ -102,27 +121,48 @@ class CaptureSpec:
 class SideSpec:
     """One stream-consuming side of a step (logical steps have two)."""
 
-    capture: CaptureSpec
+    stream_id: str
+    definition: StreamDefinition
+    capture: Optional[CaptureSpec]       # None for absent sides
     filter_exprs: list = field(default_factory=list)  # query-api Expressions
     cond: Optional[Callable] = None                   # compiled later
     bit: int = 1
+    absent: bool = False
+    wait_ms: Optional[int] = None        # absent `for <t>` deadline
 
 
 @dataclass
 class StepSpec:
     index: int
-    kind: str                    # 'stream' | 'count' | 'and' | 'or'
+    kind: str                    # 'stream' | 'count' | 'absent' | 'and' | 'or'
     sides: List[SideSpec]
     min_count: int = 1
     max_count: int = 1
+    sticky: bool = False         # mid-chain `every` re-arm point
+    wait_ms: Optional[int] = None  # absent steps
 
     @property
-    def full_bits(self) -> int:
-        return (1 << len(self.sides)) - 1
+    def need_bits(self) -> int:
+        """Sides that must affirmatively fire for an 'and' step to
+        complete: present sides plus absent sides with a deadline (absent
+        sides *without* a wait are satisfied-unless-violated)."""
+        b = 0
+        for s in self.sides:
+            if not s.absent or s.wait_ms is not None:
+                b |= s.bit
+        return b
 
     @property
     def skippable(self) -> bool:
         return self.kind == "count" and self.min_count == 0
+
+    @property
+    def waitish(self) -> bool:
+        """The step holds resting slots with deadlines."""
+        if self.kind == "absent":
+            return True
+        return self.kind in ("and", "or") and any(
+            s.absent and s.wait_ms is not None for s in self.sides)
 
 
 @dataclass
@@ -134,21 +174,60 @@ class NFAPlan:
     within: Optional[int]        # milliseconds, whole-pattern
     slots: int
     stream_ids: List[str]        # unique consumed stream ids, stable order
+    scopes: List[Tuple[int, int, int]] = field(default_factory=list)
 
     @property
     def last_step(self) -> int:
         return len(self.steps) - 1
 
+    @property
+    def has_absent(self) -> bool:
+        return any(
+            st.kind == "absent" or any(s.absent for s in st.sides)
+            for st in self.steps
+        )
 
-def _flatten_chain(el) -> List:
+    def scope_bit(self, g: int) -> int:
+        """capdone bit flagging scope g as started (top bits, below sign)."""
+        return 1 << (30 - g)
+
+    def arm_step(self) -> Optional[int]:
+        """Head step that needs an *armed* waiting slot at key creation:
+        the first non-skippable step when it is absent-ish (a pure-present
+        head arms lazily through fresh starts instead)."""
+        for st in self.steps:
+            if st.skippable:
+                continue
+            if st.kind == "absent" or (
+                st.kind in ("and", "or") and any(s.absent for s in st.sides)
+            ):
+                return st.index
+            return None
+        return None
+
+
+def _flatten(el, elements: List, scopes: List, sticky_at: set, depth: int):
+    """Linearize the state-element tree; record `within` scopes as element
+    index ranges and mid-chain `every` re-arm points."""
     if isinstance(el, NextStateElement):
+        a = len(elements)
+        _flatten(el.state, elements, scopes, sticky_at, depth + 1)
+        _flatten(el.next, elements, scopes, sticky_at, depth + 1)
         if el.within is not None:
-            raise CompileError(
-                "`within` on a parenthesized sub-pattern is not supported yet "
-                "— apply it to the whole pattern"
-            )
-        return _flatten_chain(el.state) + _flatten_chain(el.next)
-    return [el]
+            scopes.append((a, len(elements) - 1, el.within))
+        return
+    if isinstance(el, EveryStateElement):
+        a = len(elements)
+        _flatten(el.state, elements, scopes, sticky_at, depth + 1)
+        if a > 0:
+            sticky_at.add(a)          # mid-chain every: re-arm point
+        if el.within is not None:
+            scopes.append((a, len(elements) - 1, el.within))
+        return
+    a = len(elements)
+    elements.append(el)
+    if getattr(el, "within", None) is not None:
+        scopes.append((a, a, el.within))
 
 
 def build_nfa_plan(
@@ -159,60 +238,64 @@ def build_nfa_plan(
     """Linearize the state-element tree into step specs (the role of
     ``StateInputStreamParser.java:76-210`` building the InnerStateRuntime
     tree — flat here because the chain is executed as step indices)."""
-    every = False
     within = state_stream.within
     root = state_stream.state_element
+
+    elements: List = []
+    scopes: List[Tuple[int, int, int]] = []
+    sticky_at: set = set()
+    _flatten(root, elements, scopes, sticky_at, 0)
+
+    # `every` wrapping the head (whole pattern or first element) is the
+    # global re-arm flag; scopes recorded at element 0 spanning everything
+    # with the root's within fold into the whole-pattern within
+    every = False
     if isinstance(root, EveryStateElement):
-        # `every (...) within t` scopes the whole pattern here
         every = True
         if root.within is not None:
-            within = root.within if within is None else min(within, root.within)
-        root = root.state
-    elements = _flatten_chain(root)
-    if elements and isinstance(elements[0], EveryStateElement):
+            w = root.within
+            within = w if within is None else min(within, w)
+            scopes = [s for s in scopes
+                      if not (s[0] == 0 and s[1] == len(elements) - 1 and s[2] == w)]
+    elif elements and 0 not in sticky_at:
+        # `every A -> B` parses as Next(Every(A), B): sticky_at has no 0
+        # entry (depth>0 every at position 0 is the global flag)
+        first = root
+        while isinstance(first, NextStateElement):
+            first = first.state
+        if isinstance(first, EveryStateElement):
+            every = True
+    if 0 in sticky_at:
+        sticky_at.discard(0)
         every = True
-        ev0 = elements[0]
-        if ev0.within is not None and len(elements) > 1:
-            raise CompileError(
-                "`within` scoped to the first pattern element is not supported "
-                "yet — apply it to the whole pattern"
-            )
-        if ev0.within is not None:
-            within = ev0.within if within is None else min(within, ev0.within)
-        elements = _flatten_chain(ev0.state) + elements[1:]
-    # `every` deeper in the chain needs mid-pattern re-arming (reference
-    # EveryInnerStateRuntime) — not supported yet
-    for el in elements:
-        if isinstance(el, EveryStateElement):
-            raise CompileError(
-                "`every` is only supported wrapping the whole pattern or its "
-                "first element"
-            )
-        if el.within is not None:
-            raise CompileError(
-                "per-element `within` is not supported yet — apply it to the "
-                "whole pattern"
-            )
+
+    sequence = state_stream.state_type == StateInputStreamType.SEQUENCE
 
     captures: List[CaptureSpec] = []
     steps: List[StepSpec] = []
 
-    def make_capture(stream_el: StreamStateElement, is_count: bool) -> SideSpec:
+    from siddhi_tpu.query_api.execution import Filter
+
+    def make_side(stream_el: StreamStateElement, is_count: bool,
+                  absent: bool) -> SideSpec:
         s = stream_el.stream
         sid = s.stream_id
         if sid not in definitions:
             raise CompileError(f"pattern stream '{sid}' is not defined")
-        cap = CaptureSpec(
-            cid=len(captures),
-            ref_id=s.stream_reference_id,
-            stream_id=sid,
-            definition=definitions[sid],
-            is_count=is_count,
-        )
-        captures.append(cap)
+        cap = None
+        if not absent:
+            cap = CaptureSpec(
+                cid=len(captures),
+                ref_id=s.stream_reference_id,
+                stream_id=sid,
+                definition=definitions[sid],
+                is_count=is_count,
+            )
+            captures.append(cap)
+        elif s.stream_reference_id is not None:
+            raise CompileError(
+                "absent (`not`) pattern streams cannot be captured with e=")
         filters = []
-        from siddhi_tpu.query_api.execution import Filter
-
         for h in s.handlers:
             if isinstance(h, Filter):
                 filters.append(h.expression)
@@ -220,47 +303,85 @@ def build_nfa_plan(
                 raise CompileError(
                     "only [filter] handlers are allowed on pattern streams"
                 )
-        return SideSpec(capture=cap, filter_exprs=filters)
+        wait = getattr(stream_el, "waiting_time", None) if absent else None
+        return SideSpec(
+            stream_id=sid,
+            definition=definitions[sid],
+            capture=cap,
+            filter_exprs=filters,
+            absent=absent,
+            wait_ms=wait,
+        )
 
-    for el in elements:
+    for ei, el in enumerate(elements):
         idx = len(steps)
+        sticky = ei in sticky_at
         if isinstance(el, AbsentStreamStateElement):
-            raise CompileError("absent patterns (`not ... for`) land next")
-        if isinstance(el, CountStateElement):
-            side = make_capture(el.state, is_count=True)
+            if sequence:
+                raise CompileError(
+                    "absent (`not`) states are not allowed in sequences")
+            if el.waiting_time is None:
+                raise CompileError(
+                    "a chained absent pattern needs `for <time>`")
+            side = make_side(el, is_count=False, absent=True)
+            steps.append(StepSpec(index=idx, kind="absent", sides=[side],
+                                  sticky=sticky, wait_ms=el.waiting_time))
+        elif isinstance(el, CountStateElement):
+            side = make_side(el.state, is_count=True, absent=False)
             mn = el.min_count if el.min_count != CountStateElement.ANY else 0
             mx = el.max_count if el.max_count != CountStateElement.ANY else ANY_MAX
+            if sticky:
+                raise CompileError("`every` on a count state is not supported")
             steps.append(StepSpec(index=idx, kind="count", sides=[side],
                                   min_count=mn, max_count=mx))
         elif isinstance(el, LogicalStateElement):
-            if isinstance(el.stream1, AbsentStreamStateElement) or isinstance(
-                el.stream2, AbsentStreamStateElement
-            ):
-                raise CompileError("absent logical patterns land next")
-            side1 = make_capture(el.stream1, is_count=False)
-            side2 = make_capture(el.stream2, is_count=False)
-            side1.bit, side2.bit = 1, 2
-            steps.append(StepSpec(index=idx, kind=el.type, sides=[side1, side2]))
+            sides = []
+            for sub in (el.stream1, el.stream2):
+                absent = isinstance(sub, AbsentStreamStateElement)
+                if absent and sequence:
+                    raise CompileError(
+                        "absent (`not`) states are not allowed in sequences")
+                sides.append(make_side(sub, is_count=False, absent=absent))
+            sides[0].bit, sides[1].bit = 1, 2
+            if el.type == "or":
+                for s in sides:
+                    if s.absent and s.wait_ms is None:
+                        raise CompileError(
+                            "an absent `or` side needs `for <time>`")
+            if all(s.absent for s in sides) and el.type == "and":
+                for s in sides:
+                    if s.wait_ms is None:
+                        raise CompileError(
+                            "an all-absent `and` needs `for <time>` on both sides")
+            steps.append(StepSpec(index=idx, kind=el.type, sides=sides,
+                                  sticky=sticky))
         elif isinstance(el, StreamStateElement):
-            side = make_capture(el, is_count=False)
-            steps.append(StepSpec(index=idx, kind="stream", sides=[side]))
+            side = make_side(el, is_count=False, absent=False)
+            steps.append(StepSpec(index=idx, kind="stream", sides=[side],
+                                  sticky=sticky))
         else:
             raise CompileError(f"unsupported state element {type(el).__name__}")
 
     stream_ids: List[str] = []
     for st in steps:
         for side in st.sides:
-            if side.capture.stream_id not in stream_ids:
-                stream_ids.append(side.capture.stream_id)
+            if side.stream_id not in stream_ids:
+                stream_ids.append(side.stream_id)
+
+    if len(scopes) > 8:
+        raise CompileError("at most 8 nested `within` scopes are supported")
+    if len(captures) > 30 - len(scopes):
+        raise CompileError("too many pattern captures for one query")
 
     return NFAPlan(
         steps=steps,
         captures=captures,
         every=every,
-        sequence=state_stream.state_type == StateInputStreamType.SEQUENCE,
+        sequence=sequence,
         within=within,
         slots=slots,
         stream_ids=stream_ids,
+        scopes=scopes,
     )
 
 
@@ -317,6 +438,10 @@ def cap_cnt_col(cid: int) -> str:
     return f"c{cid}__#n"
 
 
+def scope_col(g: int) -> str:
+    return f"wts{g}"
+
+
 def _resolve_cap(plan: NFAPlan, var: Variable) -> Optional[Tuple[CaptureSpec, object]]:
     sid = var.stream_id
     for cap in plan.captures:
@@ -361,14 +486,15 @@ class NFASideResolver(Resolver):
 
     def resolve(self, var: Variable) -> ColumnRef:
         sid = var.stream_id
-        cap = self.side.capture
-        own = sid is None or sid == cap.ref_id or (cap.ref_id is None and sid == cap.stream_id)
+        side = self.side
+        ref_id = side.capture.ref_id if side.capture is not None else None
+        own = sid is None or sid == ref_id or (ref_id is None and sid == side.stream_id)
         if own and var.stream_index is None:
             try:
-                attr = cap.definition.attribute(var.attribute_name)
+                attr = side.definition.attribute(var.attribute_name)
                 return ColumnRef(attr.name, attr.type)
             except Exception:
-                if sid is not None:
+                if sid is not None and _cap_ref(self.plan, var) is None:
                     raise
         ref = _cap_ref(self.plan, var)
         if ref is not None:
@@ -434,6 +560,7 @@ class NFAStage:
     def __init__(self, plan: NFAPlan):
         self.plan = plan
         self.cap_cols = _cap_state_cols(plan)
+        self.scope_cols = [scope_col(g) for g in range(len(plan.scopes))]
 
     def init_state(self, num_keys: int = 1) -> dict:
         K, S = num_keys, self.plan.slots
@@ -441,11 +568,17 @@ class NFAStage:
             "active": jnp.zeros((K, S), bool),
             "stepi": jnp.zeros((K, S), jnp.int32),
             "bits": jnp.zeros((K, S), jnp.int32),
+            "vbits": jnp.zeros((K, S), jnp.int32),
             "sts": jnp.zeros((K, S), jnp.int64),
+            "adl": jnp.zeros((K, S), jnp.int64),
+            "adl2": jnp.zeros((K, S), jnp.int64),
             "capdone": jnp.zeros((K, S), jnp.int32),
             "consumed": jnp.zeros((K,), bool),
+            "armed": jnp.zeros((K,), bool),
             "nfa_overflow": jnp.int32(0),
         }
+        for g in self.scope_cols:
+            state[g] = jnp.zeros((K, S), jnp.int64)
         for name, dt in self.cap_cols.items():
             state[name] = jnp.zeros((K, S), dt)
         return state
@@ -470,8 +603,208 @@ class NFAStage:
 
     def _fresh_ok(self, j: int) -> bool:
         """A fresh (unstarted) match can begin at step j iff every earlier
-        step is a skippable min-0 count."""
+        step is a skippable min-0 count and step j itself has no absent
+        machinery (absent heads run through *armed* waiting slots)."""
+        st = self.plan.steps[j]
+        if st.kind == "absent" or any(s.absent for s in st.sides):
+            return False
         return all(self.plan.steps[p].skippable for p in range(j))
+
+    # ........................................................ slot entering
+
+    def _enter(self, V: dict, mask2d, j: int, ts2d):
+        """Slots (masked) come to rest at step j: set position, clear the
+        logical bookkeeping, arm absent deadlines, start entry scopes.
+        ``ts2d`` broadcasts against [B, S]."""
+        plan = self.plan
+        w = lambda dst, val: jnp.where(mask2d, val, dst)  # noqa: E731
+        V["ST"] = w(V["ST"], j)
+        V["BT"] = w(V["BT"], 0)
+        V["VB"] = w(V["VB"], 0)
+        if j <= plan.last_step:
+            st = plan.steps[j]
+            if st.kind == "absent":
+                V["ADL"] = w(V["ADL"], ts2d + jnp.int64(st.wait_ms))
+            elif st.kind in ("and", "or"):
+                for side in st.sides:
+                    if side.absent and side.wait_ms is not None:
+                        key = "ADL" if side.bit == 1 else "AD2"
+                        V[key] = w(V[key], ts2d + jnp.int64(side.wait_ms))
+            # scopes that start when a slot *arrives* at an absent-ish step
+            for g, (a, b, t) in enumerate(plan.scopes):
+                if a == j and st.waitish:
+                    V["SC"][g] = w(V["SC"][g], ts2d)
+                    V["CD"] = w(V["CD"], V["CD"] | plan.scope_bit(g))
+        return V
+
+    def _start_capture_scopes(self, V: dict, mask2d, j: int, ts2d):
+        """Scopes whose start step j captured its first event now."""
+        plan = self.plan
+        for g, (a, b, t) in enumerate(plan.scopes):
+            if a == j and not plan.steps[j].waitish:
+                started = (V["CD"] & plan.scope_bit(g)) != 0
+                m = mask2d & ~started
+                V["SC"][g] = jnp.where(m, ts2d, V["SC"][g])
+                V["CD"] = jnp.where(m, V["CD"] | plan.scope_bit(g), V["CD"])
+        return V
+
+    # .......................................................... expiry pass
+
+    def _expire(self, V: dict, ts2d):
+        """Kill partial matches past the whole-pattern `within` or past a
+        started scope's bound (reference expireEvents)."""
+        plan = self.plan
+        A = V["A"]
+        if plan.within is not None:
+            A = A & ~(ts2d > V["T0"] + jnp.int64(plan.within))
+        for g, (a, b, t) in enumerate(plan.scopes):
+            if a == 0 and b == plan.last_step:
+                # scope == whole pattern: same as plan.within on T0
+                A = A & ~(((V["CD"] & plan.scope_bit(g)) != 0)
+                          & (ts2d > V["SC"][g] + jnp.int64(t)))
+                continue
+            started = (V["CD"] & plan.scope_bit(g)) != 0
+            in_scope = (V["ST"] > a) & (V["ST"] <= b)
+            if plan.steps[a].waitish:
+                in_scope = in_scope | (V["ST"] == a)
+            A = A & ~(started & in_scope & (ts2d > V["SC"][g] + jnp.int64(t)))
+        V["A"] = A
+        return V
+
+    # ...................................................... deadline engine
+
+    def _cascade(self, V: dict, ts2d, emit, ets, fork_reqs: List):
+        """Advance waiting slots whose absent deadlines have passed; one
+        ascending pass chains consecutive waits. ``fork_reqs`` collects
+        (mask2d, target_step, arm_ts2d) for sticky re-arms needing a forked
+        child (allocated by the caller)."""
+        plan = self.plan
+        L = plan.last_step
+        for st in plan.steps:
+            j = st.index
+            if st.kind == "absent":
+                at = V["A"] & (V["ST"] == j)
+                due = at & (ts2d >= V["ADL"])
+                if st.sticky:
+                    if j == L:
+                        emit = emit | due
+                        ets = jnp.where(due, V["ADL"], ets)
+                    else:
+                        fork_reqs.append((due, j + 1, V["ADL"]))
+                    V["ADL"] = jnp.where(due, V["ADL"] + jnp.int64(st.wait_ms),
+                                         V["ADL"])
+                else:
+                    if j == L:
+                        emit = emit | due
+                        ets = jnp.where(due, V["ADL"], ets)
+                        V["A"] = V["A"] & ~due
+                    else:
+                        adl = V["ADL"]
+                        V = self._enter(V, due, j + 1, adl)
+            elif st.kind in ("and", "or"):
+                comp_ts = None
+                fired = jnp.zeros_like(V["A"])
+                for side in st.sides:
+                    if not (side.absent and side.wait_ms is not None):
+                        continue
+                    adlx = V["ADL"] if side.bit == 1 else V["AD2"]
+                    due_s = (
+                        V["A"] & (V["ST"] == j) & (ts2d >= adlx)
+                        & ((V["BT"] & side.bit) == 0)
+                        & ((V["VB"] & side.bit) == 0)
+                    )
+                    V["BT"] = jnp.where(due_s, V["BT"] | side.bit, V["BT"])
+                    fired = fired | due_s
+                    comp_ts = adlx if comp_ts is None else jnp.maximum(comp_ts, adlx)
+                if comp_ts is None:
+                    continue
+                if st.kind == "and":
+                    nb = st.need_bits
+                    comp = fired & ((V["BT"] & nb) == nb)
+                else:
+                    comp = fired
+                if st.sticky:
+                    if j == L:
+                        emit = emit | comp
+                        ets = jnp.where(comp, comp_ts, ets)
+                    else:
+                        fork_reqs.append((comp, j + 1, comp_ts))
+                    # re-arm the parent's deadlines for the next interval
+                    for side in st.sides:
+                        if side.absent and side.wait_ms is not None:
+                            key = "ADL" if side.bit == 1 else "AD2"
+                            V[key] = jnp.where(
+                                comp, V[key] + jnp.int64(side.wait_ms), V[key])
+                    V["BT"] = jnp.where(comp, 0, V["BT"])
+                    V["VB"] = jnp.where(comp, 0, V["VB"])
+                else:
+                    if j == L:
+                        emit = emit | comp
+                        ets = jnp.where(comp, comp_ts, ets)
+                        V["A"] = V["A"] & ~comp
+                    else:
+                        V = self._enter(V, comp, j + 1, comp_ts)
+        return V, emit, ets
+
+    def _next_deadline(self, state) -> jnp.ndarray:
+        """Earliest pending absent deadline across all keys/slots (FAR_FUTURE
+        when none) — drives scheduler wake-up."""
+        plan = self.plan
+        nd = FAR_FUTURE
+        A, ST = state["active"], state["stepi"]
+        for st in plan.steps:
+            j = st.index
+            if st.kind == "absent":
+                wait = A & (ST == j)
+                nd = jnp.minimum(nd, jnp.min(jnp.where(wait, state["adl"], FAR_FUTURE)))
+            elif st.kind in ("and", "or"):
+                for side in st.sides:
+                    if side.absent and side.wait_ms is not None:
+                        adlx = state["adl"] if side.bit == 1 else state["adl2"]
+                        wait = (
+                            A & (ST == j)
+                            & ((state["bits"] & side.bit) == 0)
+                            & ((state["vbits"] & side.bit) == 0)
+                        )
+                        nd = jnp.minimum(nd, jnp.min(jnp.where(wait, adlx, FAR_FUTURE)))
+        return nd
+
+    # ....................................................... fork allocator
+
+    def _alloc_forks(self, V: dict, req2d, overflow):
+        """Allocate one free slot per requesting slot and copy the source
+        slot's whole per-slot state into it. Returns (V, dst_mask,
+        overflow); callers then `_enter`/capture at dst_mask positions."""
+        S = self.plan.slots
+        A = V["A"]
+        B = A.shape[0]
+        free = ~A
+        n_free = jnp.sum(free, axis=1)
+        fs = jnp.argsort(
+            jnp.where(free, jnp.arange(S)[None, :], S + jnp.arange(S)[None, :]),
+            axis=1)
+        rank = jnp.cumsum(req2d, axis=1, dtype=jnp.int32) - 1
+        can = req2d & (rank < n_free[:, None])
+        overflow = overflow + jnp.sum(req2d & ~can).astype(jnp.int32)
+        dst = jnp.where(can, jnp.take_along_axis(fs, jnp.clip(rank, 0, S - 1), axis=1), S)
+        src_idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        ident = jnp.concatenate(
+            [src_idx, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        src_of_dst = ident.at[jnp.arange(B)[:, None], dst].set(
+            src_idx, mode="drop")[:, :S]
+        dst_mask = jnp.zeros((B, S + 1), bool).at[
+            jnp.arange(B)[:, None], dst].set(True, mode="drop")[:, :S]
+
+        def copy(col):
+            g = jnp.take_along_axis(col, src_of_dst, axis=1)
+            return jnp.where(dst_mask, g, col)
+
+        for key in ("ST", "BT", "VB", "T0", "ADL", "AD2", "CD"):
+            V[key] = copy(V[key])
+        V["CP"] = {n: copy(c) for n, c in V["CP"].items()}
+        V["SC"] = [copy(c) for c in V["SC"]]
+        V["A"] = A | dst_mask
+        return V, dst_mask, overflow
 
     # .................................................. one stream's step
 
@@ -491,15 +824,17 @@ class NFAStage:
         _o, _i, occ, _c, _s = _per_key_layout(pk, valid_cur, K)
         n_rounds = jnp.max(jnp.where(valid_cur, occ, -1)) + 1
 
-        # ops consuming this stream, in step order
+        # ops consuming this stream, in step order (absent sides included —
+        # their matches are violations, not advances)
         ops: List[Tuple[StepSpec, SideSpec]] = [
             (st, side)
             for st in plan.steps
             for side in st.sides
-            if side.capture.stream_id == stream_id
+            if side.stream_id == stream_id
         ]
-        in_def = ops[0][1].capture.definition if ops else None
+        in_def = ops[0][1].definition if ops else None
         cap_names = list(self.cap_cols)
+        arm_j = plan.arm_step()
 
         def capture_current(CP, CD, mask2d, cap: CaptureSpec, reset_counter: bool):
             """Write the current event into a capture (last + indexed slot +
@@ -526,21 +861,47 @@ class NFAStage:
             return CP, CD
 
         def round_body(carry):
-            (r, active, stepi, bits, sts, capdone, consumed, caps,
-             out_valid, out_caps, overflow) = carry
+            (r, active, stepi, bits, vbits, sts, adl, adl2, capdone, consumed,
+             armed, caps, scs, out_valid, out_caps, out_ts, overflow) = carry
             m = valid_cur & (occ == r)
             rows_pk = jnp.where(m, pk, K)
 
-            A = active[pk]
-            ST = stepi[pk]
-            BT = bits[pk]
-            T0 = sts[pk]
-            CD = capdone[pk]
-            CP = {n: caps[n][pk] for n in cap_names}
+            V = {
+                "A": active[pk],
+                "ST": stepi[pk],
+                "BT": bits[pk],
+                "VB": vbits[pk],
+                "T0": sts[pk],
+                "ADL": adl[pk],
+                "AD2": adl2[pk],
+                "CD": capdone[pk],
+                "CP": {n: caps[n][pk] for n in cap_names},
+                "SC": [scs[g][pk] for g in range(len(self.scope_cols))],
+            }
             CONS = consumed[pk]
+            ARMD = armed[pk]
+            ts2d = ts[:, None]
 
-            if plan.within is not None:
-                A = A & ~(A & (ts[:, None] > T0 + jnp.int64(plan.within)))
+            # ---- arming: a key's very first row arms the head wait
+            if arm_j is not None:
+                need = m & ~ARMD
+                onehot0 = need[:, None] & (jnp.arange(S)[None, :] == 0)
+                V["A"] = V["A"] | onehot0
+                V["T0"] = jnp.where(onehot0, ts2d, V["T0"])
+                V = self._enter(V, onehot0, arm_j, ts2d)
+                ARMD = ARMD | need
+
+            # ---- expiry + deadline cascade (before matching: a row at
+            # ts past a deadline sees the advanced state)
+            V = self._expire(V, ts2d)
+            emit = jnp.zeros((B, S), bool)
+            ets = jnp.broadcast_to(ts2d, (B, S))
+            fork_reqs: List = []
+            V, emit, ets = self._cascade(V, ts2d, emit, ets, fork_reqs)
+
+            A, ST, BT, VB, T0, CD = (V["A"], V["ST"], V["BT"], V["VB"],
+                                     V["T0"], V["CD"])
+            CP = V["CP"]
 
             # eval dict: current attrs [B,1], captures [B,S]
             ev = dict(CP)
@@ -548,7 +909,7 @@ class NFAStage:
                 for a in in_def.attributes:
                     ev[a.name] = cols[a.name][:, None]
                     ev[a.name + "?"] = cols[a.name + "?"][:, None]
-            ev[TS_KEY] = ts[:, None]
+            ev[TS_KEY] = ts2d
 
             # ---- phase 1: match masks against pre-event state; the
             # furthest-advanced op wins a slot (no per-event forking)
@@ -556,12 +917,24 @@ class NFAStage:
             conds: List[jnp.ndarray] = []
             at_masks: List[jnp.ndarray] = []
             adv_masks: List[jnp.ndarray] = []
+            viols: List[jnp.ndarray] = []
             for oi, (st, side) in enumerate(ops):
                 j = st.index
                 cond = side.cond(ev, ctx) if side.cond is not None \
                     else jnp.ones((B, 1), bool)
                 cond = jnp.broadcast_to(cond, (B, S))
                 conds.append(cond)
+                if side.absent:
+                    # a matching event on an absent side while the slot
+                    # waits = violation (AbsentStreamPreStateProcessor)
+                    v = A & (ST == j) & m[:, None] & cond
+                    if st.kind in ("and", "or"):
+                        v = v & ((BT & side.bit) == 0)
+                    viols.append(v)
+                    at_masks.append(jnp.zeros((B, S), bool))
+                    adv_masks.append(jnp.zeros((B, S), bool))
+                    continue
+                viols.append(jnp.zeros((B, S), bool))
                 at = A & (ST == j) & m[:, None] & cond
                 if st.kind == "count":
                     cnt = CP[cap_cnt_col(side.capture.cid)]
@@ -582,61 +955,185 @@ class NFAStage:
 
             matched = win >= 0
 
-            # ---- phase 2: apply the winning transition per slot
-            A2, ST2, BT2, CD2 = A, ST, BT, CD
+            # ---- violations: kill / mark / re-arm
+            A2, ST2, BT2, VB2, CD2 = A, ST, BT, VB, CD
+            ADL2_, AD22_ = V["ADL"], V["AD2"]
             CP2 = dict(CP)
-            emit = jnp.zeros((B, S), bool)
-            kill = jnp.zeros((B, S), bool)
             for oi, (st, side) in enumerate(ops):
+                if not side.absent:
+                    continue
+                v = viols[oi]
+                j = st.index
+                if st.kind == "absent":
+                    if st.sticky:
+                        # every-not: the violated interval restarts
+                        ADL2_ = jnp.where(v, ts2d + jnp.int64(st.wait_ms), ADL2_)
+                    else:
+                        A2 = A2 & ~v
+                elif st.kind == "and":
+                    if st.sticky:
+                        BT2 = jnp.where(v, 0, BT2)
+                        VB2 = jnp.where(v, 0, VB2)
+                        if side.wait_ms is not None:
+                            key_arr = ADL2_ if side.bit == 1 else AD22_
+                            key_arr = jnp.where(v, ts2d + jnp.int64(side.wait_ms), key_arr)
+                            if side.bit == 1:
+                                ADL2_ = key_arr
+                            else:
+                                AD22_ = key_arr
+                    else:
+                        A2 = A2 & ~v       # `and` with a violated absent side is dead
+                else:  # or
+                    VB2 = jnp.where(v, VB2 | side.bit, VB2)
+                    if all(s.absent for s in st.sides):
+                        dead = (VB2 & st.need_bits) == st.need_bits
+                        A2 = A2 & ~(v & dead)
+
+            # ---- phase 2: apply the winning transition per slot
+            emit2 = jnp.zeros((B, S), bool)
+            kill = jnp.zeros((B, S), bool)
+            sticky_emit_ops: List[Tuple[jnp.ndarray, StepSpec, SideSpec]] = []
+            phase2_forks: List[Tuple[jnp.ndarray, int, SideSpec]] = []
+            for oi, (st, side) in enumerate(ops):
+                if side.absent:
+                    continue
                 j = st.index
                 eff_at = at_masks[oi] & (win == oi)
                 eff_adv = adv_masks[oi] & (win == oi)
                 eff = eff_at | eff_adv
                 cap = side.capture
+                if st.sticky and st.kind == "stream":
+                    # sticky step: parent stays; fork an advanced child
+                    if j == L:
+                        sticky_emit_ops.append((eff, st, side))
+                    else:
+                        phase2_forks.append((eff, j + 1, side))
+                    continue
                 if st.kind == "count":
                     # entering resets the counter; absorbing continues it
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
-                    # (adv into a count step: counter starts fresh — reset
-                    # happens because a newly-advanced slot's counter was
-                    # zeroed when it advanced; fresh slots start at zero)
                     ST2 = jnp.where(eff, j, ST2)
                     if j == L:
                         cnt_after = CP2[cap_cnt_col(cap.cid)]
-                        emit = emit | (eff & (cnt_after >= st.min_count))
+                        emit2 = emit2 | (eff & (cnt_after >= st.min_count))
                 elif st.kind == "stream":
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
                     if j == L:
-                        emit = emit | eff
+                        emit2 = emit2 | eff
                         kill = kill | eff
                     else:
-                        ST2 = jnp.where(eff, j + 1, ST2)
-                        BT2 = jnp.where(eff, 0, BT2)
+                        tmp = {"ST": ST2, "BT": BT2, "VB": VB2,
+                               "ADL": ADL2_, "AD2": AD22_, "CD": CD2,
+                               "SC": V["SC"]}
+                        tmp = self._enter(tmp, eff, j + 1, ts2d)
+                        ST2, BT2, VB2 = tmp["ST"], tmp["BT"], tmp["VB"]
+                        ADL2_, AD22_, CD2 = tmp["ADL"], tmp["AD2"], tmp["CD"]
                 else:  # and / or
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
-                    bt2 = BT | side.bit
-                    full = ((bt2 & st.full_bits) == st.full_bits) \
-                        if st.kind == "and" else jnp.ones((B, S), bool)
+                    bt2 = BT2 | jnp.where(eff, side.bit, 0)
+                    nb = st.need_bits
+                    if st.kind == "and":
+                        full = (bt2 & nb) == nb
+                    else:
+                        full = jnp.ones((B, S), bool)
                     done = eff & full
+                    if st.sticky:
+                        # re-arm the logical parent on completion
+                        if j == L:
+                            emit2 = emit2 | done
+                        else:
+                            phase2_forks.append((done, j + 1, None))
+                        BT2 = jnp.where(eff & ~done, bt2,
+                                        jnp.where(done, 0, BT2))
+                        VB2 = jnp.where(done, 0, VB2)
+                        for s2 in st.sides:
+                            if s2.absent and s2.wait_ms is not None:
+                                arr = ADL2_ if s2.bit == 1 else AD22_
+                                arr = jnp.where(done, ts2d + jnp.int64(s2.wait_ms), arr)
+                                if s2.bit == 1:
+                                    ADL2_ = arr
+                                else:
+                                    AD22_ = arr
+                        continue
                     if j == L:
-                        emit = emit | done
+                        emit2 = emit2 | done
                         kill = kill | done
                     else:
-                        ST2 = jnp.where(done, j + 1, ST2)
-                    BT2 = jnp.where(eff & ~done, bt2,
-                                    jnp.where(done, 0, BT2))
+                        tmp = {"ST": ST2, "BT": BT2, "VB": VB2,
+                               "ADL": ADL2_, "AD2": AD22_, "CD": CD2,
+                               "SC": V["SC"]}
+                        tmp = self._enter(tmp, done, j + 1, ts2d)
+                        ST2, BT2, VB2 = tmp["ST"], tmp["BT"], tmp["VB"]
+                        ADL2_, AD22_, CD2 = tmp["ADL"], tmp["AD2"], tmp["CD"]
+                    BT2 = jnp.where(eff & ~done, bt2, BT2)
                     ST2 = jnp.where(eff & ~full, j, ST2)
+
+            # scope starts for plain capture steps
+            scV = {"CD": CD2, "SC": V["SC"]}
+            for oi, (st, side) in enumerate(ops):
+                if side.absent or st.sticky:
+                    continue
+                eff = (at_masks[oi] | adv_masks[oi]) & (win == oi)
+                scV = self._start_capture_scopes(scV, eff, st.index, ts2d)
+            CD2, V["SC"] = scV["CD"], scV["SC"]
 
             if plan.sequence:
                 kill = kill | (m[:, None] & A & ~matched)
             A2 = A2 & ~kill
 
-            emit = emit & m[:, None]
-            ov2 = {n: jnp.where(emit, CP2[n], out_caps[n][:, :S]) for n in cap_names}
-            new_out_valid = out_valid.at[:, :S].set(out_valid[:, :S] | emit)
-            out_cd = jnp.where(emit, CD2, out_caps["__capdone__"][:, :S])
+            emit_all = (emit | emit2) & m[:, None]
+            ets = jnp.where(emit2, ts2d, ets)
+
+            # ---- sticky emissions at the last step: emit parent captures
+            # + the current event, parent survives
+            CPe = None
+            semit = jnp.zeros((B, S), bool)
+            for eff, st, side in sticky_emit_ops:
+                if CPe is None:
+                    CPe = dict(CP2)
+                    CDe = CD2
+                CPe, CDe = capture_current(CPe, CDe, eff, side.capture,
+                                           reset_counter=False)
+                semit = semit | eff
+            emit_all = emit_all | (semit & m[:, None])
+
+            # ---- emission snapshot BEFORE fork allocation: forks may
+            # reuse slots freed by emitting matches and would clobber the
+            # capture columns the emission reads
+            out_cd = jnp.where(emit_all, CD2, out_caps["__capdone__"][:, :S])
+            if CPe is not None:
+                ov2 = {n: jnp.where(semit, CPe[n],
+                                    jnp.where(emit_all, CP2[n], out_caps[n][:, :S]))
+                       for n in cap_names}
+                out_cd = jnp.where(semit, CDe, out_cd)
+            else:
+                ov2 = {n: jnp.where(emit_all, CP2[n], out_caps[n][:, :S])
+                       for n in cap_names}
+            new_out_valid = out_valid.at[:, :S].set(out_valid[:, :S] | emit_all)
+            new_out_ts = out_ts.at[:, :S].set(
+                jnp.where(emit_all, ets, out_ts[:, :S]))
+
+            # ---- allocate forked children (sticky advances)
+            V2 = {"A": A2, "ST": ST2, "BT": BT2, "VB": VB2, "T0": T0,
+                  "ADL": ADL2_, "AD2": AD22_, "CD": CD2, "CP": CP2,
+                  "SC": V["SC"]}
+            for req, target, arm_ts in fork_reqs:
+                V2, dstm, overflow = self._alloc_forks(V2, req & m[:, None], overflow)
+                V2 = self._enter(V2, dstm, target, _gather_like(arm_ts, req, dstm))
+            for req, target, side in phase2_forks:
+                V2, dstm, overflow = self._alloc_forks(V2, req & m[:, None], overflow)
+                if side is not None and side.capture is not None:
+                    V2["CP"], V2["CD"] = capture_current(
+                        V2["CP"], V2["CD"], dstm, side.capture,
+                        reset_counter=False)
+                V2 = self._enter(V2, dstm, target, ts2d)
+                V2 = self._start_capture_scopes(V2, dstm, target - 1, ts2d)
+            A2, ST2, BT2, VB2 = V2["A"], V2["ST"], V2["BT"], V2["VB"]
+            T0, ADL2_, AD22_, CD2 = V2["T0"], V2["ADL"], V2["AD2"], V2["CD"]
+            CP2, SC2 = V2["CP"], V2["SC"]
 
             # ---- fresh starts
             every_ok = plan.every | ~CONS
@@ -645,6 +1142,8 @@ class NFAStage:
             direct_op = jnp.full((B,), -1, jnp.int32)
             fresh_reqs: List[Tuple[jnp.ndarray, int, int, SideSpec]] = []
             for oi, (st, side) in enumerate(ops):
+                if side.absent:
+                    continue
                 j = st.index
                 if not self._fresh_ok(j):
                     continue
@@ -656,9 +1155,12 @@ class NFAStage:
                     if j < L or 1 < st.max_count:
                         fresh_reqs.append((f, j, 0, side))       # park at j
                 elif st.kind == "stream":
-                    if j == L:
+                    if j == L and not st.sticky:
                         direct = direct | f
                         direct_op = jnp.where(f & (direct_op < 0), oi, direct_op)
+                    elif st.sticky:
+                        # a sticky head is plan.every — fresh slots park AT it
+                        fresh_reqs.append((f, j, 0, side))
                     else:
                         fresh_reqs.append((f, j + 1, 0, side))   # rest past j
                 else:  # logical
@@ -695,19 +1197,32 @@ class NFAStage:
                     onehot = jnp.zeros((B, S + 1), bool).at[bidx, slot].set(
                         True)[:, :S]
                     A2 = A2 | onehot
-                    ST2 = jnp.where(onehot, step_val, ST2)
-                    BT2 = jnp.where(onehot, bits_val, BT2)
-                    T0 = jnp.where(onehot, ts[:, None], T0)
+                    T0 = jnp.where(onehot, ts2d, T0)
                     # zero the new slot's captures, then capture the event
                     for n in cap_names:
                         CP2[n] = jnp.where(onehot, jnp.zeros((), CP2[n].dtype),
                                            CP2[n])
                     CD2 = jnp.where(onehot, 0, CD2)
-                    CP2, CD2 = capture_current(CP2, CD2, onehot, cap,
-                                               reset_counter=False)
+                    tmp = {"ST": ST2, "BT": BT2, "VB": VB2,
+                           "ADL": ADL2_, "AD2": AD22_, "CD": CD2, "SC": SC2}
+                    tmp = self._enter(tmp, onehot, step_val, ts2d)
+                    ST2, BT2, VB2 = tmp["ST"], tmp["BT"], tmp["VB"]
+                    ADL2_, AD22_, CD2, SC2 = (tmp["ADL"], tmp["AD2"],
+                                              tmp["CD"], tmp["SC"])
+                    BT2 = jnp.where(onehot, bits_val, BT2)
+                    if cap is not None:
+                        CP2, CD2 = capture_current(CP2, CD2, onehot, cap,
+                                                   reset_counter=False)
+                        scV2 = self._start_capture_scopes(
+                            {"CD": CD2, "SC": SC2}, onehot,
+                            fresh_cap_step(self.plan, step_val, bits_val), ts2d)
+                        CD2, SC2 = scV2["CD"], scV2["SC"]
 
             consumed2 = consumed.at[rows_pk].set(
                 jnp.where(m, CONS | fresh_any | direct, CONS), mode="drop")
+            armed2 = armed.at[rows_pk].set(
+                jnp.where(m, ARMD, armed[pk]), mode="drop") if arm_j is not None \
+                else armed
 
             # ---- direct-emission column (fresh match completing instantly)
             ov3 = {}
@@ -715,6 +1230,8 @@ class NFAStage:
                 col_S = out_caps[n][:, S]
                 for oi, (st, side) in enumerate(ops):
                     cap = side.capture
+                    if cap is None:
+                        continue
                     dm = direct & (direct_op == oi)
                     base = None
                     if n == cap_col(cap.cid, TS_KEY):
@@ -730,40 +1247,139 @@ class NFAStage:
                 ov3[n] = jnp.concatenate([ov2[n], col_S[:, None]], axis=1)
             direct_cd = out_caps["__capdone__"][:, S]
             for oi, (st, side) in enumerate(ops):
+                if side.capture is None:
+                    continue
                 dm = direct & (direct_op == oi)
                 direct_cd = jnp.where(dm, jnp.int32(1 << side.capture.cid), direct_cd)
             ov3["__capdone__"] = jnp.concatenate([out_cd, direct_cd[:, None]], axis=1)
+            new_out_ts = new_out_ts.at[:, S].set(
+                jnp.where(direct, ts, out_ts[:, S]))
 
             # ---- scatter views back (rows in this round only)
             def put(dst, view):
                 return dst.at[rows_pk].set(view, mode="drop")
 
             return (r + 1, put(active, A2), put(stepi, ST2), put(bits, BT2),
-                    put(sts, T0), put(capdone, CD2), consumed2,
+                    put(vbits, VB2), put(sts, T0), put(adl, ADL2_),
+                    put(adl2, AD22_), put(capdone, CD2), consumed2, armed2,
                     {n: put(caps[n], CP2[n]) for n in cap_names},
-                    new_out_valid, ov3, overflow)
+                    [put(scs[g], SC2[g]) for g in range(len(self.scope_cols))],
+                    new_out_valid, ov3, new_out_ts, overflow)
 
         out_valid0 = jnp.zeros((B, S + 1), bool)
         out_caps0 = {n: jnp.zeros((B, S + 1), dt) for n, dt in self.cap_cols.items()}
         out_caps0["__capdone__"] = jnp.zeros((B, S + 1), jnp.int32)
+        out_ts0 = jnp.broadcast_to(ts[:, None], (B, S + 1))
 
         carry0 = (jnp.int32(0), state["active"], state["stepi"], state["bits"],
-                  state["sts"], state["capdone"], state["consumed"],
+                  state["vbits"], state["sts"], state["adl"], state["adl2"],
+                  state["capdone"], state["consumed"], state["armed"],
                   {n: state[n] for n in cap_names},
-                  out_valid0, out_caps0, state["nfa_overflow"])
+                  [state[g] for g in self.scope_cols],
+                  out_valid0, out_caps0, out_ts0, state["nfa_overflow"])
 
         res = lax.while_loop(lambda c: c[0] < n_rounds, round_body, carry0)
-        (_r, active2, stepi2, bits2, sts2, capdone2, consumed2, caps2,
-         out_valid, out_caps, overflow2) = res
+        (_r, active2, stepi2, bits2, vbits2, sts2, adl_2, adl2_2, capdone2,
+         consumed2, armed2, caps2, scs2, out_valid, out_caps, out_ts,
+         overflow2) = res
 
         new_state = dict(state)
-        new_state.update(active=active2, stepi=stepi2, bits=bits2, sts=sts2,
-                         capdone=capdone2, consumed=consumed2,
+        new_state.update(active=active2, stepi=stepi2, bits=bits2,
+                         vbits=vbits2, sts=sts2, adl=adl_2, adl2=adl2_2,
+                         capdone=capdone2, consumed=consumed2, armed=armed2,
                          nfa_overflow=overflow2)
+        for g, name in enumerate(self.scope_cols):
+            new_state[name] = scs2[g]
         for n in cap_names:
             new_state[n] = caps2[n]
 
-        # ---- flatten [B, S+1] emissions row-major (event order, slot order)
+        out = self._flatten_out(out_valid, out_caps, out_ts, ts, cols, pk, B)
+        out["__overflow__"] = (overflow2 > state["nfa_overflow"]).astype(jnp.int32)
+        out["__notify__"] = _notify_of(self._next_deadline(new_state))
+        return new_state, out
+
+    # ................................................ scheduler TIMER sweep
+
+    def apply_timer(self, state: dict, now, ctx: dict):
+        """Advance every key's waiting slots whose deadlines have passed
+        (the role of the reference scheduler posting TIMER events through
+        AbsentStreamPreStateProcessor). Emissions flatten to [K*S]."""
+        plan = self.plan
+        S = plan.slots
+        K = state["consumed"].shape[0]
+        cap_names = list(self.cap_cols)
+        ts2d = jnp.broadcast_to(jnp.int64(now), (K, S))
+
+        V = {
+            "A": state["active"],
+            "ST": state["stepi"],
+            "BT": state["bits"],
+            "VB": state["vbits"],
+            "T0": state["sts"],
+            "ADL": state["adl"],
+            "AD2": state["adl2"],
+            "CD": state["capdone"],
+            "CP": {n: state[n] for n in cap_names},
+            "SC": [state[g] for g in self.scope_cols],
+        }
+        V = self._expire(V, ts2d)
+        emit = jnp.zeros((K, S), bool)
+        ets = ts2d
+        fork_reqs: List = []
+        V, emit, ets = self._cascade(V, ts2d, emit, ets, fork_reqs)
+        # emission snapshot before forks (forks may reuse freed slots)
+        emit_CP = dict(V["CP"])
+        emit_CD = V["CD"]
+        overflow = state["nfa_overflow"]
+        for req, target, arm_ts in fork_reqs:
+            V, dstm, overflow = self._alloc_forks(V, req, overflow)
+            V = self._enter(V, dstm, target, _gather_like(arm_ts, req, dstm))
+
+        new_state = dict(state)
+        new_state.update(active=V["A"], stepi=V["ST"], bits=V["BT"],
+                         vbits=V["VB"], sts=V["T0"], adl=V["ADL"],
+                         adl2=V["AD2"], capdone=V["CD"],
+                         nfa_overflow=overflow)
+        for g, name in enumerate(self.scope_cols):
+            new_state[name] = V["SC"][g]
+        for n in cap_names:
+            new_state[n] = V["CP"][n]
+
+        # flatten [K, S] emissions
+        N = K * S
+        out: Dict[str, jnp.ndarray] = {}
+        cd_flat = jnp.where(emit, emit_CD, 0).reshape(N)
+        for cap in plan.captures:
+            got = (cd_flat & (1 << cap.cid)) != 0
+            cnt_flat = emit_CP[cap_cnt_col(cap.cid)].reshape(N) if cap.is_count else None
+            for a in cap.definition.attributes:
+                n = cap_col(cap.cid, a.name)
+                out[n] = emit_CP[n].reshape(N)
+                out[n + "?"] = emit_CP[n + "?"].reshape(N) | ~got
+                for i in range(cap.n_idx):
+                    ni = cap_idx_col(cap.cid, i, a.name)
+                    out[ni] = emit_CP[ni].reshape(N)
+                    out[ni + "?"] = (emit_CP[ni + "?"].reshape(N) | ~got
+                                     | (cnt_flat <= i))
+            n = cap_col(cap.cid, TS_KEY)
+            out[n] = emit_CP[n].reshape(N)
+            if cap.is_count:
+                out[cap_cnt_col(cap.cid)] = cnt_flat
+        out[VALID_KEY] = emit.reshape(N)
+        out[TS_KEY] = ets.reshape(N)
+        out[TYPE_KEY] = jnp.zeros(N, jnp.int8)
+        pk_flat = jnp.repeat(jnp.arange(K, dtype=jnp.int32), S)
+        out["__gk__"] = pk_flat
+        out[PK_KEY] = pk_flat
+        out["__overflow__"] = (overflow > state["nfa_overflow"]).astype(jnp.int32)
+        out["__notify__"] = _notify_of(self._next_deadline(new_state))
+        return new_state, out
+
+    # ......................................................... output shape
+
+    def _flatten_out(self, out_valid, out_caps, out_ts, ts, cols, pk, B):
+        """Flatten [B, S+1] emissions row-major (event order, slot order)."""
+        S = self.plan.slots
         N = B * (S + 1)
         out: Dict[str, jnp.ndarray] = {}
         capdone_flat = out_caps["__capdone__"].reshape(N)
@@ -784,10 +1400,36 @@ class NFAStage:
             if cap.is_count:
                 out[cap_cnt_col(cap.cid)] = cnt_flat
         out[VALID_KEY] = out_valid.reshape(N)
-        out[TS_KEY] = jnp.repeat(ts, S + 1)
+        out[TS_KEY] = out_ts.reshape(N)
         out[TYPE_KEY] = jnp.zeros(N, jnp.int8)  # matches emit as CURRENT
         out["__gk__"] = jnp.repeat(cols.get("__gk__", pk), S + 1)
         if PK_KEY in cols:
             out[PK_KEY] = jnp.repeat(cols[PK_KEY], S + 1)
-        out["__overflow__"] = (overflow2 > state["nfa_overflow"]).astype(jnp.int32)
-        return new_state, out
+        return out
+
+
+def fresh_cap_step(plan: NFAPlan, rest_step: int, bits_val: int) -> int:
+    """The step whose event a fresh slot captured: rest-past slots captured
+    step rest-1; park-at slots (counts, logical sides) captured rest."""
+    if bits_val != 0:
+        return rest_step
+    if rest_step > 0 and plan.steps[rest_step - 1].kind == "stream":
+        return rest_step - 1
+    return rest_step
+
+
+def _gather_like(arm_ts, req, dst_mask):
+    """Move per-source-slot arm timestamps to their allocated destination
+    slots: within a row, sources and destinations pair in slot-rank order,
+    and `_alloc_forks` preserves rank, so a rank-aligned sort suffices."""
+    S = req.shape[1]
+    idx = jnp.arange(S)[None, :]
+    src_key = jnp.where(req, idx, S + idx)
+    src_sorted = jnp.take_along_axis(arm_ts, jnp.argsort(src_key, axis=1), axis=1)
+    rank_dst = jnp.cumsum(dst_mask, axis=1, dtype=jnp.int32) - 1
+    vals = jnp.take_along_axis(src_sorted, jnp.clip(rank_dst, 0, S - 1), axis=1)
+    return jnp.where(dst_mask, vals, 0)
+
+
+def _notify_of(next_dl):
+    return jnp.where(next_dl >= FAR_FUTURE, jnp.int64(-1), next_dl)

@@ -1,0 +1,392 @@
+"""Absent (`not ...`), mid-chain `every`, scoped `within` and group-by
+pattern tests — expectations mirror the reference corpus:
+``query/pattern/absent/{AbsentPatternTestCase,LogicalAbsentPatternTestCase,
+AbsentWithEveryPatternTestCase}.java``.
+
+All apps run in `@app:playback` so deadlines fire deterministically off the
+event-time clock (the reference tests Thread.sleep past the `for` windows).
+"""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+STREAMS = """
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+    define stream Stream3 (symbol string, price float, volume int);
+"""
+
+
+# ------------------------------------------------------------- tail absent
+
+
+def test_tail_absent_emits_at_deadline():
+    # AbsentPatternTestCase.testQueryAbsent1: A -> not B for 1 sec
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(1000, ["WSO2", 55.5, 100])
+    s1.send(2500, ["LATE", 15.0, 100])   # advances time past the deadline
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("WSO2",)]
+
+
+def test_tail_absent_violated_by_matching_event():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.5, 100])
+    s2.send(1500, ["IBM", 60.0, 100])    # violates the absence
+    s1.send(3000, ["LATE", 15.0, 100])
+    m.shutdown()
+    assert c.events == []
+
+
+def test_tail_absent_non_matching_event_keeps_wait():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.5, 100])
+    s2.send(1500, ["IBM", 50.0, 100])    # below e1.price: no violation
+    s1.send(2500, ["LATE", 15.0, 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("WSO2",)]
+
+
+# ------------------------------------------------------------- head absent
+
+
+def test_head_absent_then_stream():
+    # AbsentPatternTestCase: not Stream1 for 1 sec -> e2=Stream2
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+        select e2.symbol as symbol2
+        insert into OutStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(1500, ["IBM", 30.0, 100])    # past the armed deadline: match
+    s2.send(1600, ["DUP", 35.0, 100])    # chain consumed: single match
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("IBM",)]
+
+
+def test_head_absent_violated():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+        select e2.symbol as symbol2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(500, ["V", 20.0, 100])       # violates inside the window
+    s2.send(1500, ["IBM", 30.0, 100])
+    m.shutdown()
+    assert c.events == []
+
+
+def test_head_absent_stream_before_deadline_no_match():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+        select e2.symbol as symbol2
+        insert into OutStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(500, ["EARLY", 30.0, 100])   # the wait has not elapsed yet
+    m.shutdown()
+    assert c.events == []
+
+
+def test_mid_chain_absent():
+    # A -> not B for 1 sec -> C: C only matches after a silent window
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+            -> e3=Stream3[price>30]
+        select e1.symbol as s1, e3.symbol as s3
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(1000, ["A", 15.0, 100])
+    s3.send(1500, ["EARLY", 35.0, 100])  # before the deadline: no match
+    s3.send(2500, ["C", 40.0, 100])      # after: match
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", "C")]
+
+
+# ---------------------------------------------------------- logical absent
+
+
+def test_and_not_without_for():
+    # LogicalAbsentPatternTestCase: not Stream1 and e2=Stream2
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from not Stream1[price>50] and e2=Stream2[price>20]
+        select e2.symbol as symbol2
+        insert into OutStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(1000, ["IBM", 30.0, 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("IBM",)]
+
+
+def test_and_not_without_for_violated():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from not Stream1[price>50] and e2=Stream2[price>20]
+        select e2.symbol as symbol2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(500, ["V", 60.0, 100])       # Stream1 arrived first: dead
+    s2.send(1000, ["IBM", 30.0, 100])
+    m.shutdown()
+    assert c.events == []
+
+
+def test_chained_and_not_with_for_completes_at_deadline():
+    # e1 -> (not Stream2 for 1 sec and e3=Stream3): both conditions needed
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+             and e3=Stream3[price>30]
+        select e1.symbol as s1, e3.symbol as s3
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(1000, ["A", 15.0, 100])
+    s3.send(1400, ["C", 40.0, 100])      # present side fires inside window
+    s1.send(2500, ["T", 1.0, 100])       # advances past the deadline
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", "C")]
+
+
+def test_chained_and_not_with_for_violated():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+             and e3=Stream3[price>30]
+        select e1.symbol as s1, e3.symbol as s3
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(1000, ["A", 15.0, 100])
+    s3.send(1400, ["C", 40.0, 100])
+    s2.send(1600, ["V", 25.0, 100])      # violation before the deadline
+    s1.send(2500, ["T", 1.0, 100])
+    m.shutdown()
+    assert c.events == []
+
+
+def test_or_not_present_side_wins():
+    # e1 -> e2 or not Stream3 for 1 sec: the present side can fire early
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             or not Stream3[price>30] for 1 sec
+        select e1.symbol as s1, e2.symbol as s2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1000, ["A", 15.0, 100])
+    s2.send(1400, ["B", 25.0, 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", "B")]
+
+
+def test_or_not_deadline_side_emits_null():
+    # absent side completes: e2 never captured -> null
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             or not Stream3[price>30] for 1 sec
+        select e1.symbol as s1, e2.symbol as s2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(1000, ["A", 15.0, 100])
+    s1.send(2500, ["T", 1.0, 100])       # advance past deadline
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", None)]
+
+
+def test_both_absent_and_completes():
+    # (not Stream1 for 1 sec and not Stream2 for 1 sec) -> e3=Stream3
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from not Stream1[price>10] for 1 sec and not Stream2[price>20] for 1 sec
+             -> e3=Stream3[price>30]
+        select e3.symbol as s3
+        insert into OutStream;
+    """)
+    s3 = rt.get_input_handler("Stream3")
+    s3.send(1500, ["C", 40.0, 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("C",)]
+
+
+def test_both_absent_and_violated_by_either():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from not Stream1[price>10] for 1 sec and not Stream2[price>20] for 1 sec
+             -> e3=Stream3[price>30]
+        select e3.symbol as s3
+        insert into OutStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s3 = rt.get_input_handler("Stream3")
+    s2.send(500, ["V", 25.0, 100])
+    s3.send(1500, ["C", 40.0, 100])
+    m.shutdown()
+    assert c.events == []
+
+
+# ------------------------------------------------------------ every shapes
+
+
+def test_every_tail_absent_emits_per_period():
+    # e1 -> every not Stream2 for 1 sec: one emission per silent period
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>20] -> every not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(1000, ["WSO2", 55.5, 100])
+    s1.send(4500, ["LATE", 15.0, 100])   # deadlines at 2000, 3000, 4000
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("WSO2",), ("WSO2",), ("WSO2",)]
+
+
+def test_mid_chain_every_stream():
+    # A -> every B: each B after A completes (sticky fork keeps A armed)
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] -> every e2=Stream2[price>20]
+        select e1.price as p1, e2.price as p2
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 25.0, 1])
+    s2.send(["X", 45.0, 1])
+    s2.send(["Y", 46.0, 1])
+    s2.send(["Z", 47.0, 1])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(25.0, 45.0), (25.0, 46.0), (25.0, 47.0)]
+
+
+def test_mid_chain_every_with_continuation():
+    # A -> every (B) -> C: every B opens a fresh (B -> C) attempt
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] -> every e2=Stream2[price>20]
+             -> e3=Stream3[price>e2.price]
+        select e1.price as p1, e2.price as p2, e3.price as p3
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(["A", 25.0, 1])
+    s2.send(["X", 45.0, 1])
+    s2.send(["Y", 50.0, 1])
+    s3.send(["M", 48.0, 1])   # completes only the X attempt (48 > 45)
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [(25.0, 45.0, 48.0)]
+
+
+# ------------------------------------------------------------ scoped within
+
+
+def test_scoped_within_sub_pattern():
+    # A -> (B -> C) within 1 sec: the bound clocks from B, not A
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>10] ->
+             (e2=Stream2[price>20] -> e3=Stream3[price>30]) within 1 sec
+        select e1.symbol as s1, e3.symbol as s3
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(1000, ["A", 15.0, 100])
+    s2.send(5000, ["B", 25.0, 100])      # far from A: scope starts here
+    s3.send(5800, ["C", 40.0, 100])      # inside the 1 sec scope
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", "C")]
+
+
+def test_scoped_within_expires():
+    m, rt, c = build("@app:playback " + STREAMS + """
+        from e1=Stream1[price>10] ->
+             (e2=Stream2[price>20] -> e3=Stream3[price>30]) within 1 sec
+        select e1.symbol as s1, e3.symbol as s3
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(1000, ["A", 15.0, 100])
+    s2.send(5000, ["B", 25.0, 100])
+    s3.send(6500, ["C", 40.0, 100])      # past the scope bound: expired
+    m.shutdown()
+    assert c.events == []
+
+
+# ---------------------------------------------------------------- group by
+
+
+def test_pattern_group_by_aggregation():
+    m, rt, c = build(STREAMS + """
+        from every e1=Stream1[price>20] -> e2=Stream2[price>e1.price]
+        select e1.symbol as symbol, sum(e2.volume) as total
+        group by e1.symbol
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["AAA", 25.0, 1])
+    s2.send(["X", 30.0, 10])     # AAA: 10 (AAA's pending is consumed)
+    s1.send(["AAA", 26.0, 1])
+    s1.send(["BBB", 28.0, 1])
+    s2.send(["Y", 30.0, 5])      # matches both pendings: AAA: 15, BBB: 5
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [("AAA", 10), ("AAA", 15), ("BBB", 5)]
