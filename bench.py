@@ -150,12 +150,13 @@ def bench_e2e():
             "volume": rng.integers(1, 1000, B, dtype=np.int64),
         }, np.arange(i * B, (i + 1) * B, dtype=np.int64)
 
-    # warm: register every key (single growth), compile the step
-    warm_sym = np.arange(NUM_KEYS, dtype=np.int64)
+    # warm at the MEASURED batch shape (pow2 padding would otherwise
+    # compile a second shape): one B-row batch covering every key
+    warm_sym = np.arange(B, dtype=np.int64) % NUM_KEYS
     h.send_columns({"symbol": warm_sym,
-                    "price": np.ones(NUM_KEYS, np.float32),
-                    "volume": np.ones(NUM_KEYS, np.int64)},
-                   timestamps=np.zeros(NUM_KEYS, np.int64))
+                    "price": np.ones(B, np.float32),
+                    "volume": np.ones(B, np.int64)},
+                   timestamps=np.zeros(B, np.int64))
     pre = [make_cols(i + 1) for i in range(4)]
     h.send_columns(pre[0][0], timestamps=pre[0][1])
 
@@ -210,17 +211,17 @@ def bench_nfa_p99():
     rng = np.random.default_rng(2)
     B = 1024
 
-    # warm: register all 10k partition keys in one batch (single growth),
-    # then compile both stream steps at the MEASURED batch shape so no
-    # compile lands inside the timing window
-    warm_keys = np.array([f"K{i}" for i in range(NUM_KEYS)], dtype=object)
-    ts0 = np.full(NUM_KEYS, 1_000, np.int64)
-    ha.send_columns({"k": warm_keys, "v": np.zeros(NUM_KEYS)}, timestamps=ts0)
-    hb.send_columns({"k": warm_keys, "v": np.ones(NUM_KEYS)}, timestamps=ts0 + 1)
-    wk = np.array([f"K{i}" for i in range(B)], dtype=object)
-    wts = np.full(B, 2_000, np.int64)
-    ha.send_columns({"k": wk, "v": np.zeros(B)}, timestamps=wts)
-    hb.send_columns({"k": wk, "v": np.ones(B)}, timestamps=wts + 1)
+    # pre-size the key space so key registration never grows capacity
+    # mid-run (each pow2 growth would re-jit the [K, S] step), and warm
+    # with B-row batches only — ONE compiled shape per stream
+    q = rt.query_runtimes["nfa"]
+    q._win_keys = 16_384
+    q.selector_plan.num_keys = 16_384
+    for c0 in range(0, NUM_KEYS, B):
+        wk = np.array([f"K{i}" for i in range(c0, c0 + B)], dtype=object)
+        wts = np.full(B, 1_000, np.int64)
+        ha.send_columns({"k": wk, "v": np.zeros(B)}, timestamps=wts)
+        hb.send_columns({"k": wk, "v": np.ones(B)}, timestamps=wts + 1)
 
     lat = []
     n = 0

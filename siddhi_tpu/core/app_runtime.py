@@ -68,6 +68,13 @@ class SiddhiAppRuntime:
             self.app_context.precision = v
         self.app_context.scheduler = Scheduler(self.app_context)
 
+        # activate the manager's extension registry for query compilation
+        # (custom functions/windows resolve through it — the role of
+        # reference SiddhiExtensionLoader.java:58-98)
+        from siddhi_tpu.ops import expressions as _expr_mod
+
+        _expr_mod.set_active_extensions(siddhi_context.extensions)
+
         for sid, sdef in self.stream_definitions.items():
             self._create_junction(sdef)
 
@@ -125,6 +132,25 @@ class SiddhiAppRuntime:
             elif isinstance(element, Partition):
                 p_index += 1
                 q_index = self._add_partition(element, p_index, q_index)
+
+        # transport boundary: @source / @sink stream annotations
+        # (reference SiddhiAppRuntimeBuilder + SiddhiExtensionLoader)
+        from siddhi_tpu.query_api.annotations import find_annotations
+        from siddhi_tpu.core.stream.input.source import create_source_runtime
+        from siddhi_tpu.core.stream.output.sink import create_sink_runtime
+
+        extensions = siddhi_context.extensions
+        self.source_runtimes: List = []
+        self.sink_runtimes: List = []
+        for sid, sdef in list(self.stream_definitions.items()):
+            for ann in find_annotations(sdef.annotations, "source"):
+                self.source_runtimes.append(create_source_runtime(
+                    ann, sdef, self.get_input_handler(sid),
+                    self.app_context, extensions))
+            for ann in find_annotations(sdef.annotations, "sink"):
+                sr = create_sink_runtime(ann, sdef, self.app_context, extensions)
+                self.junctions[sid].subscribe(sr)
+                self.sink_runtimes.append(sr)
 
     # ------------------------------------------------------------ assembly
 
@@ -341,10 +367,18 @@ class SiddhiAppRuntime:
                     qr.rate_limiter.start(scheduler)
                 if hasattr(qr, "arm_initial"):
                     qr.arm_initial()  # head-absent patterns wait from start
+            for sr in self.sink_runtimes:
+                sr.connect()
+            for sr in self.source_runtimes:
+                # connect with retry/backoff off-thread (Source.java:155-185)
+                t = threading.Thread(target=sr.connect_with_retry, daemon=True)
+                t.start()
             for tr in self.trigger_runtimes:
                 tr.start()
 
     def shutdown(self):
+        for sr in self.source_runtimes:
+            sr.shutdown()
         for tr in self.trigger_runtimes:
             tr.stop()
         for qr in self.query_runtimes.values():
@@ -352,6 +386,8 @@ class SiddhiAppRuntime:
                 qr.rate_limiter.stop()
         for j in self.junctions.values():
             j.stop_processing()
+        for sr in self.sink_runtimes:
+            sr.shutdown()
         if self.app_context.scheduler is not None:
             self.app_context.scheduler.shutdown()
         self._started = False
@@ -368,8 +404,16 @@ class SiddhiAppRuntime:
 
     def persist(self) -> str:
         """Checkpoint all state to the configured persistence store;
-        returns the revision id (reference SiddhiAppRuntimeImpl.persist:677)."""
-        return self.persistence.persist()
+        returns the revision id (reference SiddhiAppRuntimeImpl.persist:677).
+        Sources are paused around the snapshot so no events race the
+        checkpoint (reference pauses source handlers during persist)."""
+        for sr in self.source_runtimes:
+            sr.pause()
+        try:
+            return self.persistence.persist()
+        finally:
+            for sr in self.source_runtimes:
+                sr.resume()
 
     def restore_revision(self, revision: str):
         self.persistence.restore_revision(revision)
@@ -404,6 +448,11 @@ class SiddhiAppRuntime:
         reference ``SiddhiAppRuntimeImpl.query`` +
         ``util/parser/OnDemandQueryParser.java``."""
         from siddhi_tpu.core.query.on_demand import run_on_demand_query
+        from siddhi_tpu.ops import expressions as _expr_mod
+
+        # lazy compiles resolve against THIS manager's extension registry
+        _expr_mod.set_active_extensions(
+            self.app_context.siddhi_context.extensions)
 
         with self._barrier:
             return run_on_demand_query(on_demand_query, self)

@@ -78,6 +78,9 @@ class NFAQueryRuntime(QueryRuntime):
         self._steps: Dict[str, object] = {}
         self._timer_step = None
         self._sel_step = None
+        # one stable callback object: Scheduler dedups on (id(target), ts),
+        # a fresh bound method per notify_at would defeat it
+        self._timer_cb = self.process_timer
 
     # -------------------------------------------------------------- wiring
 
@@ -152,7 +155,7 @@ class NFAQueryRuntime(QueryRuntime):
                     nfa["capdone"][0, 0] |= plan.scope_bit(g)
             self._state["nfa"] = {k: jnp.asarray(v) for k, v in nfa.items()}
         if next_dl is not None and self.scheduler is not None:
-            self.scheduler.notify_at(int(next_dl), self.process_timer)
+            self.scheduler.notify_at(int(next_dl), self._timer_cb)
 
     # ---------------------------------------------------------- step builds
 
@@ -248,7 +251,7 @@ class NFAQueryRuntime(QueryRuntime):
                 self._state, cols,
                 np.int64(self.app_context.timestamp_generator.current_time())))
         if notify is not None and self.scheduler is not None:
-            self.scheduler.notify_at(notify, self.process_timer)
+            self.scheduler.notify_at(notify, self._timer_cb)
 
     def process_timer(self, ts: int):
         with self._lock:
@@ -260,7 +263,7 @@ class NFAQueryRuntime(QueryRuntime):
             notify = self._run_nfa_step(
                 lambda: self._timer_step(self._state, np.int64(ts)))
         if notify is not None and self.scheduler is not None:
-            self.scheduler.notify_at(notify, self.process_timer)
+            self.scheduler.notify_at(notify, self._timer_cb)
 
     def _run_nfa_step(self, run) -> int | None:
         """Run a jitted NFA step; when a group-by keyer splits the pipeline,

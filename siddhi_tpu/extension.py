@@ -1,0 +1,39 @@
+"""Public extension SPI surface.
+
+The counterpart of the reference ``@Extension`` class families resolved by
+``SiddhiExtensionLoader.java:58-98``. Register implementations with
+``SiddhiManager.set_extension(name_or_kind_colon_name, cls)``; kinds:
+
+- ``function:<name>`` — a :class:`ScalarFunction` (vectorized over columns)
+- ``source:<type>`` / ``sink:<type>`` — transports
+- ``sourceMapper:<type>`` / ``sinkMapper:<type>`` — payload mappers
+
+A bare name (no ``kind:`` prefix) matches any kind.
+"""
+
+from __future__ import annotations
+
+from siddhi_tpu.core.stream.input.source import (  # noqa: F401
+    ConnectionUnavailableException,
+    Source,
+    SourceMapper,
+)
+from siddhi_tpu.core.stream.output.sink import (  # noqa: F401
+    Sink,
+    SinkMapper,
+)
+from siddhi_tpu.core.util.transport import InMemoryBroker  # noqa: F401
+
+
+class ScalarFunction:
+    """Custom scalar function over columns: set ``return_type`` to an
+    AttrType (or a callable of the argument types) and implement
+    ``apply(xp, *arrays)`` with the array namespace ``xp`` (jax.numpy on
+    device, numpy host-side) — one vectorized call per batch instead of the
+    reference's per-event ``FunctionExecutor.execute``."""
+
+    return_type = None
+
+    @staticmethod
+    def apply(xp, *args):  # pragma: no cover - interface
+        raise NotImplementedError

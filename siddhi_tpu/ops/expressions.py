@@ -405,4 +405,54 @@ def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
 
         return fn, AttrType.LONG
 
+    ext = resolve_extension("function", name)
+    if ext is not None:
+        # custom scalar function (reference SiddhiExtensionLoader resolving
+        # FunctionExecutor @Extension classes): vectorized over columns
+        compiled = [compile_expr(a, resolver) for a in args]
+        out_t = ext.return_type
+        if callable(out_t):
+            out_t = out_t([t for _, t in compiled])
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            vals, m = [], None
+            for f, _t in compiled:
+                v, vm = f(cols, ctx)
+                vals.append(v)
+                m = _or_masks(xp, m, vm)
+            return ext.apply(xp, *vals), m
+
+        return fn, out_t
+
     raise CompileError(f"unknown function '{name}'")
+
+
+# ------------------------------------------------------------- extensions
+
+# Extension registry active during query compilation. Every compile entry
+# point (app construction, on-demand queries) points this at its
+# SiddhiContext.extensions before compiling, making
+# ``SiddhiManager.set_extension`` a live lookup path (the role of reference
+# ``SiddhiExtensionLoader.java:58-98``). Thread-local so two managers
+# compiling concurrently cannot see each other's registries.
+import threading as _threading
+
+_ACTIVE = _threading.local()
+
+
+def set_active_extensions(extensions: dict) -> None:
+    _ACTIVE.extensions = extensions if extensions is not None else {}
+
+
+def resolve_in(extensions: dict, kind: str, name: str):
+    """Shared 'kind:name, then bare name, case-insensitive' lookup rule."""
+    for key in (f"{kind}:{name}", name):
+        cls = extensions.get(key) or extensions.get(key.lower())
+        if cls is not None:
+            return cls
+    return None
+
+
+def resolve_extension(kind: str, name: str):
+    return resolve_in(getattr(_ACTIVE, "extensions", {}), kind, name)

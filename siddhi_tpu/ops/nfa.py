@@ -257,17 +257,15 @@ def build_nfa_plan(
             within = w if within is None else min(within, w)
             scopes = [s for s in scopes
                       if not (s[0] == 0 and s[1] == len(elements) - 1 and s[2] == w)]
-    elif elements and 0 not in sticky_at:
-        # `every A -> B` parses as Next(Every(A), B): sticky_at has no 0
-        # entry (depth>0 every at position 0 is the global flag)
+    elif elements:
+        # `every A -> B` parses as Next(Every(A), B): an every wrapping the
+        # FIRST element is the global re-arm flag (_flatten only marks
+        # every at positions > 0 as sticky)
         first = root
         while isinstance(first, NextStateElement):
             first = first.state
         if isinstance(first, EveryStateElement):
             every = True
-    if 0 in sticky_at:
-        sticky_at.discard(0)
-        every = True
 
     sequence = state_stream.state_type == StateInputStreamType.SEQUENCE
 
@@ -702,7 +700,12 @@ class NFAStage:
                         adl = V["ADL"]
                         V = self._enter(V, due, j + 1, adl)
             elif st.kind in ("and", "or"):
+                # completion timestamp: 'and' completes when the LAST due
+                # side fires (max over due-now deadlines); 'or' when the
+                # FIRST does (min) — only deadlines firing now count
+                is_and = st.kind == "and"
                 comp_ts = None
+                init = -FAR_FUTURE if is_and else FAR_FUTURE
                 fired = jnp.zeros_like(V["A"])
                 for side in st.sides:
                     if not (side.absent and side.wait_ms is not None):
@@ -715,9 +718,15 @@ class NFAStage:
                     )
                     V["BT"] = jnp.where(due_s, V["BT"] | side.bit, V["BT"])
                     fired = fired | due_s
-                    comp_ts = adlx if comp_ts is None else jnp.maximum(comp_ts, adlx)
+                    cand = jnp.where(due_s, adlx, init)
+                    if comp_ts is None:
+                        comp_ts = cand
+                    else:
+                        comp_ts = (jnp.maximum if is_and else jnp.minimum)(
+                            comp_ts, cand)
                 if comp_ts is None:
                     continue
+                comp_ts = jnp.where(fired, comp_ts, ts2d)
                 if st.kind == "and":
                     nb = st.need_bits
                     comp = fired & ((V["BT"] & nb) == nb)
