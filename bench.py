@@ -247,9 +247,18 @@ def bench_nfa_p99():
 
 
 def main():
+    import sys
+
+    def note(msg):
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    note("device section…")
     eps_device = bench_device()
+    note(f"device: {eps_device:.0f} eps; e2e section…")
     eps_e2e = bench_e2e()
+    note(f"e2e: {eps_e2e:.0f} eps; nfa section…")
     nfa_p99_ms, nfa_eps = bench_nfa_p99()
+    note("done")
     print(json.dumps({
         "metric": "events_per_sec_10k_key_length1000_avg",
         "value": round(eps_device, 1),

@@ -245,7 +245,13 @@ class NFAQueryRuntime(QueryRuntime):
                 self._state = self._init_state()
             step = self._steps.get(stream_id)
             if step is None:
-                step = jax.jit(self.build_stream_step_fn(stream_id), donate_argnums=0)
+                fn = self.build_stream_step_fn(stream_id)
+                if self._shard_mesh is not None:
+                    from siddhi_tpu.parallel.mesh import sharded_jit_for
+
+                    step = sharded_jit_for(self, fn, n_plain_args=2)
+                else:
+                    step = jax.jit(fn, donate_argnums=0)
                 self._steps[stream_id] = step
             notify = self._run_nfa_step(lambda: step(
                 self._state, cols,
@@ -258,8 +264,13 @@ class NFAQueryRuntime(QueryRuntime):
             if self._state is None:
                 self._state = self._init_state()
             if self._timer_step is None:
-                self._timer_step = jax.jit(self.build_timer_step_fn(),
-                                           donate_argnums=0)
+                fn = self.build_timer_step_fn()
+                if self._shard_mesh is not None:
+                    from siddhi_tpu.parallel.mesh import sharded_jit_for
+
+                    self._timer_step = sharded_jit_for(self, fn, n_plain_args=1)
+                else:
+                    self._timer_step = jax.jit(fn, donate_argnums=0)
             notify = self._run_nfa_step(
                 lambda: self._timer_step(self._state, np.int64(ts)))
         if notify is not None and self.scheduler is not None:

@@ -90,6 +90,15 @@ def state_shardings(state, mesh: Mesh, num_keys: int, win_keys: int = 1):
             and win_keys % n_dev == 0
         ):
             return key_axis_sharding(mesh, leaf.ndim, 0)
+        if (
+            top == "nfa"
+            and win_keys > 1
+            and leaf.ndim >= 1
+            and leaf.shape[0] == win_keys
+            and win_keys % n_dev == 0
+        ):
+            # NFA slot tensors are key-major [K, S]; per-key vectors [K]
+            return key_axis_sharding(mesh, leaf.ndim, 0)
         return replicated
 
     return jax.tree_util.tree_map_with_path(one, state)
@@ -132,4 +141,22 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
     runtime._state = state
     runtime._step = jitted
     runtime._shard_mesh = mesh
+    if hasattr(runtime, "_steps"):
+        # NFA runtimes jit one step per input stream (plus a TIMER sweep);
+        # clear them so they re-jit with the sharded in_shardings
+        runtime._steps.clear()
+        runtime._timer_step = None
     return jitted, state
+
+
+def sharded_jit_for(runtime, fn, n_state_args: int = 1, n_plain_args: int = 2):
+    """Jit ``fn(state, *plain)`` with the runtime's recorded mesh shardings
+    (used by NFAQueryRuntime for per-stream and timer steps)."""
+    mesh = runtime._shard_mesh
+    st_sh = state_shardings(runtime._state, mesh, runtime.selector_plan.num_keys,
+                            win_keys=getattr(runtime, "_win_keys", 1))
+    return jax.jit(
+        fn,
+        in_shardings=(st_sh,) + (None,) * n_plain_args,
+        donate_argnums=(0,),
+    )
