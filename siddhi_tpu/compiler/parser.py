@@ -534,6 +534,10 @@ class Parser:
             on = self.parse_expression()
         if self.accept_kw("within"):
             within = self.parse_time_constant() if self.at_time_constant() else self.parse_expression()
+            if self.accept_op(","):
+                end = (self.parse_time_constant() if self.at_time_constant()
+                       else self.parse_expression())
+                within = (within, end)   # `within start, end` (agg joins)
         if self.accept_kw("per"):
             per = self.parse_expression()
         trigger = EventTrigger.ALL

@@ -315,6 +315,36 @@ class IncrementalAggregationRuntime(Receiver):
                     out_rows.append(row)
         return out_rows
 
+    def contents(self, duration: Duration,
+                 within: Optional[Tuple[int, int]] = None):
+        """Columnar probe surface over the stitched buckets of one
+        duration: (output_definition, cols, valid) — shared by on-demand
+        `within/per` queries and aggregation joins (reference
+        ``AggregationRuntime.java:331-357`` compiled selection)."""
+        from siddhi_tpu.ops.expressions import TS_KEY
+        from siddhi_tpu.ops.types import dtype_of
+
+        definition = self.output_definition()
+        rows = self.rows(duration, within)
+        n = len(rows)
+        cap = max(n, 1)
+        cols = {}
+        for pos, attr in enumerate(definition.attributes):
+            dt = dtype_of(attr.type)
+            arr = np.zeros(cap, dt)
+            mask = np.zeros(cap, bool)
+            for i, r in enumerate(rows):
+                v = r[pos]
+                if v is None:
+                    mask[i] = True
+                else:
+                    arr[i] = v
+            cols[attr.name] = arr
+            cols[attr.name + "?"] = mask
+        cols[TS_KEY] = cols[definition.attributes[0].name]  # AGG_TIMESTAMP
+        valid = np.arange(cap) < n
+        return definition, cols, valid
+
     # --------------------------------------------------------- persistence
 
     def snapshot(self) -> dict:

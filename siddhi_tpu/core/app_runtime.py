@@ -106,6 +106,7 @@ class SiddhiAppRuntime:
                 adef, self.app_context, dictionary, self.stream_definitions)
             self.junctions[agg.input_stream_id].subscribe(agg)
             self.aggregations[aid] = agg
+        self.app_context.aggregations = self.aggregations
 
         self.trigger_runtimes: List[TriggerRuntime] = []
         for tid, tdef in siddhi_app.trigger_definitions.items():

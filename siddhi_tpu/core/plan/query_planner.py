@@ -37,25 +37,37 @@ def plan_join_query(
 ):
     """Plan a two-stream window join (reference
     ``JoinInputStreamParser.java:200-348`` + ``JoinProcessor.java``)."""
-    from siddhi_tpu.core.query.join_runtime import JoinQueryRuntime, JoinResolver, JoinSide
+    from siddhi_tpu.core.query.join_runtime import (
+        AggregationJoinStore,
+        JoinQueryRuntime,
+        JoinResolver,
+        JoinSide,
+    )
     from siddhi_tpu.ops.windows import PassthroughWindowStage, create_window_stage
 
-    if partition_ctx is not None:
-        raise CompileError(
-            f"query '{query_name}': joins inside partitions are not supported yet"
-        )
     join: JoinInputStream = query.input_stream
-    if join.within is not None or join.per is not None:
-        raise CompileError(
-            f"query '{query_name}': `within`/`per` join clauses apply to "
-            f"aggregation joins, which are not supported yet"
-        )
     dictionary = app_context.string_dictionary
 
     def build_side(key: str, s: SingleInputStream) -> JoinSide:
         sid = s.unique_stream_id
         tables = getattr(app_context, "tables", {})
         named_windows = getattr(app_context, "named_windows", {})
+        aggregations = getattr(app_context, "aggregations", {})
+        if sid in aggregations:
+            # aggregation join side: stitched buckets as the probe store
+            # (AggregationRuntime.java:331-357 + join `within ... per ...`)
+            agg = aggregations[sid]
+            if s.handlers:
+                raise CompileError(
+                    f"query '{query_name}': handlers on the aggregation join "
+                    f"side '{sid}' are not supported")
+            duration, within = _agg_join_range(join, query_name)
+            store = AggregationJoinStore(agg, duration, within)
+            return JoinSide(
+                key=key, stream_id=sid, ref_id=s.stream_reference_id,
+                definition=store.definition, window_stage=None, filters=[],
+                triggers=False, outer=False, store=store,
+            )
         if sid in tables or sid in named_windows:
             # shared store side (reference TableWindowProcessor /
             # WindowWindowProcessor as the findable join side); named
@@ -92,6 +104,7 @@ def plan_join_query(
         resolver = SingleStreamResolver(sdef, dictionary, ref_id=s.stream_reference_id)
         filters = []
         window_stage = None
+        host_window = None
         for h in s.handlers:
             if isinstance(h, Filter):
                 if window_stage is not None:
@@ -100,17 +113,38 @@ def plan_join_query(
             elif isinstance(h, Window):
                 if window_stage is not None:
                     raise CompileError("only one #window per join side is allowed")
-                window_stage = create_window_stage(h, sdef, resolver, app_context)
+                if partition_ctx is not None:
+                    from siddhi_tpu.ops.keyed_windows import create_keyed_window_stage
+
+                    window_stage = create_keyed_window_stage(
+                        h, sdef, resolver, app_context)
+                else:
+                    window_stage = create_window_stage(h, sdef, resolver, app_context)
                 if getattr(window_stage, "host_mode", False):
-                    raise CompileError(
-                        f"window '{h.name}' as a join side is not supported yet"
-                    )
+                    # sort/frequent/... run host-side; emissions trigger the
+                    # join, contents() is the probe surface
+                    host_window = window_stage
+                    from siddhi_tpu.ops.windows import window_col_specs
+
+                    window_stage = PassthroughWindowStage(
+                        window_col_specs(sdef), pass_expired=True)
             else:
                 raise CompileError(f"stream function '{h.name}' on a join side is not supported")
         if window_stage is None:
+            if partition_ctx is not None:
+                raise CompileError(
+                    f"query '{query_name}': joins inside partitions need an "
+                    f"explicit #window on stream side '{sid}'")
             from siddhi_tpu.ops.windows import window_col_specs
 
             window_stage = PassthroughWindowStage(window_col_specs(sdef))
+        keyer = None
+        if partition_ctx is not None:
+            if sid not in partition_ctx.keyers:
+                raise CompileError(
+                    f"query '{query_name}': join stream '{sid}' is consumed "
+                    f"inside a partition but has no partition-with clause")
+            keyer = partition_ctx.keyers[sid]
         triggers = (
             join.trigger == EventTrigger.ALL
             or (join.trigger == EventTrigger.LEFT and key == "left")
@@ -130,10 +164,18 @@ def plan_join_query(
             filters=filters,
             triggers=triggers,
             outer=outer,
+            host_window=host_window,
+            keyer=keyer,
         )
 
     left = build_side("left", join.left)
     right = build_side("right", join.right)
+    if (join.within is not None or join.per is not None) and not any(
+        isinstance(s.store, AggregationJoinStore) for s in (left, right)
+    ):
+        raise CompileError(
+            f"query '{query_name}': `within`/`per` join clauses need an "
+            f"aggregation join side")
     if left.window_stage is None and right.window_stage is None:
         raise CompileError(
             f"query '{query_name}': a join needs an event-driven side — both "
@@ -153,10 +195,6 @@ def plan_join_query(
     if join.on_compare is not None:
         on_cond = compile_condition(join.on_compare, resolver)
 
-    if query.selector.group_by_list:
-        raise CompileError(
-            f"query '{query_name}': group by on join queries is not supported yet"
-        )
     if query.selector.select_all or not query.selector.selection_list:
         raise CompileError(
             f"query '{query_name}': join queries need an explicit select list"
@@ -173,6 +211,14 @@ def plan_join_query(
     )
     selector_plan.num_keys = app_context.initial_key_capacity
 
+    group_keyer = None
+    if query.selector.group_by_list:
+        fns = []
+        for var in query.selector.group_by_list:
+            fn, t = compile_expr(var, resolver)
+            fns.append((fn, t))
+        group_keyer = GroupKeyer(fns)
+
     return JoinQueryRuntime(
         name=query_name,
         app_context=app_context,
@@ -181,7 +227,43 @@ def plan_join_query(
         on_cond=on_cond,
         selector_plan=selector_plan,
         dictionary=dictionary,
+        partition_ctx=partition_ctx,
+        group_keyer=group_keyer,
     )
+
+
+def _agg_join_range(join: JoinInputStream, query_name: str):
+    """Parse `within .. per ..` of an aggregation join into (Duration,
+    (start, end) | None). Single time-constant `within t` means the
+    sliding last-t range, resolved at probe time by the store."""
+    from siddhi_tpu.core.aggregation.incremental import parse_duration_name
+    from siddhi_tpu.query_api.expressions import Constant, TimeConstant
+
+    if join.per is None:
+        raise CompileError(
+            f"query '{query_name}': an aggregation join needs `per '<duration>'`")
+    if not isinstance(join.per, Constant) or not isinstance(join.per.value, str):
+        raise CompileError(f"query '{query_name}': `per` must be a string constant")
+    duration = parse_duration_name(join.per.value)
+
+    w = join.within
+    if w is None:
+        return duration, None
+
+    def _ms(x):
+        if isinstance(x, (Constant, TimeConstant)) and not isinstance(
+            getattr(x, "value", None), str
+        ):
+            return int(x.value)
+        raise CompileError(
+            f"query '{query_name}': within bounds must be millisecond epoch "
+            f"constants (string date patterns are not supported yet)")
+
+    if isinstance(w, tuple):
+        return duration, (_ms(w[0]), _ms(w[1]))
+    # single bound: include everything from `start` on (reference single-arg
+    # within is a wildcard pattern; the numeric analog is an open range)
+    return duration, (_ms(w), 2 ** 62)
 
 
 def plan_nfa_query(

@@ -8,11 +8,20 @@ emits (CURRENT and EXPIRED) probes the other side's buffer with the
 compiled `on` condition (post-join trigger — ``:348``,
 ``JoinProcessor.execute:107-170``) as one masked [N, W] broadcast compare.
 Outer sides emit a null-padded row when nothing matches.
+
+Extensions beyond the basic stream-stream shape:
+- group-by selectors (host keyer over the joined columns — split pipeline)
+- joins inside partitions: keyed window sides, per-row probes gathered from
+  the other side's ``[K, W]`` ring by partition key
+- host-mode window sides (sort/frequent/session): the window runs host-side
+  and exposes its ``contents()`` as the probe surface
+- aggregation joins (``join AggName within ... per ...``): the aggregation's
+  stitched buckets are the probe store (``AggregationRuntime.java:331-357``)
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -23,7 +32,15 @@ from siddhi_tpu.core.event import Event, HostBatch
 from siddhi_tpu.core.plan.selector_plan import GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime
 from siddhi_tpu.core.stream.junction import Receiver
-from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY, ColumnRef, CompileError, Resolver
+from siddhi_tpu.ops.expressions import (
+    PK_KEY,
+    TS_KEY,
+    TYPE_KEY,
+    VALID_KEY,
+    ColumnRef,
+    CompileError,
+    Resolver,
+)
 from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
 from siddhi_tpu.query_api.expressions import Variable
 
@@ -40,13 +57,37 @@ class JoinSide:
     filters: List[Callable]
     triggers: bool               # unidirectional: does this side emit?
     outer: bool                  # emit null-padded row when no match
-    # shared probe-only store (InMemoryTable / NamedWindowRuntime): its
-    # contents() is fetched per batch and passed as a non-donated jit arg
+    # shared probe-only store with a contents() -> (cols, valid) surface
+    # (InMemoryTable / NamedWindowRuntime / AggregationJoinStore)
     store: object = None
+    # host-mode window (sort/frequent/...): processed host-side; its
+    # contents() is the probe surface, its emissions trigger the join
+    host_window: object = None
+    keyer: object = None         # partition keyer (partitioned joins)
 
     @property
     def prefix(self) -> str:
         return "l__" if self.key == "left" else "r__"
+
+    @property
+    def probe_external(self) -> bool:
+        """Probe columns come from outside the jitted state."""
+        return self.store is not None or self.host_window is not None
+
+
+class AggregationJoinStore:
+    """Probe adapter over an incremental aggregation's stitched buckets
+    (reference ``join AggName within <start>, <end> per '<duration>'``)."""
+
+    def __init__(self, agg, duration, within: Optional[tuple]):
+        self.agg = agg
+        self.duration = duration
+        self.within = within
+        self.definition = agg.output_definition()
+
+    def contents(self):
+        _defn, cols, valid = self.agg.contents(self.duration, self.within)
+        return cols, valid
 
 
 class JoinResolver(Resolver):
@@ -101,7 +142,8 @@ class JoinSideProxy(Receiver):
 
 class JoinQueryRuntime(QueryRuntime):
     def __init__(self, name, app_context, left: JoinSide, right: JoinSide,
-                 on_cond: Optional[Callable], selector_plan, dictionary):
+                 on_cond: Optional[Callable], selector_plan, dictionary,
+                 partition_ctx=None, group_keyer=None):
         super().__init__(
             name=name,
             app_context=app_context,
@@ -109,8 +151,9 @@ class JoinQueryRuntime(QueryRuntime):
             filters=[],
             window_stage=None,
             selector_plan=selector_plan,
-            keyer=None,
+            keyer=group_keyer,
             dictionary=dictionary,
+            partition_ctx=partition_ctx,
         )
         self.sides = {"left": left, "right": right}
         self.on_cond = on_cond
@@ -122,7 +165,7 @@ class JoinQueryRuntime(QueryRuntime):
         }
 
     def make_proxies(self) -> Dict[str, JoinSideProxy]:
-        # table sides produce no events — no proxy; named-window sides get
+        # store sides produce no events — no proxy; named-window sides get
         # one (subscribed to the window's emission junction)
         return {
             k: JoinSideProxy(self, k)
@@ -132,11 +175,19 @@ class JoinQueryRuntime(QueryRuntime):
 
     def _init_state(self) -> dict:
         state = {"sel": self.selector_plan.init_state()}
-        if self.sides["left"].window_stage is not None:
-            state["lwin"] = self.sides["left"].window_stage.init_state()
-        if self.sides["right"].window_stage is not None:
-            state["rwin"] = self.sides["right"].window_stage.init_state()
+        partitioned = self.partition_ctx is not None
+        for k, wk in (("left", "lwin"), ("right", "rwin")):
+            side = self.sides[k]
+            if side.window_stage is not None and side.host_window is None:
+                state[wk] = (side.window_stage.init_state(self._win_keys)
+                             if partitioned else side.window_stage.init_state())
         return state
+
+    def _ensure_capacity(self):
+        before = (self.selector_plan.num_keys, self._win_keys)
+        super()._ensure_capacity()
+        if (self.selector_plan.num_keys, self._win_keys) != before:
+            self._steps.clear()
 
     def build_side_step_fn(self, side_key: str):
         side = self.sides[side_key]
@@ -145,9 +196,11 @@ class JoinQueryRuntime(QueryRuntime):
         other_key = "rwin" if side_key == "left" else "lwin"
         sel = self.selector_plan
         on_cond = self.on_cond
-        filters = side.filters
-
-        other_is_store = other.store is not None
+        # host-window sides run their filters + window host-side
+        filters = [] if side.host_window is not None else side.filters
+        partitioned = self.partition_ctx is not None
+        split = self.keyer is not None
+        other_external = other.probe_external
 
         def step(state, probe_cols, probe_valid, cols, current_time):
             ctx = {"xp": jnp, "current_time": current_time}
@@ -158,33 +211,47 @@ class JoinQueryRuntime(QueryRuntime):
                 valid = valid & (f(cols, ctx) | timer)
             cols[VALID_KEY] = valid
             new_state = dict(state)
-            new_win, wout = side.window_stage.apply(state[win_key], cols, ctx)
-            new_state[win_key] = new_win
+            new_win, wout = side.window_stage.apply(state.get(win_key), cols, ctx)
+            if win_key in state:
+                new_state[win_key] = new_win
             wout = dict(wout)
             notify = wout.pop("__notify__", None)
             overflow = wout.pop("__overflow__", None)
             wout.pop("__flush__", None)
 
             N = wout[VALID_KEY].shape[0]
-            if not other_is_store:
+            if not other_external:
                 probe_cols, probe_valid = other.window_stage.contents(state[other_key])
-            W = probe_valid.shape[0]
 
-            # joined eval dict: this side [N,1], other side [1,W]
+            # joined eval dict: this side [N,1]; other side [1,W]
+            # (or, partitioned, this row's key's ring gathered to [N,W])
             ev: Dict[str, jnp.ndarray] = {}
+            if partitioned and not other_external:
+                pk_rows = jnp.clip(wout[PK_KEY].astype(jnp.int32), 0,
+                                   probe_valid.shape[0] - 1)
+                probe_cols = {a: v[pk_rows] for a, v in probe_cols.items()}
+                probe_valid = probe_valid[pk_rows]          # [N, W]
+                W = probe_valid.shape[1]
+                for a in other.definition.attributes:
+                    ev[other.prefix + a.name] = probe_cols[a.name]
+                    ev[other.prefix + a.name + "?"] = probe_cols[a.name + "?"]
+                pv = probe_valid
+            else:
+                W = probe_valid.shape[0]
+                for a in other.definition.attributes:
+                    ev[other.prefix + a.name] = probe_cols[a.name][None, :]
+                    ev[other.prefix + a.name + "?"] = probe_cols[a.name + "?"][None, :]
+                pv = probe_valid[None, :]
             for a in side.definition.attributes:
                 ev[side.prefix + a.name] = wout[a.name][:, None]
                 ev[side.prefix + a.name + "?"] = wout[a.name + "?"][:, None]
-            for a in other.definition.attributes:
-                ev[other.prefix + a.name] = probe_cols[a.name][None, :]
-                ev[other.prefix + a.name + "?"] = probe_cols[a.name + "?"][None, :]
             ev[TS_KEY] = wout[TS_KEY][:, None]
 
             row_live = wout[VALID_KEY] & ((wout[TYPE_KEY] == CURRENT) | (wout[TYPE_KEY] == EXPIRED))
             if side.triggers:
                 cond = on_cond(ev, ctx) if on_cond is not None else jnp.ones((N, W), bool)
                 cond = jnp.broadcast_to(cond, (N, W))
-                match = row_live[:, None] & probe_valid[None, :] & cond
+                match = row_live[:, None] & jnp.broadcast_to(pv, (N, W)) & cond
             else:
                 match = jnp.zeros((N, W), bool)
 
@@ -200,11 +267,13 @@ class JoinQueryRuntime(QueryRuntime):
                 joined[side.prefix + a.name] = v.reshape(NW)
                 joined[side.prefix + a.name + "?"] = mk.reshape(NW)
             for a in other.definition.attributes:
+                pc = ev[other.prefix + a.name]
+                pm = ev[other.prefix + a.name + "?"]
                 v = jnp.concatenate(
-                    [jnp.broadcast_to(probe_cols[a.name][None, :], (N, W)),
-                     jnp.zeros((N, 1), probe_cols[a.name].dtype)], axis=1)
+                    [jnp.broadcast_to(pc, (N, W)),
+                     jnp.zeros((N, 1), pc.dtype)], axis=1)
                 mk = jnp.concatenate(
-                    [jnp.broadcast_to(probe_cols[a.name + "?"][None, :], (N, W)),
+                    [jnp.broadcast_to(pm, (N, W)),
                      jnp.ones((N, 1), bool)], axis=1)
                 joined[other.prefix + a.name] = v.reshape(NW)
                 joined[other.prefix + a.name + "?"] = mk.reshape(NW)
@@ -212,7 +281,21 @@ class JoinQueryRuntime(QueryRuntime):
                 [match, one_sided[:, None]], axis=1).reshape(NW)
             joined[TS_KEY] = jnp.repeat(wout[TS_KEY], W + 1)
             joined[TYPE_KEY] = jnp.repeat(wout[TYPE_KEY], W + 1)
-            joined[GK_KEY] = jnp.zeros(NW, jnp.int32)
+            if partitioned:
+                pk_out = jnp.repeat(wout[PK_KEY].astype(jnp.int32), W + 1)
+                joined[PK_KEY] = pk_out
+                joined[GK_KEY] = pk_out
+            else:
+                joined[GK_KEY] = jnp.zeros(NW, jnp.int32)
+
+            if split:
+                # host keyer computes GK from joined columns; the selector
+                # runs as a separate jitted step (_host_keyed_select)
+                if notify is not None:
+                    joined["__notify__"] = notify
+                if overflow is not None:
+                    joined["__overflow__"] = overflow
+                return new_state, joined
 
             new_state["sel"], out = sel.apply(state["sel"], joined, ctx)
             if notify is not None:
@@ -224,11 +307,34 @@ class JoinQueryRuntime(QueryRuntime):
         return step
 
     def build_step_fn(self):
-        return self.build_side_step_fn("left")
+        key = "left" if self.sides["left"].window_stage is not None else "right"
+        return self.build_side_step_fn(key)
 
     def process_side_batch(self, side_key: str, batch: HostBatch):
         with self._lock:
-            batch.cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
+            side = self.sides[side_key]
+            cols = batch.cols
+            partitioned = self.partition_ctx is not None
+            notify_host = None
+            if partitioned:
+                if side.keyer is not None:
+                    cols, pk = side.keyer.apply(cols)
+                    batch = HostBatch(cols)
+                    cols[PK_KEY] = np.asarray(pk, np.int32)
+                elif PK_KEY not in cols:
+                    cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
+                self._ensure_capacity()
+            if side.host_window is not None:
+                now_h = int(self.app_context.timestamp_generator.current_time())
+                hctx = {"xp": np, "current_time": now_h}
+                valid = cols[VALID_KEY]
+                timer = cols[TYPE_KEY] == TIMER
+                for f in side.filters:
+                    valid = valid & (np.asarray(f(cols, hctx)) | timer)
+                cols[VALID_KEY] = valid
+                batch, notify_host = side.host_window.process(batch, now_h)
+                cols = batch.cols
+            cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
             if self._state is None:
                 self._state = self._init_state()
             jitted = self._steps.get(side_key)
@@ -238,6 +344,8 @@ class JoinQueryRuntime(QueryRuntime):
             other = self.sides["right" if side_key == "left" else "left"]
             if other.store is not None:
                 probe_cols, probe_valid = other.store.contents()
+            elif other.host_window is not None:
+                probe_cols, probe_valid = other.host_window.contents()
             else:  # placeholders; the step reads its own state instead
                 probe_cols, probe_valid = {}, jnp.zeros((1,), bool)
 
@@ -245,10 +353,28 @@ class JoinQueryRuntime(QueryRuntime):
                 return jitted(st, probe_cols, probe_valid, cols, now)
 
             notify = self._finish_device_batch(
-                call, batch.cols,
+                call, cols,
                 "join window capacity exceeded — raise app_context.window_capacity")
+        if notify_host is not None:
+            notify = notify_host if notify is None else min(notify, notify_host)
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self._timer_cbs[side_key])
+
+    def _finish_device_batch(self, step, cols, overflow_msg):
+        if self.keyer is None:
+            return super()._finish_device_batch(step, cols, overflow_msg)
+        now = np.int64(self.app_context.timestamp_generator.current_time())
+        self._state, out = step(self._state, cols, now)
+        out_host = {k: np.asarray(v) for k, v in out.items()}
+        overflow = out_host.pop("__overflow__", None)
+        if overflow is not None and int(overflow) > 0:
+            raise RuntimeError(f"query '{self.name}': {overflow_msg}")
+        notify = out_host.pop("__notify__", None)
+        out_host = self._host_keyed_select(out_host)
+        self._emit(HostBatch(out_host))
+        if notify is not None and int(notify) >= 0:
+            return int(notify)
+        return None
 
     def _timer(self, side_key: str, ts: int):
         side = self.sides[side_key]

@@ -104,7 +104,6 @@ class NFAQueryRuntime(QueryRuntime):
         if (self.selector_plan.num_keys, self._win_keys) != before:
             self._steps.clear()
             self._timer_step = None
-            self._sel_step = None
 
     def arm_initial(self):
         """Arm key 0's head wait at app start (reference: absent pre-state
@@ -210,15 +209,6 @@ class NFAQueryRuntime(QueryRuntime):
 
         return step
 
-    def _sel_step_fn(self):
-        sel = self.selector_plan
-
-        def step(sel_state, cols, current_time):
-            ctx = {"xp": jnp, "current_time": current_time}
-            return sel.apply(sel_state, cols, ctx)
-
-        return step
-
     def build_step_fn(self):
         # single-step export (driver compile checks): first stream's step
         return self.build_stream_step_fn(self.stage.plan.stream_ids[0])
@@ -289,17 +279,7 @@ class NFAQueryRuntime(QueryRuntime):
             )
         notify = out_host.pop("__notify__", None)
         if self.keyer is not None:
-            pk = out_host.get(PK_KEY) if self.partition_ctx is not None else None
-            out_host[GK_KEY] = self.keyer(out_host, pk=pk)
-            self._ensure_capacity()
-            if self._sel_step is None:
-                self._sel_step = jax.jit(self._sel_step_fn(), donate_argnums=0)
-            now = np.int64(self.app_context.timestamp_generator.current_time())
-            new_sel, sel_out = self._sel_step(self._state["sel"], out_host, now)
-            self._state["sel"] = new_sel
-            out_host = {k: np.asarray(v) for k, v in sel_out.items()}
-            out_host.pop("__notify__", None)
-            out_host.pop("__overflow__", None)
+            out_host = self._host_keyed_select(out_host)
         self._emit(HostBatch(out_host))
         if notify is not None and int(notify) >= 0:
             return int(notify)

@@ -63,26 +63,8 @@ def _aggregation_contents(agg, oq: OnDemandQuery, dictionary):
         else:
             raise CompileError("within needs `start, end` bounds for aggregations")
 
-    definition = agg.output_definition()
-    rows = agg.rows(duration, within)
-    n = len(rows)
-    cap = max(n, 1)
-    cols = {}
-    for pos, attr in enumerate(definition.attributes):
-        dt = dtype_of(attr.type)
-        arr = np.zeros(cap, dt)
-        mask = np.zeros(cap, bool)
-        for i, r in enumerate(rows):
-            v = r[pos]
-            if v is None:
-                mask[i] = True
-            else:
-                arr[i] = v
-        cols[attr.name] = jnp.asarray(arr)
-        cols[attr.name + "?"] = jnp.asarray(mask)
-    cols[TS_KEY] = cols[definition.attributes[0].name]  # AGG_TIMESTAMP
-    valid = jnp.asarray(np.arange(cap) < n)
-    return definition, cols, valid
+    definition, cols, valid = agg.contents(duration, within)
+    return definition, {k: jnp.asarray(v) for k, v in cols.items()}, jnp.asarray(valid)
 
 
 def run_on_demand_query(source: str, app_runtime) -> List[Event]:

@@ -121,6 +121,7 @@ class QueryRuntime(Receiver):
         self.scheduler = None  # set by the app runtime when timers are needed
         self._state: Optional[dict] = None
         self._step = None
+        self._sel_step = None  # split pipelines (host keyer between stages)
         self._shard_mesh = None  # set by parallel.mesh.shard_query_step
         self._lock = threading.RLock()  # per-query lock (QueryParser.java:159-215)
         self.on_error: Optional[Callable] = None
@@ -161,6 +162,7 @@ class QueryRuntime(Receiver):
                 grew = True
         if not grew:
             return
+        self._sel_step = None
         old_state = self._state
         new_state = self._init_state()
         if old_state is not None:
@@ -289,6 +291,29 @@ class QueryRuntime(Receiver):
             notify = notify_host if notify is None else min(notify, notify_host)
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self.process_timer)
+
+    def _host_keyed_select(self, out_host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Split-pipeline tail: when the group key is computed from a device
+        stage's OUTPUT columns (pattern captures, joined rows), the keyer
+        runs host-side between the stage and a separately-jitted selector
+        step (GroupByKeyGenerator.java:37 over intermediate events)."""
+        pk = out_host.get(PK_KEY) if self.partition_ctx is not None else None
+        out_host[GK_KEY] = self.keyer(out_host, pk=pk)
+        self._ensure_capacity()
+        if self._sel_step is None:
+            sel = self.selector_plan
+
+            def fn(sel_state, cols, now):
+                return sel.apply(sel_state, cols, {"xp": jnp, "current_time": now})
+
+            self._sel_step = jax.jit(fn, donate_argnums=0)
+        now = np.int64(self.app_context.timestamp_generator.current_time())
+        new_sel, sel_out = self._sel_step(self._state["sel"], out_host, now)
+        self._state["sel"] = new_sel
+        out = {k: np.asarray(v) for k, v in sel_out.items()}
+        out.pop("__notify__", None)
+        out.pop("__overflow__", None)
+        return out
 
     def _finish_device_batch(self, step, cols, overflow_msg: str) -> Optional[int]:
         """Run the jitted step, raise on overflow, emit outputs; returns the

@@ -116,6 +116,16 @@ class KeyedLengthWindowStage(WindowStage):
         out, _ = _order_emit(parts)
         return {"buf": new_buf, "total": state["total"] + counts}, out
 
+    def contents(self, state):
+        """Per-key probe surface for partitioned joins: ([K, W] cols,
+        [K, W] valid)."""
+        W = self.length
+        K = state["total"].shape[0]
+        cols = {k: v.reshape(K, W) for k, v in state["buf"].items()}
+        j = jnp.arange(W, dtype=jnp.int64)[None, :]
+        valid = j < jnp.minimum(state["total"], W)[:, None]
+        return cols, valid
+
 
 class KeyedTimeWindowStage(WindowStage):
     """Sliding time window per partition key (live clock driven). Each key
@@ -215,6 +225,18 @@ class KeyedTimeWindowStage(WindowStage):
         nxt_notify = jnp.min(jnp.where(occ2, ts2 + t, _BIG))
         out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt_notify, jnp.int64(-1))
         return {"buf": new_buf, "total": new_total, "expired_upto": new_exp}, out
+
+    def contents(self, state):
+        """Per-key probe surface: slot j of key k is live iff some sequence
+        s in [expired_upto, total) lands on it (s % Wc == j)."""
+        Wc = self.capacity
+        K = state["total"].shape[0]
+        cols = {k: v.reshape(K, Wc) for k, v in state["buf"].items()}
+        j = jnp.arange(Wc, dtype=jnp.int64)[None, :]
+        exp0 = state["expired_upto"][:, None]
+        live = state["total"][:, None] - exp0
+        valid = ((j - exp0 % Wc) % Wc) < live
+        return cols, valid
 
 
 def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:

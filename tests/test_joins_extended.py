@@ -1,0 +1,168 @@
+"""Join completeness tests: group-by selectors, joins inside partitions,
+host-window join sides, aggregation joins — mirroring reference
+``query/join/*TestCase`` + ``aggregation/*AggregationTestCase`` join shapes.
+"""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+STREAMS = """
+    define stream OrderStream (symbol string, qty int);
+    define stream PriceStream (symbol string, price double);
+"""
+
+
+def test_join_group_by_aggregation():
+    m, rt, c = build(STREAMS + """
+        from OrderStream#window.length(8) join PriceStream#window.length(8)
+          on OrderStream.symbol == PriceStream.symbol
+        select OrderStream.symbol as symbol, sum(OrderStream.qty) as total
+        group by OrderStream.symbol
+        insert into OutStream;
+    """)
+    ho = rt.get_input_handler("OrderStream")
+    hp = rt.get_input_handler("PriceStream")
+    hp.send(["A", 10.0])
+    hp.send(["B", 20.0])
+    ho.send(["A", 5])      # joins with A price: total(A) = 5
+    ho.send(["A", 7])      # total(A) = 12
+    ho.send(["B", 3])      # total(B) = 3
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    # each CURRENT match updates the group sum; EXPIRED never fires (window 8)
+    assert ("A", 5) in got and ("A", 12) in got and ("B", 3) in got
+
+
+def test_join_inside_partition():
+    m, rt, c = build("""
+        define stream L (k string, v int);
+        define stream R (k string, w int);
+        partition with (k of L, k of R)
+        begin
+          from L#window.length(4) join R#window.length(4)
+          select L.v as v, R.w as w
+          insert into OutStream;
+        end;
+    """)
+    hl = rt.get_input_handler("L")
+    hr = rt.get_input_handler("R")
+    hl.send(["p1", 1])
+    hl.send(["p2", 2])
+    hr.send(["p1", 10])    # joins ONLY with p1's L rows
+    hr.send(["p2", 20])    # joins ONLY with p2's L rows
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [(1, 10), (2, 20)]
+
+
+def test_host_window_join_side():
+    # sort window as a join side: contents() is the probe surface
+    m, rt, c = build(STREAMS + """
+        from OrderStream#window.sort(2, qty) join PriceStream#window.length(4)
+          on OrderStream.symbol == PriceStream.symbol
+        select OrderStream.qty as qty, PriceStream.price as price
+        insert into OutStream;
+    """)
+    ho = rt.get_input_handler("OrderStream")
+    hp = rt.get_input_handler("PriceStream")
+    ho.send(["A", 5])
+    ho.send(["A", 1])
+    ho.send(["A", 9])      # sort(2) keeps the 2 smallest: {1, 5}
+    c.events.clear()
+    hp.send(["A", 10.0])   # probes the sort window's held rows
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [(1, 10.0), (5, 10.0)]
+
+
+def test_aggregation_join():
+    m, rt, c = build("""
+        @app:playback
+        define stream TradeStream (symbol string, price double, volume long);
+        define stream QueryStream (symbol string);
+        define aggregation TradeAgg
+          from TradeStream
+          select symbol, sum(price) as total, count() as n
+          group by symbol
+          aggregate every sec ... min;
+        from QueryStream join TradeAgg
+          on QueryStream.symbol == TradeAgg.symbol
+          within 0L, 9999999999999L per 'seconds'
+        select QueryStream.symbol as symbol, TradeAgg.total as total
+        insert into OutStream;
+    """)
+    ht = rt.get_input_handler("TradeStream")
+    hq = rt.get_input_handler("QueryStream")
+    ht.send(10_000, ["A", 10.0, 1])
+    ht.send(10_200, ["A", 15.0, 1])     # same second bucket: total 25
+    ht.send(11_000, ["B", 50.0, 1])
+    hq.send(12_000, ["A"])
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [("A", 25.0)]
+
+
+def test_aggregation_join_multiple_buckets():
+    m, rt, c = build("""
+        @app:playback
+        define stream TradeStream (symbol string, price double, volume long);
+        define stream QueryStream (symbol string);
+        define aggregation TradeAgg
+          from TradeStream
+          select symbol, sum(price) as total
+          group by symbol
+          aggregate every sec ... min;
+        from QueryStream join TradeAgg
+          on QueryStream.symbol == TradeAgg.symbol
+          within 0L, 9999999999999L per 'seconds'
+        select TradeAgg.AGG_TIMESTAMP as bucket, TradeAgg.total as total
+        insert into OutStream;
+    """)
+    ht = rt.get_input_handler("TradeStream")
+    hq = rt.get_input_handler("QueryStream")
+    ht.send(10_000, ["A", 10.0, 1])
+    ht.send(12_000, ["A", 5.0, 1])      # a different second bucket
+    hq.send(13_000, ["A"])
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [(10_000, 10.0), (12_000, 5.0)]
+
+
+def test_join_group_by_inside_partition():
+    m, rt, c = build("""
+        define stream L (k string, g string, v int);
+        define stream R (k string, w int);
+        partition with (k of L, k of R)
+        begin
+          from L#window.length(8) join R#window.length(8)
+          select L.g as g, sum(R.w) as tw
+          group by L.g
+          insert into OutStream;
+        end;
+    """)
+    hl = rt.get_input_handler("L")
+    hr = rt.get_input_handler("R")
+    hl.send(["p1", "x", 1])
+    hr.send(["p1", 10])            # (p1, x): 10
+    hl.send(["p2", "x", 2])
+    hr.send(["p2", 30])            # (p2, x): 30 — separate key space
+    m.shutdown()
+    got = sorted(tuple(e.data) for e in c.events)
+    assert got == [("x", 10), ("x", 30)]
