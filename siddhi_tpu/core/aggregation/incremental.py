@@ -67,6 +67,30 @@ def parse_duration_name(name: str) -> Duration:
     return d
 
 
+_TIME_UNITS_MS = {
+    "ms": 1, "millisecond": 1, "milliseconds": 1,
+    "sec": 1000, "second": 1000, "seconds": 1000,
+    "min": 60_000, "minute": 60_000, "minutes": 60_000,
+    "hour": 3600_000, "hours": 3600_000,
+    "day": 86_400_000, "days": 86_400_000,
+    "month": 31 * 86_400_000, "months": 31 * 86_400_000,
+    "year": 366 * 86_400_000, "years": 366 * 86_400_000,
+}
+
+
+def _parse_time_str(s: str) -> Optional[int]:
+    """'120 sec' / '24 hours' / 'all' -> milliseconds (None = keep all)."""
+    s = s.strip().lower()
+    if s == "all":
+        return None
+    parts = s.split()
+    if len(parts) == 2 and parts[1] in _TIME_UNITS_MS:
+        return int(float(parts[0]) * _TIME_UNITS_MS[parts[1]])
+    if s.isdigit():
+        return int(s)
+    raise CompileError(f"cannot parse retention/interval time '{s}'")
+
+
 def bucket_starts(ts_ms: np.ndarray, duration: Duration) -> np.ndarray:
     """Vectorized bucket-start (ms) per duration; months/years are
     calendar-truncated (reference ``executor/incremental/*`` time
@@ -99,6 +123,8 @@ class _BaseSpec:
             return a
         if self.kind in ("sum", "count"):
             return a + b
+        if self.kind == "distinct":
+            return a | b          # sets of observed values
         return min(a, b) if self.kind == "min" else max(a, b)
 
 
@@ -174,15 +200,22 @@ class IncrementalAggregationRuntime(Receiver):
                     f"aggregation selection '{name}' must be an aggregator call "
                     f"or a group-by attribute")
             kind = expr.name.lower()
-            if kind not in ("sum", "count", "avg", "min", "max"):
+            if kind not in ("sum", "count", "avg", "min", "max", "distinctcount"):
                 raise CompileError(
                     f"incremental aggregator '{kind}' is not supported "
-                    f"(sum/count/avg/min/max)")
+                    f"(sum/count/avg/min/max/distinctCount)")
             arg_fn, arg_t = (compile_expr(expr.parameters[0], resolver)
                              if expr.parameters else (None, None))
             if kind == "count":
                 base = self._base("count", None, AttrType.LONG)
                 self.outputs.append(_OutSpec(name, "count", [base], AttrType.LONG))
+            elif kind == "distinctcount":
+                # per-bucket per-group value sets (reference
+                # IncrementalAggregateBaseTimeFunctions distinct-count)
+                base = self._base(f"distinct@{name}", arg_fn, AttrType.LONG,
+                                  kind="distinct")
+                self.outputs.append(_OutSpec(name, "distinctcount", [base],
+                                             AttrType.LONG))
             elif kind == "avg":
                 bs = self._base(f"sum@{name}", arg_fn, AttrType.DOUBLE)
                 # avg counts only non-null argument rows, so its count base
@@ -203,6 +236,63 @@ class IncrementalAggregationRuntime(Receiver):
         self.store: Dict[Duration, Dict[int, Dict[tuple, list]]] = {
             d: {} for d in self.durations
         }
+
+        # @purge retention (reference IncrementalDataPurger.java:62):
+        # per-duration retention windows; coarser durations retain the
+        # history the purged finer buckets summarized
+        from siddhi_tpu.query_api.annotations import find_annotation
+
+        purge_ann = find_annotation(definition.annotations or [], "purge")
+        self.purge_enabled = False
+        self.purge_interval_ms = 15 * 60 * 1000
+        self.retention: Dict[Duration, Optional[int]] = {}
+        if purge_ann is not None:
+            self.purge_enabled = (purge_ann.element("enable") or "true").lower() == "true"
+            interval = purge_ann.element("interval")
+            if interval:
+                self.purge_interval_ms = _parse_time_str(interval)
+            # reference defaults (IncrementalDataPurger): fine granularities
+            # age out fast, coarse ones are kept
+            self.retention = {
+                Duration.SECONDS: 120_000,
+                Duration.MINUTES: 24 * 3600_000,
+                Duration.HOURS: 30 * 24 * 3600_000,
+                Duration.DAYS: 366 * 24 * 3600_000,
+                Duration.MONTHS: None,
+                Duration.YEARS: None,
+            }
+            rp = purge_ann.annotation("retentionPeriod")
+            if rp is not None:
+                for k, v in rp.elements:
+                    if k is None:
+                        continue
+                    self.retention[parse_duration_name(k)] = _parse_time_str(v)
+
+        # @PartitionById distributed (shard) mode: this runtime aggregates
+        # only its shard's events; rows are tagged so a reader can stitch
+        # shards (reference AggregationParser.java:171-197 shardId columns)
+        pbi = find_annotation(definition.annotations or [], "PartitionById")
+        self.shard_mode = pbi is not None and (
+            (pbi.element("enable") or "true").lower() == "true")
+        self.shard_id = getattr(app_context, "node_id", "0") if self.shard_mode else None
+
+    def purge(self, now: Optional[int] = None) -> int:
+        """Drop buckets older than each duration's retention; returns the
+        number of purged buckets (reference IncrementalDataPurger run)."""
+        if now is None:
+            now = int(self.app_context.timestamp_generator.current_time())
+        purged = 0
+        with self._lock:
+            for d, dstore in self.store.items():
+                keep_ms = self.retention.get(d)
+                if keep_ms is None:
+                    continue
+                cutoff = now - keep_ms
+                drop = [b for b in dstore if b < cutoff]
+                for b in drop:
+                    del dstore[b]
+                purged += len(drop)
+        return purged
 
     def _base(self, key: str, arg_fn, out_type, kind: Optional[str] = None) -> str:
         if key not in self.bases:
@@ -261,8 +351,10 @@ class IncrementalAggregationRuntime(Receiver):
                         nm = base_null[k]
                         if nm is not None and nm[i]:
                             continue  # null arg leaves the base untouched
-                        slot[j] = self.bases[k].fold(slot[j],
-                                                     base_vals[k][i].item())
+                        spec = self.bases[k]
+                        v = base_vals[k][i].item()
+                        slot[j] = spec.fold(slot[j],
+                                            {v} if spec.kind == "distinct" else v)
 
     # -------------------------------------------------------------- query
 
@@ -306,6 +398,9 @@ class IncrementalAggregationRuntime(Receiver):
                             row.append(s / c if (c and s is not None) else None)
                         elif o.kind == "count":
                             row.append(by_key[o.bases[0]] or 0)
+                        elif o.kind == "distinctcount":
+                            s = by_key[o.bases[0]]
+                            row.append(len(s) if s else 0)
                         else:
                             row.append(by_key[o.bases[0]])  # None -> null output
                     onames = {o.name for o in self.outputs}

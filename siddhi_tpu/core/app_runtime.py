@@ -374,6 +374,11 @@ class SiddhiAppRuntime:
                 # connect with retry/backoff off-thread (Source.java:155-185)
                 t = threading.Thread(target=sr.connect_with_retry, daemon=True)
                 t.start()
+            for agg in self.aggregations.values():
+                if agg.purge_enabled and scheduler is not None:
+                    scheduler.schedule_periodic(
+                        agg.purge_interval_ms,
+                        lambda ts, a=agg: a.purge(ts))
             for tr in self.trigger_runtimes:
                 tr.start()
 
