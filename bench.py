@@ -246,19 +246,32 @@ def bench_nfa_p99():
     return p99, n / total_t
 
 
-def main():
+def _run_section(name: str) -> dict:
+    """Run one bench section in a fresh subprocess: each section gets its
+    own axon tunnel session — in-process back-to-back sections wedge the
+    single-client tunnel on the previous section's buffer teardown."""
+    import subprocess
     import sys
 
-    def note(msg):
-        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+    print(f"[bench] {name} section…", file=sys.stderr, flush=True)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--section", name],
+        capture_output=True, text=True, timeout=1200,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if r.returncode != 0:
+        print(r.stderr[-2000:], file=sys.stderr, flush=True)
+        raise RuntimeError(f"bench section {name} failed rc={r.returncode}")
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    print(f"[bench] {name}: {out}", file=sys.stderr, flush=True)
+    return out
 
-    note("device section…")
-    eps_device = bench_device()
-    note(f"device: {eps_device:.0f} eps; e2e section…")
-    eps_e2e = bench_e2e()
-    note(f"e2e: {eps_e2e:.0f} eps; nfa section…")
-    nfa_p99_ms, nfa_eps = bench_nfa_p99()
-    note("done")
+
+def main():
+    dev = _run_section("device")
+    e2e = _run_section("e2e")
+    nfa = _run_section("nfa")
+    eps_device = dev["eps"]
     print(json.dumps({
         "metric": "events_per_sec_10k_key_length1000_avg",
         "value": round(eps_device, 1),
@@ -266,12 +279,26 @@ def main():
         "vs_baseline": round(eps_device / MEASURED_BASELINE_EPS, 3),
         "baseline_events_per_sec": MEASURED_BASELINE_EPS,
         "baseline_source": "tools/baseline_cpp (measured; no JVM in image)",
-        "e2e_events_per_sec": round(eps_e2e, 1),
-        "nfa_p99_ms_per_batch": round(nfa_p99_ms, 3),
-        "nfa_events_per_sec": round(nfa_eps, 1),
+        "e2e_events_per_sec": round(e2e["eps"], 1),
+        "nfa_p99_ms_per_batch": round(nfa["p99_ms"], 3),
+        "nfa_events_per_sec": round(nfa["eps"], 1),
         "batch": BATCH,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        section = sys.argv[2]
+        if section == "device":
+            print(json.dumps({"eps": bench_device()}))
+        elif section == "e2e":
+            print(json.dumps({"eps": bench_e2e()}))
+        elif section == "nfa":
+            p99, eps = bench_nfa_p99()
+            print(json.dumps({"p99_ms": p99, "eps": eps}))
+        else:
+            raise SystemExit(f"unknown section {section}")
+    else:
+        main()

@@ -345,6 +345,278 @@ class SessionWindowStage(HostWindowStage):
         }
 
 
+class CronSchedule:
+    """Quartz-style cron subset: ``sec min hour dom mon dow`` with ``*``,
+    ``?``, ``*/n``, ``a-b``, ``a,b,c`` fields (reference CronWindowProcessor
+    delegates to Quartz; this evaluates next-fire directly)."""
+
+    _RANGES = [(0, 59), (0, 59), (0, 23), (1, 31), (1, 12), (0, 7)]
+
+    def __init__(self, expr: str):
+        fields = expr.split()
+        if len(fields) == 7:
+            fields = fields[:6]           # drop the optional year field
+        if len(fields) != 6:
+            raise CompileError(
+                f"cron expression '{expr}' needs 6 fields (sec min hour dom mon dow)")
+        self.sets = [self._parse(f, lo, hi)
+                     for f, (lo, hi) in zip(fields, self._RANGES)]
+
+    @staticmethod
+    def _parse(field: str, lo: int, hi: int) -> Optional[set]:
+        if field in ("*", "?"):
+            return None                   # any
+        out = set()
+        for part in field.split(","):
+            if part.startswith("*/"):
+                step = int(part[2:])
+                out.update(range(lo, hi + 1, step))
+            elif "-" in part:
+                a, b = part.split("-")
+                if "/" in b:
+                    b, st = b.split("/")
+                    out.update(range(int(a), int(b) + 1, int(st)))
+                else:
+                    out.update(range(int(a), int(b) + 1))
+            else:
+                out.add(int(part))
+        return out
+
+    def next_fire(self, now_ms: int) -> int:
+        """First cron time strictly after now_ms."""
+        import datetime
+
+        t = datetime.datetime.fromtimestamp(
+            now_ms / 1000.0, datetime.timezone.utc
+        ).replace(microsecond=0, tzinfo=None) + datetime.timedelta(seconds=1)
+        sec_s, min_s, hour_s, dom_s, mon_s, dow_s = self.sets
+        for _ in range(4 * 366 * 24 * 60):       # bounded search (minutes)
+            if (mon_s is None or t.month in mon_s) and \
+               (dom_s is None or t.day in dom_s) and \
+               (dow_s is None or t.isoweekday() % 7 in dow_s) and \
+               (hour_s is None or t.hour in hour_s) and \
+               (min_s is None or t.minute in min_s):
+                secs = sorted(sec_s) if sec_s is not None else range(60)
+                for s in secs:
+                    if s >= t.second:
+                        fire = t.replace(second=s)
+                        return int(fire.replace(
+                            tzinfo=datetime.timezone.utc).timestamp() * 1000)
+            t = (t + datetime.timedelta(minutes=1)).replace(second=0)
+        raise CompileError("cron expression never fires")
+
+
+class CronWindowStage(HostWindowStage):
+    """``cron('<expr>')``: collects events and flushes them as a batch at
+    each cron fire; the previous batch expires (reference
+    CronWindowProcessor)."""
+
+    needs_scheduler = True
+    batch_mode = True
+
+    def __init__(self, schedule: CronSchedule, col_specs):
+        super().__init__(col_specs)
+        self.schedule = schedule
+        self._rows: List[dict] = []
+        self._prev: List[dict] = []
+        self._next_fire: Optional[int] = None
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        out_rows: List[dict] = []
+        if self._next_fire is None:
+            self._next_fire = self.schedule.next_fire(now)
+        if now >= self._next_fire:
+            for r in self._prev:
+                rr = dict(r)
+                rr[TS_KEY] = now
+                rr[TYPE_KEY] = EXPIRED
+                out_rows.append(rr)
+            for r in self._rows:
+                rr = dict(r)
+                rr[TYPE_KEY] = CURRENT
+                out_rows.append(rr)
+            self._prev = self._rows
+            self._rows = []
+            self._next_fire = self.schedule.next_fire(now)
+        valid = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        for i in np.nonzero(valid)[0]:
+            self._rows.append(_row(cols, int(i)))
+        return _emit(out_rows, self.col_specs), self._next_fire
+
+    def _held_rows(self):
+        return list(self._rows)
+
+    def snapshot(self):
+        return {"rows": self._rows, "prev": self._prev, "next": self._next_fire}
+
+    def restore(self, snap):
+        self._rows = list(snap["rows"])
+        self._prev = list(snap["prev"])
+        self._next_fire = snap["next"]
+
+
+def _eval_window_expr(expr, rows: List[dict], new_row: Optional[dict],
+                      now: int, dictionary):
+    """Evaluate a window-retention expression over the held rows
+    (reference ExpressionWindowProcessor vocabulary): ``count()``,
+    ``sum/avg/min/max(attr)``, ``first.attr`` / ``last.attr``,
+    ``eventTimestamp(first|last)``, ``currentTimeMillis()``, literals and
+    arithmetic/compare/logic over them."""
+    from siddhi_tpu.query_api.expressions import (
+        And, Compare, Constant, Divide, Multiply, Not, Or, Subtract, Add,
+        AttributeFunction, Variable,
+    )
+
+    def ev(e):
+        if isinstance(e, Constant):
+            if isinstance(e.value, str):
+                return dictionary.encode(e.value)
+            return e.value
+        if isinstance(e, Variable):
+            sid = e.stream_id
+            if sid in ("first", "last"):
+                row = rows[0] if sid == "first" else rows[-1]
+                return row[e.attribute_name]
+            raise CompileError(
+                "expression window variables must be first.<attr>/last.<attr>")
+        if isinstance(e, AttributeFunction):
+            name = e.name.lower()
+            if name == "count":
+                return len(rows)
+            if name == "currenttimemillis":
+                return now
+            if name == "eventtimestamp":
+                if e.parameters and isinstance(e.parameters[0], Variable):
+                    which = e.parameters[0].attribute_name
+                    row = rows[0] if which == "first" else rows[-1]
+                    return row[TS_KEY]
+                return now
+            if name in ("sum", "avg", "min", "max"):
+                attr = e.parameters[0].attribute_name
+                vals = [r[attr] for r in rows]
+                if not vals:
+                    return 0 if name in ("sum", "avg") else None
+                if name == "sum":
+                    return sum(vals)
+                if name == "avg":
+                    return sum(vals) / len(vals)
+                return min(vals) if name == "min" else max(vals)
+            raise CompileError(f"expression window function '{e.name}' unsupported")
+        if isinstance(e, Add):
+            return ev(e.left) + ev(e.right)
+        if isinstance(e, Subtract):
+            return ev(e.left) - ev(e.right)
+        if isinstance(e, Multiply):
+            return ev(e.left) * ev(e.right)
+        if isinstance(e, Divide):
+            return ev(e.left) / ev(e.right)
+        if isinstance(e, Compare):
+            l, r = ev(e.left), ev(e.right)
+            op = e.operator
+            return {"==": l == r, "!=": l != r, "<": l < r, "<=": l <= r,
+                    ">": l > r, ">=": l >= r}[op]
+        if isinstance(e, And):
+            return ev(e.left) and ev(e.right)
+        if isinstance(e, Or):
+            return ev(e.left) or ev(e.right)
+        if isinstance(e, Not):
+            return not ev(e.expression)
+        raise CompileError(f"expression window: unsupported node {type(e).__name__}")
+
+    return bool(ev(expr))
+
+
+class ExpressionWindowStage(HostWindowStage):
+    """``expression('<expr>')`` sliding retention: after each arrival the
+    oldest events are evicted until the expression holds (reference
+    ExpressionWindowProcessor)."""
+
+    def __init__(self, expr, col_specs, dictionary):
+        super().__init__(col_specs)
+        self.expr = expr
+        self.dictionary = dictionary
+        self._rows: List[dict] = []
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        out_rows: List[dict] = []
+        valid = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        for i in np.nonzero(valid)[0]:
+            r = _row(cols, int(i))
+            self._rows.append(r)
+            rr = dict(r)
+            rr[TYPE_KEY] = CURRENT
+            out_rows.append(rr)
+            while self._rows and not _eval_window_expr(
+                self.expr, self._rows, r, now, self.dictionary
+            ):
+                old = self._rows.pop(0)
+                oo = dict(old)
+                oo[TS_KEY] = now
+                oo[TYPE_KEY] = EXPIRED
+                out_rows.append(oo)
+        return _emit(out_rows, self.col_specs), None
+
+    def _held_rows(self):
+        return list(self._rows)
+
+    def snapshot(self):
+        return {"rows": self._rows}
+
+    def restore(self, snap):
+        self._rows = list(snap["rows"])
+
+
+class ExpressionBatchWindowStage(HostWindowStage):
+    """``expressionBatch('<expr>')``: when an arrival breaks the
+    expression, the collected batch flushes and a new one starts with the
+    breaking event (reference ExpressionBatchWindowProcessor)."""
+
+    batch_mode = True
+
+    def __init__(self, expr, col_specs, dictionary):
+        super().__init__(col_specs)
+        self.expr = expr
+        self.dictionary = dictionary
+        self._rows: List[dict] = []
+        self._prev: List[dict] = []
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        out_rows: List[dict] = []
+        valid = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        for i in np.nonzero(valid)[0]:
+            r = _row(cols, int(i))
+            self._rows.append(r)
+            if not _eval_window_expr(self.expr, self._rows, r, now,
+                                     self.dictionary):
+                flush = self._rows[:-1]
+                if flush:
+                    for p in self._prev:
+                        pp = dict(p)
+                        pp[TS_KEY] = now
+                        pp[TYPE_KEY] = EXPIRED
+                        out_rows.append(pp)
+                    for f in flush:
+                        ff = dict(f)
+                        ff[TYPE_KEY] = CURRENT
+                        out_rows.append(ff)
+                    self._prev = flush
+                self._rows = self._rows[-1:]
+        return _emit(out_rows, self.col_specs), None
+
+    def _held_rows(self):
+        return list(self._rows)
+
+    def snapshot(self):
+        return {"rows": self._rows, "prev": self._prev}
+
+    def restore(self, snap):
+        self._rows = list(snap["rows"])
+        self._prev = list(snap["prev"])
+
+
 def create_host_window_stage(window, input_def, resolver, app_context) -> HostWindowStage:
     from siddhi_tpu.ops.types import dtype_of
     from siddhi_tpu.ops.windows import _const_param
@@ -415,5 +687,23 @@ def create_host_window_stage(window, input_def, resolver, app_context) -> HostWi
                 raise CompileError(
                     "session allowedLatency is not supported yet")
         return SessionWindowStage(gap, key_col, col_specs)
+
+    if name == "cron":
+        expr = _const_param(window, 0, "cron expression")
+        if not isinstance(expr, str):
+            raise CompileError("cron window needs a quoted cron expression")
+        return CronWindowStage(CronSchedule(expr), col_specs)
+
+    if name in ("expression", "expressionbatch"):
+        src = _const_param(window, 0, "expression")
+        if not isinstance(src, str):
+            raise CompileError(f"{window.name} window needs a quoted expression")
+        from siddhi_tpu.compiler.parser import Parser
+        from siddhi_tpu.compiler.tokenizer import tokenize
+
+        expr = Parser(tokenize(src)).parse_expression()
+        cls = (ExpressionWindowStage if name == "expression"
+               else ExpressionBatchWindowStage)
+        return cls(expr, col_specs, resolver.dictionary)
 
     raise CompileError(f"host window '{window.name}' is not implemented")

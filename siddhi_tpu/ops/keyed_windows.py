@@ -239,6 +239,120 @@ class KeyedTimeWindowStage(WindowStage):
         return cols, valid
 
 
+class KeyedSessionWindowStage(WindowStage):
+    """``session(gap)`` over dense per-key state — the shape the host
+    SessionWindowStage keeps in a Python dict, inverted to ``[K, W]``
+    tensors: per-key row buffer + last-event timestamp + row count. Events
+    pass through as CURRENT; a key idle past ``gap`` emits its buffered
+    session as one EXPIRED chunk (reference ``SessionWindowProcessor``
+    without allowedLatency). In-batch gaps are handled with one round per
+    same-key occurrence (``lax.while_loop``); end-of-batch idle keys are
+    swept vectorized across all K."""
+
+    keyed = True
+    needs_scheduler = True
+
+    def __init__(self, gap_ms: int, col_specs: Dict[str, np.dtype], capacity: int):
+        if gap_ms <= 0:
+            raise CompileError("session window needs a positive gap")
+        self.gap_ms = gap_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        W = self.capacity
+        K = num_keys
+        return {
+            "buf": {k: jnp.zeros((K, W), dt) for k, dt in self.col_specs.items()},
+            "cnt": jnp.zeros((K,), jnp.int32),
+            "last": jnp.zeros((K,), jnp.int64),
+            "sess_overflow": jnp.int32(0),
+        }
+
+    def apply(self, state, cols, ctx):
+        W = self.capacity
+        K = state["cnt"].shape[0]
+        gap = jnp.int64(self.gap_ms)
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        ts = cols[TS_KEY]
+        pk = jnp.clip(cols[PK_KEY].astype(jnp.int32), 0, K - 1)
+        jW = jnp.arange(W, dtype=jnp.int32)
+
+        _o, _i, occ, _c, _s = _per_key_layout(pk, valid_cur, K)
+        n_rounds = jnp.max(jnp.where(valid_cur, occ, -1)) + 1
+
+        buf_names = list(self.col_specs)
+        out_exp0 = {n: jnp.zeros((B, W), self.col_specs[n]) for n in buf_names}
+        exp_mask0 = jnp.zeros((B, W), bool)
+
+        def round_body(carry):
+            r, buf, cnt, last, out_exp, exp_mask, overflow = carry
+            m = valid_cur & (occ == r)
+            rows_pk = jnp.where(m, pk, K)
+            cnt_k = cnt[pk]                      # [B]
+            last_k = last[pk]
+            brk = m & (cnt_k > 0) & (ts > last_k + gap)
+            # emit the broken session's rows (this row's private lane)
+            sel = brk[:, None] & (jW[None, :] < cnt_k[:, None])
+            out_exp = {n: jnp.where(sel, buf[n][pk], out_exp[n]) for n in buf_names}
+            exp_mask = exp_mask | sel
+            cnt2 = jnp.where(brk, 0, cnt_k)
+            # append the current row to its key's session
+            overflow = overflow + jnp.sum(m & (cnt2 >= W)).astype(jnp.int32)
+            slot = jnp.where(m, jnp.minimum(cnt2, W - 1), 0)
+            buf = {n: buf[n].at[rows_pk, slot].set(cols[n], mode="drop")
+                   for n in buf_names}
+            cnt = cnt.at[rows_pk].set(jnp.where(m, cnt2 + 1, cnt_k), mode="drop")
+            last = last.at[rows_pk].set(jnp.where(m, ts, last_k), mode="drop")
+            return r + 1, buf, cnt, last, out_exp, exp_mask, overflow
+
+        carry0 = (jnp.int32(0), state["buf"], state["cnt"], state["last"],
+                  out_exp0, exp_mask0, state["sess_overflow"])
+        (_r, buf, cnt, last, out_exp, exp_mask, overflow) = lax.while_loop(
+            lambda c: c[0] < n_rounds, round_body, carry0)
+
+        # end-of-batch idle sweep across all keys
+        due = (cnt > 0) & (last + gap <= now)
+        sweep_sel = due[:, None] & (jW[None, :] < cnt[:, None])   # [K, W]
+        cnt = jnp.where(due, 0, cnt)
+
+        # ordering: per-row [expired lane..., current], then the sweep
+        idx = jnp.arange(B, dtype=jnp.int64)
+        STRIDE = jnp.int64(W + 1)
+        exp_rows = {n: out_exp[n].reshape(B * W) for n in buf_names}
+        exp_rows[TS_KEY] = jnp.where(exp_mask.reshape(B * W), now,
+                                     exp_rows[TS_KEY])
+        exp_okey = (idx[:, None] * STRIDE + jW[None, :]).reshape(B * W)
+        cur_okey = idx * STRIDE + W
+        BASE = jnp.int64(B) * STRIDE
+        sweep_rows = {n: buf[n].reshape(K * W) for n in buf_names}
+        sweep_rows[TS_KEY] = jnp.where(sweep_sel.reshape(K * W), now,
+                                       sweep_rows[TS_KEY])
+        sweep_okey = BASE + jnp.arange(K * W, dtype=jnp.int64)
+
+        parts = [
+            (exp_rows, jnp.full((B * W,), EXPIRED, jnp.int8),
+             exp_mask.reshape(B * W), exp_okey),
+            ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, cur_okey),
+            (sweep_rows, jnp.full((K * W,), EXPIRED, jnp.int8),
+             sweep_sel.reshape(K * W), sweep_okey),
+        ]
+        out, _ = _order_emit(parts)
+        nxt = jnp.min(jnp.where(cnt > 0, last + gap, _BIG))
+        out[NOTIFY_KEY] = jnp.where(jnp.any(cnt > 0), nxt, jnp.int64(-1))
+        out[OVERFLOW_KEY] = (overflow > state["sess_overflow"]).astype(jnp.int32)
+        return {"buf": buf, "cnt": cnt, "last": last,
+                "sess_overflow": overflow}, out
+
+    def contents(self, state):
+        jW = jnp.arange(self.capacity, dtype=jnp.int32)
+        valid = jW[None, :] < state["cnt"][:, None]
+        return dict(state["buf"]), valid
+
+
 def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:
     """Keyed (partitioned) window factory. Capacity per key comes from
     ``app_context.partition_window_capacity``."""
@@ -253,7 +367,10 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
         return KeyedLengthWindowStage(int(_const_param(window, 0, "length")), col_specs)
     if name == "time":
         return KeyedTimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
+    if name == "session":
+        return KeyedSessionWindowStage(int(_const_param(window, 0, "gap")),
+                                       col_specs, capacity)
     raise CompileError(
         f"window '{window.name}' inside a partition is not implemented yet "
-        f"(keyed variants exist for: length, time)"
+        f"(keyed variants exist for: length, time, session)"
     )

@@ -546,6 +546,108 @@ class TimeBatchWindowStage(WindowStage):
         return dict(state["cur"]), valid
 
 
+class HoppingWindowStage(WindowStage):
+    """``hopping(windowTime, hopTime)``: every hop, emit the events of the
+    trailing windowTime as a batch (reference HopingWindowProcessor — a
+    time batch whose emission period is decoupled from its retention)."""
+
+    batch_mode = True
+    needs_scheduler = True
+
+    def __init__(self, window_ms: int, hop_ms: int,
+                 col_specs: Dict[str, np.dtype], capacity: int):
+        if hop_ms <= 0 or window_ms <= 0:
+            raise CompileError("hopping window needs positive window and hop times")
+        self.window_ms = window_ms
+        self.hop_ms = hop_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        zero = lambda: {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}  # noqa: E731
+        return {"buf": zero(), "prev": zero(),
+                "total": jnp.int64(0), "expired_upto": jnp.int64(0),
+                "prev_count": jnp.int64(0), "next_emit": jnp.int64(-1)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        w = jnp.int64(self.window_ms)
+        hop = jnp.int64(self.hop_ms)
+        keys = _data_keys(cols)
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+
+        next_emit0 = state["next_emit"]
+        next_emit = jnp.where(next_emit0 < 0, now + hop, next_emit0)
+        send = now >= next_emit
+        next_emit = jnp.where(send, next_emit + hop, next_emit)
+
+        # append arrivals to the ts-monotone FIFO ring
+        total0 = state["total"]
+        exp0 = state["expired_upto"]
+        rank, n_ins = _insert_ranks(valid_cur)
+        slot = jnp.where(valid_cur, ((total0 + rank) % Wc).astype(jnp.int32), Wc)
+        buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop") for k in state["buf"]}
+        total = total0 + n_ins
+
+        # live FIFO view; rows older than the trailing window can never be
+        # emitted again — drop them from the live range
+        widx = jnp.arange(Wc, dtype=jnp.int64)
+        fifo_seq = exp0 + widx
+        occ = fifo_seq < total
+        flat = (fifo_seq % Wc).astype(jnp.int32)
+        ring_ts = buf[TS_KEY][flat]
+        stale = occ & (ring_ts <= now - w)
+        new_exp = exp0 + jnp.sum(stale.astype(jnp.int64))
+
+        in_window = occ & ~stale & send
+        cur_rows = {k: buf[k][flat] for k in buf}
+        n_emit = jnp.sum(in_window.astype(jnp.int64))
+
+        parts = []
+        prev_valid = (widx < state["prev_count"]) & send
+        prev_rows = {k: state["prev"][k][widx.astype(jnp.int32)] for k in state["prev"]}
+        prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+        parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid, widx))
+        reset_rows = _zero_rows(cols, 1)
+        reset_rows[TS_KEY] = jnp.broadcast_to(now, (1,))
+        parts.append((reset_rows, jnp.full((1,), RESET, jnp.int8),
+                      jnp.broadcast_to(send & (state["prev_count"] > 0), (1,)),
+                      jnp.full((1,), Wc, jnp.int64)))
+        parts.append((cur_rows, jnp.full((Wc,), CURRENT, jnp.int8), in_window,
+                      Wc + 1 + widx))
+        out, _ = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
+
+        # the emitted snapshot becomes the next expiry batch (packed)
+        emit_rank = jnp.cumsum(in_window.astype(jnp.int64)) - 1
+        pslot = jnp.where(in_window, emit_rank.astype(jnp.int32), Wc)
+        new_prev = {}
+        for k in state["prev"]:
+            base = jnp.where(send, jnp.zeros_like(state["prev"][k]), state["prev"][k])
+            new_prev[k] = base.at[pslot].set(cur_rows[k], mode="drop")
+        new_state = {
+            "buf": buf,
+            "prev": new_prev,
+            "total": total,
+            "expired_upto": new_exp,
+            "prev_count": jnp.where(send, n_emit, state["prev_count"]),
+            "next_emit": next_emit,
+        }
+        out[NOTIFY_KEY] = next_emit
+        out[OVERFLOW_KEY] = ((total - new_exp) > Wc).astype(jnp.int32)
+        return new_state, out
+
+    def contents(self, state):
+        Wc = self.capacity
+        widx = jnp.arange(Wc, dtype=jnp.int64)
+        fifo_seq = state["expired_upto"] + widx
+        occ = fifo_seq < state["total"]
+        flat = (fifo_seq % Wc).astype(jnp.int32)
+        return {k: v[flat] for k, v in state["buf"].items()}, occ
+
+
 # ------------------------------------------------------------------- batch
 
 class BatchWindowStage(WindowStage):
@@ -984,7 +1086,12 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
         return ExternalTimeBatchWindowStage(
             ts_fn, int(_const_param(window, 1, "time")), col_specs, capacity,
             start_time=start_time)
-    if name in ("sort", "frequent", "lossyfrequent", "session"):
+    if name == "hopping":
+        return HoppingWindowStage(
+            int(_const_param(window, 0, "windowTime")),
+            int(_const_param(window, 1, "hopTime")), col_specs, capacity)
+    if name in ("sort", "frequent", "lossyfrequent", "session", "cron",
+                "expression", "expressionbatch"):
         from siddhi_tpu.ops.host_windows import create_host_window_stage
 
         return create_host_window_stage(window, input_def, resolver, app_context)
