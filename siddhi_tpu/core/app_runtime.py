@@ -68,6 +68,25 @@ class SiddhiAppRuntime:
             self.app_context.precision = v
         self.app_context.scheduler = Scheduler(self.app_context)
 
+        # @app:statistics (reference SiddhiStatisticsManager wiring)
+        stats_ann = siddhi_app.app_annotation("statistics")
+        if stats_ann is not None:
+            from siddhi_tpu.core.util.statistics import (
+                StatisticsManager,
+                parse_level,
+            )
+            from siddhi_tpu.core.aggregation.incremental import _parse_time_str
+
+            level = parse_level(stats_ann.element("level")
+                                or stats_ann.element())
+            reporter = stats_ann.element("reporter")
+            interval = stats_ann.element("interval")
+            self.app_context.statistics_manager = StatisticsManager(
+                level=level,
+                reporter=reporter,
+                interval_ms=_parse_time_str(interval) if interval else 60_000,
+            )
+
         # activate the manager's extension registry for query compilation
         # (custom functions/windows resolve through it — the role of
         # reference SiddhiExtensionLoader.java:58-98)
@@ -379,10 +398,30 @@ class SiddhiAppRuntime:
                     scheduler.schedule_periodic(
                         agg.purge_interval_ms,
                         lambda ts, a=agg: a.purge(ts))
+            if self.app_context.statistics_manager is not None:
+                self.app_context.statistics_manager.start_reporting(scheduler)
             for tr in self.trigger_runtimes:
                 tr.start()
 
+    def statistics(self) -> dict:
+        """Metrics snapshot (reference SiddhiAppRuntime.getStatistics)."""
+        sm = self.app_context.statistics_manager
+        return sm.report() if sm is not None else {"level": "off"}
+
+    def set_statistics_level(self, level: str):
+        """'off' | 'basic' | 'detail' (reference setStatisticsLevel)."""
+        from siddhi_tpu.core.util.statistics import StatisticsManager, parse_level
+
+        if self.app_context.statistics_manager is None:
+            self.app_context.statistics_manager = StatisticsManager()
+        self.app_context.statistics_manager.set_level(parse_level(level))
+
+    setStatisticsLevel = set_statistics_level
+
     def shutdown(self):
+        if self.app_context.statistics_manager is not None:
+            self.app_context.statistics_manager.stop_reporting(
+                self.app_context.scheduler)
         for sr in self.source_runtimes:
             sr.shutdown()
         for tr in self.trigger_runtimes:

@@ -319,6 +319,12 @@ class QueryRuntime(Receiver):
         """Run the jitted step, raise on overflow, emit outputs; returns the
         wanted timer wake time (or None). Shared tail of every query
         runtime's batch processing (single-stream, NFA, join)."""
+        sm = self.app_context.statistics_manager
+        t0 = None
+        if sm is not None and sm.level >= 2:
+            import time as _time
+
+            t0 = _time.perf_counter()
         now = np.int64(self.app_context.timestamp_generator.current_time())
         self._state, out = step(self._state, cols, now)
         out_host = {k: np.asarray(v) for k, v in out.items()}
@@ -328,6 +334,10 @@ class QueryRuntime(Receiver):
                 f"query '{self.name}': {overflow_msg} before creating the runtime"
             )
         notify = out_host.pop("__notify__", None)
+        if t0 is not None:
+            import time as _time
+
+            sm.latency_tracker(self.name).record((_time.perf_counter() - t0) * 1000.0)
         self._emit(HostBatch(out_host))
         if notify is not None and int(notify) >= 0:
             return int(notify)

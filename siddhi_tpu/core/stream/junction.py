@@ -78,6 +78,9 @@ class StreamJunction:
     def send_events(self, events: List[Event]):
         if not events:
             return
+        sm = self.app_context.statistics_manager
+        if sm is not None and sm.level >= 1:
+            sm.throughput_tracker(self.definition.id).add(len(events))
         if self._async and self._running:
             self._queue.put(events)
         else:
@@ -93,6 +96,9 @@ class StreamJunction:
         """Columnar publish (no Event objects). @Async junctions enqueue the
         batch behind any pending event chunks (producer ordering is kept);
         it is delivered as one unit — already a batch."""
+        sm = self.app_context.statistics_manager
+        if sm is not None and sm.level >= 1:
+            sm.throughput_tracker(self.definition.id).add(int(batch.size))
         if self._async and self._running:
             self._queue.put(batch)
         else:

@@ -1,0 +1,158 @@
+"""Statistics: throughput/latency/memory trackers with OFF/BASIC/DETAIL
+levels.
+
+Mirror of reference ``util/statistics/SiddhiStatisticsManager.java:35`` +
+``ThroughputTracker`` / ``LatencyTracker`` metrics hung off junctions and
+query runtimes (``StreamJunction.java:153-155``). Counters are plain host
+ints guarded by the GIL (incremented at batch granularity, not per event —
+the columnar pump makes per-batch the natural unit).
+
+Levels: OFF (no collection), BASIC (throughput per junction/query),
+DETAIL (adds per-query step latency). Enable with
+``@app:statistics('true')`` or ``@app:statistics(level='detail',
+reporter='console', interval='5 sec')``; snapshot programmatically with
+``SiddhiAppRuntime.statistics()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+OFF, BASIC, DETAIL = 0, 1, 2
+
+_LEVELS = {"off": OFF, "basic": BASIC, "detail": DETAIL,
+           "false": OFF, "true": BASIC}
+
+
+def parse_level(s: Optional[str]) -> int:
+    if s is None:
+        return BASIC
+    lv = _LEVELS.get(s.strip().lower())
+    if lv is None:
+        raise ValueError(f"unknown statistics level '{s}'")
+    return lv
+
+
+class ThroughputTracker:
+    """Event counts + rate since creation/reset."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.batches = 0
+        self._t0 = time.perf_counter()
+
+    def add(self, n: int):
+        self.count += n
+        self.batches += 1
+
+    def rate(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self.count / dt if dt > 0 else 0.0
+
+    def reset(self):
+        self.count = 0
+        self.batches = 0
+        self._t0 = time.perf_counter()
+
+
+class LatencyTracker:
+    """Per-batch processing latency aggregates (ms)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.n = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def record(self, ms: float):
+        self.n += 1
+        self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+    @property
+    def avg_ms(self) -> float:
+        return self.total_ms / self.n if self.n else 0.0
+
+    def reset(self):
+        self.n = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+
+class StatisticsManager:
+    """Per-app metric registry (reference SiddhiStatisticsManager)."""
+
+    def __init__(self, level: int = OFF, reporter: Optional[str] = None,
+                 interval_ms: int = 60_000):
+        self.level = level
+        self.reporter = reporter
+        self.interval_ms = interval_ms
+        self._lock = threading.RLock()
+        self.throughput: Dict[str, ThroughputTracker] = {}
+        self.latency: Dict[str, LatencyTracker] = {}
+        self._job = None
+
+    # ------------------------------------------------------------ trackers
+
+    def throughput_tracker(self, name: str) -> ThroughputTracker:
+        with self._lock:
+            t = self.throughput.get(name)
+            if t is None:
+                t = self.throughput[name] = ThroughputTracker(name)
+            return t
+
+    def latency_tracker(self, name: str) -> LatencyTracker:
+        with self._lock:
+            t = self.latency.get(name)
+            if t is None:
+                t = self.latency[name] = LatencyTracker(name)
+            return t
+
+    # ------------------------------------------------------------- control
+
+    def set_level(self, level: int):
+        self.level = level
+
+    def start_reporting(self, scheduler):
+        if self.reporter == "console" and scheduler is not None:
+            self._job = scheduler.schedule_periodic(
+                self.interval_ms, lambda ts: print(self.format_report()))
+
+    def stop_reporting(self, scheduler):
+        if self._job is not None and scheduler is not None:
+            scheduler.cancel(self._job)
+            self._job = None
+
+    # -------------------------------------------------------------- report
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "level": {OFF: "off", BASIC: "basic", DETAIL: "detail"}[self.level],
+                "throughput": {
+                    n: {"events": t.count, "batches": t.batches,
+                        "events_per_sec": round(t.rate(), 1)}
+                    for n, t in self.throughput.items()
+                },
+                "latency": {
+                    n: {"batches": t.n, "avg_ms": round(t.avg_ms, 3),
+                        "max_ms": round(t.max_ms, 3)}
+                    for n, t in self.latency.items()
+                },
+            }
+
+    def format_report(self) -> str:
+        import json
+
+        return json.dumps(self.report(), indent=1)
+
+    def reset(self):
+        with self._lock:
+            for t in self.throughput.values():
+                t.reset()
+            for t in self.latency.values():
+                t.reset()

@@ -1,0 +1,71 @@
+"""Statistics subsystem + EventPrinter tests (reference
+``statistics/*TestCase`` shapes: throughput per junction, latency per
+query, level switching)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.utils.event_printer import PrintingQueryCallback, print_events
+
+
+def test_basic_throughput_tracking():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:statistics('true')
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S[v > 0] select sym, v insert into Out;
+    """)
+
+    class C(StreamCallback):
+        def receive(self, events):
+            pass
+
+    rt.add_callback("Out", C())
+    h = rt.get_input_handler("S")
+    for i in range(5):
+        h.send(["a", i + 1])
+    stats = rt.statistics()
+    m.shutdown()
+    assert stats["level"] == "basic"
+    assert stats["throughput"]["S"]["events"] == 5
+    assert stats["throughput"]["Out"]["events"] == 5
+
+
+def test_detail_latency_tracking():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:statistics(level='detail')
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S select sym, v insert into Out;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    h.send(["b", 2])
+    stats = rt.statistics()
+    m.shutdown()
+    assert stats["level"] == "detail"
+    lat = stats["latency"]["q"]
+    assert lat["batches"] == 2 and lat["avg_ms"] > 0
+
+
+def test_level_switch_and_off_default():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        from S select sym insert into Out;
+    """)
+    assert rt.statistics() == {"level": "off"}
+    rt.set_statistics_level("basic")
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    stats = rt.statistics()
+    m.shutdown()
+    assert stats["throughput"]["S"]["events"] == 1
+
+
+def test_event_printer(capsys):
+    print_events(123, [1, 2], None)
+    cb = PrintingQueryCallback()
+    cb.receive(456, ["x"], None)
+    out = capsys.readouterr().out
+    assert "@timestamp = 123" in out and "@timestamp = 456" in out
