@@ -835,8 +835,17 @@ class Parser:
     def parse_on_demand_query(self) -> OnDemandQuery:
         q = OnDemandQuery()
         t = self.peek()
-        if t.is_kw("select", "delete", "update"):
-            pass  # fall through to actions below (insert-form has no `from`)
+        if t.is_kw("delete") or (t.is_kw("update") and not self.peek(1).is_kw("or")):
+            # `delete Table on <cond>` / `update Table set ... on <cond>`
+            # (reference StoreQuery mutation forms)
+            q.output_stream = self.parse_output_action()
+            q.type = ("delete" if isinstance(q.output_stream, DeleteStream)
+                      else "update")
+            return q
+        if t.is_kw("update"):  # `update or insert into Table set ... on ...`
+            q.output_stream = self.parse_output_action()
+            q.type = "update_or_insert"
+            return q
         if self.accept_kw("from"):
             store = InputStore(store_id=self.name())
             if self.accept_kw("as"):

@@ -104,8 +104,10 @@ class SiddhiAppRuntime:
         from siddhi_tpu.core.window import NamedWindowRuntime
 
         dictionary = self.app_context.string_dictionary
+        from siddhi_tpu.core.table.record_table import create_table
+
         self.tables: Dict[str, InMemoryTable] = {
-            tid: InMemoryTable(tdef, dictionary)
+            tid: create_table(tdef, dictionary, siddhi_context.extensions)
             for tid, tdef in siddhi_app.table_definitions.items()
         }
         self.named_windows: Dict[str, NamedWindowRuntime] = {}

@@ -67,10 +67,90 @@ def _aggregation_contents(agg, oq: OnDemandQuery, dictionary):
     return definition, {k: jnp.asarray(v) for k, v in cols.items()}, jnp.asarray(valid)
 
 
+def _run_mutation(oq: OnDemandQuery, app_runtime, dictionary) -> List[Event]:
+    """On-demand table mutations (reference ``OnDemandQueryParser`` +
+    StoreQuery INSERT/DELETE/UPDATE/UPDATE-OR-INSERT runtimes)."""
+    from siddhi_tpu.core.event import HostBatch
+    from siddhi_tpu.core.query.output_callbacks import _compile_assignments
+    from siddhi_tpu.ops.expressions import compile_expr
+    from siddhi_tpu.ops.types import dtype_of
+
+    out = oq.output_stream
+    target = getattr(out, "target_id", None)
+    table = app_runtime.tables.get(target or "")
+    if table is None:
+        raise CompileError(
+            f"on-demand {oq.type} target '{target}' is not a defined table")
+    tdef = table.definition
+    resolver = TableConditionResolver(tdef, None, dictionary)
+
+    if oq.type == "insert":
+        # `select <values> insert into Table` — positional mapping
+        sel = oq.selector.selection_list
+        if len(sel) != len(tdef.attributes):
+            raise CompileError(
+                f"insert into '{target}' needs {len(tdef.attributes)} values")
+        row = {TS_KEY: np.zeros(1, np.int64),
+               TYPE_KEY: np.zeros(1, np.int8),
+               VALID_KEY: np.ones(1, bool)}
+        ctx = {"xp": np, "current_time": 0}
+        for attr, oa in zip(tdef.attributes, sel):
+            fn, _t = compile_expr(oa.expression, resolver)
+            v, m = fn({VALID_KEY: row[VALID_KEY]}, ctx)
+            row[attr.name] = np.broadcast_to(
+                np.asarray(v, dtype_of(attr.type)), (1,))
+            row[attr.name + "?"] = np.broadcast_to(
+                np.asarray(m, bool) if m is not None else np.zeros(1, bool), (1,))
+        table.insert(HostBatch(row))
+        return []
+
+    if oq.type == "delete":
+        cond = compile_condition(out.on_delete, resolver) \
+            if out.on_delete is not None else None
+        table.delete(cond, None)
+        return []
+
+    cond = compile_condition(out.on_update, resolver) \
+        if out.on_update is not None else None
+    if out.update_set is None:
+        raise CompileError(f"on-demand {oq.type} needs a `set` clause")
+    assignments = _compile_assignments(table, None, out.update_set, resolver)
+    if oq.type == "update":
+        table.update(cond, assignments, None)
+        return []
+    if oq.type == "update_or_insert":
+        import jax.numpy as jnp
+
+        m = table.update(cond, assignments, None)
+        if not bool(np.asarray(jnp.any(m))):
+            # no row matched: insert one built from the set clause
+            ctx = {"xp": np, "current_time": 0}
+            row = {TS_KEY: np.zeros(1, np.int64),
+                   TYPE_KEY: np.zeros(1, np.int8),
+                   VALID_KEY: np.ones(1, bool)}
+            set_cols = {}
+            for col_name, fn, _t in assignments:
+                v, mk = fn({VALID_KEY: row[VALID_KEY]}, ctx)
+                set_cols[col_name] = v
+            for attr in tdef.attributes:
+                if attr.name in set_cols:
+                    row[attr.name] = np.broadcast_to(
+                        np.asarray(set_cols[attr.name], dtype_of(attr.type)), (1,))
+                    row[attr.name + "?"] = np.zeros(1, bool)
+                else:
+                    row[attr.name] = np.zeros(1, dtype_of(attr.type))
+                    row[attr.name + "?"] = np.ones(1, bool)   # null
+            table.insert(HostBatch(row))
+        return []
+    raise CompileError(f"unsupported on-demand query type '{oq.type}'")
+
+
 def run_on_demand_query(source: str, app_runtime) -> List[Event]:
     oq: OnDemandQuery = SiddhiCompiler.parse_on_demand_query(source)
-    store_id = oq.input_store.store_id
     dictionary = app_runtime.app_context.string_dictionary
+    if oq.type != "find" or oq.input_store is None:
+        return _run_mutation(oq, app_runtime, dictionary)
+    store_id = oq.input_store.store_id
 
     table = app_runtime.tables.get(store_id)
     window = app_runtime.named_windows.get(store_id)
@@ -85,12 +165,6 @@ def run_on_demand_query(source: str, app_runtime) -> List[Event]:
         definition, cols, valid = _aggregation_contents(agg, oq, dictionary)
     else:
         raise CompileError(f"'{store_id}' is not a defined table/window/aggregation")
-
-    if oq.type != "find" or not isinstance(oq.output_stream, (ReturnStream, type(None))):
-        raise CompileError(
-            "only `select ... return`-style (find) on-demand queries are "
-            "supported yet — stream-driven insert/delete/update cover mutation"
-        )
 
     C = valid.shape[0]
     match = valid

@@ -75,6 +75,7 @@ class InMemoryTable:
     def __init__(self, definition: TableDefinition, dictionary: StringDictionary,
                  capacity: int = 1024):
         from siddhi_tpu.ops.windows import window_col_specs
+        from siddhi_tpu.query_api.annotations import find_annotation
 
         self.definition = definition
         self.dictionary = dictionary
@@ -82,6 +83,39 @@ class InMemoryTable:
         self.capacity = capacity
         self.state = self._zero_state(capacity)
         self._lock = threading.RLock()
+        # @primaryKey: uniqueness + host hash probe (the dense-array analog
+        # of reference IndexEventHolder's primary-key map,
+        # table/holder/IndexEventHolder.java:60-80)
+        pk_ann = find_annotation(definition.annotations or [], "primaryKey")
+        self.primary_key: List[str] = []
+        if pk_ann is not None:
+            self.primary_key = [v for _k, v in pk_ann.elements if v]
+        self._pk_map: Dict[tuple, int] = {}
+        self._pk_dirty = False
+
+    # ------------------------------------------------------- primary key map
+
+    def _pk_of_host(self, host_cols: dict, i: int) -> tuple:
+        return tuple(host_cols[a][i].item() for a in self.primary_key)
+
+    def _rebuild_pk_map(self):
+        host = {a: np.asarray(self.state["cols"][a]) for a in self.primary_key}
+        valid = np.asarray(self.state["valid"])
+        self._pk_map = {
+            tuple(host[a][i].item() for a in self.primary_key): int(i)
+            for i in np.nonzero(valid)[0]
+        }
+        self._pk_dirty = False
+
+    def find_by_pk(self, key: tuple) -> Optional[int]:
+        """Slot of the row with this primary-key tuple (hash probe — no
+        scan). String components must be dictionary-encoded ints."""
+        if not self.primary_key:
+            return None
+        with self._lock:
+            if self._pk_dirty:
+                self._rebuild_pk_map()
+            return self._pk_map.get(tuple(key))
 
     def _zero_state(self, cap: int) -> dict:
         return {
@@ -120,8 +154,27 @@ class InMemoryTable:
     # ------------------------------------------------------------- actions
 
     def insert(self, batch: HostBatch):
-        """Insert the batch's valid rows into free slots (arrival order)."""
+        """Insert the batch's valid rows into free slots (arrival order).
+        With @primaryKey, rows duplicating an existing key are dropped
+        (reference IndexEventHolder rejects primary-key collisions)."""
         with self._lock:
+            n = batch.size
+            if n == 0:
+                return
+            if self.primary_key:
+                if self._pk_dirty:
+                    self._rebuild_pk_map()
+                host = {a: np.asarray(batch.cols[a]) for a in self.primary_key}
+                valid_h = np.asarray(batch.cols[VALID_KEY], bool).copy()
+                seen = set(self._pk_map)
+                for i in np.nonzero(valid_h)[0]:
+                    key = self._pk_of_host(host, int(i))
+                    if key in seen:
+                        valid_h[i] = False       # duplicate: drop
+                    else:
+                        seen.add(key)
+                batch.cols[VALID_KEY] = valid_h
+                self._pk_dirty = True
             n = batch.size
             if n == 0:
                 return
@@ -170,6 +223,7 @@ class InMemoryTable:
                 "cols": self.state["cols"],
                 "valid": self.state["valid"] & ~jnp.any(m, axis=0),
             }
+            self._pk_dirty = True
 
     def update(self, cond: Optional[Callable], assignments, batch: Optional[HostBatch]):
         """assignments: [(table col name, compiled expr over ev/table cols)].
@@ -204,6 +258,7 @@ class InMemoryTable:
                 new_cols[col_name + "?"] = jnp.where(
                     hit, mk, new_cols[col_name + "?"])
             self.state = {"cols": new_cols, "valid": self.state["valid"]}
+            self._pk_dirty = True
             return m
 
     def update_or_insert(self, cond, assignments, batch: HostBatch,

@@ -1,0 +1,344 @@
+"""External-store table SPI + caching front.
+
+Mirror of reference ``table/record/AbstractRecordTable.java`` (the SPI the
+RDBMS/Mongo/etc. table extensions implement) and ``table/CacheTable*.java``
+(FIFO/LRU/LFU caches fronting a slow store). TPU-first inversion: the
+engine pulls the store's rows into a columnar probe surface and evaluates
+compiled conditions as masked broadcast compares — the external store only
+needs add/read/delete/update, not a condition language.
+
+Register implementations with ``SiddhiManager.set_extension('store:<type>',
+cls)`` and attach with ``@store(type='<type>', ...)`` on a table
+definition; add ``@cache(size='N', cache.policy='FIFO|LRU|LFU')`` inside
+@store for a bounded read cache.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.core.event import HostBatch
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.query_api.definitions import AttrType, TableDefinition
+
+
+class RecordTable:
+    """External store SPI (reference AbstractRecordTable). Rows are plain
+    lists in attribute order; string attributes arrive as Python strings."""
+
+    def init(self, definition: TableDefinition, options: Dict[str, str]) -> None:
+        self.definition = definition
+        self.options = options
+
+    def connect(self) -> None:
+        pass
+
+    def add(self, records: List[list]) -> None:
+        raise NotImplementedError
+
+    def read(self) -> List[list]:
+        """Full scan: the engine filters/joins columnar-side."""
+        raise NotImplementedError
+
+    def delete(self, indices: List[int]) -> None:
+        """Delete rows by their position in the last read()."""
+        raise NotImplementedError
+
+    def update(self, indices: List[int], rows: List[list]) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+
+class InMemoryRecordTable(RecordTable):
+    """Reference implementation of the SPI (and the test double)."""
+
+    def init(self, definition, options):
+        super().init(definition, options)
+        self.rows: List[list] = []
+
+    def add(self, records):
+        self.rows.extend([list(r) for r in records])
+
+    def read(self):
+        return [list(r) for r in self.rows]
+
+    def delete(self, indices):
+        for i in sorted(indices, reverse=True):
+            del self.rows[i]
+
+    def update(self, indices, rows):
+        for i, r in zip(indices, rows):
+            self.rows[i] = list(r)
+
+
+class RowCache:
+    """Bounded row cache with FIFO / LRU / LFU eviction (reference
+    CacheTableFIFO / CacheTableLRU / CacheTableLFU)."""
+
+    def __init__(self, max_size: int, policy: str = "FIFO"):
+        policy = policy.upper()
+        if policy not in ("FIFO", "LRU", "LFU"):
+            raise ValueError(f"unknown cache policy '{policy}'")
+        self.max_size = max_size
+        self.policy = policy
+        self._rows: Dict[object, list] = {}
+        self._order: List[object] = []        # FIFO/LRU order
+        self._freq: Dict[object, int] = {}    # LFU
+
+    def __contains__(self, key):
+        return key in self._rows
+
+    def __len__(self):
+        return len(self._rows)
+
+    def get(self, key) -> Optional[list]:
+        row = self._rows.get(key)
+        if row is None:
+            return None
+        if self.policy == "LRU":
+            self._order.remove(key)
+            self._order.append(key)
+        elif self.policy == "LFU":
+            self._freq[key] = self._freq.get(key, 0) + 1
+        return row
+
+    def put(self, key, row: list):
+        if key in self._rows:
+            self._rows[key] = row
+            return
+        while len(self._rows) >= self.max_size:
+            self._evict_one()
+        self._rows[key] = row
+        self._order.append(key)
+        self._freq[key] = 0
+
+    def _evict_one(self):
+        if self.policy in ("FIFO", "LRU"):
+            victim = self._order.pop(0)
+        else:  # LFU
+            victim = min(self._order, key=lambda k: self._freq.get(k, 0))
+            self._order.remove(victim)
+        self._rows.pop(victim, None)
+        self._freq.pop(victim, None)
+
+    def drop(self, key):
+        if key in self._rows:
+            self._rows.pop(key)
+            self._order.remove(key)
+            self._freq.pop(key, None)
+
+    def keys(self):
+        return list(self._order)
+
+
+class RecordTableAdapter:
+    """Engine-facing adapter: same duck-typed surface as InMemoryTable
+    (contents/insert/delete/update/all_events) over a RecordTable SPI
+    implementation, with an optional primary-key row cache."""
+
+    def __init__(self, record_table: RecordTable, definition: TableDefinition,
+                 dictionary, cache: Optional[RowCache] = None,
+                 primary_key: Optional[List[str]] = None):
+        self.record = record_table
+        self.definition = definition
+        self.dictionary = dictionary
+        self.cache = cache
+        self.primary_key = primary_key or []
+        self._lock = threading.RLock()
+        from siddhi_tpu.ops.windows import window_col_specs
+
+        self.col_specs = window_col_specs(definition)
+
+    # ------------------------------------------------------------ row codec
+
+    def _encode_rows(self, rows: List[list]) -> Tuple[dict, np.ndarray]:
+        from siddhi_tpu.ops.types import dtype_of
+
+        n = len(rows)
+        cap = max(n, 1)
+        cols = {TS_KEY: np.zeros(cap, np.int64),
+                TYPE_KEY: np.zeros(cap, np.int8),
+                VALID_KEY: np.zeros(cap, bool)}
+        cols[VALID_KEY][:n] = True
+        for pos, attr in enumerate(self.definition.attributes):
+            arr = np.zeros(cap, dtype_of(attr.type))
+            mask = np.zeros(cap, bool)
+            for i, r in enumerate(rows):
+                v = r[pos]
+                if v is None:
+                    mask[i] = True
+                elif attr.type == AttrType.STRING:
+                    arr[i] = self.dictionary.encode(v)
+                else:
+                    arr[i] = v
+            cols[attr.name] = arr
+            cols[attr.name + "?"] = mask
+        return cols, cols[VALID_KEY]
+
+    def _decode_batch(self, batch: HostBatch) -> List[list]:
+        events = batch.to_events(
+            [(a.name, a.type) for a in self.definition.attributes],
+            self.dictionary)
+        return [list(e.data) for e in events]
+
+    def _pk_of(self, row: list):
+        idx = [i for i, a in enumerate(self.definition.attributes)
+               if a.name in self.primary_key]
+        return tuple(row[i] for i in idx)
+
+    # -------------------------------------------------------------- surface
+
+    def contents(self):
+        with self._lock:
+            cols, valid = self._encode_rows(self.record.read())
+            return cols, valid
+
+    @property
+    def count(self) -> int:
+        return len(self.record.read())
+
+    def insert(self, batch: HostBatch):
+        with self._lock:
+            rows = self._decode_batch(batch)
+            self.record.add(rows)
+            if self.cache is not None and self.primary_key:
+                for r in rows:
+                    self.cache.put(self._pk_of(r), r)
+
+    def find_by_pk(self, key: tuple) -> Optional[list]:
+        """Cache-first primary-key lookup (reference CacheTable read path:
+        hit serves from memory, miss loads from the store)."""
+        with self._lock:
+            if self.cache is not None:
+                row = self.cache.get(tuple(key))
+                if row is not None:
+                    return row
+            for r in self.record.read():
+                if self._pk_of(r) == tuple(key):
+                    if self.cache is not None:
+                        self.cache.put(tuple(key), r)
+                    return r
+            return None
+
+    def _matching_indices(self, cond, batch: Optional[HostBatch]):
+        import jax.numpy as jnp
+
+        cols, valid = self.contents()
+        ev = {}
+        B = 1
+        from siddhi_tpu.core.table.in_memory_table import EV_PREFIX, TBL_PREFIX
+
+        if batch is not None:
+            B = batch.cols[VALID_KEY].shape[0]
+            for k, v in batch.cols.items():
+                ev[EV_PREFIX + k] = jnp.asarray(v)[:, None]
+        for k, v in cols.items():
+            ev[TBL_PREFIX + k] = jnp.asarray(v)[None, :]
+        ev[TS_KEY] = ev.get(EV_PREFIX + TS_KEY,
+                            jnp.zeros((B, 1), jnp.int64))
+        C = valid.shape[0]
+        m = cond(ev, {"xp": jnp}) if cond is not None else jnp.ones((B, C), bool)
+        m = jnp.broadcast_to(m, (B, C)) & jnp.asarray(valid)[None, :]
+        if batch is not None:
+            m = m & jnp.asarray(batch.cols[VALID_KEY], bool)[:, None]
+        return np.nonzero(np.asarray(jnp.any(m, axis=0)))[0].tolist()
+
+    def delete(self, cond, batch: Optional[HostBatch]):
+        with self._lock:
+            idx = self._matching_indices(cond, batch)
+            if self.cache is not None:
+                rows = self.record.read()
+                for i in idx:
+                    self.cache.drop(self._pk_of(rows[i]))
+            self.record.delete(idx)
+
+    def update(self, cond, assignments, batch: Optional[HostBatch]):
+        """Row-at-a-time SPI update: matching rows re-read, assignment
+        expressions evaluated per row, written back through the SPI."""
+        import jax.numpy as jnp
+
+        from siddhi_tpu.core.table.in_memory_table import EV_PREFIX, TBL_PREFIX
+
+        with self._lock:
+            idx = self._matching_indices(cond, batch)
+            if not idx:
+                return jnp.zeros((1, 1), bool)
+            rows = self.record.read()
+            cols, _valid = self._encode_rows(rows)
+            ctx = {"xp": np}
+            ev = {TBL_PREFIX + k: v for k, v in cols.items()}
+            if batch is not None:
+                # last event wins (chunk order) — evaluate with that event
+                last = int(np.nonzero(np.asarray(batch.cols[VALID_KEY]))[0][-1])
+                for k, v in batch.cols.items():
+                    ev[EV_PREFIX + k] = np.asarray(v)[last: last + 1]
+            ev[TS_KEY] = ev.get(EV_PREFIX + TS_KEY, np.zeros(1, np.int64))
+            name_pos = {a.name: i for i, a in enumerate(self.definition.attributes)}
+            new_rows = []
+            for i in idx:
+                row = list(rows[i])
+                for col_name, fn, _t in assignments:
+                    v, mk = fn(ev, ctx)
+                    val = np.broadcast_to(np.asarray(v), cols[TS_KEY].shape)[i] \
+                        if np.asarray(v).ndim else np.asarray(v)
+                    attr = self.definition.attributes[name_pos[col_name]]
+                    if attr.type == AttrType.STRING:
+                        val = self.dictionary.decode(int(val))
+                    elif attr.type in (AttrType.INT, AttrType.LONG):
+                        val = int(val)
+                    else:
+                        val = val.item() if hasattr(val, "item") else val
+                    row[name_pos[col_name]] = val
+                new_rows.append(row)
+                if self.cache is not None:
+                    self.cache.drop(self._pk_of(rows[i]))
+            self.record.update(idx, new_rows)
+            return jnp.ones((1, 1), bool)
+
+    def all_events(self):
+        cols, valid = self.contents()
+        cols[VALID_KEY] = valid
+        cols[TYPE_KEY] = np.zeros(valid.shape[0], np.int8)
+        return HostBatch(cols).to_events(
+            [(a.name, a.type) for a in self.definition.attributes],
+            self.dictionary)
+
+
+def create_table(definition: TableDefinition, dictionary, extensions: Dict[str, type]):
+    """Table factory: @store(type=...) resolves a RecordTable extension
+    (with optional @cache); otherwise the dense in-memory table."""
+    from siddhi_tpu.core.table.in_memory_table import InMemoryTable
+    from siddhi_tpu.ops.expressions import resolve_in
+    from siddhi_tpu.query_api.annotations import find_annotation
+
+    store_ann = find_annotation(definition.annotations or [], "store")
+    if store_ann is None:
+        return InMemoryTable(definition, dictionary)
+    opts = {k: v for k, v in store_ann.elements if k is not None}
+    type_name = (opts.pop("type", None) or "").lower()
+    cls = resolve_in(extensions, "store", type_name)
+    if cls is None and type_name in ("inmemory", "memory"):
+        cls = InMemoryRecordTable
+    if cls is None:
+        raise ValueError(f"unknown @store type '{type_name}'")
+    record = cls()
+    record.init(definition, opts)
+    record.connect()
+
+    pk_ann = find_annotation(definition.annotations or [], "primaryKey")
+    primary_key = [v for _k, v in pk_ann.elements if v] if pk_ann else []
+
+    cache = None
+    cache_ann = store_ann.annotation("cache")
+    if cache_ann is not None:
+        copts = {k: v for k, v in cache_ann.elements if k is not None}
+        size = int(copts.get("size", copts.get("max.size", 128)))
+        policy = copts.get("cache.policy", copts.get("policy", "FIFO"))
+        cache = RowCache(size, policy)
+    return RecordTableAdapter(record, definition, dictionary, cache=cache,
+                              primary_key=primary_key)

@@ -1,0 +1,190 @@
+"""Tables round 2: on-demand mutations, @primaryKey enforcement + hash
+probe, RecordTable SPI (@store), FIFO/LRU/LFU cache — mirroring reference
+``table/*TestCase`` + ``StoreQueryTableTestCase`` shapes.
+"""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.table.record_table import InMemoryRecordTable, RecordTable, RowCache
+
+
+APP = """
+define stream StockStream (symbol string, price double, volume long);
+define table StockTable (symbol string, price double, volume long);
+from StockStream insert into StockTable;
+"""
+
+
+def test_on_demand_insert_and_find():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.query("select 'WSO2', 55.5, 100L insert into StockTable;")
+    got = rt.query("from StockTable select symbol, price return;")
+    m.shutdown()
+    assert [tuple(e.data) for e in got] == [("WSO2", 55.5)]
+
+
+def test_on_demand_delete():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    h = rt.get_input_handler("StockStream")
+    h.send(["A", 1.0, 1])
+    h.send(["B", 2.0, 2])
+    rt.query("delete StockTable on StockTable.symbol == 'A';")
+    got = rt.query("from StockTable select symbol return;")
+    m.shutdown()
+    assert [tuple(e.data) for e in got] == [("B",)]
+
+
+def test_on_demand_update():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    h = rt.get_input_handler("StockStream")
+    h.send(["A", 1.0, 1])
+    rt.query("update StockTable set StockTable.price = 9.5 "
+             "on StockTable.symbol == 'A';")
+    got = rt.query("from StockTable select symbol, price return;")
+    m.shutdown()
+    assert [tuple(e.data) for e in got] == [("A", 9.5)]
+
+
+def test_on_demand_update_or_insert():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.query("update or insert into StockTable set StockTable.price = 7.0 "
+             "on StockTable.symbol == 'Z';")   # no match: inserts
+    got = rt.query("from StockTable select price return;")
+    m.shutdown()
+    assert [tuple(e.data) for e in got] == [(7.0,)]
+
+
+def test_primary_key_rejects_duplicates_and_probes():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double);
+        @primaryKey('symbol')
+        define table T (symbol string, price double);
+        from S insert into T;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    h.send(["A", 2.0])     # duplicate primary key: dropped
+    h.send(["B", 3.0])
+    got = rt.query("from T select symbol, price return;")
+    table = rt.tables["T"]
+    sid = rt.app_context.string_dictionary.encode("A")
+    slot = table.find_by_pk((sid,))
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in got) == [("A", 1.0), ("B", 3.0)]
+    assert slot is not None
+
+
+def test_record_table_store_roundtrip():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double);
+        @store(type='inMemory')
+        define table T (symbol string, price double);
+        from S insert into T;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    h.send(["B", 2.0])
+    got1 = rt.query("from T select symbol, price return;")
+    rt.query("delete T on T.symbol == 'A';")
+    got2 = rt.query("from T select symbol return;")
+    rt.query("update T set T.price = 5.0 on T.symbol == 'B';")
+    got3 = rt.query("from T select symbol, price return;")
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in got1) == [("A", 1.0), ("B", 2.0)]
+    assert [tuple(e.data) for e in got2] == [("B",)]
+    assert [tuple(e.data) for e in got3] == [("B", 5.0)]
+
+
+def test_custom_record_table_extension():
+    calls = []
+
+    class TracingStore(InMemoryRecordTable):
+        def add(self, records):
+            calls.append(("add", len(records)))
+            super().add(records)
+
+        def read(self):
+            calls.append(("read", None))
+            return super().read()
+
+    m = SiddhiManager()
+    m.set_extension("store:traced", TracingStore)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double);
+        @store(type='traced')
+        define table T (symbol string, price double);
+        from S insert into T;
+    """)
+    rt.get_input_handler("S").send(["A", 1.0])
+    got = rt.query("from T select symbol return;")
+    m.shutdown()
+    assert [tuple(e.data) for e in got] == [("A",)]
+    assert ("add", 1) in calls and ("read", None) in calls
+
+
+def test_table_store_join_side():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, qty int);
+        define stream Q (symbol string);
+        @store(type='inMemory')
+        define table T (symbol string, qty int);
+        from S insert into T;
+        from Q join T on Q.symbol == T.symbol
+        select T.symbol as symbol, T.qty as qty
+        insert into Out;
+    """)
+    from siddhi_tpu import StreamCallback
+
+    seen = []
+
+    class C(StreamCallback):
+        def receive(self, events):
+            seen.extend(tuple(e.data) for e in events)
+
+    rt.add_callback("Out", C())
+    rt.get_input_handler("S").send(["A", 5])
+    rt.get_input_handler("Q").send(["A"])
+    m.shutdown()
+    assert seen == [("A", 5)]
+
+
+def test_row_cache_policies():
+    fifo = RowCache(2, "FIFO")
+    fifo.put(1, ["a"]); fifo.put(2, ["b"]); fifo.get(1); fifo.put(3, ["c"])
+    assert 1 not in fifo and 2 in fifo and 3 in fifo
+
+    lru = RowCache(2, "LRU")
+    lru.put(1, ["a"]); lru.put(2, ["b"]); lru.get(1); lru.put(3, ["c"])
+    assert 2 not in lru and 1 in lru and 3 in lru
+
+    lfu = RowCache(2, "LFU")
+    lfu.put(1, ["a"]); lfu.put(2, ["b"])
+    lfu.get(1); lfu.get(1); lfu.get(2)
+    lfu.put(3, ["c"])          # evicts key 2 (freq 1) not key 1 (freq 2)
+    assert 2 not in lfu and 1 in lfu and 3 in lfu
+
+
+def test_cached_store_pk_lookup():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double);
+        @store(type='inMemory', @cache(size='2', cache.policy='LRU'))
+        @primaryKey('symbol')
+        define table T (symbol string, price double);
+        from S insert into T;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    h.send(["B", 2.0])
+    h.send(["C", 3.0])
+    t = rt.tables["T"]
+    assert len(t.cache) == 2            # bounded by the cache size
+    row = t.find_by_pk(("A",))          # miss -> loads from the store
+    m.shutdown()
+    assert row == ["A", 1.0]
