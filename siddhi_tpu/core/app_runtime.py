@@ -31,6 +31,37 @@ from siddhi_tpu.query_api.execution import InsertIntoStream, Partition, Query
 from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
 
+def _compile_script_function(fdef):
+    """``define function f[python] return <type> { <expression> }`` — the
+    body is a Python expression over ``arg0..argN`` (aka ``data0..``) with
+    ``xp`` (jax.numpy on device) in scope, vectorized over columns
+    (reference ``ScriptFunctionExecutor`` evaluates per event; here one
+    call per batch). String arguments arrive dictionary-encoded."""
+    from siddhi_tpu.ops.expressions import CompileError
+
+    if fdef.language.lower() not in ("python", "py"):
+        raise CompileError(
+            f"function '{fdef.id}': script language '{fdef.language}' is not "
+            f"supported (use [python])")
+    import numpy as _np
+
+    code = compile(fdef.body.strip(), f"<function {fdef.id}>", "eval")
+    rtype = fdef.return_type
+
+    class _Script:
+        return_type = rtype
+
+        @staticmethod
+        def apply(xp, *args):
+            ns = {"xp": xp, "np": _np}
+            for i, a in enumerate(args):
+                ns[f"arg{i}"] = a
+                ns[f"data{i}"] = a
+            return eval(code, ns)  # noqa: S307 — user-defined app function
+
+    return _Script
+
+
 def _default_app_name(siddhi_app: SiddhiApp) -> str:
     """Deterministic fallback name so snapshots of the same (unnamed) app
     text restore across process restarts."""
@@ -68,6 +99,16 @@ class SiddhiAppRuntime:
             self.app_context.precision = v
         self.app_context.scheduler = Scheduler(self.app_context)
 
+        # deployment config: ConfigManager system keys override the
+        # capacity knobs (reference ConfigManager consulted at parse time)
+        cm = siddhi_context.config_manager
+        if cm is not None:
+            for knob in ("window_capacity", "partition_window_capacity",
+                         "nfa_slots", "initial_key_capacity"):
+                v = cm.get_property(f"siddhi_tpu.{knob}")
+                if v is not None:
+                    setattr(self.app_context, knob, int(v))
+
         # @app:statistics (reference SiddhiStatisticsManager wiring)
         stats_ann = siddhi_app.app_annotation("statistics")
         if stats_ann is not None:
@@ -89,10 +130,16 @@ class SiddhiAppRuntime:
 
         # activate the manager's extension registry for query compilation
         # (custom functions/windows resolve through it — the role of
-        # reference SiddhiExtensionLoader.java:58-98)
+        # reference SiddhiExtensionLoader.java:58-98), merged with this
+        # app's `define function` scripts (ScriptFunctionExecutor role)
         from siddhi_tpu.ops import expressions as _expr_mod
 
-        _expr_mod.set_active_extensions(siddhi_context.extensions)
+        self._script_functions = {
+            f"function:{fid}": _compile_script_function(fdef)
+            for fid, fdef in siddhi_app.function_definitions.items()
+        }
+        self._extensions = {**siddhi_context.extensions, **self._script_functions}
+        _expr_mod.set_active_extensions(self._extensions)
 
         for sid, sdef in self.stream_definitions.items():
             self._create_junction(sdef)
@@ -312,7 +359,18 @@ class SiddhiAppRuntime:
             raise SiddhiAppValidationException(
                 f"unsupported output action {type(out).__name__}")
 
-        runtime.rate_limiter = create_rate_limiter(query.output_rate, runtime.send_to_callbacks)
+        group_key_fn = None
+        if query.selector.group_by_list and query.output_rate is not None:
+            # grouped queries get per-group first/last limiters (reference
+            # OutputParser picks the GroupBy limiter classes)
+            gb_names = {v.attribute_name for v in query.selector.group_by_list}
+            positions = tuple(i for i, (n, _t) in enumerate(runtime.output_attrs)
+                              if n in gb_names)
+            if positions:
+                group_key_fn = lambda ev, _p=positions: tuple(  # noqa: E731
+                    ev.data[i] for i in _p)
+        runtime.rate_limiter = create_rate_limiter(
+            query.output_rate, runtime.send_to_callbacks, group_key_fn)
         runtime.scheduler = self.app_context.scheduler
 
         from siddhi_tpu.query_api.execution import JoinInputStream, StateInputStream
@@ -404,6 +462,14 @@ class SiddhiAppRuntime:
                 self.app_context.statistics_manager.start_reporting(scheduler)
             for tr in self.trigger_runtimes:
                 tr.start()
+
+    def debug(self):
+        """Attach a SiddhiDebugger (reference SiddhiAppRuntime.debug)."""
+        from siddhi_tpu.core.debugger import SiddhiDebugger
+
+        if getattr(self, "_debugger", None) is None:
+            self._debugger = SiddhiDebugger(self)
+        return self._debugger
 
     def statistics(self) -> dict:
         """Metrics snapshot (reference SiddhiAppRuntime.getStatistics)."""
@@ -497,9 +563,9 @@ class SiddhiAppRuntime:
         from siddhi_tpu.core.query.on_demand import run_on_demand_query
         from siddhi_tpu.ops import expressions as _expr_mod
 
-        # lazy compiles resolve against THIS manager's extension registry
-        _expr_mod.set_active_extensions(
-            self.app_context.siddhi_context.extensions)
+        # lazy compiles resolve against THIS app's registry (manager
+        # extensions + script functions)
+        _expr_mod.set_active_extensions(self._extensions)
 
         with self._barrier:
             return run_on_demand_query(on_demand_query, self)

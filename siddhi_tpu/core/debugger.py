@@ -1,0 +1,111 @@
+"""SiddhiDebugger: query IN/OUT breakpoints with an event callback.
+
+Mirror of reference ``core/debugger/SiddhiDebugger.java`` +
+``SiddhiDebuggerCallback``: breakpoints attach at a query's input (before
+the step processes a chunk) or output (before callbacks fire). The
+callback runs synchronously on the pump thread — the batch does not
+proceed until it returns (the columnar analog of the reference's
+acquire/next/play lock-stepping; there is no separate suspended-thread
+state to resume because the pump is already synchronous).
+
+Usage::
+
+    debugger = runtime.debug()
+    debugger.set_debugger_callback(cb)          # cb(events, qname, terminal, dbg)
+    debugger.acquire_break_point('query1', SiddhiDebugger.QueryTerminal.IN)
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class SiddhiDebugger:
+    class QueryTerminal(enum.Enum):
+        IN = "IN"
+        OUT = "OUT"
+
+    def __init__(self, app_runtime):
+        self.app_runtime = app_runtime
+        self._callback: Optional[Callable] = None
+        self._wrapped: Dict[Tuple[str, "SiddhiDebugger.QueryTerminal"], tuple] = {}
+
+    def set_debugger_callback(self, callback: Callable):
+        """callback(events, query_name, terminal, debugger)."""
+        self._callback = callback
+
+    # ------------------------------------------------------------ breakpoints
+
+    def acquire_break_point(self, query_name: str, terminal: "SiddhiDebugger.QueryTerminal"):
+        rt = self.app_runtime.query_runtimes.get(query_name)
+        if rt is None:
+            raise KeyError(f"unknown query '{query_name}'")
+        key = (query_name, terminal)
+        if key in self._wrapped:
+            return
+        dbg = self
+
+        if terminal == SiddhiDebugger.QueryTerminal.IN:
+            targets = [n for n in ("receive_batch", "process_stream_batch",
+                                   "process_side_batch", "process_batch")
+                       if hasattr(rt, n)]
+            originals = []
+            for name in targets:
+                orig = getattr(rt, name)
+
+                def wrapper(*args, _orig=orig, _rt=rt, **kw):
+                    from siddhi_tpu.core.event import HostBatch
+
+                    batch = next((a for a in args if isinstance(a, HostBatch)), None)
+                    dbg._fire(_decode(batch, _rt), query_name, terminal)
+                    return _orig(*args, **kw)
+
+                setattr(rt, name, wrapper)
+                originals.append((name, orig))
+            self._wrapped[key] = tuple(originals)
+        else:
+            orig = rt._emit
+
+            def out_wrapper(out_batch, _orig=orig, _rt=rt):
+                dbg._fire(_decode(out_batch, _rt, output=True), query_name, terminal)
+                return _orig(out_batch)
+
+            rt._emit = out_wrapper
+            self._wrapped[key] = (("_emit", orig),)
+
+    def release_break_point(self, query_name: str, terminal: "SiddhiDebugger.QueryTerminal"):
+        key = (query_name, terminal)
+        originals = self._wrapped.pop(key, ())
+        rt = self.app_runtime.query_runtimes.get(query_name)
+        if rt is None:
+            return
+        for name, orig in originals:
+            setattr(rt, name, orig)
+
+    def release_all_break_points(self):
+        for qname, terminal in list(self._wrapped):
+            self.release_break_point(qname, terminal)
+
+    # ---------------------------------------------------------------- fire
+
+    def _fire(self, events: List, query_name: str, terminal):
+        if self._callback is not None and events:
+            self._callback(events, f"{query_name}:{terminal.value}", terminal, self)
+
+
+def _decode(batch, rt, output: bool = False) -> List:
+    from siddhi_tpu.core.event import HostBatch
+
+    if not isinstance(batch, HostBatch):
+        return []
+    try:
+        if output:
+            return batch.to_events(rt.output_attrs, rt.dictionary)
+        defn = rt.input_definition
+        if defn is None:    # NFA/join inputs: per-stream definitions differ
+            return []
+        return batch.to_events(
+            [(a.name, a.type) for a in defn.attributes], rt.dictionary)
+    except Exception:
+        return []

@@ -106,6 +106,9 @@ class SelectorPlan:
     # a fused upstream stage (ops/fused_agg.py) already computed the
     # aggregate columns; skip the scans and just project/filter
     precomputed: bool = False
+    # output columns whose value is a host-generated UUID per row (the
+    # device step emits placeholders; QueryRuntime._emit fills them)
+    uuid_cols: List[str] = field(default_factory=list)
 
     @property
     def contains_aggregator(self) -> bool:
@@ -211,13 +214,19 @@ def plan_selector(
         for oa in selector.selection_list:
             selections.append((oa.name, oa.expression))
 
+    from siddhi_tpu.ops.expressions import take_uuid_marker
+
+    take_uuid_marker()  # clear any stale flag
     projections = []
     output_attrs: List[Tuple[str, AttrType]] = []
+    uuid_cols: List[str] = []
     for name, expr in selections:
         rewritten = _rewrite_aggregators(expr, specs, resolver)
         # synthetic agg columns resolve through the same resolver
         _augment_synthetic(resolver, specs)
         fn, t = compile_expr(rewritten, resolver)
+        if take_uuid_marker():
+            uuid_cols.append(name)  # host fills fresh UUIDs post-step
         projections.append((name, fn, t))
         output_attrs.append((name, t))
 
@@ -249,6 +258,7 @@ def plan_selector(
         order_by=order_by,
         limit=selector.limit,
         offset=selector.offset,
+        uuid_cols=uuid_cols,
     )
 
 

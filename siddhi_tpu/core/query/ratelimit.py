@@ -117,12 +117,102 @@ class TimeRateLimiter(OutputRateLimiter):
             self._pending.extend(events)
 
 
-def create_rate_limiter(rate: Optional[OutputRate], send) -> OutputRateLimiter:
+class GroupEventRateLimiter(OutputRateLimiter):
+    """first/last every N events PER GROUP (reference
+    ``ratelimit/event/{First,Last}GroupByPerEventOutputRateLimiter`` —
+    chosen automatically when the query has a group-by, like
+    ``OutputParser.java`` does)."""
+
+    def __init__(self, send, value: int, kind: str, key_fn):
+        super().__init__(send)
+        self.value = value
+        self.kind = kind
+        self.key_fn = key_fn
+        self._counter = 0
+        self._first_seen: set = set()
+        self._last: dict = {}
+
+    def process(self, events: List[Event]):
+        out: List[Event] = []
+        for ev in events:
+            self._counter += 1
+            k = self.key_fn(ev)
+            if self.kind == "first":
+                if k not in self._first_seen:
+                    self._first_seen.add(k)
+                    out.append(ev)
+            else:  # last
+                self._last[k] = ev
+            if self._counter == self.value:
+                self._counter = 0
+                self._first_seen.clear()
+                if self.kind == "last":
+                    out.extend(self._last.values())
+                    self._last.clear()
+        if out:
+            self._send(out)
+
+
+class GroupTimeRateLimiter(OutputRateLimiter):
+    """first/last every T ms per group (reference
+    ``ratelimit/time/{First,Last}GroupByPerTimeOutputRateLimiter``)."""
+
+    def __init__(self, send, value: int, kind: str, key_fn):
+        super().__init__(send)
+        self.value = value
+        self.kind = kind
+        self.key_fn = key_fn
+        self._first_seen: set = set()
+        self._last: dict = {}
+        self._scheduler = None
+        self._job = None
+
+    def start(self, scheduler=None):
+        self._scheduler = scheduler
+        if scheduler is not None:
+            self._job = scheduler.schedule_periodic(self.value, self._tick)
+
+    def stop(self):
+        if self._scheduler is not None and self._job is not None:
+            self._scheduler.cancel(self._job)
+
+    def _tick(self, _ts: int):
+        if self.kind == "first":
+            self._first_seen.clear()
+            return
+        if self._last:
+            out = list(self._last.values())
+            self._last.clear()
+            self._send(out)
+
+    def process(self, events: List[Event]):
+        out: List[Event] = []
+        for ev in events:
+            k = self.key_fn(ev)
+            if self.kind == "first":
+                if k not in self._first_seen:
+                    self._first_seen.add(k)
+                    out.append(ev)
+            else:
+                self._last[k] = ev
+        if out:
+            self._send(out)
+
+
+def create_rate_limiter(rate: Optional[OutputRate], send,
+                        group_key_fn=None) -> OutputRateLimiter:
+    """``group_key_fn`` (group tuple from an output Event) switches
+    first/last limiters to their per-group variants, exactly as the
+    reference OutputParser picks GroupBy classes for grouped queries."""
     if rate is None:
         return PassThroughRateLimiter(send)
     if isinstance(rate, EventOutputRate):
+        if group_key_fn is not None and rate.type in ("first", "last"):
+            return GroupEventRateLimiter(send, rate.value, rate.type, group_key_fn)
         return EventRateLimiter(send, rate.value, rate.type)
     if isinstance(rate, TimeOutputRate):
+        if group_key_fn is not None and rate.type in ("first", "last"):
+            return GroupTimeRateLimiter(send, rate.value, rate.type, group_key_fn)
         return TimeRateLimiter(send, rate.value, rate.type)
     if isinstance(rate, SnapshotOutputRate):
         # snapshot limiter re-emits the full last-known output every T

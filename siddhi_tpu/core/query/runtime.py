@@ -346,6 +346,15 @@ class QueryRuntime(Receiver):
     def _emit(self, out: HostBatch):
         if out.size == 0:
             return
+        for col in self.selector_plan.uuid_cols:
+            # uuid(): fresh per-row UUID strings, filled host-side (the
+            # jitted step emitted placeholders — see ops/expressions.py)
+            import uuid as _uuid
+
+            vals = np.asarray(out.cols[col]).copy()
+            for i in np.nonzero(np.asarray(out.cols[VALID_KEY]))[0]:
+                vals[i] = self.dictionary.encode(str(_uuid.uuid4()))
+            out.cols[col] = vals
         from siddhi_tpu.core.query.ratelimit import PassThroughRateLimiter
 
         if (

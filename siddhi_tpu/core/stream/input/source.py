@@ -216,6 +216,12 @@ def create_source_runtime(ann, stream_def: StreamDefinition, input_handler,
     mapper = mcls()
     mapper.init(stream_def, map_opts)
     source = cls()
+    # namespaced deployment config (reference ConfigReader per extension)
+    from siddhi_tpu.core.util.config import ConfigReader
+
+    source.config_reader = ConfigReader(
+        getattr(app_context.siddhi_context, "config_manager", None),
+        f"source.{type_name}")
     source.init(stream_def, opts, app_context)
     return SourceRuntime(source, mapper, input_handler, app_context)
 

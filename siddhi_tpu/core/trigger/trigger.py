@@ -2,29 +2,31 @@
 
 Mirror of reference ``core/trigger/{StartTrigger,PeriodicTrigger.java:36,
 CronTrigger.java:46}``: a trigger defines a stream ``T (triggered_time
-long)`` and publishes one event per firing. Cron expressions need a cron
-engine (quartz in the reference) and are not supported yet.
+long)`` and publishes one event per firing. Cron triggers evaluate their
+next fire time with the same Quartz-subset schedule the cron window uses
+(``ops/host_windows.CronSchedule``) and chain through the scheduler.
 """
 
 from __future__ import annotations
 
 from siddhi_tpu.core.event import Event
-from siddhi_tpu.ops.expressions import CompileError
 from siddhi_tpu.query_api.definitions import TriggerDefinition
 
 
 class TriggerRuntime:
     def __init__(self, definition: TriggerDefinition, junction, app_context,
                  barrier=None):
-        if definition.cron is not None:
-            raise CompileError(
-                f"trigger '{definition.id}': cron triggers are not supported yet"
-            )
         self.definition = definition
         self.junction = junction
         self.app_context = app_context
         self._barrier = barrier  # the app's quiesce gate (InputEntryValve role)
         self._job = None
+        self._cron = None
+        self._stopped = False
+        if definition.cron is not None:
+            from siddhi_tpu.ops.host_windows import CronSchedule
+
+            self._cron = CronSchedule(definition.cron)
 
     def start(self):
         scheduler = self.app_context.scheduler
@@ -33,11 +35,23 @@ class TriggerRuntime:
             self._fire(ts)
         elif self.definition.at_every is not None and scheduler is not None:
             self._job = scheduler.schedule_periodic(self.definition.at_every, self._fire)
+        elif self._cron is not None and scheduler is not None:
+            now = int(self.app_context.timestamp_generator.current_time())
+            scheduler.notify_at(self._cron.next_fire(now), self._cron_fire)
 
     def stop(self):
+        self._stopped = True
         if self._job is not None and self.app_context.scheduler is not None:
             self.app_context.scheduler.cancel(self._job)
             self._job = None
+
+    def _cron_fire(self, ts: int):
+        if self._stopped:
+            return
+        self._fire(ts)
+        scheduler = self.app_context.scheduler
+        if scheduler is not None:
+            scheduler.notify_at(self._cron.next_fire(int(ts)), self._cron_fire)
 
     def _fire(self, ts: int):
         events = [Event(timestamp=int(ts), data=[int(ts)])]

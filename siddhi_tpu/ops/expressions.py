@@ -405,6 +405,41 @@ def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
 
         return fn, AttrType.LONG
 
+    if name == "uuid":
+        # reference UUIDFunctionExecutor: a fresh UUID string per event.
+        # Random strings cannot be produced inside the jitted step (string
+        # columns are dictionary-encoded); the compiled fn emits a
+        # placeholder and flags the output column for a host-side fill
+        # after the step (QueryRuntime._emit).
+        mark_uuid_seen()
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            shape = _shape_of(xp, None, cols)
+            return xp.zeros(shape, T.dtype_of(AttrType.STRING)), None
+
+        return fn, AttrType.STRING
+
+    if name == "log":
+        # reference LogFunctionExecutor: logs its arguments per event and
+        # passes true; device-side via jax.debug.print (TPU-safe)
+        compiled = [compile_expr(a, resolver) for a in args]
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            vals = [f(cols, ctx)[0] for f, _t in compiled]
+            if xp is np:
+                print("siddhi:", *[np.asarray(v) for v in vals])
+            else:
+                import jax
+
+                fmt = "siddhi: " + " ".join("{}" for _ in vals)
+                jax.debug.print(fmt, *[xp.asarray(v) for v in vals])
+            shape = _shape_of(xp, vals[0] if vals else None, cols)
+            return xp.ones(shape, bool), None
+
+        return fn, AttrType.BOOL
+
     ext = resolve_extension("function", name)
     if ext is not None:
         # custom scalar function (reference SiddhiExtensionLoader resolving
@@ -439,6 +474,19 @@ def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
 import threading as _threading
 
 _ACTIVE = _threading.local()
+_UUID_MARK = _threading.local()
+
+
+def mark_uuid_seen():
+    _UUID_MARK.flag = True
+
+
+def take_uuid_marker() -> bool:
+    """True if a uuid() call was compiled since the last take (consumed by
+    plan_selector to flag the output column for host fill)."""
+    flag = getattr(_UUID_MARK, "flag", False)
+    _UUID_MARK.flag = False
+    return flag
 
 
 def set_active_extensions(extensions: dict) -> None:

@@ -1,0 +1,164 @@
+"""Long-tail parity: cron triggers, log(), script functions, per-group
+rate limiters, ConfigManager/ConfigReader, SiddhiDebugger."""
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.debugger import SiddhiDebugger
+from siddhi_tpu.core.util.config import (
+    ConfigReader,
+    FileConfigManager,
+    InMemoryConfigManager,
+)
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def test_cron_trigger_parses_and_schedules():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define trigger FiveSec at '*/5 * * * * ?';
+        from FiveSec select triggered_time insert into Out;
+    """)
+    tr = rt.trigger_runtimes[0]
+    assert tr._cron is not None
+    # schedule math: next fire strictly after now, on a 5s boundary
+    nxt = tr._cron.next_fire(7_000)
+    m.shutdown()
+    assert nxt == 10_000
+
+
+def test_script_function_python():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define function cube[python] return double { arg0 * arg0 * arg0 };
+        define stream S (v double);
+        from S select cube(v) as c insert into Out;
+    """)
+    c = Collector()
+    rt.add_callback("Out", c)
+    rt.get_input_handler("S").send([3.0])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [(27.0,)]
+
+
+def test_log_function_passes_through():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v double);
+        from S[log(v)] select v insert into Out;
+    """)
+    c = Collector()
+    rt.add_callback("Out", c)
+    rt.get_input_handler("S").send([1.5])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [(1.5,)]
+
+
+def test_per_group_last_rate_limiter():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        from S select sym, v group by sym
+        output last every 4 events
+        insert into Out;
+    """)
+    c = Collector()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    h.send(["b", 2])
+    h.send(["a", 3])
+    h.send(["b", 4])    # window of 4: last per group -> a:3, b:4
+    got = sorted(tuple(e.data) for e in c.events)
+    m.shutdown()
+    assert got == [("a", 3), ("b", 4)]
+
+
+def test_per_group_first_rate_limiter():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        from S select sym, v group by sym
+        output first every 4 events
+        insert into Out;
+    """)
+    c = Collector()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])    # first a
+    h.send(["a", 2])
+    h.send(["b", 3])    # first b
+    h.send(["a", 4])
+    got = sorted(tuple(e.data) for e in c.events)
+    m.shutdown()
+    assert got == [("a", 1), ("b", 3)]
+
+
+def test_config_manager_overrides_knobs(tmp_path):
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager({
+        "siddhi_tpu.nfa_slots": "64",
+        "source.inMemory.poll": "7",
+    }))
+    rt = m.create_siddhi_app_runtime("define stream S (v int); from S select v insert into Out;")
+    assert rt.app_context.nfa_slots == 64
+    reader = ConfigReader(m.siddhi_context.config_manager, "source.inMemory")
+    assert reader.read("poll") == "7"
+    assert reader.read("missing", "dflt") == "dflt"
+    m.shutdown()
+
+    p = tmp_path / "deploy.yaml"
+    p.write_text("# deployment\nsiddhi_tpu.window_capacity: 128\n")
+    fm = FileConfigManager(str(p))
+    assert fm.get_property("siddhi_tpu.window_capacity") == "128"
+
+
+def test_debugger_breakpoints():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S[v > 0] select sym, v insert into Out;
+    """)
+    hits = []
+
+    rt.add_callback("Out", Collector())
+    dbg = rt.debug()
+    dbg.set_debugger_callback(
+        lambda events, name, terminal, d: hits.append((name, len(events))))
+    dbg.acquire_break_point("q", SiddhiDebugger.QueryTerminal.IN)
+    dbg.acquire_break_point("q", SiddhiDebugger.QueryTerminal.OUT)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    h.send(["b", -1])    # filtered: IN fires, OUT does not
+    assert ("q:IN", 1) in hits and ("q:OUT", 1) in hits
+    n_before = len(hits)
+    dbg.release_all_break_points()
+    h.send(["c", 2])
+    m.shutdown()
+    assert len(hits) == n_before
+
+
+def test_uuid_function_unique_per_row():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        from S select v, uuid() as id insert into Out;
+    """)
+    c = Collector()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    h.send([1])
+    h.send([2])
+    m.shutdown()
+    ids = [e.data[1] for e in c.events]
+    assert len(ids) == 2 and ids[0] != ids[1]
+    assert all(isinstance(i, str) and len(i) == 36 for i in ids)
