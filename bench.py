@@ -193,6 +193,12 @@ def bench_nfa_p99():
     end;
     """
     manager = SiddhiManager()
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    # config #4 holds at most a couple of pending matches per key: 8 slots
+    # (vs the 32 default) quarters the [K, S] state and the emission pull
+    manager.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.nfa_slots": "8"}))
     rt = manager.create_siddhi_app_runtime(app)
 
     class Counter(StreamCallback):

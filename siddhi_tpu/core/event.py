@@ -120,20 +120,70 @@ def _pad_len(n: int, minimum: int = 8) -> int:
     return b
 
 
+class LazyColumns(dict):
+    """Column dict whose device-array values materialize to numpy on first
+    access. Device->host transfer through the axon tunnel costs a ~70 ms
+    round trip PER PULL regardless of size, but ``jax.device_get`` batches
+    arbitrarily many arrays into one round trip — so the first touched
+    device column pulls every remaining device column in one RPC, and
+    consumers that never read data columns (output counters served by the
+    ``__meta__`` size hint) pull nothing."""
+
+    def __getitem__(self, k):
+        v = super().__getitem__(k)
+        if not isinstance(v, np.ndarray):
+            self._materialize_all()
+            v = super().__getitem__(k)
+        return v
+
+    def _materialize_all(self):
+        import jax
+
+        pending = [(key, val) for key, val in super().items()
+                   if not isinstance(val, np.ndarray)]
+        if not pending:
+            return
+        pulled = jax.device_get([v for _k, v in pending])
+        for (key, _v), arr in zip(pending, pulled):
+            super().__setitem__(key, np.asarray(arr))
+
+    def get(self, k, default=None):
+        if k in self:
+            return self[k]
+        return default
+
+    def pop(self, k, *default):
+        # pops materialize ONLY the popped value (control scalars like
+        # __meta__ must not drag every data column across the link)
+        if k in self:
+            v = super().__getitem__(k)
+            dict.pop(self, k)
+            if not isinstance(v, np.ndarray):
+                v = np.asarray(v)
+            return v
+        if default:
+            return default[0]
+        raise KeyError(k)
+
+
 class HostBatch:
     """Columnar batch on host (numpy), convertible to device cols dict.
 
     Column keys: attribute names (optionally prefixed by the planner), plus
     reserved ``__ts__`` (i64), ``__type__`` (i8), ``__valid__`` (bool) and
-    per-attribute null masks under ``<key>?``.
+    per-attribute null masks under ``<key>?``. Columns may be lazily-held
+    device arrays (``LazyColumns``) that pull on first read.
     """
 
-    def __init__(self, cols: Dict[str, np.ndarray]):
+    def __init__(self, cols: Dict[str, np.ndarray], size: Optional[int] = None):
         self.cols = cols
+        self._size = size        # known valid-row count (avoids a pull)
 
     @property
     def size(self) -> int:
-        return int(self.cols[VALID_KEY].sum())
+        if self._size is None:
+            self._size = int(np.asarray(self.cols[VALID_KEY]).sum())
+        return self._size
 
     @property
     def capacity(self) -> int:

@@ -28,9 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from siddhi_tpu.core.event import Event, HostBatch
+from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
 from siddhi_tpu.core.plan.selector_plan import GK_KEY
-from siddhi_tpu.core.query.runtime import QueryRuntime
+from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
 from siddhi_tpu.core.stream.junction import Receiver
 from siddhi_tpu.ops.expressions import (
     PK_KEY,
@@ -295,14 +295,14 @@ class JoinQueryRuntime(QueryRuntime):
                     joined["__notify__"] = notify
                 if overflow is not None:
                     joined["__overflow__"] = overflow
-                return new_state, joined
+                return new_state, pack_meta(joined)
 
             new_state["sel"], out = sel.apply(state["sel"], joined, ctx)
             if notify is not None:
                 out["__notify__"] = notify
             if overflow is not None:
                 out["__overflow__"] = overflow
-            return new_state, out
+            return new_state, pack_meta(out)
 
         return step
 
@@ -365,15 +365,22 @@ class JoinQueryRuntime(QueryRuntime):
             return super()._finish_device_batch(step, cols, overflow_msg)
         now = np.int64(self.app_context.timestamp_generator.current_time())
         self._state, out = step(self._state, cols, now)
-        out_host = {k: np.asarray(v) for k, v in out.items()}
-        overflow = out_host.pop("__overflow__", None)
-        if overflow is not None and int(overflow) > 0:
+        out_host = LazyColumns(out)
+        meta = out_host.pop("__meta__", None)
+        if meta is not None:
+            meta = np.asarray(meta)
+            overflow, notify = int(meta[0]), int(meta[1])
+        else:
+            ovf = out_host.pop("__overflow__", None)
+            overflow = int(ovf) if ovf is not None else 0
+            nt = out_host.pop("__notify__", None)
+            notify = int(nt) if nt is not None else -1
+        if overflow > 0:
             raise RuntimeError(f"query '{self.name}': {overflow_msg}")
-        notify = out_host.pop("__notify__", None)
         out_host = self._host_keyed_select(out_host)
         self._emit(HostBatch(out_host))
-        if notify is not None and int(notify) >= 0:
-            return int(notify)
+        if notify >= 0:
+            return notify
         return None
 
     def _timer(self, side_key: str, ts: int):

@@ -21,9 +21,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from siddhi_tpu.core.event import Event, HostBatch
+from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
 from siddhi_tpu.core.plan.selector_plan import GK_KEY
-from siddhi_tpu.core.query.runtime import QueryRuntime
+from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
 from siddhi_tpu.core.stream.junction import Receiver
 from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
 from siddhi_tpu.ops.nfa import NFAStage
@@ -175,13 +175,13 @@ class NFAQueryRuntime(QueryRuntime):
             if split:
                 out_cols["__overflow__"] = overflow
                 out_cols["__notify__"] = notify
-                return {"nfa": new_nfa, "sel": state["sel"]}, out_cols
+                return {"nfa": new_nfa, "sel": state["sel"]}, pack_meta(out_cols)
             new_sel, out = sel.apply(state["sel"], out_cols, ctx)
             if overflow is not None:
                 out["__overflow__"] = overflow
             if notify is not None:
                 out["__notify__"] = notify
-            return {"nfa": new_nfa, "sel": new_sel}, out
+            return {"nfa": new_nfa, "sel": new_sel}, pack_meta(out)
 
         return step
 
@@ -199,13 +199,13 @@ class NFAQueryRuntime(QueryRuntime):
             if split:
                 out_cols["__overflow__"] = overflow
                 out_cols["__notify__"] = notify
-                return {"nfa": new_nfa, "sel": state["sel"]}, out_cols
+                return {"nfa": new_nfa, "sel": state["sel"]}, pack_meta(out_cols)
             new_sel, out = sel.apply(state["sel"], out_cols, ctx)
             if overflow is not None:
                 out["__overflow__"] = overflow
             if notify is not None:
                 out["__notify__"] = notify
-            return {"nfa": new_nfa, "sel": new_sel}, out
+            return {"nfa": new_nfa, "sel": new_sel}, pack_meta(out)
 
         return step
 
@@ -243,8 +243,9 @@ class NFAQueryRuntime(QueryRuntime):
                 else:
                     step = jax.jit(fn, donate_argnums=0)
                 self._steps[stream_id] = step
+            jcols = dict(cols) if isinstance(cols, LazyColumns) else cols
             notify = self._run_nfa_step(lambda: step(
-                self._state, cols,
+                self._state, jcols,
                 np.int64(self.app_context.timestamp_generator.current_time())))
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self._timer_cb)
@@ -268,21 +269,33 @@ class NFAQueryRuntime(QueryRuntime):
 
     def _run_nfa_step(self, run) -> int | None:
         """Run a jitted NFA step; when a group-by keyer splits the pipeline,
-        key the NFA emissions host-side and run the selector step after."""
+        key the NFA emissions host-side and run the selector step after.
+        Overflow/notify/size arrive packed in __meta__ — one pull."""
         self._state, out = run()
-        out_host = {k: np.asarray(v) for k, v in out.items()}
-        overflow = out_host.pop("__overflow__", None)
-        if overflow is not None and int(overflow) > 0:
+        out_host = LazyColumns(out)
+        size_hint = None
+        meta = out_host.pop("__meta__", None)
+        if meta is not None:
+            meta = np.asarray(meta)
+            overflow, notify, size_hint = int(meta[0]), int(meta[1]), int(meta[2])
+        else:
+            ovf = out_host.pop("__overflow__", None)
+            overflow = int(ovf) if ovf is not None else 0
+            nt = out_host.pop("__notify__", None)
+            notify = int(nt) if nt is not None else -1
+        if overflow > 0:
             raise RuntimeError(
                 f"query '{self.name}': pattern match-slot capacity exceeded — "
                 f"raise app_context.nfa_slots before creating the runtime"
             )
-        notify = out_host.pop("__notify__", None)
         if self.keyer is not None:
+            out_host.pop("__overflow__", None)
+            out_host.pop("__notify__", None)
             out_host = self._host_keyed_select(out_host)
-        self._emit(HostBatch(out_host))
-        if notify is not None and int(notify) >= 0:
-            return int(notify)
+            size_hint = None
+        self._emit(HostBatch(out_host, size=size_hint))
+        if notify >= 0:
+            return notify
         return None
 
     def receive(self, events: List[Event]):  # pragma: no cover — proxies only

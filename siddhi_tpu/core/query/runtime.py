@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, StringDictionary
+from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, LazyColumns, StringDictionary
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
 from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
@@ -210,7 +210,7 @@ class QueryRuntime(Receiver):
                 out["__notify__"] = notify
             if overflow is not None:
                 out["__overflow__"] = overflow
-            return new_state, out
+            return new_state, pack_meta(out)
 
         return step
 
@@ -304,13 +304,15 @@ class QueryRuntime(Receiver):
             sel = self.selector_plan
 
             def fn(sel_state, cols, now):
-                return sel.apply(sel_state, cols, {"xp": jnp, "current_time": now})
+                st2, out2 = sel.apply(sel_state, cols, {"xp": jnp, "current_time": now})
+                return st2, pack_meta(out2)
 
             self._sel_step = jax.jit(fn, donate_argnums=0)
         now = np.int64(self.app_context.timestamp_generator.current_time())
-        new_sel, sel_out = self._sel_step(self._state["sel"], out_host, now)
+        new_sel, sel_out = self._sel_step(self._state["sel"], dict(out_host), now)
         self._state["sel"] = new_sel
-        out = {k: np.asarray(v) for k, v in sel_out.items()}
+        out = LazyColumns(sel_out)
+        out.pop("__meta__", None)
         out.pop("__notify__", None)
         out.pop("__overflow__", None)
         return out
@@ -326,8 +328,32 @@ class QueryRuntime(Receiver):
 
             t0 = _time.perf_counter()
         now = np.int64(self.app_context.timestamp_generator.current_time())
+        if isinstance(cols, LazyColumns):
+            cols = dict(cols)   # jit boundary: raw (possibly device) arrays
         self._state, out = step(self._state, cols, now)
-        out_host = {k: np.asarray(v) for k, v in out.items()}
+        # lazy pull: only columns a consumer actually reads cross the
+        # device->host link; overflow/notify/size travel as ONE packed
+        # array — a single ~70ms tunnel round trip per batch
+        out_host = LazyColumns(out)
+        size_hint = None
+        meta = out_host.pop("__meta__", None)
+        if meta is not None:
+            meta = np.asarray(meta)
+            overflow = int(meta[0])
+            notify = int(meta[1])
+            size_hint = int(meta[2])
+            if overflow > 0:
+                raise RuntimeError(
+                    f"query '{self.name}': {overflow_msg} before creating the runtime")
+            if t0 is not None:
+                import time as _time
+
+                sm.latency_tracker(self.name).record(
+                    (_time.perf_counter() - t0) * 1000.0)
+            self._emit(HostBatch(out_host, size=size_hint))
+            if notify >= 0:
+                return notify
+            return None
         overflow = out_host.pop("__overflow__", None)
         if overflow is not None and int(overflow) > 0:
             raise RuntimeError(
@@ -367,11 +393,15 @@ class QueryRuntime(Receiver):
             and hasattr(self.output_junction, "send_batch")
         ):
             # columnar re-publish: no Event materialization between queries
-            cols = dict(out.cols)
-            t = cols[TYPE_KEY]
-            # EXPIRED -> CURRENT on re-publish (InsertIntoStreamCallback.java:52-55)
-            cols[TYPE_KEY] = np.where(t == EXPIRED, CURRENT, t).astype(np.int8)
-            self.output_junction.send_batch(HostBatch(cols))
+            cols = LazyColumns(out.cols)
+            if self.selector_plan.expired_on:
+                # EXPIRED -> CURRENT on re-publish
+                # (InsertIntoStreamCallback.java:52-55); CURRENT-only
+                # selectors skip the flip — touching TYPE would pull every
+                # device column across the tunnel
+                t = cols[TYPE_KEY]
+                cols[TYPE_KEY] = np.where(t == EXPIRED, CURRENT, t).astype(np.int8)
+            self.output_junction.send_batch(HostBatch(cols, size=out._size))
             return
         events = out.to_events(
             self.output_attrs, self.dictionary,
@@ -398,6 +428,19 @@ class QueryRuntime(Receiver):
             in_events = [e for e in events if not e.is_expired] or None
             remove_events = [e for e in events if e.is_expired] or None
             cb.receive(events[0].timestamp, in_events, remove_events)
+
+
+def pack_meta(out: dict) -> dict:
+    """Fold __overflow__/__notify__/valid-count into ONE device array so
+    the host pays a single D2H round trip per batch (the axon tunnel
+    charges ~70 ms latency per pull, independent of size)."""
+    ov = out.pop("__overflow__", None)
+    nt = out.pop("__notify__", None)
+    ov = jnp.int64(0) if ov is None else jnp.asarray(ov).astype(jnp.int64).reshape(())
+    nt = jnp.int64(-1) if nt is None else jnp.asarray(nt).astype(jnp.int64).reshape(())
+    n = jnp.sum(out[VALID_KEY], dtype=jnp.int64)
+    out["__meta__"] = jnp.stack([ov, nt, n])
+    return out
 
 
 def _zero_value(attr_type: AttrType):

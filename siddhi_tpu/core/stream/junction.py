@@ -105,13 +105,15 @@ class StreamJunction:
             self._deliver_batch(batch)
 
     def _deliver_batch(self, batch):
-        from siddhi_tpu.core.event import HostBatch
+        from siddhi_tpu.core.event import HostBatch, LazyColumns
 
         for r in self.receivers:
             # receivers mutate batch.cols in place (filters, key columns) —
-            # hand each its own dict so mutations don't leak across
+            # hand each its own dict so mutations don't leak across;
+            # LazyColumns keeps device-held outputs unpulled until read
             try:
-                r.receive_batch(HostBatch(dict(batch.cols)), self)
+                r.receive_batch(
+                    HostBatch(LazyColumns(batch.cols), size=batch._size), self)
             except Exception as e:  # noqa: BLE001 — fault-stream routing
                 self.handle_error(self.decode_events(batch), e)
 
