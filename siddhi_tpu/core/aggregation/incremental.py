@@ -236,6 +236,10 @@ class IncrementalAggregationRuntime(Receiver):
         self.store: Dict[Duration, Dict[int, Dict[tuple, list]]] = {
             d: {} for d in self.durations
         }
+        # incremental-snapshot bookkeeping: buckets touched/purged since
+        # the last checkpoint (reference IncrementalSnapshotable op-logs)
+        self._dirty: set = set()
+        self._deleted: set = set()
 
         # @purge retention (reference IncrementalDataPurger.java:62):
         # per-duration retention windows; coarser durations retain the
@@ -291,8 +295,38 @@ class IncrementalAggregationRuntime(Receiver):
                 drop = [b for b in dstore if b < cutoff]
                 for b in drop:
                     del dstore[b]
+                    self._deleted.add((d, b))
+                    self._dirty.discard((d, b))
                 purged += len(drop)
         return purged
+
+    # ----------------------------------------------- incremental snapshots
+
+    def incremental_snapshot(self) -> dict:
+        """Buckets touched since the last checkpoint (+ purge tombstones);
+        clears the dirty log (reference incremental snapshot op-logs)."""
+        with self._lock:
+            out = {"base_keys": list(self.bases), "buckets": {}, "deleted": []}
+            for d, b in self._dirty:
+                groups = self.store.get(d, {}).get(b)
+                if groups is None:
+                    continue
+                out["buckets"].setdefault(d.value, {})[b] = {
+                    g: list(v) for g, v in groups.items()}
+            out["deleted"] = [(d.value, b) for d, b in self._deleted]
+            self._dirty.clear()
+            self._deleted.clear()
+            return out
+
+    def apply_increment(self, snap: dict):
+        with self._lock:
+            for dv, buckets in snap.get("buckets", {}).items():
+                d = Duration(dv)
+                dstore = self.store.setdefault(d, {})
+                for b, groups in buckets.items():
+                    dstore[b] = {g: list(v) for g, v in groups.items()}
+            for dv, b in snap.get("deleted", []):
+                self.store.get(Duration(dv), {}).pop(b, None)
 
     def _base(self, key: str, arg_fn, out_type, kind: Optional[str] = None) -> str:
         if key not in self.bases:
@@ -344,6 +378,7 @@ class IncrementalAggregationRuntime(Receiver):
                 for i in idx:
                     b = int(buckets[i])
                     g = tuple(x[i].item() for x in groups)
+                    self._dirty.add((d, b))
                     slot = dstore.setdefault(b, {}).get(g)
                     if slot is None:
                         slot = dstore[b][g] = [None] * len(base_keys)

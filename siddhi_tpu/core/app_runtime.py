@@ -528,6 +528,17 @@ class SiddhiAppRuntime:
             for sr in self.source_runtimes:
                 sr.resume()
 
+    def persist_incremental(self) -> str:
+        """Op-log checkpoint chained to the last revision (reference
+        incremental snapshots); falls back to full when none exists."""
+        for sr in self.source_runtimes:
+            sr.pause()
+        try:
+            return self.persistence.persist_incremental()
+        finally:
+            for sr in self.source_runtimes:
+                sr.resume()
+
     def restore_revision(self, revision: str):
         self.persistence.restore_revision(revision)
 

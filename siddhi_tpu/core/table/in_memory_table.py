@@ -92,6 +92,10 @@ class InMemoryTable:
             self.primary_key = [v for _k, v in pk_ann.elements if v]
         self._pk_map: Dict[tuple, int] = {}
         self._pk_dirty = False
+        # incremental-snapshot op log: inserted rows since the last
+        # checkpoint; deletes/updates force a full capture
+        self._journal: List[dict] = []
+        self._journal_full = False
 
     # ------------------------------------------------------- primary key map
 
@@ -186,11 +190,16 @@ class InMemoryTable:
             rank = jnp.cumsum(np.asarray(valid, bool)) - 1
             slot = jnp.where(valid, fs[jnp.clip(rank, 0, C - 1)], C)
             new_cols = {}
+            journal_rows = {}
+            vidx = np.nonzero(np.asarray(valid, bool))[0]
             for name in st["cols"]:
                 src = cols.get(name)
                 if src is None:
                     src = np.zeros(valid.shape[0], self.col_specs[name])
+                journal_rows[name] = np.asarray(src)[vidx].copy()
                 new_cols[name] = st["cols"][name].at[slot].set(jnp.asarray(src), mode="drop")
+            if not self._journal_full and vidx.size:
+                self._journal.append(journal_rows)
             self.state = {
                 "cols": new_cols,
                 "valid": st["valid"].at[slot].set(True, mode="drop"),
@@ -224,6 +233,7 @@ class InMemoryTable:
                 "valid": self.state["valid"] & ~jnp.any(m, axis=0),
             }
             self._pk_dirty = True
+            self._journal_full = True
 
     def update(self, cond: Optional[Callable], assignments, batch: Optional[HostBatch]):
         """assignments: [(table col name, compiled expr over ev/table cols)].
@@ -259,6 +269,7 @@ class InMemoryTable:
                     hit, mk, new_cols[col_name + "?"])
             self.state = {"cols": new_cols, "valid": self.state["valid"]}
             self._pk_dirty = True
+            self._journal_full = True
             return m
 
     def update_or_insert(self, cond, assignments, batch: HostBatch,
@@ -291,6 +302,41 @@ class InMemoryTable:
                             ins[table_attr + "?"] = row.get(ev_col + "?", np.zeros(1, bool))
                         single = HostBatch(ins)
                     self.insert(single)
+
+    # ----------------------------------------------- incremental snapshots
+
+    def incremental_snapshot(self) -> dict:
+        """Insert journal since the last checkpoint, or the full state when
+        a delete/update invalidated the op log; clears the journal."""
+        with self._lock:
+            if self._journal_full:
+                snap = {"full": {
+                    "cols": {k: np.asarray(v) for k, v in self.state["cols"].items()},
+                    "valid": np.asarray(self.state["valid"]),
+                }, "capacity": self.capacity}
+            else:
+                snap = {"journal": self._journal}
+            self._journal = []
+            self._journal_full = False
+            return snap
+
+    def apply_increment(self, snap: dict):
+        if "full" in snap:
+            with self._lock:
+                self.state = {
+                    "cols": {k: jnp.asarray(v) for k, v in snap["full"]["cols"].items()},
+                    "valid": jnp.asarray(snap["full"]["valid"]),
+                }
+                self.capacity = snap["capacity"]
+                self._pk_dirty = True
+            return
+        for rows in snap.get("journal", []):
+            n = len(next(iter(rows.values()))) if rows else 0
+            if n == 0:
+                continue
+            cols = {k: v.copy() for k, v in rows.items()}
+            cols[VALID_KEY] = np.ones(n, bool)
+            self.insert(HostBatch(cols))
 
     # ------------------------------------------------------------ decoding
 

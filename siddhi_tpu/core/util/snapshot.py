@@ -48,7 +48,7 @@ class SnapshotService:
 
     # ------------------------------------------------------------ capture
 
-    def full_snapshot(self) -> bytes:
+    def _capture_common(self) -> dict:
         rt = self.app_runtime
         dictionary = rt.app_context.string_dictionary
         queries = {}
@@ -62,10 +62,6 @@ class SnapshotService:
                     "host_window": (q.host_window.snapshot()
                                     if q.host_window is not None else None),
                 }
-        tables = {}
-        for tid, t in rt.tables.items():
-            with t._lock:
-                tables[tid] = {"state": _to_host(t.state), "capacity": t.capacity}
         windows = {}
         for wid, w in rt.named_windows.items():
             with w._lock:
@@ -73,17 +69,48 @@ class SnapshotService:
                     windows[wid] = {"host": True, "data": w.stage.snapshot()}
                 else:
                     windows[wid] = {"host": False, "data": _to_host(w.state)}
-        partitions = [p.keyspace.snapshot() for p in rt.partition_contexts]
-        aggregations = {aid: a.snapshot() for aid, a in rt.aggregations.items()}
-        obj = {
+        return {
             "version": FORMAT_VERSION,
             "app": rt.name,
             "strings": list(dictionary._to_str),
             "queries": queries,
-            "tables": tables,
             "windows": windows,
-            "partitions": partitions,
-            "aggregations": aggregations,
+            "partitions": [p.keyspace.snapshot() for p in rt.partition_contexts],
+        }
+
+    def full_snapshot(self) -> bytes:
+        rt = self.app_runtime
+        obj = self._capture_common()
+        tables = {}
+        for tid, t in rt.tables.items():
+            if not hasattr(t, "state"):
+                continue    # @store record tables own their durability
+            with t._lock:
+                tables[tid] = {"state": _to_host(t.state), "capacity": t.capacity}
+                t._journal = []
+                t._journal_full = False
+        obj["tables"] = tables
+        obj["aggregations"] = {aid: a.snapshot() for aid, a in rt.aggregations.items()}
+        for a in rt.aggregations.values():
+            a._dirty.clear()
+            a._deleted.clear()
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def incremental_snapshot(self, base_revision: str) -> bytes:
+        """Checkpoint with op-log deltas for the heavy history holders
+        (aggregation buckets, table inserts) and full state for the light
+        components — the reference's incremental SnapshotService split
+        (``SnapshotService.java:189`` IncrementalSnapshotable)."""
+        rt = self.app_runtime
+        obj = self._capture_common()
+        obj["incremental"] = True
+        obj["base"] = base_revision
+        obj["tables_inc"] = {
+            tid: t.incremental_snapshot()
+            for tid, t in rt.tables.items() if hasattr(t, "incremental_snapshot")
+        }
+        obj["aggregations_inc"] = {
+            aid: a.incremental_snapshot() for aid, a in rt.aggregations.items()
         }
         return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
 
@@ -91,6 +118,29 @@ class SnapshotService:
 
     def restore(self, data: bytes):
         obj = pickle.loads(data)
+        if obj.get("incremental"):
+            raise ValueError(
+                "incremental snapshot cannot be restored standalone — "
+                "restore its base chain via PersistenceManager")
+        self._restore_obj(obj)
+
+    def apply_incremental(self, data: bytes):
+        """Apply one incremental checkpoint on top of already-restored
+        state: light components overwrite, heavy ones apply op logs."""
+        obj = pickle.loads(data)
+        self._restore_obj(obj, incremental=True)
+        rt = self.app_runtime
+        for tid, snap in obj.get("tables_inc", {}).items():
+            t = rt.tables.get(tid)
+            if t is not None and hasattr(t, "apply_increment"):
+                t.apply_increment(snap)
+        for aid, snap in obj.get("aggregations_inc", {}).items():
+            a = rt.aggregations.get(aid)
+            if a is not None:
+                a.apply_increment(snap)
+        self._rearm_schedulers()
+
+    def _restore_obj(self, obj, incremental: bool = False):
         if obj.get("version") != FORMAT_VERSION:
             raise ValueError(
                 f"snapshot format {obj.get('version')} is not supported "
@@ -133,13 +183,14 @@ class SnapshotService:
                 if hasattr(q, "_steps"):
                     q._steps.clear()
 
-        for tid, tsnap in obj["tables"].items():
+        for tid, tsnap in obj.get("tables", {}).items():
             t = rt.tables.get(tid)
             if t is None:
                 raise ValueError(f"snapshot has unknown table '{tid}'")
             with t._lock:
                 t.state = _to_device(tsnap["state"])
                 t.capacity = tsnap["capacity"]
+                t._pk_dirty = True
 
         for aid, asnap in obj.get("aggregations", {}).items():
             a = rt.aggregations.get(aid)
@@ -158,7 +209,8 @@ class SnapshotService:
                     w.state = _to_device(wsnap["data"])
                     w._step = None
 
-        self._rearm_schedulers()
+        if not incremental:
+            self._rearm_schedulers()
 
     def _rearm_schedulers(self):
         """Re-arm expiry timers on restored time-driven stages (the
@@ -199,6 +251,7 @@ class PersistenceManager:
     def __init__(self, app_runtime):
         self.app_runtime = app_runtime
         self.snapshot_service = SnapshotService(app_runtime)
+        self._last_revision: Optional[str] = None
 
     def _store(self):
         store = self.app_runtime.app_context.siddhi_context.persistence_store
@@ -211,24 +264,45 @@ class PersistenceManager:
 
     _seq = itertools.count()  # ms collisions must not overwrite snapshots
 
-    def persist(self) -> str:
+    def persist(self, incremental: bool = False) -> str:
+        """Full checkpoint, or (``incremental=True``, after at least one
+        full) an op-log delta chained to the previous revision (reference
+        incremental SnapshotService + IncrementalPersistenceStore)."""
         rt = self.app_runtime
         store = self._store()
         with rt._barrier:  # quiesce inputs (ThreadBarrier)
-            data = self.snapshot_service.full_snapshot()
+            if incremental and self._last_revision is not None:
+                data = self.snapshot_service.incremental_snapshot(
+                    self._last_revision)
+            else:
+                data = self.snapshot_service.full_snapshot()
         # sortable: ms prefix, then a process-monotonic counter
         revision = f"{int(time.time() * 1000):020d}_{next(self._seq):06d}_{rt.name}"
         store.save(rt.name, revision, data)
+        self._last_revision = revision
         return revision
+
+    def persist_incremental(self) -> str:
+        return self.persist(incremental=True)
 
     def restore_revision(self, revision: str):
         rt = self.app_runtime
         store = self._store()
-        data = store.load(rt.name, revision)
-        if data is None:
-            raise KeyError(f"revision '{revision}' not found for app '{rt.name}'")
+        # walk the base chain: a stack of increments over one full snapshot
+        chain: List[bytes] = []
+        rev: Optional[str] = revision
+        while rev is not None:
+            data = store.load(rt.name, rev)
+            if data is None:
+                raise KeyError(f"revision '{rev}' not found for app '{rt.name}'")
+            chain.append(data)
+            obj = pickle.loads(data)
+            rev = obj.get("base") if obj.get("incremental") else None
         with rt._barrier:
-            self.snapshot_service.restore(data)
+            self.snapshot_service.restore(chain[-1])
+            for data in reversed(chain[:-1]):
+                self.snapshot_service.apply_incremental(data)
+        self._last_revision = revision
 
     def restore_last_revision(self) -> Optional[str]:
         rt = self.app_runtime
