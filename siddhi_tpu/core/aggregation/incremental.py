@@ -303,10 +303,11 @@ class IncrementalAggregationRuntime(Receiver):
     # ----------------------------------------------- incremental snapshots
 
     def incremental_snapshot(self) -> dict:
-        """Buckets touched since the last checkpoint (+ purge tombstones);
-        clears the dirty log (reference incremental snapshot op-logs)."""
+        """Buckets touched since the last checkpoint (+ purge tombstones).
+        Pure capture — the op log is cleared only after the checkpoint is
+        durably saved (``clear_oplog``), so a failed save loses nothing."""
         with self._lock:
-            out = {"base_keys": list(self.bases), "buckets": {}, "deleted": []}
+            out = {"buckets": {}, "deleted": []}
             for d, b in self._dirty:
                 groups = self.store.get(d, {}).get(b)
                 if groups is None:
@@ -314,19 +315,24 @@ class IncrementalAggregationRuntime(Receiver):
                 out["buckets"].setdefault(d.value, {})[b] = {
                     g: list(v) for g, v in groups.items()}
             out["deleted"] = [(d.value, b) for d, b in self._deleted]
+            return out
+
+    def clear_oplog(self):
+        with self._lock:
             self._dirty.clear()
             self._deleted.clear()
-            return out
 
     def apply_increment(self, snap: dict):
         with self._lock:
+            # deletions first: a bucket purged then re-created within one
+            # checkpoint interval appears in both lists and must survive
+            for dv, b in snap.get("deleted", []):
+                self.store.get(Duration(dv), {}).pop(b, None)
             for dv, buckets in snap.get("buckets", {}).items():
                 d = Duration(dv)
                 dstore = self.store.setdefault(d, {})
                 for b, groups in buckets.items():
                     dstore[b] = {g: list(v) for g, v in groups.items()}
-            for dv, b in snap.get("deleted", []):
-                self.store.get(Duration(dv), {}).pop(b, None)
 
     def _base(self, key: str, arg_fn, out_type, kind: Optional[str] = None) -> str:
         if key not in self.bases:
@@ -379,6 +385,7 @@ class IncrementalAggregationRuntime(Receiver):
                     b = int(buckets[i])
                     g = tuple(x[i].item() for x in groups)
                     self._dirty.add((d, b))
+                    self._deleted.discard((d, b))   # re-created after purge
                     slot = dstore.setdefault(b, {}).get(g)
                     if slot is None:
                         slot = dstore[b][g] = [None] * len(base_keys)

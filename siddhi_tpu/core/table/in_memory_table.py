@@ -93,7 +93,10 @@ class InMemoryTable:
         self._pk_map: Dict[tuple, int] = {}
         self._pk_dirty = False
         # incremental-snapshot op log: inserted rows since the last
-        # checkpoint; deletes/updates force a full capture
+        # checkpoint; deletes/updates force a full capture. Journaling is
+        # off until persistence is in use (PersistenceManager enables it)
+        # so non-persisted apps pay no copy or memory cost.
+        self.journal_enabled = False
         self._journal: List[dict] = []
         self._journal_full = False
 
@@ -190,15 +193,17 @@ class InMemoryTable:
             rank = jnp.cumsum(np.asarray(valid, bool)) - 1
             slot = jnp.where(valid, fs[jnp.clip(rank, 0, C - 1)], C)
             new_cols = {}
+            journal = self.journal_enabled and not self._journal_full
             journal_rows = {}
-            vidx = np.nonzero(np.asarray(valid, bool))[0]
+            vidx = np.nonzero(np.asarray(valid, bool))[0] if journal else None
             for name in st["cols"]:
                 src = cols.get(name)
                 if src is None:
                     src = np.zeros(valid.shape[0], self.col_specs[name])
-                journal_rows[name] = np.asarray(src)[vidx].copy()
+                if journal:
+                    journal_rows[name] = np.asarray(src)[vidx].copy()
                 new_cols[name] = st["cols"][name].at[slot].set(jnp.asarray(src), mode="drop")
-            if not self._journal_full and vidx.size:
+            if journal and vidx.size:
                 self._journal.append(journal_rows)
             self.state = {
                 "cols": new_cols,
@@ -307,18 +312,20 @@ class InMemoryTable:
 
     def incremental_snapshot(self) -> dict:
         """Insert journal since the last checkpoint, or the full state when
-        a delete/update invalidated the op log; clears the journal."""
+        a delete/update invalidated the op log. Pure capture — cleared via
+        ``clear_oplog`` only after the checkpoint is durably saved."""
         with self._lock:
             if self._journal_full:
-                snap = {"full": {
+                return {"full": {
                     "cols": {k: np.asarray(v) for k, v in self.state["cols"].items()},
                     "valid": np.asarray(self.state["valid"]),
                 }, "capacity": self.capacity}
-            else:
-                snap = {"journal": self._journal}
+            return {"journal": list(self._journal)}
+
+    def clear_oplog(self):
+        with self._lock:
             self._journal = []
             self._journal_full = False
-            return snap
 
     def apply_increment(self, snap: dict):
         if "full" in snap:
@@ -330,13 +337,20 @@ class InMemoryTable:
                 self.capacity = snap["capacity"]
                 self._pk_dirty = True
             return
-        for rows in snap.get("journal", []):
-            n = len(next(iter(rows.values()))) if rows else 0
-            if n == 0:
-                continue
-            cols = {k: v.copy() for k, v in rows.items()}
-            cols[VALID_KEY] = np.ones(n, bool)
-            self.insert(HostBatch(cols))
+        # replay without re-journaling (the restored chain already holds
+        # these rows — journaling them would duplicate on the NEXT restore)
+        was = self.journal_enabled
+        self.journal_enabled = False
+        try:
+            for rows in snap.get("journal", []):
+                n = len(next(iter(rows.values()))) if rows else 0
+                if n == 0:
+                    continue
+                cols = {k: v.copy() for k, v in rows.items()}
+                cols[VALID_KEY] = np.ones(n, bool)
+                self.insert(HostBatch(cols))
+        finally:
+            self.journal_enabled = was
 
     # ------------------------------------------------------------ decoding
 

@@ -79,6 +79,8 @@ class SnapshotService:
         }
 
     def full_snapshot(self) -> bytes:
+        """Pure capture — op logs are untouched; PersistenceManager calls
+        ``mark_checkpoint`` only after the revision is durably saved."""
         rt = self.app_runtime
         obj = self._capture_common()
         tables = {}
@@ -87,14 +89,19 @@ class SnapshotService:
                 continue    # @store record tables own their durability
             with t._lock:
                 tables[tid] = {"state": _to_host(t.state), "capacity": t.capacity}
-                t._journal = []
-                t._journal_full = False
         obj["tables"] = tables
         obj["aggregations"] = {aid: a.snapshot() for aid, a in rt.aggregations.items()}
-        for a in rt.aggregations.values():
-            a._dirty.clear()
-            a._deleted.clear()
         return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def mark_checkpoint(self):
+        """Clear the incremental op logs after a checkpoint is durably
+        stored (clear-before-save would lose deltas on a failed save)."""
+        rt = self.app_runtime
+        for t in rt.tables.values():
+            if hasattr(t, "clear_oplog"):
+                t.clear_oplog()
+        for a in rt.aggregations.values():
+            a.clear_oplog()
 
     def incremental_snapshot(self, base_revision: str) -> bytes:
         """Checkpoint with op-log deltas for the heavy history holders
@@ -123,11 +130,12 @@ class SnapshotService:
                 "incremental snapshot cannot be restored standalone — "
                 "restore its base chain via PersistenceManager")
         self._restore_obj(obj)
+        self.mark_checkpoint()   # restored state must not re-enter op logs
 
-    def apply_incremental(self, data: bytes):
+    def apply_incremental(self, data: bytes, rearm: bool = True):
         """Apply one incremental checkpoint on top of already-restored
         state: light components overwrite, heavy ones apply op logs."""
-        obj = pickle.loads(data)
+        obj = pickle.loads(data) if isinstance(data, (bytes, bytearray)) else data
         self._restore_obj(obj, incremental=True)
         rt = self.app_runtime
         for tid, snap in obj.get("tables_inc", {}).items():
@@ -138,7 +146,8 @@ class SnapshotService:
             a = rt.aggregations.get(aid)
             if a is not None:
                 a.apply_increment(snap)
-        self._rearm_schedulers()
+        if rearm:
+            self._rearm_schedulers()
 
     def _restore_obj(self, obj, incremental: bool = False):
         if obj.get("version") != FORMAT_VERSION:
@@ -252,6 +261,11 @@ class PersistenceManager:
         self.app_runtime = app_runtime
         self.snapshot_service = SnapshotService(app_runtime)
         self._last_revision: Optional[str] = None
+        # persistence is in use: start journaling table inserts so
+        # incremental checkpoints have an op log to draw from
+        for t in app_runtime.tables.values():
+            if hasattr(t, "journal_enabled"):
+                t.journal_enabled = True
 
     def _store(self):
         store = self.app_runtime.app_context.siddhi_context.persistence_store
@@ -279,6 +293,8 @@ class PersistenceManager:
         # sortable: ms prefix, then a process-monotonic counter
         revision = f"{int(time.time() * 1000):020d}_{next(self._seq):06d}_{rt.name}"
         store.save(rt.name, revision, data)
+        # only after the save is durable: clear the op logs
+        self.snapshot_service.mark_checkpoint()
         self._last_revision = revision
         return revision
 
@@ -289,19 +305,22 @@ class PersistenceManager:
         rt = self.app_runtime
         store = self._store()
         # walk the base chain: a stack of increments over one full snapshot
-        chain: List[bytes] = []
+        chain: List[dict] = []
         rev: Optional[str] = revision
         while rev is not None:
             data = store.load(rt.name, rev)
             if data is None:
                 raise KeyError(f"revision '{rev}' not found for app '{rt.name}'")
-            chain.append(data)
             obj = pickle.loads(data)
+            chain.append(obj)
             rev = obj.get("base") if obj.get("incremental") else None
         with rt._barrier:
-            self.snapshot_service.restore(chain[-1])
-            for data in reversed(chain[:-1]):
-                self.snapshot_service.apply_incremental(data)
+            self.snapshot_service._restore_obj(chain[-1])
+            for obj in reversed(chain[:-1]):
+                self.snapshot_service.apply_incremental(obj, rearm=False)
+            self.snapshot_service._rearm_schedulers()
+            # replayed state must not re-enter the next delta's op log
+            self.snapshot_service.mark_checkpoint()
         self._last_revision = revision
 
     def restore_last_revision(self) -> Optional[str]:
@@ -314,3 +333,5 @@ class PersistenceManager:
 
     def clear_all_revisions(self):
         self._store().clear_all_revisions(self.app_runtime.name)
+        # the next incremental must not chain to a wiped revision
+        self._last_revision = None

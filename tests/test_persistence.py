@@ -242,3 +242,42 @@ def test_incremental_persist_chain():
     # bucket sums: A folded across full+delta, B and C arrive via deltas
     assert sorted(rows.values()) == [3.0, 5.0, 7.0]
     assert len(table_rows) == 4
+
+
+def test_incremental_second_generation_restore_no_duplicates():
+    """restore -> more inserts -> persist_incremental -> restore again:
+    replayed journal rows must not re-enter the new delta (review finding:
+    journal pollution on restore would duplicate table rows)."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+    APP = """
+    define stream S (sym string, price double);
+    define table T (sym string, price double);
+    from S insert into T;
+    """
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.get_input_handler("S").send(["A", 1.0])
+    rt.persist()
+    rt.get_input_handler("S").send(["B", 2.0])
+    rev1 = rt.persist_incremental()
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(APP)
+    rt2.restore_revision(rev1)
+    rt2.get_input_handler("S").send(["C", 3.0])
+    rev2 = rt2.persist_incremental()
+    m2.shutdown()
+
+    m3 = SiddhiManager()
+    m3.set_persistence_store(store)
+    rt3 = m3.create_siddhi_app_runtime(APP)
+    rt3.restore_revision(rev2)
+    rows = sorted(tuple(e.data) for e in rt3.tables["T"].all_events())
+    m3.shutdown()
+    assert rows == [("A", 1.0), ("B", 2.0), ("C", 3.0)]
