@@ -1,0 +1,148 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The compute path is JAX/XLA; the host runtime around it uses native code
+where the per-row work would otherwise be interpreted Python — here the
+columnar ingest loader (``CsvLoader``): transport byte buffers parse in
+one C++ pass into the typed column arrays ``InputHandler.send_columns``
+consumes, with native dictionary encoding for string attributes (Python
+syncs the app StringDictionary once per NEW unique string, never per
+row).
+
+The shared library builds on first use with the image's g++ and is cached
+next to the source (no pip/pybind11 dependency).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from siddhi_tpu.query_api.definitions import AttrType
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csv_loader.cpp")
+_SO = os.path.join(_HERE, "_csv_loader.so")
+_LOCK = threading.Lock()
+_LIB = None
+
+_TYPE_CODES = {
+    AttrType.INT: 0, AttrType.LONG: 0,
+    AttrType.FLOAT: 1, AttrType.DOUBLE: 1,
+    AttrType.STRING: 2,
+    AttrType.BOOL: 3,
+}
+
+
+def _lib():
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 _SRC, "-o", _SO],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+        lib.loader_new.restype = ctypes.c_void_p
+        lib.loader_free.argtypes = [ctypes.c_void_p]
+        lib.loader_dict_size.restype = ctypes.c_int64
+        lib.loader_dict_size.argtypes = [ctypes.c_void_p]
+        lib.loader_dict_get.restype = ctypes.c_int64
+        lib.loader_dict_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.loader_parse_csv.restype = ctypes.c_int64
+        lib.loader_parse_csv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64,
+        ]
+        _LIB = lib
+        return lib
+
+
+class CsvLoader:
+    """Parse CSV byte buffers into send_columns-ready column dicts.
+
+    String columns come back dictionary-encoded; ids are remapped into the
+    app's StringDictionary (one Python round trip per new unique)."""
+
+    def __init__(self, definition, dictionary):
+        self.definition = definition
+        self.dictionary = dictionary
+        self._lib = _lib()
+        self._loader = ctypes.c_void_p(self._lib.loader_new())
+        self._codes = np.array(
+            [_TYPE_CODES[a.type] for a in definition.attributes], np.int32)
+        # native-dict id -> app StringDictionary id
+        self._remap = np.zeros(0, np.int64)
+
+    def __del__(self):
+        try:
+            if self._loader:
+                self._lib.loader_free(self._loader)
+        except Exception:
+            pass
+
+    def _sync_dictionary(self):
+        n = int(self._lib.loader_dict_size(self._loader))
+        if n <= len(self._remap):
+            return
+        grown = np.zeros(n, np.int64)
+        grown[: len(self._remap)] = self._remap
+        buf = ctypes.create_string_buffer(1 << 16)
+        for i in range(len(self._remap), n):
+            ln = self._lib.loader_dict_get(self._loader, i, buf, len(buf))
+            grown[i] = self.dictionary.encode(buf.raw[:ln].decode("utf-8"))
+        self._remap = grown
+
+    def parse(self, data: bytes, max_rows: Optional[int] = None
+              ) -> Tuple[Dict[str, np.ndarray], int]:
+        """-> (columns dict incl. null masks, n_rows)."""
+        attrs = self.definition.attributes
+        ncols = len(attrs)
+        if max_rows is None:
+            max_rows = data.count(b"\n") + 1
+        from siddhi_tpu.ops.types import dtype_of
+
+        natives: List[np.ndarray] = []
+        out_cols = (ctypes.c_void_p * ncols)()
+        out_masks = (ctypes.POINTER(ctypes.c_uint8) * ncols)()
+        masks: List[np.ndarray] = []
+        for c, a in enumerate(attrs):
+            code = self._codes[c]
+            arr = np.zeros(max_rows,
+                           {0: np.int64, 1: np.float64, 2: np.int64,
+                            3: np.uint8}[int(code)])
+            natives.append(arr)
+            out_cols[c] = arr.ctypes.data_as(ctypes.c_void_p)
+            mk = np.zeros(max_rows, np.uint8)
+            masks.append(mk)
+            out_masks[c] = mk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        n = int(self._lib.loader_parse_csv(
+            self._loader, data, len(data),
+            self._codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ncols, out_cols, out_masks, max_rows))
+        if n < 0:
+            raise ValueError("CSV parse failed")
+        self._sync_dictionary()
+        cols: Dict[str, np.ndarray] = {}
+        for c, a in enumerate(attrs):
+            v = natives[c][:n]
+            if a.type == AttrType.STRING:
+                v = self._remap[v]
+            elif a.type == AttrType.BOOL:
+                v = v.astype(bool)
+            else:
+                v = v.astype(dtype_of(a.type))
+            cols[a.name] = v
+            cols[a.name + "?"] = masks[c][:n].astype(bool)
+        return cols, n

@@ -1,0 +1,120 @@
+// Native columnar ingest: CSV byte buffers -> typed column arrays.
+//
+// The runtime-side analog of the reference's event construction path
+// (transport bytes -> Event objects -> per-attribute conversion): here a
+// whole buffer parses in one C++ pass directly into the columnar layout
+// the device step consumes (int64/double/int32-dict columns + null
+// masks), with string attributes dictionary-encoded against a native
+// hash map. Python touches strings only once per NEW unique (to sync the
+// app's StringDictionary), never per row.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Loader {
+    std::unordered_map<std::string, int64_t> dict;
+    std::vector<std::string> strings;   // id -> string
+
+    int64_t encode(const char* s, size_t n) {
+        std::string key(s, n);
+        auto it = dict.find(key);
+        if (it != dict.end()) return it->second;
+        int64_t id = (int64_t)strings.size();
+        dict.emplace(std::move(key), id);
+        strings.emplace_back(s, n);
+        return id;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// column type codes (mirror siddhi_tpu.ops.types)
+enum { COL_LONG = 0, COL_DOUBLE = 1, COL_STRING = 2, COL_BOOL = 3 };
+
+Loader* loader_new() { return new Loader(); }
+void loader_free(Loader* l) { delete l; }
+
+int64_t loader_dict_size(Loader* l) { return (int64_t)l->strings.size(); }
+
+// copy string `id` into out (cap bytes incl. NUL); returns its length
+int64_t loader_dict_get(Loader* l, int64_t id, char* out, int64_t cap) {
+    if (id < 0 || id >= (int64_t)l->strings.size()) return -1;
+    const std::string& s = l->strings[(size_t)id];
+    int64_t n = (int64_t)s.size();
+    if (n + 1 <= cap) {
+        std::memcpy(out, s.data(), (size_t)n);
+        out[n] = '\0';
+    }
+    return n;
+}
+
+// Parse up to max_rows CSV lines from buf[0:len).
+//   types[c]   : column type code
+//   out_cols[c]: int64* (LONG), double* (DOUBLE), int64* dict ids (STRING),
+//                uint8* (BOOL) — caller-allocated, max_rows each
+//   out_masks[c]: uint8* null mask (1 = null), max_rows each
+// Empty fields are null. Returns rows parsed (< 0 on error).
+int64_t loader_parse_csv(Loader* l, const char* buf, int64_t len,
+                         const int32_t* types, int32_t ncols,
+                         void** out_cols, uint8_t** out_masks,
+                         int64_t max_rows) {
+    int64_t row = 0;
+    int64_t i = 0;
+    while (i < len && row < max_rows) {
+        for (int32_t c = 0; c < ncols; ++c) {
+            int64_t start = i;
+            while (i < len && buf[i] != ',' && buf[i] != '\n' && buf[i] != '\r')
+                ++i;
+            int64_t n = i - start;
+            bool is_null = (n == 0);
+            out_masks[c][row] = is_null ? 1 : 0;
+            switch (types[c]) {
+                case COL_LONG: {
+                    int64_t* col = (int64_t*)out_cols[c];
+                    col[row] = is_null ? 0 : strtoll(buf + start, nullptr, 10);
+                    break;
+                }
+                case COL_DOUBLE: {
+                    double* col = (double*)out_cols[c];
+                    col[row] = is_null ? 0.0 : strtod(buf + start, nullptr);
+                    break;
+                }
+                case COL_STRING: {
+                    int64_t* col = (int64_t*)out_cols[c];
+                    col[row] = is_null ? 0 : l->encode(buf + start, (size_t)n);
+                    break;
+                }
+                case COL_BOOL: {
+                    uint8_t* col = (uint8_t*)out_cols[c];
+                    col[row] = (!is_null && (buf[start] == 't' || buf[start] == 'T' ||
+                                             buf[start] == '1'))
+                                   ? 1
+                                   : 0;
+                    break;
+                }
+                default:
+                    return -1;
+            }
+            if (i < len && buf[i] == ',') ++i;   // field separator
+        }
+        // consume the line terminator(s)
+        while (i < len && (buf[i] == '\r' || buf[i] == '\n')) {
+            if (buf[i] == '\n') { ++i; break; }
+            ++i;
+        }
+        ++row;
+    }
+    return row;
+}
+
+}  // extern "C"
