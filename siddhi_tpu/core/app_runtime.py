@@ -249,6 +249,16 @@ class SiddhiAppRuntime:
 
         pctx = PartitionContext(p_index)
         self.partition_contexts.append(pctx)
+        purge_ann = find_annotation(partition.annotations or [], "purge")
+        if purge_ann is not None and (
+            purge_ann.element("enable") or "true"
+        ).lower() == "true":
+            from siddhi_tpu.core.aggregation.incremental import _parse_time_str
+
+            interval = purge_ann.element("interval")
+            idle = purge_ann.element("idle.period")
+            pctx.purge_interval_ms = _parse_time_str(interval) if interval else 60_000
+            pctx.purge_idle_ms = _parse_time_str(idle) if idle else 3600_000
         for ptype in partition.partition_types:
             sid = ptype.stream_id
             if sid not in self.stream_definitions:
@@ -406,6 +416,8 @@ class SiddhiAppRuntime:
         else:
             self.junctions[query.input_stream.unique_stream_id].subscribe(runtime)
         self.query_runtimes[query_name] = runtime
+        if partition_ctx is not None:
+            partition_ctx.runtimes.append(runtime)
 
     # ------------------------------------------------------------- API
 
@@ -460,6 +472,11 @@ class SiddhiAppRuntime:
                         lambda ts, a=agg: a.purge(ts))
             if self.app_context.statistics_manager is not None:
                 self.app_context.statistics_manager.start_reporting(scheduler)
+            for pctx in self.partition_contexts:
+                if pctx.purge_interval_ms is not None and scheduler is not None:
+                    scheduler.schedule_periodic(
+                        pctx.purge_interval_ms,
+                        lambda ts, p=pctx: p.purge(ts))
             for tr in self.trigger_runtimes:
                 tr.start()
 

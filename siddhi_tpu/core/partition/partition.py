@@ -29,29 +29,61 @@ class PartitionKeySpace:
     """Shared partition-key dictionary: key tuple -> dense id. One per
     partition block — two streams partitioned by equal values land in the
     same partition instance (reference keys are strings compared across
-    streams)."""
+    streams). ``@purge`` retires idle ids into a free list for reuse
+    (reference PartitionRuntimeImpl idle-partition purge)."""
 
     def __init__(self):
         self._map: Dict[tuple, int] = {}
         self._reverse: List[tuple] = []
+        self._free: List[int] = []
+        self.last_seen: Dict[int, int] = {}
 
     def id_of(self, key: tuple) -> int:
-        i = self._map.setdefault(key, len(self._map))
-        if i == len(self._reverse):
-            self._reverse.append(key)
+        i = self._map.get(key)
+        if i is None:
+            if self._free:
+                i = self._free.pop()
+                self._reverse[i] = key
+            else:
+                i = len(self._reverse)
+                self._reverse.append(key)
+            self._map[key] = i
         return i
 
+    def touch(self, ids, now_ms: int):
+        for i in np.unique(np.asarray(ids)):
+            self.last_seen[int(i)] = now_ms
+
+    def purge_idle(self, now_ms: int, idle_ms: int) -> List[int]:
+        """Retire keys idle past ``idle_ms``; their dense ids go to the
+        free list (callers must reset the ids' state rows before reuse)."""
+        freed = []
+        for i, t in list(self.last_seen.items()):
+            if now_ms - t > idle_ms and i < len(self._reverse) \
+                    and self._reverse[i] is not None:
+                self._map.pop(self._reverse[i], None)
+                self._reverse[i] = None
+                self._free.append(i)
+                del self.last_seen[i]
+                freed.append(i)
+        return freed
+
     def __len__(self):
-        return len(self._map)
+        # capacity semantics: freed slots still occupy the dense range
+        return len(self._reverse)
 
     def snapshot(self) -> dict:
-        return {"map": dict(self._map)}
+        return {"map": dict(self._map), "free": list(self._free),
+                "n": len(self._reverse)}
 
     def restore(self, snap: dict):
         self._map = dict(snap["map"])
-        self._reverse = [None] * len(self._map)
+        n = snap.get("n", len(self._map))
+        self._reverse = [None] * n
         for k, i in self._map.items():
             self._reverse[i] = k
+        self._free = list(snap.get("free", []))
+        self.last_seen = {}
 
 
 class ValuePartitionKeyer:
@@ -91,6 +123,10 @@ class ValuePartitionKeyer:
             from siddhi_tpu.core.event import encode_key_tuples
 
             pk[keyed] = encode_key_tuples(vals, keyed, self._keyspace.id_of)
+            if self._keyspace.last_seen is not None:
+                import time as _time
+
+                self._keyspace.touch(pk[keyed], int(_time.time() * 1000))
         if drop.any():
             cols = dict(cols)
             cols[VALID_KEY] = valid & ~drop
@@ -163,7 +199,26 @@ class PartitionContext:
         self.keyers: Dict[str, object] = {}      # outer stream id -> keyer
         self.inner_definitions: Dict[str, object] = {}   # '#X' -> StreamDefinition
         self.inner_junctions: Dict[str, object] = {}     # '#X' -> StreamJunction
+        # @purge config + the block's query runtimes (wired by app_runtime)
+        self.purge_interval_ms: Optional[int] = None
+        self.purge_idle_ms: Optional[int] = None
+        self.runtimes: List[object] = []
 
     def num_keys(self) -> int:
         static = [k.static_keys for k in self.keyers.values() if k.static_keys]
         return max(max(static, default=0), len(self.keyspace), 1)
+
+    def purge(self, now_ms: Optional[int] = None) -> List[int]:
+        """Retire idle partition keys and reset their dense state rows in
+        every query runtime of this block (reference @purge idle-partition
+        eviction); freed ids are reused by future keys."""
+        import time as _time
+
+        if now_ms is None:
+            now_ms = int(_time.time() * 1000)
+        idle = self.purge_idle_ms if self.purge_idle_ms is not None else 3600_000
+        freed = self.keyspace.purge_idle(now_ms, idle)
+        if freed:
+            for rt in self.runtimes:
+                rt.reset_partition_keys(freed)
+        return freed

@@ -176,6 +176,52 @@ class QueryRuntime(Receiver):
 
             shard_query_step(self, self._shard_mesh)
 
+    def reset_partition_keys(self, ids):
+        """Zero the dense state rows of purged partition keys so their ids
+        can be reused by new keys (@purge — PartitionRuntimeImpl purge)."""
+        with self._lock:
+            if self._state is None:
+                return
+            idx = jnp.asarray(np.asarray(ids, np.int32))
+            state = dict(self._state)
+            if "win" in state and hasattr(self.window_stage, "reset_keys"):
+                state["win"] = self.window_stage.reset_keys(state["win"], idx)
+            for wk in ("lwin", "rwin"):     # partitioned join sides
+                side = getattr(self, "sides", {}).get(
+                    "left" if wk == "lwin" else "right") if hasattr(self, "sides") else None
+                if wk in state and side is not None and hasattr(
+                        side.window_stage, "reset_keys"):
+                    state[wk] = side.window_stage.reset_keys(state[wk], idx)
+            if "nfa" in state:
+                nfa = dict(state["nfa"])
+                for k in ("active", "consumed", "armed"):
+                    nfa[k] = nfa[k].at[idx].set(False)
+                state["nfa"] = nfa
+            if self.keyer is None:
+                # gk == pk: selector rows are addressed by partition id
+                K = self.selector_plan.num_keys
+
+                def zero_key_rows(x):
+                    if not hasattr(x, "shape"):
+                        return x
+                    for ax, s in enumerate(x.shape):
+                        if s == K:
+                            sl = [slice(None)] * x.ndim
+                            sl[ax] = idx
+                            return x.at[tuple(sl)].set(0)
+                    return x
+
+                state["sel"] = jax.tree_util.tree_map(zero_key_rows, state["sel"])
+            else:
+                # composite (pk, group) keys: drop the purged pks' entries
+                # so a reused id cannot alias old groups (their gk rows
+                # become unreachable, not recycled)
+                dead = set(int(i) for i in np.asarray(ids))
+                self.keyer._map = {k: v for k, v in self.keyer._map.items()
+                                   if int(k[0]) not in dead}
+                self.keyer._lut = np.full(64, -1, np.int32)
+            self._state = state
+
     def _make_step(self):
         return jax.jit(self.build_step_fn(), donate_argnums=0)
 

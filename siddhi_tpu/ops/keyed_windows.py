@@ -126,6 +126,12 @@ class KeyedLengthWindowStage(WindowStage):
         valid = j < jnp.minimum(state["total"], W)[:, None]
         return cols, valid
 
+    def reset_keys(self, state, ids):
+        """@purge: restart purged keys' windows (rows become unreachable
+        as soon as total is zeroed)."""
+        return {"buf": state["buf"],
+                "total": state["total"].at[ids].set(0)}
+
 
 class KeyedTimeWindowStage(WindowStage):
     """Sliding time window per partition key (live clock driven). Each key
@@ -237,6 +243,11 @@ class KeyedTimeWindowStage(WindowStage):
         live = state["total"][:, None] - exp0
         valid = ((j - exp0 % Wc) % Wc) < live
         return cols, valid
+
+    def reset_keys(self, state, ids):
+        return {"buf": state["buf"],
+                "total": state["total"].at[ids].set(0),
+                "expired_upto": state["expired_upto"].at[ids].set(0)}
 
 
 class KeyedSessionWindowStage(WindowStage):
@@ -351,6 +362,12 @@ class KeyedSessionWindowStage(WindowStage):
         jW = jnp.arange(self.capacity, dtype=jnp.int32)
         valid = jW[None, :] < state["cnt"][:, None]
         return dict(state["buf"]), valid
+
+    def reset_keys(self, state, ids):
+        return {"buf": state["buf"],
+                "cnt": state["cnt"].at[ids].set(0),
+                "last": state["last"].at[ids].set(0),
+                "sess_overflow": state["sess_overflow"]}
 
 
 def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:
