@@ -259,6 +259,7 @@ class SiddhiAppRuntime:
             idle = purge_ann.element("idle.period")
             pctx.purge_interval_ms = _parse_time_str(interval) if interval else 60_000
             pctx.purge_idle_ms = _parse_time_str(idle) if idle else 3600_000
+            pctx.keyspace.enable_purge_tracking()
         for ptype in partition.partition_types:
             sid = ptype.stream_id
             if sid not in self.stream_definitions:
@@ -476,7 +477,7 @@ class SiddhiAppRuntime:
                 if pctx.purge_interval_ms is not None and scheduler is not None:
                     scheduler.schedule_periodic(
                         pctx.purge_interval_ms,
-                        lambda ts, p=pctx: p.purge(ts))
+                        lambda _ts, p=pctx: p.purge())  # wall clock, not event time
             for tr in self.trigger_runtimes:
                 tr.start()
 

@@ -33,57 +33,86 @@ class PartitionKeySpace:
     (reference PartitionRuntimeImpl idle-partition purge)."""
 
     def __init__(self):
+        import threading
+
+        self._lock = threading.RLock()
         self._map: Dict[tuple, int] = {}
         self._reverse: List[tuple] = []
         self._free: List[int] = []
-        self.last_seen: Dict[int, int] = {}
+        # last-seen tracking is enabled only when the partition has @purge
+        # (a per-batch touch would otherwise tax every partitioned app)
+        self.last_seen: Optional[Dict[int, int]] = None
+
+    def enable_purge_tracking(self):
+        if self.last_seen is None:
+            self.last_seen = {}
 
     def id_of(self, key: tuple) -> int:
-        i = self._map.get(key)
-        if i is None:
-            if self._free:
-                i = self._free.pop()
-                self._reverse[i] = key
-            else:
-                i = len(self._reverse)
-                self._reverse.append(key)
-            self._map[key] = i
-        return i
+        with self._lock:
+            i = self._map.get(key)
+            if i is None:
+                if self._free:
+                    i = self._free.pop()
+                    self._reverse[i] = key
+                else:
+                    i = len(self._reverse)
+                    self._reverse.append(key)
+                self._map[key] = i
+            return i
 
     def touch(self, ids, now_ms: int):
-        for i in np.unique(np.asarray(ids)):
-            self.last_seen[int(i)] = now_ms
+        if self.last_seen is None:
+            return
+        with self._lock:
+            for i in np.unique(np.asarray(ids)):
+                self.last_seen[int(i)] = now_ms
 
-    def purge_idle(self, now_ms: int, idle_ms: int) -> List[int]:
-        """Retire keys idle past ``idle_ms``; their dense ids go to the
-        free list (callers must reset the ids' state rows before reuse)."""
-        freed = []
-        for i, t in list(self.last_seen.items()):
-            if now_ms - t > idle_ms and i < len(self._reverse) \
-                    and self._reverse[i] is not None:
-                self._map.pop(self._reverse[i], None)
-                self._reverse[i] = None
-                self._free.append(i)
-                del self.last_seen[i]
-                freed.append(i)
-        return freed
+    def retire_idle(self, now_ms: int, idle_ms: int) -> List[int]:
+        """Unmap keys idle past ``idle_ms``. Their ids are NOT freed yet —
+        the caller resets the ids' state rows first, then ``release``s
+        them; in between the ids are unreachable (not in the map, not in
+        the free list), so concurrent ingest cannot be wiped."""
+        if self.last_seen is None:
+            return []
+        with self._lock:
+            retired = []
+            for i, t in list(self.last_seen.items()):
+                if now_ms - t > idle_ms and i < len(self._reverse) \
+                        and self._reverse[i] is not None:
+                    self._map.pop(self._reverse[i], None)
+                    self._reverse[i] = None
+                    del self.last_seen[i]
+                    retired.append(i)
+            return retired
+
+    def release(self, ids: List[int]):
+        with self._lock:
+            self._free.extend(ids)
 
     def __len__(self):
         # capacity semantics: freed slots still occupy the dense range
         return len(self._reverse)
 
     def snapshot(self) -> dict:
-        return {"map": dict(self._map), "free": list(self._free),
-                "n": len(self._reverse)}
+        with self._lock:
+            return {"map": dict(self._map), "free": list(self._free),
+                    "n": len(self._reverse)}
 
     def restore(self, snap: dict):
-        self._map = dict(snap["map"])
-        n = snap.get("n", len(self._map))
-        self._reverse = [None] * n
-        for k, i in self._map.items():
-            self._reverse[i] = k
-        self._free = list(snap.get("free", []))
-        self.last_seen = {}
+        import time as _time
+
+        with self._lock:
+            self._map = dict(snap["map"])
+            n = snap.get("n", len(self._map))
+            self._reverse = [None] * n
+            for k, i in self._map.items():
+                self._reverse[i] = k
+            self._free = list(snap.get("free", []))
+            if self.last_seen is not None:
+                # restored keys start their idle clocks at restore time —
+                # otherwise pre-restart keys would be invisible to purge
+                now = int(_time.time() * 1000)
+                self.last_seen = {i: now for i in self._map.values()}
 
 
 class ValuePartitionKeyer:
@@ -209,16 +238,19 @@ class PartitionContext:
         return max(max(static, default=0), len(self.keyspace), 1)
 
     def purge(self, now_ms: Optional[int] = None) -> List[int]:
-        """Retire idle partition keys and reset their dense state rows in
-        every query runtime of this block (reference @purge idle-partition
-        eviction); freed ids are reused by future keys."""
+        """Retire idle partition keys, reset their dense state rows in
+        every query runtime of this block, then release the ids for reuse
+        (reference @purge idle-partition eviction). Idle comparison uses
+        WALL clock (touch() stamps wall time) — the scheduler's event-time
+        tick value is ignored on purpose (playback apps mix clocks)."""
         import time as _time
 
         if now_ms is None:
             now_ms = int(_time.time() * 1000)
         idle = self.purge_idle_ms if self.purge_idle_ms is not None else 3600_000
-        freed = self.keyspace.purge_idle(now_ms, idle)
-        if freed:
+        retired = self.keyspace.retire_idle(now_ms, idle)
+        if retired:
             for rt in self.runtimes:
-                rt.reset_partition_keys(freed)
-        return freed
+                rt.reset_partition_keys(retired)
+            self.keyspace.release(retired)
+        return retired

@@ -34,12 +34,21 @@ class GroupKeyer:
     def __init__(self, fns: List[Tuple[Callable, AttrType]]):
         self._fns = fns
         self._map: Dict[tuple, int] = {}
+        self._next = 0   # ids are NEVER reused (purged entries leave holes)
         # fast path: single string attribute -> LUT from dict id to key id
         self._single_string = len(fns) == 1 and fns[0][1] == AttrType.STRING
         self._lut = np.full(64, -1, np.int32)
 
+    def _alloc(self, key: tuple) -> int:
+        i = self._map.get(key)
+        if i is None:
+            i = self._map[key] = self._next
+            self._next += 1
+        return i
+
     def __len__(self):
-        return len(self._map)
+        # dense capacity: holes from purged entries still occupy the range
+        return self._next
 
     def __call__(self, cols: Dict[str, np.ndarray], pk: Optional[np.ndarray] = None) -> np.ndarray:
         """Group ids for a batch; when ``pk`` is given the dictionary key is
@@ -59,7 +68,7 @@ class GroupKeyer:
                 self._lut = grown
             for sid in np.unique(ids[valid]):
                 if self._lut[sid] < 0:
-                    self._lut[sid] = self._map.setdefault((int(sid),), len(self._map))
+                    self._lut[sid] = self._alloc((int(sid),))
             np.take(self._lut, ids, out=gk)
             gk[~valid] = 0
             return gk
@@ -77,8 +86,7 @@ class GroupKeyer:
         vidx = np.nonzero(valid)[0]
         if vidx.size == 0:
             return gk
-        gk[vidx] = encode_key_tuples(
-            arrays, vidx, lambda key: self._map.setdefault(key, len(self._map)))
+        gk[vidx] = encode_key_tuples(arrays, vidx, self._alloc)
         return gk
 
 
@@ -198,20 +206,27 @@ class QueryRuntime(Receiver):
                     nfa[k] = nfa[k].at[idx].set(False)
                 state["nfa"] = nfa
             if self.keyer is None:
-                # gk == pk: selector rows are addressed by partition id
+                # gk == pk: selector rows are addressed by partition id.
+                # Rows reset to the aggregator INIT values (min/max keep
+                # their +/-inf sentinels), gathered from a fresh state.
+                # Key axis = first axis sized num_keys (the same heuristic
+                # parallel/mesh.py shards by).
                 K = self.selector_plan.num_keys
+                init = self.selector_plan.init_state()
 
-                def zero_key_rows(x):
+                def reset_key_rows(x, x0):
                     if not hasattr(x, "shape"):
                         return x
                     for ax, s in enumerate(x.shape):
                         if s == K:
                             sl = [slice(None)] * x.ndim
                             sl[ax] = idx
-                            return x.at[tuple(sl)].set(0)
+                            return x.at[tuple(sl)].set(
+                                jnp.asarray(x0)[tuple(sl)])
                     return x
 
-                state["sel"] = jax.tree_util.tree_map(zero_key_rows, state["sel"])
+                state["sel"] = jax.tree_util.tree_map(
+                    reset_key_rows, state["sel"], init)
             else:
                 # composite (pk, group) keys: drop the purged pks' entries
                 # so a reused id cannot alias old groups (their gk rows
@@ -220,6 +235,8 @@ class QueryRuntime(Receiver):
                 self.keyer._map = {k: v for k, v in self.keyer._map.items()
                                    if int(k[0]) not in dead}
                 self.keyer._lut = np.full(64, -1, np.int32)
+                # _next is untouched: gk ids are never reused, so a fresh
+                # (pk, group) key can never alias a surviving group's row
             self._state = state
 
     def _make_step(self):

@@ -185,6 +185,7 @@ class SnapshotService:
                 q._state = _to_device(qsnap["state"]) if qsnap["state"] is not None else None
                 if q.keyer is not None and qsnap["keyer_map"] is not None:
                     q.keyer._map = dict(qsnap["keyer_map"])
+                    q.keyer._next = max(q.keyer._map.values(), default=-1) + 1
                     q.keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
                 if q.host_window is not None and qsnap.get("host_window") is not None:
                     q.host_window.restore(qsnap["host_window"])
