@@ -1,0 +1,1 @@
+from siddhi_tpu.service.rest import SiddhiRestService  # noqa: F401

@@ -1,0 +1,161 @@
+"""REST service: HTTP surface over SiddhiManager.
+
+Mirror of the reference runner's HTTP APIs
+(``siddhi-service``/runner: deploy apps, inject events, run on-demand
+queries, snapshot state, read metrics) on the standard-library HTTP
+server — no framework dependency, one daemon thread.
+
+Endpoints (JSON in/out):
+
+- ``GET  /apps``                       — deployed app names
+- ``POST /apps``                       — body = SiddhiQL app text (deploy + start)
+- ``DELETE /apps/<name>``              — shutdown + undeploy
+- ``POST /apps/<name>/events``         — ``{"stream": S, "data": [...] | [[...], ...], "timestamp": optional}``
+- ``POST /query``                      — ``{"app": name, "query": "<on-demand query>"}`` -> rows
+- ``GET  /apps/<name>/statistics``     — metrics snapshot
+- ``POST /apps/<name>/persist``        — checkpoint; -> ``{"revision": ...}``
+- ``POST /apps/<name>/restore``        — ``{"revision": optional}`` (last when omitted)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class SiddhiRestService:
+    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0):
+        self.manager = manager
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):   # quiet
+                pass
+
+            def _send(self, code: int, obj):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                ctype = self.headers.get("Content-Type", "")
+                if "json" in ctype and raw:
+                    return json.loads(raw)
+                return raw.decode("utf-8")
+
+            def do_GET(self):
+                try:
+                    service._get(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    service._post(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(400, {"error": str(e)})
+
+            def do_DELETE(self):
+                try:
+                    service._delete(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(400, {"error": str(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="siddhi-rest")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------ handlers
+
+    def _rt(self, name: str):
+        rt = self.manager.get_siddhi_app_runtime(name)
+        if rt is None:
+            raise KeyError(f"app '{name}' is not deployed")
+        return rt
+
+    def _get(self, h):
+        parts = [p for p in h.path.split("/") if p]
+        if parts == ["apps"]:
+            h._send(200, {"apps": sorted(self.manager.app_runtimes)})
+            return
+        if len(parts) == 3 and parts[0] == "apps" and parts[2] == "statistics":
+            h._send(200, self._rt(parts[1]).statistics())
+            return
+        h._send(404, {"error": f"unknown path {h.path}"})
+
+    def _post(self, h):
+        parts = [p for p in h.path.split("/") if p]
+        body = h._body()
+        if parts == ["apps"]:
+            if not isinstance(body, str) or not body.strip():
+                raise ValueError("POST /apps expects SiddhiQL app text")
+            rt = self.manager.create_siddhi_app_runtime(body)
+            rt.start()
+            h._send(201, {"app": rt.name})
+            return
+        if parts == ["query"]:
+            rt = self._rt(body["app"])
+            events = rt.query(body["query"])
+            h._send(200, {"rows": [list(e.data) for e in events]})
+            return
+        if len(parts) == 3 and parts[0] == "apps":
+            rt = self._rt(parts[1])
+            if parts[2] == "events":
+                stream = body["stream"]
+                data = body["data"]
+                ts = body.get("timestamp")
+                rows = data if data and isinstance(data[0], list) else [data]
+                handler = rt.get_input_handler(stream)
+                for row in rows:
+                    if ts is None:
+                        handler.send(row)
+                    else:
+                        handler.send(int(ts), row)
+                h._send(200, {"accepted": len(rows)})
+                return
+            if parts[2] == "persist":
+                h._send(200, {"revision": rt.persist()})
+                return
+            if parts[2] == "restore":
+                rev = body.get("revision") if isinstance(body, dict) else None
+                if rev:
+                    rt.restore_revision(rev)
+                else:
+                    rev = rt.restore_last_revision()
+                h._send(200, {"revision": rev})
+                return
+        h._send(404, {"error": f"unknown path {h.path}"})
+
+    def _delete(self, h):
+        parts = [p for p in h.path.split("/") if p]
+        if len(parts) == 2 and parts[0] == "apps":
+            rt = self._rt(parts[1])
+            rt.shutdown()
+            del self.manager.app_runtimes[parts[1]]
+            h._send(200, {"removed": parts[1]})
+            return
+        h._send(404, {"error": f"unknown path {h.path}"})

@@ -1,0 +1,79 @@
+"""REST service + doc generator tests (reference siddhi-service HTTP
+surface / siddhi-doc-gen)."""
+
+import json
+import urllib.request
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+from siddhi_tpu.service import SiddhiRestService
+from siddhi_tpu.utils.docgen import generate_docs
+
+
+def _req(port, method, path, body=None, as_json=True):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None
+    headers = {}
+    if body is not None:
+        if as_json:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        else:
+            data = body.encode()
+            headers["Content-Type"] = "text/plain"
+    req = urllib.request.Request(url, data=data, method=method, headers=headers)
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_rest_service_lifecycle():
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    svc = SiddhiRestService(m).start()
+    p = svc.port
+    try:
+        app = """
+        @app:name('RestApp')
+        @app:statistics('true')
+        define stream S (sym string, price double);
+        define table T (sym string, price double);
+        from S[price > 10.0] insert into T;
+        """
+        got = _req(p, "POST", "/apps", app, as_json=False)
+        assert got == {"app": "RestApp"}
+        assert _req(p, "GET", "/apps")["apps"] == ["RestApp"]
+
+        _req(p, "POST", "/apps/RestApp/events",
+             {"stream": "S", "data": [["IBM", 55.5], ["X", 1.0]]})
+        rows = _req(p, "POST", "/query",
+                    {"app": "RestApp",
+                     "query": "from T select sym, price return;"})["rows"]
+        assert rows == [["IBM", 55.5]]
+
+        stats = _req(p, "GET", "/apps/RestApp/statistics")
+        assert stats["throughput"]["S"]["events"] == 2
+
+        rev = _req(p, "POST", "/apps/RestApp/persist")["revision"]
+        assert rev
+        got = _req(p, "POST", "/apps/RestApp/restore", {})
+        assert got["revision"] == rev
+
+        assert _req(p, "DELETE", "/apps/RestApp") == {"removed": "RestApp"}
+        assert _req(p, "GET", "/apps")["apps"] == []
+    finally:
+        svc.stop()
+        m.shutdown()
+
+
+def test_doc_generator():
+    m = SiddhiManager()
+
+    class MyFn:
+        """Doubles a value."""
+
+    m.set_extension("function:double", MyFn)
+    md = generate_docs(m)
+    assert "## Windows (device)" in md
+    assert "`hopping(windowT, hopT)`" in md
+    assert "distinctCount" in md
+    assert "`function:double` (MyFn) — Doubles a value." in md
