@@ -29,8 +29,10 @@ from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY, Comp
 from siddhi_tpu.ops.windows import (
     CURRENT,
     EXPIRED,
+    FLUSH_KEY,
     NOTIFY_KEY,
     OVERFLOW_KEY,
+    RESET,
     WindowStage,
     _BIG,
     _data_keys,
@@ -250,6 +252,145 @@ class KeyedTimeWindowStage(WindowStage):
                 "expired_upto": state["expired_upto"].at[ids].set(0)}
 
 
+class KeyedLengthBatchWindowStage(WindowStage):
+    """Tumbling count batches per partition key (reference
+    LengthBatchWindowProcessor applied per key): key k's Nth arrival
+    flushes [EXPIRED(previous batch), RESET, CURRENT(batch)]. A chunk can
+    complete several batches for one key — emission rows gather from the
+    stored partial ring, the stored previous batch, or earlier rows of
+    the same chunk by absolute per-key sequence number."""
+
+    keyed = True
+    batch_mode = True
+
+    def __init__(self, length: int, col_specs: Dict[str, np.dtype]):
+        if length <= 0:
+            raise CompileError("lengthBatch window needs a positive length")
+        self.length = length
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        N = self.length
+        K = num_keys
+        zero = lambda: {k: jnp.zeros((K, N), dt)                  # noqa: E731
+                        for k, dt in self.col_specs.items()}
+        return {"cur": zero(), "prev": zero(),
+                "cnt": jnp.zeros((K,), jnp.int64),      # total arrivals ever
+                "prev_full": jnp.zeros((K,), bool)}     # prev batch exists
+
+    def apply(self, state, cols, ctx):
+        N = self.length
+        K = state["cnt"].shape[0]
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        pk = jnp.clip(cols[PK_KEY].astype(jnp.int64), 0, K - 1)
+        jN = jnp.arange(N, dtype=jnp.int64)
+
+        order, _inv, occ, counts, start_pos = _per_key_layout(pk, valid_cur, K)
+        cnt0 = state["cnt"][pk]                  # [B] prior arrivals of row's key
+        seq = cnt0 + occ                         # absolute per-key sequence
+        flush = valid_cur & ((seq + 1) % N == 0)
+
+        def gather(q):
+            """[B, N] rows at absolute positions q[b, j] of row b's key:
+            from this chunk, the stored partial ring, or the stored
+            previous batch (negative q = invalid)."""
+            from_chunk = q >= cnt0[:, None]
+            chunk_pos = jnp.clip(start_pos[:, None] + (q - cnt0[:, None]), 0, B - 1)
+            chunk_row = order[chunk_pos]
+            part_start = cnt0 - cnt0 % N         # partial batch's first seq
+            in_ring = (~from_chunk) & (q >= part_start[:, None])
+            slot = (q % N).astype(jnp.int32)
+            outr = {}
+            for k in keys:
+                ring_v = state["cur"][k][pk[:, None], slot]
+                prev_v = state["prev"][k][pk[:, None], slot]
+                v = jnp.where(from_chunk, cols[k][chunk_row],
+                              jnp.where(in_ring, ring_v, prev_v))
+                outr[k] = v
+            return outr
+
+        # batch being completed by a flush row at seq s: positions s+1-N..s
+        cur_q = (seq[:, None] - (N - 1)) + jN[None, :]
+        cur_rows = gather(cur_q)
+        # the batch before it: positions s+1-2N..s-N (may be the stored prev)
+        prev_q = cur_q - N
+        prev_rows = gather(prev_q)
+        # a previous batch exists if those positions are >= 0 AND (they come
+        # from this chunk/ring, or the stored prev batch exists)
+        prev_from_store = prev_q[:, 0] < (cnt0 - cnt0 % N)
+        has_prev = flush & (prev_q[:, 0] >= 0) & (
+            ~prev_from_store | state["prev_full"][pk])
+
+        # ordering: per flush row r: N expired, 1 reset, N current
+        idx = jnp.arange(B, dtype=jnp.int64)
+        STRIDE = jnp.int64(2 * N + 1)
+        exp_okey = (idx[:, None] * STRIDE + jN[None, :]).reshape(B * N)
+        reset_okey = idx * STRIDE + N
+        cur_okey = (idx[:, None] * STRIDE + N + 1 + jN[None, :]).reshape(B * N)
+
+        exp_emit = {k: v.reshape(B * N) for k, v in prev_rows.items()}
+        exp_emit[TS_KEY] = jnp.where(
+            (has_prev[:, None] & jnp.ones((B, N), bool)).reshape(B * N),
+            now, exp_emit[TS_KEY])
+        cur_emit = {k: v.reshape(B * N) for k, v in cur_rows.items()}
+        reset_rows = {k: jnp.zeros((B,), v.dtype) for k, v in cols.items()
+                      if k in keys}
+        reset_rows[TS_KEY] = jnp.broadcast_to(now, (B,))
+
+        parts = [
+            (exp_emit, jnp.full((B * N,), EXPIRED, jnp.int8),
+             (has_prev[:, None] & jnp.ones((B, N), bool)).reshape(B * N), exp_okey),
+            (reset_rows, jnp.full((B,), RESET, jnp.int8), has_prev, reset_okey),
+            (cur_emit, jnp.full((B * N,), CURRENT, jnp.int8),
+             (flush[:, None] & jnp.ones((B, N), bool)).reshape(B * N), cur_okey),
+        ]
+        out, _ = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
+
+        # ---- state update
+        new_cnt = state["cnt"] + counts
+        # cur ring: rows with seq >= floorN(new_cnt) of their key
+        part_start_new = (new_cnt - new_cnt % N)[pk]
+        keep = valid_cur & (seq >= part_start_new)
+        kslot = jnp.where(keep, (seq % N).astype(jnp.int64), jnp.int64(N))
+        kpk = jnp.where(keep, pk, K)
+        new_cur = {k: state["cur"][k].at[kpk, kslot].set(cols[k], mode="drop")
+                   for k in state["cur"]}
+        # prev batch: the last completed batch — rows with seq in
+        # [floorN(new_cnt)-N, floorN(new_cnt)) that arrived this chunk;
+        # keys that flushed at least once get a full new prev
+        flushed_key = jnp.zeros((K + 1,), bool).at[
+            jnp.where(flush, pk, K)].set(True, mode="drop")[:K]
+        pstart = part_start_new - N
+        in_prev = valid_cur & (seq >= pstart) & (seq < part_start_new)
+        ppk = jnp.where(in_prev, pk, K)
+        pslot = jnp.where(in_prev, (seq % N).astype(jnp.int64), jnp.int64(N))
+        new_prev = {}
+        for k in state["prev"]:
+            # keys that flushed: batch rows may ALSO come from the old cur
+            # ring (batch started before this chunk)
+            base = jnp.where(flushed_key[:, None], state["cur"][k],
+                             state["prev"][k])
+            new_prev[k] = base.at[ppk, pslot].set(cols[k], mode="drop")
+        new_prev_full = state["prev_full"] | flushed_key
+        return {"cur": new_cur, "prev": new_prev, "cnt": new_cnt,
+                "prev_full": new_prev_full}, out
+
+    def contents(self, state):
+        N = self.length
+        part = (state["cnt"] % N)[:, None]
+        valid = jnp.arange(N, dtype=jnp.int64)[None, :] < part
+        return dict(state["cur"]), valid
+
+    def reset_keys(self, state, ids):
+        return {"cur": state["cur"], "prev": state["prev"],
+                "cnt": state["cnt"].at[ids].set(0),
+                "prev_full": state["prev_full"].at[ids].set(False)}
+
+
 class KeyedSessionWindowStage(WindowStage):
     """``session(gap)`` over dense per-key state — the shape the host
     SessionWindowStage keeps in a Python dict, inverted to ``[K, W]``
@@ -384,10 +525,13 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
         return KeyedLengthWindowStage(int(_const_param(window, 0, "length")), col_specs)
     if name == "time":
         return KeyedTimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
+    if name == "lengthbatch":
+        return KeyedLengthBatchWindowStage(
+            int(_const_param(window, 0, "length")), col_specs)
     if name == "session":
         return KeyedSessionWindowStage(int(_const_param(window, 0, "gap")),
                                        col_specs, capacity)
     raise CompileError(
         f"window '{window.name}' inside a partition is not implemented yet "
-        f"(keyed variants exist for: length, time, session)"
+        f"(keyed variants exist for: length, lengthBatch, time, session)"
     )

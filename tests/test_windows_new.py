@@ -177,3 +177,60 @@ def test_keyed_session_timer_sweep():
     exp = sorted(tuple(e.data) for e in c.expired)
     m.shutdown()
     assert ("p1", 1) in exp and ("p2", 2) in exp
+
+
+def test_keyed_length_batch_in_partition():
+    m, rt, c = build_q("""
+        define stream S (k string, v int);
+        partition with (k of S)
+        begin
+          @info(name='q')
+          from S#window.lengthBatch(3)
+          select k, v insert all events into OutStream;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    for v in (1, 2):
+        h.send(["p1", v])
+    h.send(["p2", 10])
+    assert c.events == []          # no key completed a batch yet
+    h.send(["p1", 3])              # p1's 3rd event: flush {1,2,3}
+    got1 = [tuple(e.data) for e in c.events]
+    for v in (4, 5, 6):
+        h.send(["p1", v])          # second p1 batch: prev {1,2,3} expires
+    got2 = [tuple(e.data) for e in c.events]
+    exp2 = [tuple(e.data) for e in c.expired]
+    h.send(["p2", 11]); h.send(["p2", 12])   # p2 completes independently
+    got3 = [tuple(e.data) for e in c.events]
+    m.shutdown()
+    assert got1 == [("p1", 1), ("p1", 2), ("p1", 3)]
+    assert got2 == got1 + [("p1", 4), ("p1", 5), ("p1", 6)]
+    assert exp2 == [("p1", 1), ("p1", 2), ("p1", 3)]
+    assert got3 == got2 + [("p2", 10), ("p2", 11), ("p2", 12)]
+
+
+def test_keyed_length_batch_multiple_flushes_one_chunk():
+    import numpy as np
+
+    m, rt, c = build_q("""
+        define stream S (k string, v int);
+        partition with (k of S)
+        begin
+          @info(name='q')
+          from S#window.lengthBatch(2)
+          select k, v insert all events into OutStream;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    # one columnar chunk completing TWO batches for p1 and one for p2
+    h.send_columns(
+        {"k": np.array(["p1", "p1", "p2", "p1", "p1", "p2"], dtype=object),
+         "v": np.array([1, 2, 9, 3, 4, 8], np.int32)},
+        timestamps=np.arange(6, dtype=np.int64))
+    cur = [tuple(e.data) for e in c.events]
+    exp = [tuple(e.data) for e in c.expired]
+    m.shutdown()
+    assert cur == [("p1", 1), ("p1", 2), ("p1", 3), ("p1", 4),
+                   ("p2", 9), ("p2", 8)]
+    # p1's second flush expires its first batch, all inside the chunk
+    assert exp == [("p1", 1), ("p1", 2)]
