@@ -115,6 +115,7 @@ class CaptureSpec:
     definition: StreamDefinition
     is_count: bool = False
     n_idx: int = 0               # indexed slots kept (max referenced idx + 1)
+    last_offsets: set = field(default_factory=set)  # e[last - k] offsets used
 
 
 @dataclass
@@ -405,16 +406,34 @@ def assign_indexed_captures(plan: NFAPlan, exprs: List) -> None:
     def visit(e):
         if not isinstance(e, Variable) or e.stream_index is None:
             return
-        if not isinstance(e.stream_index, int):
-            raise CompileError(
-                f"event index '{e.stream_index}' is not supported yet "
-                f"(only e[<int>])"
-            )
+        idx = e.stream_index
         for cap in plan.captures:
-            if e.stream_id in (cap.ref_id, cap.stream_id):
-                if cap.is_count:  # non-count refs hold a single event
-                    cap.n_idx = max(cap.n_idx, e.stream_index + 1)
+            if e.stream_id not in (cap.ref_id, cap.stream_id):
+                continue
+            if idx == "last":
+                return   # the unindexed capture IS the last event
+            if isinstance(idx, tuple) and idx[0] == "last":
+                k = -idx[1]
+                if not cap.is_count:
+                    raise CompileError(
+                        "e[last - k] needs a count capture (e<min:max>)")
+                # the k-th from the end is a runtime position: keep every
+                # indexed slot up to the step's bounded max occurrence
+                mx = _count_max_of(plan, cap)
+                if mx >= ANY_MAX:
+                    raise CompileError(
+                        "e[last - k] needs a bounded count (e<min:max>), "
+                        "not an open-ended one")
+                cap.n_idx = max(cap.n_idx, mx)
+                cap.last_offsets.add(k)
                 return
+            if not isinstance(idx, int):
+                raise CompileError(
+                    f"event index '{idx}' is not supported (e[<int>], "
+                    f"e[last], e[last - k])")
+            if cap.is_count:  # non-count refs hold a single event
+                cap.n_idx = max(cap.n_idx, idx + 1)
+            return
         raise CompileError(f"unknown pattern reference '{e.stream_id}'")
 
     for expr in exprs:
@@ -434,6 +453,18 @@ def cap_idx_col(cid: int, i: int, attr: str) -> str:
 
 def cap_cnt_col(cid: int) -> str:
     return f"c{cid}__#n"
+
+
+def cap_last_col(cid: int, k: int, attr: str) -> str:
+    return f"c{cid}L{k}__{attr}"
+
+
+def _count_max_of(plan: NFAPlan, cap: CaptureSpec) -> int:
+    for st in plan.steps:
+        for side in st.sides:
+            if side.capture is cap:
+                return st.max_count
+    return ANY_MAX
 
 
 def scope_col(g: int) -> str:
@@ -458,17 +489,24 @@ def _cap_ref(plan: NFAPlan, var: Variable) -> Optional[ColumnRef]:
     if got is None:
         return None
     cap, attr = got
-    if var.stream_index is not None:
-        if not isinstance(var.stream_index, int):
-            raise CompileError("only e[<int>] indexing is supported yet")
-        if var.stream_index >= max(cap.n_idx, 1) and cap.is_count:
+    idx = var.stream_index
+    if idx is not None:
+        if idx == "last":
+            return ColumnRef(cap_col(cap.cid, attr.name), attr.type)
+        if isinstance(idx, tuple) and idx[0] == "last":
+            # derived column materialized by the flatten stage
+            return ColumnRef(cap_last_col(cap.cid, -idx[1], attr.name), attr.type)
+        if not isinstance(idx, int):
             raise CompileError(
-                f"index {var.stream_index} out of the capture's sized range"
+                "only e[<int>], e[last], e[last - k] indexing is supported")
+        if idx >= max(cap.n_idx, 1) and cap.is_count:
+            raise CompileError(
+                f"index {idx} out of the capture's sized range"
             )
-        if not cap.is_count and var.stream_index != 0:
+        if not cap.is_count and idx != 0:
             raise CompileError("only count states capture multiple events")
         if cap.is_count:
-            return ColumnRef(cap_idx_col(cap.cid, var.stream_index, attr.name), attr.type)
+            return ColumnRef(cap_idx_col(cap.cid, idx, attr.name), attr.type)
     return ColumnRef(cap_col(cap.cid, attr.name), attr.type)
 
 
@@ -1374,6 +1412,8 @@ class NFAStage:
             out[n] = emit_CP[n].reshape(N)
             if cap.is_count:
                 out[cap_cnt_col(cap.cid)] = cnt_flat
+            _emit_last_cols(out, cap,
+                            lambda nm: emit_CP[nm].reshape(N), got, cnt_flat)
         out[VALID_KEY] = emit.reshape(N)
         out[TS_KEY] = ets.reshape(N)
         out[TYPE_KEY] = jnp.zeros(N, jnp.int8)
@@ -1408,6 +1448,8 @@ class NFAStage:
             out[n] = out_caps[n].reshape(N)
             if cap.is_count:
                 out[cap_cnt_col(cap.cid)] = cnt_flat
+            _emit_last_cols(out, cap,
+                            lambda nm: out_caps[nm].reshape(N), got, cnt_flat)
         out[VALID_KEY] = out_valid.reshape(N)
         out[TS_KEY] = out_ts.reshape(N)
         out[TYPE_KEY] = jnp.zeros(N, jnp.int8)  # matches emit as CURRENT
@@ -1415,6 +1457,31 @@ class NFAStage:
         if PK_KEY in cols:
             out[PK_KEY] = jnp.repeat(cols[PK_KEY], S + 1)
         return out
+
+
+def _emit_last_cols(out: Dict, cap: CaptureSpec, flat_of, got, cnt_flat):
+    """Materialize ``e[last - k]`` derived columns: the value at runtime
+    position cnt-1-k selected across the capture's indexed slots."""
+    if not cap.last_offsets or cnt_flat is None:
+        return
+    for k in sorted(cap.last_offsets):
+        pos = cnt_flat - 1 - k
+        for a in cap.definition.attributes:
+            acc = None
+            mk = None
+            for i in range(cap.n_idx):
+                sel = pos == i
+                v = flat_of(cap_idx_col(cap.cid, i, a.name))
+                m = flat_of(cap_idx_col(cap.cid, i, a.name) + "?")
+                # rows whose pos matches no slot keep slot 0's value but
+                # are nulled by the pos<0 / ~got mask below
+                acc = v if acc is None else jnp.where(sel, v, acc)
+                mk = m if mk is None else jnp.where(sel, m, mk)
+            if acc is None:
+                continue
+            out[cap_last_col(cap.cid, k, a.name)] = acc
+            out[cap_last_col(cap.cid, k, a.name) + "?"] = (
+                mk | ~got | (pos < 0))
 
 
 def fresh_cap_step(plan: NFAPlan, rest_step: int, bits_val: int) -> int:

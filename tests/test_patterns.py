@@ -260,3 +260,22 @@ def test_partitioned_pattern():
     m.shutdown()
     got = sorted(tuple(e.data) for e in c.events)
     assert got == [("k1", 10, 15), ("k2", 20, 25)]
+
+
+def test_count_pattern_last_indexing():
+    # e1[last] reads the final accumulated event; e1[last - 1] the one
+    # before it (reference StateEvent LAST semantics)
+    m, rt, c = build(STREAMS + """
+        from e1=Stream1[price>20] <2:4> -> e2=Stream2[price>20]
+        select e1[last].price as pl, e1[last - 1].price as pl1, e2.price as pb
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 25.5, 1])
+    s1.send(["B", 47.5, 1])
+    s1.send(["C", 48.75, 1])
+    s2.send(["X", 55.0, 1])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [(48.75, 47.5, 55.0)]
