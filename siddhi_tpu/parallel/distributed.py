@@ -1,0 +1,58 @@
+"""Multi-host initialization: the DCN-facing half of the comm backend.
+
+The reference scales out with NCCL/MPI-style transports; the TPU-native
+equivalent is ``jax.distributed``: every host runs the same program,
+``initialize_cluster`` joins them into one JAX process group, and
+``global_mesh`` spans EVERY host's devices in one 1-D key mesh. The same
+``NamedSharding``s used single-host (``parallel/mesh.py``) then shard key
+state across hosts — XLA routes collectives over ICI within a slice and
+DCN across slices; nothing else in the framework changes.
+
+Usage (identical program on each host)::
+
+    from siddhi_tpu.parallel.distributed import initialize_cluster, global_mesh
+    initialize_cluster(coordinator_address="host0:8476",
+                       num_processes=4, process_id=HOST_RANK)
+    mesh = global_mesh()
+    shard_query_step(runtime, mesh)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from siddhi_tpu.parallel.mesh import KEY_AXIS
+
+
+def initialize_cluster(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> None:
+    """Join this process into the cluster (``jax.distributed.initialize``);
+    with no arguments, cluster-environment auto-detection applies."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis_name: str = KEY_AXIS):
+    """1-D mesh over every device of every process (DCN+ICI spanning)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis_name,))
+
+
+def process_info() -> dict:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -139,3 +139,47 @@ def test_sharded_partitioned_nfa_pattern():
     m2.shutdown()
     assert len(c1.events) > 0
     assert [e.data for e in c1.events] == [e.data for e in c2.events]
+
+
+def test_distributed_single_process_cluster():
+    """jax.distributed bring-up: a 1-process cluster initializes, the
+    global mesh spans its devices, and a sharded query runs over it —
+    exercised in a subprocess (distributed init is process-global)."""
+    import subprocess
+    import sys
+
+    script = r'''
+from siddhi_tpu.parallel.mesh import force_host_devices
+force_host_devices(4)   # the axon plugin overrides JAX_PLATFORMS env
+from siddhi_tpu.parallel.distributed import (
+    global_mesh, initialize_cluster, process_info)
+initialize_cluster(coordinator_address="127.0.0.1:18476",
+                   num_processes=1, process_id=0)
+info = process_info()
+assert info["process_count"] == 1 and info["global_devices"] == 4, info
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.parallel.mesh import shard_query_step
+m = SiddhiManager()
+rt = m.create_siddhi_app_runtime("""
+    define stream S (sym string, v int);
+    @info(name='q')
+    from S select sym, sum(v) as s group by sym insert into Out;
+""")
+seen = []
+class C(StreamCallback):
+    def receive(self, events):
+        seen.extend(tuple(e.data) for e in events)
+rt.add_callback("Out", C())
+shard_query_step(rt.query_runtimes["q"], global_mesh())
+h = rt.get_input_handler("S")
+h.send(["a", 1]); h.send(["b", 2]); h.send(["a", 3])
+m.shutdown()
+assert seen == [("a", 1), ("b", 2), ("a", 4)], seen
+print("DIST_OK")
+'''
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, timeout=300,
+                       env={**__import__("os").environ,
+                            "JAX_COMPILATION_CACHE_DIR": "/root/repo/.jax_cache"})
+    assert "DIST_OK" in r.stdout, r.stderr[-2000:]
