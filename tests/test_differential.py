@@ -166,3 +166,92 @@ def test_differential_pattern_counts():
             pend = [a for a in pend if v <= a]
     assert sorted(got) == sorted(model)
     assert len(got) == len(model)
+
+
+def test_differential_length_batch():
+    rng = np.random.default_rng(4)
+    N = 5
+    sends = [(None, "S", [f"k{int(rng.integers(0, 3))}",
+                          float(int(rng.integers(1, 20)))])
+             for _ in range(123)]
+    app = f"""
+        define stream S (sym string, v double);
+        @info(name='q')
+        from S#window.lengthBatch({N})
+        select sym, v insert all events into Out;
+    """
+    got = _run_engine(app, sends)
+    # one callback chunk per flush: in-events list precedes remove-events
+    # (QueryCallback groups them; order between the lists is by-list)
+    model = []
+    buf, prev = [], []
+    for _ts, _sid, row in sends:
+        buf.append(tuple(row))
+        if len(buf) == N:
+            for r in buf:
+                model.append(("in", r))
+            for r in prev:
+                model.append(("rm", r))
+            prev, buf = buf, []
+    assert got == model
+
+
+def test_differential_window_join():
+    rng = np.random.default_rng(5)
+    sends = []
+    for i in range(120):
+        side = "L" if rng.random() < 0.5 else "R"
+        sends.append((None, side, [f"k{int(rng.integers(0, 3))}",
+                                   int(rng.integers(0, 100))]))
+    app = """
+        define stream L (sym string, v int);
+        define stream R (sym string, w int);
+        @info(name='q')
+        from L#window.length(6) join R#window.length(6)
+          on L.sym == R.sym
+        select L.v as v, R.w as w
+        insert into Out;
+    """
+    got = _run_engine(app, sends)
+    # model: arriving row joins the OTHER side's current window (post-
+    # insert of its own window); CURRENT matches only (default output)
+    lwin, rwin = collections.deque(maxlen=6), collections.deque(maxlen=6)
+    model = []
+    for _ts, side, (sym, x) in sends:
+        if side == "L":
+            lwin.append((sym, x))
+            matches = [("in", (x, w)) for (rs, w) in rwin if rs == sym]
+        else:
+            rwin.append((sym, x))
+            matches = [("in", (v, x)) for (ls, v) in lwin if ls == sym]
+        model.extend(matches)
+    assert sorted(got) == sorted(model)
+    assert len(got) == len(model)
+
+
+def test_differential_partitioned_length_window():
+    rng = np.random.default_rng(6)
+    W = 4
+    sends = [(1000 + i, "S", [f"p{int(rng.integers(0, 6))}",
+                              float(int(rng.integers(1, 30)))])
+             for i in range(300)]
+    app = f"""
+        @app:playback
+        define stream S (k string, v double);
+        partition with (k of S)
+        begin
+          @info(name='q')
+          from S#window.length({W})
+          select k, sum(v) as s insert into Out;
+        end;
+    """
+    got = _run_engine(app, sends)
+    wins = collections.defaultdict(lambda: collections.deque(maxlen=W))
+    model = []
+    for _ts, _sid, (k, v) in sends:
+        wins[k].append(v)
+        model.append(("in", (k, sum(wins[k]))))
+    assert len(got) == len(model)
+    for (gk, gv), (mk, mv) in zip(got, model):
+        assert gk == mk and gv[0] == mv[0]
+        assert gv[1] == pytest.approx(mv[1], abs=1e-6)
