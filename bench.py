@@ -118,8 +118,14 @@ def bench_e2e():
     (SimpleFilterSingleQueryPerformance.java: pump, count outputs,
     events/sec) with the framework's bulk ingestion API."""
     from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
 
     manager = SiddhiManager()
+    # batch 8 step metas into one device->host round trip (the tunnel
+    # charges ~70ms latency per pull — PERF.md); outputs drain every 8
+    # batches and at shutdown
+    manager.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.defer_meta": "8"}))
     rt = manager.create_siddhi_app_runtime(_APP)
 
     class Counter(StreamCallback):

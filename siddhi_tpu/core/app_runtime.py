@@ -104,7 +104,7 @@ class SiddhiAppRuntime:
         cm = siddhi_context.config_manager
         if cm is not None:
             for knob in ("window_capacity", "partition_window_capacity",
-                         "nfa_slots", "initial_key_capacity"):
+                         "nfa_slots", "initial_key_capacity", "defer_meta"):
                 v = cm.get_property(f"siddhi_tpu.{knob}")
                 if v is not None:
                     setattr(self.app_context, knob, int(v))
@@ -505,6 +505,9 @@ class SiddhiAppRuntime:
     setStatisticsLevel = set_statistics_level
 
     def shutdown(self):
+        for qr in self.query_runtimes.values():
+            if getattr(qr, "_deferred", None):
+                qr.flush_deferred()
         if self.app_context.statistics_manager is not None:
             self.app_context.statistics_manager.stop_reporting(
                 self.app_context.scheduler)

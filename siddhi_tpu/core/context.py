@@ -78,6 +78,11 @@ class SiddhiAppContext:
         # 32-bit on-device (TPU default — v5e emulates 64-bit in software).
         # Overridable with @app:precision('exact'|'fast').
         self.precision = _default_precision()
+        # >1: batch N step metas into ONE device->host round trip, emitting
+        # outputs (and surfacing overflow errors) up to N batches late —
+        # the tunnel charges ~70ms latency per pull (see PERF.md). Set via
+        # ConfigManager key siddhi_tpu.defer_meta.
+        self.defer_meta = 1
         # fold window evictions into invertible aggregator deltas where the
         # query shape allows (ops/fused_agg.py); off = always-generic path
         self.enable_fusion = True

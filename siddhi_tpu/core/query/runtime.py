@@ -132,6 +132,7 @@ class QueryRuntime(Receiver):
         self._sel_step = None  # split pipelines (host keyer between stages)
         self._shard_mesh = None  # set by parallel.mesh.shard_query_step
         self._lock = threading.RLock()  # per-query lock (QueryParser.java:159-215)
+        self._deferred: List = []   # queued outputs when defer_meta > 1
         self.on_error: Optional[Callable] = None
 
     # ---------------------------------------------------------------- state
@@ -399,8 +400,18 @@ class QueryRuntime(Receiver):
         # array — a single ~70ms tunnel round trip per batch
         out_host = LazyColumns(out)
         size_hint = None
-        meta = out_host.pop("__meta__", None)
+        meta = (dict.__getitem__(out_host, "__meta__")
+                if "__meta__" in out_host else None)   # raw — no pull yet
         if meta is not None:
+            defer = getattr(self.app_context, "defer_meta", 1)
+            if defer > 1 and self._defer_ok:
+                # batch N metas into ONE round trip: queue the (device)
+                # output; emission + overflow surfacing lag <= N batches
+                self._deferred.append((out_host, overflow_msg))
+                if len(self._deferred) < defer:
+                    return None
+                return self.flush_deferred()
+            dict.pop(out_host, "__meta__")
             meta = np.asarray(meta)
             overflow = int(meta[0])
             notify = int(meta[1])
@@ -431,6 +442,36 @@ class QueryRuntime(Receiver):
         if notify is not None and int(notify) >= 0:
             return int(notify)
         return None
+
+    @property
+    def _defer_ok(self) -> bool:
+        # scheduler-driven windows need their per-batch __notify__ promptly
+        return (self.host_window is None
+                and (self.window_stage is None
+                     or not getattr(self.window_stage, "needs_scheduler", False)))
+
+    def flush_deferred(self) -> Optional[int]:
+        """Drain queued outputs: pull ALL their metas in one batched round
+        trip, then emit in order (called when the defer window fills, at
+        checkpoints, and at shutdown)."""
+        with self._lock:
+            if not self._deferred:
+                return None
+            pending, self._deferred = self._deferred, []
+            metas = jax.device_get(
+                [dict.__getitem__(o, "__meta__") for o, _m in pending])
+            notify_min: Optional[int] = None
+            for (out_host, overflow_msg), meta in zip(pending, metas):
+                dict.pop(out_host, "__meta__")
+                overflow, notify, size = int(meta[0]), int(meta[1]), int(meta[2])
+                if overflow > 0:
+                    raise RuntimeError(
+                        f"query '{self.name}': {overflow_msg} before creating "
+                        f"the runtime")
+                self._emit(HostBatch(out_host, size=size))
+                if notify >= 0:
+                    notify_min = notify if notify_min is None else min(notify_min, notify)
+            return notify_min
 
     def _emit(self, out: HostBatch):
         if out.size == 0:

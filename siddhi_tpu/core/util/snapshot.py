@@ -51,6 +51,9 @@ class SnapshotService:
     def _capture_common(self) -> dict:
         rt = self.app_runtime
         dictionary = rt.app_context.string_dictionary
+        for q in rt.query_runtimes.values():
+            if getattr(q, "_deferred", None):
+                q.flush_deferred()   # un-emitted outputs must not be lost
         queries = {}
         for name, q in rt.query_runtimes.items():
             with q._lock:

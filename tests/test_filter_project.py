@@ -165,3 +165,34 @@ def test_event_order_preserved_in_batch_send():
     h.send([Event(timestamp=i, data=[i]) for i in range(20)])
     assert [e.data[0] for e in cb.events] == list(range(0, 20, 2))
     manager.shutdown()
+
+
+def test_deferred_meta_batching():
+    """siddhi_tpu.defer_meta=4: outputs queue device-side and flush as one
+    batched pull every 4 batches (and at shutdown)."""
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    manager = SiddhiManager()
+    manager.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.defer_meta": "4"}))
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S[v > 0] select sym, v insert into Out;
+    """)
+    seen = []
+
+    class C(StreamCallback):
+        def receive(self, events):
+            seen.extend(tuple(e.data) for e in events)
+
+    rt.add_callback("Out", C())
+    h = rt.get_input_handler("S")
+    for i in range(1, 4):
+        h.send(["a", i])
+    assert seen == []                     # still queued (window of 4)
+    h.send(["a", 4])                      # 4th batch: flush
+    assert seen == [("a", 1), ("a", 2), ("a", 3), ("a", 4)]
+    h.send(["b", 5])                      # queued again
+    manager.shutdown()                    # shutdown drains the tail
+    assert seen[-1] == ("b", 5)
