@@ -507,7 +507,15 @@ class SiddhiAppRuntime:
     def shutdown(self):
         for qr in self.query_runtimes.values():
             if getattr(qr, "_deferred", None):
-                qr.flush_deferred()
+                try:
+                    qr.flush_deferred()
+                except RuntimeError:
+                    # deferred overflow error must not abort teardown —
+                    # outputs were drained before the raise
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "deferred flush failed during shutdown")
         if self.app_context.statistics_manager is not None:
             self.app_context.statistics_manager.stop_reporting(
                 self.app_context.scheduler)

@@ -360,6 +360,12 @@ class JoinQueryRuntime(QueryRuntime):
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self._timer_cbs[side_key])
 
+    @property
+    def _defer_ok(self) -> bool:
+        # per-side scheduler windows need their __notify__ promptly, and
+        # notify values are per SIDE — never defer join metas
+        return False
+
     def _finish_device_batch(self, step, cols, overflow_msg):
         if self.keyer is None:
             return super()._finish_device_batch(step, cols, overflow_msg)

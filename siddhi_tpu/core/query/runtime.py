@@ -407,6 +407,12 @@ class QueryRuntime(Receiver):
             if defer > 1 and self._defer_ok:
                 # batch N metas into ONE round trip: queue the (device)
                 # output; emission + overflow surfacing lag <= N batches
+                if t0 is not None:
+                    import time as _time
+
+                    # dispatch-side latency only (emission is deferred)
+                    sm.latency_tracker(self.name).record(
+                        (_time.perf_counter() - t0) * 1000.0)
                 self._deferred.append((out_host, overflow_msg))
                 if len(self._deferred) < defer:
                     return None
@@ -461,16 +467,19 @@ class QueryRuntime(Receiver):
             metas = jax.device_get(
                 [dict.__getitem__(o, "__meta__") for o, _m in pending])
             notify_min: Optional[int] = None
+            overflow_err: Optional[str] = None
             for (out_host, overflow_msg), meta in zip(pending, metas):
                 dict.pop(out_host, "__meta__")
                 overflow, notify, size = int(meta[0]), int(meta[1]), int(meta[2])
-                if overflow > 0:
-                    raise RuntimeError(
-                        f"query '{self.name}': {overflow_msg} before creating "
-                        f"the runtime")
+                if overflow > 0 and overflow_err is None:
+                    overflow_err = overflow_msg   # raise AFTER draining all
                 self._emit(HostBatch(out_host, size=size))
                 if notify >= 0:
                     notify_min = notify if notify_min is None else min(notify_min, notify)
+            if overflow_err is not None:
+                raise RuntimeError(
+                    f"query '{self.name}': {overflow_err} before creating "
+                    f"the runtime")
             return notify_min
 
     def _emit(self, out: HostBatch):

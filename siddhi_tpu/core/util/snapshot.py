@@ -183,6 +183,8 @@ class SnapshotService:
             if q is None:
                 raise ValueError(f"snapshot has unknown query '{name}'")
             with q._lock:
+                q._deferred = []   # pre-restore outputs belong to the
+                #                    rolled-back timeline — discard
                 q.selector_plan.num_keys = qsnap["sel_keys"]
                 q._win_keys = qsnap["win_keys"]
                 q._state = _to_device(qsnap["state"]) if qsnap["state"] is not None else None
