@@ -255,3 +255,79 @@ def test_differential_partitioned_length_window():
     for (gk, gv), (mk, mv) in zip(got, model):
         assert gk == mk and gv[0] == mv[0]
         assert gv[1] == pytest.approx(mv[1], abs=1e-6)
+
+
+def test_differential_session_window():
+    rng = np.random.default_rng(7)
+    GAP = 300
+    ts = 1000
+    sends = []
+    for _ in range(160):
+        ts += int(rng.integers(0, 250))
+        sends.append((ts, "S", [f"u{int(rng.integers(0, 4))}",
+                                int(rng.integers(1, 9))]))
+    app = f"""
+        @app:playback
+        define stream S (user string, v int);
+        @info(name='q')
+        from S#window.session({GAP} milliseconds, user)
+        select user, v insert all events into Out;
+    """
+    got = _run_engine(app, sends)
+    # model: CURRENT on arrival; a user's session expires as one chunk
+    # when the clock passes last+GAP (timers fire before the advancing
+    # event in playback)
+    sessions = {}
+    model = []
+    for ts_i, _sid, (u, v) in sends:
+        for uu in list(sessions):
+            last, rows = sessions[uu]
+            if last + GAP <= ts_i:
+                for r in rows:
+                    model.append(("rm", r))
+                del sessions[uu]
+        model.append(("in", (u, v)))
+        last, rows = sessions.get(u, (0, []))
+        rows.append((u, v))
+        sessions[u] = (ts_i, rows)
+    assert got[: len(model)] == model
+
+
+def test_differential_absent_pattern_timer():
+    rng = np.random.default_rng(8)
+    WAIT = 400
+    ts = 1000
+    sends = []
+    for _ in range(120):
+        ts += int(rng.integers(50, 300))
+        if rng.random() < 0.55:
+            sends.append((ts, "A", [int(rng.integers(0, 100))]))
+        else:
+            sends.append((ts, "B", [int(rng.integers(0, 100))]))
+    app = f"""
+        @app:playback
+        define stream A (v int);
+        define stream B (v int);
+        @info(name='q')
+        from every a=A -> not B for {WAIT} milliseconds
+        select a.v as av
+        insert into Out;
+    """
+    got = _run_engine(app, sends)
+    # model: each A arms a deadline; a B before it cancels ALL pending
+    # waits; the deadline passing (timers fire on clock advance) emits
+    pending = []   # (deadline, av)
+    model = []
+    for ts_i, sid, (v,) in sends:
+        still = []
+        for dl, av in pending:
+            if dl <= ts_i:
+                model.append(("in", (av,)))
+            else:
+                still.append((dl, av))
+        pending = still
+        if sid == "A":
+            pending.append((ts_i + WAIT, v))
+        else:
+            pending = []          # violation kills every pending wait
+    assert got[: len(model)] == model
