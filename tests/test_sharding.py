@@ -183,3 +183,44 @@ print("DIST_OK")
                        env={**__import__("os").environ,
                             "JAX_COMPILATION_CACHE_DIR": "/root/repo/.jax_cache"})
     assert "DIST_OK" in r.stdout, r.stderr[-2000:]
+
+
+def test_sharded_partitioned_absent_pattern():
+    """Absent deadlines + scheduler TIMER sweeps over key-sharded [K, S]
+    NFA state must match the unsharded run."""
+    app = """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        partition with (k of A, k of B)
+        begin
+          @info(name = 'q')
+          from every e1=A -> not B[v > e1.v] for 200 milliseconds
+          select e1.v as v1
+          insert into Out;
+        end;
+    """
+
+    def feed(rt):
+        r = np.random.default_rng(9)
+        ha = rt.get_input_handler("A")
+        hb = rt.get_input_handler("B")
+        t = 1000
+        for i in range(50):
+            k = f"P{int(r.integers(0, 12))}"
+            va = float(int(r.random() * 10))
+            ha.send(t, [k, va])
+            if i % 3 == 0:
+                hb.send(t + 50, [k, va + 1.0])   # violates that key's wait
+            t += 120   # advances past earlier deadlines -> timer sweeps
+        ha.send(t + 1000, ["PX", 0.0])           # final clock advance
+
+    m1, rt1, c1 = _build(app, "Out")
+    feed(rt1)
+    m1.shutdown()
+    m2, rt2, c2 = _build(app, "Out")
+    shard_query_step(rt2.query_runtimes["q"], make_mesh(8))
+    feed(rt2)
+    m2.shutdown()
+    assert len(c1.events) > 0
+    assert [e.data for e in c1.events] == [e.data for e in c2.events]
