@@ -466,10 +466,19 @@ class KeyedSessionWindowStage(WindowStage):
         (_r, buf, cnt, last, out_exp, exp_mask, overflow) = lax.while_loop(
             lambda c: c[0] < n_rounds, round_body, carry0)
 
-        # end-of-batch idle sweep across all keys
+        # end-of-batch idle sweep, COMPACTED: at most D due keys drain per
+        # tick (emitting [K, W] every batch would materialize K*W rows at
+        # 10k+ keys); leftovers re-arm an immediate timer and drain on the
+        # next sweep
+        D = min(128, K)
         due = (cnt > 0) & (last + gap <= now)
-        sweep_sel = due[:, None] & (jW[None, :] < cnt[:, None])   # [K, W]
-        cnt = jnp.where(due, 0, cnt)
+        korder = jnp.argsort(~due)              # due keys first, stable
+        kids = korder[:D]                       # [D] candidate key ids
+        ksel = due[kids]                        # which candidates are due
+        jD = jnp.arange(D, dtype=jnp.int64)
+        sweep_sel = ksel[:, None] & (jW[None, :] < cnt[kids][:, None])  # [D, W]
+        cnt = cnt.at[jnp.where(ksel, kids, K)].set(0, mode="drop")
+        leftover = jnp.sum(due.astype(jnp.int32)) > D
 
         # ordering: per-row [expired lane..., current], then the sweep
         idx = jnp.arange(B, dtype=jnp.int64)
@@ -480,21 +489,23 @@ class KeyedSessionWindowStage(WindowStage):
         exp_okey = (idx[:, None] * STRIDE + jW[None, :]).reshape(B * W)
         cur_okey = idx * STRIDE + W
         BASE = jnp.int64(B) * STRIDE
-        sweep_rows = {n: buf[n].reshape(K * W) for n in buf_names}
-        sweep_rows[TS_KEY] = jnp.where(sweep_sel.reshape(K * W), now,
+        sweep_rows = {n: buf[n][kids].reshape(D * W) for n in buf_names}
+        sweep_rows[TS_KEY] = jnp.where(sweep_sel.reshape(D * W), now,
                                        sweep_rows[TS_KEY])
-        sweep_okey = BASE + jnp.arange(K * W, dtype=jnp.int64)
+        sweep_okey = BASE + (jD[:, None] * W + jW[None, :]).reshape(D * W)
 
         parts = [
             (exp_rows, jnp.full((B * W,), EXPIRED, jnp.int8),
              exp_mask.reshape(B * W), exp_okey),
             ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, cur_okey),
-            (sweep_rows, jnp.full((K * W,), EXPIRED, jnp.int8),
-             sweep_sel.reshape(K * W), sweep_okey),
+            (sweep_rows, jnp.full((D * W,), EXPIRED, jnp.int8),
+             sweep_sel.reshape(D * W), sweep_okey),
         ]
         out, _ = _order_emit(parts)
         nxt = jnp.min(jnp.where(cnt > 0, last + gap, _BIG))
-        out[NOTIFY_KEY] = jnp.where(jnp.any(cnt > 0), nxt, jnp.int64(-1))
+        nxt = jnp.where(leftover, now, nxt)     # drain the backlog next tick
+        out[NOTIFY_KEY] = jnp.where(jnp.any(cnt > 0) | leftover,
+                                    nxt, jnp.int64(-1))
         out[OVERFLOW_KEY] = (overflow > state["sess_overflow"]).astype(jnp.int32)
         return {"buf": buf, "cnt": cnt, "last": last,
                 "sess_overflow": overflow}, out
