@@ -391,6 +391,133 @@ class KeyedLengthBatchWindowStage(WindowStage):
                 "prev_full": state["prev_full"].at[ids].set(False)}
 
 
+class KeyedTimeBatchWindowStage(WindowStage):
+    """Tumbling time batches per partition key (reference
+    TimeBatchWindowProcessor per partition instance): a key's first event
+    starts its boundary clock; at each elapsed boundary the key's
+    collected batch flushes [EXPIRED(prev), RESET, CURRENT(batch)].
+    Flushes are checked once per chunk against the chunk clock (arriving
+    rows join the flushing batch) and drained COMPACTED: at most D due
+    keys per tick, leftovers re-armed immediately."""
+
+    keyed = True
+    batch_mode = True
+    needs_scheduler = True
+
+    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int):
+        if time_ms <= 0:
+            raise CompileError("timeBatch window needs a positive time")
+        self.time_ms = time_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        K = num_keys
+        zero = lambda: {k: jnp.zeros((K, Wc), dt)                 # noqa: E731
+                        for k, dt in self.col_specs.items()}
+        return {"buf": zero(), "prev": zero(),
+                "cnt": jnp.zeros((K,), jnp.int32),
+                "prev_cnt": jnp.zeros((K,), jnp.int32),
+                "next_emit": jnp.zeros((K,), jnp.int64)}   # 0 = unstarted
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        K = state["cnt"].shape[0]
+        t = jnp.int64(self.time_ms)
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        pk = jnp.clip(cols[PK_KEY].astype(jnp.int64), 0, K - 1)
+        jW = jnp.arange(Wc, dtype=jnp.int32)
+
+        # ---- collect arrivals (rows join the possibly-flushing batch)
+        _o, _i, occ, counts, _s = _per_key_layout(pk, valid_cur, K)
+        slot = jnp.where(valid_cur,
+                         jnp.minimum(state["cnt"][pk] + occ.astype(jnp.int32),
+                                     Wc - 1),
+                         Wc).astype(jnp.int32)
+        kpk = jnp.where(valid_cur, pk, K)
+        buf = {k: state["buf"][k].at[kpk, slot].set(cols[k], mode="drop")
+               for k in state["buf"]}
+        overflow_now = state["cnt"] + counts.astype(jnp.int32)
+        cnt = jnp.minimum(overflow_now, Wc)
+        # first arrival starts the key's boundary clock
+        started0 = state["next_emit"] > 0
+        has_arrival = counts > 0
+        next_emit = jnp.where(~started0 & has_arrival, now + t,
+                              state["next_emit"])
+
+        # ---- compacted flush of due keys
+        D = min(64, K)
+        due = (next_emit > 0) & (now >= next_emit) \
+            & ((cnt > 0) | (state["prev_cnt"] > 0))
+        korder = jnp.argsort(~due)
+        kids = korder[:D]
+        ksel = due[kids]
+        jD = jnp.arange(D, dtype=jnp.int64)
+        cur_sel = ksel[:, None] & (jW[None, :] < cnt[kids][:, None])
+        prev_sel = ksel[:, None] & (jW[None, :] < state["prev_cnt"][kids][:, None])
+        leftover = jnp.sum(due.astype(jnp.int32)) > D
+
+        STRIDE = jnp.int64(2 * Wc + 1)
+        prev_rows = {k: state["prev"][k][kids].reshape(D * Wc)
+                     for k in state["prev"]}
+        prev_rows[TS_KEY] = jnp.where(prev_sel.reshape(D * Wc), now,
+                                      prev_rows[TS_KEY])
+        cur_rows = {k: buf[k][kids].reshape(D * Wc) for k in buf}
+        reset_rows = {k: jnp.zeros((D,), v.dtype)
+                      for k, v in cur_rows.items()}
+        reset_rows[TS_KEY] = jnp.broadcast_to(now, (D,))
+        jwl = jnp.broadcast_to(jW.astype(jnp.int64)[None, :], (D, Wc))
+        parts = [
+            (prev_rows, jnp.full((D * Wc,), EXPIRED, jnp.int8),
+             prev_sel.reshape(D * Wc),
+             (jD[:, None] * STRIDE + jwl).reshape(D * Wc)),
+            (reset_rows, jnp.full((D,), RESET, jnp.int8),
+             ksel & (cnt[kids] > 0) & (state["prev_cnt"][kids] > 0),
+             jD * STRIDE + Wc),
+            (cur_rows, jnp.full((D * Wc,), CURRENT, jnp.int8),
+             cur_sel.reshape(D * Wc),
+             (jD[:, None] * STRIDE + Wc + 1 + jwl).reshape(D * Wc)),
+        ]
+        out, _ = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
+
+        # flushed keys: cur -> prev, roll the boundary past `now`
+        fsel = jnp.zeros((K,), bool).at[jnp.where(ksel, kids, K)].set(
+            True, mode="drop")
+        new_prev = {k: jnp.where(fsel[:, None], buf[k], state["prev"][k])
+                    for k in state["prev"]}
+        new_prev_cnt = jnp.where(fsel, cnt, state["prev_cnt"])
+        new_cnt = jnp.where(fsel, 0, cnt)
+        rolled = now - ((now - next_emit) % t) + t
+        new_next = jnp.where(fsel, rolled, next_emit)
+
+        out[OVERFLOW_KEY] = jnp.any(overflow_now > Wc).astype(jnp.int32)
+        started = new_next > 0
+        nxt = jnp.min(jnp.where(started & ((new_cnt > 0) | (new_prev_cnt > 0)),
+                                new_next, _BIG))
+        nxt = jnp.where(leftover, now, nxt)
+        out[NOTIFY_KEY] = jnp.where(
+            jnp.any(started & ((new_cnt > 0) | (new_prev_cnt > 0))) | leftover,
+            nxt, jnp.int64(-1))
+        return {"buf": buf, "prev": new_prev, "cnt": new_cnt,
+                "prev_cnt": new_prev_cnt, "next_emit": new_next}, out
+
+    def contents(self, state):
+        valid = (jnp.arange(self.capacity, dtype=jnp.int32)[None, :]
+                 < state["cnt"][:, None])
+        return dict(state["buf"]), valid
+
+    def reset_keys(self, state, ids):
+        return {"buf": state["buf"], "prev": state["prev"],
+                "cnt": state["cnt"].at[ids].set(0),
+                "prev_cnt": state["prev_cnt"].at[ids].set(0),
+                "next_emit": state["next_emit"].at[ids].set(0)}
+
+
 class KeyedSessionWindowStage(WindowStage):
     """``session(gap)`` over dense per-key state — the shape the host
     SessionWindowStage keeps in a Python dict, inverted to ``[K, W]``
@@ -539,10 +666,13 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
     if name == "lengthbatch":
         return KeyedLengthBatchWindowStage(
             int(_const_param(window, 0, "length")), col_specs)
+    if name == "timebatch":
+        return KeyedTimeBatchWindowStage(
+            int(_const_param(window, 0, "time")), col_specs, capacity)
     if name == "session":
         return KeyedSessionWindowStage(int(_const_param(window, 0, "gap")),
                                        col_specs, capacity)
     raise CompileError(
         f"window '{window.name}' inside a partition is not implemented yet "
-        f"(keyed variants exist for: length, lengthBatch, time, session)"
+        f"(keyed variants exist for: length, lengthBatch, time, timeBatch, session)"
     )

@@ -234,3 +234,30 @@ def test_keyed_length_batch_multiple_flushes_one_chunk():
                    ("p2", 9), ("p2", 8)]
     # p1's second flush expires its first batch, all inside the chunk
     assert exp == [("p1", 1), ("p1", 2)]
+
+
+def test_keyed_time_batch_in_partition():
+    m, rt, c = build_q("""
+        @app:playback
+        define stream S (k string, v int);
+        partition with (k of S)
+        begin
+          @info(name='q')
+          from S#window.timeBatch(1 sec)
+          select k, v insert all events into OutStream;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["p1", 1])      # p1's boundary: 2000
+    h.send(1400, ["p1", 2])
+    h.send(1800, ["p2", 9])      # p2's boundary: 2800
+    assert c.events == []
+    h.send(2100, ["p1", 3])      # clock passes p1's boundary: flush {1,2}
+    got1 = [tuple(e.data) for e in c.events]
+    h.send(3300, ["p1", 4])      # p1 flush {3}; prev {1,2} expires; p2 due too
+    got2 = [tuple(e.data) for e in c.events]
+    exp2 = [tuple(e.data) for e in c.expired]
+    m.shutdown()
+    assert got1 == [("p1", 1), ("p1", 2)]
+    assert ("p1", 3) in got2 and ("p2", 9) in got2
+    assert exp2 == [("p1", 1), ("p1", 2)]
