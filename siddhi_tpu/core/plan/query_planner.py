@@ -28,6 +28,33 @@ from siddhi_tpu.query_api.execution import (
 )
 
 
+def _plan_stream_function_handler(handler, resolver, query_name, filters,
+                                  transforms, ext_def, base_def):
+    """Plan one ``#name(args)`` handler (shared by the single-stream and
+    join-side paths): returns ``(log_stage_or_None, ext_def)``. Transform
+    stages are appended to ``transforms`` in place, their output attributes
+    registered as resolver synthetics and folded into the (copy-on-write)
+    extended definition."""
+    from siddhi_tpu.ops.stream_functions import LogStage, plan_stream_function
+
+    stage = plan_stream_function(
+        handler, resolver, query_name, len(filters), len(transforms))
+    if isinstance(stage, LogStage):
+        return stage, ext_def
+    taken = {a.name for a in ext_def.attributes}
+    for a in stage.out_attrs:
+        if a.name in taken:
+            raise CompileError(
+                f"stream function '{handler.name}' output attribute "
+                f"'{a.name}' collides with an existing attribute")
+        resolver.synthetic[a.name] = a.type
+    if ext_def is base_def:
+        ext_def = StreamDefinition(base_def.id, list(base_def.attributes))
+    ext_def.attributes = ext_def.attributes + stage.out_attrs
+    transforms.append(stage)
+    return None, ext_def
+
+
 def plan_join_query(
     query: Query,
     query_name: str,
@@ -105,6 +132,8 @@ def plan_join_query(
         filters = []
         window_stage = None
         host_window = None
+        transforms = []
+        ext_sdef = sdef  # grows as stream functions append attributes
         for h in s.handlers:
             if isinstance(h, Filter):
                 if window_stage is not None:
@@ -117,9 +146,9 @@ def plan_join_query(
                     from siddhi_tpu.ops.keyed_windows import create_keyed_window_stage
 
                     window_stage = create_keyed_window_stage(
-                        h, sdef, resolver, app_context)
+                        h, ext_sdef, resolver, app_context)
                 else:
-                    window_stage = create_window_stage(h, sdef, resolver, app_context)
+                    window_stage = create_window_stage(h, ext_sdef, resolver, app_context)
                 if getattr(window_stage, "host_mode", False):
                     # sort/frequent/... run host-side; emissions trigger the
                     # join, contents() is the probe surface
@@ -127,9 +156,15 @@ def plan_join_query(
                     from siddhi_tpu.ops.windows import window_col_specs
 
                     window_stage = PassthroughWindowStage(
-                        window_col_specs(sdef), pass_expired=True)
+                        window_col_specs(ext_sdef), pass_expired=True)
             else:
-                raise CompileError(f"stream function '{h.name}' on a join side is not supported")
+                if window_stage is not None:
+                    raise CompileError(
+                        "post-window stream functions on join sides are not supported")
+                log_stage, ext_sdef = _plan_stream_function_handler(
+                    h, resolver, query_name, filters, transforms, ext_sdef, sdef)
+                if log_stage is not None:
+                    raise CompileError("#log() on a join side is not supported")
         if window_stage is None:
             if partition_ctx is not None:
                 raise CompileError(
@@ -137,7 +172,7 @@ def plan_join_query(
                     f"explicit #window on stream side '{sid}'")
             from siddhi_tpu.ops.windows import window_col_specs
 
-            window_stage = PassthroughWindowStage(window_col_specs(sdef))
+            window_stage = PassthroughWindowStage(window_col_specs(ext_sdef))
         keyer = None
         if partition_ctx is not None:
             if sid not in partition_ctx.keyers:
@@ -159,13 +194,15 @@ def plan_join_query(
             key=key,
             stream_id=sdef.id,
             ref_id=s.stream_reference_id,
-            definition=sdef,
+            definition=ext_sdef,
             window_stage=window_stage,
             filters=filters,
             triggers=triggers,
             outer=outer,
             host_window=host_window,
             keyer=keyer,
+            transforms=transforms,
+            input_definition=sdef if ext_sdef is not sdef else None,
         )
 
     left = build_side("left", join.left)
@@ -407,6 +444,9 @@ def plan_query(
     window_stage = None
     host_window = None
     batch_mode = False
+    transforms = []
+    log_stages = []
+    ext_def = input_def  # grows as stream functions append attributes
     for handler in input_stream.handlers:
         if isinstance(handler, Filter):
             if window_stage is not None or host_window is not None:
@@ -418,22 +458,29 @@ def plan_query(
             if partition_ctx is not None:
                 from siddhi_tpu.ops.keyed_windows import create_keyed_window_stage
 
-                window_stage = create_keyed_window_stage(handler, input_def, resolver, app_context)
+                window_stage = create_keyed_window_stage(handler, ext_def, resolver, app_context)
             else:
                 from siddhi_tpu.ops.windows import create_window_stage  # cycle-free
 
-                window_stage = create_window_stage(handler, input_def, resolver, app_context)
+                window_stage = create_window_stage(handler, ext_def, resolver, app_context)
             batch_mode = window_stage.batch_mode
             if getattr(window_stage, "host_mode", False):
                 host_window = window_stage
                 window_stage = None
         elif isinstance(handler, StreamFunction):
-            raise CompileError(f"stream function '{handler.name}' not yet implemented")
+            if window_stage is not None or host_window is not None:
+                raise CompileError(
+                    "post-window stream functions are not supported yet")
+            log_stage, ext_def = _plan_stream_function_handler(
+                handler, resolver, query_name, filters, transforms,
+                ext_def, input_def)
+            if log_stage is not None:
+                log_stages.append(log_stage)
 
     output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
     selector_plan = plan_selector(
         selector=query.selector,
-        input_attrs=[(a.name, a.type) for a in input_def.attributes],
+        input_attrs=[(a.name, a.type) for a in ext_def.attributes],
         resolver=resolver,
         output_event_type=output_event_type,
         batch_mode=batch_mode,
@@ -442,11 +489,16 @@ def plan_query(
     selector_plan.num_keys = app_context.initial_key_capacity
 
     keyer = None
+    host_transforms = False
     if selector_plan.group_by:
         fns = []
         for var in query.selector.group_by_list:
             fn, t = compile_expr(var, resolver)
             fns.append((fn, t))
+            # group key on a stream-function output: the host keyer needs
+            # the synthetic columns, so transforms must run host-side
+            if getattr(var, "attribute_name", None) in resolver.synthetic:
+                host_transforms = True
         keyer = GroupKeyer(fns)
 
     # fuse window eviction into invertible aggregator deltas when the query
@@ -479,6 +531,9 @@ def plan_query(
         partition_ctx=partition_ctx,
         partition_keyer=partition_keyer,
         carried_pk=carried_pk,
+        transforms=transforms,
+        log_stages=log_stages,
     )
+    runtime.host_transforms = host_transforms
     runtime.host_window = host_window
     return runtime

@@ -64,6 +64,15 @@ class JoinSide:
     # contents() is the probe surface, its emissions trigger the join
     host_window: object = None
     keyer: object = None         # partition keyer (partitioned joins)
+    # stream-function column transforms applied before filters/window
+    transforms: List = field(default_factory=list)
+    # when transforms append attributes, `definition` is the extended
+    # (post-transform) shape; ingest packing uses the declared one
+    input_definition: Optional[StreamDefinition] = None
+
+    @property
+    def pack_definition(self) -> StreamDefinition:
+        return self.input_definition or self.definition
 
     @property
     def prefix(self) -> str:
@@ -136,7 +145,7 @@ class JoinSideProxy(Receiver):
 
     def receive(self, events: List[Event]):
         side = self.runtime.sides[self.side_key]
-        batch = HostBatch.from_events(events, side.definition, self.runtime.dictionary)
+        batch = HostBatch.from_events(events, side.pack_definition, self.runtime.dictionary)
         self.runtime.process_side_batch(self.side_key, batch)
 
 
@@ -196,8 +205,10 @@ class JoinQueryRuntime(QueryRuntime):
         other_key = "rwin" if side_key == "left" else "lwin"
         sel = self.selector_plan
         on_cond = self.on_cond
-        # host-window sides run their filters + window host-side
-        filters = [] if side.host_window is not None else side.filters
+        # host-window sides run their transforms + filters + window host-side
+        host_pre = side.host_window is not None
+        filters = [] if host_pre else side.filters
+        transforms = [] if host_pre else side.transforms
         partitioned = self.partition_ctx is not None
         split = self.keyer is not None
         other_external = other.probe_external
@@ -205,6 +216,8 @@ class JoinQueryRuntime(QueryRuntime):
         def step(state, probe_cols, probe_valid, cols, current_time):
             ctx = {"xp": jnp, "current_time": current_time}
             cols = dict(cols)
+            for t in transforms:
+                cols = t.apply(cols, ctx)
             valid = cols[VALID_KEY]
             timer = cols[TYPE_KEY] == TIMER
             for f in filters:
@@ -327,11 +340,14 @@ class JoinQueryRuntime(QueryRuntime):
             if side.host_window is not None:
                 now_h = int(self.app_context.timestamp_generator.current_time())
                 hctx = {"xp": np, "current_time": now_h}
+                for t in side.transforms:
+                    cols = t.apply(cols, hctx)
                 valid = cols[VALID_KEY]
                 timer = cols[TYPE_KEY] == TIMER
                 for f in side.filters:
                     valid = valid & (np.asarray(f(cols, hctx)) | timer)
                 cols[VALID_KEY] = valid
+                batch = HostBatch(cols)
                 batch, notify_host = side.host_window.process(batch, now_h)
                 cols = batch.cols
             cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
@@ -396,8 +412,8 @@ class JoinQueryRuntime(QueryRuntime):
 
         batch = HostBatch.from_events(
             [Event(timestamp=int(ts),
-                   data=[_zero_value(a.type) for a in side.definition.attributes])],
-            side.definition,
+                   data=[_zero_value(a.type) for a in side.pack_definition.attributes])],
+            side.pack_definition,
             self.dictionary,
         )
         batch.cols[TYPE_KEY][...] = TIMER_TYPE

@@ -104,11 +104,16 @@ class QueryRuntime(Receiver):
         partition_ctx=None,
         partition_keyer=None,
         carried_pk: bool = False,
+        transforms=None,
+        log_stages=None,
     ):
         self.name = name
         self.app_context = app_context
         self.input_definition = input_definition
         self.filters = filters
+        self.transforms = transforms or []   # ops/stream_functions stages
+        self.log_stages = log_stages or []   # host #log() taps
+        self.host_transforms = False         # run transforms host-side (keyer needs them)
         self.window_stage = window_stage
         self.selector_plan = selector_plan
         self.keyer = keyer
@@ -248,14 +253,20 @@ class QueryRuntime(Receiver):
         this query — jit-compiled by `_make_step`, also exported raw for
         sharded execution (siddhi_tpu.parallel) and the driver's
         compile-check (`__graft_entry__.entry`)."""
-        # host windows already applied the filters before their stage
-        filters = [] if self.host_window is not None else list(self.filters)
+        # host windows already applied the filters (and transforms) before
+        # their stage, host-side; host_transforms likewise pre-applies the
+        # transforms so the group keyer can read synthetic columns
+        host_pre = self.host_window is not None
+        filters = [] if host_pre else list(self.filters)
+        transforms = [] if (host_pre or self.host_transforms) else list(self.transforms)
         sel = self.selector_plan
         win = self.window_stage
 
         def step(state, cols, current_time):
             ctx = {"xp": jnp, "current_time": current_time}
             cols = dict(cols)
+            for t in transforms:
+                cols = t.apply(cols, ctx)
             valid = cols[VALID_KEY]
             timer = cols[TYPE_KEY] == 2
             for f in filters:
@@ -307,19 +318,79 @@ class QueryRuntime(Receiver):
         batch.cols[TYPE_KEY][...] = TIMER_TYPE
         self.process_batch(batch)
 
+    def _apply_host_transforms(self, cols, ctx):
+        for t in self.transforms:
+            cols = t.apply(cols, ctx)
+        return cols
+
+    def _run_log_taps(self, batch: HostBatch):
+        """Host side of ``#log()`` taps: replay each tap's slice of the
+        pre-window pipeline with numpy and log the rows flowing at its
+        position in the handler chain (LogStreamProcessor.java:219-277)."""
+        base_valid = np.asarray(batch.cols[VALID_KEY]) & (
+            np.asarray(batch.cols[TYPE_KEY]) == CURRENT)
+        if not base_valid.any():
+            return
+        ctx = {
+            "xp": np,
+            "current_time": int(self.app_context.timestamp_generator.current_time()),
+        }
+        # only replay the transform prefix some tap actually reads
+        depth = min(max(t.n_transforms for t in self.log_stages),
+                    len(self.transforms))
+        stages = [batch.cols]
+        for t in self.transforms[:depth]:
+            stages.append(t.apply(stages[-1], ctx))
+        for tap in self.log_stages:
+            cols = stages[min(tap.n_transforms, len(stages) - 1)]
+            valid = np.asarray(cols[VALID_KEY]) & (
+                np.asarray(cols[TYPE_KEY]) == CURRENT)
+            for f in self.filters[: tap.n_filters]:
+                valid = valid & np.asarray(f(cols, ctx))
+            idx = np.nonzero(valid)[0]
+            if idx.size == 0:
+                continue
+            attrs = list(self.input_definition.attributes)
+            for t in self.transforms[: tap.n_transforms]:
+                attrs.extend(t.out_attrs)
+            rows, timestamps = [], []
+            ts_col = cols[TS_KEY]
+            for i in idx:
+                row = []
+                for a in attrs:
+                    mcol = cols.get(a.name + "?")
+                    if mcol is not None and bool(mcol[i]):
+                        row.append(None)
+                    elif a.type == AttrType.STRING:
+                        row.append(self.dictionary.decode(int(cols[a.name][i])))
+                    else:
+                        row.append(cols[a.name][i].item())
+                rows.append(tuple(row))
+                timestamps.append(int(ts_col[i]))
+            tap.emit(rows, timestamps)
+
     def process_batch(self, batch: HostBatch):
         with self._lock:
             notify_host = None
+            if self.log_stages:
+                self._run_log_taps(batch)
             if self.host_window is not None:
                 now_h = int(self.app_context.timestamp_generator.current_time())
                 ctx = {"xp": np, "current_time": now_h}
                 cols = batch.cols
+                for t in self.transforms:
+                    cols = t.apply(cols, ctx)
                 valid = cols[VALID_KEY]
                 timer = cols[TYPE_KEY] == TIMER_TYPE
                 for f in self.filters:
                     valid = valid & (np.asarray(f(cols, ctx)) | timer)
                 cols[VALID_KEY] = valid
+                batch = HostBatch(cols)
                 batch, notify_host = self.host_window.process(batch, now_h)
+            elif self.host_transforms:
+                now_h = int(self.app_context.timestamp_generator.current_time())
+                batch = HostBatch(self._apply_host_transforms(
+                    batch.cols, {"xp": np, "current_time": now_h}))
             cols = batch.cols
             partitioned = self.partition_ctx is not None
             pk = None

@@ -5,6 +5,8 @@ The counterpart of the reference ``@Extension`` class families resolved by
 ``SiddhiManager.set_extension(name_or_kind_colon_name, cls)``; kinds:
 
 - ``function:<name>`` — a :class:`ScalarFunction` (vectorized over columns)
+- ``streamFunction:<name>`` — a :class:`StreamFunction` (``#name(args)``
+  handler appending attributes to the stream)
 - ``source:<type>`` / ``sink:<type>`` — transports
 - ``sourceMapper:<type>`` / ``sinkMapper:<type>`` — payload mappers
 
@@ -23,6 +25,7 @@ from siddhi_tpu.core.stream.output.sink import (  # noqa: F401
     SinkMapper,
 )
 from siddhi_tpu.core.util.transport import InMemoryBroker  # noqa: F401
+from siddhi_tpu.ops.stream_functions import StreamFunction  # noqa: F401
 
 
 class ScalarFunction:

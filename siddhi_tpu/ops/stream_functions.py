@@ -1,0 +1,237 @@
+"""Stream functions / stream processors: ``#name(args)`` handlers.
+
+TPU inversion of the reference stream-processor chain
+(``query/processor/stream/function/StreamFunctionProcessor.java``,
+``query/processor/stream/LogStreamProcessor.java``,
+``Pol2CartStreamFunctionProcessor.java``): instead of per-event
+``process()`` calls on a linked processor chain, a stream function
+compiles to a vectorized column transform fused into the query's jitted
+step. A :class:`TransformStage` appends synthetic columns (plus their
+``<name>?`` null masks) that downstream filters, windows, and selectors
+resolve exactly like declared attributes; a :class:`LogStage` is a
+host-side pass-through tap (logging is inherently a host effect).
+
+Custom stream functions register through the extension SPI
+(``set_extension("streamFunction:<name>", cls)``) as vectorized
+column transforms — the analog of ``@Extension`` StreamFunctionProcessor
+subclasses resolved by ``SiddhiExtensionLoader.java:58-98``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+from siddhi_tpu.ops.expressions import (
+    VALID_KEY,
+    CompileError,
+    compile_expr,
+    resolve_extension,
+)
+from siddhi_tpu.ops.types import dtype_of, is_numeric
+from siddhi_tpu.query_api.definitions import Attribute, AttrType
+from siddhi_tpu.query_api.expressions import Constant
+
+logger = logging.getLogger("siddhi")
+
+
+class TransformStage:
+    """A pure row-wise column transform: ``apply(cols, ctx)`` returns the
+    column dict extended with ``out_attrs`` value + null-mask columns.
+    Runs inside the jitted device step (``ctx["xp"] is jnp``) and equally
+    host-side with numpy (join sides, log taps)."""
+
+    def __init__(self, out_attrs: List[Attribute], fn: Callable):
+        # fn(cols, ctx) -> List[(name, values, null_mask)]
+        self.out_attrs = out_attrs
+        self._fn = fn
+
+    def apply(self, cols: Dict, ctx: Dict) -> Dict:
+        xp = ctx["xp"]
+        cols = dict(cols)
+        B = cols[VALID_KEY].shape[0]
+        for name, v, m in self._fn(cols, ctx):
+            cols[name] = xp.broadcast_to(xp.asarray(v), (B,))
+            if m is None:
+                m = xp.zeros((B,), bool)
+            cols[name + "?"] = xp.broadcast_to(xp.asarray(m), (B,))
+        return cols
+
+
+class LogStage:
+    """``#log(...)`` — pass-through host tap (LogStreamProcessor.java:219-277).
+
+    ``n_filters`` / ``n_transforms`` record how much of the pre-window
+    pipeline precedes the tap, so it prints exactly the rows (and columns)
+    flowing at its position in the handler chain."""
+
+    LEVELS = {
+        "TRACE": logging.DEBUG, "DEBUG": logging.DEBUG, "INFO": logging.INFO,
+        "WARN": logging.WARNING, "ERROR": logging.ERROR, "FATAL": logging.CRITICAL,
+        "OFF": None,
+    }
+
+    def __init__(self, priority: str, message: str, show_event: bool,
+                 prefix: str, n_filters: int, n_transforms: int):
+        if priority.upper() not in self.LEVELS:
+            raise CompileError(
+                f"log priority must be one of {sorted(self.LEVELS)}, got '{priority}'")
+        self.level = self.LEVELS[priority.upper()]
+        self.message = message
+        self.show_event = show_event
+        self.prefix = prefix
+        self.n_filters = n_filters
+        self.n_transforms = n_transforms
+
+    def emit(self, rows: List[tuple], timestamps: List[int]):
+        if self.level is None:
+            return
+        for ts, row in zip(timestamps, rows):
+            parts = [self.prefix]
+            if self.message:
+                parts.append(self.message + (", " if self.show_event else ""))
+            if self.show_event:
+                parts.append(f"StreamEvent{{ timestamp={ts}, data={list(row)} }}")
+            logger.log(self.level, "%s", "".join(parts))
+
+
+def _const(expr, what: str):
+    if not isinstance(expr, Constant):
+        raise CompileError(f"#log() {what} must be a constant")
+    return expr.value
+
+
+def plan_log(handler, query_name: str, n_filters: int, n_transforms: int) -> LogStage:
+    """Parameter overloads per LogStreamProcessor.java:72-77:
+    (), (message), (is.event.logged), (message, bool), (priority, message),
+    (priority, message, bool)."""
+    prefix = f"{query_name}: "
+    ps = handler.parameters
+    priority, message, show = "INFO", "", True
+    if len(ps) == 1:
+        v = _const(ps[0], "parameter")
+        if isinstance(v, bool):
+            show = v
+        else:
+            message = str(v)
+    elif len(ps) == 2:
+        a, b = _const(ps[0], "parameter"), _const(ps[1], "parameter")
+        if isinstance(b, bool):
+            message, show = str(a), b
+        else:
+            priority, message = str(a), str(b)
+    elif len(ps) == 3:
+        priority = str(_const(ps[0], "priority"))
+        message = str(_const(ps[1], "message"))
+        v = _const(ps[2], "is.event.logged")
+        if not isinstance(v, bool):
+            raise CompileError("#log() is.event.logged must be a bool constant")
+        show = v
+    elif len(ps) > 3:
+        raise CompileError("#log() takes at most (priority, message, is.event.logged)")
+    return LogStage(priority, message, show, prefix, n_filters, n_transforms)
+
+
+def _numeric_arg(handler, i: int, name: str, resolver):
+    fn, t = compile_expr(handler.parameters[i], resolver)
+    if not is_numeric(t):
+        raise CompileError(f"pol2Cart {name} must be numeric, got {t}")
+    return fn
+
+
+def plan_pol2cart(handler, resolver) -> TransformStage:
+    """``#pol2Cart(theta, rho[, z])`` appends double x, y[, z] columns —
+    theta in degrees (Pol2CartStreamFunctionProcessor.java examples:
+    theta=0.7854, rho=5 -> x=4.9995..., y=0.0685...)."""
+    n = len(handler.parameters)
+    if n not in (2, 3):
+        raise CompileError("pol2Cart needs (theta, rho) or (theta, rho, z)")
+    theta_f = _numeric_arg(handler, 0, "theta", resolver)
+    rho_f = _numeric_arg(handler, 1, "rho", resolver)
+    z_f = _numeric_arg(handler, 2, "z", resolver) if n == 3 else None
+    f64 = dtype_of(AttrType.DOUBLE)
+    out = [Attribute("x", AttrType.DOUBLE), Attribute("y", AttrType.DOUBLE)]
+    if z_f is not None:
+        out.append(Attribute("z", AttrType.DOUBLE))
+
+    def fn(cols, ctx):
+        xp = ctx["xp"]
+        th, thm = theta_f(cols, ctx)
+        rh, rhm = rho_f(cols, ctx)
+        m = None
+        for part in (thm, rhm):
+            if part is not None:
+                m = part if m is None else (m | part)
+        rad = xp.deg2rad(xp.asarray(th).astype(f64))
+        rho = xp.asarray(rh).astype(f64)
+        res = [("x", rho * xp.cos(rad), m), ("y", rho * xp.sin(rad), m)]
+        if z_f is not None:
+            zv, zm = z_f(cols, ctx)
+            res.append(("z", xp.asarray(zv).astype(f64), zm))
+        return res
+
+    return TransformStage(out, fn)
+
+
+class StreamFunction:
+    """Extension base for custom ``#name(args)`` stream functions: declare
+    ``out_attrs`` (or make it a callable of the argument types) and
+    implement ``apply(xp, *arrays) -> one array per out attr``, vectorized
+    over the batch — the SPI analog of StreamFunctionProcessor.process()
+    (reference per-event) as a single columnar call."""
+
+    out_attrs: object = None  # List[(name, AttrType)] or callable(arg_types)
+
+    @staticmethod
+    def apply(xp, *args):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def plan_extension_stream_function(ext, handler, resolver) -> TransformStage:
+    compiled = [compile_expr(a, resolver) for a in handler.parameters]
+    out_spec = ext.out_attrs
+    if callable(out_spec):
+        out_spec = out_spec([t for _, t in compiled])
+    if not out_spec:
+        raise CompileError(
+            f"stream function '{handler.name}' declares no out_attrs")
+    out_attrs = [Attribute(n, t) for n, t in out_spec]
+
+    def fn(cols, ctx):
+        xp = ctx["xp"]
+        vals, m = [], None
+        for f, _t in compiled:
+            v, vm = f(cols, ctx)
+            vals.append(v)
+            if vm is not None:
+                m = vm if m is None else (m | vm)
+        outs = ext.apply(xp, *vals)
+        if len(out_attrs) == 1 and not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return [(a.name, xp.asarray(v).astype(dtype_of(a.type)), m)
+                for a, v in zip(out_attrs, outs)]
+
+    return TransformStage(out_attrs, fn)
+
+
+def plan_stream_function(handler, resolver, query_name: str,
+                         n_filters: int, n_transforms: int):
+    """Factory: returns a TransformStage or a LogStage for a
+    ``StreamFunction`` handler (SingleInputStreamParser.generateProcessor
+    dispatch role)."""
+    ns = getattr(handler, "namespace", "") or ""
+    full_name = f"{ns}:{handler.name}" if ns else handler.name
+    if not ns:
+        # built-ins live in the root namespace only — '#custom:log' must
+        # resolve through the extension registry, not shadow #log
+        name = handler.name.lower()
+        if name == "log":
+            return plan_log(handler, query_name, n_filters, n_transforms)
+        if name == "pol2cart":
+            return plan_pol2cart(handler, resolver)
+    ext = resolve_extension("streamFunction", full_name)
+    if ext is not None:
+        return plan_extension_stream_function(ext, handler, resolver)
+    raise CompileError(f"unknown stream function '{full_name}'")

@@ -43,6 +43,10 @@ _FUNCTIONS = [
     "instanceOfBoolean/String/Integer/Long/Float/Double(x)",
     "eventTimestamp()", "currentTimeMillis()", "uuid()", "log(...)",
 ]
+_STREAM_FUNCTIONS = [
+    "log([priority,] [message,] [is.event.logged])",
+    "pol2Cart(theta, rho[, z])",
+]
 _SOURCES = ["inMemory(topic)"]
 _SINKS = ["inMemory(topic)", "log([prefix])",
           "@distribution(strategy='roundRobin|broadcast|partitioned', @destination...)"]
@@ -71,6 +75,7 @@ def generate_docs(manager=None, title: str = "siddhi_tpu reference") -> str:
     section("Attribute aggregators", _AGGREGATORS)
     section("Incremental aggregators (define aggregation)", _INCREMENTAL_AGGS)
     section("Built-in functions", _FUNCTIONS)
+    section("Stream functions (#handler)", _STREAM_FUNCTIONS)
     section("Sources", _SOURCES)
     section("Sinks", _SINKS)
     section("Mappers", _MAPPERS)

@@ -1,0 +1,267 @@
+"""Stream functions (`#name(args)` handlers): #log, #pol2Cart, and
+custom extension stream functions — reference
+``query/processor/stream/LogStreamProcessor.java``,
+``Pol2CartStreamFunctionProcessor.java``,
+``StreamFunctionProcessor.java`` (and the core LogStreamProcessorTestCase /
+Pol2CartStreamProcessorTestCase shapes)."""
+
+import logging
+import math
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.ops.expressions import CompileError
+from siddhi_tpu.extension import StreamFunction
+from siddhi_tpu.query_api.definitions import AttrType
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream", manager=None):
+    manager = manager or SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+# --------------------------------------------------------------- pol2Cart
+
+
+def test_pol2cart_appends_x_y():
+    m, rt, c = build("""
+        define stream PolarStream (theta double, rho double);
+        from PolarStream#pol2Cart(theta, rho)
+        select x, y
+        insert into OutStream;
+    """)
+    rt.get_input_handler("PolarStream").send([0.7854, 5.0])
+    m.shutdown()
+    (x, y), = [tuple(e.data) for e in c.events]
+    # reference example: theta in degrees
+    assert x == pytest.approx(5.0 * math.cos(math.radians(0.7854)), rel=1e-9)
+    assert y == pytest.approx(5.0 * math.sin(math.radians(0.7854)), rel=1e-9)
+
+
+def test_pol2cart_with_z_and_select_star():
+    m, rt, c = build("""
+        define stream PolarStream (theta double, rho double);
+        from PolarStream#pol2Cart(theta, rho, 3.4)
+        select *
+        insert into OutStream;
+    """)
+    rt.get_input_handler("PolarStream").send([90.0, 2.0])
+    m.shutdown()
+    row, = [tuple(e.data) for e in c.events]
+    theta, rho, x, y, z = row
+    assert (theta, rho) == (90.0, 2.0)
+    assert x == pytest.approx(0.0, abs=1e-12)
+    assert y == pytest.approx(2.0)
+    assert z == pytest.approx(3.4)
+
+
+def test_pol2cart_then_filter_and_window():
+    # a post-function filter may reference the appended attributes, and the
+    # window buffers them
+    m, rt, c = build("""
+        define stream PolarStream (theta double, rho double);
+        from PolarStream#pol2Cart(theta, rho)[y > 0.0]#window.length(2)
+        select sum(y) as total
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("PolarStream")
+    h.send([90.0, 1.0])    # y = 1
+    h.send([270.0, 1.0])   # y = -1, filtered out
+    h.send([90.0, 2.0])    # y = 2
+    m.shutdown()
+    totals = [e.data[0] for e in c.events]
+    assert totals[-1] == pytest.approx(3.0)
+
+
+def test_pol2cart_group_by_synthetic_attr():
+    # group key computed from a stream-function output (host keyer path)
+    m, rt, c = build("""
+        define stream PolarStream (theta double, rho double);
+        from PolarStream#pol2Cart(theta, rho)
+        select x, count() as n
+        group by x
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("PolarStream")
+    h.send([0.0, 2.0])   # x = 2
+    h.send([0.0, 2.0])   # x = 2 again
+    h.send([0.0, 3.0])   # x = 3
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got[-1][1] == 1 and got[1] == (2.0, 2)
+
+
+def test_pol2cart_inside_partition():
+    m, rt, c = build("""
+        define stream PolarStream (symbol string, theta double, rho double);
+        partition with (symbol of PolarStream)
+        begin
+            from PolarStream#pol2Cart(theta, rho)#window.length(10)
+            select symbol, sum(y) as total
+            insert into OutStream;
+        end;
+    """)
+    h = rt.get_input_handler("PolarStream")
+    h.send(["A", 90.0, 1.0])
+    h.send(["B", 90.0, 5.0])
+    h.send(["A", 90.0, 2.0])
+    m.shutdown()
+    last = {e.data[0]: e.data[1] for e in c.events}
+    assert last["A"] == pytest.approx(3.0)
+    assert last["B"] == pytest.approx(5.0)
+
+
+def test_stream_function_name_collision_rejected():
+    with pytest.raises(CompileError, match="collides"):
+        build("""
+            define stream PolarStream (x double, theta double, rho double);
+            from PolarStream#pol2Cart(theta, rho)
+            select x insert into OutStream;
+        """)
+
+
+def test_unknown_stream_function_rejected():
+    with pytest.raises(CompileError, match="unknown stream function"):
+        build("""
+            define stream S (v int);
+            from S#noSuchThing(v) select v insert into OutStream;
+        """)
+
+
+# -------------------------------------------------------------------- log
+
+
+def test_log_passthrough_and_message(caplog):
+    m, rt, c = build("""
+        define stream S (symbol string, price double);
+        from S#log('INFO', 'price event', true)[price > 10.0]
+        select symbol insert into OutStream;
+    """)
+    with caplog.at_level(logging.INFO, logger="siddhi"):
+        rt.get_input_handler("S").send(["WSO2", 55.5])
+        rt.get_input_handler("S").send(["CHEAP", 5.0])
+    m.shutdown()
+    # pass-through: filter applies after, so only WSO2 reaches the output
+    assert [e.data[0] for e in c.events] == ["WSO2"]
+    msgs = [r.message for r in caplog.records]
+    # log sits before the filter: both events are logged, with the message
+    assert any("price event" in s and "WSO2" in s for s in msgs)
+    assert any("CHEAP" in s for s in msgs)
+
+
+def test_log_after_filter_only_logs_passing_rows(caplog):
+    m, rt, c = build("""
+        define stream S (symbol string, price double);
+        from S[price > 10.0]#log('filtered')
+        select symbol insert into OutStream;
+    """)
+    with caplog.at_level(logging.INFO, logger="siddhi"):
+        rt.get_input_handler("S").send(["WSO2", 55.5])
+        rt.get_input_handler("S").send(["CHEAP", 5.0])
+    m.shutdown()
+    msgs = [r.message for r in caplog.records]
+    assert any("WSO2" in s for s in msgs)
+    assert not any("CHEAP" in s for s in msgs)
+
+
+def test_log_no_event(caplog):
+    # #log('msg', false) logs the message without the event payload
+    m, rt, c = build("""
+        define stream S (v int);
+        from S#log('tick', false) select v insert into OutStream;
+    """)
+    with caplog.at_level(logging.INFO, logger="siddhi"):
+        rt.get_input_handler("S").send([7])
+    m.shutdown()
+    msgs = [r.message for r in caplog.records]
+    assert any(s.endswith("tick") for s in msgs)
+    assert not any("StreamEvent" in s for s in msgs)
+
+
+def test_log_bad_priority_rejected():
+    with pytest.raises(CompileError, match="priority"):
+        build("""
+            define stream S (v int);
+            from S#log('LOUD', 'oops') select v insert into OutStream;
+        """)
+
+
+# --------------------------------------------------------- join sides
+
+
+def test_pol2cart_on_join_side():
+    m, rt, c = build("""
+        define stream PolarStream (symbol string, theta double, rho double);
+        define stream RefStream (symbol string, lim double);
+        from PolarStream#pol2Cart(theta, rho)#window.length(5)
+             join RefStream#window.length(5)
+             on PolarStream.symbol == RefStream.symbol
+        select PolarStream.symbol as symbol, PolarStream.y as y, RefStream.lim as lim
+        insert into OutStream;
+    """)
+    rt.get_input_handler("RefStream").send(["A", 10.0])
+    rt.get_input_handler("PolarStream").send(["A", 90.0, 4.0])
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert ("A", pytest.approx(4.0), 10.0) in [
+        (s, y, l) for s, y, l in got]
+
+
+# ------------------------------------------------------ extension SPI
+
+
+class Magnitude(StreamFunction):
+    out_attrs = [("magnitude", AttrType.DOUBLE)]
+
+    @staticmethod
+    def apply(xp, a, b):
+        return xp.sqrt(a * a + b * b)
+
+
+def test_custom_stream_function_extension():
+    manager = SiddhiManager()
+    manager.set_extension("streamFunction:mag", Magnitude)
+    m, rt, c = build("""
+        define stream Vec (x1 double, x2 double);
+        from Vec#mag(x1, x2)
+        select magnitude
+        insert into OutStream;
+    """, manager=manager)
+    rt.get_input_handler("Vec").send([3.0, 4.0])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [pytest.approx(5.0)]
+
+
+def test_namespaced_stream_function_extension():
+    # '#custom:mag(...)' resolves through the registry under its namespaced
+    # name and must not shadow (or be shadowed by) root-namespace built-ins
+    manager = SiddhiManager()
+    manager.set_extension("streamFunction:custom:mag", Magnitude)
+    m, rt, c = build("""
+        define stream Vec (x1 double, x2 double);
+        from Vec#custom:mag(x1, x2)
+        select magnitude
+        insert into OutStream;
+    """, manager=manager)
+    rt.get_input_handler("Vec").send([6.0, 8.0])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [pytest.approx(10.0)]
+
+    with pytest.raises(CompileError, match="custom:log"):
+        build("""
+            define stream S (v int);
+            from S#custom:log('x') select v insert into OutStream;
+        """)
