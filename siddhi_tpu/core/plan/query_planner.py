@@ -130,6 +130,7 @@ def plan_join_query(
         sdef = definitions[sid]
         resolver = SingleStreamResolver(sdef, dictionary, ref_id=s.stream_reference_id)
         filters = []
+        post_filters = []
         window_stage = None
         host_window = None
         transforms = []
@@ -137,8 +138,9 @@ def plan_join_query(
         for h in s.handlers:
             if isinstance(h, Filter):
                 if window_stage is not None:
-                    raise CompileError("post-window filters on join sides are not supported")
-                filters.append(compile_condition(h.expression, resolver))
+                    post_filters.append(compile_condition(h.expression, resolver))
+                else:
+                    filters.append(compile_condition(h.expression, resolver))
             elif isinstance(h, Window):
                 if window_stage is not None:
                     raise CompileError("only one #window per join side is allowed")
@@ -203,6 +205,7 @@ def plan_join_query(
             keyer=keyer,
             transforms=transforms,
             input_definition=sdef if ext_sdef is not sdef else None,
+            post_filters=post_filters,
         )
 
     left = build_side("left", join.left)
@@ -441,6 +444,7 @@ def plan_query(
             )
 
     filters = []
+    post_filters = []   # after the window: mask emitted rows (FilterProcessor downstream of a WindowProcessor)
     window_stage = None
     host_window = None
     batch_mode = False
@@ -450,8 +454,9 @@ def plan_query(
     for handler in input_stream.handlers:
         if isinstance(handler, Filter):
             if window_stage is not None or host_window is not None:
-                raise CompileError("post-window filters are not supported yet")
-            filters.append(compile_condition(handler.expression, resolver))
+                post_filters.append(compile_condition(handler.expression, resolver))
+            else:
+                filters.append(compile_condition(handler.expression, resolver))
         elif isinstance(handler, Window):
             if window_stage is not None or host_window is not None:
                 raise CompileError("only one #window per stream is allowed")
@@ -506,6 +511,7 @@ def plan_query(
     # path for windowed aggregation (see ops/fused_agg.py)
     if (
         window_stage is not None
+        and not post_filters   # fused stages never materialize emitted rows
         and partition_ctx is None
         and getattr(app_context, "enable_fusion", True)
         and stream_id not in getattr(app_context, "named_windows", {})
@@ -533,6 +539,7 @@ def plan_query(
         carried_pk=carried_pk,
         transforms=transforms,
         log_stages=log_stages,
+        post_filters=post_filters,
     )
     runtime.host_transforms = host_transforms
     runtime.host_window = host_window

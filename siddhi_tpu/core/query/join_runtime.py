@@ -69,6 +69,8 @@ class JoinSide:
     # when transforms append attributes, `definition` is the extended
     # (post-transform) shape; ingest packing uses the declared one
     input_definition: Optional[StreamDefinition] = None
+    # filters after the window: mask this side's emitted (trigger) rows
+    post_filters: List = field(default_factory=list)
 
     @property
     def pack_definition(self) -> StreamDefinition:
@@ -231,6 +233,13 @@ class JoinQueryRuntime(QueryRuntime):
             notify = wout.pop("__notify__", None)
             overflow = wout.pop("__overflow__", None)
             wout.pop("__flush__", None)
+            # post-window filters mask emitted rows (probe/trigger side
+            # only — the window's retained contents are unaffected)
+            pvalid = wout[VALID_KEY]
+            ptimer = wout[TYPE_KEY] == TIMER
+            for f in side.post_filters:
+                pvalid = pvalid & (f(wout, ctx) | ptimer)
+            wout[VALID_KEY] = pvalid
 
             N = wout[VALID_KEY].shape[0]
             if not other_external:

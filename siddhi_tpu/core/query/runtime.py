@@ -106,6 +106,7 @@ class QueryRuntime(Receiver):
         carried_pk: bool = False,
         transforms=None,
         log_stages=None,
+        post_filters=None,
     ):
         self.name = name
         self.app_context = app_context
@@ -113,6 +114,7 @@ class QueryRuntime(Receiver):
         self.filters = filters
         self.transforms = transforms or []   # ops/stream_functions stages
         self.log_stages = log_stages or []   # host #log() taps
+        self.post_filters = post_filters or []  # masks on window-emitted rows
         self.host_transforms = False         # run transforms host-side (keyer needs them)
         self.window_stage = window_stage
         self.selector_plan = selector_plan
@@ -259,6 +261,7 @@ class QueryRuntime(Receiver):
         host_pre = self.host_window is not None
         filters = [] if host_pre else list(self.filters)
         transforms = [] if (host_pre or self.host_transforms) else list(self.transforms)
+        post_filters = [] if host_pre else list(self.post_filters)
         sel = self.selector_plan
         win = self.window_stage
 
@@ -280,6 +283,13 @@ class QueryRuntime(Receiver):
                 cols = dict(cols)
                 notify = cols.pop("__notify__", None)
                 overflow = cols.pop("__overflow__", None)
+                # post-window filters mask emitted rows (window retention
+                # is unaffected — the filter sits downstream of the window)
+                pvalid = cols[VALID_KEY]
+                ptimer = cols[TYPE_KEY] == 2
+                for f in post_filters:
+                    pvalid = pvalid & (f(cols, ctx) | ptimer)
+                cols[VALID_KEY] = pvalid
             new_state["sel"], out = sel.apply(state["sel"], cols, ctx)
             if notify is not None:
                 out["__notify__"] = notify
@@ -387,6 +397,13 @@ class QueryRuntime(Receiver):
                 cols[VALID_KEY] = valid
                 batch = HostBatch(cols)
                 batch, notify_host = self.host_window.process(batch, now_h)
+                if self.post_filters:
+                    cols = batch.cols
+                    pvalid = cols[VALID_KEY]
+                    ptimer = cols[TYPE_KEY] == TIMER_TYPE
+                    for f in self.post_filters:
+                        pvalid = pvalid & (np.asarray(f(cols, ctx)) | ptimer)
+                    cols[VALID_KEY] = pvalid
             elif self.host_transforms:
                 now_h = int(self.app_context.timestamp_generator.current_time())
                 batch = HostBatch(self._apply_host_transforms(

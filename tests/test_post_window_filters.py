@@ -1,0 +1,138 @@
+"""Post-window filters: ``#window.x(...)[cond]`` masks the window's
+emitted rows (CURRENT and EXPIRED) without affecting window retention —
+the reference's FilterProcessor placed downstream of a WindowProcessor
+(SingleInputStreamParser handler chains)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+def test_post_window_filter_masks_current_rows():
+    m, rt, c = build("""
+        define stream S (price double);
+        from S#window.length(2)[price > 10.0]
+        select price insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([5.0])
+    h.send([100.0])
+    h.send([20.0])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [100.0, 20.0]
+
+
+def test_post_window_filter_masks_expired_rows_too():
+    m, rt, c = build("""
+        define stream S (price double);
+        from S#window.length(1)[price > 10.0]
+        select price insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([5.0])     # filtered current
+    h.send([100.0])   # current passes; expired 5.0 filtered
+    h.send([20.0])    # current passes; expired 100.0 passes
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [100.0, 100.0, 20.0]
+
+
+def test_post_window_filter_does_not_affect_retention():
+    # the filtered row still occupies a window slot: with length(2), a
+    # non-passing row still evicts the oldest row
+    m, rt, c = build("""
+        define stream S (price double);
+        from S#window.length(2)[price > 10.0]
+        select price insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([100.0])
+    h.send([200.0])
+    h.send([5.0])     # filtered, but evicts 100.0 -> expired 100.0 emitted
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [100.0, 200.0, 100.0]
+
+
+def test_post_window_filter_with_aggregation():
+    # sum() sees only rows that pass the post-filter, symmetrically on
+    # insert and expiry
+    m, rt, c = build("""
+        define stream S (v int);
+        from S#window.length(2)[v > 0]
+        select sum(v) as total insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([3])     # total 3
+    h.send([-1])    # filtered: no current emission
+    h.send([4])     # total: +4, expired 3 passes -> -3 => 4... but -1 still in window
+    h.send([5])     # +5, expired -1 filtered => 4 + 5 = 9
+    m.shutdown()
+    totals = [e.data[0] for e in c.events]
+    assert totals == [3, 4, 9]
+
+
+def test_post_window_filter_inside_partition():
+    m, rt, c = build("""
+        define stream S (sym string, v int);
+        partition with (sym of S)
+        begin
+            from S#window.lengthBatch(2)[v > 10]
+            select sym, v insert into OutStream;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 5])
+    h.send(["A", 20])   # batch flush: 5 filtered, 20 passes
+    h.send(["B", 30])
+    h.send(["B", 40])   # batch flush: both pass
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", 20), ("B", 30), ("B", 40)]
+
+
+def test_post_window_filter_on_join_side():
+    # only passing window emissions trigger the join
+    m, rt, c = build("""
+        define stream L (sym string, v int);
+        define stream R (sym string, w int);
+        from L#window.length(5)[v > 10] join R#window.length(5)
+             on L.sym == R.sym
+        select L.sym as sym, L.v as v, R.w as w
+        insert into OutStream;
+    """)
+    rt.get_input_handler("R").send(["A", 7])
+    rt.get_input_handler("L").send(["A", 5])    # filtered: no trigger
+    rt.get_input_handler("L").send(["A", 50])   # triggers
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", 50, 7)]
+
+
+def test_filter_window_filter_combination():
+    # pre-filter feeds the window; post-filter masks its emissions
+    m, rt, c = build("""
+        define stream S (v int);
+        from S[v > 0]#window.length(3)[v < 100]
+        select v insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    for v in [-5, 1, 500, 7]:
+        h.send([v])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [1, 7]
