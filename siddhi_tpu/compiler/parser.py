@@ -588,27 +588,44 @@ class Parser:
             within = self.parse_time_constant().value
         return StateInputStream(state_type=state_type, state_element=element, within=within)
 
-    def parse_state_chain(self, sep: str, state_type) -> StateElement:
-        left = self.parse_state_unit(sep, state_type)
+    def parse_state_chain(self, sep: str, state_type, depth: int = 0) -> StateElement:
+        left = self.parse_state_unit(sep, state_type, depth)
         while (sep == "->" and self.accept_op("->")) or (sep == "," and self.accept_op(",")):
-            right = self.parse_state_unit(sep, state_type)
+            right = self.parse_state_unit(sep, state_type, depth)
             left = NextStateElement(state=left, next=right)
         return left
 
-    def parse_state_unit(self, sep: str, state_type) -> StateElement:
+    def _accept_scoped_within(self, depth: int):
+        """A trailing top-level `within` belongs to the whole pattern
+        (SiddhiQL.g4 pattern_stream: ... within_time?) — bind it to the
+        preceding element only when more chain follows or we are inside
+        parentheses (the scoped-within extension)."""
+        mark = self.pos
+        if not self.accept_kw("within"):
+            return None
+        w = self.parse_time_constant().value
+        if depth > 0 or self.peek().is_op("->") or self.peek().is_op(","):
+            return w
+        self.pos = mark
+        return None
+
+    def parse_state_unit(self, sep: str, state_type, depth: int = 0) -> StateElement:
         if self.accept_kw("every"):
             if self.accept_op("("):
-                inner = self.parse_state_chain(sep, state_type)
+                inner = self.parse_state_chain(sep, state_type, depth + 1)
                 self.expect_op(")")
                 el: StateElement = EveryStateElement(state=inner)
             else:
                 el = EveryStateElement(state=self.parse_state_source(sep, state_type))
-            if self.accept_kw("within"):
-                el.within = self.parse_time_constant().value
+            w = self._accept_scoped_within(depth)
+            if w is not None:
+                el.within = w
             return el
         if self.accept_op("("):
-            inner = self.parse_state_chain(sep, state_type)
+            inner = self.parse_state_chain(sep, state_type, depth + 1)
             self.expect_op(")")
+            # `(...) within t` is always the scoped-within extension: the
+            # parentheses make the scope explicit
             if self.accept_kw("within"):
                 inner.within = self.parse_time_constant().value
             return inner

@@ -329,10 +329,8 @@ def build_nfa_plan(
             side = make_side(el.state, is_count=True, absent=False)
             mn = el.min_count if el.min_count != CountStateElement.ANY else 0
             mx = el.max_count if el.max_count != CountStateElement.ANY else ANY_MAX
-            if sticky:
-                raise CompileError("`every` on a count state is not supported")
             steps.append(StepSpec(index=idx, kind="count", sides=[side],
-                                  min_count=mn, max_count=mx))
+                                  min_count=mn, max_count=mx, sticky=sticky))
         elif isinstance(el, LogicalStateElement):
             sides = []
             for sub in (el.stream1, el.stream2):
@@ -366,6 +364,16 @@ def build_nfa_plan(
         for side in st.sides:
             if side.stream_id not in stream_ids:
                 stream_ids.append(side.stream_id)
+
+    for st in steps:
+        # sticky counts re-arm by forking on advance; the forked child's
+        # entry is only implemented for plain stream successors (and
+        # emission when the count is the last step)
+        if (st.kind == "count" and st.sticky and st.index < len(steps) - 1
+                and steps[st.index + 1].kind != "stream"):
+            raise CompileError(
+                "`every` on a count state followed by a "
+                f"{steps[st.index + 1].kind} state is not supported")
 
     if len(scopes) > 8:
         raise CompileError("at most 8 nested `within` scopes are supported")
@@ -964,6 +972,9 @@ class NFAStage:
             conds: List[jnp.ndarray] = []
             at_masks: List[jnp.ndarray] = []
             adv_masks: List[jnp.ndarray] = []
+            # per-op [(src_step, mask)]: advances out of a sticky (`every`)
+            # count source fork a child instead of moving the parent
+            adv_fork_masks: List[List[Tuple[int, jnp.ndarray]]] = []
             viols: List[jnp.ndarray] = []
             for oi, (st, side) in enumerate(ops):
                 j = st.index
@@ -980,6 +991,7 @@ class NFAStage:
                     viols.append(v)
                     at_masks.append(jnp.zeros((B, S), bool))
                     adv_masks.append(jnp.zeros((B, S), bool))
+                    adv_fork_masks.append([])
                     continue
                 viols.append(jnp.zeros((B, S), bool))
                 at = A & (ST == j) & m[:, None] & cond
@@ -991,14 +1003,31 @@ class NFAStage:
                     # an already-matched side must not re-match/overwrite
                     at = at & ((BT & side.bit) == 0)
                 adv = jnp.zeros((B, S), bool)
+                fork_all = jnp.zeros((B, S), bool)
+                fork_srcs: List[Tuple[int, jnp.ndarray]] = []
                 for p in self._advance_sources(j):
-                    src_cap = plan.steps[p].sides[0].capture
+                    src = plan.steps[p]
+                    src_cap = src.sides[0].capture
                     pc = CP[cap_cnt_col(src_cap.cid)]
-                    adv = adv | (A & (ST == p) & (pc >= plan.steps[p].min_count))
+                    am = A & (ST == p) & (pc >= src.min_count)
+                    if (src.kind == "count" and src.sticky
+                            and src.min_count != src.max_count):
+                        # range `every` count: group = whatever is collected
+                        # when consumed; parent re-arms, child advances
+                        fm = am & m[:, None] & cond
+                        fork_srcs.append((p, fm))
+                        fork_all = fork_all | fm
+                    else:
+                        # exact `every` counts fork at completion instead:
+                        # complete groups are waiting children that MOVE
+                        # (the collecting parent has cnt < min and never
+                        # qualifies as an advance source)
+                        adv = adv | am
                 adv = adv & m[:, None] & cond
                 at_masks.append(at)
                 adv_masks.append(adv)
-                win = jnp.where(at | adv, oi, win)
+                adv_fork_masks.append(fork_srcs)
+                win = jnp.where(at | adv | fork_all, oi, win)
 
             matched = win >= 0
 
@@ -1041,6 +1070,9 @@ class NFAStage:
             kill = jnp.zeros((B, S), bool)
             sticky_emit_ops: List[Tuple[jnp.ndarray, StepSpec, SideSpec]] = []
             phase2_forks: List[Tuple[jnp.ndarray, int, SideSpec]] = []
+            # (mask, src_step): sticky count parents to re-arm (zero their
+            # collection) after the emission snapshot + fork copies
+            count_resets: List[Tuple[jnp.ndarray, StepSpec]] = []
             for oi, (st, side) in enumerate(ops):
                 if side.absent:
                     continue
@@ -1049,6 +1081,17 @@ class NFAStage:
                 eff_adv = adv_masks[oi] & (win == oi)
                 eff = eff_at | eff_adv
                 cap = side.capture
+                # advances out of a sticky (`every`) count source: the
+                # parent stays collecting (reset below); a forked child
+                # takes this op's transition (plan validation guarantees
+                # st.kind == "stream" here)
+                for p, fmask in adv_fork_masks[oi]:
+                    fm = fmask & (win == oi)
+                    count_resets.append((fm, plan.steps[p]))
+                    if j == L:
+                        sticky_emit_ops.append((fm, st, side))
+                    else:
+                        phase2_forks.append((fm, j + 1, side))
                 if st.sticky and st.kind == "stream":
                     # sticky step: parent stays; fork an advanced child
                     if j == L:
@@ -1063,7 +1106,21 @@ class NFAStage:
                     ST2 = jnp.where(eff, j, ST2)
                     if j == L:
                         cnt_after = CP2[cap_cnt_col(cap.cid)]
-                        emit2 = emit2 | (eff & (cnt_after >= st.min_count))
+                        done = eff & (cnt_after >= st.min_count)
+                        emit2 = emit2 | done
+                        if st.sticky:
+                            # `every` count tail: emit each completed group
+                            # and re-arm a fresh collection
+                            count_resets.append((done, st))
+                    elif st.sticky and st.min_count == st.max_count:
+                        # exact `every` count mid-chain: a completed group
+                        # forks a waiting child (it advances on the next
+                        # step's event); the parent restarts collecting
+                        # (CountPatternTestCase.testQuery20 grouping)
+                        cnt_after = CP2[cap_cnt_col(cap.cid)]
+                        done = eff & (cnt_after >= st.max_count)
+                        phase2_forks.append((done, j, None))
+                        count_resets.append((done, st))
                 elif st.kind == "stream":
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
@@ -1181,6 +1238,23 @@ class NFAStage:
             A2, ST2, BT2, VB2 = V2["A"], V2["ST"], V2["BT"], V2["VB"]
             T0, ADL2_, AD22_, CD2 = V2["T0"], V2["ADL"], V2["AD2"], V2["CD"]
             CP2, SC2 = V2["CP"], V2["SC"]
+
+            # ---- re-arm sticky (`every`) count parents: zero the counter,
+            # the collected capture arrays, and any capture scope anchored
+            # at the count step, so the next group starts fresh (applied
+            # after the emission snapshot and fork copies, which must see
+            # the completed collection)
+            for fm, src_st in count_resets:
+                scap = src_st.sides[0].capture
+                cnt_col = cap_cnt_col(scap.cid)
+                pref, prefi = f"c{scap.cid}__", f"c{scap.cid}i"
+                for n in cap_names:
+                    if n == cnt_col or n.startswith(pref) or n.startswith(prefi):
+                        CP2[n] = jnp.where(fm, jnp.zeros((), CP2[n].dtype),
+                                           CP2[n])
+                for g, (a, b, t) in enumerate(plan.scopes):
+                    if a == src_st.index and not plan.steps[a].waitish:
+                        CD2 = jnp.where(fm, CD2 & ~plan.scope_bit(g), CD2)
 
             # ---- fresh starts
             every_ok = plan.every | ~CONS

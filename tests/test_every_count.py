@@ -1,0 +1,133 @@
+"""`every` on count states — reference CountPatternTestCase.testQuery20
+(tail `-> every e2=B<2> within 3 sec`: one emission per completed,
+non-overlapping group; the whole chain still dies at `within`) and the
+mid-chain fork shape `A -> every B<n:n> -> C`."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+import pytest
+
+from siddhi_tpu.ops.expressions import CompileError
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+APP = "@app:playback define stream InputStream (name string);\n"
+
+
+def test_every_count_tail_groups_non_overlapping():
+    # CountPatternTestCase.testQuery20 without the within expiry part:
+    # A A B B -> 1, B B -> 1 more (pairs are consumed, not sliding)
+    m, rt, c = build(APP + """
+        from e1=InputStream[name == 'A']<2:2>
+          -> every e2=InputStream[name == 'B']<2:2>
+        select e2[0].name as n0, e2[1].name as n1
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("InputStream")
+    for i, n in enumerate(["A", "A", "B", "B", "B", "B", "B"]):
+        h.send(1000 + i * 100, [n])
+    m.shutdown()
+    # 4 B's -> 2 groups; the 5th B starts an incomplete group
+    assert [tuple(e.data) for e in c.events] == [("B", "B"), ("B", "B")]
+
+
+def test_every_count_tail_within_kills_the_chain():
+    # testQuery20 proper: within 3 sec from the first A; after expiry no
+    # more groups emit, and a fresh AA does not restart (no head every)
+    m, rt, c = build(APP + """
+        from e1=InputStream[name == 'A']<2:2>
+          -> every e2=InputStream[name == 'B']<2:2>
+          within 3 sec
+        select e2[0].name as n0
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("InputStream")
+    t = 1000
+    for n in ["A", "A", "B", "B", "B", "B"]:
+        h.send(t, [n]); t += 100
+    h.send(t, ["A"]); t += 100
+    h.send(t, ["B"]); t += 100
+    t += 4000                      # past the 3 sec window
+    h.send(t, ["B"]); t += 100
+    for n in ["A", "A", "B", "B"]:
+        h.send(t, [n]); t += 100
+    m.shutdown()
+    assert len(c.events) == 2      # exactly the two pre-expiry groups
+
+
+def test_every_count_midchain_forks_completed_groups():
+    # A -> every B<2:2> -> C: completed pairs wait; each C consumes all
+    # waiting pairs collected so far
+    m, rt, c = build(APP + """
+        from e1=InputStream[name == 'A']
+          -> every e2=InputStream[name == 'B']<2:2>
+          -> e3=InputStream[name == 'C']
+        select e2[0].name as n0, e2[1].name as n1
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("InputStream")
+    for i, n in enumerate(["A", "B", "B", "C", "B", "B", "C"]):
+        h.send(1000 + i * 100, [n])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("B", "B"), ("B", "B")]
+
+
+def test_every_count_midchain_two_groups_before_consumer():
+    # both completed pairs wait at the count step; one C event consumes
+    # both (reference every semantics: each waiting instance matches)
+    m, rt, c = build(APP + """
+        from e1=InputStream[name == 'A']
+          -> every e2=InputStream[name == 'B']<2:2>
+          -> e3=InputStream[name == 'C']
+        select e2[0].name as n0
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("InputStream")
+    for i, n in enumerate(["A", "B", "B", "B", "B", "C"]):
+        h.send(1000 + i * 100, [n])
+    m.shutdown()
+    assert len(c.events) == 2
+
+
+def test_every_range_count_rearms_on_consumption():
+    # range counts re-arm when the next step's event consumes the group
+    m, rt, c = build(APP + """
+        from e1=InputStream[name == 'A']
+          -> every e2=InputStream[name == 'B']<1:3>
+          -> e3=InputStream[name == 'C']
+        select e2[0].name as n0
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("InputStream")
+    for i, n in enumerate(["A", "B", "B", "C", "B", "C"]):
+        h.send(1000 + i * 100, [n])
+    m.shutdown()
+    # group1 = B,B consumed by first C; group2 = B consumed by second C
+    assert len(c.events) == 2
+
+
+def test_every_count_followed_by_logical_rejected():
+    with pytest.raises(CompileError, match="every.*count"):
+        build(APP + """
+            define stream S2 (name string);
+            from e1=InputStream[name == 'A']
+              -> every e2=InputStream[name == 'B']<2:2>
+              -> e3=InputStream[name == 'C'] and e4=S2[name == 'D']
+            select e2[0].name as n0
+            insert into OutStream;
+        """)
