@@ -149,6 +149,10 @@ def plan_join_query(
 
                     window_stage = create_keyed_window_stage(
                         h, ext_sdef, resolver, app_context)
+                    if not getattr(window_stage, "keyed", False):
+                        raise CompileError(
+                            f"window '{h.name}' cannot be a join side inside "
+                            f"a partition (no per-key probe surface)")
                 else:
                     window_stage = create_window_stage(h, ext_sdef, resolver, app_context)
                 if getattr(window_stage, "host_mode", False):

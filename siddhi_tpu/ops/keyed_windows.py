@@ -139,15 +139,30 @@ class KeyedTimeWindowStage(WindowStage):
     """Sliding time window per partition key (live clock driven). Each key
     keeps a FIFO ring of capacity ``Wc``; expiry scans the ``[K, Wc]`` ring
     (arrival order per key is timestamp-monotone, so the expired set is a
-    FIFO prefix per key)."""
+    FIFO prefix per key).
+
+    ``external=True`` is the keyed externalTime variant: each key's cutoff
+    clock advances only with that key's own events (the reference gives
+    every partition key its own ExternalTimeWindowProcessor instance), and
+    expired rows keep their original timestamps.
+
+    ``max_len`` is the keyed timeLength variant: on top of time expiry,
+    each insert beyond ``max_len`` live rows evicts its key's oldest row
+    (emitted EXPIRED just before the displacing insert —
+    TimeLengthWindowProcessor per key)."""
 
     keyed = True
-    needs_scheduler = True
 
-    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int):
+    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int,
+                 external: bool = False, max_len: int = None):
+        if external and max_len is not None:
+            raise CompileError("externalTime cannot combine with a length cap")
         self.time_ms = time_ms
-        self.capacity = capacity
+        self.capacity = max(capacity, max_len) if max_len is not None else capacity
         self.col_specs = col_specs
+        self.external = external
+        self.max_len = max_len
+        self.needs_scheduler = not external
 
     def init_state(self, num_keys: int = 1) -> dict:
         Wc = self.capacity
@@ -183,33 +198,117 @@ class KeyedTimeWindowStage(WindowStage):
         occupied = fifo_seq < total0[:, None]
         fifo_flat = (jnp.arange(K, dtype=jnp.int64)[:, None] * Wc + fifo_seq % Wc)
         ring_ts = state["buf"][TS_KEY][fifo_flat]
-        expire_ring = occupied & (ring_ts + t <= now)
-        n_exp_per_key = jnp.sum(expire_ring.astype(jnp.int64), axis=1)
 
-        # within-batch expiry: a row whose ts is already older than the
-        # cutoff expires before the next CURRENT row of the same key
         order, inv, occ, counts, start_pos = _per_key_layout(pk, valid_cur, K)
         B_idx = jnp.arange(B, dtype=jnp.int64)
-        # next valid row of the same key (in original coords; B if none)
-        nxt_sorted_pos = start_pos + occ + 1
-        has_next = (occ + 1) < counts[pk]
-        nxt = jnp.where(has_next, order[jnp.clip(nxt_sorted_pos, 0, B - 1)], B)
-        batch_exp = valid_cur & (ts + t <= now) & (nxt < B)
 
-        ring_rows = {k: state["buf"][k][fifo_flat.reshape(-1)] for k in state["buf"]}
-        ring_rows[TS_KEY] = jnp.where(expire_ring.reshape(-1), now, ring_rows[TS_KEY])
-        batch_exp_rows = {k: cols[k] for k in keys}
-        batch_exp_rows[TS_KEY] = jnp.broadcast_to(now, (B,))
+        if self.external:
+            # keyed externalTime: key k's clock advances only with key k's
+            # events. An item (ring or earlier batch row) expires just
+            # before the first same-key batch row whose ts covers it —
+            # found by a composite (key, ts) searchsorted over the
+            # key-grouped batch layout.
+            M = jnp.int64(1) << 42      # > any ms epoch until ~2109
+            ts_c = jnp.clip(ts, 0, M - 1)
+            safe_pk = jnp.where(valid_cur, pk, jnp.int64(K))
+            comp_sorted = (safe_pk[order] * M + ts_c[order]).astype(jnp.int64)
 
-        ring_okey = jnp.arange(K * Wc, dtype=jnp.int64)
-        batch_okey = BASE + nxt * STRIDE + B_idx
-        cur_okey = BASE + B_idx * STRIDE + B + 1
+            def first_covering(keys_of, item_ts):
+                tgt = keys_of * M + jnp.clip(item_ts + t, 0, M - 1)
+                pos = jnp.searchsorted(comp_sorted, tgt, side="left")
+                posc = jnp.clip(pos, 0, B - 1)
+                ok = (pos < B) & (safe_pk[order][posc] == keys_of)
+                return ok, jnp.where(ok, order[posc], B)
+
+            ring_keys = jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int64)[:, None], (K, Wc)).reshape(-1)
+            ring_cov, ring_anchor = first_covering(ring_keys, ring_ts.reshape(-1))
+            expire_ring = occupied & ring_cov.reshape(K, Wc)
+            n_exp_per_key = jnp.sum(expire_ring.astype(jnp.int64), axis=1)
+
+            batch_cov, batch_anchor = first_covering(
+                jnp.where(valid_cur, pk, jnp.int64(K)), ts)
+            batch_exp = valid_cur & batch_cov
+            nxt = batch_anchor
+
+            ring_rows = {k: state["buf"][k][fifo_flat.reshape(-1)] for k in state["buf"]}
+            batch_exp_rows = {k: cols[k] for k in keys}  # original timestamps
+
+            # anchor-major order: everything anchored before batch row a
+            # sorts between rows a-1 and a
+            STRIDE2 = jnp.int64(K * Wc + B + 2)
+            ring_okey = ring_anchor * STRIDE2 + jnp.arange(K * Wc, dtype=jnp.int64)
+            batch_okey = nxt * STRIDE2 + jnp.int64(K * Wc) + B_idx
+            cur_okey = B_idx * STRIDE2 + jnp.int64(K * Wc) + B + 1
+            extra_parts = []
+            len_cursor = None
+        else:
+            expire_ring = occupied & (ring_ts + t <= now)
+            n_exp_per_key = jnp.sum(expire_ring.astype(jnp.int64), axis=1)
+
+            # within-batch expiry: a row whose ts is already older than the
+            # cutoff expires before the next CURRENT row of the same key
+            nxt_sorted_pos = start_pos + occ + 1
+            has_next = (occ + 1) < counts[pk]
+            nxt = jnp.where(has_next, order[jnp.clip(nxt_sorted_pos, 0, B - 1)], B)
+            batch_exp = valid_cur & (ts + t <= now) & (nxt < B)
+
+            ring_rows = {k: state["buf"][k][fifo_flat.reshape(-1)] for k in state["buf"]}
+            batch_exp_rows = {k: cols[k] for k in keys}
+            batch_exp_rows[TS_KEY] = jnp.broadcast_to(now, (B,))
+
+            # anchor-major order: item anchored at batch row a sorts
+            # between rows a-1 and a; time ring expirees drain first
+            STRIDE_A = jnp.int64(K * Wc + B + 2)
+            KWc = jnp.int64(K * Wc)
+            ring_okey = jnp.arange(K * Wc, dtype=jnp.int64)
+            batch_okey = (nxt + 1) * STRIDE_A + KWc + B_idx
+            cur_okey = (B_idx + 1) * STRIDE_A + KWc + B + 1
+
+            if self.max_len is not None:
+                # timeLength: drain oldest rows so each key's live count
+                # stays <= L, each evictee anchored before its displacer
+                # (the insert L sequence numbers later)
+                L = jnp.int64(self.max_len)
+                n_be = jnp.zeros(K + 1, jnp.int64).at[
+                    jnp.where(batch_exp, pk, K)].add(1)[:K]
+                E = exp0 + n_exp_per_key + n_be      # cursor after time drain
+                n_len = jnp.maximum(total0 + counts - L - E, 0)
+                start_key = jnp.full((K + 1,), B, jnp.int64).at[
+                    jnp.where(valid_cur, pk, jnp.int64(K))].min(start_pos)[:K]
+
+                len_ring = occupied & (fifo_seq >= E[:, None]) & (
+                    fifo_seq < (E + n_len)[:, None])
+                disp_pos_r = start_key[:, None] + (fifo_seq + L - total0[:, None])
+                anchor_r = order[jnp.clip(disp_pos_r, 0, B - 1)]
+
+                seq_b = total0[pk] + occ
+                len_batch = valid_cur & (seq_b >= E[pk]) & (seq_b < (E + n_len)[pk])
+                disp_pos_b = start_pos + occ + L
+                anchor_b = order[jnp.clip(disp_pos_b, 0, B - 1)]
+
+                len_ring_rows = dict(ring_rows)
+                len_ring_rows[TS_KEY] = jnp.broadcast_to(now, (K * Wc,))
+                extra_parts = [
+                    (len_ring_rows, jnp.full((K * Wc,), EXPIRED, jnp.int8),
+                     len_ring.reshape(-1),
+                     (anchor_r.reshape(-1) + 1) * STRIDE_A + ring_okey),
+                    (batch_exp_rows, jnp.full((B,), EXPIRED, jnp.int8),
+                     len_batch, (anchor_b + 1) * STRIDE_A + KWc + B_idx),
+                ]
+                len_cursor = E + n_len
+            else:
+                extra_parts = []
+                len_cursor = None
+            ring_rows = dict(ring_rows)
+            ring_rows[TS_KEY] = jnp.where(expire_ring.reshape(-1), now,
+                                          ring_rows[TS_KEY])
 
         parts = [
             (ring_rows, jnp.full((K * Wc,), EXPIRED, jnp.int8), expire_ring.reshape(-1), ring_okey),
             (batch_exp_rows, jnp.full((B,), EXPIRED, jnp.int8), batch_exp, batch_okey),
             ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, cur_okey),
-        ]
+        ] + extra_parts
         out, _ = _order_emit(parts)
 
         # append inserts per key
@@ -221,17 +320,23 @@ class KeyedTimeWindowStage(WindowStage):
             jnp.where(batch_exp, pk, K)
         ].add(1)[:K]
         new_total = total0 + counts
-        new_exp = exp0 + n_exp_per_key + n_batch_exp_per_key
+        if len_cursor is not None:
+            new_exp = len_cursor       # includes time drain + length evictions
+        else:
+            new_exp = exp0 + n_exp_per_key + n_batch_exp_per_key
 
         live = new_total - new_exp
         out[OVERFLOW_KEY] = jnp.any(live > Wc).astype(jnp.int32)
 
-        fifo2 = new_exp[:, None] + j[None, :]
-        occ2 = fifo2 < new_total[:, None]
-        flat2 = jnp.arange(K, dtype=jnp.int64)[:, None] * Wc + fifo2 % Wc
-        ts2 = new_buf[TS_KEY][flat2]
-        nxt_notify = jnp.min(jnp.where(occ2, ts2 + t, _BIG))
-        out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt_notify, jnp.int64(-1))
+        if self.external:
+            out[NOTIFY_KEY] = jnp.int64(-1)   # expiry rides event arrivals
+        else:
+            fifo2 = new_exp[:, None] + j[None, :]
+            occ2 = fifo2 < new_total[:, None]
+            flat2 = jnp.arange(K, dtype=jnp.int64)[:, None] * Wc + fifo2 % Wc
+            ts2 = new_buf[TS_KEY][flat2]
+            nxt_notify = jnp.min(jnp.where(occ2, ts2 + t, _BIG))
+            out[NOTIFY_KEY] = jnp.where(jnp.any(occ2), nxt_notify, jnp.int64(-1))
         return {"buf": new_buf, "total": new_total, "expired_upto": new_exp}, out
 
     def contents(self, state):
@@ -663,6 +768,22 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
         return KeyedLengthWindowStage(int(_const_param(window, 0, "length")), col_specs)
     if name == "time":
         return KeyedTimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
+    if name == "externaltime":
+        # externalTime(tsAttr, time) — per-key cutoff driven by event ts
+        return KeyedTimeWindowStage(int(_const_param(window, 1, "time")),
+                                    col_specs, capacity, external=True)
+    if name == "timelength":
+        return KeyedTimeWindowStage(int(_const_param(window, 0, "time")),
+                                    col_specs, capacity,
+                                    max_len=int(_const_param(window, 1, "length")))
+    if name == "delay":
+        # delay is key-independent: the unkeyed stage (its ring carries the
+        # pk column) behaves identically per key and shards per device
+        from siddhi_tpu.ops.windows import DelayWindowStage
+
+        return DelayWindowStage(int(_const_param(window, 0, "delay")),
+                                col_specs,
+                                getattr(app_context, "window_capacity", 4096))
     if name == "lengthbatch":
         return KeyedLengthBatchWindowStage(
             int(_const_param(window, 0, "length")), col_specs)
@@ -674,5 +795,6 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
                                        col_specs, capacity)
     raise CompileError(
         f"window '{window.name}' inside a partition is not implemented yet "
-        f"(keyed variants exist for: length, lengthBatch, time, timeBatch, session)"
+        f"(keyed variants exist for: length, lengthBatch, time, timeBatch, "
+        f"externalTime, timeLength, delay, session)"
     )

@@ -33,7 +33,8 @@ _WINDOWS_HOST = [
     ("expression('<expr>')", "retention while the expression holds"),
     ("expressionBatch('<expr>')", "flushes when the expression breaks"),
 ]
-_WINDOWS_KEYED = ["length", "lengthBatch", "time", "timeBatch", "session"]
+_WINDOWS_KEYED = ["length", "lengthBatch", "time", "timeBatch",
+                  "externalTime", "timeLength", "delay", "session"]
 _AGGREGATORS = ["sum", "count", "avg", "min", "max", "stdDev", "and", "or",
                 "minForever", "maxForever"]
 _INCREMENTAL_AGGS = ["sum", "count", "avg", "min", "max", "distinctCount"]

@@ -1,0 +1,113 @@
+"""Keyed (inside-partition) externalTime, timeLength, and delay windows —
+per-key instances of ExternalTimeWindowProcessor / TimeLengthWindowProcessor
+/ DelayWindowProcessor (partitions give every key its own window)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+STREAM = "@app:playback define stream S (sym string, v int);\n"
+
+
+def test_keyed_external_time_sliding_sum():
+    # per-key clock: A's rows only expire when A gets new events
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.externalTime(v, 1 sec)
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 10])
+    h.send(1200, ["B", 100])
+    h.send(1500, ["A", 20])     # A window: 10+20
+    h.send(2300, ["A", 30])     # 1000+1000<=2300: row 10 expires -> 20+30
+    h.send(5000, ["B", 1])      # B: row 100 expired -> 1
+    m.shutdown()
+    got = {}
+    for e in c.events:
+        got[e.data[0]] = e.data[1]
+    by_seq = [tuple(e.data) for e in c.events]
+    assert ("A", 30) in by_seq       # after first A
+    assert by_seq[-2:] == [("A", 50), ("B", 1)] or got == {"A": 50, "B": 1}
+
+
+def test_keyed_external_time_expired_keep_timestamps():
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.externalTime(v, 1 sec)
+        select sym, v insert all events into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 1])
+    h.send(2500, ["A", 2])     # expires row 1
+    m.shutdown()
+    # arrival, expiry (original timestamp — ExternalTimeWindowProcessor
+    # keeps event time), then the new current
+    got = [(e.timestamp, tuple(e.data)) for e in c.events]
+    assert got == [(1000, ("A", 1)), (1000, ("A", 1)), (2500, ("A", 2))]
+
+
+def test_keyed_timelength_evicts_by_count_and_time():
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.timeLength(10 sec, 2)
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 1])
+    h.send(1100, ["A", 2])      # A live: 1,2
+    h.send(1200, ["A", 4])      # count cap 2: evict 1 -> total 6
+    h.send(1300, ["B", 100])    # B independent
+    h.send(1400, ["A", 8])      # evict 2 -> total 12
+    m.shutdown()
+    last = {}
+    for e in c.events:
+        last[e.data[0]] = e.data[1]
+    assert last == {"A": 12, "B": 100}
+
+
+def test_keyed_timelength_time_expiry_still_works():
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.timeLength(1 sec, 10)
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 5])
+    h.send(2500, ["A", 7])      # row 5 expired by time
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events][-1] == ("A", 7)
+
+
+def test_keyed_delay_releases_after_time():
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.delay(1 sec)
+        select sym, v insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 1])
+    h.send(1100, ["B", 2])
+    assert c.events == []        # still held
+    h.send(2200, ["A", 3])       # clock passes 2000: A1 and B2 release
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    # A1 and B2 released once the clock passed their +1s deadlines; A3's
+    # deadline (3200) never arrives before shutdown, so it stays held
+    assert got == [("A", 1), ("B", 2)]
