@@ -272,18 +272,25 @@ class LossyFrequentWindowStage(HostWindowStage):
 
 
 class SessionWindowStage(HostWindowStage):
-    """``session(gap[, key])``: events pass through as CURRENT and join
-    their key's open session; a session with no events for `gap` expires —
-    its events emit as one EXPIRED chunk (``SessionWindowProcessor``
-    without allowedLatency)."""
+    """``session(gap[, key[, allowedLatency]])``: events pass through as
+    CURRENT and join their key's open session; a session with no events
+    for `gap` expires — its events emit as one EXPIRED chunk. With
+    ``allowedLatency``, a gap-expired session is retained for the latency
+    period: a late event of the same key revives it (merging its rows back
+    into a live session), and only after the latency passes do its events
+    emit EXPIRED (``SessionWindowProcessor`` current/expired session
+    containers)."""
 
     needs_scheduler = True
 
-    def __init__(self, gap_ms: int, key_col: Optional[str], col_specs):
+    def __init__(self, gap_ms: int, key_col: Optional[str], col_specs,
+                 latency_ms: int = 0):
         super().__init__(col_specs)
         self.gap_ms = gap_ms
         self.key_col = key_col
+        self.latency_ms = latency_ms
         self._sessions: Dict[object, dict] = {}  # key -> {last, rows}
+        self._expired: Dict[object, dict] = {}   # key -> {last, rows, due}
 
     def _key(self, row):
         if self.key_col is None:
@@ -291,19 +298,35 @@ class SessionWindowStage(HostWindowStage):
         v = row[self.key_col]
         return v.item() if hasattr(v, "item") else v
 
+    def _emit_expired(self, rows, now, out_rows):
+        for r in rows:
+            expired = dict(r)
+            expired[TS_KEY] = now
+            expired[TYPE_KEY] = EXPIRED
+            out_rows.append(expired)
+
     def process(self, batch, now: int):
         cols = batch.cols
         out_rows: List[dict] = []
-        # expire idle sessions first
+        # gap-expired sessions: emit, or park in the expired container
         for k in list(self._sessions):
             s = self._sessions[k]
             if now - s["last"] >= self.gap_ms:
-                for r in s["rows"]:
-                    expired = dict(r)
-                    expired[TS_KEY] = now
-                    expired[TYPE_KEY] = EXPIRED
-                    out_rows.append(expired)
                 del self._sessions[k]
+                if self.latency_ms > 0:
+                    s["due"] = s["last"] + self.gap_ms + self.latency_ms
+                    old = self._expired.get(k)
+                    if old is not None:       # merge back-to-back sessions
+                        s["rows"] = old["rows"] + s["rows"]
+                    self._expired[k] = s
+                else:
+                    self._emit_expired(s["rows"], now, out_rows)
+        # latency-expired sessions: finally emit
+        for k in list(self._expired):
+            s = self._expired[k]
+            if now >= s["due"]:
+                del self._expired[k]
+                self._emit_expired(s["rows"], now, out_rows)
         for i in np.nonzero(cols[VALID_KEY])[0]:
             if cols[TYPE_KEY][i] != CURRENT:
                 continue
@@ -312,14 +335,23 @@ class SessionWindowStage(HostWindowStage):
             key = self._key(row)
             s = self._sessions.get(key)
             if s is not None and ts - s["last"] >= self.gap_ms:
-                for r in s["rows"]:
-                    expired = dict(r)
-                    expired[TS_KEY] = now
-                    expired[TYPE_KEY] = EXPIRED
-                    out_rows.append(expired)
+                if self.latency_ms > 0:
+                    s["due"] = s["last"] + self.gap_ms + self.latency_ms
+                    old = self._expired.get(key)
+                    if old is not None:
+                        s["rows"] = old["rows"] + s["rows"]
+                    self._expired[key] = s
+                else:
+                    self._emit_expired(s["rows"], now, out_rows)
+                del self._sessions[key]
                 s = None
             if s is None:
-                s = {"last": ts, "rows": []}
+                # a late event revives its key's retained expired session
+                revived = self._expired.pop(key, None)
+                if revived is not None:
+                    s = {"last": revived["last"], "rows": revived["rows"]}
+                else:
+                    s = {"last": ts, "rows": []}
                 self._sessions[key] = s
             s["last"] = max(s["last"], ts)
             s["rows"].append(row)
@@ -327,21 +359,34 @@ class SessionWindowStage(HostWindowStage):
             cur[TYPE_KEY] = CURRENT
             out_rows.append(cur)
         notify = None
-        if self._sessions:
-            notify = min(s["last"] for s in self._sessions.values()) + self.gap_ms
+        deadlines = [s["last"] + self.gap_ms for s in self._sessions.values()]
+        deadlines += [s["due"] for s in self._expired.values()]
+        if deadlines:
+            notify = min(deadlines)
         return _emit(out_rows, self.col_specs), notify
 
     def _held_rows(self):
-        return [r for s in self._sessions.values() for r in s["rows"]]
+        return ([r for s in self._sessions.values() for r in s["rows"]]
+                + [r for s in self._expired.values() for r in s["rows"]])
 
     def snapshot(self):
-        return {"sessions": {k: {"last": s["last"], "rows": [dict(r) for r in s["rows"]]}
-                             for k, s in self._sessions.items()}}
+        return {
+            "sessions": {k: {"last": s["last"], "rows": [dict(r) for r in s["rows"]]}
+                         for k, s in self._sessions.items()},
+            "expired": {k: {"last": s["last"], "due": s["due"],
+                            "rows": [dict(r) for r in s["rows"]]}
+                        for k, s in self._expired.items()},
+        }
 
     def restore(self, snap):
         self._sessions = {
             k: {"last": s["last"], "rows": [dict(r) for r in s["rows"]]}
             for k, s in snap["sessions"].items()
+        }
+        self._expired = {
+            k: {"last": s["last"], "due": s["due"],
+                "rows": [dict(r) for r in s["rows"]]}
+            for k, s in snap.get("expired", {}).items()
         }
 
 
@@ -677,16 +722,24 @@ def create_host_window_stage(window, input_def, resolver, app_context) -> HostWi
         return LossyFrequentWindowStage(support, error, key_cols, col_specs)
 
     if name == "session":
+        from siddhi_tpu.query_api.expressions import TimeConstant
+
         gap = int(_const_param(window, 0, "gap"))
         key_col = None
-        if len(window.parameters) >= 2:
-            p = window.parameters[1]
+        latency = 0
+        for p in window.parameters[1:]:
             if isinstance(p, Variable):
                 key_col = input_def.attribute(p.attribute_name).name
+            elif isinstance(p, (TimeConstant, Constant)):
+                latency = int(p.value if not isinstance(p.value, str) else 0)
             else:
                 raise CompileError(
-                    "session allowedLatency is not supported yet")
-        return SessionWindowStage(gap, key_col, col_specs)
+                    "session parameters are (gap[, key][, allowedLatency])")
+        if latency > gap:
+            # SessionWindowProcessor.validateAllowedLatency
+            raise CompileError(
+                "session allowedLatency must not be greater than the session gap")
+        return SessionWindowStage(gap, key_col, col_specs, latency)
 
     if name == "cron":
         expr = _const_param(window, 0, "cron expression")

@@ -884,19 +884,27 @@ class ExternalTimeBatchWindowStage(WindowStage):
     batch_mode = True
 
     def __init__(self, ts_fn, time_ms: int, col_specs: Dict[str, np.dtype],
-                 capacity: int, start_time: int = -1):
+                 capacity: int, start_time: int = -1, timeout: int = 0):
         self.ts_fn = ts_fn          # compiled expr for the time attribute
         self.time_ms = time_ms
         self.capacity = capacity
         self.col_specs = col_specs
         self.start_time = start_time
+        # timeout > 0: flush the open batch when no event arrives for
+        # `timeout` ms of runtime-clock time (scheduler-driven); the window
+        # end does NOT advance, and the next event-time crossing APPENDS to
+        # the already-flushed output instead of re-expiring it
+        # (ExternalTimeBatchWindowProcessor.java:256-307 timer path)
+        self.timeout = timeout
+        self.needs_scheduler = timeout > 0
 
     def init_state(self, num_keys: int = 1) -> dict:
         Wc = self.capacity
         zero = lambda: {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}  # noqa: E731
         return {"cur": zero(), "prev": zero(),
                 "count": jnp.int64(0), "prev_count": jnp.int64(0),
-                "end": jnp.int64(-1)}
+                "end": jnp.int64(-1),
+                "flushed": jnp.bool_(False), "last_sched": jnp.int64(-1)}
 
     def apply(self, state, cols, ctx):
         Wc = self.capacity
@@ -927,6 +935,20 @@ class ExternalTimeBatchWindowStage(WindowStage):
         n_flush = b_i[B - 1]
 
         count0 = state["count"]
+        flushed0 = state["flushed"]
+        last_sched0 = state["last_sched"]
+        if self.timeout > 0:
+            # timer-driven flush: no event arrived within `timeout`
+            has_timer = jnp.any(cols[VALID_KEY] & (cols[TYPE_KEY] == TIMER))
+            due = (has_timer & (last_sched0 >= 0) & (now >= last_sched0)
+                   & (state["end"] >= 0) & ((count0 > 0) | ~flushed0)
+                   & (n_flush == 0))
+        else:
+            due = jnp.bool_(False)
+        n_flush_eff = jnp.where(due, jnp.int64(1), n_flush)
+        # flush 1 appends to the already-timeout-flushed batch: its prev
+        # expiry and RESET are suppressed, prev grows instead of replacing
+        append1 = flushed0 & (n_flush_eff > 0)
         rank, n_ins = _insert_ranks(valid_cur)
         pos = rank  # arrival position among the batch's inserts
 
@@ -938,25 +960,25 @@ class ExternalTimeBatchWindowStage(WindowStage):
         lead = jnp.arange(Wc, dtype=jnp.int64)
         parts = []
         # prev state buffer expires at flush 1
-        prev_valid = (lead < state["prev_count"]) & (n_flush > 0)
+        prev_valid = (lead < state["prev_count"]) & (n_flush_eff > 0) & ~append1
         prev_rows = {k: state["prev"][k][lead.astype(jnp.int32)] for k in state["prev"]}
         prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
         parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid, S + lead))
         # carry-over cur buffer (window 0): CURRENT at flush 1, EXPIRED at flush 2
-        carry_valid = (lead < count0) & (n_flush > 0)
+        carry_valid = (lead < count0) & (n_flush_eff > 0)
         carry_rows = {k: state["cur"][k][lead.astype(jnp.int32)] for k in state["cur"]}
         parts.append((carry_rows, jnp.full((Wc,), CURRENT, jnp.int8), carry_valid,
                       S + CUR_OFF + lead))
-        carry_exp_valid = (lead < count0) & (n_flush > 1)
+        carry_exp_valid = (lead < count0) & (n_flush_eff > 1)
         carry_exp = dict(carry_rows)
         carry_exp[TS_KEY] = jnp.where(carry_exp_valid, now, carry_exp[TS_KEY])
         parts.append((carry_exp, jnp.full((Wc,), EXPIRED, jnp.int8), carry_exp_valid,
                       2 * S + lead))
         # batch rows of window k: CURRENT at flush k+1, EXPIRED at flush k+2
-        cur_valid = valid_cur & (b_i < n_flush)
+        cur_valid = valid_cur & (b_i < n_flush_eff)
         parts.append(({k: cols[k] for k in keys}, jnp.full((B,), CURRENT, jnp.int8),
                       cur_valid, (b_i + 1) * S + CUR_OFF + Wc + pos))
-        bexp_valid = valid_cur & (b_i + 1 < n_flush)
+        bexp_valid = valid_cur & (b_i + 1 < n_flush_eff)
         bexp = {k: cols[k] for k in keys}
         bexp[TS_KEY] = jnp.where(bexp_valid, now, cols[TS_KEY])
         parts.append((bexp, jnp.full((B,), EXPIRED, jnp.int8), bexp_valid,
@@ -964,7 +986,7 @@ class ExternalTimeBatchWindowStage(WindowStage):
         # one RESET per flush, between that flush's expired and currents
         n_reset_cap = B + 2
         ridx = jnp.arange(n_reset_cap, dtype=jnp.int64)
-        reset_valid = (ridx >= 1) & (ridx <= n_flush)
+        reset_valid = (ridx >= 1) & (ridx <= n_flush_eff) & ~(append1 & (ridx == 1))
         reset_rows = _zero_rows(cols, n_reset_cap)
         reset_rows[TS_KEY] = jnp.where(reset_valid, now, jnp.int64(0))
         parts.append((reset_rows, jnp.full((n_reset_cap,), RESET, jnp.int8),
@@ -974,8 +996,8 @@ class ExternalTimeBatchWindowStage(WindowStage):
         out[FLUSH_KEY] = jnp.where(okeys == _BIG, 0, okeys // S).astype(jnp.int32)
 
         # ---- state update
-        keep_old = n_flush == 0
-        is_rem = valid_cur & (b_i == n_flush)          # open window rows
+        keep_old = n_flush_eff == 0
+        is_rem = valid_cur & (b_i == n_flush_eff)          # open window rows
         rem_rank = jnp.cumsum(is_rem.astype(jnp.int64)) - 1
         base_cnt = jnp.where(keep_old, count0, 0)
         slot = jnp.where(is_rem, (base_cnt + rem_rank).astype(jnp.int32), Wc)
@@ -986,30 +1008,47 @@ class ExternalTimeBatchWindowStage(WindowStage):
         n_rem = jnp.sum(is_rem.astype(jnp.int64))
         new_count = base_cnt + n_rem
 
-        # prev <- window n_flush-1 (carry buffer if n_flush == 1 and no batch
+        # prev <- window n_flush_eff-1 (carry buffer if n_flush_eff == 1 and no batch
         # rows in window 0... both can contribute: carry + batch B==0 rows)
-        in_last = valid_cur & (b_i == n_flush - 1) & (n_flush > 0)
+        in_last = valid_cur & (b_i == n_flush_eff - 1) & (n_flush_eff > 0)
         last_rank = jnp.cumsum(in_last.astype(jnp.int64)) - 1
-        carry_in_last = (lead < count0) & (n_flush == 1)
-        pslot_carry = jnp.where(carry_in_last, lead.astype(jnp.int32), Wc)
-        n_carry_last = jnp.where(n_flush == 1, count0, 0)
-        pslot_batch = jnp.where(in_last, (n_carry_last + last_rank).astype(jnp.int32), Wc)
+        carry_in_last = (lead < count0) & (n_flush_eff == 1)
+        # append mode: the flushed batch is already in prev — grow it
+        app = append1 & (n_flush_eff == 1)
+        app_off = jnp.where(app, state["prev_count"], 0).astype(jnp.int32)
+        pslot_carry = jnp.where(carry_in_last, app_off + lead.astype(jnp.int32), Wc)
+        n_carry_last = jnp.where(n_flush_eff == 1, count0, 0)
+        pslot_batch = jnp.where(
+            in_last, app_off + (n_carry_last + last_rank).astype(jnp.int32), Wc)
         new_prev = {}
         for k in state["prev"]:
-            base = jnp.where(n_flush > 0, jnp.zeros_like(state["prev"][k]), state["prev"][k])
+            base = jnp.where((n_flush_eff > 0) & ~app,
+                             jnp.zeros_like(state["prev"][k]), state["prev"][k])
             base = base.at[pslot_carry].set(state["cur"][k], mode="drop")
             base = base.at[pslot_batch].set(cols[k], mode="drop")
             new_prev[k] = base
         n_last = jnp.sum(in_last.astype(jnp.int64)) + n_carry_last
-        new_prev_count = jnp.where(n_flush > 0, n_last, state["prev_count"])
+        new_prev_count = jnp.where(
+            n_flush_eff > 0,
+            n_last + jnp.where(app, state["prev_count"], 0),
+            state["prev_count"])
 
         any_first = jnp.any(valid_cur)
         new_end = jnp.where(state["end"] < 0,
                             jnp.where(any_first, end0 + n_flush * t, jnp.int64(-1)),
                             end0 + n_flush * t)
         out[OVERFLOW_KEY] = ((new_count > Wc) | (new_prev_count > Wc)).astype(jnp.int32)
+        new_flushed = jnp.where(n_flush > 0, jnp.bool_(False),
+                                jnp.where(due, jnp.bool_(True), flushed0))
+        new_sched = last_sched0
+        if self.timeout > 0:
+            resched = due | (n_flush > 0) | ((state["end"] < 0) & any_first)
+            new_sched = jnp.where(resched, now + jnp.int64(self.timeout),
+                                  last_sched0)
+            out[NOTIFY_KEY] = jnp.where(new_sched >= 0, new_sched, jnp.int64(-1))
         return {"cur": new_cur, "prev": new_prev, "count": new_count,
-                "prev_count": new_prev_count, "end": new_end}, out
+                "prev_count": new_prev_count, "end": new_end,
+                "flushed": new_flushed, "last_sched": new_sched}, out
 
     def contents(self, state):
         valid = jnp.arange(self.capacity, dtype=jnp.int64) < state["count"]
@@ -1080,12 +1119,12 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
                 raise CompileError(
                     "externalTimeBatch startTime must be a constant")
             start_time = int(p.value)
+        timeout = 0
         if len(window.parameters) >= 4:
-            raise CompileError(
-                "externalTimeBatch timeout parameter is not supported yet")
+            timeout = int(_const_param(window, 3, "timeout"))
         return ExternalTimeBatchWindowStage(
             ts_fn, int(_const_param(window, 1, "time")), col_specs, capacity,
-            start_time=start_time)
+            start_time=start_time, timeout=timeout)
     if name == "hopping":
         return HoppingWindowStage(
             int(_const_param(window, 0, "windowTime")),

@@ -18,7 +18,7 @@ _WINDOWS_DEVICE = [
     ("time(t)", "sliding time window"),
     ("timeBatch(t[, startTime])", "tumbling time window"),
     ("externalTime(tsAttr, t)", "sliding window on an event-time attribute"),
-    ("externalTimeBatch(tsAttr, t[, startTime])", "tumbling external-time window"),
+    ("externalTimeBatch(tsAttr, t[, startTime[, timeout]])", "tumbling external-time window"),
     ("batch()", "per-chunk batch window"),
     ("timeLength(t, n)", "time+count bounded sliding window"),
     ("delay(t)", "emits events delayed by t"),
@@ -28,7 +28,7 @@ _WINDOWS_HOST = [
     ("sort(n, attr[, 'asc'|'desc', ...])", "keeps the n smallest/largest"),
     ("frequent(n[, attrs])", "Misra-Gries frequent keys"),
     ("lossyFrequent(support[, error][, attrs])", "lossy counting"),
-    ("session(gap[, key])", "per-key session chunks"),
+    ("session(gap[, key[, allowedLatency]])", "per-key session chunks"),
     ("cron('<expr>')", "flushes on a cron schedule"),
     ("expression('<expr>')", "retention while the expression holds"),
     ("expressionBatch('<expr>')", "flushes when the expression breaks"),
