@@ -1,0 +1,210 @@
+"""Differential harness round 4: random traces over this round's features
+— stream functions, post-window filters, every-count patterns, and keyed
+externalTime / timeLength windows — vs plain-Python reference models."""
+
+import collections
+import math
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class SCollect(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(tuple(e.data) for e in events)
+
+
+def _run_engine_stream(app, sends, out="Out"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = SCollect()
+    rt.add_callback(out, c)
+    handlers = {}
+    for ts, sid, row in sends:
+        h = handlers.get(sid)
+        if h is None:
+            h = handlers[sid] = rt.get_input_handler(sid)
+        if ts is None:
+            h.send(row)
+        else:
+            h.send(ts, row)
+    m.shutdown()
+    return c.rows
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.rows = []   # (kind, tuple) in arrival order
+
+    def receive(self, timestamp, in_events, remove_events):
+        for e in in_events or []:
+            self.rows.append(("in", tuple(e.data)))
+        for e in remove_events or []:
+            self.rows.append(("rm", tuple(e.data)))
+
+
+def _run_engine(app, sends, qname="q"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback(qname, q)
+    handlers = {}
+    for ts, sid, row in sends:
+        h = handlers.get(sid)
+        if h is None:
+            h = handlers[sid] = rt.get_input_handler(sid)
+        if ts is None:
+            h.send(row)
+        else:
+            h.send(ts, row)
+    m.shutdown()
+    return q.rows
+
+
+def test_differential_pol2cart_filter_window_sum():
+    rng = np.random.default_rng(7)
+    sends = []
+    for _ in range(200):
+        theta = float(rng.choice([0.0, 30.0, 90.0, 150.0, 210.0, 330.0]))
+        rho = float(rng.integers(1, 5))
+        sends.append((None, "P", [theta, rho]))
+    app = """
+        define stream P (theta double, rho double);
+        @info(name='q')
+        from P#pol2Cart(theta, rho)[y > 0.0]#window.length(5)
+        select sum(y) as total insert into Out;
+    """
+    got = _run_engine(app, sends)
+    dq = collections.deque()
+    model = []
+    for _, _, (theta, rho) in sends:
+        y = rho * math.sin(math.radians(theta))
+        if y <= 0:
+            continue
+        dq.append(y)
+        if len(dq) > 5:
+            dq.popleft()
+        model.append(("in", (sum(dq),)))
+    assert len(got) == len(model)
+    for (gk, gv), (mk, mv) in zip(got, model):
+        assert gk == mk and abs(gv[0] - mv[0]) < 1e-9
+
+
+def test_differential_post_window_filter_all_events():
+    rng = np.random.default_rng(11)
+    sends = [(None, "S", [int(rng.integers(-50, 50))]) for _ in range(300)]
+    app = """
+        define stream S (v int);
+        @info(name='q')
+        from S#window.length(4)[v > 0]
+        select v insert all events into Out;
+    """
+    got = _run_engine(app, sends)
+    dq = collections.deque()
+    model = []
+    for _, _, (v,) in sends:
+        # QueryCallback groups each chunk's in-events before remove-events
+        rm = None
+        if len(dq) == 4:
+            ev = dq.popleft()
+            if ev > 0:
+                rm = ("rm", (ev,))
+        dq.append(v)
+        if v > 0:
+            model.append(("in", (v,)))
+        if rm is not None:
+            model.append(rm)
+    assert got == model
+
+
+def test_differential_every_count_tail():
+    rng = np.random.default_rng(13)
+    ts, sends, names = 1000, [], []
+    for _ in range(120):
+        ts += int(rng.integers(1, 40))
+        n = str(rng.choice(["A", "B"]))
+        names.append((ts, n))
+        sends.append((ts, "In", [n]))
+    app = """
+        @app:playback define stream In (name string);
+        @info(name='q')
+        from e1=In[name == 'A']<2:2> -> every e2=In[name == 'B']<2:2>
+        select e2[0].name as n0, e2[1].name as n1 insert into Out;
+    """
+    got = _run_engine(app, sends)
+    # model: first two A's arm; afterwards every non-overlapping B pair emits
+    a_seen, b_in_group, armed = 0, 0, False
+    model = []
+    for _ts, n in names:
+        if not armed:
+            if n == "A":
+                a_seen += 1
+                if a_seen == 2:
+                    armed = True
+        elif n == "B":
+            b_in_group += 1
+            if b_in_group == 2:
+                model.append(("in", ("B", "B")))
+                b_in_group = 0
+    assert got == model
+
+
+def test_differential_keyed_external_time():
+    rng = np.random.default_rng(17)
+    T = 400
+    ts, sends = 1000, []
+    for _ in range(250):
+        ts += int(rng.integers(1, 90))
+        sends.append((ts, "S", [f"k{int(rng.integers(0, 4))}", ts,
+                                int(rng.integers(1, 9))]))
+    app = f"""
+        @app:playback define stream S (sym string, ets long, v int);
+        partition with (sym of S) begin
+        from S#window.externalTime(ets, {T} milliseconds)
+        select sym, sum(v) as total insert into Out; end;
+    """
+    got = _run_engine_stream(app, sends)
+    held = collections.defaultdict(collections.deque)
+    model = []
+    for ts_i, _sid, (sym, _ets, v) in sends:
+        d = held[sym]
+        while d and d[0][0] + T <= ts_i:   # key's own clock advance
+            d.popleft()
+        d.append((ts_i, v))
+        model.append((sym, sum(x for _, x in d)))
+    assert got == model
+
+
+def test_differential_keyed_timelength():
+    rng = np.random.default_rng(23)
+    T, L = 600, 3
+    ts, sends = 1000, []
+    for _ in range(250):
+        ts += int(rng.integers(1, 60))
+        sends.append((ts, "S", [f"k{int(rng.integers(0, 4))}",
+                                int(rng.integers(1, 9))]))
+    app = f"""
+        @app:playback define stream S (sym string, v int);
+        partition with (sym of S) begin
+        from S#window.timeLength({T} milliseconds, {L})
+        select sym, sum(v) as total insert into Out; end;
+    """
+    got = _run_engine_stream(app, sends)
+    held = collections.defaultdict(collections.deque)
+    model = []
+    for ts_i, _sid, (sym, v) in sends:
+        for d in held.values():            # shared live clock
+            while d and d[0][0] + T <= ts_i:
+                d.popleft()
+        d = held[sym]
+        d.append((ts_i, v))
+        if len(d) > L:
+            d.popleft()
+        model.append((sym, sum(x for _, x in d)))
+    assert got == model
