@@ -384,6 +384,23 @@ class QueryRuntime(Receiver):
             notify_host = None
             if self.log_stages:
                 self._run_log_taps(batch)
+            partitioned = self.partition_ctx is not None
+            pk_done = False
+            if partitioned and self.host_window is not None:
+                # per-key host stages route rows by the pk column, so the
+                # partition key must be attached before the window runs
+                cols = batch.cols
+                if self.carried_pk:
+                    pk0 = cols.get(PK_KEY)
+                    if pk0 is None:
+                        pk0 = np.zeros(batch.capacity, np.int32)
+                elif self.partition_keyer is not None:
+                    cols, pk0 = self.partition_keyer.apply(cols)
+                    batch = HostBatch(cols)
+                else:
+                    pk0 = np.zeros(batch.capacity, np.int32)
+                batch.cols[PK_KEY] = np.asarray(pk0, np.int32)
+                pk_done = True
             if self.host_window is not None:
                 now_h = int(self.app_context.timestamp_generator.current_time())
                 ctx = {"xp": np, "current_time": now_h}
@@ -409,10 +426,15 @@ class QueryRuntime(Receiver):
                 batch = HostBatch(self._apply_host_transforms(
                     batch.cols, {"xp": np, "current_time": now_h}))
             cols = batch.cols
-            partitioned = self.partition_ctx is not None
             pk = None
             if partitioned:
-                if self.carried_pk:
+                if pk_done:
+                    # already attached (and carried through the host
+                    # window's emitted rows)
+                    pk = cols.get(PK_KEY)
+                    if pk is None:
+                        pk = np.zeros(batch.capacity, np.int32)
+                elif self.carried_pk:
                     pk = cols.get(PK_KEY)
                     if pk is None:
                         pk = np.zeros(batch.capacity, np.int32)

@@ -662,6 +662,85 @@ class ExpressionBatchWindowStage(HostWindowStage):
         self._prev = list(snap["prev"])
 
 
+class PartitionedHostWindow(HostWindowStage):
+    """Per-partition-key instances of a host window stage — the analog of
+    the reference PartitionRuntime creating one WindowProcessor instance
+    per key for windows inside ``partition with`` blocks. Rows are split
+    by the ``__pk__`` column in first-encounter order and each key's
+    sub-batch flows through that key's own stage instance; TIMER rows
+    fan out to every live instance."""
+
+    def __init__(self, factory):
+        probe = factory()
+        super().__init__({})
+        self.col_specs = dict(probe.col_specs)
+        self._factory = factory
+        self._stages: Dict[int, HostWindowStage] = {}
+        self.needs_scheduler = probe.needs_scheduler
+
+    def process(self, batch, now: int):
+        cols = batch.cols
+        pk = np.asarray(cols.get("__pk__", np.zeros(len(cols[VALID_KEY]), np.int32)))
+        valid = np.asarray(cols[VALID_KEY])
+        types = np.asarray(cols[TYPE_KEY])
+        is_timer = valid & (types == TIMER)
+        keys_in_order: List[int] = []
+        seen = set()
+        for i in np.nonzero(valid & (types == CURRENT))[0]:
+            k = int(pk[i])
+            if k not in seen:
+                seen.add(k)
+                keys_in_order.append(k)
+        targets = list(keys_in_order)
+        if is_timer.any():
+            targets += [k for k in self._stages if k not in seen]
+        from siddhi_tpu.core.event import HostBatch
+
+        out_cols_list, notify = [], None
+        for k in targets:
+            stage = self._stages.get(k)
+            if stage is None:
+                stage = self._stages[k] = self._factory()
+            mask = (valid & (pk == k)) | is_timer
+            idx = np.nonzero(mask)[0]
+            sub = HostBatch({c: np.asarray(v)[idx] for c, v in cols.items()})
+            sub.cols["__pk__"] = np.full(idx.size, k, np.int32)
+            b2, n2 = stage.process(sub, now)
+            v2 = b2.cols[VALID_KEY]
+            if v2.any():
+                out_cols_list.append({c: np.asarray(v)[v2]
+                                      for c, v in b2.cols.items()})
+            if n2 is not None:
+                notify = n2 if notify is None else min(notify, n2)
+        if not out_cols_list:
+            return _emit([], self.col_specs), notify
+        merged = {c: np.concatenate([o[c] for o in out_cols_list])
+                  for c in out_cols_list[0]}
+        n = merged[VALID_KEY].shape[0]
+        from siddhi_tpu.core.event import _pad_len
+
+        cap = _pad_len(n)
+        if cap != n:
+            pad = cap - n
+            for c in list(merged):
+                merged[c] = np.concatenate(
+                    [merged[c], np.zeros(pad, merged[c].dtype)])
+        return HostBatch(merged), notify
+
+    def _held_rows(self):
+        return [r for s in self._stages.values() for r in s._held_rows()]
+
+    def snapshot(self):
+        return {"keys": {str(k): s.snapshot() for k, s in self._stages.items()}}
+
+    def restore(self, snap):
+        self._stages = {}
+        for k, s in snap.get("keys", {}).items():
+            stage = self._factory()
+            stage.restore(s)
+            self._stages[int(k)] = stage
+
+
 def create_host_window_stage(window, input_def, resolver, app_context) -> HostWindowStage:
     from siddhi_tpu.ops.types import dtype_of
     from siddhi_tpu.ops.windows import _const_param

@@ -793,6 +793,17 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
     if name == "session":
         return KeyedSessionWindowStage(int(_const_param(window, 0, "gap")),
                                        col_specs, capacity)
+    if name in ("sort", "frequent", "lossyfrequent", "cron",
+                "expression", "expressionbatch"):
+        # host-mode windows inside a partition: one stage instance per key
+        from siddhi_tpu.ops.host_windows import (
+            PartitionedHostWindow,
+            create_host_window_stage,
+        )
+
+        return PartitionedHostWindow(
+            lambda: create_host_window_stage(window, input_def, resolver,
+                                             app_context))
     raise CompileError(
         f"window '{window.name}' inside a partition is not implemented yet "
         f"(keyed variants exist for: length, lengthBatch, time, timeBatch, "

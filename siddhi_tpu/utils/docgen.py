@@ -34,7 +34,9 @@ _WINDOWS_HOST = [
     ("expressionBatch('<expr>')", "flushes when the expression breaks"),
 ]
 _WINDOWS_KEYED = ["length", "lengthBatch", "time", "timeBatch",
-                  "externalTime", "timeLength", "delay", "session"]
+                  "externalTime", "timeLength", "delay", "session",
+                  "sort", "frequent", "lossyFrequent", "cron",
+                  "expression", "expressionBatch (per-key host instances)"]
 _AGGREGATORS = ["sum", "count", "avg", "min", "max", "stdDev", "and", "or",
                 "minForever", "maxForever"]
 _INCREMENTAL_AGGS = ["sum", "count", "avg", "min", "max", "distinctCount"]
