@@ -1,0 +1,215 @@
+"""Grammar-coverage battery: SiddhiQL surface shapes from the reference
+grammar (SiddhiQL.g4) that the hand-written parser must accept — pure
+parse/compile checks (no runtime assertions beyond successful build)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.compiler.compiler import SiddhiCompiler
+
+
+def parses(app: str):
+    return SiddhiCompiler().parse(app)
+
+
+def builds(app: str):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    m.shutdown()
+    return rt
+
+
+BASE = "define stream S (sym string, price double, vol long);\n"
+
+
+def test_comments_line_and_block():
+    parses("""
+        -- line comment
+        /* block
+           comment */
+        define stream S (a int);  -- trailing
+        from S select a insert into O;
+    """)
+
+
+def test_time_constant_chains():
+    # time_value: descending unit chains compose additively
+    app = parses(BASE + """
+        from S#window.time(1 hour 20 min 30 sec) select sym insert into O;
+    """)
+    q = app.execution_elements[0]
+    w = q.input_stream.handlers[0]
+    assert w.parameters[0].value == (3600 + 20 * 60 + 30) * 1000
+
+
+def test_time_constant_every_unit():
+    for unit, ms in [("milliseconds", 1), ("seconds", 1000), ("minutes", 60000),
+                     ("hours", 3600000), ("days", 86400000)]:
+        app = parses(BASE + f"from S#window.time(2 {unit}) select sym insert into O;")
+        w = app.execution_elements[0].input_stream.handlers[0]
+        assert w.parameters[0].value == 2 * ms
+
+
+def test_numeric_literal_suffixes():
+    # 10L, 10l (long), 1.5f/F (float), 1.5d/D (double)
+    builds(BASE + """
+        from S[vol > 10L and price > 1.5f and price < 99.5d]
+        select sym insert into O;
+    """)
+
+
+def test_scientific_literals():
+    # (the reference INT_LITERAL is decimal-only: no hex in SiddhiQL)
+    builds(BASE + "from S[price > 1.5e2] select sym insert into O;")
+
+
+def test_string_literals_quotes_and_escapes():
+    builds(BASE + """
+        from S[sym == "dq" or sym == 'sq' or sym == "it''s"]
+        select sym insert into O;
+    """)
+
+
+def test_triple_quoted_string():
+    builds(BASE + '''
+        from S[sym == """tri"ple"""] select sym insert into O;
+    ''')
+
+
+def test_annotation_nesting_and_elements():
+    builds("""
+        @app:name('Nested')
+        @app:description("desc, with commas")
+        define stream S (a int);
+        @info(name = 'q1')
+        from S select a insert into O;
+    """)
+
+
+def test_output_rate_forms():
+    for clause in ["output every 3 events", "output last every 1 sec",
+                   "output first every 2 events", "output all every 1 min",
+                   "output snapshot every 1 sec"]:
+        builds(BASE + f"from S select sym, price {clause} insert into O;")
+
+
+def test_join_type_keywords():
+    for jt in ["join", "inner join", "left outer join", "right outer join",
+               "full outer join"]:
+        builds(BASE + """define stream T (sym string, x double);
+            from S#window.length(5) %s T#window.length(5)
+            on S.sym == T.sym
+            select S.sym as sym, T.x as x insert into O;""" % jt)
+
+
+def test_unidirectional_join():
+    builds(BASE + """define stream T (sym string, x double);
+        from S#window.length(5) unidirectional join T#window.length(5)
+        on S.sym == T.sym
+        select S.sym as sym, T.x as x insert into O;""")
+
+
+def test_define_forms():
+    builds("""
+        define stream S (a int, b string);
+        define table T (a int, b string);
+        define window W (a int, b string) length(5) output all events;
+        define trigger Trg at every 5 sec;
+        define trigger Start at 'start';
+        from S select a, b insert into T;
+    """)
+
+
+def test_aggregation_define_and_range():
+    builds("""
+        define stream S (sym string, price double, ts long);
+        define aggregation Agg
+        from S select sym, avg(price) as ap
+        group by sym
+        aggregate by ts every sec ... year;
+    """)
+
+
+def test_on_demand_query_parse():
+    c = SiddhiCompiler()
+    q = c.parse_on_demand_query("from T on a > 5 select a, b")
+    assert q is not None
+
+
+def test_patterns_arrow_chains_and_groups():
+    builds("""
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from every (e1=A -> e2=B) -> e3=C[v > e1.v]
+        select e1.v as v1, e3.v as v3 insert into O;
+    """)
+
+
+def test_sequence_comma_chain():
+    builds("""
+        define stream A (v int); define stream B (v int);
+        from e1=A, e2=B[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into O;
+    """)
+
+
+def test_filter_math_and_functions_in_select():
+    builds(BASE + """
+        from S[not (price < 10.0) and (vol % 2 == 0 or sym != 'x')]
+        select sym, price * 1.1 as up, ifThenElse(price > 50.0, 'hi', 'lo') as band
+        insert into O;
+    """)
+
+
+def test_is_null_conditions():
+    builds(BASE + "from S[sym is null] select price insert into O;")
+    builds(BASE + "from S[not (sym is null)] select price insert into O;")
+
+
+def test_delete_update_output_actions():
+    builds("""
+        define stream S (a int);
+        define table T (a int);
+        from S insert into T;
+        from S delete T on T.a == a;
+    """)
+    builds("""
+        define stream S (a int);
+        define table T (a int);
+        from S update T set T.a = a on T.a < a;
+    """)
+    builds("""
+        define stream S (a int);
+        define table T (a int);
+        from S update or insert into T set T.a = a on T.a == a;
+    """)
+
+
+def test_current_expired_event_outputs():
+    builds(BASE + "from S#window.length(2) select sym insert current events into O;")
+    builds(BASE + "from S#window.length(2) select sym insert expired events into O;")
+    builds(BASE + "from S#window.length(2) select sym insert all events into O;")
+
+
+def test_group_by_having_order_limit_offset():
+    builds(BASE + """
+        from S#window.length(10)
+        select sym, avg(price) as ap
+        group by sym
+        having ap > 10.0
+        order by ap desc
+        limit 5
+        offset 1
+        insert into O;
+    """)
+
+
+def test_multiline_app_with_partition_and_inner_stream():
+    builds("""
+        define stream S (sym string, v int);
+        partition with (sym of S)
+        begin
+            from S select sym, v insert into #inner;
+            from #inner#window.length(2) select sym, sum(v) as t
+            insert into OutStream;
+        end;
+    """)
