@@ -313,6 +313,10 @@ def main():
         "nfa_p99_ms_per_batch": round(nfa["p99_ms"], 3),
         "nfa_events_per_sec": round(nfa["eps"], 1),
         "batch": BATCH,
+        # '_avg' in the metric name is the avg() aggregator in the query,
+        # not run averaging; sections take the best of 2 runs (tunnel
+        # stalls crater single windows — PERF.md cost model)
+        "runs": "best_of_2",
     }))
 
 

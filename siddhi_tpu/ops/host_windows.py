@@ -346,11 +346,17 @@ class SessionWindowStage(HostWindowStage):
                 del self._sessions[key]
                 s = None
             if s is None:
-                # a late event revives its key's retained expired session
-                revived = self._expired.pop(key, None)
-                if revived is not None:
+                # a late event revives its key's retained expired session —
+                # but only within the latency hold (event time vs due)
+                revived = self._expired.get(key)
+                if revived is not None and ts < revived["due"]:
+                    self._expired.pop(key)
                     s = {"last": revived["last"], "rows": revived["rows"]}
                 else:
+                    if revived is not None:
+                        # the hold passed at this event's time: emit it
+                        self._expired.pop(key)
+                        self._emit_expired(revived["rows"], now, out_rows)
                     s = {"last": ts, "rows": []}
                 self._sessions[key] = s
             s["last"] = max(s["last"], ts)
@@ -809,8 +815,9 @@ def create_host_window_stage(window, input_def, resolver, app_context) -> HostWi
         for p in window.parameters[1:]:
             if isinstance(p, Variable):
                 key_col = input_def.attribute(p.attribute_name).name
-            elif isinstance(p, (TimeConstant, Constant)):
-                latency = int(p.value if not isinstance(p.value, str) else 0)
+            elif (isinstance(p, (TimeConstant, Constant))
+                  and not isinstance(p.value, str)):
+                latency = int(p.value)
             else:
                 raise CompileError(
                     "session parameters are (gap[, key][, allowedLatency])")

@@ -959,11 +959,15 @@ class ExternalTimeBatchWindowStage(WindowStage):
         CUR_OFF = jnp.int64(Wc + B + 1)
         lead = jnp.arange(Wc, dtype=jnp.int64)
         parts = []
-        # prev state buffer expires at flush 1
-        prev_valid = (lead < state["prev_count"]) & (n_flush_eff > 0) & ~append1
+        # prev state buffer expires at flush 1 — except in append mode,
+        # where the appended output IS the prev batch continued, so prev
+        # expires together with it at flush 2 (if the chunk crosses twice)
+        prev_exp_flush = jnp.where(append1, jnp.int64(2), jnp.int64(1))
+        prev_valid = (lead < state["prev_count"]) & (n_flush_eff >= prev_exp_flush)
         prev_rows = {k: state["prev"][k][lead.astype(jnp.int32)] for k in state["prev"]}
         prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
-        parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid, S + lead))
+        parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid,
+                      prev_exp_flush * S + lead))
         # carry-over cur buffer (window 0): CURRENT at flush 1, EXPIRED at flush 2
         carry_valid = (lead < count0) & (n_flush_eff > 0)
         carry_rows = {k: state["cur"][k][lead.astype(jnp.int32)] for k in state["cur"]}

@@ -114,6 +114,40 @@ def test_session_latency_expires_after_hold():
     assert len(u1) == 2          # current + expired emission
 
 
+def test_session_latency_revive_bounded_by_due_same_batch():
+    # two same-key events far apart delivered in ONE batch must still
+    # split into two sessions (the revive checks event time vs due)
+    from siddhi_tpu.core.event import Event
+
+    m, rt, c = build(SESSION)
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=1000, data=["u1", 1]),
+            Event(timestamp=10000, data=["u1", 2])])
+    h.send(15000, ["u2", 0])    # drain timers
+    m.shutdown()
+    u1 = [tuple(e.data) for e in c.events if e.data[0] == "u1"]
+    # each row appears twice: CURRENT on arrival + EXPIRED with its own
+    # session (not one merged session)
+    assert u1.count(("u1", 1)) == 2 and u1.count(("u1", 2)) == 2
+
+
+def test_etb_timeout_flush_then_double_crossing_expires_prev():
+    # rows flushed by the idle timer must still emit EXPIRED when the next
+    # event crosses 2+ window boundaries (prev expires at flush 2)
+    m, rt, c = build("""@app:playback define stream S (ets long, v int);
+        from S#window.externalTimeBatch(ets, 10 sec, 0, 1 sec)
+        select v insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, [1000, 5])
+    h.send(2500, [1100, 7])      # timer flush {5} happened; 7 joins window 0
+    h.send(2600, [25000, 9])     # crosses 2 boundaries
+    m.shutdown()
+    fives = [e for e in c.events if e.data[0] == 5]
+    # 5 appears as CURRENT (arrival-flush) AND as EXPIRED eventually
+    assert len(fives) >= 2
+
+
 def test_session_latency_validation():
     with pytest.raises(CompileError, match="allowedLatency"):
         build("""define stream S (user string, v int);
