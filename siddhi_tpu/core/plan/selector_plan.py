@@ -152,8 +152,10 @@ class SelectorPlan:
             valid = valid & self.having_fn(out, ctx)
 
         if self.batch_mode and (self.contains_aggregator or self.group_by):
-            # keep only the last valid row per (flush epoch, group)
-            gk = out[GK_KEY] if self.group_by else jnp.zeros(B, jnp.int32)
+            # keep only the last valid row per (flush epoch, group) — GK is
+            # the partition id for keyless partitioned queries, so per-key
+            # flushes in one multi-key chunk stay distinct
+            gk = out[GK_KEY]
             flush = out.get(FLUSH_KEY, jnp.zeros(B, jnp.int32))
             combo = flush.astype(jnp.int64) * jnp.int64(self.num_keys + 1) + gk.astype(jnp.int64)
             combo = jnp.where(valid, combo, jnp.int64(2**62))  # invalid rows last

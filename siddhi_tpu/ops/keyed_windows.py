@@ -754,6 +754,94 @@ class KeyedSessionWindowStage(WindowStage):
                 "sess_overflow": state["sess_overflow"]}
 
 
+class KeyedBatchWindowStage(WindowStage):
+    """``#window.batch()`` per partition key: key k's window is its rows
+    from the latest chunk containing k; those rows expire when k's next
+    chunk arrives (each key has its own BatchWindowProcessor instance in
+    the reference partition runtime). Per key-in-chunk emission:
+    [EXPIRED(prev batch), RESET, CURRENT rows], keys ordered by first
+    appearance in the chunk."""
+
+    keyed = True
+    batch_mode = True
+
+    def __init__(self, col_specs: Dict[str, np.dtype], capacity: int):
+        self.col_specs = col_specs
+        self.capacity = capacity
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        prev = {k: jnp.zeros((num_keys * Wc,), dt) for k, dt in self.col_specs.items()}
+        return {"prev": prev, "prev_count": jnp.zeros((num_keys,), jnp.int64)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        K = state["prev_count"].shape[0]
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        pk = jnp.clip(cols[PK_KEY].astype(jnp.int64), 0, K - 1)
+        safe_pk = jnp.where(valid_cur, pk, jnp.int64(K))
+        B_idx = jnp.arange(B, dtype=jnp.int64)
+
+        order, _inv, occ, counts, _start = _per_key_layout(pk, valid_cur, K)
+        in_chunk = counts > 0                                    # [K]
+        # anchor: each key's first row index this chunk
+        first_row = jnp.full((K + 1,), B, jnp.int64).at[safe_pk].min(B_idx)[:K]
+
+        STRIDE = jnp.int64(Wc + B + 2)
+        grid_k = jnp.broadcast_to(
+            jnp.arange(K, dtype=jnp.int64)[:, None], (K, Wc))
+        widx = jnp.broadcast_to(jnp.arange(Wc, dtype=jnp.int64)[None, :], (K, Wc))
+        flat = (grid_k * Wc + widx).reshape(-1)
+
+        prev_valid = ((widx < state["prev_count"][:, None])
+                      & in_chunk[:, None]).reshape(-1)
+        prev_rows = {k: state["prev"][k][flat] for k in state["prev"]}
+        prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+        prev_okey = (first_row[grid_k.reshape(-1)] * STRIDE + widx.reshape(-1))
+
+        reset_valid = in_chunk & (state["prev_count"] > 0)
+        reset_rows = {k: jnp.zeros((K,), state["prev"][k].dtype)
+                      for k in state["prev"]}
+        reset_rows[TS_KEY] = jnp.where(reset_valid, now, jnp.int64(0))
+        reset_okey = first_row * STRIDE + Wc
+
+        cur_okey = first_row[pk] * STRIDE + Wc + 1 + B_idx
+
+        parts = [
+            (prev_rows, jnp.full((K * Wc,), EXPIRED, jnp.int8), prev_valid, prev_okey),
+            (reset_rows, jnp.full((K,), RESET, jnp.int8), reset_valid, reset_okey),
+            ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, cur_okey),
+        ]
+        out, _ = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
+
+        slot = jnp.where(valid_cur & (occ < Wc), pk * Wc + occ, jnp.int64(K * Wc))
+        new_prev = {}
+        clear = in_chunk[grid_k.reshape(-1)]   # wipe only keys in this chunk
+        for k in state["prev"]:
+            base = jnp.where(clear, jnp.zeros((), state["prev"][k].dtype),
+                             state["prev"][k])
+            new_prev[k] = base.at[slot].set(cols[k], mode="drop")
+        new_count = jnp.where(in_chunk, counts, state["prev_count"])
+        out[OVERFLOW_KEY] = jnp.any(counts > Wc).astype(jnp.int32)
+        return {"prev": new_prev, "prev_count": new_count}, out
+
+    def contents(self, state):
+        Wc = self.capacity
+        K = state["prev_count"].shape[0]
+        cols = {k: v.reshape(K, Wc) for k, v in state["prev"].items()}
+        j = jnp.arange(Wc, dtype=jnp.int64)[None, :]
+        valid = j < jnp.minimum(state["prev_count"], Wc)[:, None]
+        return cols, valid
+
+    def reset_keys(self, state, ids):
+        return {"prev": state["prev"],
+                "prev_count": state["prev_count"].at[ids].set(0)}
+
+
 def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:
     """Keyed (partitioned) window factory. Capacity per key comes from
     ``app_context.partition_window_capacity``."""
@@ -790,6 +878,8 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
     if name == "timebatch":
         return KeyedTimeBatchWindowStage(
             int(_const_param(window, 0, "time")), col_specs, capacity)
+    if name == "batch":
+        return KeyedBatchWindowStage(col_specs, capacity)
     if name == "session":
         return KeyedSessionWindowStage(int(_const_param(window, 0, "gap")),
                                        col_specs, capacity)

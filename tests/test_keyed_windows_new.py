@@ -95,6 +95,63 @@ def test_keyed_timelength_time_expiry_still_works():
     assert [tuple(e.data) for e in c.events][-1] == ("A", 7)
 
 
+def test_keyed_batch_window_per_key_chunks():
+    from siddhi_tpu.core.event import Event
+
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.batch()
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    # chunk 1: A{1,2}, B{10}
+    h.send([Event(timestamp=1000, data=["A", 1]),
+            Event(timestamp=1000, data=["A", 2]),
+            Event(timestamp=1000, data=["B", 10])])
+    # chunk 2: A{5} — replaces A's batch; B untouched
+    h.send(1100, ["A", 5])
+    m.shutdown()
+    rows = [tuple(e.data) for e in c.events]
+    # batch-mode sums per flush: chunk1 A->3, B->10; chunk2 A->5
+    assert rows[-1] == ("A", 5)
+    assert ("A", 3) in rows and ("B", 10) in rows
+
+
+def test_keyed_lengthbatch_multi_key_chunk_emits_every_key():
+    # regression: a single chunk flushing several keys' batches must emit
+    # one row per key, not just the chunk's last row
+    from siddhi_tpu.core.event import Event
+
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.lengthBatch(2)
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=1000, data=["A", 1]),
+            Event(timestamp=1000, data=["A", 2]),
+            Event(timestamp=1000, data=["B", 10]),
+            Event(timestamp=1000, data=["B", 20])])
+    m.shutdown()
+    rows = sorted(tuple(e.data) for e in c.events)
+    assert rows == [("A", 3), ("B", 30)]
+
+
+def test_keyed_batch_window_join_side_probes_latest_chunk():
+    m, rt, c = build("""
+        define stream S (sym string, v int);
+        define stream R (sym string, w int);
+        partition with (sym of S, sym of R) begin
+        from S#window.batch() join R#window.length(4)
+             on S.sym == R.sym
+        select S.sym as sym, S.v as v, R.w as w insert into OutStream; end;
+    """)
+    rt.get_input_handler("S").send(["A", 1])
+    rt.get_input_handler("R").send(["A", 7])   # probes A's latest batch {1}
+    m.shutdown()
+    assert ("A", 1, 7) in [tuple(e.data) for e in c.events]
+
+
 def test_keyed_delay_releases_after_time():
     m, rt, c = build(STREAM + """
         partition with (sym of S) begin
