@@ -504,6 +504,28 @@ class SiddhiAppRuntime:
 
     setStatisticsLevel = set_statistics_level
 
+    def start_trace(self, log_dir: str):
+        """Start a device-level profiler trace (XLA/TPU timeline) into
+        ``log_dir`` — the TPU-native answer to the reference's latency
+        tracker detail level: per-op device timings come from the XLA
+        profiler rather than per-processor stopwatches. View with
+        TensorBoard or xprof."""
+        import jax
+
+        if getattr(self, "_tracing", False):
+            raise RuntimeError("a trace is already running")
+        jax.profiler.start_trace(log_dir)
+        self._tracing = True
+        return log_dir
+
+    def stop_trace(self):
+        import jax
+
+        if not getattr(self, "_tracing", False):
+            raise RuntimeError("no trace is running")
+        jax.profiler.stop_trace()
+        self._tracing = False
+
     def shutdown(self):
         for qr in self.query_runtimes.values():
             if getattr(qr, "_deferred", None):

@@ -140,6 +140,14 @@ class SiddhiRestService:
             if parts[2] == "persist":
                 h._send(200, {"revision": rt.persist()})
                 return
+            if parts[2] == "trace":
+                # {"action": "start", "dir": ...} | {"action": "stop"}
+                if body.get("action") == "start":
+                    h._send(200, {"tracing": rt.start_trace(body["dir"])})
+                else:
+                    rt.stop_trace()
+                    h._send(200, {"tracing": None})
+                return
             if parts[2] == "restore":
                 rev = body.get("revision") if isinstance(body, dict) else None
                 if rev:
