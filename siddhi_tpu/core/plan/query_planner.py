@@ -252,6 +252,7 @@ def plan_join_query(
         output_event_type=output_event_type,
         batch_mode=False,
         dictionary=dictionary,
+        app_context=app_context,
     )
     selector_plan.num_keys = app_context.initial_key_capacity
 
@@ -372,6 +373,7 @@ def plan_nfa_query(
         output_event_type=output_event_type,
         batch_mode=False,
         dictionary=dictionary,
+        app_context=app_context,
     )
     selector_plan.num_keys = app_context.initial_key_capacity
 
@@ -494,6 +496,7 @@ def plan_query(
         output_event_type=output_event_type,
         batch_mode=batch_mode,
         dictionary=dictionary,
+        app_context=app_context,
     )
     selector_plan.num_keys = app_context.initial_key_capacity
 

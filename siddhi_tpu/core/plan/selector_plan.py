@@ -205,6 +205,7 @@ def plan_selector(
     output_event_type: str,
     batch_mode: bool,
     dictionary,
+    app_context=None,
 ) -> SelectorPlan:
     specs: List[agg_ops.AggSpec] = []
 
@@ -246,6 +247,12 @@ def plan_selector(
 
     current_on = output_event_type in ("current", "all")
     expired_on = output_event_type in ("expired", "all")
+
+    if app_context is not None:
+        for spec in specs:
+            if spec.kind == "distinctcount":
+                spec.distinct_capacity = getattr(
+                    app_context, "distinct_values_capacity", 64)
 
     return SelectorPlan(
         specs=specs,

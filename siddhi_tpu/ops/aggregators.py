@@ -51,6 +51,7 @@ class AggSpec:
     arg_type: Optional[AttrType]
     out_key: str                   # synthetic output column name (__agg<i>__)
     out_type: AttrType = AttrType.DOUBLE
+    distinct_capacity: int = 64    # distinctCount: per-group value slots
 
     # filled by the planner:
     @property
@@ -75,6 +76,8 @@ _AGG_DEFS = {
     "max": _AggDef(1, "max"),
     "minforever": _AggDef(1, "min"),
     "maxforever": _AggDef(1, "max"),
+    # multiset state, handled by its own scan path (_apply_distinct)
+    "distinctcount": _AggDef(1, "add"),
 }
 
 
@@ -94,6 +97,8 @@ def agg_result_type(kind: str, arg_type: Optional[AttrType]) -> AttrType:
         return AttrType.BOOL
     if kind in ("min", "max", "minforever", "maxforever"):
         return arg_type
+    if kind == "distinctcount":
+        return AttrType.LONG
     raise KeyError(kind)
 
 
@@ -126,6 +131,15 @@ def init_agg_state(specs: List[AggSpec], num_keys: int) -> dict:
     """State pytree: per spec a [slots, K] array (plus a seen-flag per key)."""
     state = {}
     for i, spec in enumerate(specs):
+        if spec.kind == "distinctcount":
+            H = spec.distinct_capacity
+            state[f"a{i}"] = {
+                "vk": jnp.zeros((num_keys, H), jnp.int64),     # value keys
+                "vc": jnp.full((num_keys, H), -1, jnp.int32),  # counts; -1 = empty
+                "stamp": jnp.zeros((num_keys,), jnp.int64),    # lazy-clear epoch
+                "eb": jnp.int64(0),                            # global epoch base
+            }
+            continue
         dtype = _slot_dtype(spec)
         init = _identity(spec.kind, dtype)
         state[f"a{i}"] = jnp.broadcast_to(jnp.asarray(init), (spec.slots, num_keys)).astype(dtype)
@@ -222,6 +236,84 @@ def _output(spec: AggSpec, slots, ctx):
     return slots[0], None
 
 
+
+
+def _encode_distinct_value(spec: AggSpec, cols, ctx):
+    """Value column -> int64 identity keys (floats by bit pattern; strings
+    are already dictionary ids), plus the null mask."""
+    v, m = spec.arg_fn(cols, ctx)
+    v = jnp.asarray(v)
+    if spec.arg_type == AttrType.FLOAT:
+        v = lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
+    elif spec.arg_type == AttrType.DOUBLE:
+        v = lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
+    return v.astype(jnp.int64), m
+
+
+def _apply_distinct(spec: AggSpec, st: dict, cols: dict, ctx: dict,
+                    num_keys: int, gk, participates, epoch_before,
+                    final_epoch):
+    """distinctCount: exact per-event running count of distinct live values
+    per group (DistinctCountAttributeAggregatorExecutor semantics: +1 on a
+    value's first CURRENT, -1 when its count returns to zero via EXPIRED).
+
+    State is a per-group open table of (value, count) pairs with lazy
+    RESET clearing via epoch stamps; the batch is processed by one
+    sequential ``lax.scan`` in arrival order — exact, not the fast path
+    (opt in by using the aggregator)."""
+    types = cols[TYPE_KEY]
+    B = gk.shape[0]
+    H = spec.distinct_capacity
+    K = num_keys
+
+    v, null_m = _encode_distinct_value(spec, cols, ctx)
+    part = participates
+    if null_m is not None:
+        part = part & ~jnp.asarray(null_m)
+    delta = jnp.where(types == CURRENT, jnp.int32(1), jnp.int32(-1))
+    g = jnp.clip(gk.astype(jnp.int32), 0, K - 1)
+    ep = st["eb"] + epoch_before.astype(jnp.int64)
+
+    def body(carry, x):
+        vk, vc, stamp, of = carry
+        gi, vi, di, pi, ei = x
+        vk_row = lax.dynamic_index_in_dim(vk, gi, 0, keepdims=False)   # [H]
+        vc_orig = lax.dynamic_index_in_dim(vc, gi, 0, keepdims=False)
+        fresh = stamp[gi] != ei
+        vc_row = jnp.where(fresh, jnp.int32(-1), vc_orig)
+        occupied = vc_row >= 0
+        match = occupied & (vk_row == vi)
+        has = jnp.any(match)
+        empty = ~occupied
+        slot = jnp.where(has, jnp.argmax(match), jnp.argmax(empty))
+        ok = has | jnp.any(empty)
+        cnt = jnp.where(has, vc_row[slot], jnp.int32(0))
+        newc = jnp.maximum(cnt + di, 0)
+        vk2_row = vk_row.at[slot].set(vi)
+        vc2_row = vc_row.at[slot].set(newc)
+        apply = pi & ok
+        vk_w = jnp.where(apply, vk2_row, vk_row)
+        vc_w = jnp.where(apply, vc2_row, vc_orig)
+        vk = lax.dynamic_update_index_in_dim(vk, vk_w, gi, 0)
+        vc = lax.dynamic_update_index_in_dim(vc, vc_w, gi, 0)
+        stamp = stamp.at[gi].set(jnp.where(apply, ei, stamp[gi]))
+        nd = jnp.sum(vc_w > 0).astype(jnp.int64)
+        of = of | (pi & ~ok)
+        return (vk, vc, stamp, of), nd
+
+    (vk, vc, stamp, of), nd = lax.scan(
+        body, (st["vk"], st["vc"], st["stamp"], jnp.bool_(False)),
+        (g, v, delta, part, ep))
+    new_st = {"vk": vk, "vc": vc, "stamp": stamp,
+              "eb": st["eb"] + final_epoch.astype(jnp.int64)}
+    cols = dict(cols)
+    cols[spec.out_key] = nd
+    prev = cols.get("__agg_overflow__")
+    ov = of.astype(jnp.int32)
+    cols["__agg_overflow__"] = ov if prev is None else jnp.maximum(prev, ov)
+    return new_st, cols
+
+
 def apply_aggregators(specs: List[AggSpec], state: dict, cols: dict, ctx: dict,
                       num_keys: int) -> Tuple[dict, dict]:
     """Run all aggregator scans for one batch.
@@ -269,6 +361,11 @@ def apply_aggregators(specs: List[AggSpec], state: dict, cols: dict, ctx: dict,
     cols = dict(cols)
     for i, spec in enumerate(specs):
         key = f"a{i}"
+        if spec.kind == "distinctcount":
+            new_state[key], cols = _apply_distinct(
+                spec, state[key], cols, ctx, num_keys, gk, participates,
+                epoch_before, final_epoch)
+            continue
         st = state[key]  # [slots, K]
         deltas = _deltas(spec, cols, ctx, xp)  # [slots, B]
         deltas_sorted = deltas[:, order]

@@ -38,7 +38,7 @@ _WINDOWS_KEYED = ["length", "lengthBatch", "batch", "time", "timeBatch",
                   "sort", "frequent", "lossyFrequent", "cron",
                   "expression", "expressionBatch (per-key host instances)"]
 _AGGREGATORS = ["sum", "count", "avg", "min", "max", "stdDev", "and", "or",
-                "minForever", "maxForever"]
+                "minForever", "maxForever", "distinctCount"]
 _INCREMENTAL_AGGS = ["sum", "count", "avg", "min", "max", "distinctCount"]
 _FUNCTIONS = [
     "cast(x, 'type')", "convert(x, 'type')", "ifThenElse(c, a, b)",
