@@ -132,6 +132,9 @@ class SelectorPlan:
         }
         if FLUSH_KEY in cols:
             out[FLUSH_KEY] = cols[FLUSH_KEY]
+        if "__agg_overflow__" in cols:
+            # distinctCount value-table saturation rides the meta channel
+            out["__overflow__"] = cols["__agg_overflow__"]
         if PK_KEY in cols:
             out[PK_KEY] = cols[PK_KEY]  # partition id rides along to the edge
         B = cols[TS_KEY].shape[0]

@@ -294,7 +294,10 @@ class QueryRuntime(Receiver):
             if notify is not None:
                 out["__notify__"] = notify
             if overflow is not None:
-                out["__overflow__"] = overflow
+                sel_ov = out.get("__overflow__")
+                out["__overflow__"] = overflow if sel_ov is None else jnp.maximum(
+                    jnp.asarray(overflow).astype(jnp.int32),
+                    jnp.asarray(sel_ov).astype(jnp.int32))
             return new_state, pack_meta(out)
 
         return step
@@ -459,6 +462,9 @@ class QueryRuntime(Receiver):
                 if self.partition_ctx is not None
                 else "app_context.window_capacity"
             )
+            if any(s.kind == "distinctcount"
+                   for s in self.selector_plan.specs or []):
+                knob += " (or app_context.distinct_values_capacity)"
             notify = self._finish_device_batch(
                 self._step, cols, f"window buffer capacity exceeded — raise {knob}")
         if notify_host is not None:

@@ -108,3 +108,23 @@ def test_distinct_count_differential_random():
     m.shutdown()
     got = [e.data[0] for e in c.events]
     assert got == model
+
+
+def test_distinct_count_capacity_overflow_raises():
+    from siddhi_tpu import SiddhiManager
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v long);
+        from S#window.length(100)
+        select distinctCount(v) as d insert into OutStream;
+    """)
+    q = next(iter(rt.query_runtimes.values()))
+    for spec in q.selector_plan.specs:
+        spec.distinct_capacity = 4   # shrink the value table
+    h = rt.get_input_handler("S")
+    import pytest
+    with pytest.raises(RuntimeError, match="distinct_values_capacity"):
+        for v in range(10):          # 10 live distinct values > 4 slots
+            h.send([v])
+    m.shutdown()
