@@ -754,6 +754,144 @@ class KeyedSessionWindowStage(WindowStage):
                 "sess_overflow": state["sess_overflow"]}
 
 
+class KeyedHoppingWindowStage(WindowStage):
+    """``hopping(windowTime, hopTime)`` per partition key: each key hops on
+    its own phase (the reference gives every key its own HopingWindowProcessor
+    whose first event arms the schedule); every hop emits the key's trailing
+    windowTime of events as a batch [EXPIRED(prev snapshot), RESET,
+    CURRENT(snapshot)]."""
+
+    keyed = True
+    batch_mode = True
+    needs_scheduler = True
+
+    def __init__(self, window_ms: int, hop_ms: int,
+                 col_specs: Dict[str, np.dtype], capacity: int):
+        if hop_ms <= 0 or window_ms <= 0:
+            raise CompileError("hopping window needs positive window and hop times")
+        self.window_ms = window_ms
+        self.hop_ms = hop_ms
+        self.capacity = capacity
+        self.col_specs = col_specs
+
+    def init_state(self, num_keys: int = 1) -> dict:
+        Wc = self.capacity
+        zero = lambda: {k: jnp.zeros((num_keys * Wc,), dt)  # noqa: E731
+                        for k, dt in self.col_specs.items()}
+        return {"buf": zero(), "prev": zero(),
+                "total": jnp.zeros((num_keys,), jnp.int64),
+                "expired_upto": jnp.zeros((num_keys,), jnp.int64),
+                "prev_count": jnp.zeros((num_keys,), jnp.int64),
+                "next_emit": jnp.full((num_keys,), -1, jnp.int64)}
+
+    def apply(self, state, cols, ctx):
+        Wc = self.capacity
+        K = state["total"].shape[0]
+        w = jnp.int64(self.window_ms)
+        hop = jnp.int64(self.hop_ms)
+        keys = _data_keys(cols)
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        pk = jnp.clip(cols[PK_KEY].astype(jnp.int64), 0, K - 1)
+
+        order, _inv, occ_r, counts, _start = _per_key_layout(pk, valid_cur, K)
+
+        # append arrivals to each key's ts-monotone FIFO ring
+        total0 = state["total"]
+        exp0 = state["expired_upto"]
+        seq = total0[pk] + occ_r
+        write = valid_cur & (occ_r >= counts[pk] - Wc)
+        slot = jnp.where(write, pk * Wc + seq % Wc, jnp.int64(K * Wc))
+        buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop")
+               for k in state["buf"]}
+        total = total0 + counts
+
+        # per-key hop schedule: a key's first event arms it
+        ne0 = state["next_emit"]
+        ne = jnp.where((ne0 < 0) & (total > 0), now + hop, ne0)
+        send = (ne >= 0) & (now >= ne)
+        ne2 = jnp.where(send, ne + hop, ne)
+
+        # stale rows (older than the trailing window) leave the live range
+        j = jnp.arange(Wc, dtype=jnp.int64)[None, :]
+        grid_k = jnp.arange(K, dtype=jnp.int64)[:, None]
+        fifo_seq = exp0[:, None] + j
+        occ = fifo_seq < total[:, None]
+        flat = (grid_k * Wc + fifo_seq % Wc).reshape(-1)
+        ring_ts = buf[TS_KEY][flat].reshape(K, Wc)
+        stale = occ & (ring_ts <= now - w)
+        new_exp = exp0 + jnp.sum(stale.astype(jnp.int64), axis=1)
+
+        in_window = occ & ~stale & send[:, None]
+        cur_rows = {k: buf[k][flat] for k in buf}
+        n_emit = jnp.sum(in_window.astype(jnp.int64), axis=1)
+
+        # key-major emission order: [EXPIRED prev, RESET, CURRENT snapshot]
+        STRIDE = jnp.int64(2 * Wc + 2)
+        kflat = jnp.broadcast_to(grid_k, (K, Wc)).reshape(-1)
+        prev_valid = ((j < state["prev_count"][:, None]) & send[:, None]).reshape(-1)
+        prev_rows = dict(state["prev"])
+        prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+        jflat = jnp.broadcast_to(j, (K, Wc)).reshape(-1)
+        reset_valid = send & (state["prev_count"] > 0)
+        reset_rows = {k: jnp.zeros((K,), v.dtype) for k, v in buf.items()}
+        reset_rows[TS_KEY] = jnp.where(reset_valid, now, jnp.int64(0))
+
+        parts = [
+            (prev_rows, jnp.full((K * Wc,), EXPIRED, jnp.int8), prev_valid,
+             kflat * STRIDE + jflat),
+            (reset_rows, jnp.full((K,), RESET, jnp.int8), reset_valid,
+             jnp.arange(K, dtype=jnp.int64) * STRIDE + Wc),
+            (cur_rows, jnp.full((K * Wc,), CURRENT, jnp.int8),
+             in_window.reshape(-1), kflat * STRIDE + Wc + 1 + jflat),
+        ]
+        out, _ = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
+
+        # emitted snapshot becomes each flushing key's next expiry batch
+        emit_rank = jnp.cumsum(in_window.astype(jnp.int64), axis=1) - 1
+        pslot = jnp.where(in_window, grid_k * Wc + emit_rank,
+                          jnp.int64(K * Wc)).reshape(-1)
+        clear = send[kflat]
+        new_prev = {}
+        for k in state["prev"]:
+            base = jnp.where(clear, jnp.zeros((), state["prev"][k].dtype),
+                             state["prev"][k])
+            new_prev[k] = base.at[pslot].set(cur_rows[k], mode="drop")
+        new_state = {
+            "buf": buf,
+            "prev": new_prev,
+            "total": total,
+            "expired_upto": new_exp,
+            "prev_count": jnp.where(send, n_emit, state["prev_count"]),
+            "next_emit": ne2,
+        }
+        pending = ne2 >= 0
+        out[NOTIFY_KEY] = jnp.where(jnp.any(pending),
+                                    jnp.min(jnp.where(pending, ne2, _BIG)),
+                                    jnp.int64(-1))
+        out[OVERFLOW_KEY] = jnp.any((total - new_exp) > Wc).astype(jnp.int32)
+        return new_state, out
+
+    def contents(self, state):
+        Wc = self.capacity
+        K = state["total"].shape[0]
+        j = jnp.arange(Wc, dtype=jnp.int64)[None, :]
+        fifo_seq = state["expired_upto"][:, None] + j
+        occ = fifo_seq < state["total"][:, None]
+        grid_k = jnp.arange(K, dtype=jnp.int64)[:, None]
+        flat = (grid_k * Wc + fifo_seq % Wc).reshape(-1)
+        cols = {k: v[flat].reshape(K, Wc) for k, v in state["buf"].items()}
+        return cols, occ
+
+    def reset_keys(self, state, ids):
+        return {"buf": state["buf"], "prev": state["prev"],
+                "total": state["total"].at[ids].set(0),
+                "expired_upto": state["expired_upto"].at[ids].set(0),
+                "prev_count": state["prev_count"].at[ids].set(0),
+                "next_emit": state["next_emit"].at[ids].set(-1)}
+
+
 class KeyedBatchWindowStage(WindowStage):
     """``#window.batch()`` per partition key: key k's window is its rows
     from the latest chunk containing k; those rows expire when k's next
@@ -880,6 +1018,10 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
             int(_const_param(window, 0, "time")), col_specs, capacity)
     if name == "batch":
         return KeyedBatchWindowStage(col_specs, capacity)
+    if name == "hopping":
+        return KeyedHoppingWindowStage(
+            int(_const_param(window, 0, "windowTime")),
+            int(_const_param(window, 1, "hopTime")), col_specs, capacity)
     if name == "session":
         return KeyedSessionWindowStage(int(_const_param(window, 0, "gap")),
                                        col_specs, capacity)

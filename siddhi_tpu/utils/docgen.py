@@ -33,7 +33,7 @@ _WINDOWS_HOST = [
     ("expression('<expr>')", "retention while the expression holds"),
     ("expressionBatch('<expr>')", "flushes when the expression breaks"),
 ]
-_WINDOWS_KEYED = ["length", "lengthBatch", "batch", "time", "timeBatch",
+_WINDOWS_KEYED = ["length", "lengthBatch", "batch", "time", "timeBatch", "hopping",
                   "externalTime", "timeLength", "delay", "session",
                   "sort", "frequent", "lossyFrequent", "cron",
                   "expression", "expressionBatch (per-key host instances)"]

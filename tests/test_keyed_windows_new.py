@@ -152,6 +152,25 @@ def test_keyed_batch_window_join_side_probes_latest_chunk():
     assert ("A", 1, 7) in [tuple(e.data) for e in c.events]
 
 
+def test_keyed_hopping_window_per_key_phase():
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.hopping(3 sec, 1 sec)
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["A", 1])        # A arms: first hop at 2000
+    h.send(1500, ["B", 10])       # B arms: first hop at 2500
+    h.send(2100, ["A", 2])        # A's hop at 2000 fired via timer/arrival
+    h.send(2600, ["B", 20])       # B's hop fired
+    h.send(3100, ["A", 4])        # A's 2nd hop (3000): trailing {1,2}
+    m.shutdown()
+    rows = [tuple(e.data) for e in c.events]
+    assert ("A", 1) in rows       # A's first hop: {1}
+    assert ("B", 10) in rows      # B's first hop: {10}
+    assert ("A", 3) in rows       # A's second hop: {1,2}
+
+
 def test_keyed_delay_releases_after_time():
     m, rt, c = build(STREAM + """
         partition with (sym of S) begin
