@@ -55,6 +55,64 @@ def _plan_stream_function_handler(handler, resolver, query_name, filters,
     return None, ext_def
 
 
+def _rewrite_string_casts(expr, input_def, resolver, transforms, ext_state,
+                          dictionary):
+    """Replace ``cast/convert(<string attr>, '<numeric>')`` nodes with
+    synthetic Variables backed by a host parse-LUT transform (strings are
+    dictionary ids — parsing happens host-side once per new dictionary
+    entry, the device sees a numeric column)."""
+    from siddhi_tpu.query_api.definitions import AttrType
+    from siddhi_tpu.query_api.expressions import (
+        AttributeFunction,
+        Constant,
+        Expression,
+        Variable,
+    )
+
+    if not isinstance(expr, Expression):
+        return expr
+    for attr in ("left", "right", "expression"):
+        child = getattr(expr, attr, None)
+        if isinstance(child, Expression):
+            setattr(expr, attr, _rewrite_string_casts(
+                child, input_def, resolver, transforms, ext_state, dictionary))
+    if isinstance(expr, AttributeFunction):
+        expr.parameters = [
+            _rewrite_string_casts(p, input_def, resolver, transforms,
+                                  ext_state, dictionary)
+            for p in expr.parameters]
+        numeric = {"int": AttrType.INT, "long": AttrType.LONG,
+                   "float": AttrType.FLOAT, "double": AttrType.DOUBLE}
+        if (not expr.namespace and expr.name.lower() in ("cast", "convert")
+                and len(expr.parameters) == 2
+                and isinstance(expr.parameters[1], Constant)
+                and isinstance(expr.parameters[1].value, str)
+                and expr.parameters[1].value.lower() in numeric
+                and isinstance(expr.parameters[0], Variable)):
+            var = expr.parameters[0]
+            try:
+                src = input_def.attribute(var.attribute_name)
+            except Exception:
+                return expr
+            if src.type != AttrType.STRING or not resolver.accepts_stream(
+                    var.stream_id):
+                return expr
+            target = numeric[expr.parameters[1].value.lower()]
+            key = (src.name, target)
+            name = ext_state["casts"].get(key)
+            if name is None:
+                from siddhi_tpu.ops.stream_functions import StringParseCastStage
+
+                name = f"__cast{len(ext_state['casts'])}__"
+                ext_state["casts"][key] = name
+                stage = StringParseCastStage(name, src.name, target, dictionary)
+                resolver.synthetic[name] = target
+                transforms.append(stage)
+                ext_state["attrs"].extend(stage.out_attrs)
+            return Variable(attribute_name=name)
+    return expr
+
+
 def plan_join_query(
     query: Query,
     query_name: str,
@@ -457,6 +515,28 @@ def plan_query(
     transforms = []
     log_stages = []
     ext_def = input_def  # grows as stream functions append attributes
+
+    # string -> numeric casts become host parse-LUT transforms feeding the
+    # device a synthetic numeric column (rewrites filter + selector ASTs)
+    cast_state = {"casts": {}, "attrs": []}
+    for handler in input_stream.handlers:
+        if isinstance(handler, Filter):
+            handler.expression = _rewrite_string_casts(
+                handler.expression, input_def, resolver, transforms,
+                cast_state, dictionary)
+    if query.selector is not None:
+        for sel in getattr(query.selector, "selection_list", []) or []:
+            sel.expression = _rewrite_string_casts(
+                sel.expression, input_def, resolver, transforms,
+                cast_state, dictionary)
+        if query.selector.having is not None:
+            query.selector.having = _rewrite_string_casts(
+                query.selector.having, input_def, resolver,
+                transforms, cast_state, dictionary)
+    if cast_state["attrs"]:
+        ext_def = StreamDefinition(input_def.id, list(input_def.attributes))
+        ext_def.attributes = ext_def.attributes + cast_state["attrs"]
+
     for handler in input_stream.handlers:
         if isinstance(handler, Filter):
             if window_stage is not None or host_window is not None:
@@ -501,7 +581,9 @@ def plan_query(
     selector_plan.num_keys = app_context.initial_key_capacity
 
     keyer = None
-    host_transforms = False
+    # parse-LUT cast stages are numpy-only: the whole transform chain then
+    # runs host-side (stream-function transforms handle xp=np equally)
+    host_transforms = bool(cast_state["casts"])
     if selector_plan.group_by:
         fns = []
         for var in query.selector.group_by_list:

@@ -175,6 +175,60 @@ def plan_pol2cart(handler, resolver) -> TransformStage:
     return TransformStage(out, fn)
 
 
+class StringParseCastStage(TransformStage):
+    """Host-side ``convert(strAttr, '<numeric>')``: dictionary ids map to
+    parsed values through a grow-on-demand LUT (the dictionary is
+    append-only, so parsed entries stay valid). Runs as a host transform
+    feeding the device step a synthetic numeric column; unparseable
+    strings yield null (ConvertFunctionExecutor returns null on failure)."""
+
+    def __init__(self, out_name: str, src_key: str, target: AttrType,
+                 dictionary):
+        self.out_attrs = [Attribute(out_name, target)]
+        self._src = src_key
+        self._target = target
+        self._dict = dictionary
+        self._vals = np.zeros(0, dtype_of(target))
+        self._bad = np.zeros(0, bool)
+
+    def _grow(self):
+        n = len(self._dict)
+        if n <= self._vals.shape[0]:
+            return
+        vals = np.zeros(n, self._vals.dtype)
+        bad = np.zeros(n, bool)
+        vals[: self._vals.shape[0]] = self._vals
+        bad[: self._bad.shape[0]] = self._bad
+        for i in range(self._vals.shape[0], n):
+            s = self._dict.decode(i)
+            try:
+                f = float(s)
+                if self._target in (AttrType.INT, AttrType.LONG):
+                    vals[i] = int(f)
+                else:
+                    vals[i] = f
+            except (TypeError, ValueError):
+                bad[i] = True
+        self._vals, self._bad = vals, bad
+
+    def apply(self, cols, ctx):
+        # numpy-only (host transform); ids clip to the LUT for safety
+        self._grow()
+        cols = dict(cols)
+        ids = np.asarray(cols[self._src])
+        safe = np.clip(ids, 0, max(len(self._vals) - 1, 0))
+        name = self.out_attrs[0].name
+        B = ids.shape[0]
+        if len(self._vals) == 0:
+            cols[name] = np.zeros(B, dtype_of(self._target))
+            cols[name + "?"] = np.ones(B, bool)
+            return cols
+        cols[name] = self._vals[safe]
+        null = np.asarray(cols.get(self._src + "?", np.zeros(B, bool)))
+        cols[name + "?"] = null | self._bad[safe] | (ids < 0)
+        return cols
+
+
 class StreamFunction:
     """Extension base for custom ``#name(args)`` stream functions: declare
     ``out_attrs`` (or make it a callable of the argument types) and

@@ -1,0 +1,61 @@
+"""Device-path string -> numeric casts via the host parse-LUT transform
+(ConvertFunctionExecutor semantics: unparseable -> null)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+def test_convert_string_to_double_in_select_and_filter():
+    m, rt, c = build("""
+        define stream S (txt string);
+        from S[convert(txt, 'double') > 10.0]
+        select convert(txt, 'double') as v insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    for s in ["5.5", "42.25", "nope", "100"]:
+        h.send([s])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [42.25, 100.0]
+
+
+def test_convert_string_to_long_with_window_sum():
+    m, rt, c = build("""
+        define stream S (txt string);
+        from S#window.length(2)
+        select sum(convert(txt, 'long')) as total insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    for s in ["3", "4", "5"]:
+        h.send([s])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [3, 7, 9]
+
+
+def test_convert_unparseable_yields_null():
+    m, rt, c = build("""
+        define stream S (txt string);
+        from S select convert(txt, 'double') as v insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["abc"])
+    h.send(["1.5"])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [None, 1.5]
