@@ -154,7 +154,8 @@ class KeyedTimeWindowStage(WindowStage):
     keyed = True
 
     def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int,
-                 external: bool = False, max_len: int = None):
+                 external: bool = False, max_len: int = None,
+                 ts_key: str = TS_KEY):
         if external and max_len is not None:
             raise CompileError("externalTime cannot combine with a length cap")
         self.time_ms = time_ms
@@ -162,6 +163,7 @@ class KeyedTimeWindowStage(WindowStage):
         self.col_specs = col_specs
         self.external = external
         self.max_len = max_len
+        self.ts_key = ts_key    # externalTime clock column (attribute)
         self.needs_scheduler = not external
 
     def init_state(self, num_keys: int = 1) -> dict:
@@ -209,7 +211,9 @@ class KeyedTimeWindowStage(WindowStage):
             # found by a composite (key, ts) searchsorted over the
             # key-grouped batch layout.
             M = jnp.int64(1) << 42      # > any ms epoch until ~2109
-            ts_c = jnp.clip(ts, 0, M - 1)
+            ck = cols[self.ts_key]
+            ring_ck = state["buf"][self.ts_key][fifo_flat]
+            ts_c = jnp.clip(ck, 0, M - 1)
             safe_pk = jnp.where(valid_cur, pk, jnp.int64(K))
             comp_sorted = (safe_pk[order] * M + ts_c[order]).astype(jnp.int64)
 
@@ -222,12 +226,12 @@ class KeyedTimeWindowStage(WindowStage):
 
             ring_keys = jnp.broadcast_to(
                 jnp.arange(K, dtype=jnp.int64)[:, None], (K, Wc)).reshape(-1)
-            ring_cov, ring_anchor = first_covering(ring_keys, ring_ts.reshape(-1))
+            ring_cov, ring_anchor = first_covering(ring_keys, ring_ck.reshape(-1))
             expire_ring = occupied & ring_cov.reshape(K, Wc)
             n_exp_per_key = jnp.sum(expire_ring.astype(jnp.int64), axis=1)
 
             batch_cov, batch_anchor = first_covering(
-                jnp.where(valid_cur, pk, jnp.int64(K)), ts)
+                jnp.where(valid_cur, pk, jnp.int64(K)), ck)
             batch_exp = valid_cur & batch_cov
             nxt = batch_anchor
 
@@ -995,9 +999,13 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
     if name == "time":
         return KeyedTimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
     if name == "externaltime":
-        # externalTime(tsAttr, time) — per-key cutoff driven by event ts
+        # externalTime(tsAttr, time) — per-key cutoff clock from the named
+        # timestamp attribute
+        from siddhi_tpu.ops.windows import _external_ts_key
+
         return KeyedTimeWindowStage(int(_const_param(window, 1, "time")),
-                                    col_specs, capacity, external=True)
+                                    col_specs, capacity, external=True,
+                                    ts_key=_external_ts_key(window, input_def))
     if name == "timelength":
         return KeyedTimeWindowStage(int(_const_param(window, 0, "time")),
                                     col_specs, capacity,

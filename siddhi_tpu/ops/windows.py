@@ -222,11 +222,15 @@ class TimeWindowStage(WindowStage):
     timestamps (externalTime) instead of the runtime clock."""
 
     def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int,
-                 external: bool = False):
+                 external: bool = False, ts_key: str = TS_KEY):
         self.time_ms = time_ms
         self.capacity = capacity
         self.col_specs = col_specs
         self.external = external
+        # externalTime clock column: the named timestamp ATTRIBUTE (falls
+        # back to the event timestamp) — expiry cutoffs read this column,
+        # expired emissions keep the original event timestamps
+        self.ts_key = ts_key
         self.needs_scheduler = not external
 
     def init_state(self, num_keys: int = 1) -> dict:
@@ -255,12 +259,14 @@ class TimeWindowStage(WindowStage):
         ring_ts = state["buf"][TS_KEY][fifo_slot]
 
         if self.external:
-            # cutoff for row i: ts_i - t (running max for safety)
-            run_max = lax.cummax(jnp.where(valid_cur, ts, jnp.int64(-(2**62))))
+            # cutoff for row i: clock_i - t (running max for safety)
+            ck = cols[self.ts_key]
+            ring_ck = state["buf"][self.ts_key][fifo_slot]
+            run_max = lax.cummax(jnp.where(valid_cur, ck, jnp.int64(-(2**62))))
             final_cutoff = run_max[B - 1] - t
-            expire_ring = occupied & (ring_ts <= final_cutoff)
+            expire_ring = occupied & (ring_ck <= final_cutoff)
             # first row whose cutoff covers item j
-            covers = (run_max[None, :] - t) >= ring_ts[:, None]  # [Wc, B]
+            covers = (run_max[None, :] - t) >= ring_ck[:, None]  # [Wc, B]
             first_row = jnp.where(
                 jnp.any(covers, axis=1), jnp.argmax(covers, axis=1), 0
             ).astype(jnp.int64)
@@ -1061,6 +1067,21 @@ class ExternalTimeBatchWindowStage(WindowStage):
 
 # ----------------------------------------------------------------- factory
 
+def _external_ts_key(window, input_def) -> str:
+    """externalTime clock column: a plain LONG attribute reference, else
+    the event timestamp."""
+    from siddhi_tpu.query_api.expressions import Variable
+
+    p0 = window.parameters[0] if window.parameters else None
+    if isinstance(p0, Variable):
+        attr = input_def.attribute(p0.attribute_name)
+        if attr.type != AttrType.LONG:
+            raise CompileError(
+                "externalTime timestamp attribute must be long (ms epoch)")
+        return attr.name
+    return TS_KEY
+
+
 def window_col_specs(input_def, extra: Tuple[str, ...] = ()) -> Dict[str, np.dtype]:
     """Column dtypes a window ring buffer must carry for a stream: every
     attribute + its null mask, the timestamp, and reserved id columns."""
@@ -1093,9 +1114,12 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
     if name == "time":
         return TimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
     if name == "externaltime":
-        # externalTime(tsAttr, time) — expiry driven by the event timestamps
+        # externalTime(tsAttr, time) — expiry driven by the named
+        # timestamp attribute (event ts when the expression isn't a plain
+        # long attribute)
+        ts_key = _external_ts_key(window, input_def)
         return TimeWindowStage(int(_const_param(window, 1, "time")), col_specs, capacity,
-                               external=True)
+                               external=True, ts_key=ts_key)
     if name == "timebatch":
         start_time = -1
         if len(window.parameters) >= 2:

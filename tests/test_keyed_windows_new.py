@@ -27,17 +27,17 @@ STREAM = "@app:playback define stream S (sym string, v int);\n"
 
 def test_keyed_external_time_sliding_sum():
     # per-key clock: A's rows only expire when A gets new events
-    m, rt, c = build(STREAM + """
+    m, rt, c = build("""@app:playback define stream S (sym string, ets long, v int);
         partition with (sym of S) begin
-        from S#window.externalTime(v, 1 sec)
+        from S#window.externalTime(ets, 1 sec)
         select sym, sum(v) as total insert into OutStream; end;
     """)
     h = rt.get_input_handler("S")
-    h.send(1000, ["A", 10])
-    h.send(1200, ["B", 100])
-    h.send(1500, ["A", 20])     # A window: 10+20
-    h.send(2300, ["A", 30])     # 1000+1000<=2300: row 10 expires -> 20+30
-    h.send(5000, ["B", 1])      # B: row 100 expired -> 1
+    h.send(1000, ["A", 1000, 10])
+    h.send(1200, ["B", 1200, 100])
+    h.send(1500, ["A", 1500, 20])     # A window: 10+20
+    h.send(2300, ["A", 2300, 30])     # 1000+1000<=2300: row 10 expires -> 20+30
+    h.send(5000, ["B", 5000, 1])      # B: row 100 expired -> 1
     m.shutdown()
     got = {}
     for e in c.events:
@@ -48,14 +48,14 @@ def test_keyed_external_time_sliding_sum():
 
 
 def test_keyed_external_time_expired_keep_timestamps():
-    m, rt, c = build(STREAM + """
+    m, rt, c = build("""@app:playback define stream S (sym string, ets long, v int);
         partition with (sym of S) begin
-        from S#window.externalTime(v, 1 sec)
+        from S#window.externalTime(ets, 1 sec)
         select sym, v insert all events into OutStream; end;
     """)
     h = rt.get_input_handler("S")
-    h.send(1000, ["A", 1])
-    h.send(2500, ["A", 2])     # expires row 1
+    h.send(1000, ["A", 1000, 1])
+    h.send(2500, ["A", 2500, 2])     # expires row 1
     m.shutdown()
     # arrival, expiry (original timestamp — ExternalTimeWindowProcessor
     # keeps event time), then the new current

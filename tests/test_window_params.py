@@ -154,3 +154,32 @@ def test_session_latency_validation():
             from S#window.session(1 sec, user, 2 sec)
             select user insert into OutStream;
         """)
+
+
+def test_external_time_uses_the_named_attribute():
+    # the clock attribute, not the event timestamp, drives expiry
+    m, rt, c = build("""@app:playback define stream S (ets long, v int);
+        from S#window.externalTime(ets, 1 sec)
+        select sum(v) as total insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(100, [1000, 1])
+    h.send(200, [2500, 2])   # attr clock passed 2000: row 1 expires
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [1, 2]
+
+
+def test_keyed_external_time_uses_the_named_attribute():
+    m, rt, c = build("""@app:playback define stream S (sym string, ets long, v int);
+        partition with (sym of S) begin
+        from S#window.externalTime(ets, 1 sec)
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(100, ["A", 1000, 1])
+    h.send(150, ["B", 1000, 5])
+    h.send(200, ["A", 2500, 2])   # A's attr clock expires A's row 1
+    h.send(250, ["B", 1100, 7])   # B's clock hasn't passed 2000
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert got == [("A", 1), ("B", 5), ("A", 2), ("B", 12)]
