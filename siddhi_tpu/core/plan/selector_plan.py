@@ -172,6 +172,7 @@ class SelectorPlan:
 
         if self.order_by:
             # jnp.lexsort: last key is the primary sort key
+            scalar_ov = out.pop("__overflow__", None)  # 0-d: not row-shaped
             keys = []
             for col, desc in reversed(self.order_by):
                 k = out[col]
@@ -182,6 +183,8 @@ class SelectorPlan:
             order = jnp.lexsort(keys)
             out = {k: v[order] for k, v in out.items()}
             valid = out[VALID_KEY]
+            if scalar_ov is not None:
+                out["__overflow__"] = scalar_ov
 
         if self.limit is not None or self.offset is not None:
             rank = jnp.cumsum(valid.astype(jnp.int32)) - 1

@@ -492,9 +492,15 @@ class QueryRuntime(Receiver):
         new_sel, sel_out = self._sel_step(self._state["sel"], dict(out_host), now)
         self._state["sel"] = new_sel
         out = LazyColumns(sel_out)
-        out.pop("__meta__", None)
+        meta = out.pop("__meta__", None)
         out.pop("__notify__", None)
         out.pop("__overflow__", None)
+        if meta is not None and int(np.asarray(meta)[0]) != 0:
+            # the selector step's own overflow (distinctCount value-table
+            # saturation) must not be silently clamped on the split path
+            raise RuntimeError(
+                "selector aggregation overflow — raise "
+                "app_context.distinct_values_capacity")
         return out
 
     def _finish_device_batch(self, step, cols, overflow_msg: str) -> Optional[int]:

@@ -281,7 +281,9 @@ def _apply_distinct(spec: AggSpec, st: dict, cols: dict, ctx: dict,
         vc_orig = lax.dynamic_index_in_dim(vc, gi, 0, keepdims=False)
         fresh = stamp[gi] != ei
         vc_row = jnp.where(fresh, jnp.int32(-1), vc_orig)
-        occupied = vc_row >= 0
+        # a slot whose count returned to 0 is dead: reclaimable, no longer
+        # matching — the table tracks LIVE values, not all-time cardinality
+        occupied = vc_row > 0
         match = occupied & (vk_row == vi)
         has = jnp.any(match)
         empty = ~occupied

@@ -199,16 +199,24 @@ class StringParseCastStage(TransformStage):
         bad = np.zeros(n, bool)
         vals[: self._vals.shape[0]] = self._vals
         bad[: self._bad.shape[0]] = self._bad
+        int_bounds = {
+            AttrType.INT: (-2**31, 2**31 - 1),
+            AttrType.LONG: (-2**63, 2**63 - 1),
+        }
         for i in range(self._vals.shape[0], n):
             s = self._dict.decode(i)
             try:
                 f = float(s)
-                if self._target in (AttrType.INT, AttrType.LONG):
-                    vals[i] = int(f)
+                if self._target in int_bounds:
+                    v = int(f)
+                    lo, hi = int_bounds[self._target]
+                    if not (lo <= v <= hi):
+                        raise OverflowError(v)
+                    vals[i] = v
                 else:
                     vals[i] = f
-            except (TypeError, ValueError):
-                bad[i] = True
+            except (TypeError, ValueError, OverflowError):
+                bad[i] = True   # unparseable/out-of-range -> null
         self._vals, self._bad = vals, bad
 
     def apply(self, cols, ctx):

@@ -280,7 +280,8 @@ class TimeWindowStage(WindowStage):
 
         # within-batch expiry: row i's clone expires before a later row r
         if self.external:
-            nxt = _first_later_covering(ts, valid_cur, t)  # [B] (B if none)
+            # coverage by the clock attribute, not the event timestamp
+            nxt = _first_later_covering(cols[self.ts_key], valid_cur, t)  # [B] (B if none)
             batch_exp = valid_cur & (nxt < B)
             exp_ts_batch = ts
         else:

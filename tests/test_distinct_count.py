@@ -128,3 +128,19 @@ def test_distinct_count_capacity_overflow_raises():
         for v in range(10):          # 10 live distinct values > 4 slots
             h.send([v])
     m.shutdown()
+
+
+def test_distinct_count_unbounded_cardinality_reuses_dead_slots():
+    # 70 unique all-time values but never more than 3 live: zero-count
+    # slots must be reclaimed, not exhaust the table
+    m, rt, c = build("""
+        define stream S (sym string);
+        from S#window.length(3)
+        select distinctCount(sym) as d insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    for i in range(70):
+        h.send([f"v{i}"])
+    m.shutdown()
+    got = [e.data[0] for e in c.events]
+    assert got[:3] == [1, 2, 3] and all(d == 3 for d in got[3:])

@@ -59,3 +59,15 @@ def test_convert_unparseable_yields_null():
     h.send(["1.5"])
     m.shutdown()
     assert [e.data[0] for e in c.events] == [None, 1.5]
+
+
+def test_convert_overflow_values_yield_null():
+    m, rt, c = build("""
+        define stream S (txt string);
+        from S select convert(txt, 'int') as v insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    for s in ["1e400", "3000000000", "7"]:
+        h.send([s])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [None, None, 7]

@@ -183,3 +183,18 @@ def test_keyed_external_time_uses_the_named_attribute():
     m.shutdown()
     got = [tuple(e.data) for e in c.events]
     assert got == [("A", 1), ("B", 5), ("A", 2), ("B", 12)]
+
+
+def test_external_time_attribute_clock_within_one_chunk():
+    # both events in ONE chunk: in-batch expiry must use the clock attr
+    from siddhi_tpu.core.event import Event
+
+    m, rt, c = build("""@app:playback define stream S (ets long, v int);
+        from S#window.externalTime(ets, 1 sec)
+        select sum(v) as total insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=100, data=[1000, 1]),
+            Event(timestamp=200, data=[2500, 2])])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [1, 2]
