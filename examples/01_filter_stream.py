@@ -1,0 +1,31 @@
+"""Quick start: filter + projection (the reference's hello-world app)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class PrintCallback(StreamCallback):
+    def receive(self, events):
+        for e in events:
+            print("out:", e.data)
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price double, volume long);
+
+        @info(name = 'filter-query')
+        from StockStream[price > 100.0]
+        select symbol, price
+        insert into HighPriceStream;
+    """)
+    runtime.add_callback("HighPriceStream", PrintCallback())
+    stocks = runtime.get_input_handler("StockStream")
+    stocks.send(["WSO2", 105.5, 100])
+    stocks.send(["CHEAP", 20.0, 50])
+    stocks.send(["GOOG", 220.0, 10])
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

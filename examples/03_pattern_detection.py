@@ -1,0 +1,33 @@
+"""CEP pattern: every A followed by a higher-priced B within 5 seconds,
+per key — the dense-NFA hot path."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class PrintCallback(StreamCallback):
+    def receive(self, events):
+        for e in events:
+            print("match:", e.data)
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        @app:playback
+        define stream Ticks (symbol string, price double);
+
+        from every e1=Ticks -> e2=Ticks[symbol == e1.symbol and price > e1.price]
+             within 5 sec
+        select e1.symbol as symbol, e1.price as p1, e2.price as p2
+        insert into Rises;
+    """)
+    runtime.add_callback("Rises", PrintCallback())
+    h = runtime.get_input_handler("Ticks")
+    h.send(1000, ["ACME", 10.0])
+    h.send(2000, ["ACME", 12.0])     # match (10 -> 12)
+    h.send(9000, ["ACME", 50.0])     # outside 'within' of the first pair
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Partitions (per-key state), tables with primary keys, and on-demand
+queries."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        define stream Logins (user string, ok bool);
+        @primaryKey('user')
+        define table FailCounts (user string, fails long);
+
+        partition with (user of Logins)
+        begin
+            from Logins[not ok]#window.length(100)
+            select user, count() as fails
+            insert into #tally;
+
+            from #tally select user, fails update or insert into FailCounts
+                set FailCounts.fails = fails
+                on FailCounts.user == user;
+        end;
+    """)
+    h = runtime.get_input_handler("Logins")
+    for user, ok in [("alice", False), ("bob", False), ("alice", False)]:
+        h.send([user, ok])
+
+    rows = runtime.query("from FailCounts select user, fails")
+    print("fail counts:", sorted(tuple(e.data) for e in rows))
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Sources/sinks over the InMemoryBroker, plus checkpoint/restore."""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+from siddhi_tpu.extension import InMemoryBroker
+
+
+def main():
+    received = []
+
+    class AlertTap:
+        topic = "alerts"
+
+        @staticmethod
+        def on_message(payload):
+            received.append(payload)
+
+    InMemoryBroker.subscribe(AlertTap)
+
+    manager = SiddhiManager()
+    manager.set_persistence_store(InMemoryPersistenceStore())
+    runtime = manager.create_siddhi_app_runtime("""
+        @source(type='inMemory', topic='ticks', @map(type='passThrough'))
+        define stream Ticks (symbol string, price double);
+
+        @sink(type='inMemory', topic='alerts', @map(type='passThrough'))
+        define stream Alerts (symbol string, price double);
+
+        from Ticks[price > 100.0] select symbol, price insert into Alerts;
+    """)
+    runtime.start()
+    InMemoryBroker.publish("ticks", ["ACME", 150.0])
+    InMemoryBroker.publish("ticks", ["ACME", 50.0])
+
+    revision = runtime.persist()            # checkpoint
+    runtime.restore_revision(revision)      # and restore
+    print("alerts:", received, "| revision:", revision)
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()
