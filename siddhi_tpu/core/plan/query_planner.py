@@ -509,6 +509,7 @@ def plan_query(
 
     filters = []
     post_filters = []   # after the window: mask emitted rows (FilterProcessor downstream of a WindowProcessor)
+    post_pipeline = []  # ordered post-window stages: ("f", cond) | ("t", transform)
     window_stage = None
     host_window = None
     batch_mode = False
@@ -540,7 +541,9 @@ def plan_query(
     for handler in input_stream.handlers:
         if isinstance(handler, Filter):
             if window_stage is not None or host_window is not None:
-                post_filters.append(compile_condition(handler.expression, resolver))
+                f = compile_condition(handler.expression, resolver)
+                post_filters.append(f)
+                post_pipeline.append(("f", f))
             else:
                 filters.append(compile_condition(handler.expression, resolver))
         elif isinstance(handler, Window):
@@ -560,13 +563,22 @@ def plan_query(
                 window_stage = None
         elif isinstance(handler, StreamFunction):
             if window_stage is not None or host_window is not None:
-                raise CompileError(
-                    "post-window stream functions are not supported yet")
-            log_stage, ext_def = _plan_stream_function_handler(
-                handler, resolver, query_name, filters, transforms,
-                ext_def, input_def)
-            if log_stage is not None:
-                log_stages.append(log_stage)
+                # post-window stream functions transform the window's
+                # EMITTED rows (their outputs are not buffered)
+                post_transforms = []
+                log_stage, ext_def = _plan_stream_function_handler(
+                    handler, resolver, query_name, filters, post_transforms,
+                    ext_def, input_def)
+                if log_stage is not None:
+                    raise CompileError(
+                        "#log() after a window is not supported")
+                post_pipeline.extend(("t", t) for t in post_transforms)
+            else:
+                log_stage, ext_def = _plan_stream_function_handler(
+                    handler, resolver, query_name, filters, transforms,
+                    ext_def, input_def)
+                if log_stage is not None:
+                    log_stages.append(log_stage)
 
     output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
     selector_plan = plan_selector(
@@ -600,7 +612,7 @@ def plan_query(
     # path for windowed aggregation (see ops/fused_agg.py)
     if (
         window_stage is not None
-        and not post_filters   # fused stages never materialize emitted rows
+        and not post_pipeline  # fused stages never materialize emitted rows
         and partition_ctx is None
         and getattr(app_context, "enable_fusion", True)
         and stream_id not in getattr(app_context, "named_windows", {})
@@ -629,6 +641,7 @@ def plan_query(
         transforms=transforms,
         log_stages=log_stages,
         post_filters=post_filters,
+        post_pipeline=post_pipeline,
     )
     runtime.host_transforms = host_transforms
     runtime.host_window = host_window

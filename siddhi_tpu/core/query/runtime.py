@@ -107,6 +107,7 @@ class QueryRuntime(Receiver):
         transforms=None,
         log_stages=None,
         post_filters=None,
+        post_pipeline=None,
     ):
         self.name = name
         self.app_context = app_context
@@ -115,6 +116,10 @@ class QueryRuntime(Receiver):
         self.transforms = transforms or []   # ops/stream_functions stages
         self.log_stages = log_stages or []   # host #log() taps
         self.post_filters = post_filters or []  # masks on window-emitted rows
+        # ordered post-window stages ("f", cond) | ("t", transform); falls
+        # back to post_filters when only filters exist
+        self.post_pipeline = post_pipeline if post_pipeline is not None else [
+            ("f", f) for f in (post_filters or [])]
         self.host_transforms = False         # run transforms host-side (keyer needs them)
         self.window_stage = window_stage
         self.selector_plan = selector_plan
@@ -261,7 +266,7 @@ class QueryRuntime(Receiver):
         host_pre = self.host_window is not None
         filters = [] if host_pre else list(self.filters)
         transforms = [] if (host_pre or self.host_transforms) else list(self.transforms)
-        post_filters = [] if host_pre else list(self.post_filters)
+        post_pipeline = [] if host_pre else list(self.post_pipeline)
         sel = self.selector_plan
         win = self.window_stage
 
@@ -283,13 +288,15 @@ class QueryRuntime(Receiver):
                 cols = dict(cols)
                 notify = cols.pop("__notify__", None)
                 overflow = cols.pop("__overflow__", None)
-                # post-window filters mask emitted rows (window retention
-                # is unaffected — the filter sits downstream of the window)
-                pvalid = cols[VALID_KEY]
+                # post-window stages transform/mask emitted rows (window
+                # retention is unaffected — they sit downstream of it)
                 ptimer = cols[TYPE_KEY] == 2
-                for f in post_filters:
-                    pvalid = pvalid & (f(cols, ctx) | ptimer)
-                cols[VALID_KEY] = pvalid
+                for kind, obj in post_pipeline:
+                    if kind == "t":
+                        cols = obj.apply(cols, ctx)
+                    else:
+                        cols[VALID_KEY] = cols[VALID_KEY] & (
+                            obj(cols, ctx) | ptimer)
             new_state["sel"], out = sel.apply(state["sel"], cols, ctx)
             if notify is not None:
                 out["__notify__"] = notify
@@ -417,13 +424,16 @@ class QueryRuntime(Receiver):
                 cols[VALID_KEY] = valid
                 batch = HostBatch(cols)
                 batch, notify_host = self.host_window.process(batch, now_h)
-                if self.post_filters:
-                    cols = batch.cols
-                    pvalid = cols[VALID_KEY]
+                if self.post_pipeline:
+                    cols = dict(batch.cols)
                     ptimer = cols[TYPE_KEY] == TIMER_TYPE
-                    for f in self.post_filters:
-                        pvalid = pvalid & (np.asarray(f(cols, ctx)) | ptimer)
-                    cols[VALID_KEY] = pvalid
+                    for kind, obj in self.post_pipeline:
+                        if kind == "t":
+                            cols = obj.apply(cols, ctx)
+                        else:
+                            cols[VALID_KEY] = cols[VALID_KEY] & (
+                                np.asarray(obj(cols, ctx)) | ptimer)
+                    batch = HostBatch(cols)
             elif self.host_transforms:
                 now_h = int(self.app_context.timestamp_generator.current_time())
                 batch = HostBatch(self._apply_host_transforms(

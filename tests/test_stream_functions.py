@@ -265,3 +265,20 @@ def test_namespaced_stream_function_extension():
             define stream S (v int);
             from S#custom:log('x') select v insert into OutStream;
         """)
+
+
+def test_post_window_stream_function():
+    # #window.length(2)#pol2Cart(...): the transform applies to the
+    # window's emitted rows (both CURRENT and EXPIRED)
+    m, rt, c = build("""
+        define stream PolarStream (theta double, rho double);
+        from PolarStream#window.length(2)#pol2Cart(theta, rho)[y > 0.0]
+        select y insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("PolarStream")
+    h.send([90.0, 1.0])    # y=1
+    h.send([270.0, 1.0])   # y=-1 filtered
+    h.send([90.0, 2.0])    # y=2; expired row y=1 passes
+    m.shutdown()
+    ys = [round(e.data[0], 9) for e in c.events]
+    assert ys == [1.0, 2.0, 1.0] or sorted(ys) == [1.0, 1.0, 2.0]
