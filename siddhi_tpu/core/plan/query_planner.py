@@ -87,26 +87,44 @@ def _rewrite_string_casts(expr, input_def, resolver, transforms, ext_state,
                 and len(expr.parameters) == 2
                 and isinstance(expr.parameters[1], Constant)
                 and isinstance(expr.parameters[1].value, str)
-                and expr.parameters[1].value.lower() in numeric
                 and isinstance(expr.parameters[0], Variable)):
+            tname = expr.parameters[1].value.lower()
             var = expr.parameters[0]
             try:
                 src = input_def.attribute(var.attribute_name)
             except Exception:
                 return expr
-            if src.type != AttrType.STRING or not resolver.accepts_stream(
-                    var.stream_id):
+            if not resolver.accepts_stream(var.stream_id):
                 return expr
-            target = numeric[expr.parameters[1].value.lower()]
-            key = (src.name, target)
-            name = ext_state["casts"].get(key)
-            if name is None:
-                from siddhi_tpu.ops.stream_functions import StringParseCastStage
+            stage = None
+            if src.type == AttrType.STRING and tname in numeric:
+                target = numeric[tname]
+                key = (src.name, target)
+                name = ext_state["casts"].get(key)
+                if name is None:
+                    from siddhi_tpu.ops.stream_functions import StringParseCastStage
 
-                name = f"__cast{len(ext_state['casts'])}__"
+                    name = f"__cast{len(ext_state['casts'])}__"
+                    stage = StringParseCastStage(name, src.name, target,
+                                                 dictionary)
+                    resolver.synthetic[name] = target
+            elif (src.type != AttrType.STRING and tname == "string"
+                  and src.type != AttrType.OBJECT):
+                key = (src.name, AttrType.STRING)
+                name = ext_state["casts"].get(key)
+                if name is None:
+                    from siddhi_tpu.ops.stream_functions import (
+                        NumericFormatCastStage,
+                    )
+
+                    name = f"__cast{len(ext_state['casts'])}__"
+                    stage = NumericFormatCastStage(name, src.name, src.type,
+                                                   dictionary)
+                    resolver.synthetic[name] = AttrType.STRING
+            else:
+                return expr
+            if stage is not None:
                 ext_state["casts"][key] = name
-                stage = StringParseCastStage(name, src.name, target, dictionary)
-                resolver.synthetic[name] = target
                 transforms.append(stage)
                 ext_state["attrs"].extend(stage.out_attrs)
             return Variable(attribute_name=name)

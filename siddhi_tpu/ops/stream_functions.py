@@ -237,6 +237,37 @@ class StringParseCastStage(TransformStage):
         return cols
 
 
+class NumericFormatCastStage(TransformStage):
+    """Host-side ``convert(numericAttr, 'string')``: formats each batch's
+    unique values once and dictionary-encodes them (string columns are
+    dictionary ids). Distinct-value cardinality grows the app dictionary —
+    bounded-domain attributes are the intended use."""
+
+    def __init__(self, out_name: str, src_key: str, src_type: AttrType,
+                 dictionary):
+        self.out_attrs = [Attribute(out_name, AttrType.STRING)]
+        self._src = src_key
+        self._src_type = src_type
+        self._dict = dictionary
+
+    def apply(self, cols, ctx):
+        cols = dict(cols)
+        vals = np.asarray(cols[self._src])
+        uniq, inv = np.unique(vals, return_inverse=True)
+        if self._src_type in (AttrType.INT, AttrType.LONG):
+            strs = np.array([str(int(v)) for v in uniq], object)
+        elif self._src_type == AttrType.BOOL:
+            strs = np.array(["true" if v else "false" for v in uniq], object)
+        else:
+            strs = np.array([str(float(v)) for v in uniq], object)
+        ids = self._dict.encode_array(strs)[inv].astype(np.int32)
+        name = self.out_attrs[0].name
+        cols[name] = ids
+        cols[name + "?"] = np.asarray(
+            cols.get(self._src + "?", np.zeros(vals.shape[0], bool)))
+        return cols
+
+
 class StreamFunction:
     """Extension base for custom ``#name(args)`` stream functions: declare
     ``out_attrs`` (or make it a callable of the argument types) and

@@ -71,3 +71,31 @@ def test_convert_overflow_values_yield_null():
         h.send([s])
     m.shutdown()
     assert [e.data[0] for e in c.events] == [None, None, 7]
+
+
+def test_convert_numeric_to_string():
+    m, rt, c = build("""
+        define stream S (v int, d double, b bool);
+        from S select convert(v, 'string') as vs, convert(d, 'string') as ds,
+                      convert(b, 'string') as bs
+        insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([42, 1.5, True])
+    h.send([-3, 0.25, False])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [
+        ("42", "1.5", "true"), ("-3", "0.25", "false")]
+
+
+def test_convert_numeric_to_string_in_filter():
+    m, rt, c = build("""
+        define stream S (v int);
+        from S[convert(v, 'string') == '7']
+        select v insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    for v in [5, 7, 9]:
+        h.send([v])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [7]
