@@ -316,9 +316,6 @@ def build_nfa_plan(
         idx = len(steps)
         sticky = ei in sticky_at
         if isinstance(el, AbsentStreamStateElement):
-            if sequence:
-                raise CompileError(
-                    "absent (`not`) states are not allowed in sequences")
             if el.waiting_time is None:
                 raise CompileError(
                     "a chained absent pattern needs `for <time>`")
@@ -335,9 +332,6 @@ def build_nfa_plan(
             sides = []
             for sub in (el.stream1, el.stream2):
                 absent = isinstance(sub, AbsentStreamStateElement)
-                if absent and sequence:
-                    raise CompileError(
-                        "absent (`not`) states are not allowed in sequences")
                 sides.append(make_side(sub, is_count=False, absent=absent))
             sides[0].bit, sides[1].bit = 1, 2
             if el.type == "or":
@@ -1185,7 +1179,16 @@ class NFAStage:
             CD2, V["SC"] = scV["CD"], scV["SC"]
 
             if plan.sequence:
-                kill = kill | (m[:, None] & A & ~matched)
+                # strict continuity kills unmatched partials — but not
+                # slots WAITING at an absent-ish step: their lifecycle is
+                # time-driven, non-matching events pass them by
+                # (AbsentSequenceTestCase: a non-violating event during
+                # `not X for t` does not break the sequence)
+                at_waitish = jnp.zeros_like(A)
+                for wst in plan.steps:
+                    if wst.waitish:
+                        at_waitish = at_waitish | (ST == wst.index)
+                kill = kill | (m[:, None] & A & ~matched & ~at_waitish)
             A2 = A2 & ~kill
 
             emit_all = (emit | emit2) & m[:, None]

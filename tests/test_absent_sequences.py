@@ -1,0 +1,123 @@
+"""Absent states in sequences — reference
+query/sequence/absent/{AbsentSequenceTestCase,LogicalAbsentSequenceTestCase}."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+STREAMS = """@app:playback
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+TAIL = STREAMS + """
+from e1=Stream1[price>20], not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1
+insert into OutStream;
+"""
+
+
+def test_seq_tail_absent_emits_at_deadline():
+    # AbsentSequenceTestCase.testQueryAbsent1
+    m, rt, c = build(TAIL)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s1.send(2500, ["LATE", 5.0, 100])   # advances the clock past 2000
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("WSO2",)]
+
+
+def test_seq_tail_absent_late_event_after_deadline_ok():
+    # testQueryAbsent2: a matching B after the deadline changes nothing
+    m, rt, c = build(TAIL)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(2200, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("WSO2",)]
+
+
+def test_seq_tail_absent_violated_within_wait():
+    # testQueryAbsent3: a matching B inside the window kills the match
+    m, rt, c = build(TAIL)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(1100, ["IBM", 58.7, 100])
+    s1.send(2500, ["X", 5.0, 100])
+    m.shutdown()
+    assert c.events == []
+
+
+def test_seq_tail_absent_nonmatching_event_does_not_kill():
+    # testQueryAbsent4 family: a NON-matching Stream2 event during the
+    # wait neither violates nor breaks the sequence
+    m, rt, c = build(TAIL)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(1100, ["IBM", 10.0, 100])    # price <= e1.price: no violation
+    s1.send(2500, ["X", 5.0, 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("WSO2",)]
+
+
+def test_seq_head_absent_then_event():
+    # testQueryAbsent from the head-absent family:
+    # `not Stream1 for 1 sec, e2=Stream2[price>30]`
+    m, rt, c = build(STREAMS + """
+        from not Stream1[price>20] for 1 sec, e2=Stream2[price>30]
+        select e2.symbol as symbol
+        insert into OutStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(2500, ["IBM", 45.0, 100])   # quiet first second passed
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("IBM",)]
+
+
+def test_seq_head_absent_violated():
+    m, rt, c = build(STREAMS + """
+        from not Stream1[price>20] for 1 sec, e2=Stream2[price>30]
+        select e2.symbol as symbol
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(1100, ["WSO2", 55.0, 100])   # matching A inside the quiet window
+    s2.send(1500, ["IBM", 45.0, 100])
+    m.shutdown()
+    assert c.events == []
+
+
+def test_seq_logical_absent_or_present():
+    # LogicalAbsentSequenceTestCase shape: (not A for 1 sec) or e2 present
+    m, rt, c = build(STREAMS + """
+        define stream Stream3 (symbol string, price float, volume int);
+        from e1=Stream1[price>20], not Stream2[price>e1.price] for 1 sec or e3=Stream3[price>e1.price]
+        select e1.symbol as symbol1
+        insert into OutStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s3.send(1200, ["HIGH", 60.0, 100])   # present side completes first
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("WSO2",)]
