@@ -369,6 +369,13 @@ def build_nfa_plan(
                 "`every` on a count state followed by a "
                 f"{steps[st.index + 1].kind} state is not supported")
 
+    # `every` wrapping an ABSENT head can't restart through fresh starts
+    # (absent heads live as armed waiting slots) — make the armed slot
+    # sticky so each elapsed quiet window forks a pending successor
+    # (EveryAbsentSequenceTestCase / EveryAbsentPatternTestCase re-arming)
+    if every and steps and steps[0].kind == "absent" and len(steps) > 1:
+        steps[0].sticky = True
+
     if len(scopes) > 8:
         raise CompileError("at most 8 nested `within` scopes are supported")
     if len(captures) > 30 - len(scopes):
@@ -727,6 +734,13 @@ class NFAStage:
                     if j == L:
                         emit = emit | due
                         ets = jnp.where(due, V["ADL"], ets)
+                    elif j == 0:
+                        # head every-absent: pending successors carry no
+                        # captures, so keep at most ONE per key (the
+                        # reference replaces rather than stacks them)
+                        pending = jnp.any(V["A"] & (V["ST"] == j + 1),
+                                          axis=1)[:, None]
+                        fork_reqs.append((due & ~pending, j + 1, V["ADL"]))
                     else:
                         fork_reqs.append((due, j + 1, V["ADL"]))
                     V["ADL"] = jnp.where(due, V["ADL"] + jnp.int64(st.wait_ms),

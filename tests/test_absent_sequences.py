@@ -121,3 +121,42 @@ def test_seq_logical_absent_or_present():
     s3.send(1200, ["HIGH", 60.0, 100])   # present side completes first
     m.shutdown()
     assert [tuple(e.data) for e in c.events] == [("WSO2",)]
+
+
+EVERY_HEAD = STREAMS + """
+from every not Stream1[price>20] for 1 sec, e2=Stream2[price>30]
+select e2.symbol as symbol
+insert into OutStream;
+"""
+
+
+def test_seq_every_head_absent_rearms():
+    # EveryAbsentSequenceTestCase testQueryAbsent2 shape: each event after
+    # its own quiet window matches
+    m, rt, c = build(EVERY_HEAD)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(2200, ["IBM", 58.7, 100])
+    s2.send(3300, ["WSO2", 68.7, 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("IBM",), ("WSO2",)]
+
+
+def test_seq_every_head_absent_single_pending():
+    # a long quiet stretch yields ONE pending state, not one per second
+    m, rt, c = build(EVERY_HEAD)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(5100, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("IBM",)]
+
+
+def test_seq_every_head_absent_violated_window():
+    m, rt, c = build(EVERY_HEAD)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(600, ["WSO2", 55.0, 100])     # breaks the first quiet window
+    s2.send(900, ["IBM", 58.7, 100])      # no quiet window elapsed yet
+    s2.send(2000, ["GOOG", 58.7, 100])    # quiet [600+,1600+] passed
+    m.shutdown()
+    got = [tuple(e.data) for e in c.events]
+    assert ("IBM",) not in got and ("GOOG",) in got
