@@ -66,7 +66,7 @@ class _AggDef:
 
 
 _AGG_DEFS = {
-    "sum": _AggDef(1, "add"),
+    "sum": _AggDef(2, "add"),      # (sum, non-null count): empty -> null
     "count": _AggDef(1, "add"),
     "avg": _AggDef(2, "add"),        # (sum, count)
     "stddev": _AggDef(3, "add"),     # (sum, sumsq, count)
@@ -170,7 +170,8 @@ def _deltas(spec: AggSpec, cols, ctx, xp):
     k = spec.kind
     if k == "sum":
         d = xp.where(is_cur, v, xp.where(is_exp, -v, ident))
-        return d[None, :]
+        sgn = xp.where(is_cur, 1, xp.where(is_exp, -1, 0)).astype(dtype)
+        return xp.stack([d, sgn])
     if k == "count":
         d = xp.where(is_cur, 1, xp.where(is_exp, -1, 0)).astype(dtype)
         return d[None, :]
@@ -213,7 +214,10 @@ def _output(spec: AggSpec, slots, ctx):
     """Running value -> (value, null_mask) per the reference return rules."""
     xp = ctx["xp"]
     k = spec.kind
-    if k in ("sum", "count"):
+    if k == "sum":
+        # SumAttributeAggregatorExecutor: null until a non-null folds in
+        return slots[0], slots[1] == 0
+    if k == "count":
         return slots[0], None
     if k == "avg":
         s, c = slots[0], slots[1]
@@ -231,9 +235,11 @@ def _output(spec: AggSpec, slots, ctx):
         return slots[0] == 0, None
     if k == "or":
         return slots[0] > 0, None
-    # min/max family: every output row folds at least its own value, so the
-    # running value is well-defined wherever an output is emitted.
-    return slots[0], None
+    # min/max family: a value equal to the fold identity means nothing
+    # folded in (all-null) -> null, as the reference returns before any
+    # non-null datum
+    ident = _identity(k, np.dtype(T.dtype_of(spec.arg_type)))
+    return slots[0], slots[0] == xp.asarray(ident)
 
 
 

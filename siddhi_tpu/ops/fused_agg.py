@@ -93,8 +93,10 @@ class FusedSlidingAggStage:
             k = spec.kind
             if k in ("count", "and", "or"):
                 out.append(np.dtype(np.int64))
-            elif k == "sum" and spec.arg_type in (AttrType.INT, AttrType.LONG):
-                out.append(np.dtype(np.int64))
+            elif k == "sum":
+                val_dt = (np.int64 if spec.arg_type in (AttrType.INT, AttrType.LONG)
+                          else np.float64)
+                out.extend([np.dtype(val_dt), np.dtype(np.int64)])  # (sum, n)
             elif k == "avg":
                 out.extend([np.dtype(np.float64), np.dtype(np.int64)])
             elif k == "stddev":
@@ -137,6 +139,7 @@ class FusedSlidingAggStage:
             k = spec.kind
             if k == "sum":
                 emit(ok, v)
+                emit(ok, xp.ones((B,)))     # non-null count: empty -> null
             elif k == "count":
                 emit(ok, xp.ones((B,)))
             elif k == "avg":

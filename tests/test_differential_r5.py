@@ -1,0 +1,137 @@
+"""Differential harness round 5: string casts, post-window transform
+pipelines, and absent-sequence timing vs plain-Python models."""
+
+import collections
+import math
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class SCollect(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(tuple(e.data) for e in events)
+
+
+def _run(app, sends, out="Out"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = SCollect()
+    rt.add_callback(out, c)
+    handlers = {}
+    for ts, sid, row in sends:
+        h = handlers.get(sid)
+        if h is None:
+            h = handlers[sid] = rt.get_input_handler(sid)
+        if ts is None:
+            h.send(row)
+        else:
+            h.send(ts, row)
+    m.shutdown()
+    return c.rows
+
+
+def test_differential_string_cast_window_group():
+    rng = np.random.default_rng(41)
+    sends = []
+    for _ in range(250):
+        sends.append((None, "S", [f"k{int(rng.integers(0, 3))}",
+                                  str(rng.choice(["1", "2", "bad", "10"]))]))
+    app = """
+        define stream S (sym string, num string);
+        from S#window.length(6)
+        select sym, sum(convert(num, 'long')) as t
+        group by sym insert into Out;
+    """
+    got = _run(app, sends)
+    dq = collections.deque()
+    model = []
+    for _, _, (sym, num) in sends:
+        v = int(num) if num.isdigit() else None
+        dq.append((sym, v))
+        if len(dq) > 6:
+            dq.popleft()
+        vals = [x for s, x in dq if s == sym and x is not None]
+        model.append((sym, sum(vals) if vals else None))
+    assert got == model
+
+
+def test_differential_post_window_transform_pipeline():
+    rng = np.random.default_rng(43)
+    sends = []
+    for _ in range(200):
+        theta = float(rng.choice([0.0, 45.0, 90.0, 225.0]))
+        rho = float(rng.integers(1, 4))
+        sends.append((None, "P", [theta, rho]))
+    app = """
+        define stream P (theta double, rho double);
+        from P#window.length(3)#pol2Cart(theta, rho)[y > 0.0]
+        select y insert all events into Out;
+    """
+    got = _run(app, sends)
+    dq = collections.deque()
+    model = []
+    for _, _, (theta, rho) in sends:
+        y = rho * math.sin(math.radians(theta))
+        # StreamCallback sees the window's natural order: the evicted
+        # (expired) row is emitted before the arriving current row
+        if len(dq) == 3:
+            ev = dq.popleft()
+            if ev > 1e-12:
+                model.append((ev,))
+        dq.append(y)
+        if y > 1e-12:
+            model.append((y,))
+    assert len(got) == len(model)
+    for (g,), (mv,) in zip(got, model):
+        assert abs(g - mv) < 1e-9
+
+
+def test_differential_absent_sequence_random_timing():
+    rng = np.random.default_rng(47)
+    T = 500
+    ts, sends, trace = 1000, [], []
+    for _ in range(150):
+        ts += int(rng.integers(50, 400))
+        if rng.random() < 0.5:
+            p = float(rng.integers(10, 60))
+            sends.append((ts, "S1", ["a", p, 1]))
+            trace.append((ts, "A", p))
+        else:
+            p = float(rng.integers(10, 60))
+            sends.append((ts, "S2", ["b", p, 1]))
+            trace.append((ts, "B", p))
+    app = f"""@app:playback
+        define stream S1 (symbol string, price double, v int);
+        define stream S2 (symbol string, price double, v int);
+        from e1=S1[price>30], not S2[price>e1.price] for {T} milliseconds
+        select e1.price as p insert into Out;
+    """
+    got = _run(app, sends)
+    # model: each qualifying A starts a wait; a LATER B with higher price
+    # within T kills it; otherwise it emits at deadline. Sequence semantics
+    # here: only one pending chain at a time (no head every) — the first
+    # un-killed qualifying A wins, then the machine stops (every absent).
+    model = []
+    waiting = None   # (deadline, price)
+    done = False
+    for t_i, kind, p in trace:
+        if done:
+            break
+        if waiting is not None and t_i >= waiting[0]:
+            model.append((waiting[1],))
+            done = True     # no head `every`: single match then stop
+            waiting = None
+        if done:
+            break
+        if kind == "A" and waiting is None and p > 30:
+            waiting = (t_i + T, p)
+        elif kind == "B" and waiting is not None and p > waiting[1]:
+            waiting = None  # violated; chain dead (no every)
+            done = True
+    assert got == model
