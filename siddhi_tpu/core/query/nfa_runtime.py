@@ -276,6 +276,20 @@ class NFAQueryRuntime(QueryRuntime):
         size_hint = None
         meta = out_host.pop("__meta__", None)
         if meta is not None:
+            defer = getattr(self.app_context, "defer_meta", 1)
+            if defer > 1 and self.keyer is None and not any(
+                    st.waitish for st in self.stage.plan.steps):
+                # batch N step metas into ONE round trip (PERF.md tunnel
+                # cost model); absent deadlines need prompt notifies, so
+                # only wait-free plans defer
+                dict.__setitem__(out_host, "__meta__", meta)
+                self._deferred.append((
+                    out_host,
+                    "pattern match-slot capacity exceeded — raise "
+                    "app_context.nfa_slots"))
+                if len(self._deferred) < defer:
+                    return None
+                return self.flush_deferred()
             meta = np.asarray(meta)
             overflow, notify, size_hint = int(meta[0]), int(meta[1]), int(meta[2])
         else:
