@@ -160,3 +160,30 @@ def test_seq_every_head_absent_violated_window():
     m.shutdown()
     got = [tuple(e.data) for e in c.events]
     assert ("IBM",) not in got and ("GOOG",) in got
+
+
+def test_seq_mid_chain_every():
+    m, rt, c = build("""@app:playback
+        define stream A (v int); define stream B (v int);
+        from e1=A, every e2=B[v > e1.v]
+        select e1.v as a, e2.v as b insert into OutStream;
+    """)
+    rt.get_input_handler("A").send(1000, [1])
+    hb = rt.get_input_handler("B")
+    hb.send(1100, [5])
+    hb.send(1200, [7])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [(1, 5), (1, 7)]
+
+
+def test_seq_mid_absent_then_stream():
+    m, rt, c = build("""@app:playback
+        define stream A (v int); define stream B (v int);
+        define stream Cs (v int);
+        from e1=A, not B[v > e1.v] for 1 sec, e3=Cs
+        select e1.v as a, e3.v as c insert into OutStream;
+    """)
+    rt.get_input_handler("A").send(1000, [1])
+    rt.get_input_handler("Cs").send(2500, [9])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [(1, 9)]
