@@ -274,7 +274,10 @@ class NFAQueryRuntime(QueryRuntime):
         self._state, out = run()
         out_host = LazyColumns(out)
         size_hint = None
-        meta = out_host.pop("__meta__", None)
+        # raw device ref — LazyColumns.pop would PULL it (one ~70ms round
+        # trip), defeating the defer batching below
+        meta = (dict.__getitem__(out_host, "__meta__")
+                if "__meta__" in out_host else None)
         if meta is not None:
             defer = getattr(self.app_context, "defer_meta", 1)
             if defer > 1 and self.keyer is None and not any(
@@ -282,7 +285,6 @@ class NFAQueryRuntime(QueryRuntime):
                 # batch N step metas into ONE round trip (PERF.md tunnel
                 # cost model); absent deadlines need prompt notifies, so
                 # only wait-free plans defer
-                dict.__setitem__(out_host, "__meta__", meta)
                 self._deferred.append((
                     out_host,
                     "pattern match-slot capacity exceeded — raise "
@@ -290,6 +292,7 @@ class NFAQueryRuntime(QueryRuntime):
                 if len(self._deferred) < defer:
                     return None
                 return self.flush_deferred()
+            dict.pop(out_host, "__meta__")
             meta = np.asarray(meta)
             overflow, notify, size_hint = int(meta[0]), int(meta[1]), int(meta[2])
         else:

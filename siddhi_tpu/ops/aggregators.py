@@ -72,10 +72,12 @@ _AGG_DEFS = {
     "stddev": _AggDef(3, "add"),     # (sum, sumsq, count)
     "and": _AggDef(1, "add"),        # false-count
     "or": _AggDef(1, "add"),         # true-count
-    "min": _AggDef(1, "min"),
-    "max": _AggDef(1, "max"),
-    "minforever": _AggDef(1, "min"),
-    "maxforever": _AggDef(1, "max"),
+    # (extreme, non-null count): the presence slot distinguishes "nothing
+    # folded" (null) from a datum equal to the fold identity
+    "min": _AggDef(2, "min"),
+    "max": _AggDef(2, "max"),
+    "minforever": _AggDef(2, "min"),
+    "maxforever": _AggDef(2, "max"),
     # multiset state, handled by its own scan path (_apply_distinct)
     "distinctcount": _AggDef(1, "add"),
 }
@@ -141,8 +143,9 @@ def init_agg_state(specs: List[AggSpec], num_keys: int) -> dict:
             }
             continue
         dtype = _slot_dtype(spec)
-        init = _identity(spec.kind, dtype)
-        state[f"a{i}"] = jnp.broadcast_to(jnp.asarray(init), (spec.slots, num_keys)).astype(dtype)
+        init = _slot_identities(spec.kind, dtype)
+        state[f"a{i}"] = jnp.broadcast_to(
+            jnp.asarray(init)[:, None], (spec.slots, num_keys)).astype(dtype)
     return state
 
 
@@ -192,22 +195,43 @@ def _deltas(spec: AggSpec, cols, ctx, xp):
         return d[None, :]
     if k in ("min", "max"):
         d = xp.where(is_cur, v, ident)
-        return d[None, :]
+        pres = xp.where(is_cur, 1, 0).astype(dtype)
+        return xp.stack([d, pres])
     if k in ("minforever", "maxforever"):
         # forever variants also fold EXPIRED events in (processRemove updates
         # the same way — reference MaxForeverAttributeAggregatorExecutor)
         d = xp.where(is_cur | is_exp, v, ident)
-        return d[None, :]
+        pres = xp.where(is_cur | is_exp, 1, 0).astype(dtype)
+        return xp.stack([d, pres])
     raise KeyError(k)
 
 
+def _slot_identities(kind: str, dtype) -> np.ndarray:
+    """[slots] per-slot fold identities (extreme slots pair with an
+    add-combined presence counter at identity 0)."""
+    d = _AGG_DEFS[kind]
+    prim = _identity(kind, dtype)
+    if d.combine in ("min", "max") and d.slots == 2:
+        return np.stack([prim, np.zeros((), dtype)])
+    return np.broadcast_to(prim, (d.slots,)).copy()
+
+
 def _combine(kind: str):
-    c = _AGG_DEFS[kind].combine
-    if c == "add":
+    """Combine fn over slot-LAST arrays [..., slots] (add/1-slot combines
+    are axis-agnostic; min/max pair the extreme slot with an added
+    presence slot)."""
+    d = _AGG_DEFS[kind]
+    if d.combine == "add":
         return lambda a, b: a + b
-    if c == "min":
-        return jnp.minimum
-    return jnp.maximum
+    prim = jnp.minimum if d.combine == "min" else jnp.maximum
+    if d.slots == 1:
+        return lambda a, b: prim(a, b)
+
+    def comb(a, b):
+        return jnp.concatenate([prim(a, b)[..., :1], (a + b)[..., 1:]],
+                               axis=-1)
+
+    return comb
 
 
 def _output(spec: AggSpec, slots, ctx):
@@ -235,11 +259,10 @@ def _output(spec: AggSpec, slots, ctx):
         return slots[0] == 0, None
     if k == "or":
         return slots[0] > 0, None
-    # min/max family: a value equal to the fold identity means nothing
-    # folded in (all-null) -> null, as the reference returns before any
-    # non-null datum
-    ident = _identity(k, np.dtype(T.dtype_of(spec.arg_type)))
-    return slots[0], slots[0] == xp.asarray(ident)
+    # min/max family: null until a non-null datum folds in (the presence
+    # slot counts folded rows — a datum equal to the fold identity still
+    # reports correctly)
+    return slots[0], slots[1] == 0
 
 
 
@@ -377,9 +400,9 @@ def apply_aggregators(specs: List[AggSpec], state: dict, cols: dict, ctx: dict,
         st = state[key]  # [slots, K]
         deltas = _deltas(spec, cols, ctx, xp)  # [slots, B]
         deltas_sorted = deltas[:, order]
-        comb = _combine(spec.kind)
+        comb = _combine(spec.kind)   # slot-LAST combine
         safe_gk = jnp.minimum(gk_sorted, num_keys - 1)
-        folded = comb(st[:, safe_gk], deltas_sorted)
+        folded = comb(st[:, safe_gk].T, deltas_sorted.T).T
         vals = jnp.where(fold_state[None, :], folded, deltas_sorted)
 
         def scan_op(a, b):
@@ -397,8 +420,10 @@ def apply_aggregators(specs: List[AggSpec], state: dict, cols: dict, ctx: dict,
         # new persistent state: all-init on any RESET, then last-row-per-group
         # values for groups active in the final epoch
         dtype = st.dtype
-        ident = jnp.asarray(_identity(spec.kind, np.dtype(dtype)))
-        base = jnp.where(any_reset, jnp.broadcast_to(ident, st.shape).astype(dtype), st)
+        idents = jnp.asarray(_slot_identities(spec.kind, np.dtype(dtype)))
+        base = jnp.where(any_reset,
+                         jnp.broadcast_to(idents[:, None], st.shape).astype(dtype),
+                         st)
         upd_mask = last_of_group & in_final_epoch & (gk_sorted < num_keys)
         scatter_idx = jnp.where(upd_mask, gk_sorted, num_keys)  # drop non-updates
         new_state[key] = base.at[:, scatter_idx].set(scanned, mode="drop")

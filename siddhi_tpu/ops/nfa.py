@@ -369,11 +369,14 @@ def build_nfa_plan(
                 "`every` on a count state followed by a "
                 f"{steps[st.index + 1].kind} state is not supported")
 
-    # `every` wrapping an ABSENT head can't restart through fresh starts
-    # (absent heads live as armed waiting slots) — make the armed slot
-    # sticky so each elapsed quiet window forks a pending successor
-    # (EveryAbsentSequenceTestCase / EveryAbsentPatternTestCase re-arming)
-    if every and steps and steps[0].kind == "absent" and len(steps) > 1:
+    # `every` wrapping an ABSENT head (plain or all-absent logical) can't
+    # restart through fresh starts (absent heads live as armed waiting
+    # slots) — make the armed slot sticky so each elapsed quiet window
+    # forks a pending successor (EveryAbsentSequenceTestCase /
+    # EveryAbsentPatternTestCase re-arming). Heads with a present side
+    # carry captures and keep the non-sticky path.
+    if (every and len(steps) > 1 and steps[0].waitish
+            and all(s.absent for s in steps[0].sides)):
         steps[0].sticky = True
 
     if len(scopes) > 8:
@@ -790,6 +793,12 @@ class NFAStage:
                     if j == L:
                         emit = emit | comp
                         ets = jnp.where(comp, comp_ts, ets)
+                    elif j == 0 and all(s.absent for s in st.sides):
+                        # head every-absent logical: capture-less pending
+                        # successors dedupe per key (see the absent branch)
+                        pending = jnp.any(V["A"] & (V["ST"] == j + 1),
+                                          axis=1)[:, None]
+                        fork_reqs.append((comp & ~pending, j + 1, comp_ts))
                     else:
                         fork_reqs.append((comp, j + 1, comp_ts))
                     # re-arm the parent's deadlines for the next interval

@@ -237,6 +237,12 @@ class StringParseCastStage(TransformStage):
         return cols
 
 
+def _format_float(v) -> str:
+    # unique=True: shortest round-trip text at the value's own precision;
+    # trim="0" keeps Java's "N.0" form for integral values
+    return np.format_float_positional(v, unique=True, trim="0")
+
+
 class NumericFormatCastStage(TransformStage):
     """Host-side ``convert(numericAttr, 'string')``: formats each batch's
     unique values once and dictionary-encodes them (string columns are
@@ -259,7 +265,10 @@ class NumericFormatCastStage(TransformStage):
         elif self._src_type == AttrType.BOOL:
             strs = np.array(["true" if v else "false" for v in uniq], object)
         else:
-            strs = np.array([str(float(v)) for v in uniq], object)
+            # shortest round-trip representation at the SOURCE precision
+            # (Java String.valueOf(float) prints "1.1", not the float64
+            # expansion of the float32 bits)
+            strs = np.array([_format_float(v) for v in uniq], object)
         ids = self._dict.encode_array(strs)[inv].astype(np.int32)
         name = self.out_attrs[0].name
         cols[name] = ids

@@ -187,3 +187,19 @@ def test_seq_mid_absent_then_stream():
     rt.get_input_handler("Cs").send(2500, [9])
     m.shutdown()
     assert [tuple(e.data) for e in c.events] == [(1, 9)]
+
+
+def test_seq_every_logical_absent_head_rearms():
+    # every (not A for 1 sec and not B for 1 sec), e3=C — re-arms per
+    # quiet window like the plain absent head
+    m, rt, c = build("""@app:playback
+        define stream A (v int); define stream B (v int);
+        define stream Cs (v int);
+        from every not A[v > 0] for 1 sec and not B[v > 0] for 1 sec, e3=Cs
+        select e3.v as c insert into OutStream;
+    """)
+    h = rt.get_input_handler("Cs")
+    h.send(2500, [1])
+    h.send(4000, [2])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [(1,), (2,)]

@@ -171,3 +171,29 @@ def test_limit_and_offset_and_orderby():
     ])
     assert [e.data for e in cb.events] == [["b", 9], ["c", 5]]
     manager.shutdown()
+
+
+def test_min_max_extreme_values_not_null():
+    # a datum equal to the fold identity must report, not read as null
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    class C(StreamCallback):
+        def __init__(self):
+            super().__init__()
+            self.rows = []
+
+        def receive(self, events):
+            self.rows.extend(tuple(e.data) for e in events)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        from S select max(v) as mx, min(v) as mn insert into Out;
+    """)
+    c = C()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    h.send([-2147483648])
+    h.send([2147483647])
+    m.shutdown()
+    assert c.rows == [(-2147483648, -2147483648), (2147483647, -2147483648)]

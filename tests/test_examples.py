@@ -16,7 +16,16 @@ def test_example_runs(path):
     env = dict(os.environ)
     root = str(path.parent.parent)
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    # examples are correctness smoke tests: force the CPU platform at the
+    # jax.config level (plugin platforms override the env var at
+    # interpreter start — same defense as conftest.force_host_devices),
+    # keeping them off the single-client TPU tunnel
+    wrapper = (
+        "import sys; "
+        "from siddhi_tpu.parallel.mesh import force_host_devices; "
+        "force_host_devices(1); "
+        "import runpy; runpy.run_path(sys.argv[1], run_name='__main__')")
     r = subprocess.run(
-        [sys.executable, str(path)], capture_output=True, text=True,
-        timeout=240, cwd=root, env=env)
+        [sys.executable, "-c", wrapper, str(path)], capture_output=True,
+        text=True, timeout=240, cwd=root, env=env)
     assert r.returncode == 0, r.stderr[-2000:]
