@@ -117,4 +117,141 @@ int64_t loader_parse_csv(Loader* l, const char* buf, int64_t len,
     return row;
 }
 
+
+// JSON-lines: one flat object per line. Fields resolve by name against
+// the stream definition; missing keys / JSON null -> null mask; unknown
+// keys are skipped. String values handle \" \\ \/ \n \t \r escapes
+// (\uXXXX passes through as-is).
+//   names: concatenated field names; name_lens[c] their lengths
+// Returns rows parsed (< 0 on error).
+int64_t loader_parse_jsonl(Loader* l, const char* buf, int64_t len,
+                           const char* names, const int32_t* name_lens,
+                           const int32_t* types, int32_t ncols,
+                           void** out_cols, uint8_t** out_masks,
+                           int64_t max_rows) {
+    std::vector<std::pair<const char*, int32_t>> fields(ncols);
+    {
+        const char* p = names;
+        for (int32_t c = 0; c < ncols; ++c) {
+            fields[c] = {p, name_lens[c]};
+            p += name_lens[c];
+        }
+    }
+    std::string sval;
+    int64_t row = 0, i = 0;
+    while (i < len && row < max_rows) {
+        // skip blank space before the object
+        while (i < len && (buf[i] == ' ' || buf[i] == '\t' ||
+                           buf[i] == '\r' || buf[i] == '\n'))
+            ++i;
+        if (i >= len) break;
+        if (buf[i] != '{') return -1;
+        ++i;
+        for (int32_t c = 0; c < ncols; ++c) out_masks[c][row] = 1;
+        bool done = false;
+        while (!done) {
+            while (i < len && (buf[i] == ' ' || buf[i] == '\t')) ++i;
+            if (i < len && buf[i] == '}') { ++i; done = true; break; }
+            if (i >= len || buf[i] != '"') return -1;
+            ++i;
+            int64_t kstart = i;
+            while (i < len && buf[i] != '"') {
+                if (buf[i] == '\\') ++i;
+                ++i;
+            }
+            int64_t klen = i - kstart;
+            if (i >= len) return -1;
+            ++i;  // closing quote
+            while (i < len && (buf[i] == ' ' || buf[i] == '\t')) ++i;
+            if (i >= len || buf[i] != ':') return -1;
+            ++i;
+            while (i < len && (buf[i] == ' ' || buf[i] == '\t')) ++i;
+            int32_t col = -1;
+            for (int32_t c = 0; c < ncols; ++c)
+                if (fields[c].second == klen &&
+                    memcmp(fields[c].first, buf + kstart, (size_t)klen) == 0) {
+                    col = c;
+                    break;
+                }
+            bool is_null = false;
+            sval.clear();
+            bool have_str = false;
+            int64_t vstart = i, vlen = 0;
+            if (i < len && buf[i] == '"') {
+                ++i;
+                have_str = true;
+                while (i < len && buf[i] != '"') {
+                    char ch = buf[i];
+                    if (ch == '\\' && i + 1 < len) {
+                        ++i;
+                        char e = buf[i];
+                        switch (e) {
+                            case 'n': ch = '\n'; break;
+                            case 't': ch = '\t'; break;
+                            case 'r': ch = '\r'; break;
+                            case 'b': ch = '\b'; break;
+                            case 'f': ch = '\f'; break;
+                            default: ch = e; break;   // " \\ / and \uXXXX tail
+                        }
+                    }
+                    sval.push_back(ch);
+                    ++i;
+                }
+                if (i >= len) return -1;
+                ++i;  // closing quote
+            } else if (i < len && buf[i] == 'n') {
+                is_null = true;
+                while (i < len && buf[i] != ',' && buf[i] != '}') ++i;
+            } else {
+                vstart = i;
+                while (i < len && buf[i] != ',' && buf[i] != '}' &&
+                       buf[i] != '\n')
+                    ++i;
+                vlen = i - vstart;
+                if (vlen == 0) is_null = true;
+            }
+            if (col >= 0) {
+                out_masks[col][row] = is_null ? 1 : 0;
+                const char* vp = have_str ? sval.data() : buf + vstart;
+                size_t vn = have_str ? sval.size() : (size_t)vlen;
+                switch (types[col]) {
+                    case COL_LONG: {
+                        int64_t* out = (int64_t*)out_cols[col];
+                        out[row] = is_null ? 0 : strtoll(vp, nullptr, 10);
+                        break;
+                    }
+                    case COL_DOUBLE: {
+                        double* out = (double*)out_cols[col];
+                        out[row] = is_null ? 0.0 : strtod(vp, nullptr);
+                        break;
+                    }
+                    case COL_STRING: {
+                        int64_t* out = (int64_t*)out_cols[col];
+                        out[row] = is_null ? 0 : l->encode(vp, vn);
+                        break;
+                    }
+                    case COL_BOOL: {
+                        uint8_t* out = (uint8_t*)out_cols[col];
+                        out[row] = (!is_null && vn > 0 &&
+                                    (vp[0] == 't' || vp[0] == 'T' ||
+                                     vp[0] == '1'))
+                                       ? 1
+                                       : 0;
+                        break;
+                    }
+                    default:
+                        return -1;
+                }
+            }
+            while (i < len && (buf[i] == ' ' || buf[i] == '\t')) ++i;
+            if (i < len && buf[i] == ',') { ++i; continue; }
+            if (i < len && buf[i] == '}') { ++i; done = true; }
+        }
+        while (i < len && buf[i] != '\n') ++i;
+        if (i < len) ++i;
+        ++row;
+    }
+    return row;
+}
+
 }  // extern "C"

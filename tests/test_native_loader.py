@@ -59,3 +59,62 @@ def test_csv_loader_end_to_end():
         cols, timestamps=np.arange(n, dtype=np.int64))
     m.shutdown()
     assert seen == [("IBM", 55.5), ("GOOG", 20.0)]
+
+
+def test_jsonl_loader_parses_typed_columns():
+    from siddhi_tpu.core.event import StringDictionary
+    from siddhi_tpu.native import JsonlLoader
+    from siddhi_tpu.query_api.definitions import (
+        Attribute, AttrType, StreamDefinition,
+    )
+
+    d = StreamDefinition("S", [
+        Attribute("sym", AttrType.STRING),
+        Attribute("price", AttrType.DOUBLE),
+        Attribute("vol", AttrType.LONG),
+        Attribute("ok", AttrType.BOOL),
+    ])
+    dic = StringDictionary()
+    loader = JsonlLoader(d, dic)
+    data = (b'{"sym": "IBM", "price": 42.5, "vol": 100, "ok": true}\n'
+            b'{"price": 1.25, "sym": "W\\"X", "vol": null, "ok": false}\n'
+            b'{"sym": "IBM", "extra": 9, "price": 7, "vol": 3, "ok": true}\n')
+    cols, n = loader.parse(data)
+    assert n == 3
+    assert [dic.decode(int(i)) for i in cols["sym"]] == ["IBM", 'W"X', "IBM"]
+    assert list(cols["price"]) == [42.5, 1.25, 7.0]
+    assert list(cols["vol"]) == [100, 0, 3]
+    assert list(cols["vol?"]) == [False, True, False]
+    assert list(cols["ok"]) == [True, False, True]
+
+
+def test_jsonl_loader_end_to_end():
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.native import JsonlLoader
+
+    class C(StreamCallback):
+        def __init__(self):
+            super().__init__()
+            self.rows = []
+
+        def receive(self, events):
+            self.rows.extend(tuple(e.data) for e in events)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, price double);
+        from S[price > 10.0] select sym, price insert into Out;
+    """)
+    c = C()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    loader = JsonlLoader(rt.app_context.definitions["S"]
+                         if hasattr(rt.app_context, "definitions")
+                         else rt.query_runtimes[
+                             next(iter(rt.query_runtimes))].input_definition,
+                         rt.app_context.string_dictionary)
+    cols, n = loader.parse(b'{"sym": "A", "price": 50.0}\n'
+                           b'{"sym": "B", "price": 5.0}\n')
+    h.send_columns({k: v for k, v in cols.items()})
+    m.shutdown()
+    assert c.rows == [("A", 50.0)]
