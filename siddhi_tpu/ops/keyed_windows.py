@@ -41,6 +41,13 @@ from siddhi_tpu.ops.windows import (
 
 
 
+
+def _is_variable(p) -> bool:
+    from siddhi_tpu.query_api.expressions import Variable
+
+    return isinstance(p, Variable)
+
+
 def _per_key_layout(pk, valid_cur, num_keys: int):
     """Group batch rows by key: returns (order, inv_order, occ, counts,
     start_pos) where occ[i] is row i's arrival rank within its key this
@@ -1031,6 +1038,19 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
             int(_const_param(window, 0, "windowTime")),
             int(_const_param(window, 1, "hopTime")), col_specs, capacity)
     if name == "session":
+        if len(window.parameters) >= 3 or (
+                len(window.parameters) == 2
+                and not _is_variable(window.parameters[1])):
+            # session with allowedLatency: per-key host stage instances
+            # (the dense keyed stage covers the plain-gap fast path)
+            from siddhi_tpu.ops.host_windows import (
+                PartitionedHostWindow,
+                create_host_window_stage,
+            )
+
+            return PartitionedHostWindow(
+                lambda: create_host_window_stage(window, input_def, resolver,
+                                                 app_context))
         return KeyedSessionWindowStage(int(_const_param(window, 0, "gap")),
                                        col_specs, capacity)
     if name in ("sort", "frequent", "lossyfrequent", "cron",

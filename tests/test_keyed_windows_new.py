@@ -187,3 +187,20 @@ def test_keyed_delay_releases_after_time():
     # A1 and B2 released once the clock passed their +1s deadlines; A3's
     # deadline (3200) never arrives before shutdown, so it stays held
     assert got == [("A", 1), ("B", 2)]
+
+
+def test_keyed_session_with_latency_per_key_host_instances():
+    m, rt, c = build(STREAM + """
+        partition with (sym of S) begin
+        from S#window.session(2 sec, sym, 1 sec)
+        select sym, v insert all events into OutStream; end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["u1", 1])
+    h.send(3500, ["u2", 9])     # u1's session parked (latency hold)
+    h.send(3700, ["u1", 2])     # late event revives u1
+    h.send(9000, ["u2", 0])     # everything expires
+    m.shutdown()
+    u1 = [tuple(e.data) for e in c.events if e.data[0] == "u1"]
+    # both rows appear twice (CURRENT + one joint EXPIRED emission)
+    assert u1.count(("u1", 1)) == 2 and u1.count(("u1", 2)) == 2
