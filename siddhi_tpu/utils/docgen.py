@@ -34,7 +34,7 @@ _WINDOWS_HOST = [
     ("expressionBatch('<expr>')", "flushes when the expression breaks"),
 ]
 _WINDOWS_KEYED = ["length", "lengthBatch", "batch", "time", "timeBatch", "hopping",
-                  "externalTime", "timeLength", "delay", "session",
+                  "externalTime", "timeLength", "delay", "session (incl. allowedLatency)",
                   "sort", "frequent", "lossyFrequent", "cron",
                   "expression", "expressionBatch (per-key host instances)"]
 _AGGREGATORS = ["sum", "count", "avg", "min", "max", "stdDev", "and", "or",
