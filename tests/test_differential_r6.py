@@ -1,0 +1,89 @@
+"""Differential harness round 6: incremental aggregation cube vs a
+plain-Python bucket model over random out-of-order traces, and on-demand
+table CRUD vs a dict model."""
+
+import collections
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+
+
+def test_differential_incremental_aggregation_ooo():
+    rng = np.random.default_rng(53)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream Trades (symbol string, price double, ts long);
+        define aggregation TradeAgg
+        from Trades
+        select symbol, sum(price) as total, count() as n, avg(price) as ap,
+               min(price) as lo, max(price) as hi
+        group by symbol
+        aggregate by ts every sec ... min;
+    """)
+    h = rt.get_input_handler("Trades")
+    buckets = collections.defaultdict(list)   # (sec_bucket, sym) -> prices
+    base = 1_700_000_000_000
+    for _ in range(400):
+        # out-of-order timestamps across a 30-second span
+        ts = base + int(rng.integers(0, 30_000))
+        sym = f"s{int(rng.integers(0, 4))}"
+        p = float(rng.integers(1, 100))
+        h.send([sym, p, ts])
+        buckets[(ts // 1000, sym)].append(p)
+
+    rows = rt.query(
+        f"from TradeAgg within {base}L, {base + 60_000}L per 'seconds' "
+        "select AGG_TIMESTAMP, symbol, total, n, ap, lo, hi")
+    got = {}
+    for e in rows:
+        ts_b, sym, total, n, ap, lo, hi = e.data
+        got[(ts_b // 1000, sym)] = (total, n, ap, lo, hi)
+    m.shutdown()
+
+    assert len(got) == len(buckets)
+    for key, prices in buckets.items():
+        total, n, ap, lo, hi = got[key]
+        assert n == len(prices)
+        assert abs(total - sum(prices)) < 1e-6
+        assert abs(ap - sum(prices) / len(prices)) < 1e-9
+        assert lo == min(prices) and hi == max(prices)
+
+
+def test_differential_table_crud_random():
+    rng = np.random.default_rng(59)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream Ins (k string, v long);
+        define stream Del (k string);
+        define stream Upd (k string, v long);
+        @primaryKey('k')
+        define table T (k string, v long);
+        from Ins select k, v update or insert into T
+            set T.v = v on T.k == k;
+        from Del delete T on T.k == k;
+        from Upd update T set T.v = v on T.k == k;
+    """)
+    hi = rt.get_input_handler("Ins")
+    hd = rt.get_input_handler("Del")
+    hu = rt.get_input_handler("Upd")
+    model = {}
+    for _ in range(300):
+        op = rng.random()
+        k = f"k{int(rng.integers(0, 12))}"
+        if op < 0.5:
+            v = int(rng.integers(0, 1000))
+            hi.send([k, v])
+            model[k] = v
+        elif op < 0.75:
+            hd.send([k])
+            model.pop(k, None)
+        else:
+            v = int(rng.integers(0, 1000))
+            hu.send([k, v])
+            if k in model:
+                model[k] = v
+    rows = rt.query("from T select k, v")
+    got = {e.data[0]: e.data[1] for e in rows}
+    m.shutdown()
+    assert got == model
