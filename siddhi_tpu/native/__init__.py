@@ -113,6 +113,12 @@ class CsvLoader:
             grown[i] = self.dictionary.encode(buf.raw[:ln].decode("utf-8"))
         self._remap = grown
 
+    def _native_parse(self, data, out_cols, out_masks, max_rows) -> int:
+        return int(self._lib.loader_parse_csv(
+            self._loader, data, len(data),
+            self._codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(self.definition.attributes), out_cols, out_masks, max_rows))
+
     def parse(self, data: bytes, max_rows: Optional[int] = None
               ) -> Tuple[Dict[str, np.ndarray], int]:
         """-> (columns dict incl. null masks, n_rows)."""
@@ -136,12 +142,9 @@ class CsvLoader:
             mk = np.zeros(max_rows, np.uint8)
             masks.append(mk)
             out_masks[c] = mk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
-        n = int(self._lib.loader_parse_csv(
-            self._loader, data, len(data),
-            self._codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            ncols, out_cols, out_masks, max_rows))
+        n = self._native_parse(data, out_cols, out_masks, max_rows)
         if n < 0:
-            raise ValueError("CSV parse failed")
+            raise ValueError(f"{type(self).__name__}: parse failed")
         self._sync_dictionary()
         cols: Dict[str, np.ndarray] = {}
         for c, a in enumerate(attrs):
@@ -171,45 +174,9 @@ class JsonlLoader(CsvLoader):
             [len(a.name.encode("utf-8")) for a in definition.attributes],
             np.int32)
 
-    def parse(self, data: bytes, max_rows: Optional[int] = None
-              ) -> Tuple[Dict[str, np.ndarray], int]:
-        attrs = self.definition.attributes
-        ncols = len(attrs)
-        if max_rows is None:
-            max_rows = data.count(b"\n") + 1
-        from siddhi_tpu.ops.types import dtype_of
-
-        natives: List[np.ndarray] = []
-        out_cols = (ctypes.c_void_p * ncols)()
-        out_masks = (ctypes.POINTER(ctypes.c_uint8) * ncols)()
-        masks: List[np.ndarray] = []
-        for c, a in enumerate(attrs):
-            code = self._codes[c]
-            arr = np.zeros(max_rows,
-                           {0: np.int64, 1: np.float64, 2: np.int64,
-                            3: np.uint8}[int(code)])
-            natives.append(arr)
-            out_cols[c] = arr.ctypes.data_as(ctypes.c_void_p)
-            mk = np.zeros(max_rows, np.uint8)
-            masks.append(mk)
-            out_masks[c] = mk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
-        n = int(self._lib.loader_parse_jsonl(
+    def _native_parse(self, data, out_cols, out_masks, max_rows) -> int:
+        return int(self._lib.loader_parse_jsonl(
             self._loader, data, len(data), self._names,
             self._name_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             self._codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            ncols, out_cols, out_masks, max_rows))
-        if n < 0:
-            raise ValueError("JSONL parse failed")
-        self._sync_dictionary()
-        cols: Dict[str, np.ndarray] = {}
-        for c, a in enumerate(attrs):
-            v = natives[c][:n]
-            if a.type == AttrType.STRING:
-                v = self._remap[v]
-            elif a.type == AttrType.BOOL:
-                v = v.astype(bool)
-            else:
-                v = v.astype(dtype_of(a.type))
-            cols[a.name] = v
-            cols[a.name + "?"] = masks[c][:n].astype(bool)
-        return cols, n
+            len(self.definition.attributes), out_cols, out_masks, max_rows))

@@ -19,6 +19,31 @@
 
 namespace {
 
+int hex_val(char c) {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+}
+
+void append_utf8(std::string& s, uint32_t cp) {
+    if (cp < 0x80) {
+        s.push_back((char)cp);
+    } else if (cp < 0x800) {
+        s.push_back((char)(0xC0 | (cp >> 6)));
+        s.push_back((char)(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+        s.push_back((char)(0xE0 | (cp >> 12)));
+        s.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+        s.push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+        s.push_back((char)(0xF0 | (cp >> 18)));
+        s.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+        s.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+        s.push_back((char)(0x80 | (cp & 0x3F)));
+    }
+}
+
 struct Loader {
     std::unordered_map<std::string, int64_t> dict;
     std::vector<std::string> strings;   // id -> string
@@ -120,8 +145,8 @@ int64_t loader_parse_csv(Loader* l, const char* buf, int64_t len,
 
 // JSON-lines: one flat object per line. Fields resolve by name against
 // the stream definition; missing keys / JSON null -> null mask; unknown
-// keys are skipped. String values handle \" \\ \/ \n \t \r escapes
-// (\uXXXX passes through as-is).
+// keys are skipped. String values handle \" \\ \/ \n \t \r escapes and
+// \uXXXX (incl. surrogate pairs), encoded to UTF-8.
 //   names: concatenated field names; name_lens[c] their lengths
 // Returns rows parsed (< 0 on error).
 int64_t loader_parse_jsonl(Loader* l, const char* buf, int64_t len,
@@ -185,13 +210,37 @@ int64_t loader_parse_jsonl(Loader* l, const char* buf, int64_t len,
                     if (ch == '\\' && i + 1 < len) {
                         ++i;
                         char e = buf[i];
+                        if (e == 'u' && i + 4 < len) {
+                            int h0 = hex_val(buf[i + 1]), h1 = hex_val(buf[i + 2]);
+                            int h2 = hex_val(buf[i + 3]), h3 = hex_val(buf[i + 4]);
+                            if (h0 < 0 || h1 < 0 || h2 < 0 || h3 < 0) return -1;
+                            uint32_t cp = (uint32_t)((h0 << 12) | (h1 << 8) |
+                                                     (h2 << 4) | h3);
+                            i += 4;
+                            if (cp >= 0xD800 && cp <= 0xDBFF && i + 6 < len &&
+                                buf[i + 1] == '\\' && buf[i + 2] == 'u') {
+                                int g0 = hex_val(buf[i + 3]), g1 = hex_val(buf[i + 4]);
+                                int g2 = hex_val(buf[i + 5]), g3 = hex_val(buf[i + 6]);
+                                if (g0 < 0 || g1 < 0 || g2 < 0 || g3 < 0) return -1;
+                                uint32_t lo = (uint32_t)((g0 << 12) | (g1 << 8) |
+                                                         (g2 << 4) | g3);
+                                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                                    cp = 0x10000 + ((cp - 0xD800) << 10) +
+                                         (lo - 0xDC00);
+                                    i += 6;
+                                }
+                            }
+                            append_utf8(sval, cp);
+                            ++i;
+                            continue;
+                        }
                         switch (e) {
                             case 'n': ch = '\n'; break;
                             case 't': ch = '\t'; break;
                             case 'r': ch = '\r'; break;
                             case 'b': ch = '\b'; break;
                             case 'f': ch = '\f'; break;
-                            default: ch = e; break;   // " \\ / and \uXXXX tail
+                            default: ch = e; break;   // " \\ /
                         }
                     }
                     sval.push_back(ch);
@@ -201,7 +250,10 @@ int64_t loader_parse_jsonl(Loader* l, const char* buf, int64_t len,
                 ++i;  // closing quote
             } else if (i < len && buf[i] == 'n') {
                 is_null = true;
-                while (i < len && buf[i] != ',' && buf[i] != '}') ++i;
+                while (i < len && buf[i] != ',' && buf[i] != '}' &&
+                       buf[i] != '\n')
+                    ++i;
+                if (i >= len || buf[i] == '\n') return -1;  // missing '}'
             } else {
                 vstart = i;
                 while (i < len && buf[i] != ',' && buf[i] != '}' &&

@@ -41,13 +41,6 @@ from siddhi_tpu.ops.windows import (
 
 
 
-
-def _is_variable(p) -> bool:
-    from siddhi_tpu.query_api.expressions import Variable
-
-    return isinstance(p, Variable)
-
-
 def _per_key_layout(pk, valid_cur, num_keys: int):
     """Group batch rows by key: returns (order, inv_order, occ, counts,
     start_pos) where occ[i] is row i's arrival rank within its key this
@@ -1038,11 +1031,11 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
             int(_const_param(window, 0, "windowTime")),
             int(_const_param(window, 1, "hopTime")), col_specs, capacity)
     if name == "session":
-        if len(window.parameters) >= 3 or (
-                len(window.parameters) == 2
-                and not _is_variable(window.parameters[1])):
-            # session with allowedLatency: per-key host stage instances
-            # (the dense keyed stage covers the plain-gap fast path)
+        if len(window.parameters) >= 2:
+            # session with its own key attribute and/or allowedLatency:
+            # per-key host stage instances (the session key may differ
+            # from the partition key). The dense keyed stage covers the
+            # plain session(gap) fast path, keyed by the partition.
             from siddhi_tpu.ops.host_windows import (
                 PartitionedHostWindow,
                 create_host_window_stage,

@@ -118,3 +118,22 @@ def test_jsonl_loader_end_to_end():
     h.send_columns({k: v for k, v in cols.items()})
     m.shutdown()
     assert c.rows == [("A", 50.0)]
+
+
+def test_jsonl_loader_unicode_escapes():
+    import json as _json
+
+    from siddhi_tpu.core.event import StringDictionary
+    from siddhi_tpu.native import JsonlLoader
+    from siddhi_tpu.query_api.definitions import (
+        Attribute, AttrType, StreamDefinition,
+    )
+
+    d = StreamDefinition("S", [Attribute("sym", AttrType.STRING)])
+    dic = StringDictionary()
+    loader = JsonlLoader(d, dic)
+    vals = ["café", "日本", "emoji 🎉", 'quote"inside']
+    data = "".join(_json.dumps({"sym": v}) + "\n" for v in vals).encode()
+    cols, n = loader.parse(data)
+    assert n == len(vals)
+    assert [dic.decode(int(i)) for i in cols["sym"]] == vals
