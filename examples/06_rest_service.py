@@ -1,0 +1,42 @@
+"""Deploy apps and inject events over HTTP (the service surface)."""
+
+import json
+import urllib.request
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.service.rest import SiddhiRestService
+
+
+def _post(port, path, body):
+    is_text = isinstance(body, str)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body.encode() if is_text else json.dumps(body).encode(),
+        headers={"Content-Type": "text/plain" if is_text else "application/json"},
+        method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def main():
+    manager = SiddhiManager()
+    svc = SiddhiRestService(manager, port=0).start()
+    port = svc.port
+
+    _post(port, "/apps", """
+        @app:name('RestDemo')
+        define stream S (sym string, v long);
+        define table T (sym string, v long);
+        from S select sym, v insert into T;
+    """)
+    _post(port, "/apps/RestDemo/events",
+          {"stream": "S", "data": [["ACME", 7], ["GOOG", 9]]})
+    rows = _post(port, "/query", {"app": "RestDemo",
+                                  "query": "from T select sym, v"})
+    print("rows over HTTP:", rows)
+    svc.stop()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()
