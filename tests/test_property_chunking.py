@@ -1,0 +1,76 @@
+"""Property: outputs are invariant to how a trace is chunked into batches
+(per-event sends vs multi-event chunks) — the engine's batch processing
+must not change window/aggregation semantics. Hypothesis shrinks failing
+chunkings to minimal counterexamples."""
+
+from hypothesis import given, settings, strategies as st
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.event import Event
+
+
+class C(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(tuple(e.data) for e in events)
+
+
+def run_chunked(app, rows, chunks):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = C()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    i = 0
+    for size in chunks:
+        batch = [Event(timestamp=1000 + j, data=list(rows[j]))
+                 for j in range(i, min(i + size, len(rows)))]
+        if batch:
+            h.send(batch)
+        i += size
+        if i >= len(rows):
+            break
+    while i < len(rows):
+        h.send(1000 + i, list(rows[i]))
+        i += 1
+    m.shutdown()
+    return c.rows
+
+
+APP = """
+    define stream S (sym string, v long);
+    from S#window.length(3)
+    select sym, sum(v) as total, count() as n
+    group by sym insert into Out;
+"""
+
+trace = st.lists(
+    st.tuples(st.sampled_from(["a", "b", "c"]), st.integers(1, 9)),
+    min_size=1, max_size=24)
+chunking = st.lists(st.integers(1, 7), min_size=1, max_size=24)
+
+
+@settings(max_examples=25, deadline=None)
+@given(trace, chunking)
+def test_chunking_invariance_windowed_groupby(rows, chunks):
+    per_event = run_chunked(APP, rows, [1] * len(rows))
+    chunked = run_chunked(APP, rows, chunks)
+    assert chunked == per_event
+
+
+APP_BATCH = """
+    define stream S (sym string, v long);
+    from S#window.lengthBatch(4)
+    select sum(v) as total insert into Out;
+"""
+
+
+@settings(max_examples=15, deadline=None)
+@given(trace, chunking)
+def test_chunking_invariance_tumbling(rows, chunks):
+    per_event = run_chunked(APP_BATCH, rows, [1] * len(rows))
+    chunked = run_chunked(APP_BATCH, rows, chunks)
+    assert chunked == per_event
