@@ -74,3 +74,18 @@ def test_chunking_invariance_tumbling(rows, chunks):
     per_event = run_chunked(APP_BATCH, rows, [1] * len(rows))
     chunked = run_chunked(APP_BATCH, rows, chunks)
     assert chunked == per_event
+
+
+NFA_APP = """
+    define stream S (sym string, v long);
+    from every e1=S[v > 5] -> e2=S[v > e1.v]
+    select e1.v as a, e2.v as b insert into Out;
+"""
+
+
+@settings(max_examples=15, deadline=None)
+@given(trace, chunking)
+def test_chunking_invariance_nfa(rows, chunks):
+    per_event = run_chunked(NFA_APP, rows, [1] * len(rows))
+    chunked = run_chunked(NFA_APP, rows, chunks)
+    assert chunked == per_event
