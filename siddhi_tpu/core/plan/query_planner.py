@@ -131,6 +131,79 @@ def _rewrite_string_casts(expr, input_def, resolver, transforms, ext_state,
     return expr
 
 
+class _InPairResolver:
+    """Resolver for the inner condition of ``<cond> in Table``: qualified
+    (or stream-unresolvable) attributes bind to the probed table's
+    prefixed columns, the rest to the stream resolver."""
+
+    def __init__(self, stream_resolver, table_def, prefix):
+        self._stream = stream_resolver
+        self._table = table_def
+        self._prefix = prefix
+
+    def resolve(self, var):
+        from siddhi_tpu.ops.expressions import ColumnRef
+
+        if var.stream_id == self._table.id:
+            attr = self._table.attribute(var.attribute_name)
+            return ColumnRef(self._prefix + attr.name, attr.type)
+        try:
+            return self._stream.resolve(var)
+        except CompileError:
+            attr = self._table.attribute(var.attribute_name)
+            return ColumnRef(self._prefix + attr.name, attr.type)
+
+    def encode_string(self, s):
+        return self._stream.encode_string(s)
+
+
+def _rewrite_in_conditions(expr, resolver, app_context, transforms, ext_state):
+    """Replace ``<cond> in Table`` nodes with synthetic bool Variables
+    backed by a host exists-probe over the table's contents
+    (InConditionExpressionExecutor)."""
+    from siddhi_tpu.query_api.expressions import (
+        AttributeFunction,
+        Expression,
+        InOp,
+        Variable,
+    )
+
+    if not isinstance(expr, Expression):
+        return expr
+    for attr in ("left", "right", "expression"):
+        child = getattr(expr, attr, None)
+        if isinstance(child, Expression) and not isinstance(expr, InOp):
+            setattr(expr, attr, _rewrite_in_conditions(
+                child, resolver, app_context, transforms, ext_state))
+    if isinstance(expr, AttributeFunction):
+        expr.parameters = [
+            _rewrite_in_conditions(p, resolver, app_context, transforms,
+                                   ext_state)
+            for p in expr.parameters]
+    if isinstance(expr, InOp):
+        from siddhi_tpu.ops.stream_functions import InProbeStage
+        from siddhi_tpu.query_api.definitions import AttrType
+
+        table = getattr(app_context, "tables", {}).get(expr.source_id)
+        if table is None:
+            raise CompileError(
+                f"'{expr.source_id}' in an `in` condition is not a defined table")
+        i = len(ext_state["casts"])
+        prefix = f"__int{i}__"
+        pair = _InPairResolver(resolver, table.definition, prefix)
+        cond = compile_condition(expr.expression, pair)
+        name = f"__in{i}__"
+        stage = InProbeStage(
+            name, table, cond,
+            {a.name: prefix + a.name for a in table.definition.attributes})
+        resolver.synthetic[name] = AttrType.BOOL
+        ext_state["casts"][("__in__", name)] = name
+        transforms.append(stage)
+        ext_state["attrs"].extend(stage.out_attrs)
+        return Variable(attribute_name=name)
+    return expr
+
+
 def plan_join_query(
     query: Query,
     query_name: str,
@@ -543,6 +616,9 @@ def plan_query(
             handler.expression = _rewrite_string_casts(
                 handler.expression, input_def, resolver, transforms,
                 cast_state, dictionary)
+            handler.expression = _rewrite_in_conditions(
+                handler.expression, resolver, app_context, transforms,
+                cast_state)
     if query.selector is not None:
         for sel in getattr(query.selector, "selection_list", []) or []:
             sel.expression = _rewrite_string_casts(

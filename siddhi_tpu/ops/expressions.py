@@ -150,7 +150,9 @@ def compile_expr(expr: Expression, resolver: Resolver) -> Compiled:
     if isinstance(expr, AttributeFunction):
         return _compile_function(expr, resolver)
     if isinstance(expr, InOp):
-        raise CompileError("'in <table>' is compiled by the table planner, not here")
+        raise CompileError(
+            "'in <table>' conditions are supported in single-stream filter "
+            "handlers (rewritten to a table exists-probe by the planner)")
     raise CompileError(f"cannot compile expression {expr!r}")
 
 

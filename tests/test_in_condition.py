@@ -1,0 +1,58 @@
+"""`<cond> in Table` filter conditions — reference
+InConditionExpressionExecutor (exists-probe over table contents)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(app)
+    collector = Collector()
+    runtime.add_callback(out, collector)
+    return manager, runtime, collector
+
+
+def test_in_table_membership_filter():
+    m, rt, c = build("""
+        define stream Feed (sym string, v long);
+        define stream Allow (sym string);
+        define table AllowT (sym string);
+        from Allow select sym insert into AllowT;
+        from Feed[AllowT.sym == sym in AllowT]
+        select sym, v insert into OutStream;
+    """)
+    rt.get_input_handler("Allow").send(["ACME"])
+    h = rt.get_input_handler("Feed")
+    h.send(["ACME", 1])
+    h.send(["EVIL", 2])
+    rt.get_input_handler("Allow").send(["EVIL"])   # table grows live
+    h.send(["EVIL", 3])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("ACME", 1), ("EVIL", 3)]
+
+
+def test_in_table_combined_with_other_conditions():
+    m, rt, c = build("""
+        define stream Feed (sym string, v long);
+        define table T (sym string, lim long);
+        define stream Seed (sym string, lim long);
+        from Seed select sym, lim insert into T;
+        from Feed[v > 10 and (T.sym == sym and T.lim < v) in T]
+        select sym, v insert into OutStream;
+    """)
+    rt.get_input_handler("Seed").send(["A", 20])
+    h = rt.get_input_handler("Feed")
+    h.send(["A", 15])    # v>10 but lim(20) !< 15
+    h.send(["A", 25])    # passes both
+    h.send(["B", 99])    # not in table
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("A", 25)]
