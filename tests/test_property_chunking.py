@@ -53,7 +53,7 @@ trace = st.lists(
 chunking = st.lists(st.integers(1, 7), min_size=1, max_size=24)
 
 
-@settings(max_examples=25, deadline=None)
+@settings(max_examples=12, deadline=None)
 @given(trace, chunking)
 def test_chunking_invariance_windowed_groupby(rows, chunks):
     per_event = run_chunked(APP, rows, [1] * len(rows))
@@ -68,7 +68,7 @@ APP_BATCH = """
 """
 
 
-@settings(max_examples=15, deadline=None)
+@settings(max_examples=8, deadline=None)
 @given(trace, chunking)
 def test_chunking_invariance_tumbling(rows, chunks):
     per_event = run_chunked(APP_BATCH, rows, [1] * len(rows))
@@ -83,7 +83,7 @@ NFA_APP = """
 """
 
 
-@settings(max_examples=15, deadline=None)
+@settings(max_examples=8, deadline=None)
 @given(trace, chunking)
 def test_chunking_invariance_nfa(rows, chunks):
     per_event = run_chunked(NFA_APP, rows, [1] * len(rows))
