@@ -131,36 +131,13 @@ def _rewrite_string_casts(expr, input_def, resolver, transforms, ext_state,
     return expr
 
 
-class _InPairResolver:
-    """Resolver for the inner condition of ``<cond> in Table``: qualified
-    (or stream-unresolvable) attributes bind to the probed table's
-    prefixed columns, the rest to the stream resolver."""
-
-    def __init__(self, stream_resolver, table_def, prefix):
-        self._stream = stream_resolver
-        self._table = table_def
-        self._prefix = prefix
-
-    def resolve(self, var):
-        from siddhi_tpu.ops.expressions import ColumnRef
-
-        if var.stream_id == self._table.id:
-            attr = self._table.attribute(var.attribute_name)
-            return ColumnRef(self._prefix + attr.name, attr.type)
-        try:
-            return self._stream.resolve(var)
-        except CompileError:
-            attr = self._table.attribute(var.attribute_name)
-            return ColumnRef(self._prefix + attr.name, attr.type)
-
-    def encode_string(self, s):
-        return self._stream.encode_string(s)
-
-
-def _rewrite_in_conditions(expr, resolver, app_context, transforms, ext_state):
+def _rewrite_in_conditions(expr, input_def, ref_id, resolver, app_context,
+                           transforms, ext_state):
     """Replace ``<cond> in Table`` nodes with synthetic bool Variables
     backed by a host exists-probe over the table's contents
-    (InConditionExpressionExecutor)."""
+    (InConditionExpressionExecutor). The inner condition compiles with the
+    table's own resolver/probe machinery (TableConditionResolver +
+    InMemoryTable._match), sharing the join/update binding rules."""
     from siddhi_tpu.query_api.expressions import (
         AttributeFunction,
         Expression,
@@ -174,13 +151,15 @@ def _rewrite_in_conditions(expr, resolver, app_context, transforms, ext_state):
         child = getattr(expr, attr, None)
         if isinstance(child, Expression) and not isinstance(expr, InOp):
             setattr(expr, attr, _rewrite_in_conditions(
-                child, resolver, app_context, transforms, ext_state))
+                child, input_def, ref_id, resolver, app_context,
+                transforms, ext_state))
     if isinstance(expr, AttributeFunction):
         expr.parameters = [
-            _rewrite_in_conditions(p, resolver, app_context, transforms,
-                                   ext_state)
+            _rewrite_in_conditions(p, input_def, ref_id, resolver,
+                                   app_context, transforms, ext_state)
             for p in expr.parameters]
     if isinstance(expr, InOp):
+        from siddhi_tpu.core.table.in_memory_table import TableConditionResolver
         from siddhi_tpu.ops.stream_functions import InProbeStage
         from siddhi_tpu.query_api.definitions import AttrType
 
@@ -188,16 +167,13 @@ def _rewrite_in_conditions(expr, resolver, app_context, transforms, ext_state):
         if table is None:
             raise CompileError(
                 f"'{expr.source_id}' in an `in` condition is not a defined table")
-        i = len(ext_state["casts"])
-        prefix = f"__int{i}__"
-        pair = _InPairResolver(resolver, table.definition, prefix)
+        pair = TableConditionResolver(
+            table.definition, input_def, app_context.string_dictionary,
+            event_ref=ref_id)
         cond = compile_condition(expr.expression, pair)
-        name = f"__in{i}__"
-        stage = InProbeStage(
-            name, table, cond,
-            {a.name: prefix + a.name for a in table.definition.attributes})
+        name = f"__in{len(transforms)}__"
+        stage = InProbeStage(name, table, cond)
         resolver.synthetic[name] = AttrType.BOOL
-        ext_state["casts"][("__in__", name)] = name
         transforms.append(stage)
         ext_state["attrs"].extend(stage.out_attrs)
         return Variable(attribute_name=name)
@@ -611,14 +587,22 @@ def plan_query(
     # string -> numeric casts become host parse-LUT transforms feeding the
     # device a synthetic numeric column (rewrites filter + selector ASTs)
     cast_state = {"casts": {}, "attrs": []}
+    seen_window = False
     for handler in input_stream.handlers:
+        if isinstance(handler, Window):
+            seen_window = True
         if isinstance(handler, Filter):
             handler.expression = _rewrite_string_casts(
                 handler.expression, input_def, resolver, transforms,
                 cast_state, dictionary)
-            handler.expression = _rewrite_in_conditions(
-                handler.expression, resolver, app_context, transforms,
-                cast_state)
+            if not seen_window:
+                # post-window `in` probes would bake ingestion-time table
+                # state into buffered rows — unsupported (compile_expr
+                # raises a clear error if one survives here)
+                handler.expression = _rewrite_in_conditions(
+                    handler.expression, input_def,
+                    input_stream.stream_reference_id, resolver, app_context,
+                    transforms, cast_state)
     if query.selector is not None:
         for sel in getattr(query.selector, "selection_list", []) or []:
             sel.expression = _rewrite_string_casts(
@@ -687,9 +671,11 @@ def plan_query(
     selector_plan.num_keys = app_context.initial_key_capacity
 
     keyer = None
-    # parse-LUT cast stages are numpy-only: the whole transform chain then
-    # runs host-side (stream-function transforms handle xp=np equally)
-    host_transforms = bool(cast_state["casts"])
+    # host-only stages (parse-LUT casts, table exists-probes) force the
+    # whole transform chain host-side (stream-function transforms handle
+    # xp=np equally)
+    host_transforms = bool(cast_state["casts"]) or any(
+        getattr(t, "host_only", False) for t in transforms)
     if selector_plan.group_by:
         fns = []
         for var in query.selector.group_by_list:

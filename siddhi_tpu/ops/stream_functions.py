@@ -279,36 +279,30 @@ class NumericFormatCastStage(TransformStage):
 
 class InProbeStage(TransformStage):
     """``<cond> in Table`` filter support (InConditionExpressionExecutor):
-    a host probe computing, per batch row, whether ANY table row satisfies
-    the compiled pair condition — materialized as a synthetic bool column
-    the device filter reads. Stream columns broadcast [B, 1] against the
-    table's [1, W] contents."""
+    an exists-probe computing, per batch row, whether ANY table row
+    satisfies the compiled pair condition — materialized as a synthetic
+    bool column the device filter reads. Delegates the [B,1]x[1,W]
+    broadcast to the table's own ``_match`` (same machinery and
+    resolution rules as join/update/delete probes)."""
 
-    def __init__(self, out_name: str, table, cond_fn, table_keys):
+    # reads mutable table state per batch: must run host-side, never be
+    # traced into the jitted step (the planner checks this flag)
+    host_only = True
+
+    def __init__(self, out_name: str, table, cond_fn):
         self.out_attrs = [Attribute(out_name, AttrType.BOOL)]
         self._table = table
         self._cond = cond_fn
-        self._tkeys = table_keys     # table column name -> prefixed key
 
     def apply(self, cols, ctx):
+        import jax.numpy as jnp
+
         cols = dict(cols)
-        tcols, tvalid = self._table.contents()
-        tvalid = np.asarray(tvalid)
-        B = np.asarray(cols[VALID_KEY]).shape[0]
-        ev = {}
-        for k, v in cols.items():
-            arr = np.asarray(v)
-            ev[k] = arr[:, None] if arr.ndim == 1 else arr
-        for name, key in self._tkeys.items():
-            ev[key] = np.asarray(tcols[name])[None, :]
-            mk = tcols.get(name + "?")
-            ev[key + "?"] = (np.asarray(mk)[None, :] if mk is not None
-                             else np.zeros((1, tvalid.shape[0]), bool))
-        m = np.asarray(self._cond(ev, {**ctx, "xp": np}))
-        m = np.broadcast_to(m, (B, tvalid.shape[0])) & tvalid[None, :]
+        m = self._table._match(self._cond, cols, {**ctx, "xp": jnp})
         name = self.out_attrs[0].name
-        cols[name] = m.any(axis=1)
-        cols[name + "?"] = np.zeros(B, bool)
+        present = np.asarray(jnp.any(m, axis=1))
+        cols[name] = present
+        cols[name + "?"] = np.zeros(present.shape[0], bool)
         return cols
 
 
