@@ -56,3 +56,31 @@ def test_in_table_combined_with_other_conditions():
     h.send(["B", 99])    # not in table
     m.shutdown()
     assert [tuple(e.data) for e in c.events] == [("A", 25)]
+
+
+def test_in_condition_bad_qualifier_rejected():
+    import pytest
+
+    from siddhi_tpu.ops.expressions import CompileError
+
+    with pytest.raises(CompileError):
+        build("""
+            define stream Feed (sym string, v long);
+            define table AllowT (sym string);
+            from Feed[Bogus.sym == sym in AllowT]
+            select sym insert into OutStream;
+        """)
+
+
+def test_in_condition_post_window_rejected():
+    import pytest
+
+    from siddhi_tpu.ops.expressions import CompileError
+
+    with pytest.raises(CompileError, match="in <table>"):
+        build("""
+            define stream Feed (sym string, v long);
+            define table AllowT (sym string);
+            from Feed#window.length(2)[AllowT.sym == sym in AllowT]
+            select sym insert into OutStream;
+        """)
