@@ -166,3 +166,31 @@ def test_join_group_by_inside_partition():
     m.shutdown()
     got = sorted(tuple(e.data) for e in c.events)
     assert got == [("x", 10), ("x", 30)]
+
+
+def test_join_side_aliases():
+    m, rt, c = build("""
+        define stream L (sym string, v int);
+        define stream R (sym string, w int);
+        from L#window.length(5) as a join R#window.length(5) as b
+             on a.sym == b.sym
+        select a.sym as sym, a.v as v, b.w as w insert into OutStream;
+    """)
+    rt.get_input_handler("R").send(["A", 7])
+    rt.get_input_handler("L").send(["A", 1])
+    m.shutdown()
+    assert ("A", 1, 7) in [tuple(e.data) for e in c.events]
+
+
+def test_self_join_with_aliases():
+    m, rt, c = build("""
+        define stream S (sym string, v int);
+        from S#window.length(5) as a join S#window.length(5) as b
+             on a.sym == b.sym and a.v < b.v
+        select a.v as lo, b.v as hi insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1])
+    h.send(["A", 5])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in c.events) == [(1, 5)]
