@@ -1296,6 +1296,13 @@ class NFAStage:
                     continue
                 f = m & every_ok & conds[oi][:, 0]
                 if st.kind == "count":
+                    # non-overlapping `every` collections: an event some
+                    # slot absorbed into its collection does not also seed
+                    # a fresh instance — the next instance begins with the
+                    # first event a full collection cannot take
+                    # (CountPatternTestCase testQuery18/20 grouping)
+                    absorbed = jnp.any(at_masks[oi] & (win == oi), axis=1)
+                    f = f & ~absorbed
                     if j == L and 1 >= st.min_count:
                         direct = direct | f
                         direct_op = jnp.where(f & (direct_op < 0), oi, direct_op)

@@ -131,3 +131,23 @@ def test_every_count_followed_by_logical_rejected():
             select e2[0].name as n0
             insert into OutStream;
         """)
+
+
+def test_head_every_count_non_overlapping_with_within():
+    # CountPatternTestCase.testQuery18: every e1=A<2> -> e2=B within 3 sec
+    # over the reference trace — exactly 3 matches (non-overlapping pairs,
+    # the 4s gap expires pending chains)
+    m, rt, c = build(APP + """
+        from every e1=InputStream[name == 'A']<2:2>
+          -> e2=InputStream[name == 'B'] within 3 sec
+        select e1[0].name as n insert into OutStream;
+    """)
+    h = rt.get_input_handler("InputStream")
+    t = 1000
+    for n in ["A", "A", "B", "B", "A", "A", "B", "B", "A"]:
+        h.send(t, [n]); t += 100
+    t += 4000
+    for n in ["A", "B", "B", "A", "A", "B", "B"]:
+        h.send(t, [n]); t += 100
+    m.shutdown()
+    assert len(c.events) == 3
