@@ -1,0 +1,73 @@
+"""Integration capstone: tables + in-conditions + windowed group-by +
+partitions + patterns + incremental aggregation + persistence in ONE app."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+
+class C(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.out = []
+
+    def receive(self, events):
+        self.out.extend(events)
+
+
+def test_capstone_app():
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('Capstone') @app:playback
+        define stream Trades (user string, sym string, price double, ts long);
+        define stream Logins (user string, ok bool);
+        @primaryKey('sym') define table Limits (sym string, cap double);
+        define stream SeedLimits (sym string, cap double);
+        define aggregation TradeCube
+        from Trades select sym, sum(price) as turnover
+        group by sym aggregate by ts every sec ... hour;
+
+        from SeedLimits select sym, cap insert into Limits;
+
+        @info(name='guard')
+        from Trades[Limits.sym == sym in Limits]#window.length(100)
+        select user, sym, sum(price) as vol group by user, sym
+        insert into GuardedVol;
+
+        partition with (user of Logins) begin
+          @info(name='fails')
+          from Logins[not ok]#window.lengthBatch(2)
+          select user, count() as fails insert into FailAlerts;
+        end;
+
+        @info(name='suspect')
+        from every e1=Logins[not ok] -> e2=Trades[e2.user == e1.user and price > 50.0]
+             within 1 min
+        select e1.user as user, e2.price as price insert into Suspects;
+    """)
+    g, f, s = C(), C(), C()
+    rt.add_callback("GuardedVol", g)
+    rt.add_callback("FailAlerts", f)
+    rt.add_callback("Suspects", s)
+    rt.get_input_handler("SeedLimits").send(["ACME", 100.0])
+    tr = rt.get_input_handler("Trades")
+    lg = rt.get_input_handler("Logins")
+    base = 1_700_000_000_000
+    lg.send(base, ["eve", False])
+    lg.send(base + 100, ["eve", False])
+    tr.send(base + 200, ["eve", "ACME", 60.0, base + 200])
+    tr.send(base + 300, ["bob", "ACME", 10.0, base + 300])
+    tr.send(base + 400, ["bob", "EVIL", 99.0, base + 400])  # not in Limits
+    rev = rt.persist()
+    rows = rt.query(
+        f"from TradeCube within {base}L, {base + 10_000}L per 'seconds' "
+        "select sym, turnover")
+    m.shutdown()
+    assert [tuple(e.data) for e in g.out] == [
+        ("eve", "ACME", 60.0), ("bob", "ACME", 10.0)]
+    assert [tuple(e.data) for e in f.out] == [("eve", 2)]
+    # both of eve's failed logins started chains; the trade completes both
+    assert [tuple(e.data) for e in s.out] == [("eve", 60.0), ("eve", 60.0)]
+    assert sorted(tuple(e.data) for e in rows) == [
+        ("ACME", 70.0), ("EVIL", 99.0)]
+    assert rev
