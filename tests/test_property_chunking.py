@@ -89,3 +89,19 @@ def test_chunking_invariance_nfa(rows, chunks):
     per_event = run_chunked(NFA_APP, rows, [1] * len(rows))
     chunked = run_chunked(NFA_APP, rows, chunks)
     assert chunked == per_event
+
+
+PART_APP = """
+    define stream S (sym string, v long);
+    partition with (sym of S) begin
+    from S#window.length(2)
+    select sym, sum(v) as total insert into Out; end;
+"""
+
+
+@settings(max_examples=8, deadline=None)
+@given(trace, chunking)
+def test_chunking_invariance_partitioned(rows, chunks):
+    per_event = run_chunked(PART_APP, rows, [1] * len(rows))
+    chunked = run_chunked(PART_APP, rows, chunks)
+    assert chunked == per_event
