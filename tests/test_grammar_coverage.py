@@ -213,3 +213,23 @@ def test_multiline_app_with_partition_and_inner_stream():
             insert into OutStream;
         end;
     """)
+
+
+def test_annotation_on_aggregation_and_purge():
+    builds("""
+        define stream S (sym string, price double, ts long);
+        @purge(enable='true', interval='10 sec',
+               @retentionPeriod(sec='1 min', min='1 hour'))
+        define aggregation A2
+        from S select sym, sum(price) as total
+        group by sym aggregate by ts every sec ... min;
+    """)
+
+
+def test_unidirectional_right_join_side():
+    builds("""
+        define stream L (sym string); define stream R (sym string);
+        from L#window.length(2) join R#window.length(2) unidirectional
+             on L.sym == R.sym
+        select L.sym as sym insert into O;
+    """)
