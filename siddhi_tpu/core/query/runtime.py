@@ -327,6 +327,18 @@ class QueryRuntime(Receiver):
             batch.cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
         self.process_batch(batch)
 
+    _now_override = None   # timer chunks sweep at their scheduled time
+
+    def _now(self) -> int:
+        """Current time for window expiry/stamping: the TIMER chunk's
+        scheduled timestamp while one is being processed (the playback
+        clock has already jumped ahead of queued timers — reference
+        ``Scheduler.sendTimerEvents`` fires each timer AT its time), else
+        the app clock."""
+        if self._now_override is not None:
+            return self._now_override
+        return int(self.app_context.timestamp_generator.current_time())
+
     def process_timer(self, ts: int):
         """Inject a TIMER chunk (the role of Scheduler.sendTimerEvents +
         EntryValveProcessor in the reference)."""
@@ -336,7 +348,15 @@ class QueryRuntime(Receiver):
             self.dictionary,
         )
         batch.cols[TYPE_KEY][...] = TIMER_TYPE
-        self.process_batch(batch)
+        # take the per-query lock BEFORE setting the override: a live-mode
+        # event batch on another thread must never observe the timer's ts
+        # as its clock (the RLock nests with process_batch's own acquire)
+        with self._lock:
+            self._now_override = int(ts)
+            try:
+                self.process_batch(batch)
+            finally:
+                self._now_override = None
 
     def _apply_host_transforms(self, cols, ctx):
         for t in self.transforms:
@@ -353,7 +373,7 @@ class QueryRuntime(Receiver):
             return
         ctx = {
             "xp": np,
-            "current_time": int(self.app_context.timestamp_generator.current_time()),
+            "current_time": self._now(),
         }
         # only replay the transform prefix some tap actually reads
         depth = min(max(t.n_transforms for t in self.log_stages),
@@ -412,7 +432,7 @@ class QueryRuntime(Receiver):
                 batch.cols[PK_KEY] = np.asarray(pk0, np.int32)
                 pk_done = True
             if self.host_window is not None:
-                now_h = int(self.app_context.timestamp_generator.current_time())
+                now_h = self._now()
                 ctx = {"xp": np, "current_time": now_h}
                 cols = batch.cols
                 for t in self.transforms:
@@ -435,7 +455,7 @@ class QueryRuntime(Receiver):
                                 np.asarray(obj(cols, ctx)) | ptimer)
                     batch = HostBatch(cols)
             elif self.host_transforms:
-                now_h = int(self.app_context.timestamp_generator.current_time())
+                now_h = self._now()
                 batch = HostBatch(self._apply_host_transforms(
                     batch.cols, {"xp": np, "current_time": now_h}))
             cols = batch.cols
@@ -498,7 +518,7 @@ class QueryRuntime(Receiver):
                 return st2, pack_meta(out2)
 
             self._sel_step = jax.jit(fn, donate_argnums=0)
-        now = np.int64(self.app_context.timestamp_generator.current_time())
+        now = np.int64(self._now())
         new_sel, sel_out = self._sel_step(self._state["sel"], dict(out_host), now)
         self._state["sel"] = new_sel
         out = LazyColumns(sel_out)
@@ -523,7 +543,7 @@ class QueryRuntime(Receiver):
             import time as _time
 
             t0 = _time.perf_counter()
-        now = np.int64(self.app_context.timestamp_generator.current_time())
+        now = np.int64(self._now())
         if isinstance(cols, LazyColumns):
             cols = dict(cols)   # jit boundary: raw (possibly device) arrays
         self._state, out = step(self._state, cols, now)

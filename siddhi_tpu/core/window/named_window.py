@@ -66,6 +66,8 @@ class NamedWindowRuntime(Receiver):
     # queries `insert into W` treat the window as their output junction
     send_events = receive
 
+    _now_override = None   # timer chunks sweep at their scheduled time
+
     def process_timer(self, ts: int):
         from siddhi_tpu.core.query.runtime import _zero_value
 
@@ -74,12 +76,21 @@ class NamedWindowRuntime(Receiver):
                    data=[_zero_value(a.type) for a in self.definition.attributes])],
             self.definition, self.dictionary)
         batch.cols[TYPE_KEY][...] = TIMER
-        self._process(batch)
+        # lock before setting the override (see QueryRuntime.process_timer)
+        with self._lock:
+            self._now_override = int(ts)
+            try:
+                self._process(batch)
+            finally:
+                self._now_override = None
 
     def _process(self, batch: HostBatch):
         with self._lock:
             batch.cols["__gk__"] = np.zeros(batch.capacity, np.int32)
-            now = np.int64(self.app_context.timestamp_generator.current_time())
+            now = np.int64(
+                self._now_override
+                if self._now_override is not None
+                else self.app_context.timestamp_generator.current_time())
             if self.host_mode:
                 out_batch, notify = self.stage.process(batch, int(now))
                 out_host = dict(out_batch.cols)

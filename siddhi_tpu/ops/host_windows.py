@@ -275,22 +275,29 @@ class SessionWindowStage(HostWindowStage):
     """``session(gap[, key[, allowedLatency]])``: events pass through as
     CURRENT and join their key's open session; a session with no events
     for `gap` expires — its events emit as one EXPIRED chunk. With
-    ``allowedLatency``, a gap-expired session is retained for the latency
-    period: a late event of the same key revives it (merging its rows back
-    into a live session), and only after the latency passes do its events
-    emit EXPIRED (``SessionWindowProcessor`` current/expired session
-    containers)."""
+    ``allowedLatency``, each key holds a *current* and a *previous*
+    session: a gap-expired current session parks as previous until
+    ``end + allowedLatency``; only genuinely late (out-of-order) events
+    merge into it, while on-time events past the gap start a fresh
+    current session (``SessionWindowProcessor.processEventChunk`` /
+    ``moveCurrentSessionToPreviousSession`` / ``addLateEvent``,
+    SessionWindowProcessor.java:228-432)."""
 
     needs_scheduler = True
 
     def __init__(self, gap_ms: int, key_col: Optional[str], col_specs,
                  latency_ms: int = 0):
         super().__init__(col_specs)
+        if latency_ms > gap_ms:
+            raise CompileError(
+                "session window allowedLatency must not exceed the gap")
         self.gap_ms = gap_ms
         self.key_col = key_col
         self.latency_ms = latency_ms
-        self._sessions: Dict[object, dict] = {}  # key -> {last, rows}
-        self._expired: Dict[object, dict] = {}   # key -> {last, rows, due}
+        # key -> {start, end, rows}; end = last event ts + gap
+        self._cur: Dict[object, dict] = {}
+        # key -> {start, end, due, rows}; due = end + allowedLatency
+        self._prev: Dict[object, dict] = {}
 
     def _key(self, row):
         if self.key_col is None:
@@ -305,95 +312,131 @@ class SessionWindowStage(HostWindowStage):
             expired[TYPE_KEY] = EXPIRED
             out_rows.append(expired)
 
+    def _sweep(self, now, out_rows):
+        # currentSessionTimeout: earliest-ending sessions first
+        for k in sorted(self._cur, key=lambda k: self._cur[k]["end"]):
+            c = self._cur[k]
+            if now < c["end"]:
+                continue
+            del self._cur[k]
+            if self.latency_ms > 0:
+                p = self._prev.get(k)
+                rows = (p["rows"] + c["rows"]) if p is not None else c["rows"]
+                self._prev[k] = {"start": c["start"], "end": c["end"],
+                                 "due": c["end"] + self.latency_ms,
+                                 "rows": rows}
+            else:
+                self._emit_expired(c["rows"], now, out_rows)
+        # previousSessionTimeout: the latency hold has passed
+        for k in sorted(self._prev, key=lambda k: self._prev[k]["end"]):
+            p = self._prev[k]
+            if now >= p["due"]:
+                del self._prev[k]
+                self._emit_expired(p["rows"], now, out_rows)
+
+    def _merge_prev_into_cur(self, key):
+        """``mergeWindows``: if the previous session's span reaches the
+        current session's start-gap, fold it into the current session."""
+        p, c = self._prev.get(key), self._cur.get(key)
+        if p is not None and c is not None and \
+                p["end"] >= c["start"] - self.gap_ms:
+            c["rows"] = p["rows"] + c["rows"]
+            c["start"] = p["start"]
+            del self._prev[key]
+
     def process(self, batch, now: int):
         cols = batch.cols
         out_rows: List[dict] = []
-        # gap-expired sessions: emit, or park in the expired container
-        for k in list(self._sessions):
-            s = self._sessions[k]
-            if now - s["last"] >= self.gap_ms:
-                del self._sessions[k]
-                if self.latency_ms > 0:
-                    s["due"] = s["last"] + self.gap_ms + self.latency_ms
-                    old = self._expired.get(k)
-                    if old is not None:       # merge back-to-back sessions
-                        s["rows"] = old["rows"] + s["rows"]
-                    self._expired[k] = s
-                else:
-                    self._emit_expired(s["rows"], now, out_rows)
-        # latency-expired sessions: finally emit
-        for k in list(self._expired):
-            s = self._expired[k]
-            if now >= s["due"]:
-                del self._expired[k]
-                self._emit_expired(s["rows"], now, out_rows)
+        self._sweep(now, out_rows)
         for i in np.nonzero(cols[VALID_KEY])[0]:
             if cols[TYPE_KEY][i] != CURRENT:
                 continue
             row = _row(cols, i)
             ts = int(cols[TS_KEY][i])
             key = self._key(row)
-            s = self._sessions.get(key)
-            if s is not None and ts - s["last"] >= self.gap_ms:
-                if self.latency_ms > 0:
-                    s["due"] = s["last"] + self.gap_ms + self.latency_ms
-                    old = self._expired.get(key)
-                    if old is not None:
-                        s["rows"] = old["rows"] + s["rows"]
-                    self._expired[key] = s
+            c = self._cur.get(key)
+            if c is None:
+                self._cur[key] = {"start": ts, "end": ts + self.gap_ms,
+                                  "rows": [row]}
+            elif ts >= c["start"]:
+                if ts <= c["end"]:
+                    c["end"] = ts + self.gap_ms
+                    c["rows"].append(row)
                 else:
-                    self._emit_expired(s["rows"], now, out_rows)
-                del self._sessions[key]
-                s = None
-            if s is None:
-                # a late event revives its key's retained expired session —
-                # but only within the latency hold (event time vs due)
-                revived = self._expired.get(key)
-                if revived is not None and ts < revived["due"]:
-                    self._expired.pop(key)
-                    s = {"last": revived["last"], "rows": revived["rows"]}
-                else:
-                    if revived is not None:
-                        # the hold passed at this event's time: emit it
-                        self._expired.pop(key)
-                        self._emit_expired(revived["rows"], now, out_rows)
-                    s = {"last": ts, "rows": []}
-                self._sessions[key] = s
-            s["last"] = max(s["last"], ts)
-            s["rows"].append(row)
+                    # on-time event past the gap: a NEW session starts; the
+                    # old one parks as previous (a displaced previous emits)
+                    if self.latency_ms > 0:
+                        p = self._prev.get(key)
+                        if p is not None:
+                            self._emit_expired(p["rows"], now, out_rows)
+                        self._prev[key] = {"start": c["start"], "end": c["end"],
+                                           "due": c["end"] + self.latency_ms,
+                                           "rows": c["rows"]}
+                    else:
+                        # reference quirk: with no latency this event is
+                        # silently dropped from the window (the timer would
+                        # normally have flushed first); we expire inline
+                        self._emit_expired(c["rows"], now, out_rows)
+                    self._cur[key] = {"start": ts, "end": ts + self.gap_ms,
+                                      "rows": [row]}
+            else:
+                # late (out-of-order) event: addLateEvent
+                if not self._add_late(key, ts, row, out_rows, now):
+                    continue                  # timed out: drop entirely
             cur = dict(row)
             cur[TYPE_KEY] = CURRENT
             out_rows.append(cur)
         notify = None
-        deadlines = [s["last"] + self.gap_ms for s in self._sessions.values()]
-        deadlines += [s["due"] for s in self._expired.values()]
+        deadlines = [c["end"] for c in self._cur.values()]
+        deadlines += [p["due"] for p in self._prev.values()]
         if deadlines:
             notify = min(deadlines)
         return _emit(out_rows, self.col_specs), notify
 
+    def _add_late(self, key, ts, row, out_rows, now) -> bool:
+        """Reference ``addLateEvent``; returns False when the event's
+        session has timed out (the reference removes it from the chunk)."""
+        c = self._cur[key]
+        if ts >= c["start"] - self.gap_ms:
+            c["rows"].insert(0, row)
+            c["start"] = ts
+            self._merge_prev_into_cur(key)
+            return True
+        if self.latency_ms <= 0:
+            return False
+        p = self._prev.get(key)
+        if p is None or ts < p["start"] - self.gap_ms:
+            return False
+        p["rows"].append(row)
+        if ts <= p["end"] - self.gap_ms and ts < p["start"]:
+            p["start"] = ts
+        else:
+            p["end"] = ts + self.gap_ms
+            p["due"] = p["end"] + self.latency_ms
+            self._merge_prev_into_cur(key)
+        return True
+
     def _held_rows(self):
-        return ([r for s in self._sessions.values() for r in s["rows"]]
-                + [r for s in self._expired.values() for r in s["rows"]])
+        return ([r for s in self._cur.values() for r in s["rows"]]
+                + [r for s in self._prev.values() for r in s["rows"]])
 
     def snapshot(self):
         return {
-            "sessions": {k: {"last": s["last"], "rows": [dict(r) for r in s["rows"]]}
-                         for k, s in self._sessions.items()},
-            "expired": {k: {"last": s["last"], "due": s["due"],
-                            "rows": [dict(r) for r in s["rows"]]}
-                        for k, s in self._expired.items()},
+            "cur": {k: {"start": s["start"], "end": s["end"],
+                        "rows": [dict(r) for r in s["rows"]]}
+                    for k, s in self._cur.items()},
+            "prev": {k: {"start": s["start"], "end": s["end"], "due": s["due"],
+                         "rows": [dict(r) for r in s["rows"]]}
+                     for k, s in self._prev.items()},
         }
 
     def restore(self, snap):
-        self._sessions = {
-            k: {"last": s["last"], "rows": [dict(r) for r in s["rows"]]}
-            for k, s in snap["sessions"].items()
-        }
-        self._expired = {
-            k: {"last": s["last"], "due": s["due"],
-                "rows": [dict(r) for r in s["rows"]]}
-            for k, s in snap.get("expired", {}).items()
-        }
+        self._cur = {k: {"start": s["start"], "end": s["end"],
+                         "rows": [dict(r) for r in s["rows"]]}
+                     for k, s in snap["cur"].items()}
+        self._prev = {k: {"start": s["start"], "end": s["end"], "due": s["due"],
+                          "rows": [dict(r) for r in s["rows"]]}
+                      for k, s in snap.get("prev", {}).items()}
 
 
 class CronSchedule:

@@ -215,7 +215,15 @@ class KeyedTimeWindowStage(WindowStage):
             ring_ck = state["buf"][self.ts_key][fifo_flat]
             ts_c = jnp.clip(ck, 0, M - 1)
             safe_pk = jnp.where(valid_cur, pk, jnp.int64(K))
-            comp_sorted = (safe_pk[order] * M + ts_c[order]).astype(jnp.int64)
+            # a backwards external clock would leave the composite keys
+            # unsorted and searchsorted arbitrary; cummax over the grouped
+            # composite is a per-key running max (the key occupies the high
+            # bits and groups are contiguous ascending, so the running max
+            # never leaks across keys) — mirroring the unkeyed stage's
+            # lax.cummax guard (ExternalTimeWindowProcessor degrades the
+            # same way under a non-monotone clock)
+            comp_sorted = lax.cummax(
+                (safe_pk[order] * M + ts_c[order]).astype(jnp.int64))
 
             def first_covering(keys_of, item_ts):
                 tgt = keys_of * M + jnp.clip(item_ts + t, 0, M - 1)

@@ -20,14 +20,21 @@ Endpoints (JSON in/out):
 from __future__ import annotations
 
 import json
+import os
+import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
 class SiddhiRestService:
-    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
+                 trace_base: Optional[str] = None):
         self.manager = manager
+        # profiler traces are confined under this directory; REST clients
+        # supply a relative name, never an absolute filesystem path
+        self.trace_base = trace_base or os.path.join(
+            tempfile.gettempdir(), "siddhi_tpu_traces")
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -141,12 +148,33 @@ class SiddhiRestService:
                 h._send(200, {"revision": rt.persist()})
                 return
             if parts[2] == "trace":
-                # {"action": "start", "dir": ...} | {"action": "stop"}
-                if body.get("action") == "start":
-                    h._send(200, {"tracing": rt.start_trace(body["dir"])})
+                # {"action": "start", "dir": <relative name>} | {"action": "stop"}
+                if not isinstance(body, dict) or body.get("action") not in (
+                        "start", "stop"):
+                    h._send(400, {"error": "trace expects action=start|stop"})
+                    return
+                if body["action"] == "start":
+                    name = body.get("dir")
+                    if not isinstance(name, str) or not name:
+                        h._send(400, {"error": "trace start expects a "
+                                               "'dir' (relative name)"})
+                        return
+                    base = os.path.realpath(self.trace_base)
+                    target = os.path.realpath(os.path.join(base, name))
+                    if target != base and not target.startswith(base + os.sep):
+                        h._send(400, {"error": "trace dir escapes the "
+                                               "configured trace base"})
+                        return
+                    try:
+                        h._send(200, {"tracing": rt.start_trace(target)})
+                    except RuntimeError as e:   # double-start
+                        h._send(409, {"error": str(e)})
                 else:
-                    rt.stop_trace()
-                    h._send(200, {"tracing": None})
+                    try:
+                        rt.stop_trace()
+                        h._send(200, {"tracing": None})
+                    except RuntimeError as e:   # stop without start
+                        h._send(409, {"error": str(e)})
                 return
             if parts[2] == "restore":
                 rev = body.get("revision") if isinstance(body, dict) else None

@@ -275,17 +275,18 @@ def test_differential_session_window():
     """
     got = _run_engine(app, sends)
     # model: CURRENT on arrival; a user's session expires as one chunk
-    # when the clock passes last+GAP (timers fire before the advancing
-    # event in playback)
+    # when the clock passes last+GAP. Each session's timer fires AT its
+    # own deadline (Scheduler.sendTimerEvents), so sessions expiring in
+    # the same inter-event interval emit in DEADLINE order (stable for
+    # ties — the engine's sweep sorts by session end)
     sessions = {}
     model = []
     for ts_i, _sid, (u, v) in sends:
-        for uu in list(sessions):
-            last, rows = sessions[uu]
-            if last + GAP <= ts_i:
-                for r in rows:
-                    model.append(("rm", r))
-                del sessions[uu]
+        due = [uu for uu in sessions if sessions[uu][0] + GAP <= ts_i]
+        for uu in sorted(due, key=lambda x: sessions[x][0]):
+            for r in sessions[uu][1]:
+                model.append(("rm", r))
+            del sessions[uu]
         model.append(("in", (u, v)))
         last, rows = sessions.get(u, (0, []))
         rows.append((u, v))

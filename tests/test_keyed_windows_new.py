@@ -63,6 +63,34 @@ def test_keyed_external_time_expired_keep_timestamps():
     assert got == [(1000, ("A", 1)), (1000, ("A", 1)), (2500, ("A", 2))]
 
 
+def test_keyed_external_time_nonmonotone_clock_degrades_gracefully():
+    # a backwards external timestamp must not corrupt expiry: the per-key
+    # running max (segmented cummax) treats the stalled clock as "no
+    # advance", mirroring the unkeyed stage and the reference's behavior
+    # of never expiring on a clock that goes backwards
+    m, rt, c = build("""@app:playback define stream S (sym string, ets long, v int);
+        partition with (sym of S) begin
+        from S#window.externalTime(ets, 1 sec)
+        select sym, sum(v) as total insert into OutStream; end;
+    """)
+    from siddhi_tpu.core.event import Event
+    h = rt.get_input_handler("S")
+    # one batch, A's clock goes 2000 -> 1500 (backwards) -> 3500
+    h.send([Event(timestamp=2000, data=["A", 2000, 1]),
+            Event(timestamp=2100, data=["A", 1500, 2]),
+            Event(timestamp=2200, data=["B", 9000, 100]),
+            Event(timestamp=2300, data=["A", 3500, 4])])
+    m.shutdown()
+    a_totals = [e.data[1] for e in c.events if e.data[0] == "A"]
+    # rows 1 and 2 expire exactly once each (at clock 3500: 2000+1000 and
+    # 1500+1000 are both covered); no arbitrary expiry from the backwards
+    # tick — final A total is 4, never negative or duplicated
+    assert a_totals[-1] == 4
+    assert all(t >= 0 for t in a_totals)
+    b_totals = [e.data[1] for e in c.events if e.data[0] == "B"]
+    assert b_totals == [100]
+
+
 def test_keyed_timelength_evicts_by_count_and_time():
     m, rt, c = build(STREAM + """
         partition with (sym of S) begin

@@ -65,6 +65,45 @@ def test_rest_service_lifecycle():
         m.shutdown()
 
 
+def _req_status(port, method, path, body):
+    import urllib.error
+    try:
+        _req(port, method, path, body)
+        return 200
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_rest_trace_validation():
+    # malformed trace bodies get 4xx, and the trace dir is confined to
+    # the service's trace_base (no client-chosen filesystem paths)
+    import tempfile
+
+    m = SiddhiManager()
+    base = tempfile.mkdtemp()
+    svc = SiddhiRestService(m, trace_base=base).start()
+    p = svc.port
+    try:
+        _req(p, "POST", "/apps",
+             "@app:name('TrApp') define stream S (v int); "
+             "from S select v insert into O;", as_json=False)
+        # missing dir -> 400 (not an unhandled 500)
+        assert _req_status(p, "POST", "/apps/TrApp/trace",
+                           {"action": "start"}) == 400
+        # path escape -> 400
+        assert _req_status(p, "POST", "/apps/TrApp/trace",
+                           {"action": "start", "dir": "../../etc"}) == 400
+        # bad action -> 400
+        assert _req_status(p, "POST", "/apps/TrApp/trace",
+                           {"action": "zap"}) == 400
+        # stop without start -> 4xx, never a 500
+        assert _req_status(p, "POST", "/apps/TrApp/trace",
+                           {"action": "stop"}) in (200, 409)
+    finally:
+        svc.stop()
+        m.shutdown()
+
+
 def test_doc_generator():
     m = SiddhiManager()
 

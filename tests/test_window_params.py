@@ -94,14 +94,70 @@ def test_session_latency_delays_expiry_and_revives():
     # gap passes at 3000; latency holds the session until 4000
     h.send(3500, ["u2", 9])     # advances clock: u1 parked, not emitted yet
     n_at_3500 = len(c.events)
-    h.send(3700, ["u1", 2])     # late event revives u1's session
+    h.send(3700, ["u1", 2])     # ON-TIME past the gap: starts a NEW session
     h.send(8000, ["u2", 0])     # clock far ahead: everything expires
     m.shutdown()
     data = [tuple(e.data) for e in c.events]
-    # u1's rows expire together (revived session emitted once, 2 rows)
     assert data.count(("u1", 1)) == 2 and data.count(("u1", 2)) == 2
     # at 3500 only pass-through currents had been emitted (no u1 expiry)
     assert n_at_3500 == 2
+    # the parked session {1} expires at its due (4000) BEFORE the fresh
+    # session {2} does (6700) — they must NOT fuse into one emission
+    # (reference moveCurrentSessionToPreviousSession, not a revive)
+    exp1 = max(i for i, d in enumerate(data) if d == ("u1", 1))
+    exp2 = max(i for i, d in enumerate(data) if d == ("u1", 2))
+    assert exp1 < exp2
+
+
+def test_session_latency_timers_fire_at_scheduled_times():
+    # a playback clock jump releases each pending session timer AT its
+    # scheduled time (Scheduler.sendTimerEvents): parked {1} emits at its
+    # due 4000, u2 at 6500, the fresh session {2} at 6700 — three distinct
+    # expiry timestamps, never one fused sweep at the jumped-to clock
+    m, rt, c = build(SESSION)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["u1", 1])
+    h.send(3500, ["u2", 9])
+    h.send(3700, ["u1", 2])
+    h.send(9000, ["u2", 0])
+    m.shutdown()
+    exp = [(e.timestamp, tuple(e.data)) for e in c.events[3:-1]]
+    assert exp == [(4000, ("u1", 1)), (6500, ("u2", 9)), (6700, ("u1", 2))]
+
+
+def test_session_latency_late_event_with_empty_current_starts_new():
+    # reference processEventChunk: with current EMPTY (just parked), a late
+    # event starts a NEW current session — it does NOT rejoin previous
+    from siddhi_tpu.core.event import Event
+
+    m, rt, c = build(SESSION)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["u1", 1])
+    h.send(3500, ["u2", 9])        # u1 {1} parks as previous (due 4000)
+    h.send([Event(timestamp=2500, data=["u1", 2])])
+    h.send(10000, ["u2", 0])
+    m.shutdown()
+    u1_exp = [e.timestamp for e in c.events if e.data[0] == "u1"][2:]
+    # {1} at its due 4000; {2} (span 2500-4500, hold to 5500) at 5500
+    assert u1_exp == [4000, 5500]
+
+
+def test_session_latency_bridging_late_event_merges_all():
+    # reference addLateEvent + mergeWindows: a late event landing within
+    # gap of the live current session pulls its start back far enough to
+    # bridge to the parked previous — all rows fuse into ONE emission
+    from siddhi_tpu.core.event import Event
+
+    m, rt, c = build(SESSION)
+    h = rt.get_input_handler("S")
+    h.send(1000, ["u1", 1])
+    h.send(3500, ["u2", 9])        # u1 {1} parks (span 1000-3000, due 4000)
+    h.send(3600, ["u1", 2])        # on-time: fresh current {start 3600}
+    h.send([Event(timestamp=3400, data=["u1", 3])])   # late, bridges
+    h.send(10000, ["u2", 0])
+    m.shutdown()
+    u1_exp = [e.timestamp for e in c.events if e.data[0] == "u1"][3:]
+    assert len(u1_exp) == 3 and len(set(u1_exp)) == 1   # one merged chunk
 
 
 def test_session_latency_expires_after_hold():
