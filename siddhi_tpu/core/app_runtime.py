@@ -192,6 +192,11 @@ class SiddhiAppRuntime:
         self.input_manager.ensure_started = self.start
 
         self.partition_contexts: List = []
+        # pre-register set metadata on EXPLICITLY defined target streams so
+        # a consumer query written before its producer still compiles with
+        # the right multi/element-type knowledge (assembly is one pass in
+        # text order; auto-defined streams cannot be forward-referenced)
+        self._prescan_object_metadata(siddhi_app)
         q_index = 0
         p_index = 0
         for element in siddhi_app.execution_elements:
@@ -227,6 +232,11 @@ class SiddhiAppRuntime:
         j = StreamJunction(sdef, self.app_context)
         async_ann = find_annotation(sdef.annotations, "async")
         if async_ann is not None:
+            if self.app_context.enforce_order:
+                raise SiddhiAppValidationException(
+                    f"@app:enforceOrder is incompatible with @Async on "
+                    f"stream '{sdef.id}': async buffering can interleave "
+                    f"producer batches out of timestamp order")
             buffer_size = int(async_ann.element("buffer.size") or 1024)
             batch_size = int(async_ann.element("batch.size") or 256)
             j.enable_async(buffer_size, batch_size)
@@ -285,6 +295,76 @@ class SiddhiAppRuntime:
             q_index += 1
             self._add_query(query, q_index, partition_ctx=pctx)
         return q_index
+
+    def _prescan_object_metadata(self, siddhi_app):
+        """Best-effort first pass over query ASTs: record which object
+        attributes of explicitly defined streams are MULTI-element sets
+        (unionSet outputs) and their element types (createSet args), so
+        query text order does not change set semantics."""
+        from siddhi_tpu.query_api.execution import (
+            InsertIntoStream,
+            Partition,
+            Query,
+        )
+        from siddhi_tpu.query_api.expressions import AttributeFunction, Variable
+
+        def input_attr_type(query, var):
+            ist = getattr(query, "input_stream", None)
+            sid = getattr(ist, "stream_id", None)
+            sdef = self.stream_definitions.get(sid) if sid else None
+            if sdef is None:
+                return None
+            try:
+                return sdef.attribute(var.attribute_name).type
+            except Exception:
+                return None
+
+        def elem_of(query, expr):
+            # element type of createSet(<arg>) when statically resolvable
+            if not (isinstance(expr, AttributeFunction)
+                    and expr.name.lower() == "createset" and expr.parameters):
+                return None
+            arg = expr.parameters[0]
+            if isinstance(arg, Variable):
+                return input_attr_type(query, arg)
+            return None
+
+        def scan(query):
+            out = getattr(query, "output_stream", None)
+            if not isinstance(out, InsertIntoStream):
+                return
+            tdef = self.stream_definitions.get(out.target_id)
+            if tdef is None or query.selector is None:
+                return
+            for oa in query.selector.selection_list or []:
+                expr = oa.expression
+                if not isinstance(expr, AttributeFunction):
+                    continue
+                name = expr.name.lower()
+                elem = None
+                multi = False
+                if name == "unionset" and expr.parameters:
+                    multi = True
+                    elem = elem_of(query, expr.parameters[0])
+                elif name == "createset":
+                    elem = elem_of(query, expr)
+                else:
+                    continue
+                if multi:
+                    ms = set(getattr(tdef, "object_multi_attrs", None) or set())
+                    ms.add(oa.name)
+                    tdef.object_multi_attrs = ms
+                if elem is not None:
+                    et = dict(getattr(tdef, "object_elem_types", None) or {})
+                    et[oa.name] = elem
+                    tdef.object_elem_types = et
+
+        for element in siddhi_app.execution_elements:
+            if isinstance(element, Query):
+                scan(element)
+            elif isinstance(element, Partition):
+                for q in element.queries:
+                    scan(q)
 
     def _add_query(self, query: Query, index: int, partition_ctx=None):
         query_name = query.name or f"query_{index}"
@@ -366,6 +446,21 @@ class SiddhiAppRuntime:
                     self.stream_definitions[target] = sdef
                     self._create_junction(sdef)
                 runtime.output_junction = self.junctions[target]
+                # record set-element types on the target stream so later
+                # queries (unionSet/sizeOfSet over this stream) and event
+                # decode know how to interpret object set columns
+                ometa = {n: t for n, t in getattr(
+                    runtime.selector_plan, "object_meta", {}).items()
+                    if t is not None}
+                omulti = getattr(runtime.selector_plan, "object_multi", [])
+                if ometa or omulti:
+                    tdef = self.stream_definitions[target]
+                    merged = dict(getattr(tdef, "object_elem_types", None) or {})
+                    merged.update(ometa)
+                    tdef.object_elem_types = merged
+                    tdef.object_multi_attrs = (
+                        set(getattr(tdef, "object_multi_attrs", None) or set())
+                        | set(omulti))
         elif out is not None:
             raise SiddhiAppValidationException(
                 f"unsupported output action {type(out).__name__}")

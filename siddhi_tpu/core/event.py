@@ -220,7 +220,55 @@ class HostBatch:
             # shapes) stay static whether or not a batch contains nulls
             mask = np.zeros(b, bool)
             if n:
-                if attr.type == AttrType.STRING:
+                if attr.type == AttrType.OBJECT:
+                    # set ingestion. Element codes follow the stream's
+                    # recorded element type (see encode_set_value); the
+                    # representation follows its multi/singleton register:
+                    # a MULTI attr (unionSet output) re-encodes as live
+                    # count + '#set'/'#setm' companions, a singleton as its
+                    # element code.
+                    from siddhi_tpu.ops.expressions import encode_set_value
+
+                    elem_t = (getattr(definition, "object_elem_types", None)
+                              or {}).get(attr.name)
+                    multi = attr.name in (getattr(
+                        definition, "object_multi_attrs", None) or set())
+                    as_sets = []
+                    nulls = []
+                    for i, r in enumerate(rows):
+                        val = r[pos]
+                        if val is None:
+                            nulls.append(i)
+                            as_sets.append(frozenset())
+                        elif isinstance(val, (set, frozenset)):
+                            as_sets.append(val)
+                        else:
+                            as_sets.append(frozenset([val]))
+                    if multi:
+                        H = max(1, max((len(s) for s in as_sets), default=1))
+                        snap = np.zeros((b, H), np.int64)
+                        snapm = np.zeros((b, H), bool)
+                        for i, s in enumerate(as_sets):
+                            for j, el in enumerate(s):
+                                snap[i, j] = encode_set_value(
+                                    el, elem_t, dictionary)
+                                snapm[i, j] = True
+                            arr[i] = len(s)
+                        cols[attr.name + "#set"] = snap
+                        cols[attr.name + "#setm"] = snapm
+                    else:
+                        for i, s in enumerate(as_sets):
+                            if len(s) > 1:
+                                raise ValueError(
+                                    f"attribute '{attr.name}' carries "
+                                    "singleton sets (createSet transport); "
+                                    "got a multi-element set")
+                            if s:
+                                arr[i] = encode_set_value(
+                                    next(iter(s)), elem_t, dictionary)
+                    if nulls:
+                        mask[nulls] = True
+                elif attr.type == AttrType.STRING:
                     vals = [
                         StringDictionary.NULL_ID if r[pos] is None else encode(r[pos])
                         for r in rows
@@ -296,9 +344,16 @@ class HostBatch:
         dictionary: StringDictionary,
         types_wanted: Optional[Sequence[int]] = None,
         pk_key: Optional[str] = None,
+        object_meta: Optional[Dict[str, object]] = None,
+        object_multi: Optional[set] = None,
     ) -> List[Event]:
         """Decode valid rows into Events (optionally filtered by type).
-        ``pk_key`` names a partition-id column to attach as Event.pk."""
+        ``pk_key`` names a partition-id column to attach as Event.pk.
+        ``object_meta`` maps OBJECT (set-valued) attr names to their
+        element AttrType (raw int codes without it); ``object_multi``
+        names the attrs that are MULTI-element sets — decoding one whose
+        '#set' companions were dropped raises instead of emitting the
+        live count as a bogus singleton."""
         valid = np.asarray(self.cols[VALID_KEY])
         types = np.asarray(self.cols[TYPE_KEY])
         ts = np.asarray(self.cols[TS_KEY])
@@ -314,6 +369,38 @@ class HostBatch:
         col_lists: List[list] = []
         for key, attr_type in attr_order:
             vals = np.asarray(self.cols[key])[idx]
+            if attr_type == AttrType.OBJECT:
+                # set values: '#set'/'#setm' companions hold the elements
+                # (unionSet snapshots); a bare column is a singleton set
+                # whose value IS the element code (createSet transport)
+                from siddhi_tpu.ops.expressions import decode_set_element
+
+                elem_t = (object_meta or {}).get(key)
+                snap = self.cols.get(key + "#set")
+                if snap is not None:
+                    sv = np.asarray(snap)[idx]
+                    sm = np.asarray(self.cols[key + "#setm"])[idx]
+                    lst = [frozenset(decode_set_element(c, elem_t, dictionary)
+                                     for c in row_v[row_m])
+                           for row_v, row_m in zip(sv, sm)]
+                elif object_multi and key in object_multi:
+                    # the base column of a multi set is its live COUNT —
+                    # decoding it as an element would be silent garbage
+                    # (mirrors the unionSet arg_is_multi guard)
+                    raise ValueError(
+                        f"multi-element set attribute '{key}' lost its "
+                        f"'#set' element snapshot (a window buffers only "
+                        f"the base column); project it before windowing")
+                else:
+                    lst = [frozenset([decode_set_element(v, elem_t, dictionary)])
+                           for v in vals]
+                mask = self.cols.get(key + "?")
+                if mask is not None:
+                    mvals = np.asarray(mask)[idx]
+                    if mvals.any():
+                        lst = [None if m else v for v, m in zip(lst, mvals)]
+                col_lists.append(lst)
+                continue
             if attr_type == AttrType.STRING:
                 lst = [dictionary.decode(int(v)) for v in vals]
             elif attr_type == AttrType.BOOL:

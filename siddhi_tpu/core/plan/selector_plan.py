@@ -74,8 +74,26 @@ def _rewrite_aggregators(expr: Expression, specs: List[agg_ops.AggSpec], resolve
             raise CompileError(f"{kind}() requires an argument")
         out_key = f"__agg{len(specs)}__"
         out_type = agg_ops.agg_result_type(kind, arg_t)
-        specs.append(agg_ops.AggSpec(kind=kind, arg_fn=arg_f, arg_type=arg_t,
-                                     out_key=out_key, out_type=out_type))
+        spec = agg_ops.AggSpec(kind=kind, arg_fn=arg_f, arg_type=arg_t,
+                               out_key=out_key, out_type=out_type)
+        if kind == "unionset":
+            from siddhi_tpu.ops.expressions import take_object_elem_marker
+
+            if arg_t != AttrType.OBJECT:
+                raise CompileError(
+                    "Parameter passed to unionSet aggregator should be of "
+                    f"type object but found: {arg_t.value if arg_t else None}")
+            # element type for decode: a nested createSet() marks it; a
+            # bare set attribute carries it on its stream definition (and
+            # its column key locates '#set' companions for re-union)
+            spec.elem_type = take_object_elem_marker()
+            param = expr.parameters[0]
+            if isinstance(param, Variable):
+                spec.arg_key = resolver.resolve(param).key
+                spec.arg_is_multi = _is_multi(resolver, param)
+                if spec.elem_type is None:
+                    spec.elem_type = _elem_type_of(resolver, param)
+        specs.append(spec)
         return Variable(attribute_name=out_key)
     for attr_name in ("left", "right", "expression"):
         child = getattr(expr, attr_name, None)
@@ -84,6 +102,24 @@ def _rewrite_aggregators(expr: Expression, specs: List[agg_ops.AggSpec], resolve
     if isinstance(expr, AttributeFunction):
         expr.parameters = [_rewrite_aggregators(p, specs, resolver) for p in expr.parameters]
     return expr
+
+
+def _elem_type_of(resolver, var: Variable):
+    """Set-element type of an object attribute, recorded on its stream
+    definition by the app assembler (best effort; None = decode raw)."""
+    defn = getattr(resolver, "definition", None)
+    meta = getattr(defn, "object_elem_types", None) if defn is not None else None
+    if meta:
+        return meta.get(var.attribute_name)
+    return None
+
+
+def _is_multi(resolver, var: Variable) -> bool:
+    """Whether an object attribute is a MULTI-element set (unionSet
+    output), per its stream definition's assembler metadata."""
+    defn = getattr(resolver, "definition", None)
+    multi = getattr(defn, "object_multi_attrs", None) if defn is not None else None
+    return bool(multi) and var.attribute_name in multi
 
 
 @dataclass
@@ -109,6 +145,14 @@ class SelectorPlan:
     # output columns whose value is a host-generated UUID per row (the
     # device step emits placeholders; QueryRuntime._emit fills them)
     uuid_cols: List[str] = field(default_factory=list)
+    # OBJECT set outputs: (out name, source column key) pairs whose
+    # '#set'/'#setm' companions must ride along, and out name -> element
+    # AttrType for event decode (None = raw int codes)
+    set_cols: List[Tuple[str, str]] = field(default_factory=list)
+    object_meta: Dict[str, Optional[AttrType]] = field(default_factory=dict)
+    # outputs that are MULTI-element sets (unionSet results): their base
+    # column is the live COUNT; singletons' base column is the element code
+    object_multi: List[str] = field(default_factory=list)
 
     @property
     def contains_aggregator(self) -> bool:
@@ -146,6 +190,11 @@ class SelectorPlan:
             out[name] = v
             if m is not None:
                 out[name + "?"] = m
+        for name, src in self.set_cols:
+            # a set-valued output's element snapshot rides beside its count
+            for suf in ("#set", "#setm"):
+                if src + suf in cols:
+                    out[name + suf] = cols[src + suf]
 
         types = cols[TYPE_KEY]
         valid = cols[VALID_KEY]
@@ -225,17 +274,40 @@ def plan_selector(
 
     from siddhi_tpu.ops.expressions import take_uuid_marker
 
+    from siddhi_tpu.ops.expressions import take_object_elem_marker
+
     take_uuid_marker()  # clear any stale flag
+    take_object_elem_marker()
     projections = []
     output_attrs: List[Tuple[str, AttrType]] = []
     uuid_cols: List[str] = []
+    set_cols: List[Tuple[str, str]] = []
+    object_meta: Dict[str, Optional[AttrType]] = {}
+    object_multi: List[str] = []
     for name, expr in selections:
+        n_specs = len(specs)
         rewritten = _rewrite_aggregators(expr, specs, resolver)
         # synthetic agg columns resolve through the same resolver
         _augment_synthetic(resolver, specs)
         fn, t = compile_expr(rewritten, resolver)
         if take_uuid_marker():
             uuid_cols.append(name)  # host fills fresh UUIDs post-step
+        if t == AttrType.OBJECT:
+            # set-valued output: record element type (for decode) and the
+            # source column (for '#set' companion pass-through)
+            elem = take_object_elem_marker()     # createSet in this expr
+            if isinstance(rewritten, Variable):
+                src = resolver.resolve(rewritten).key
+                for s in specs[n_specs:]:
+                    if s.out_key == src and s.kind == "unionset":
+                        elem = s.elem_type
+                        object_multi.append(name)
+                set_cols.append((name, src))
+                if elem is None:
+                    elem = _elem_type_of(resolver, rewritten)
+                if name not in object_multi and _is_multi(resolver, rewritten):
+                    object_multi.append(name)   # pass-through of a multi set
+            object_meta[name] = elem
         projections.append((name, fn, t))
         output_attrs.append((name, t))
 
@@ -256,7 +328,7 @@ def plan_selector(
 
     if app_context is not None:
         for spec in specs:
-            if spec.kind == "distinctcount":
+            if spec.kind in ("distinctcount", "unionset"):
                 spec.distinct_capacity = getattr(
                     app_context, "distinct_values_capacity", 64)
 
@@ -274,6 +346,9 @@ def plan_selector(
         limit=selector.limit,
         offset=selector.offset,
         uuid_cols=uuid_cols,
+        set_cols=set_cols,
+        object_meta=object_meta,
+        object_multi=object_multi,
     )
 
 

@@ -325,6 +325,11 @@ class QueryRuntime(Receiver):
         objects on ingest."""
         if self.carried_pk and PK_KEY not in batch.cols:
             batch.cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
+        # a re-published batch omits '?' masks for never-null outputs;
+        # window buffers key off the full col-spec set, so backfill
+        for a in self.input_definition.attributes:
+            if a.name in batch.cols and a.name + "?" not in batch.cols:
+                batch.cols[a.name + "?"] = np.zeros(batch.capacity, bool)
         self.process_batch(batch)
 
     _now_override = None   # timer chunks sweep at their scheduled time
@@ -671,6 +676,8 @@ class QueryRuntime(Receiver):
         events = out.to_events(
             self.output_attrs, self.dictionary,
             pk_key=PK_KEY if self.attach_pk else None,
+            object_meta=self.selector_plan.object_meta or None,
+            object_multi=set(self.selector_plan.object_multi) or None,
         )
         if self.rate_limiter is not None:
             self.rate_limiter.process(events)

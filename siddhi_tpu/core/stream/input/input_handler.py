@@ -23,6 +23,20 @@ class InputHandler:
         self.app_context = app_context
         self._barrier = barrier
         self._ensure_started = ensure_started
+        self._last_ts = None   # @app:enforceOrder monotonicity watermark
+
+    def _check_order(self, first_ts: int, last_ts: int):
+        """@app:enforceOrder: reject out-of-order ingestion on this stream
+        (the reference carries the flag on SiddhiAppContext with no
+        enforcement anywhere — here it buys a real guarantee: a send whose
+        timestamp precedes the stream's watermark raises instead of
+        silently reordering window/pattern state)."""
+        if self._last_ts is not None and first_ts < self._last_ts:
+            raise ValueError(
+                f"@app:enforceOrder: event timestamp {first_ts} precedes "
+                f"stream '{self.stream_id}' watermark {self._last_ts}")
+        self._last_ts = last_ts if self._last_ts is None \
+            else max(self._last_ts, last_ts)
 
     def send(self, *args):
         """send(data_list) | send(ts, data_list) | send(Event) | send([Event,...])"""
@@ -44,8 +58,19 @@ class InputHandler:
         for ev in events:
             if ev.timestamp < 0:
                 ev.timestamp = tsg.current_time()
-            tsg.set_current_timestamp(ev.timestamp)
         with self._barrier:  # snapshot quiesce gate (ThreadBarrier.java:30-36)
+            # order check INSIDE the barrier (atomic with delivery order)
+            # and BEFORE the clock advances — a rejected batch must not
+            # fire timers or expire windows as a side effect
+            if self.app_context.enforce_order and events:
+                ts_seq = [e.timestamp for e in events]
+                if any(b < a for a, b in zip(ts_seq, ts_seq[1:])):
+                    raise ValueError(
+                        f"@app:enforceOrder: non-monotone timestamps inside "
+                        f"a batch on stream '{self.stream_id}'")
+                self._check_order(ts_seq[0], ts_seq[-1])
+            for ev in events:
+                tsg.set_current_timestamp(ev.timestamp)
             self.junction.send_events(events)
 
     def send_columns(self, data, timestamps=None):
@@ -65,11 +90,18 @@ class InputHandler:
             data, self.junction.definition,
             self.app_context.string_dictionary,
             timestamps=timestamps, default_ts=now)
-        if timestamps is not None:
-            ts_arr = np.asarray(timestamps, np.int64)
-            if ts_arr.size:
-                tsg.set_current_timestamp(int(ts_arr.max()))
         with self._barrier:
+            if timestamps is not None:
+                ts_arr = np.asarray(timestamps, np.int64)
+                if ts_arr.size:
+                    # order check before the clock advances (see send())
+                    if self.app_context.enforce_order:
+                        if np.any(ts_arr[1:] < ts_arr[:-1]):
+                            raise ValueError(
+                                f"@app:enforceOrder: non-monotone timestamps "
+                                f"inside a batch on stream '{self.stream_id}'")
+                        self._check_order(int(ts_arr[0]), int(ts_arr[-1]))
+                    tsg.set_current_timestamp(int(ts_arr.max()))
             self.junction.send_batch(batch)
 
 

@@ -90,6 +90,8 @@ class StreamJunction:
         return batch.to_events(
             [(a.name, a.type) for a in self.definition.attributes],
             self.app_context.string_dictionary,
+            object_meta=getattr(self.definition, "object_elem_types", None),
+            object_multi=getattr(self.definition, "object_multi_attrs", None),
         )
 
     def send_batch(self, batch):

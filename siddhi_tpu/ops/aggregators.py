@@ -34,7 +34,7 @@ import numpy as np
 from jax import lax
 
 from siddhi_tpu.ops import types as T
-from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY, CompileError
 from siddhi_tpu.query_api.definitions import AttrType
 
 CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
@@ -51,7 +51,12 @@ class AggSpec:
     arg_type: Optional[AttrType]
     out_key: str                   # synthetic output column name (__agg<i>__)
     out_type: AttrType = AttrType.DOUBLE
-    distinct_capacity: int = 64    # distinctCount: per-group value slots
+    distinct_capacity: int = 64    # distinctCount/unionSet: per-group value slots
+    arg_key: Optional[str] = None  # unionSet: raw column key of a bare-Variable
+    #                                arg (to find '#set' companions on re-union)
+    elem_type: Optional[AttrType] = None  # unionSet: set element type (decode)
+    arg_is_multi: bool = False     # unionSet: arg is a MULTI-element set attr
+    #                                (companions REQUIRED; base col is a count)
 
     # filled by the planner:
     @property
@@ -80,6 +85,11 @@ _AGG_DEFS = {
     "maxforever": _AggDef(2, "max"),
     # multiset state, handled by its own scan path (_apply_distinct)
     "distinctcount": _AggDef(1, "add"),
+    # union of sets over the window: the same multiset value-table as
+    # distinctCount, additionally emitting the live-element snapshot as
+    # bounded [B, H] '#set'/'#setm' companions
+    # (UnionSetAttributeAggregatorExecutor.java processAdd/processRemove)
+    "unionset": _AggDef(1, "add"),
 }
 
 
@@ -101,6 +111,8 @@ def agg_result_type(kind: str, arg_type: Optional[AttrType]) -> AttrType:
         return arg_type
     if kind == "distinctcount":
         return AttrType.LONG
+    if kind == "unionset":
+        return AttrType.OBJECT
     raise KeyError(kind)
 
 
@@ -133,7 +145,7 @@ def init_agg_state(specs: List[AggSpec], num_keys: int) -> dict:
     """State pytree: per spec a [slots, K] array (plus a seen-flag per key)."""
     state = {}
     for i, spec in enumerate(specs):
-        if spec.kind == "distinctcount":
+        if spec.kind in ("distinctcount", "unionset"):
             H = spec.distinct_capacity
             state[f"a{i}"] = {
                 "vk": jnp.zeros((num_keys, H), jnp.int64),     # value keys
@@ -269,47 +281,60 @@ def _output(spec: AggSpec, slots, ctx):
 
 def _encode_distinct_value(spec: AggSpec, cols, ctx):
     """Value column -> int64 identity keys (floats by bit pattern; strings
-    are already dictionary ids), plus the null mask."""
+    are already dictionary ids), plus the null mask. Shares ONE encoding
+    with createSet/unionSet set elements (ops/expressions.py) so
+    distinctCount and set features always agree on value identity."""
+    from siddhi_tpu.ops.expressions import _encode_set_element
+
     v, m = spec.arg_fn(cols, ctx)
-    v = jnp.asarray(v)
-    if spec.arg_type == AttrType.FLOAT:
-        v = lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
-    elif spec.arg_type == AttrType.DOUBLE:
-        v = lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
-    return v.astype(jnp.int64), m
+    return _encode_set_element(ctx["xp"], v, spec.arg_type), m
 
 
 def _apply_distinct(spec: AggSpec, st: dict, cols: dict, ctx: dict,
                     num_keys: int, gk, participates, epoch_before,
                     final_epoch):
-    """distinctCount: exact per-event running count of distinct live values
-    per group (DistinctCountAttributeAggregatorExecutor semantics: +1 on a
-    value's first CURRENT, -1 when its count returns to zero via EXPIRED).
+    """distinctCount / unionSet: exact per-event running multiset of live
+    values per group (DistinctCountAttributeAggregatorExecutor /
+    UnionSetAttributeAggregatorExecutor semantics: +1 on a value's
+    CURRENT, -1 on its EXPIRED; a value is live while its count > 0).
 
     State is a per-group open table of (value, count) pairs with lazy
     RESET clearing via epoch stamps; the batch is processed by one
     sequential ``lax.scan`` in arrival order — exact, not the fast path
-    (opt in by using the aggregator)."""
+    (opt in by using the aggregator). unionSet additionally emits the
+    per-row live-element snapshot as bounded ``[B, H]`` '#set'/'#setm'
+    companion columns, and folds multi-element input sets (an upstream
+    unionSet's companions) element-wise — the processAdd loop over the
+    incoming java.util.Set."""
     types = cols[TYPE_KEY]
     B = gk.shape[0]
     H = spec.distinct_capacity
     K = num_keys
+    emit_set = spec.kind == "unionset"
 
     v, null_m = _encode_distinct_value(spec, cols, ctx)
+    set_in = set_in_m = None
+    if emit_set and spec.arg_key is not None:
+        set_in = cols.get(spec.arg_key + "#set")
+        if set_in is not None:
+            set_in_m = cols[spec.arg_key + "#setm"]
+        elif spec.arg_is_multi:
+            # the base column of a multi set is its live COUNT — folding
+            # counts as element codes would be silent garbage
+            raise CompileError(
+                f"unionSet over multi-element set attribute "
+                f"'{spec.arg_key}' requires its element snapshot, but the "
+                f"'#set' companions were dropped (a window between the "
+                f"producing unionSet and this one buffers only the base "
+                f"column); apply unionSet before the window instead")
     part = participates
-    if null_m is not None:
+    if null_m is not None and set_in is None:
         part = part & ~jnp.asarray(null_m)
     delta = jnp.where(types == CURRENT, jnp.int32(1), jnp.int32(-1))
     g = jnp.clip(gk.astype(jnp.int32), 0, K - 1)
     ep = st["eb"] + epoch_before.astype(jnp.int64)
 
-    def body(carry, x):
-        vk, vc, stamp, of = carry
-        gi, vi, di, pi, ei = x
-        vk_row = lax.dynamic_index_in_dim(vk, gi, 0, keepdims=False)   # [H]
-        vc_orig = lax.dynamic_index_in_dim(vc, gi, 0, keepdims=False)
-        fresh = stamp[gi] != ei
-        vc_row = jnp.where(fresh, jnp.int32(-1), vc_orig)
+    def insert_one(vk_row, vc_row, vi, di, apply_i):
         # a slot whose count returned to 0 is dead: reclaimable, no longer
         # matching — the table tracks LIVE values, not all-time cardinality
         occupied = vc_row > 0
@@ -320,24 +345,62 @@ def _apply_distinct(spec: AggSpec, st: dict, cols: dict, ctx: dict,
         ok = has | jnp.any(empty)
         cnt = jnp.where(has, vc_row[slot], jnp.int32(0))
         newc = jnp.maximum(cnt + di, 0)
-        vk2_row = vk_row.at[slot].set(vi)
-        vc2_row = vc_row.at[slot].set(newc)
-        apply = pi & ok
-        vk_w = jnp.where(apply, vk2_row, vk_row)
-        vc_w = jnp.where(apply, vc2_row, vc_orig)
+        applied = apply_i & ok
+        vk2 = jnp.where(applied, vk_row.at[slot].set(vi), vk_row)
+        vc2 = jnp.where(applied, vc_row.at[slot].set(newc), vc_row)
+        return vk2, vc2, applied, apply_i & ~ok
+
+    def body(carry, x):
+        vk, vc, stamp, of = carry
+        if set_in is not None:
+            gi, vis, mis, di, pi, ei = x          # vis/mis: [Cin]
+        else:
+            gi, vi, di, pi, ei = x
+        vk_row = lax.dynamic_index_in_dim(vk, gi, 0, keepdims=False)   # [H]
+        vc_orig = lax.dynamic_index_in_dim(vc, gi, 0, keepdims=False)
+        fresh = stamp[gi] != ei
+        vc_row = jnp.where(fresh, jnp.int32(-1), vc_orig)
+        if set_in is None:
+            vk_w2, vc_w2, any_applied, ofl = insert_one(
+                vk_row, vc_row, vi, di, pi)
+        else:
+            Cin = set_in.shape[1]
+
+            def fold(c, acc):
+                vkr, vcr, anya, ofa = acc
+                vk2, vc2, ap, ofl_c = insert_one(vkr, vcr, vis[c], di,
+                                                 pi & mis[c])
+                return vk2, vc2, anya | ap, ofa | ofl_c
+
+            vk_w2, vc_w2, any_applied, ofl = lax.fori_loop(
+                0, Cin, fold,
+                (vk_row, vc_row, jnp.bool_(False), jnp.bool_(False)))
+        vk_w = jnp.where(any_applied, vk_w2, vk_row)
+        vc_w = jnp.where(any_applied, vc_w2, vc_orig)
         vk = lax.dynamic_update_index_in_dim(vk, vk_w, gi, 0)
         vc = lax.dynamic_update_index_in_dim(vc, vc_w, gi, 0)
-        stamp = stamp.at[gi].set(jnp.where(apply, ei, stamp[gi]))
-        nd = jnp.sum(vc_w > 0).astype(jnp.int64)
-        of = of | (pi & ~ok)
+        stamp = stamp.at[gi].set(jnp.where(any_applied, ei, stamp[gi]))
+        live = jnp.where(any_applied, vc_w2, vc_row) > 0
+        nd = jnp.sum(live).astype(jnp.int64)
+        of = of | ofl
+        if emit_set:
+            snap_vk = jnp.where(any_applied, vk_w2, vk_row)
+            return (vk, vc, stamp, of), (nd, snap_vk, live)
         return (vk, vc, stamp, of), nd
 
-    (vk, vc, stamp, of), nd = lax.scan(
-        body, (st["vk"], st["vc"], st["stamp"], jnp.bool_(False)),
-        (g, v, delta, part, ep))
+    xs = ((g, set_in, set_in_m, delta, part, ep) if set_in is not None
+          else (g, v, delta, part, ep))
+    (vk, vc, stamp, of), ys = lax.scan(
+        body, (st["vk"], st["vc"], st["stamp"], jnp.bool_(False)), xs)
     new_st = {"vk": vk, "vc": vc, "stamp": stamp,
               "eb": st["eb"] + final_epoch.astype(jnp.int64)}
     cols = dict(cols)
+    if emit_set:
+        nd, snap_vk, snap_live = ys
+        cols[spec.out_key + "#set"] = snap_vk          # [B, H]
+        cols[spec.out_key + "#setm"] = snap_live       # [B, H]
+    else:
+        nd = ys
     cols[spec.out_key] = nd
     prev = cols.get("__agg_overflow__")
     ov = of.astype(jnp.int32)
@@ -392,7 +455,7 @@ def apply_aggregators(specs: List[AggSpec], state: dict, cols: dict, ctx: dict,
     cols = dict(cols)
     for i, spec in enumerate(specs):
         key = f"a{i}"
-        if spec.kind == "distinctcount":
+        if spec.kind in ("distinctcount", "unionset"):
             new_state[key], cols = _apply_distinct(
                 spec, state[key], cols, ctx, num_keys, gk, participates,
                 epoch_before, final_epoch)

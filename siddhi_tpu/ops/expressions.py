@@ -422,6 +422,63 @@ def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
 
         return fn, AttrType.STRING
 
+    if name == "createset":
+        # reference CreateSetFunctionExecutor: wraps one value in a
+        # singleton set. TPU inversion: the set IS its element's int64
+        # identity code (strings: dict ids; floats: bit patterns) — a
+        # scalar column, so windows/joins buffer it natively; multi-element
+        # sets only arise as unionSet outputs (bounded [B,H] snapshots).
+        if len(args) != 1:
+            raise CompileError(
+                "createSet() function has to have exactly 1 parameter, "
+                f"currently {len(args)} parameters provided")
+        src_f, src_t = compile_expr(args[0], resolver)
+        if src_t == AttrType.OBJECT:
+            raise CompileError("createSet() argument must be a primitive type")
+        mark_object_elem(src_t)
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            v, m = src_f(cols, ctx)
+            return _encode_set_element(xp, v, src_t), m
+
+        return fn, AttrType.OBJECT
+
+    if name == "sizeofset":
+        # reference SizeOfSetFunctionExecutor: cardinality of a set value.
+        # unionSet outputs carry their live count in the base column and
+        # their elements in '#set'/'#setm' companions; a singleton (from
+        # createSet) is size 1, or 0 when null.
+        if len(args) != 1 or not isinstance(args[0], Variable):
+            raise CompileError(
+                "sizeOfSet() expects exactly one set-typed attribute reference")
+        ref = resolver.resolve(args[0])
+        if ref.type != AttrType.OBJECT:
+            raise CompileError(
+                f"sizeOfSet() argument must be of type object, "
+                f"found {ref.type.value}")
+        key = ref.key
+        # a unionSet output's base column IS the live count (its element
+        # snapshot travels in '#set' companions that windows drop); a
+        # createSet singleton's base column is the element code
+        defn = getattr(resolver, "definition", None)
+        multi = key in (getattr(defn, "object_multi_attrs", None) or set())
+
+        def fn(cols, ctx):
+            xp = ctx["xp"]
+            sm = cols.get(key + "#setm")
+            if sm is not None:      # multi-element set: count live slots
+                return xp.sum(sm, axis=-1).astype(xp.int64), None
+            if multi:               # companions dropped: count column stands
+                return xp.asarray(cols[key]).astype(xp.int64), None
+            m = cols.get(key + "?")
+            one = xp.ones_like(xp.asarray(cols[key]), dtype=xp.int64)
+            if m is None:
+                return one, None
+            return xp.where(m, 0, one), None
+
+        return fn, AttrType.INT
+
     if name == "log":
         # reference LogFunctionExecutor: logs its arguments per event and
         # passes true; device-side via jax.debug.print (TPU-safe)
@@ -489,6 +546,78 @@ def take_uuid_marker() -> bool:
     flag = getattr(_UUID_MARK, "flag", False)
     _UUID_MARK.flag = False
     return flag
+
+
+_OBJ_MARK = _threading.local()
+
+
+def mark_object_elem(elem_type):
+    _OBJ_MARK.elem = elem_type
+
+
+def take_object_elem_marker():
+    """Element type of the set produced by a createSet() compiled since the
+    last take (consumed by plan_selector to record decode metadata)."""
+    elem = getattr(_OBJ_MARK, "elem", None)
+    _OBJ_MARK.elem = None
+    return elem
+
+
+def _encode_set_element(xp, v, elem_type):
+    """Value column -> int64 set-element identity codes (shared with the
+    distinctCount/unionSet value tables: floats by bit pattern, strings
+    already dictionary ids)."""
+    from siddhi_tpu.query_api.definitions import AttrType as _AT
+
+    v = xp.asarray(v)
+    if elem_type == _AT.FLOAT:
+        if xp is np:
+            v = v.astype(np.float32).view(np.int32)
+        else:
+            from jax import lax as _lax
+
+            v = _lax.bitcast_convert_type(v.astype(xp.float32), xp.int32)
+    elif elem_type == _AT.DOUBLE:
+        if xp is np:
+            v = v.astype(np.float64).view(np.int64)
+        else:
+            from jax import lax as _lax
+
+            v = _lax.bitcast_convert_type(v.astype(xp.float64), xp.int64)
+    return v.astype(xp.int64)
+
+
+def encode_set_value(val, elem_type, dictionary) -> int:
+    """Host-side inverse of ``decode_set_element`` for Event ingestion:
+    encode one Python element to its int64 identity code, honouring the
+    stream's recorded element type (FLOAT -> float32 bit pattern, DOUBLE
+    -> float64 — matching the device-side ``_encode_set_element``)."""
+    from siddhi_tpu.query_api.definitions import AttrType as _AT
+
+    if isinstance(val, str):
+        return int(dictionary.encode(val))
+    if isinstance(val, bool):
+        return int(val)
+    if isinstance(val, float):
+        if elem_type == _AT.FLOAT:
+            return int(np.float32(val).view(np.int32))
+        return int(np.float64(val).view(np.int64))
+    return int(val)
+
+
+def decode_set_element(code: int, elem_type, dictionary):
+    """Inverse of ``_encode_set_element`` for host-side event decode."""
+    from siddhi_tpu.query_api.definitions import AttrType as _AT
+
+    if elem_type == _AT.STRING:
+        return dictionary.decode(int(code))
+    if elem_type == _AT.FLOAT:
+        return float(np.int32(code).view(np.float32))
+    if elem_type == _AT.DOUBLE:
+        return float(np.int64(code).view(np.float64))
+    if elem_type == _AT.BOOL:
+        return bool(code)
+    return int(code)
 
 
 def set_active_extensions(extensions: dict) -> None:

@@ -176,6 +176,19 @@ class NFAPlan:
     slots: int
     stream_ids: List[str]        # unique consumed stream ids, stable order
     scopes: List[Tuple[int, int, int]] = field(default_factory=list)
+    # `every (...)` group spans: start step -> end step. A new group
+    # iteration arms only when no live slot is still INSIDE the span —
+    # the reference starts the next iteration on group COMPLETION
+    # (StreamPostStateProcessor.process -> addEveryState), so grouped
+    # chains are non-overlapping (EveryPatternTestCase:282) while
+    # single-state every (span start==end) stays per-event
+    every_groups: Dict[int, int] = field(default_factory=dict)
+    # non-every pattern whose head is a COUNT state: the start state
+    # re-arms when no chain is live (after a completed match or a
+    # within-expiry) — CountPreStateProcessor keeps collecting on the
+    # shared state event (CountPatternTestCase.testQuery20 expects two
+    # matches); plain stream heads match once, as our corpus pins
+    rearm_on_empty: bool = False
 
     @property
     def last_step(self) -> int:
@@ -207,19 +220,21 @@ class NFAPlan:
         return None
 
 
-def _flatten(el, elements: List, scopes: List, sticky_at: set, depth: int):
+def _flatten(el, elements: List, scopes: List, sticky_at: set, depth: int,
+             groups: Dict[int, int]):
     """Linearize the state-element tree; record `within` scopes as element
-    index ranges and mid-chain `every` re-arm points."""
+    index ranges, mid-chain `every` re-arm points, and every-group spans."""
     if isinstance(el, NextStateElement):
         a = len(elements)
-        _flatten(el.state, elements, scopes, sticky_at, depth + 1)
-        _flatten(el.next, elements, scopes, sticky_at, depth + 1)
+        _flatten(el.state, elements, scopes, sticky_at, depth + 1, groups)
+        _flatten(el.next, elements, scopes, sticky_at, depth + 1, groups)
         if el.within is not None:
             scopes.append((a, len(elements) - 1, el.within))
         return
     if isinstance(el, EveryStateElement):
         a = len(elements)
-        _flatten(el.state, elements, scopes, sticky_at, depth + 1)
+        _flatten(el.state, elements, scopes, sticky_at, depth + 1, groups)
+        groups[a] = len(elements) - 1
         if a > 0:
             sticky_at.add(a)          # mid-chain every: re-arm point
         if el.within is not None:
@@ -245,7 +260,8 @@ def build_nfa_plan(
     elements: List = []
     scopes: List[Tuple[int, int, int]] = []
     sticky_at: set = set()
-    _flatten(root, elements, scopes, sticky_at, 0)
+    every_groups: Dict[int, int] = {}
+    _flatten(root, elements, scopes, sticky_at, 0, every_groups)
 
     # `every` wrapping the head (whole pattern or first element) is the
     # global re-arm flag; scopes recorded at element 0 spanning everything
@@ -393,6 +409,9 @@ def build_nfa_plan(
         slots=slots,
         stream_ids=stream_ids,
         scopes=scopes,
+        every_groups=every_groups,
+        rearm_on_empty=(not every and not sequence and bool(steps)
+                        and steps[0].kind == "count"),
     )
 
 
@@ -1110,7 +1129,15 @@ class NFAStage:
                     else:
                         phase2_forks.append((fm, j + 1, side))
                 if st.sticky and st.kind == "stream":
-                    # sticky step: parent stays; fork an advanced child
+                    # sticky step: parent stays; fork an advanced child.
+                    # For a mid-chain `every (...)` GROUP, fork only while
+                    # no earlier child is still INSIDE the group span —
+                    # iterations are sequential, not overlapping
+                    # (EveryPatternTestCase:351 grouping)
+                    gend = plan.every_groups.get(j)
+                    if gend is not None and gend > j:
+                        busy = jnp.any(A & (ST > j) & (ST <= gend), axis=1)
+                        eff = eff & ~busy[:, None]
                     if j == L:
                         sticky_emit_ops.append((eff, st, side))
                     else:
@@ -1121,6 +1148,24 @@ class NFAStage:
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
                     ST2 = jnp.where(eff, j, ST2)
+                    if (j < L and not st.sticky
+                            and st.min_count == st.max_count):
+                        # a FULL exact count advances into the next step
+                        # immediately (it can absorb nothing more) — the
+                        # reference adds the shared state event to the next
+                        # pre-state at min-reach (processMinCountReached),
+                        # so an absent successor can be violated while the
+                        # chain "rests" (CountPatternTestCase:886)
+                        cnt_after = CP2[cap_cnt_col(cap.cid)]
+                        done = eff & (cnt_after >= st.max_count)
+                        tmp = {"ST": ST2, "BT": BT2, "VB": VB2,
+                               "ADL": ADL2_, "AD2": AD22_, "CD": CD2,
+                               "SC": list(V["SC"])}
+                        tmp = self._enter(tmp, done, j + 1, ts2d)
+                        ST2, BT2, VB2 = tmp["ST"], tmp["BT"], tmp["VB"]
+                        ADL2_, AD22_, CD2 = (tmp["ADL"], tmp["AD2"],
+                                             tmp["CD"])
+                        V["SC"] = tmp["SC"]
                     if j == L:
                         cnt_after = CP2[cap_cnt_col(cap.cid)]
                         done = eff & (cnt_after >= st.min_count)
@@ -1284,6 +1329,20 @@ class NFAStage:
 
             # ---- fresh starts
             every_ok = plan.every | ~CONS
+            if plan.rearm_on_empty:
+                # count-head non-every: the start state re-arms once no
+                # chain is live (post-match / post-expiry) — see NFAPlan
+                no_live = ~jnp.any(A, axis=1)
+                every_ok = every_ok | no_live
+            # head `every (...)` GROUP: the next iteration arms only after
+            # the previous one exits the group span (pre-advance occupancy;
+            # the completing event itself does not seed the new iteration —
+            # reference addEveryState lands after the current chunk)
+            head_gend = plan.every_groups.get(0)
+            if plan.every and head_gend:
+                in_head_group = jnp.any(A & (ST <= head_gend), axis=1)
+            else:
+                in_head_group = None
             fresh_any = jnp.zeros((B,), bool)
             direct = jnp.zeros((B,), bool)
             direct_op = jnp.full((B,), -1, jnp.int32)
@@ -1295,6 +1354,8 @@ class NFAStage:
                 if not self._fresh_ok(j):
                     continue
                 f = m & every_ok & conds[oi][:, 0]
+                if in_head_group is not None and j <= head_gend:
+                    f = f & ~in_head_group
                 if st.kind == "count":
                     # non-overlapping `every` collections: an event some
                     # slot absorbed into its collection does not also seed

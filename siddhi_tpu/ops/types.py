@@ -19,8 +19,12 @@ import numpy as np
 
 from siddhi_tpu.query_api.definitions import AttrType
 
-# STRING columns are dictionary-encoded int32 ids (host-side dictionary);
-# OBJECT columns never reach the device.
+# STRING columns are dictionary-encoded int32 ids (host-side dictionary).
+# OBJECT columns carry SET values (the only object kind the built-ins
+# produce: createSet/unionSet) as dense element codes: a singleton set is
+# one int64 identity code (strings: dict ids; floats: bit patterns);
+# multi-element sets (unionSet outputs) add bounded [B, H] companion
+# columns '<name>#set'/'<name>#setm' beside the [B] live-count column.
 DTYPES = {
     AttrType.STRING: np.int32,
     AttrType.INT: np.int32,
@@ -28,6 +32,7 @@ DTYPES = {
     AttrType.FLOAT: np.float32,
     AttrType.DOUBLE: np.float64,
     AttrType.BOOL: np.bool_,
+    AttrType.OBJECT: np.int64,
 }
 
 _NUMERIC_ORDER = [AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE]

@@ -63,9 +63,14 @@ _BIG = jnp.int64(2**62)
 
 
 def _data_keys(cols: Dict) -> List[str]:
+    # '#set'/'#setm' companions ([B, H] element snapshots of multi-element
+    # set values) never enter window buffers — only the scalar base column
+    # is buffered; a downstream unionSet that NEEDS the snapshot raises
+    # (ops/aggregators.py arg_is_multi guard)
     return sorted(
         k for k in cols
         if k not in (TYPE_KEY, VALID_KEY, NOTIFY_KEY, OVERFLOW_KEY, FLUSH_KEY)
+        and "#set" not in k
     )
 
 

@@ -38,13 +38,14 @@ _WINDOWS_KEYED = ["length", "lengthBatch", "batch", "time", "timeBatch", "hoppin
                   "sort", "frequent", "lossyFrequent", "cron",
                   "expression", "expressionBatch (per-key host instances)"]
 _AGGREGATORS = ["sum", "count", "avg", "min", "max", "stdDev", "and", "or",
-                "minForever", "maxForever", "distinctCount"]
+                "minForever", "maxForever", "distinctCount", "unionSet"]
 _INCREMENTAL_AGGS = ["sum", "count", "avg", "min", "max", "distinctCount"]
 _FUNCTIONS = [
     "cast(x, 'type')", "convert(x, 'type')", "ifThenElse(c, a, b)",
     "coalesce(a, b, ...)", "default(x, d)", "maximum(...)", "minimum(...)",
     "instanceOfBoolean/String/Integer/Long/Float/Double(x)",
     "eventTimestamp()", "currentTimeMillis()", "uuid()", "log(...)",
+    "createSet(x)", "sizeOfSet(s)",
 ]
 _STREAM_FUNCTIONS = [
     "log([priority,] [message,] [is.event.logged])",

@@ -47,8 +47,11 @@ def test_every_count_tail_groups_non_overlapping():
 
 
 def test_every_count_tail_within_kills_the_chain():
-    # testQuery20 proper: within 3 sec from the first A; after expiry no
-    # more groups emit, and a fresh AA does not restart (no head every)
+    # within 3 sec anchored at the first A: the post-expiry lone B emits
+    # nothing, and a COUNT-head non-every pattern re-arms once no chain is
+    # live (CountPatternTestCase.testQuery20: "AA are not consumed after
+    # within time period" — then a fresh AA DOES start a new chain), so
+    # the final AABB yields a third group
     m, rt, c = build(APP + """
         from e1=InputStream[name == 'A']<2:2>
           -> every e2=InputStream[name == 'B']<2:2>
@@ -67,7 +70,8 @@ def test_every_count_tail_within_kills_the_chain():
     for n in ["A", "A", "B", "B"]:
         h.send(t, [n]); t += 100
     m.shutdown()
-    assert len(c.events) == 2      # exactly the two pre-expiry groups
+    # two pre-expiry groups + one from the re-armed post-expiry chain
+    assert [e.timestamp for e in c.events] == [1300, 1500, 6200]
 
 
 def test_every_count_midchain_forks_completed_groups():

@@ -130,10 +130,13 @@ def test_debugger_breakpoints():
     """)
     hits = []
 
+    def cb(events, name, terminal, d):
+        hits.append((name, len(events)))
+        d.play()          # release the suspended pump (reference idiom)
+
     rt.add_callback("Out", Collector())
     dbg = rt.debug()
-    dbg.set_debugger_callback(
-        lambda events, name, terminal, d: hits.append((name, len(events))))
+    dbg.set_debugger_callback(cb)
     dbg.acquire_break_point("q", SiddhiDebugger.QueryTerminal.IN)
     dbg.acquire_break_point("q", SiddhiDebugger.QueryTerminal.OUT)
     h = rt.get_input_handler("S")
@@ -145,6 +148,154 @@ def test_debugger_breakpoints():
     h.send(["c", 2])
     m.shutdown()
     assert len(hits) == n_before
+
+
+def test_debugger_next_single_steps_to_unacquired_checkpoint():
+    # only IN is acquired; next() from the IN hit must break again at the
+    # OUT checkpoint even though no breakpoint is acquired there
+    # (SiddhiDebugger.java threadLocalNextFlag semantics)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S[v > 0] select sym, v insert into Out;
+    """)
+    hits = []
+
+    def cb(events, name, terminal, d):
+        hits.append(name)
+        if terminal is SiddhiDebugger.QueryTerminal.IN:
+            d.next()      # single-step: break at the next checkpoint
+        else:
+            d.play()      # resume freely from OUT
+
+    rt.add_callback("Out", Collector())
+    dbg = rt.debug()
+    dbg.set_debugger_callback(cb)
+    dbg.acquire_break_point("q", SiddhiDebugger.QueryTerminal.IN)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    m.shutdown()
+    assert hits == ["q:IN", "q:OUT"]
+
+
+def test_debugger_suspends_pump_until_play():
+    # without next()/play() the pump thread stays BLOCKED at the
+    # breakpoint — the lock-stepping the reference implements with its
+    # breakPointLock semaphore (SiddhiDebugger.java:182-190)
+    import threading
+    import time
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S[v > 0] select sym, v insert into Out;
+    """)
+    out = Collector()
+    rt.add_callback("Out", out)
+    dbg = rt.debug()
+    fired = threading.Event()
+    dbg.set_debugger_callback(
+        lambda events, name, terminal, d: fired.set())
+    dbg.acquire_break_point("q", SiddhiDebugger.QueryTerminal.IN)
+    h = rt.get_input_handler("S")
+    t = threading.Thread(target=lambda: h.send(["a", 1]), daemon=True)
+    t.start()
+    assert fired.wait(10.0)
+    time.sleep(0.2)
+    assert t.is_alive()            # suspended at the breakpoint
+    assert not out.events          # nothing emitted while suspended
+    dbg.play()
+    t.join(10.0)
+    assert not t.is_alive()
+    assert [tuple(e.data) for e in out.events] == [("a", 1)]
+    m.shutdown()
+
+
+def test_debugger_get_query_state_while_suspended_at_out():
+    # the suspend-inspect-resume workflow: the pump holds the query lock
+    # across an OUT suspension; get_query_state must not deadlock
+    import threading
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S[v > 0] select sym, v insert into Out;
+    """)
+    rt.add_callback("Out", Collector())
+    dbg = rt.debug()
+    fired = threading.Event()
+    dbg.set_debugger_callback(lambda *a: fired.set())
+    dbg.acquire_break_point("q", SiddhiDebugger.QueryTerminal.OUT)
+    h = rt.get_input_handler("S")
+    t = threading.Thread(target=lambda: h.send(["a", 1]), daemon=True)
+    t.start()
+    assert fired.wait(10.0)
+    st = dbg.get_query_state("q")     # pump suspended INSIDE the lock
+    assert "state" in st
+    dbg.play()
+    t.join(10.0)
+    assert not t.is_alive()
+    m.shutdown()
+
+
+def test_debugger_get_query_state():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v int);
+        @info(name='q')
+        from S#window.length(3) select sym, sum(v) as t insert into Out;
+    """)
+    rt.add_callback("Out", Collector())
+    dbg = rt.debug()
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    st = dbg.get_query_state("q")
+    assert st["state"] is not None
+    m.shutdown()
+
+
+def test_enforce_order_rejects_out_of_order_and_async():
+    import numpy as np
+    import pytest
+
+    from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:enforceOrder @app:playback
+        define stream S (v int);
+        from S select v insert into Out;
+    """)
+    rt.add_callback("Out", Collector())
+    h = rt.get_input_handler("S")
+    h.send(1000, [1])
+    h.send(2000, [2])
+    with pytest.raises(ValueError, match="enforceOrder"):
+        h.send(1500, [3])          # behind the stream watermark
+    with pytest.raises(ValueError, match="enforceOrder"):
+        h.send_columns({"v": np.array([4, 5])},
+                       timestamps=np.array([3000, 2500]))  # in-batch regress
+    with pytest.raises(ValueError, match="enforceOrder"):
+        from siddhi_tpu.core.event import Event
+
+        # in-batch regression through the Event-list form too
+        h.send([Event(timestamp=3000, data=[7]),
+                Event(timestamp=2600, data=[8])])
+    h.send(3000, [6])              # monotone again: fine
+    m.shutdown()
+
+    # @Async buffering can reorder across producers: rejected at build time
+    with pytest.raises(SiddhiAppValidationException, match="enforceOrder"):
+        m2 = SiddhiManager()
+        m2.create_siddhi_app_runtime("""
+            @app:enforceOrder
+            @Async(buffer.size='64')
+            define stream S (v int);
+            from S select v insert into Out;
+        """)
 
 
 def test_uuid_function_unique_per_row():

@@ -1,0 +1,337 @@
+"""Reference pattern-corpus differential: scenarios ported verbatim
+(inputs AND expected outputs) from the reference test suite —
+``query/pattern/CountPatternTestCase.java`` (Q1-Q8 count accumulation and
+``e1[i]`` nulls, Q17-Q20 every-count with `within` expiry, the
+not-and tail at :886, the unbounded-min login pipeline at :1319) and
+``query/pattern/EveryPatternTestCase.java`` (grouped every chains).
+Thread.sleep pacing becomes explicit playback timestamps.
+
+These pin exactly the multi-pending shapes the dense-slot NFA's
+"furthest-advanced transition wins" policy could diverge on.
+"""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutputStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+TWO_STREAMS = """@app:playback
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+"""
+
+COUNT_25 = TWO_STREAMS + """
+    from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>20]
+    select e1[0].price as p0, e1[1].price as p1, e1[2].price as p2,
+           e1[3].price as p3, e2.price as p4
+    insert into OutputStream;
+"""
+
+
+def _rows(c):
+    # 'float' attrs are float32: round back to the literal's precision
+    return [tuple(round(v, 4) if isinstance(v, float) else v
+                  for v in e.data) for e in c.events]
+
+
+def test_count_q1_accumulate_with_filter_gap():
+    # CountPatternTestCase.testQuery1: filtered-out A leaves a null slot gap
+    m, rt, c = build(COUNT_25)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 25.6, 100]); t += 100
+    s1.send(t, ["GOOG", 47.6, 100]); t += 100
+    s1.send(t, ["GOOG", 13.7, 100]); t += 100   # fails e1 filter
+    s1.send(t, ["GOOG", 47.8, 100]); t += 100
+    s2.send(t, ["IBM", 45.7, 100]); t += 100    # match
+    s2.send(t, ["IBM", 55.7, 100]); t += 100    # no pending AA: no match
+    m.shutdown()
+    assert _rows(c) == [(25.6, 47.6, 47.8, None, 45.7)]
+
+
+def test_count_q2_b_mid_accumulation_matches_then_rearms_partially():
+    # testQuery2: B after 2 As matches; a single further A cannot reach min
+    m, rt, c = build(COUNT_25)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 25.6, 100]); t += 100
+    s1.send(t, ["GOOG", 47.6, 100]); t += 100
+    s1.send(t, ["GOOG", 13.7, 100]); t += 100
+    s2.send(t, ["IBM", 45.7, 100]); t += 100    # match {25.6, 47.6}
+    s1.send(t, ["GOOG", 47.8, 100]); t += 100
+    s2.send(t, ["IBM", 55.7, 100]); t += 100    # count 1 < min 2: no match
+    m.shutdown()
+    assert _rows(c) == [(25.6, 47.6, None, None, 45.7)]
+
+
+def test_count_q3_below_min_b_skipped_accumulation_continues():
+    # testQuery3: B while count<min does not kill the pattern (not a
+    # sequence); accumulation continues and the NEXT B matches
+    m, rt, c = build(COUNT_25)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 25.6, 100]); t += 100
+    s2.send(t, ["IBM", 45.7, 100]); t += 100    # count 1 < 2: skipped
+    s1.send(t, ["GOOG", 47.8, 100]); t += 100
+    s2.send(t, ["IBM", 55.7, 100]); t += 100    # match {25.6, 47.8}
+    m.shutdown()
+    assert _rows(c) == [(25.6, 47.8, None, None, 55.7)]
+
+
+def test_count_q5_max_stops_absorbing():
+    # testQuery5: the 6th/7th A beyond max 5 are not absorbed; match shows
+    # the FIRST four captures
+    m, rt, c = build(COUNT_25)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    for p in (25.6, 47.6, 23.7, 24.7, 25.7, 27.6):
+        s1.send(t, ["WSO2", p, 100]); t += 100
+    s2.send(t, ["IBM", 45.7, 100]); t += 100    # match, captures first 5
+    s1.send(t, ["GOOG", 47.8, 100]); t += 100
+    s2.send(t, ["IBM", 55.7, 100]); t += 100
+    m.shutdown()
+    assert _rows(c)[0] == (25.6, 47.6, 23.7, 24.7, 45.7)
+
+
+def test_count_q6_e2_filter_on_indexed_capture_failing_b_skipped():
+    # testQuery6: e2 references e1[1].price; a failing B does NOT kill
+    m, rt, c = build(TWO_STREAMS + """
+        from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>e1[1].price]
+        select e1[0].price as p0, e1[1].price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 25.6, 100]); t += 100
+    s1.send(t, ["GOOG", 47.6, 100]); t += 100
+    s2.send(t, ["IBM", 45.7, 100]); t += 100    # 45.7 < 47.6: skipped
+    s2.send(t, ["IBM", 55.7, 100]); t += 100    # match
+    m.shutdown()
+    assert _rows(c) == [(25.6, 47.6, 55.7)]
+
+
+def test_count_q7_min_zero_b_alone_matches():
+    # testQuery7: <0:5> start state is skippable
+    m, rt, c = build(TWO_STREAMS + """
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>20]
+        select e1[0].price as p0, e1[1].price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(1000, ["IBM", 45.7, 100])
+    m.shutdown()
+    assert _rows(c) == [(None, None, 45.7)]
+
+
+def test_count_q8_min_zero_with_capture_reference():
+    # testQuery8: one A absorbed, one filtered out; e2 compares to e1[0]
+    m, rt, c = build(TWO_STREAMS + """
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>e1[0].price]
+        select e1[0].price as p0, e1[1].price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 25.6, 100]); t += 100
+    s1.send(t, ["GOOG", 7.6, 100]); t += 100    # fails filter
+    s2.send(t, ["IBM", 45.7, 100]); t += 100    # 45.7 > 25.6: match
+    m.shutdown()
+    assert _rows(c) == [(25.6, None, 45.7)]
+
+
+IN_STREAM = "@app:playback define stream InputStream (name string);\n"
+EVERY_A2_B = IN_STREAM + """
+    from every e1=InputStream[(e1.name == 'A')]<2>
+       -> e2=InputStream[(e2.name == 'B')]
+       within 3 seconds
+    select 'rule1' as ruleId, count() as numOfEvents
+    insert into OutputStream;
+"""
+
+
+def _feed(h, t, names, step=100):
+    for n in names:
+        if n == "|":        # 4-second clock jump (Thread.sleep(4000))
+            t += 4000
+            continue
+        h.send(t, [n])
+        t += step
+    return t
+
+
+def test_count_q17_every_exact2_within():
+    # testQuery17: AABB AABB A |sleep4s| ABB AABB -> 3 matches
+    m, rt, c = build(EVERY_A2_B)
+    h = rt.get_input_handler("InputStream")
+    _feed(h, 1000, list("AABBAABB") + ["A", "|"] + list("ABBAABB"))
+    m.shutdown()
+    assert len(c.events) == 3
+
+
+def test_count_q18_every_exact2_within_extra_bs():
+    # testQuery18: AABBB AABB A |4s| ABB AABB -> 3 matches
+    m, rt, c = build(EVERY_A2_B)
+    h = rt.get_input_handler("InputStream")
+    _feed(h, 1000, list("AABBB") + list("AABB") + ["A", "|"]
+          + list("ABB") + list("AABB"))
+    m.shutdown()
+    assert len(c.events) == 3
+
+
+def test_count_q19_every_exact2_within_four_matches():
+    # testQuery19: AABBBB AABB A |4s| ABB AAB AABB -> 4 matches
+    m, rt, c = build(EVERY_A2_B)
+    h = rt.get_input_handler("InputStream")
+    _feed(h, 1000, list("AABBBB") + list("AABB") + ["A", "|"]
+          + list("ABB") + list("AAB") + list("AABB"))
+    m.shutdown()
+    assert len(c.events) == 4
+
+
+def test_count_q20_non_every_rearms_after_completion_and_expiry():
+    # testQuery20 (NON-every): AABB BB AB |4s| B AABB -> 2 matches — the
+    # start state re-initializes after a completed match AND after a
+    # within-expiry ("AA are not consumed after within time period")
+    m, rt, c = build(IN_STREAM + """
+        from e1=InputStream[(e1.name == 'A')]<2>
+           -> e2=InputStream[(e2.name == 'B')]
+           within 3 seconds
+        select 'rule1' as ruleId, count() as numOfEvents
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("InputStream")
+    _feed(h, 1000, list("AABB") + list("BB") + list("AB") + ["|"]
+          + ["B"] + list("AABB"))
+    m.shutdown()
+    assert len(c.events) == 2
+
+
+def test_count_mid_chain_count_then_not_and():
+    # CountPatternTestCase:886 — every e1 -> e2<2> -> not ... and e3
+    m, rt, c = build(TWO_STREAMS + """
+        from every e1=Stream1[price>20] -> e2=Stream1[price>20]<2>
+           -> not Stream1[price>20] and e3=Stream2
+        select e1.price as p0, e2[0].price as p1, e2[1].price as p2,
+               e2[2].price as p3, e3.price as p4
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 25.6, 100]); t += 100
+    s1.send(t, ["WSO2", 23.6, 100]); t += 100
+    s1.send(t, ["WSO2", 23.6, 100]); t += 100
+    s1.send(t, ["GOOG", 27.6, 100]); t += 100
+    s1.send(t, ["GOOG", 28.6, 100]); t += 100
+    s2.send(t, ["IBM", 45.7, 100]); t += 100
+    m.shutdown()
+    assert len(c.events) == 1
+    assert _rows(c)[0] == (23.6, 27.6, 28.6, None, 45.7)
+
+
+LOGIN = """@app:playback
+    define stream LoginFailure (id string, user string, type string);
+    define stream LoginSuccess (id string, user string, type string);
+    from every (e1=LoginFailure<3:> -> e2=LoginSuccess)
+    select e1[0].id as id, e2.user as user
+    insert into OutputStream;
+"""
+
+
+def test_count_unbounded_min_login_pipeline():
+    # CountPatternTestCase:1319 — min-3 unbounded accumulation, every
+    # group re-arms after each completed match
+    m, rt, c = build(LOGIN)
+    f = rt.get_input_handler("LoginFailure")
+    s = rt.get_input_handler("LoginSuccess")
+    now = 1000
+    for i in range(1, 7):
+        now += 1; f.send(now, [f"id_{i}", "hans", "failure"])
+    now += 1; s.send(now, ["id_7", "hans", "success"])
+    for i in range(8, 16):
+        now += 1; f.send(now, [f"id_{i}", "werner", "failure"])
+    now += 1; s.send(now, ["id_16", "werner", "success"])
+    for i in range(17, 20):
+        now += 1; f.send(now, [f"id_{i}", "hans", "failure"])
+    now += 1; s.send(now, ["id_20", "hans", "success"])
+    m.shutdown()
+    got = _rows(c)
+    assert got == [("id_1", "hans"), ("id_8", "werner"), ("id_17", "hans")]
+
+
+# --------------------------------------------------- EveryPatternTestCase
+
+
+def test_every_group_chain_restarts_per_group():
+    # EveryPatternTestCase:227 — every (e1 -> e3) -> e2[price > e1.price]
+    m, rt, c = build(TWO_STREAMS + """
+        from every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+           -> e2=Stream2[price>e1.price]
+        select e1.price as p1, e3.price as p3, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 55.6, 100]); t += 100
+    s1.send(t, ["GOOG", 54.0, 100]); t += 100
+    s2.send(t, ["IBM", 57.7, 100]); t += 100
+    m.shutdown()
+    assert _rows(c) == [(55.6, 54.0, 57.7)]
+
+
+def test_every_group_two_rounds():
+    # EveryPatternTestCase:282 — two grouped rounds, one e2 closes both
+    m, rt, c = build(TWO_STREAMS + """
+        from every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+           -> e2=Stream2[price>e1.price]
+        select e1.price as p1, e3.price as p3, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 55.6, 100]); t += 100
+    s1.send(t, ["GOOG", 54.0, 100]); t += 100
+    s1.send(t, ["WSO2", 53.6, 100]); t += 100
+    s1.send(t, ["GOOG", 53.0, 100]); t += 100
+    s2.send(t, ["IBM", 57.7, 100]); t += 100
+    m.shutdown()
+    got = _rows(c)
+    assert sorted(got) == sorted([(55.6, 54.0, 57.7), (53.6, 53.0, 57.7)])
+
+
+def test_lead_then_every_group():
+    # EveryPatternTestCase:351 — e4=MSFT -> every (e1 -> e3) -> e2
+    m, rt, c = build(TWO_STREAMS + """
+        from e4=Stream1[symbol=='MSFT'] ->
+             every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+           -> e2=Stream2[price>e1.price]
+        select e4.price as p4, e1.price as p1, e3.price as p3,
+               e2.price as p2
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["MSFT", 55.6, 100]); t += 100
+    s1.send(t, ["WSO2", 55.7, 100]); t += 100
+    s1.send(t, ["GOOG", 54.0, 100]); t += 100
+    s1.send(t, ["WSO2", 53.6, 100]); t += 100
+    s1.send(t, ["GOOG", 53.0, 100]); t += 100
+    s2.send(t, ["IBM", 57.7, 100]); t += 100
+    m.shutdown()
+    got = _rows(c)
+    assert sorted(got) == sorted([(55.6, 55.7, 54.0, 57.7),
+                                  (55.6, 53.6, 53.0, 57.7)])

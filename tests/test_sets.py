@@ -1,0 +1,225 @@
+"""createSet / unionSet / sizeOfSet — set-valued attributes.
+
+Mirrors reference ``CreateSetFunctionExecutor`` /
+``UnionSetAttributeAggregatorExecutor`` / ``SizeOfSetFunctionExecutor``
+semantics (FunctionTestCase createSet tests; the unionSet docstring
+example pipeline) on the dense encoding: a singleton set travels as its
+element's int64 identity code; unionSet keeps a per-group live multiset
+value-table and emits bounded ``[B, H]`` element snapshots.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+from siddhi_tpu.ops.expressions import CompileError
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+def test_createset_singleton_decodes_to_set():
+    m, rt, c = build("""
+        define stream S (sym string, v int);
+        from S select createSet(sym) as s, v insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["IBM", 1])
+    h.send(["WSO2", 2])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [
+        frozenset({"IBM"}), frozenset({"WSO2"})]
+
+
+@pytest.mark.parametrize("typ,val,expect", [
+    ("int", 7, 7), ("long", 9, 9), ("double", 2.5, 2.5), ("bool", True, True),
+])
+def test_createset_primitive_types(typ, val, expect):
+    m, rt, c = build(f"""
+        define stream S (x {typ});
+        from S select createSet(x) as s insert into OutStream;
+    """)
+    rt.get_input_handler("S").send([val])
+    m.shutdown()
+    assert c.events[0].data[0] == frozenset({expect})
+
+
+def test_createset_arity_rejected():
+    # reference FunctionTestCase.testFunctionQuery9: two parameters fail
+    m = SiddhiManager()
+    with pytest.raises((CompileError, SiddhiAppValidationException)):
+        m.create_siddhi_app_runtime("""
+            define stream S (sym string, d long);
+            from S select createSet(sym, d) as s insert into OutStream;
+        """)
+
+
+def test_unionset_over_window_adds_and_removes():
+    # the UnionSetAttributeAggregatorExecutor docstring pipeline: createSet
+    # per event, union over a sliding window; processRemove drops departed
+    # elements (multiset counting keeps duplicates alive)
+    m, rt, c = build("""
+        define stream S (sym string);
+        from S#window.length(2)
+        select unionSet(createSet(sym)) as syms insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A"])
+    h.send(["B"])
+    h.send(["A"])     # evicts the first A — but A stays via the new one
+    h.send(["C"])     # evicts B
+    m.shutdown()
+    got = [e.data[0] for e in c.events]
+    assert got[0] == frozenset({"A"})
+    assert got[1] == frozenset({"A", "B"})
+    assert got[2] == frozenset({"A", "B"})
+    assert got[3] == frozenset({"A", "C"})
+
+
+def test_unionset_chain_across_streams_and_sizeofset():
+    # canonical chain: createSet -> stream -> window+unionSet -> stream ->
+    # sizeOfSet downstream (element metadata propagates across streams)
+    m, rt, c = build("""
+        define stream Stock (sym string, price double);
+        from Stock select createSet(sym) as initialSet insert into InitStream;
+        from InitStream#window.lengthBatch(3)
+        select unionSet(initialSet) as distinctSyms insert into DistinctStream;
+        from DistinctStream select sizeOfSet(distinctSyms) as n
+        insert into OutStream;
+    """)
+    d = Collector()
+    rt.add_callback("DistinctStream", d)
+    h = rt.get_input_handler("Stock")
+    h.send(["IBM", 10.0])
+    h.send(["WSO2", 20.0])
+    h.send(["IBM", 30.0])     # batch flushes: {IBM, WSO2}
+    m.shutdown()
+    sizes = [e.data[0] for e in c.events]
+    assert sizes[-1] == 2
+    assert d.events[-1].data[0] == frozenset({"IBM", "WSO2"})
+
+
+def test_unionset_group_by_keeps_groups_separate():
+    m, rt, c = build("""
+        define stream S (user string, sym string);
+        from S#window.length(10)
+        select user, unionSet(createSet(sym)) as syms
+        group by user insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["u1", "A"])
+    h.send(["u2", "B"])
+    h.send(["u1", "C"])
+    m.shutdown()
+    last = {}
+    for e in c.events:
+        last[e.data[0]] = e.data[1]
+    assert last == {"u1": frozenset({"A", "C"}), "u2": frozenset({"B"})}
+
+
+def test_sizeofset_on_singleton_and_requires_object():
+    m, rt, c = build("""
+        define stream S (sym string);
+        from S select createSet(sym) as s insert into Mid;
+        from Mid select sizeOfSet(s) as n insert into OutStream;
+    """)
+    rt.get_input_handler("S").send(["A"])
+    m.shutdown()
+    assert c.events[0].data[0] == 1
+
+    m2 = SiddhiManager()
+    with pytest.raises((CompileError, SiddhiAppValidationException)):
+        m2.create_siddhi_app_runtime("""
+            define stream S (v int);
+            from S select sizeOfSet(v) as n insert into OutStream;
+        """)
+
+
+def test_unionset_survives_event_republish_path():
+    # a query callback forces the Event (non-columnar) re-publish path:
+    # multi-element sets must round-trip through Events into the next
+    # query via the stream's multi/elem metadata (review finding: the
+    # from_events re-ingest used to raise)
+    from siddhi_tpu import QueryCallback
+
+    class QC(QueryCallback):
+        n = 0
+
+        def receive(self, ts, in_events, remove_events):
+            QC.n += 1
+
+    m, rt, c = build("""
+        define stream S (sym string);
+        define stream Mid (u object);
+        @info(name='q1')
+        from S#window.length(4)
+        select unionSet(createSet(sym)) as u insert into Mid;
+        from Mid select sizeOfSet(u) as n insert into OutStream;
+    """)
+    rt.add_callback("q1", QC())     # forces Event materialization
+    h = rt.get_input_handler("S")
+    h.send(["A"])
+    h.send(["B"])
+    h.send(["C"])
+    m.shutdown()
+    assert QC.n >= 3
+    assert [e.data[0] for e in c.events] == [1, 2, 3]
+
+
+def test_consumer_defined_before_producer_sees_metadata():
+    # review finding: one-pass assembly used to compile the consumer with
+    # multi=False when it appeared before the producer in the app text
+    m, rt, c = build("""
+        define stream S (sym string);
+        define stream Mid (u object);
+        from Mid select sizeOfSet(u) as n insert into OutStream;
+        from S#window.length(4)
+        select unionSet(createSet(sym)) as u insert into Mid;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A"])
+    h.send(["B"])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [1, 2]
+
+
+def test_unionset_after_window_drops_snapshot_rejected():
+    # review finding: folding a multi set's COUNT column as element codes
+    # must be an error, not silent garbage
+    import numpy as np
+
+    m, rt, c = build("""
+        define stream S (sym string);
+        define stream Mid (u object);
+        from S select unionSet(createSet(sym)) as u insert into Mid;
+        from Mid#window.length(2)
+        select unionSet(u) as uu insert into OutStream;
+    """)
+    h = rt.get_input_handler("S")
+    with pytest.raises(Exception, match="snapshot|companions|multi"):
+        h.send(["A"])
+        m.shutdown()
+
+
+def test_unionset_requires_object_argument():
+    m = SiddhiManager()
+    with pytest.raises((CompileError, SiddhiAppValidationException)):
+        m.create_siddhi_app_runtime("""
+            define stream S (sym string);
+            from S#window.length(2)
+            select unionSet(sym) as s insert into OutStream;
+        """)
