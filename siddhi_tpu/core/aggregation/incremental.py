@@ -278,7 +278,14 @@ class IncrementalAggregationRuntime(Receiver):
         pbi = find_annotation(definition.annotations or [], "PartitionById")
         self.shard_mode = pbi is not None and (
             (pbi.element("enable") or "true").lower() == "true")
-        self.shard_id = getattr(app_context, "node_id", "0") if self.shard_mode else None
+        self.shard_id = None
+        if self.shard_mode:
+            # the reference requires a configured shardId
+            # (AggregationParser.java:173-186); we fall back to node_id/0
+            cm = getattr(app_context.siddhi_context, "config_manager", None)
+            cfg = cm.get_property("shardId") if cm is not None else None
+            self.shard_id = (cfg or getattr(app_context, "node_id", None)
+                             or "0")
 
     def purge(self, now: Optional[int] = None) -> int:
         """Drop buckets older than each duration's retention; returns the
@@ -481,6 +488,101 @@ class IncrementalAggregationRuntime(Receiver):
         cols[TS_KEY] = cols[definition.attributes[0].name]  # AGG_TIMESTAMP
         valid = np.arange(cap) < n
         return definition, cols, valid
+
+    # ------------------------------------------------- distributed shards
+
+    def _shard_store(self):
+        store = self.app_context.siddhi_context.persistence_store
+        if store is None:
+            raise RuntimeError(
+                "@PartitionById aggregation needs a shared persistence "
+                "store — call SiddhiManager.set_persistence_store(...)")
+        return store
+
+    @property
+    def _shard_ns(self) -> str:
+        return f"aggregation-shards:{self.definition.id}"
+
+    def _group_codec(self):
+        """(encode, decode) for group-key tuples: STRING components travel
+        as text between shards (each node has its OWN dictionary, so raw
+        ids don't align across runtimes)."""
+        str_pos = [i for i, a in enumerate(self.group_attrs)
+                   if a.type == AttrType.STRING]
+        dic = self.app_context.string_dictionary
+
+        def decode(g):
+            return tuple(dic.decode(int(v)) if i in str_pos else v
+                         for i, v in enumerate(g))
+
+        def encode(g):
+            return tuple(dic.encode(v) if i in str_pos else v
+                         for i, v in enumerate(g))
+
+        return encode, decode
+
+    def publish_shard(self):
+        """Shard mode: publish this node's partial buckets to the shared
+        persistence store under its shardId — the TPU-native analog of
+        the reference writing per-``shardId``-keyed rows into shared
+        aggregation tables (AggregationParser.java:171-197). Idempotent:
+        each publish overwrites this shard's previous rows. String group
+        keys are decoded to text (dictionaries are per-node)."""
+        import pickle
+
+        if not self.shard_mode:
+            raise RuntimeError(
+                f"aggregation '{self.definition.id}' is not @PartitionById")
+        _enc, dec = self._group_codec()
+        with self._lock:
+            snap = self.snapshot()
+        snap["store"] = {
+            dv: {b: {dec(tuple(g) if isinstance(g, (list, tuple)) else (g,)):
+                     v for g, v in groups.items()}
+                 for b, groups in dstore.items()}
+            for dv, dstore in snap["store"].items()
+        }
+        blob = pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
+        self._shard_store().save(self._shard_ns, f"shard-{self.shard_id}",
+                                 blob)
+
+    def stitch_shards(self) -> int:
+        """Reader side: fold every published shard's partial bases into
+        this runtime's store (sum/count add, min/min, max/max, distinct
+        sets union — ``_BaseSpec.fold``), REPLACING local buckets. The
+        role of the reference's cross-shard aggregation table reads
+        (``IncrementalAggregateCompileCondition`` over shardId-keyed
+        tables). Returns the number of shards merged."""
+        import pickle
+
+        store = self._shard_store()
+        shard_revs = [r for r in store.revisions(self._shard_ns)
+                      if r.startswith("shard-")]
+        base_keys = list(self.bases)
+        merged: Dict[Duration, Dict[int, Dict[tuple, list]]] = {
+            d: {} for d in self.durations}
+        enc, _dec = self._group_codec()
+        for rev in sorted(shard_revs):
+            snap = pickle.loads(store.load(self._shard_ns, rev))
+            snap_keys = snap.get("base_keys", base_keys)
+            for dv, dstore in snap["store"].items():
+                d = parse_duration_name(dv)
+                if d not in merged:
+                    continue
+                for b, groups in dstore.items():
+                    buckets = merged[d].setdefault(int(b), {})
+                    for g, vals in groups.items():
+                        key = enc(tuple(g) if isinstance(g, (list, tuple))
+                                  else (g,))
+                        by = dict(zip(snap_keys, vals))
+                        cur = buckets.get(key)
+                        if cur is None:
+                            cur = buckets[key] = [None] * len(base_keys)
+                        for i, k in enumerate(base_keys):
+                            cur[i] = self.bases[k].fold(cur[i], by.get(k))
+        with self._lock:
+            self.store = merged
+        return len(shard_revs)
 
     # --------------------------------------------------------- persistence
 

@@ -180,6 +180,97 @@ def _rewrite_in_conditions(expr, input_def, ref_id, resolver, app_context,
     return expr
 
 
+def _probe_type_safe(attr_t, val_t) -> bool:
+    """An index probe casts the value into the COLUMN dtype; allow it only
+    when that cast cannot change equality semantics vs the promoted
+    broadcast compare (same type, or a widening numeric cast)."""
+    from siddhi_tpu.ops import types as T
+
+    if attr_t == val_t:
+        return True
+    if T.is_numeric(attr_t) and T.is_numeric(val_t):
+        try:
+            return T.promote(attr_t, val_t) == attr_t
+        except Exception:
+            return False
+    return False
+
+
+def _extract_join_index_probe(on_expr, left, right, resolver):
+    """Detect ``T.attr == <expr over the opposite side>`` (possibly one
+    conjunct of a top-level And) where T is an InMemoryTable join side
+    with attr in ``probe_attrs()``. Returns a dict for
+    JoinQueryRuntime.index_probe or None."""
+    from siddhi_tpu.core.table.in_memory_table import InMemoryTable
+    from siddhi_tpu.query_api.expressions import (
+        And,
+        AttributeFunction,
+        Compare,
+        Variable,
+    )
+
+    def vars_of(e, out):
+        if isinstance(e, Variable):
+            out.append(e)
+        for name in ("left", "right", "expression"):
+            c = getattr(e, name, None)
+            if c is not None and not isinstance(c, (str, int, float, bool)):
+                vars_of(c, out)
+        if isinstance(e, AttributeFunction):
+            for p in e.parameters:
+                vars_of(p, out)
+        return out
+
+    def side_ids(s):
+        return {s.stream_id, s.ref_id} - {None}
+
+    def try_eq(e):
+        if not isinstance(e, Compare) or e.operator != "==":
+            return None
+        for store_side in (left, right):
+            store = store_side.store
+            if not isinstance(store, InMemoryTable):
+                continue
+            other_side = right if store_side is left else left
+            probe_attrs = store.probe_attrs()
+            for tvar, vexpr in ((e.left, e.right), (e.right, e.left)):
+                if not (isinstance(tvar, Variable)
+                        and tvar.stream_id in side_ids(store_side)
+                        and tvar.attribute_name in probe_attrs):
+                    continue
+                # the value expr must reference ONLY the other side
+                vs = vars_of(vexpr, [])
+                if not vs or any(
+                        v.stream_id is None
+                        or v.stream_id in side_ids(store_side) for v in vs):
+                    continue
+                if any(v.stream_id not in side_ids(other_side) for v in vs):
+                    continue
+                val_fn, val_t = compile_expr(vexpr, resolver)
+                attr_t = store.definition.attribute(tvar.attribute_name).type
+                if not _probe_type_safe(attr_t, val_t):
+                    # casting the probe value into the column dtype would
+                    # NARROW it (e.g. double -> long truncates), and the
+                    # indexed path skips re-evaluating the equality — fall
+                    # back to the broadcast compare
+                    continue
+                return {"store_side": store_side.key, "attr": tvar.attribute_name,
+                        "val_fn": val_fn, "residual_fn": None}
+        return None
+
+    hit = try_eq(on_expr)
+    if hit is not None:
+        return hit
+    if isinstance(on_expr, And):
+        for this, rest in ((on_expr.left, on_expr.right),
+                           (on_expr.right, on_expr.left)):
+            hit = try_eq(this)
+            if hit is not None:
+                hit["residual_fn"] = compile_condition(rest, resolver)
+                return hit
+    return None
+
+
 def plan_join_query(
     query: Query,
     query_name: str,
@@ -364,6 +455,16 @@ def plan_join_query(
     if join.on_compare is not None:
         on_cond = compile_condition(join.on_compare, resolver)
 
+    # @index/@primaryKey equality probe: `on T.attr == <expr over the
+    # other side>` against an indexed table side compiles to a device
+    # searchsorted over the sorted probe column instead of the [N, W]
+    # broadcast compare (the reference's IndexedEventHolder probe,
+    # OverwriteTableIndexOperator/CollectionExecutor path)
+    index_probe = None
+    if join.on_compare is not None and partition_ctx is None:
+        index_probe = _extract_join_index_probe(
+            join.on_compare, left, right, resolver)
+
     if query.selector.select_all or not query.selector.selection_list:
         raise CompileError(
             f"query '{query_name}': join queries need an explicit select list"
@@ -389,7 +490,7 @@ def plan_join_query(
             fns.append((fn, t))
         group_keyer = GroupKeyer(fns)
 
-    return JoinQueryRuntime(
+    rt = JoinQueryRuntime(
         name=query_name,
         app_context=app_context,
         left=left,
@@ -400,6 +501,8 @@ def plan_join_query(
         partition_ctx=partition_ctx,
         group_keyer=group_keyer,
     )
+    rt.index_probe = index_probe
+    return rt
 
 
 def _agg_join_range(join: JoinInputStream, query_name: str):

@@ -168,6 +168,9 @@ class JoinQueryRuntime(QueryRuntime):
         )
         self.sides = {"left": left, "right": right}
         self.on_cond = on_cond
+        # @index equality probe spec from the planner (None = broadcast
+        # compare): {"store_side", "attr", "val_fn", "residual_fn"}
+        self.index_probe = None
         self._steps: Dict[str, object] = {}
         # stable per-side timer callbacks so the scheduler's
         # (id(target), ts) dedup holds across batches
@@ -214,6 +217,13 @@ class JoinQueryRuntime(QueryRuntime):
         partitioned = self.partition_ctx is not None
         split = self.keyer is not None
         other_external = other.probe_external
+        # indexed probe: only when THIS side triggers against the indexed
+        # store side (the store never triggers)
+        iprobe = self.index_probe
+        use_index = (iprobe is not None and side.triggers
+                     and iprobe["store_side"] == other.key
+                     and other.store is not None and not partitioned)
+        probe_width = int(getattr(self.app_context, "index_probe_width", 64))
 
         def step(state, probe_cols, probe_valid, cols, current_time):
             ctx = {"xp": jnp, "current_time": current_time}
@@ -246,9 +256,59 @@ class JoinQueryRuntime(QueryRuntime):
                 probe_cols, probe_valid = other.window_stage.contents(state[other_key])
 
             # joined eval dict: this side [N,1]; other side [1,W]
-            # (or, partitioned, this row's key's ring gathered to [N,W])
+            # (or, partitioned, this row's key's ring gathered to [N,W];
+            # or, INDEXED, per-row candidate windows gathered to [N,G])
             ev: Dict[str, jnp.ndarray] = {}
-            if partitioned and not other_external:
+            idx_overflow = None
+            if use_index:
+                # sort the probe column once (invalid/null rows to the
+                # end), then per-event searchsorted gives a contiguous
+                # candidate range — O(W log W + N log W + N*G) instead of
+                # the O(N*W) broadcast compare, and the join materializes
+                # [N, G+1] instead of [N, W+1]
+                attr = iprobe["attr"]
+                ev0 = {TS_KEY: wout[TS_KEY][:, None]}
+                for a in side.definition.attributes:
+                    ev0[side.prefix + a.name] = wout[a.name][:, None]
+                    ev0[side.prefix + a.name + "?"] = wout[a.name + "?"][:, None]
+                v, vmask = iprobe["val_fn"](ev0, ctx)
+                pvals = probe_cols[attr]
+                pnull = probe_cols.get(attr + "?")
+                ok = probe_valid
+                if pnull is not None:
+                    ok = ok & ~pnull
+                if jnp.issubdtype(pvals.dtype, jnp.floating):
+                    big = jnp.asarray(jnp.inf, pvals.dtype)
+                else:
+                    big = jnp.asarray(jnp.iinfo(pvals.dtype).max, pvals.dtype)
+                sortkey = jnp.where(ok, pvals, big)
+                order = jnp.argsort(sortkey)
+                sk = sortkey[order]
+                Wfull = sk.shape[0]
+                vv = jnp.broadcast_to(jnp.asarray(v), (N, 1))[:, 0] \
+                    .astype(pvals.dtype)
+                lo = jnp.searchsorted(sk, vv, side="left")
+                hi = jnp.searchsorted(sk, vv, side="right")
+                G = min(probe_width, Wfull)
+                grid = lo[:, None] + jnp.arange(G)[None, :]
+                cmask = grid < hi[:, None]
+                if vmask is not None:
+                    cmask = cmask & ~jnp.broadcast_to(
+                        jnp.asarray(vmask), (N, 1))
+                idx_overflow = jnp.any((hi - lo) > G).astype(jnp.int32)
+                cand = order[jnp.clip(grid, 0, Wfull - 1)]        # [N, G]
+                W = G
+                for a in other.definition.attributes:
+                    ev[other.prefix + a.name] = probe_cols[a.name][cand]
+                    ev[other.prefix + a.name + "?"] = \
+                        probe_cols[a.name + "?"][cand]
+                # belt-and-braces equality re-check on the gathered rows:
+                # guards the dtype-max/inf sentinel (a probe value equal
+                # to it would otherwise sweep deleted/null rows in) and
+                # any residual dtype edge case
+                pv = (cmask & ok[cand]
+                      & (pvals[cand] == vv[:, None]))
+            elif partitioned and not other_external:
                 pk_rows = jnp.clip(wout[PK_KEY].astype(jnp.int32), 0,
                                    probe_valid.shape[0] - 1)
                 probe_cols = {a: v[pk_rows] for a, v in probe_cols.items()}
@@ -270,7 +330,14 @@ class JoinQueryRuntime(QueryRuntime):
             ev[TS_KEY] = wout[TS_KEY][:, None]
 
             row_live = wout[VALID_KEY] & ((wout[TYPE_KEY] == CURRENT) | (wout[TYPE_KEY] == EXPIRED))
-            if side.triggers:
+            if use_index:
+                # the probed equality holds by construction; only the
+                # residual conjuncts (if any) still need evaluating
+                rfn = iprobe["residual_fn"]
+                cond = rfn(ev, ctx) if rfn is not None else jnp.ones((N, W), bool)
+                cond = jnp.broadcast_to(cond, (N, W))
+                match = row_live[:, None] & jnp.broadcast_to(pv, (N, W)) & cond
+            elif side.triggers:
                 cond = on_cond(ev, ctx) if on_cond is not None else jnp.ones((N, W), bool)
                 cond = jnp.broadcast_to(cond, (N, W))
                 match = row_live[:, None] & jnp.broadcast_to(pv, (N, W)) & cond
@@ -309,6 +376,12 @@ class JoinQueryRuntime(QueryRuntime):
                 joined[GK_KEY] = pk_out
             else:
                 joined[GK_KEY] = jnp.zeros(NW, jnp.int32)
+
+            if idx_overflow is not None:
+                # candidate window saturated: surfacing it beats silently
+                # dropping matches (raise app_context.index_probe_width)
+                overflow = idx_overflow if overflow is None else jnp.maximum(
+                    jnp.asarray(overflow).astype(jnp.int32), idx_overflow)
 
             if split:
                 # host keyer computes GK from joined columns; the selector

@@ -26,7 +26,9 @@ from siddhi_tpu.ops.expressions import (
     CompileError,
     compile_condition,
 )
+from siddhi_tpu.query_api.definitions import AttrType
 from siddhi_tpu.query_api.execution import OnDemandQuery, ReturnStream
+from siddhi_tpu.query_api.expressions import Variable
 
 
 def _aggregation_contents(agg, oq: OnDemandQuery, dictionary):
@@ -145,6 +147,35 @@ def _run_mutation(oq: OnDemandQuery, app_runtime, dictionary) -> List[Event]:
     raise CompileError(f"unsupported on-demand query type '{oq.type}'")
 
 
+def extract_eq_probe(cond, table_def, probe_attrs):
+    """Split an `on` condition into (attr, const, residual) when it has a
+    top-level equality conjunct ``T.attr == <constant>`` over an indexed
+    attribute — the shape the reference compiles to an
+    ``IndexedEventHolder`` probe (CompareCollectionExecutor over
+    indexData). Returns None when no probe applies."""
+    from siddhi_tpu.query_api.expressions import And, Compare, Constant
+
+    def attr_const(e):
+        if not isinstance(e, Compare) or e.operator != "==":
+            return None
+        for var, const in ((e.left, e.right), (e.right, e.left)):
+            if (isinstance(var, Variable) and isinstance(const, Constant)
+                    and var.stream_id in (None, table_def.id)
+                    and var.attribute_name in probe_attrs):
+                return var.attribute_name, const
+        return None
+
+    hit = attr_const(cond)
+    if hit is not None:
+        return hit[0], hit[1], None
+    if isinstance(cond, And):
+        for this, other in ((cond.left, cond.right), (cond.right, cond.left)):
+            hit = attr_const(this)
+            if hit is not None:
+                return hit[0], hit[1], other
+    return None
+
+
 def run_on_demand_query(source: str, app_runtime) -> List[Event]:
     oq: OnDemandQuery = SiddhiCompiler.parse_on_demand_query(source)
     dictionary = app_runtime.app_context.string_dictionary
@@ -170,11 +201,54 @@ def run_on_demand_query(source: str, app_runtime) -> List[Event]:
     match = valid
     if oq.input_store.on_condition is not None:
         resolver = TableConditionResolver(definition, None, dictionary)
-        cond = compile_condition(oq.input_store.on_condition, resolver)
-        ev = {TBL_PREFIX + k: v[None, :] for k, v in cols.items()}
-        ev[TS_KEY] = cols[TS_KEY][None, :]
-        m = jnp.broadcast_to(cond(ev, {"xp": jnp}), (1, C))[0]
-        match = match & m
+        probe = None
+        if table is not None and hasattr(table, "probe_attrs"):
+            probe = extract_eq_probe(oq.input_store.on_condition,
+                                     definition, table.probe_attrs())
+            if probe is not None:
+                # a narrowing cast into the column dtype would change
+                # equality semantics (2.5 -> 2): scan instead
+                from siddhi_tpu.core.plan.query_planner import _probe_type_safe
+
+                attr_t = definition.attribute(probe[0]).type
+                if not _probe_type_safe(attr_t, probe[1].type):
+                    probe = None
+        if probe is not None:
+            # indexed equality: hash-probe the candidate slots and evaluate
+            # only the residual condition over them — sub-linear in the
+            # table size (IndexEventHolder probe path)
+            attr, const, residual = probe
+            value = const.value
+            if const.type == AttrType.STRING:
+                value = dictionary.encode(value)
+            with table._lock:
+                # probe + snapshot under ONE lock: slots must index the
+                # same state the output columns come from (a concurrent
+                # insert could otherwise grow capacity past this snapshot)
+                slots = table.index_candidates(attr, value)
+                cols, valid = table.contents()
+                C = valid.shape[0]
+            sel = np.zeros(C, bool)
+            if slots.size:
+                host_valid = np.asarray(valid)
+                keep = slots[host_valid[slots]]
+                if residual is not None and keep.size:
+                    rcond = compile_condition(residual, resolver)
+                    sub = {TBL_PREFIX + k: np.asarray(v)[keep][None, :]
+                           for k, v in cols.items()}
+                    sub[TS_KEY] = np.asarray(cols[TS_KEY])[keep][None, :]
+                    rm = np.broadcast_to(
+                        np.asarray(rcond(sub, {"xp": np})),
+                        (1, keep.size))[0]
+                    keep = keep[rm]
+                sel[keep] = True
+            match = match & jnp.asarray(sel)
+        else:
+            cond = compile_condition(oq.input_store.on_condition, resolver)
+            ev = {TBL_PREFIX + k: v[None, :] for k, v in cols.items()}
+            ev[TS_KEY] = cols[TS_KEY][None, :]
+            m = jnp.broadcast_to(cond(ev, {"xp": jnp}), (1, C))[0]
+            match = match & m
 
     sel_cols = {k: v for k, v in cols.items()}
     sel_cols[VALID_KEY] = match

@@ -9,8 +9,11 @@ condition as a masked ``[B, C]`` broadcast compare — the vectorized
 equivalent of an index probe, with no pointer-chasing. Capacity doubles by
 prefix copy when full.
 
-``@primaryKey``/``@index`` annotations are accepted (they shape reference
-semantics only through lookup performance, which is uniform here).
+``@primaryKey`` adds uniqueness plus a host hash probe; ``@index`` adds
+sub-linear equality probes: host value->slots hash maps for on-demand
+queries (``index_candidates``) and a device sorted-column searchsorted
+path for joins (``join_runtime`` — bounded [N, G] candidate windows
+replace the [N, C] broadcast compare).
 """
 
 from __future__ import annotations
@@ -92,6 +95,20 @@ class InMemoryTable:
             self.primary_key = [v for _k, v in pk_ann.elements if v]
         self._pk_map: Dict[tuple, int] = {}
         self._pk_dirty = False
+        # @index: secondary per-attribute probes (the dense analog of the
+        # reference's per-attribute TreeMap indexes,
+        # IndexEventHolder.java:60-80). Host side: value -> slots hash maps
+        # (on-demand queries); device side: joins sort the probe column
+        # once per batch and searchsorted into it (join_runtime).
+        from siddhi_tpu.query_api.annotations import find_annotations
+
+        self.indexes: List[str] = []
+        for ann in find_annotations(definition.annotations or [], "index"):
+            self.indexes.extend(v for _k, v in ann.elements if v)
+        for a in self.indexes:
+            definition.attribute(a)     # validate the attr exists
+        self._idx_maps: Dict[str, Dict[object, np.ndarray]] = {}
+        self._idx_dirty = True
         # incremental-snapshot op log: inserted rows since the last
         # checkpoint; deletes/updates force a full capture. Journaling is
         # off until persistence is in use (PersistenceManager enables it)
@@ -123,6 +140,49 @@ class InMemoryTable:
             if self._pk_dirty:
                 self._rebuild_pk_map()
             return self._pk_map.get(tuple(key))
+
+    # --------------------------------------------------- secondary indexes
+
+    def probe_attrs(self) -> List[str]:
+        """Attributes with a sub-linear equality probe: @index attrs plus
+        a single-attribute @primaryKey."""
+        out = list(self.indexes)
+        if len(self.primary_key) == 1 and self.primary_key[0] not in out:
+            out.append(self.primary_key[0])
+        return out
+
+    def _rebuild_idx_maps(self):
+        valid = np.asarray(self.state["valid"])
+        live = np.nonzero(valid)[0]
+        self._idx_maps = {}
+        for a in self.probe_attrs():
+            # vectorized group-by-value: one stable sort + split (no
+            # per-row Python loop even at 10^5+ rows)
+            col = np.asarray(self.state["cols"][a])[live]
+            nm = np.asarray(self.state["cols"][a + "?"])[live]
+            ok = ~nm
+            vals, slots = col[ok], live[ok].astype(np.int64)
+            order = np.argsort(vals, kind="stable")
+            sv, ss = vals[order], slots[order]
+            uniq, starts = np.unique(sv, return_index=True)
+            parts = np.split(ss, starts[1:])
+            self._idx_maps[a] = {k.item(): p for k, p in zip(uniq, parts)}
+        self._idx_dirty = False
+
+    def index_candidates(self, attr: str, value) -> Optional[np.ndarray]:
+        """Slots whose ``attr`` equals ``value`` (hash probe, no scan).
+        None when the attribute has no index; [] when no row matches.
+        String values must be dictionary-encoded ints. The value must
+        already fit the column dtype — the probe compilers only take this
+        path for non-narrowing types (see _probe_type_safe)."""
+        if attr not in self.probe_attrs():
+            return None
+        with self._lock:
+            if self._idx_dirty:
+                self._rebuild_idx_maps()
+            key = self.state["cols"][attr].dtype.type(value).item()
+            hits = self._idx_maps.get(attr, {}).get(key)
+            return hits if hits is not None else np.empty(0, np.int64)
 
     def _zero_state(self, cap: int) -> dict:
         return {
@@ -182,6 +242,7 @@ class InMemoryTable:
                         seen.add(key)
                 batch.cols[VALID_KEY] = valid_h
                 self._pk_dirty = True
+            self._idx_dirty = True
             n = batch.size
             if n == 0:
                 return
@@ -238,6 +299,7 @@ class InMemoryTable:
                 "valid": self.state["valid"] & ~jnp.any(m, axis=0),
             }
             self._pk_dirty = True
+            self._idx_dirty = True
             self._journal_full = True
 
     def update(self, cond: Optional[Callable], assignments, batch: Optional[HostBatch]):
@@ -274,6 +336,7 @@ class InMemoryTable:
                     hit, mk, new_cols[col_name + "?"])
             self.state = {"cols": new_cols, "valid": self.state["valid"]}
             self._pk_dirty = True
+            self._idx_dirty = True
             self._journal_full = True
             return m
 
@@ -336,6 +399,7 @@ class InMemoryTable:
                 }
                 self.capacity = snap["capacity"]
                 self._pk_dirty = True
+            self._idx_dirty = True
             return
         # replay without re-journaling (the restored chain already holds
         # these rows — journaling them would duplicate on the NEXT restore)

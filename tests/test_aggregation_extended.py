@@ -98,15 +98,67 @@ def test_restart_rebuild_from_revision():
     assert (10_000, 5.0) in after          # 1.0 persisted + 4.0 new
 
 
+SHARD_APP = """
+    @app:playback
+    define stream S (symbol string, price double);
+    @PartitionById(enable='true')
+    define aggregation Agg
+      from S select symbol, sum(price) as total, count() as n
+      group by symbol aggregate every sec;
+"""
+
+
 def test_shard_mode_flag():
     m = SiddhiManager()
-    rt = m.create_siddhi_app_runtime("""
-        define stream S (symbol string, price double);
-        @PartitionById(enable='true')
-        define aggregation Agg
-          from S select symbol, sum(price) as total
-          group by symbol aggregate every sec;
-    """)
+    rt = m.create_siddhi_app_runtime(SHARD_APP)
     agg = rt.aggregations["Agg"]
     m.shutdown()
     assert agg.shard_mode and agg.shard_id is not None
+
+
+def test_distributed_aggregation_two_shards_stitch():
+    # two runtimes (shard-0/shard-1) each aggregate their half of the
+    # event stream and publish partial buckets to ONE shared persistence
+    # store; a reader stitches them back — cross-shard sums/counts equal
+    # the unsharded totals (reference per-shardId aggregation tables,
+    # AggregationParser.java:171-197)
+    from siddhi_tpu.core.aggregation.incremental import Duration
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+    from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+    shared = InMemoryPersistenceStore()
+    aggs = []
+    for shard, rows in (
+        ("0", [(1000, ["A", 1.0]), (1100, ["A", 2.0]), (1200, ["B", 5.0])]),
+        ("1", [(1300, ["A", 4.0]), (2200, ["B", 8.0])]),
+    ):
+        m = SiddhiManager()
+        m.set_persistence_store(shared)
+        m.set_config_manager(InMemoryConfigManager({"shardId": shard}))
+        rt = m.create_siddhi_app_runtime(SHARD_APP)
+        h = rt.get_input_handler("S")
+        for ts, data in rows:
+            h.send(ts, data)
+        agg = rt.aggregations["Agg"]
+        assert agg.shard_id == shard
+        agg.publish_shard()
+        aggs.append((m, agg))
+
+    # reader: a third runtime with the same store stitches both shards
+    mr = SiddhiManager()
+    mr.set_persistence_store(shared)
+    rtr = mr.create_siddhi_app_runtime(SHARD_APP)
+    reader = rtr.aggregations["Agg"]
+    assert reader.stitch_shards() == 2
+    # on-demand query over the stitched reader: cross-shard sums/counts
+    out = rtr.query("from Agg within 0, 10000 per 'seconds' "
+                    "select AGG_TIMESTAMP, symbol, total, n return;")
+    got = {(e.data[0], e.data[1]): (e.data[2], e.data[3]) for e in out}
+    # bucket 1000: A = 1+2+4 over both shards (3 events), B = 5 (1 event)
+    assert got[(1000, "A")] == (7.0, 3)
+    assert got[(1000, "B")] == (5.0, 1)
+    # bucket 2000: B = 8 from shard 1 only
+    assert got[(2000, "B")] == (8.0, 1)
+    for m, _ in aggs:
+        m.shutdown()
+    mr.shutdown()

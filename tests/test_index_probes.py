@@ -1,0 +1,236 @@
+"""@index / single-attr @primaryKey probes — sub-linear equality lookups
+(reference ``IndexEventHolder.java:60-80`` per-attribute indexes +
+``CollectionExecutor`` probe compilation): host value->slots hash maps
+for on-demand queries, device sorted-column searchsorted for joins."""
+
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def _fill(rt, n, dup_every=None):
+    """Insert n rows (sym Sx, price x, volume x%7) via bulk ingest."""
+    h = rt.get_input_handler("In")
+    B = 8192
+    for c0 in range(0, n, B):
+        m = min(B, n - c0)
+        ids = np.arange(c0, c0 + m)
+        h.send_columns({
+            "sym": np.array([f"S{i}" for i in ids], dtype=object),
+            "price": ids.astype(np.float64),
+            "volume": (ids % 7).astype(np.int64),
+        })
+
+
+APP = """
+define stream In (sym string, price double, volume long);
+@index('sym')
+define table T (sym string, price double, volume long);
+from In insert into T;
+"""
+
+
+def test_on_demand_indexed_equality_probe_correct():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    _fill(rt, 1000)
+    rows = rt.query("from T on T.sym == 'S123' select sym, price return;")
+    assert [tuple(e.data) for e in rows] == [("S123", 123.0)]
+    # conjunct with residual
+    rows = rt.query(
+        "from T on T.sym == 'S123' and volume > 100 select sym return;")
+    assert rows == []   # 123 % 7 = 4, residual fails
+    rows = rt.query(
+        "from T on T.sym == 'S123' and volume >= 0 select sym return;")
+    assert [e.data[0] for e in rows] == ["S123"]
+    # miss
+    assert rt.query("from T on T.sym == 'NOPE' select sym return;") == []
+    m.shutdown()
+
+
+def test_on_demand_probe_tracks_mutations():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    _fill(rt, 100)
+    rt.query("delete T on T.sym == 'S42';")
+    assert rt.query("from T on T.sym == 'S42' select sym return;") == []
+    rt.query("update T set T.price = 999.0 on T.sym == 'S43';")
+    rows = rt.query("from T on T.sym == 'S43' select price return;")
+    assert [e.data[0] for e in rows] == [999.0]
+    m.shutdown()
+
+
+def test_on_demand_indexed_probe_sublinear_100k():
+    # the probe must not degrade with table size: compare per-query time
+    # on a 100k-row table between an indexed lookup and a forced full
+    # scan (inequality prevents the probe) — the probe must win big
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    _fill(rt, 100_000)
+
+    def best_of(q, n=3):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            rt.query(q)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    probe_q = "from T on T.sym == 'S77777' select sym, price return;"
+    scan_q = ("from T on T.sym == 'S77777' and price >= 0.0 "
+              "select sym, price return;")
+    # warm both paths (jit/selector compile + index build)
+    assert [e.data[0] for e in rt.query(probe_q)] == ["S77777"]
+    rt.query(scan_q)
+    t_probe = best_of(probe_q)
+    # results agree
+    assert [e.data[0] for e in rt.query(scan_q)] == ["S77777"]
+    m.shutdown()
+    # hash probe over 100k rows: well under 50ms (a full [1,C] device
+    # scan + selector over 100k rows costs much more; avoid asserting a
+    # flaky ratio — assert the probe's absolute cost stays tiny)
+    assert t_probe < 0.05, f"indexed probe took {t_probe * 1e3:.1f} ms"
+
+
+JOIN_APP = """
+define stream In (sym string, price double, volume long);
+define stream Q (qsym string, qty long);
+@index('sym')
+define table T (sym string, price double, volume long);
+from In insert into T;
+@info(name='j')
+from Q join T on T.sym == Q.qsym
+select Q.qsym as sym, T.price as price, Q.qty as qty
+insert into OutStream;
+"""
+
+
+def test_indexed_join_correct_and_uses_probe():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(JOIN_APP)
+    c = Collector()
+    rt.add_callback("OutStream", c)
+    _fill(rt, 5000)
+    # planner detected the probe
+    assert rt.query_runtimes["j"].index_probe is not None
+    hq = rt.get_input_handler("Q")
+    hq.send(["S1234", 7])
+    hq.send(["MISSING", 1])
+    hq.send(["S4999", 2])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [
+        ("S1234", 1234.0, 7), ("S4999", 4999.0, 2)]
+
+
+def test_indexed_join_with_residual_and_duplicates():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream In (sym string, price double, volume long);
+        define stream Q (qsym string, minp double);
+        @index('sym')
+        define table T (sym string, price double, volume long);
+        from In insert into T;
+        @info(name='j')
+        from Q join T on T.sym == Q.qsym and T.price > Q.minp
+        select Q.qsym as sym, T.price as price
+        insert into OutStream;
+    """)
+    c = Collector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("In")
+    # duplicate keys with different prices
+    h.send_columns({"sym": np.array(["A", "A", "A", "B"], dtype=object),
+                    "price": np.array([1.0, 5.0, 9.0, 3.0]),
+                    "volume": np.array([1, 1, 1, 1], dtype=np.int64)})
+    assert rt.query_runtimes["j"].index_probe is not None
+    hq = rt.get_input_handler("Q")
+    hq.send(["A", 4.0])
+    m.shutdown()
+    assert sorted(e.data[1] for e in c.events) == [5.0, 9.0]
+
+
+def test_indexed_join_probe_width_overflow_raises():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream In (sym string, price double, volume long);
+        define stream Q (qsym string, qty long);
+        @index('sym')
+        define table T (sym string, price double, volume long);
+        from In insert into T;
+        @info(name='j')
+        from Q join T on T.sym == Q.qsym
+        select Q.qsym as sym, T.price as price insert into OutStream;
+    """)
+    rt.app_context.index_probe_width = 4
+    rt.add_callback("OutStream", Collector())
+    h = rt.get_input_handler("In")
+    h.send_columns({"sym": np.array(["X"] * 10, dtype=object),
+                    "price": np.arange(10, dtype=np.float64),
+                    "volume": np.zeros(10, np.int64)})
+    hq = rt.get_input_handler("Q")
+    try:
+        with pytest.raises(RuntimeError):
+            hq.send(["X", 1])
+    finally:
+        m.shutdown()
+
+
+def test_probe_skipped_for_narrowing_value_type():
+    # `on T.volume == price` with price double against a long index:
+    # casting 2.5 -> 2 would fabricate matches, so the planner must fall
+    # back to the broadcast compare; on-demand likewise scans
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream In (sym string, price double, volume long);
+        define stream Q (qsym string, price double);
+        @index('volume')
+        define table T (sym string, price double, volume long);
+        from In insert into T;
+        @info(name='j')
+        from Q join T on T.volume == Q.price
+        select T.sym as sym insert into OutStream;
+    """)
+    c = Collector()
+    rt.add_callback("OutStream", c)
+    assert rt.query_runtimes["j"].index_probe is None   # narrowing: no probe
+    h = rt.get_input_handler("In")
+    h.send(["A", 1.0, 2])
+    rt.get_input_handler("Q").send(["q", 2.5])   # 2.5 != 2: no match
+    rows = rt.query("from T on T.volume == 2.5 select sym return;")
+    assert rows == [] and not c.events
+    rt.get_input_handler("Q").send(["q", 2.0])   # 2.0 == 2: matches
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == ["A"]
+
+
+def test_unindexed_join_still_broadcasts():
+    # no @index: the planner leaves the broadcast compare in place
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream In (sym string, price double, volume long);
+        define stream Q (qsym string, qty long);
+        define table T (sym string, price double, volume long);
+        from In insert into T;
+        @info(name='j')
+        from Q join T on T.sym == Q.qsym
+        select Q.qsym as sym, T.price as price insert into OutStream;
+    """)
+    c = Collector()
+    rt.add_callback("OutStream", c)
+    assert rt.query_runtimes["j"].index_probe is None
+    _fill(rt, 100)
+    rt.get_input_handler("Q").send(["S5", 1])
+    m.shutdown()
+    assert [tuple(e.data) for e in c.events] == [("S5", 5.0)]

@@ -164,7 +164,12 @@ def test_trigger_periodic():
         from T select triggered_time insert into OutStream;
     """, out="OutStream")
     rt.start()
-    time.sleep(0.45)
+    # wall-clock trigger: poll with a deadline instead of one fixed sleep
+    # (a cold jit compile of the pass-through step can eat several 100ms
+    # periods on a loaded machine)
+    deadline = time.time() + 15.0
+    while len(c.events) < 2 and time.time() < deadline:
+        time.sleep(0.05)
     m.shutdown()
     assert len(c.events) >= 2
 
