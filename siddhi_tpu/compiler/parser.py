@@ -495,6 +495,13 @@ class Parser:
                 self.expect_op("]")
             elif t.is_op("#"):
                 self.next()
+                if self.peek().is_op("["):
+                    # '#[expr]' filter-handler shorthand (SiddhiQL grammar
+                    # StreamHandler: '#'? '[' expression ']')
+                    self.next()
+                    handlers.append(Filter(self.parse_expression()))
+                    self.expect_op("]")
+                    continue
                 nm = self.name()
                 if nm.lower() == "window" and self.accept_op("."):
                     wname = self.name()

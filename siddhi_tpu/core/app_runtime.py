@@ -475,8 +475,15 @@ class SiddhiAppRuntime:
             if positions:
                 group_key_fn = lambda ev, _p=positions: tuple(  # noqa: E731
                     ev.data[i] for i in _p)
+        # inside a partition each key is its OWN query instance in the
+        # reference — wrap the limiter per partition key (events carry pk)
+        limiter_partitioned = (partition_ctx is not None
+                               and query.output_rate is not None)
+        if limiter_partitioned:
+            runtime.limiter_needs_pk = True
         runtime.rate_limiter = create_rate_limiter(
-            query.output_rate, runtime.send_to_callbacks, group_key_fn)
+            query.output_rate, runtime.send_to_callbacks, group_key_fn,
+            partitioned=limiter_partitioned)
         runtime.scheduler = self.app_context.scheduler
 
         from siddhi_tpu.query_api.execution import JoinInputStream, StateInputStream

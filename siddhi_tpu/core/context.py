@@ -47,6 +47,12 @@ class TimestampGenerator:
             for listener in self._increment_listeners:
                 listener(ts)
 
+    def reset_timestamp(self, ts: int):
+        """Force the event clock (restore/rollback): unlike
+        ``set_current_timestamp`` this may move BACKWARD, and fires no
+        time-change listeners (restored timers re-arm separately)."""
+        self._last_event_ts = int(ts)
+
     def add_time_change_listener(self, fn):
         self._increment_listeners.append(fn)
 

@@ -199,22 +199,77 @@ class GroupTimeRateLimiter(OutputRateLimiter):
             self._send(out)
 
 
+class PartitionedRateLimiter(OutputRateLimiter):
+    """One limiter instance PER PARTITION KEY: the reference clones the
+    whole query runtime — including its OutputRateLimiter — per key
+    (PartitionInstanceRuntime), so counters/windows never mix across
+    keys. Events route by ``Event.pk``."""
+
+    def __init__(self, send, factory):
+        super().__init__(send)
+        self._factory = factory
+        self._per_key: dict = {}
+        self._scheduler = None
+
+    def _limiter(self, pk):
+        lim = self._per_key.get(pk)
+        if lim is None:
+            lim = self._per_key[pk] = self._factory()
+            lim.start(self._scheduler)
+        return lim
+
+    def process(self, events: List[Event]):
+        by: dict = {}
+        for ev in events:
+            by.setdefault(ev.pk, []).append(ev)
+        for pk, evs in by.items():
+            self._limiter(pk).process(evs)
+
+    def start(self, scheduler=None):
+        self._scheduler = scheduler
+        for lim in self._per_key.values():
+            lim.start(scheduler)
+
+    def stop(self):
+        for lim in self._per_key.values():
+            lim.stop()
+
+    def reset_keys(self, ids):
+        """Drop retired partition keys' limiter instances (@purge) so a
+        recycled pk starts fresh and periodic jobs don't leak."""
+        for pk in ids:
+            lim = self._per_key.pop(int(pk), None)
+            if lim is not None:
+                lim.stop()
+
+
 def create_rate_limiter(rate: Optional[OutputRate], send,
-                        group_key_fn=None) -> OutputRateLimiter:
+                        group_key_fn=None,
+                        partitioned: bool = False) -> OutputRateLimiter:
     """``group_key_fn`` (group tuple from an output Event) switches
     first/last limiters to their per-group variants, exactly as the
-    reference OutputParser picks GroupBy classes for grouped queries."""
+    reference OutputParser picks GroupBy classes for grouped queries.
+    ``partitioned`` wraps the limiter per partition key (events carry
+    ``pk``), matching the reference's per-key query instances."""
     if rate is None:
         return PassThroughRateLimiter(send)
-    if isinstance(rate, EventOutputRate):
-        if group_key_fn is not None and rate.type in ("first", "last"):
-            return GroupEventRateLimiter(send, rate.value, rate.type, group_key_fn)
-        return EventRateLimiter(send, rate.value, rate.type)
-    if isinstance(rate, TimeOutputRate):
-        if group_key_fn is not None and rate.type in ("first", "last"):
-            return GroupTimeRateLimiter(send, rate.value, rate.type, group_key_fn)
-        return TimeRateLimiter(send, rate.value, rate.type)
-    if isinstance(rate, SnapshotOutputRate):
-        # snapshot limiter re-emits the full last-known output every T
-        return TimeRateLimiter(send, rate.value, "last")
-    raise NotImplementedError(f"rate {rate!r}")
+
+    def build():
+        if isinstance(rate, EventOutputRate):
+            if group_key_fn is not None and rate.type in ("first", "last"):
+                return GroupEventRateLimiter(send, rate.value, rate.type,
+                                             group_key_fn)
+            return EventRateLimiter(send, rate.value, rate.type)
+        if isinstance(rate, TimeOutputRate):
+            if group_key_fn is not None and rate.type in ("first", "last"):
+                return GroupTimeRateLimiter(send, rate.value, rate.type,
+                                            group_key_fn)
+            return TimeRateLimiter(send, rate.value, rate.type)
+        if isinstance(rate, SnapshotOutputRate):
+            # snapshot limiter re-emits the full last-known output every T
+            return TimeRateLimiter(send, rate.value, "last")
+        raise NotImplementedError(f"rate {rate!r}")
+
+    if partitioned:
+        return PartitionedRateLimiter(send, build)
+    return build()

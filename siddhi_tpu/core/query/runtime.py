@@ -130,6 +130,7 @@ class QueryRuntime(Receiver):
         self.partition_keyer = partition_keyer
         self.carried_pk = carried_pk      # input is an inner '#stream': rows carry pk
         self.attach_pk = False            # output goes to an inner '#stream'
+        self.limiter_needs_pk = False     # partitioned rate limiter routing
         self._win_keys = 1
         if partition_ctx is not None:
             self._win_keys = max(_pow2(partition_ctx.num_keys()), 16)
@@ -200,6 +201,11 @@ class QueryRuntime(Receiver):
     def reset_partition_keys(self, ids):
         """Zero the dense state rows of purged partition keys so their ids
         can be reused by new keys (@purge — PartitionRuntimeImpl purge)."""
+        if self.rate_limiter is not None and hasattr(
+                self.rate_limiter, "reset_keys"):
+            # per-key limiter instances of retired keys must not leak
+            # their counters/pending into a recycled pk
+            self.rate_limiter.reset_keys(ids)
         with self._lock:
             if self._state is None:
                 return
@@ -673,9 +679,10 @@ class QueryRuntime(Receiver):
                 cols[TYPE_KEY] = np.where(t == EXPIRED, CURRENT, t).astype(np.int8)
             self.output_junction.send_batch(HostBatch(cols, size=out._size))
             return
+        want_pk = self.attach_pk or self.limiter_needs_pk
         events = out.to_events(
             self.output_attrs, self.dictionary,
-            pk_key=PK_KEY if self.attach_pk else None,
+            pk_key=PK_KEY if want_pk else None,
             object_meta=self.selector_plan.object_meta or None,
             object_multi=set(self.selector_plan.object_multi) or None,
         )

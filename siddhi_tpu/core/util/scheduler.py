@@ -83,6 +83,20 @@ class Scheduler:
     def cancel(self, job):
         job.cancelled = True
 
+    def clear_pending(self):
+        """Drop pending ONE-SHOT timers (snapshot restore: wake times of
+        the rolled-back timeline must not fire; restored stages re-arm).
+        Periodic jobs (triggers, time rate limiters) self-re-arm only on
+        fire, so their entries are kept. Live-mode one-shot timers are
+        left to fire — an early sweep at wall time is harmless."""
+        with self._lock:
+            kept = [e for e in self._heap
+                    if isinstance(getattr(e[2], "__self__", None),
+                                  _PeriodicJob)]
+            heapq.heapify(kept)
+            self._heap = kept
+            self._scheduled = {(id(t), ts): True for ts, _seq, t in kept}
+
     def shutdown(self):
         with self._lock:
             self._stopped = True

@@ -79,6 +79,11 @@ class SnapshotService:
             "queries": queries,
             "windows": windows,
             "partitions": [p.keyspace.snapshot() for p in rt.partition_contexts],
+            # playback event clock: restoring mid-trace must resume event
+            # time, or re-armed timers land at WALL-clock timestamps and
+            # held windows never expire (reference persists via the
+            # element snapshot map; the clock travels with it)
+            "clock": rt.app_context.timestamp_generator._last_event_ts,
         }
 
     def full_snapshot(self) -> bytes:
@@ -175,6 +180,14 @@ class SnapshotService:
         dictionary._to_str = list(strings)
         dictionary._to_id = {s: i for i, s in enumerate(strings)}
 
+        # resume the event clock: re-armed timers and window deadlines
+        # must anchor to restored EVENT time, not wall time. Forced (not
+        # monotone) — restoring an EARLIER revision in-place rolls the
+        # clock back with the state (reference restoreRevision replay)
+        clock = obj.get("clock", -1)
+        if clock is not None and clock >= 0:
+            rt.app_context.timestamp_generator.reset_timestamp(int(clock))
+
         for snap, pctx in zip(obj["partitions"], rt.partition_contexts):
             pctx.keyspace.restore(snap)
 
@@ -237,6 +250,9 @@ class SnapshotService:
         scheduler = rt.app_context.scheduler
         if scheduler is None:
             return
+        # timers of the pre-restore timeline are void (esp. on rollback,
+        # where they'd sit in the FUTURE of the restored clock)
+        scheduler.clear_pending()
         now = int(rt.app_context.timestamp_generator.current_time())
         for q in rt.query_runtimes.values():
             if getattr(q, "_state", None) is None:
