@@ -35,6 +35,10 @@ class OutputRateLimiter:
     def stop(self):
         pass
 
+    def reset(self):
+        """Discard buffered/counted state (snapshot restore: pending
+        outputs of the rolled-back timeline must not flush)."""
+
 
 class PassThroughRateLimiter(OutputRateLimiter):
     """``PassThroughOutputRateLimiter`` — no limiting."""
@@ -54,6 +58,10 @@ class EventRateLimiter(OutputRateLimiter):
         self.kind = kind
         self._counter = 0
         self._pending: List[Event] = []
+
+    def reset(self):
+        self._counter = 0
+        self._pending = []
 
     def process(self, events: List[Event]):
         out: List[Event] = []
@@ -87,6 +95,10 @@ class TimeRateLimiter(OutputRateLimiter):
         self._sent_first = False
         self._scheduler = None
         self._job = None
+
+    def reset(self):
+        self._pending = []
+        self._sent_first = False
 
     def start(self, scheduler=None):
         self._scheduler = scheduler
@@ -132,6 +144,11 @@ class GroupEventRateLimiter(OutputRateLimiter):
         self._first_seen: set = set()
         self._last: dict = {}
 
+    def reset(self):
+        self._counter = 0
+        self._first_seen.clear()
+        self._last.clear()
+
     def process(self, events: List[Event]):
         out: List[Event] = []
         for ev in events:
@@ -166,6 +183,10 @@ class GroupTimeRateLimiter(OutputRateLimiter):
         self._last: dict = {}
         self._scheduler = None
         self._job = None
+
+    def reset(self):
+        self._first_seen.clear()
+        self._last.clear()
 
     def start(self, scheduler=None):
         self._scheduler = scheduler
@@ -233,6 +254,11 @@ class PartitionedRateLimiter(OutputRateLimiter):
     def stop(self):
         for lim in self._per_key.values():
             lim.stop()
+
+    def reset(self):
+        for lim in self._per_key.values():
+            lim.stop()
+        self._per_key.clear()
 
     def reset_keys(self, ids):
         """Drop retired partition keys' limiter instances (@purge) so a

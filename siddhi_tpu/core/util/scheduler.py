@@ -31,6 +31,7 @@ class Scheduler:
         self._counter = itertools.count()
         self._scheduled: Dict[Tuple[int, int], bool] = {}
         self._live_timers: List[threading.Timer] = []
+        self._periodic: List["_PeriodicJob"] = []
         self._stopped = False
         if app_context.playback:
             app_context.timestamp_generator.add_time_change_listener(self._on_time_change)
@@ -77,6 +78,8 @@ class Scheduler:
         """Recurring tick every interval (used by time-based rate limiters
         and periodic triggers)."""
         job = _PeriodicJob(self, interval_ms, callback)
+        with self._lock:
+            self._periodic.append(job)
         job.arm()
         return job
 
@@ -84,18 +87,20 @@ class Scheduler:
         job.cancelled = True
 
     def clear_pending(self):
-        """Drop pending ONE-SHOT timers (snapshot restore: wake times of
-        the rolled-back timeline must not fire; restored stages re-arm).
-        Periodic jobs (triggers, time rate limiters) self-re-arm only on
-        fire, so their entries are kept. Live-mode one-shot timers are
-        left to fire — an early sweep at wall time is harmless."""
+        """Drop every pending timer of the abandoned timeline (snapshot
+        restore): one-shots are re-requested by the restored stages, and
+        periodic jobs (triggers, time rate limiters) are re-armed HERE at
+        the restored clock — after a rollback their old heap entries
+        would sit in the future of the replayed window and never fire."""
         with self._lock:
-            kept = [e for e in self._heap
-                    if isinstance(getattr(e[2], "__self__", None),
-                                  _PeriodicJob)]
-            heapq.heapify(kept)
-            self._heap = kept
-            self._scheduled = {(id(t), ts): True for ts, _seq, t in kept}
+            self._heap.clear()
+            self._scheduled.clear()
+            for t in self._live_timers:
+                t.cancel()
+            self._live_timers.clear()
+            jobs = [j for j in self._periodic if not j.cancelled]
+        for j in jobs:
+            j.arm()
 
     def shutdown(self):
         with self._lock:

@@ -198,6 +198,10 @@ class SnapshotService:
             with q._lock:
                 q._deferred = []   # pre-restore outputs belong to the
                 #                    rolled-back timeline — discard
+                if q.rate_limiter is not None:
+                    # likewise: buffered/counted limiter state would flush
+                    # phantom pre-restore events after the rollback
+                    q.rate_limiter.reset()
                 q.selector_plan.num_keys = qsnap["sel_keys"]
                 q._win_keys = qsnap["win_keys"]
                 q._state = _to_device(qsnap["state"]) if qsnap["state"] is not None else None

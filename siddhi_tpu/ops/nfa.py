@@ -195,6 +195,20 @@ class NFAPlan:
         return len(self.steps) - 1
 
     @property
+    def eager_tail_start(self) -> int:
+        """First index t such that steps t..last are ALL min-0 counts: a
+        chain resting at/after t is already complete and emits eagerly
+        (reference processMinCountReached fires at min 0 on addState —
+        SequenceTestCase.testQuery3 `every e1, e2*` emits per e1)."""
+        t = len(self.steps)
+        for st in reversed(self.steps):
+            if st.kind == "count" and st.min_count == 0:
+                t = st.index
+            else:
+                break
+        return t
+
+    @property
     def has_absent(self) -> bool:
         return any(
             st.kind == "absent" or any(s.absent for s in st.sides)
@@ -647,7 +661,12 @@ class NFAStage:
         for g in self.scope_cols:
             state[g] = jnp.zeros((K, S), jnp.int64)
         for name, dt in self.cap_cols.items():
-            state[name] = jnp.zeros((K, S), dt)
+            # '?' mask columns start TRUE: an uncaptured reference (e.g.
+            # e1[0].price before anything collected) is NULL, and null
+            # comparisons are false (reference StateEvent returns null
+            # for absent events; CompareConditionExecutor null guards)
+            state[name] = (jnp.ones((K, S), dt) if name.endswith("?")
+                           else jnp.zeros((K, S), dt))
         return state
 
     # ............................................ static eligibility chains
@@ -1001,6 +1020,15 @@ class NFAStage:
                     ev[a.name] = cols[a.name][:, None]
                     ev[a.name + "?"] = cols[a.name + "?"][:, None]
             ev[TS_KEY] = ts2d
+            # fresh-start eval dict: capture references are NULL (a fresh
+            # chain has captured nothing — a freed slot's stale values
+            # must not leak into fresh-start conditions)
+            ev_fresh = dict(ev)
+            for n in cap_names:
+                if n.endswith("?"):
+                    ev_fresh[n] = jnp.ones((B, 1), ev[n].dtype)
+                else:
+                    ev_fresh[n] = jnp.zeros((B, 1), ev[n].dtype)
 
             # ---- phase 1: match masks against pre-event state; the
             # furthest-advanced op wins a slot (no per-event forking)
@@ -1063,7 +1091,14 @@ class NFAStage:
                 at_masks.append(at)
                 adv_masks.append(adv)
                 adv_fork_masks.append(fork_srcs)
-                win = jnp.where(at | adv | fork_all, oi, win)
+                claim = at | adv | fork_all
+                if oi > 0 and ops[oi - 1][0] is st:
+                    # sides of one logical step: the FIRST side wins when
+                    # an event matches both (reference LogicalPreState
+                    # processes side 1's executor first — SequenceTestCase
+                    # testQuery8 captures e2, not e3)
+                    claim = claim & (win != oi - 1)
+                win = jnp.where(claim, oi, win)
 
             matched = win >= 0
 
@@ -1196,6 +1231,10 @@ class NFAStage:
                         tmp = self._enter(tmp, eff, j + 1, ts2d)
                         ST2, BT2, VB2 = tmp["ST"], tmp["BT"], tmp["VB"]
                         ADL2_, AD22_, CD2 = tmp["ADL"], tmp["AD2"], tmp["CD"]
+                        if j + 1 >= plan.eager_tail_start:
+                            # the rest of the chain is all min-0 counts:
+                            # already complete — emit now, keep absorbing
+                            emit2 = emit2 | eff
                 else:  # and / or
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
@@ -1321,8 +1360,9 @@ class NFAStage:
                 pref, prefi = f"c{scap.cid}__", f"c{scap.cid}i"
                 for n in cap_names:
                     if n == cnt_col or n.startswith(pref) or n.startswith(prefi):
-                        CP2[n] = jnp.where(fm, jnp.zeros((), CP2[n].dtype),
-                                           CP2[n])
+                        clear = (jnp.ones((), CP2[n].dtype) if n.endswith("?")
+                                 else jnp.zeros((), CP2[n].dtype))
+                        CP2[n] = jnp.where(fm, clear, CP2[n])
                 for g, (a, b, t) in enumerate(plan.scopes):
                     if a == src_st.index and not plan.steps[a].waitish:
                         CD2 = jnp.where(fm, CD2 & ~plan.scope_bit(g), CD2)
@@ -1353,7 +1393,9 @@ class NFAStage:
                 j = st.index
                 if not self._fresh_ok(j):
                     continue
-                f = m & every_ok & conds[oi][:, 0]
+                fcond = (side.cond(ev_fresh, ctx)[:, 0]
+                         if side.cond is not None else jnp.ones((B,), bool))
+                f = m & every_ok & fcond
                 if in_head_group is not None and j <= head_gend:
                     f = f & ~in_head_group
                 if st.kind == "count":
@@ -1378,6 +1420,13 @@ class NFAStage:
                         fresh_reqs.append((f, j, 0, side))
                     else:
                         fresh_reqs.append((f, j + 1, 0, side))   # rest past j
+                        if j + 1 >= plan.eager_tail_start:
+                            # everything after j is a min-0 count: this
+                            # fresh chain is already complete — emit now
+                            # AND park the slot to keep absorbing
+                            direct = direct | f
+                            direct_op = jnp.where(f & (direct_op < 0), oi,
+                                                  direct_op)
                 else:  # logical
                     full0 = st.kind == "or"
                     if full0 and j == L:
@@ -1413,10 +1462,12 @@ class NFAStage:
                         True)[:, :S]
                     A2 = A2 | onehot
                     T0 = jnp.where(onehot, ts2d, T0)
-                    # zero the new slot's captures, then capture the event
+                    # clear the new slot's captures (masks to NULL),
+                    # then capture the event
                     for n in cap_names:
-                        CP2[n] = jnp.where(onehot, jnp.zeros((), CP2[n].dtype),
-                                           CP2[n])
+                        clear = (jnp.ones((), CP2[n].dtype) if n.endswith("?")
+                                 else jnp.zeros((), CP2[n].dtype))
+                        CP2[n] = jnp.where(onehot, clear, CP2[n])
                     CD2 = jnp.where(onehot, 0, CD2)
                     tmp = {"ST": ST2, "BT": BT2, "VB": VB2,
                            "ADL": ADL2_, "AD2": AD22_, "CD": CD2, "SC": SC2}

@@ -1,0 +1,147 @@
+"""Reference sequence-corpus differential: scenarios ported verbatim from
+``query/sequence/SequenceTestCase.java`` — Kleene ``*``/``+``/``?``
+quantifiers, or-joined steps, and multi-stream chains, with the exact
+inputs and expected outputs."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutputStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+TWO = """@app:playback
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+def _rows(c):
+    return [tuple(round(v, 4) if isinstance(v, float) else v
+                  for v in e.data) for e in c.events]
+
+
+def test_seq1_basic_two_step():
+    # SequenceTestCase.testQuery1: ',' sequence, one match, no re-arm
+    m, rt, c = build(TWO + """
+        from e1=Stream1[price>20], e2=Stream2[price>e1.price]
+        select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(1100, ["IBM", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "IBM")]
+
+
+def test_seq3_trailing_star_completes_eagerly():
+    # testQuery3: `every e1, e2*` — a trailing min-0 Kleene star is
+    # already satisfied at e1, so each e1 match EMITS immediately with
+    # an empty collection (reference processMinCountReached at min 0)
+    m, rt, c = build(TWO + """
+        from every e1=Stream1[price>20], e2=Stream2[price>e1.price]*
+        select e1.symbol as s1, e2[0].symbol as s2, e2[1].symbol as s3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s1.send(1100, ["IBM", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", None, None), ("IBM", None, None)]
+
+
+def test_seq5_leading_star_collects_then_reference():
+    # testQuery5: `every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]`
+    m, rt, c = build(TWO + """
+        from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+        select e1[0].price as p1, e1[1].price as p2, e2.price as p3
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 59.6, 100])
+    s2.send(1100, ["WSO2", 55.6, 100])
+    s2.send(1200, ["IBM", 55.7, 100])
+    s1.send(1300, ["WSO2", 57.6, 100])
+    m.shutdown()
+    assert _rows(c) == [(55.6, 55.7, 57.6)]
+
+
+def test_seq7_optional_question_mark():
+    # testQuery7: `every e1=Stream2[price>20]?, e2=Stream1[price>e1[0].price]`
+    m, rt, c = build(TWO + """
+        from every e1=Stream2[price>20]?, e2=Stream1[price>e1[0].price]
+        select e1[0].price as p1, e2.price as p3 insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 59.6, 100])
+    s2.send(1100, ["WSO2", 55.6, 100])
+    s2.send(1200, ["IBM", 55.7, 100])
+    s1.send(1300, ["WSO2", 57.6, 100])
+    m.shutdown()
+    assert _rows(c) == [(55.7, 57.6)]
+
+
+def test_seq8_or_joined_second_step():
+    # testQuery8: `every e1, e2[...] or e3[symbol=='IBM']` — two matches
+    m, rt, c = build(TWO + """
+        from every e1=Stream2[price>20],
+             e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM']
+        select e1.price as p1, e2.price as p2, e3.price as p3
+        insert into OutputStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(1000, ["WSO2", 59.6, 100])
+    s2.send(1100, ["WSO2", 55.6, 100])
+    s2.send(1200, ["IBM", 55.7, 100])
+    s2.send(1300, ["WSO2", 57.6, 100])
+    m.shutdown()
+    got = _rows(c)
+    assert len(got) == 2
+    assert (55.6, 55.7, None) in got
+    assert (55.7, 57.6, None) in got
+
+
+def test_seq10_plus_requires_one():
+    # testQuery10: `every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price]`
+    m, rt, c = build(TWO + """
+        from every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price]
+        select e1[0].price as p1, e1[1].price as p2, e2.price as p3
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 59.6, 100])
+    s2.send(1100, ["WSO2", 55.6, 100])
+    s1.send(1200, ["WSO2", 57.6, 100])
+    m.shutdown()
+    assert _rows(c) == [(55.6, None, 57.6)]
+
+
+def test_seq13_mid_star_between_filters():
+    # testQuery13 (one-stream form): e1[hi], e2[low]*, e3[vol<=70]
+    m, rt, c = build("""@app:playback
+        define stream StockStream (symbol string, price float, volume int);
+        from every e1=StockStream[ price >= 50 and volume > 100 ],
+             e2=StockStream[price <= 40]*,
+             e3=StockStream[volume <= 70]
+        select e1.symbol as s1, e2[0].symbol as s2, e3.symbol as s3
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("StockStream")
+    h.send(1000, ["IBM", 75.6, 105])
+    h.send(1100, ["GOOG", 21.0, 81])
+    h.send(1200, ["WSO2", 176.6, 65])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "GOOG", "WSO2")]
