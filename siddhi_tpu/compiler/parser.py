@@ -654,8 +654,9 @@ class Parser:
                 self.error(
                     "absent pattern requires 'for <time>' or an and/or pairing")
             return first
-        # count / regex quantifiers
-        if t.is_op("<"):
+        # count / regex quantifiers ('<:' is the tokenizer-fused max-only
+        # form, e.g. `<:5>`)
+        if t.is_op("<") or t.is_op("<:"):
             return self.parse_count_suffix(first)
         if t.is_op("+"):
             self.next()
@@ -677,6 +678,13 @@ class Parser:
             self.expect_op(">")
             return el
         self.expect_op("<")
+        if self.accept_op(":"):
+            # whitespace-separated max-only form `< :5>` (the ANTLR
+            # grammar is whitespace-insensitive between '<' and ':')
+            el.min_count = CountStateElement.ANY
+            el.max_count = self.next().value
+            self.expect_op(">")
+            return el
         el.min_count = self.next().value
         if self.accept_op(":>"):
             # ':>' fused by the tokenizer — the closing '>' is already consumed

@@ -57,12 +57,16 @@ Semantics reproduced (reference file:line):
   ``event/state/StateEvent.java:152-156``); ``e1[i].price`` reads
   occurrence i (null when fewer were captured).
 - Logical ``and``/``or`` match sides in any order
-  (``LogicalPreStateProcessor``).
+  (``LogicalPreStateProcessor``); when ONE event matches both sides,
+  side 1 captures (executor order — SequenceTestCase.testQuery8).
+- An event matching both a count's absorb and the next step's advance
+  takes the ADVANCE ("furthest-advanced transition wins") — validated
+  against the reference corpus (CountPatternTestCase testQuery10-12
+  expect exactly this: one match with the ambiguous event advanced, no
+  absorb fork). ``e[last]``/``e[last-k]`` indexing is supported.
 
-Known gaps (reported as CompileError): `e[last]` indexing, absent states
-inside SEQUENCE queries (the reference forbids them too), an event forking
-one slot down two non-sticky paths at once (the furthest-advanced
-transition wins here).
+Known gaps (reported as CompileError): absent states inside SEQUENCE
+queries (the reference forbids them too).
 """
 
 from __future__ import annotations

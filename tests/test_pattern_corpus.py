@@ -273,6 +273,50 @@ def test_count_unbounded_min_login_pipeline():
     assert got == [("id_1", "hans"), ("id_8", "werner"), ("id_17", "hans")]
 
 
+EVENT_STREAM = ("@app:playback define stream EventStream "
+                "(symbol string, price float, volume int);\n")
+
+
+def test_count_q10_ambiguous_event_advances_not_absorbs():
+    # testQuery10/11: GOOG matches BOTH the e2 count absorb and e3 —
+    # the reference takes the ADVANCE (the dense-slot "furthest-advanced
+    # transition wins" policy is reference-faithful here): one match with
+    # an EMPTY e2, and no second match from an absorb fork
+    m, rt, c = build(EVENT_STREAM + """
+        from e1 = EventStream[price >= 50 and volume > 100]
+          -> e2 = EventStream[price <= 40] <:5>
+          -> e3 = EventStream[volume <= 70]
+        select e1.symbol as s1, e2[0].symbol as s2, e3.symbol as s3
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("EventStream")
+    t = 1000
+    h.send(t, ["IBM", 75.6, 105]); t += 100
+    h.send(t, ["GOOG", 21.0, 61]); t += 100   # matches e2 AND e3
+    h.send(t, ["WSO2", 21.0, 61]); t += 100
+    m.shutdown()
+    assert _rows(c) == [("IBM", None, "GOOG")]
+
+
+def test_count_q12_last_indexing():
+    # testQuery12: e2[last] reads the final collected occurrence
+    m, rt, c = build(EVENT_STREAM + """
+        from e1 = EventStream[price >= 50 and volume > 100]
+          -> e2 = EventStream[price <= 40] <:5>
+          -> e3 = EventStream[volume <= 70]
+        select e1.symbol as s1, e2[last].symbol as s2, e3.symbol as s3
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("EventStream")
+    t = 1000
+    h.send(t, ["IBM", 75.6, 105]); t += 100
+    h.send(t, ["GOOG", 21.0, 91]); t += 100   # absorbed (vol 91 > 70)
+    h.send(t, ["FB", 21.0, 81]); t += 100     # absorbed
+    h.send(t, ["WSO2", 21.0, 61]); t += 100   # advances e3
+    m.shutdown()
+    assert _rows(c) == [("IBM", "FB", "WSO2")]
+
+
 # --------------------------------------------------- EveryPatternTestCase
 
 
