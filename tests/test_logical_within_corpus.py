@@ -1,0 +1,164 @@
+"""Reference Logical/Within pattern corpus — scenarios ported verbatim
+from ``query/pattern/LogicalPatternTestCase.java`` (or/and tails and
+heads, three-stream logical joins) and ``WithinPatternTestCase.java``
+(grouped every chains under `within`, sleeps -> playback clock jumps)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutputStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+TWO = """@app:playback
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+def _rows(c):
+    return [tuple(round(v, 4) if isinstance(v, float) else v
+                  for v in e.data) for e in c.events]
+
+
+def test_logical_q1_or_tail_present_side():
+    # LogicalPatternTestCase.testQuery1
+    m, rt, c = build(TWO + """
+        from e1=Stream1[price > 20]
+          -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+        select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(1100, ["GOOG", 59.6, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "GOOG")]
+
+
+def test_logical_q4_and_tail_completes_on_both():
+    # testQuery4: e2 and e3 — sides fill in any order, emit when both do
+    m, rt, c = build(TWO + """
+        from e1=Stream1[price > 20]
+          -> e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol]
+        select e1.symbol as s1, e2.price as p2, e3.price as p3
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(1100, ["GOOG", 72.7, 100])
+    s2.send(1200, ["IBM", 4.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", 72.7, 4.7)]
+
+
+def test_logical_q7_and_head_then_tail():
+    # testQuery7: the AND is the HEAD state
+    m, rt, c = build(TWO + """
+        from e1=Stream1[price > 20] and e2=Stream2[price > 30]
+          -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as s1, e2.price as p2, e3.price as p3
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(1100, ["GOOG", 72.7, 100])
+    s2.send(1200, ["IBM", 4.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", 72.7, 4.7)]
+
+
+def test_logical_q8_or_head_unmatched_side_null():
+    # testQuery8: OR head completes on e1 alone; e2 stays null
+    m, rt, c = build(TWO + """
+        from e1=Stream1[price > 20] or e2=Stream2[price > 30]
+          -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as s1, e2.price as p2, e3.price as p3
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    s1.send(1000, ["WSO2", 55.6, 100])
+    s2.send(1100, ["GOOG", 72.7, 100])
+    s2.send(1200, ["IBM", 4.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", None, 4.7)]
+
+
+def test_logical_q13_three_stream_and_tail_two_chains():
+    # testQuery13: every e1 -> e2=S2 and e3=S3 over THREE streams; one
+    # e2/e3 pair closes BOTH pending chains
+    m, rt, c = build("""@app:playback
+        define stream Stream1 (symbol string, price float, volume int);
+        define stream Stream2 (symbol string, price float, volume int);
+        define stream Stream3 (symbol string, price float, volume int);
+        from every e1=Stream1[price > 20]
+          -> e2=Stream2['IBM' == symbol] and e3=Stream3['WSO2' == symbol]
+        select e1.price as p1, e2.price as p2, e3.price as p3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s3 = rt.get_input_handler("Stream3")
+    s1.send(1000, ["IBM", 25.5, 100])
+    s1.send(1100, ["IBM", 59.65, 100])
+    s2.send(1200, ["IBM", 45.5, 100])
+    s3.send(1300, ["WSO2", 46.56, 100])
+    m.shutdown()
+    got = sorted(_rows(c))
+    assert got == sorted([(25.5, 45.5, 46.56), (59.65, 45.5, 46.56)])
+
+
+ONE = "@app:playback define stream Stream1 (symbol string, price float, volume int);\n"
+
+
+def test_within_q4_grouped_every_pair_expiry():
+    # WithinPatternTestCase.testQuery4: every (e1 -> e2[same symbol])
+    # within 5 sec; a 6-second gap expires the first chain
+    m, rt, c = build(ONE + """
+        from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol])
+        within 5 sec
+        select e1.symbol as s1, e1.volume as v1, e2.symbol as s2,
+               e2.volume as v2
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("Stream1")
+    t = 1000
+    h.send(t, ["WSO2", 55.6, 100])
+    t += 6000                              # Thread.sleep(6000): expires
+    h.send(t, ["WSO2", 55.7, 150]); t += 500
+    h.send(t, ["WSO2", 58.7, 200]); t += 10
+    h.send(t, ["WSO2", 58.7, 250]); t += 500
+    m.shutdown()
+    assert _rows(c) == [("WSO2", 150, "WSO2", 200)]
+
+
+def test_within_q5_grouped_every_triples_non_overlapping():
+    # testQuery5: every (e1 -> e2 -> e3) within 5 sec over one stream —
+    # sequential non-overlapping triples
+    m, rt, c = build(ONE + """
+        from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]
+          -> e3=Stream1[symbol == e2.symbol]) within 5 sec
+        select e1.volume as v1, e2.volume as v2, e3.volume as v3
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("Stream1")
+    t = 1000
+    for v in (100, 150, 200, 210):
+        h.send(t, ["WSO2", 55.6, v]); t += 10
+    t += 500
+    for v in (250, 260, 270):
+        h.send(t, ["WSO2", 58.7, v]); t += 10
+    m.shutdown()
+    assert _rows(c) == [(100, 150, 200), (210, 250, 260)]
