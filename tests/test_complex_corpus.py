@@ -1,0 +1,129 @@
+"""Reference ComplexPatternTestCase corpus — composed shapes: or-groups
+under every with a continuation, every-group with a mid count, unbounded
+min-2 counts with e[last], and a plain chain where a non-count step
+follows a capture-referencing filter."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutputStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+TWO = """@app:playback
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+"""
+ONE = ("@app:playback define stream Stream1 "
+       "(symbol string, price float, volume int);\n")
+
+
+def _rows(c):
+    return [tuple(round(v, 4) if isinstance(v, float) else v
+                  for v in e.data) for e in c.events]
+
+
+def test_complex_q1_or_group_with_continuation():
+    # ComplexPatternTestCase.testQuery1: every (e1 -> e2 or e3) -> e4
+    m, rt, c = build(TWO + """
+        from every ( e1=Stream1[price > 20]
+          -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol])
+          -> e4=Stream2[price > e1.price]
+        select e1.price as p1, e2.price as p2, e3.price as p3,
+               e4.price as p4
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    s1.send(t, ["WSO2", 55.6, 100]); t += 100
+    s2.send(t, ["WSO2", 55.7, 100]); t += 100
+    s2.send(t, ["GOOG", 55.0, 100]); t += 100
+    s1.send(t, ["GOOG", 54.0, 100]); t += 100
+    s2.send(t, ["IBM", 57.7, 100]); t += 100
+    s2.send(t, ["IBM", 59.7, 100]); t += 100
+    m.shutdown()
+    got = _rows(c)
+    assert len(got) == 2
+    assert (55.6, 55.7, None, 57.7) in got
+    assert (54.0, 57.7, None, 59.7) in got
+
+
+def test_complex_q2_every_group_with_mid_count():
+    # testQuery2: every (e1 -> e2<1:2>) -> e3[price > e1.price]
+    m, rt, c = build(ONE + """
+        from every ( e1=Stream1[price > 20] -> e2=Stream1[price > 20]<1:2>)
+          -> e3=Stream1[price > e1.price]
+        select e1.price as p1, e2[0].price as p20, e2[1].price as p21,
+               e3.price as p3
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("Stream1")
+    t = 1000
+    for sym, p in [("WSO2", 55.6), ("GOOG", 54.0), ("WSO2", 53.6),
+                   ("GOOG", 57.0)]:
+        h.send(t, [sym, p, 100]); t += 100
+    m.shutdown()
+    assert _rows(c) == [(55.6, 54.0, 53.6, 57.0)]
+
+
+def test_complex_q3_min2_unbounded_count_with_last():
+    # testQuery3: every e1 -> e2<2:> -> e3, three chained matches with
+    # e2[last] reading the final collected occurrence
+    m, rt, c = build(ONE + """
+        from every e1 = Stream1[ price >= 50 and volume > 100 ]
+          -> e2 = Stream1[price <= 40] <2:>
+          -> e3 = Stream1[volume <= 70]
+        select e1.symbol as s1, e2[last].symbol as s2, e3.symbol as s3
+        insert into OutputStream;
+    """)
+    h = rt.get_input_handler("Stream1")
+    t = 1000
+    for sym, p, v in [("IBM", 75.6, 105), ("GOOG", 39.8, 91), ("FB", 35.0, 81),
+                      ("WSO2", 21.0, 61), ("ADP", 50.0, 101),
+                      ("GOOG", 41.2, 90), ("FB", 40.0, 100),
+                      ("WSO2", 33.6, 85), ("AMZN", 23.5, 55),
+                      ("WSO2", 51.7, 180), ("TXN", 34.0, 61),
+                      ("QQQ", 24.6, 45), ("CSCO", 181.6, 40),
+                      ("WSO2", 53.7, 200)]:
+        h.send(t, [sym, p, v]); t += 100
+    m.shutdown()
+    assert _rows(c) == [("IBM", "FB", "WSO2"),
+                        ("ADP", "WSO2", "AMZN"),
+                        ("WSO2", "QQQ", "CSCO")]
+
+
+def test_complex_q5_non_every_capture_ref_chain():
+    # testQuery5 (non-every): e1 -> e2[e1.symbol != 'AMBA'] -> e3, one
+    # match only, no re-arm for the plain stream head
+    m, rt, c = build(TWO + """
+        from e1 = Stream1[ price >= 50 and volume > 100 ]
+          -> e2 = Stream2[e1.symbol != 'AMBA']
+          -> e3 = Stream2[volume <= 70]
+        select e3.symbol as s1, e2[0].symbol as s2, e3.volume as v3
+        insert into OutputStream;
+    """)
+    s1, s2 = rt.get_input_handler("Stream1"), rt.get_input_handler("Stream2")
+    t = 1000
+    feed = [(s1, ["IBM", 75.6, 105]), (s2, ["GOOG", 21.0, 81]),
+            (s2, ["WSO2", 176.6, 65]), (s1, ["BIRT", 21.0, 81]),
+            (s1, ["AMBA", 126.6, 165]), (s2, ["DDD", 23.0, 181]),
+            (s2, ["BIRT", 21.0, 86]), (s2, ["BIRT", 21.0, 82]),
+            (s2, ["WSO2", 176.6, 60]), (s1, ["AMBA", 126.6, 165]),
+            (s2, ["DOX", 16.2, 25])]
+    for h, row in feed:
+        h.send(t, row); t += 100
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "GOOG", 65)]
