@@ -320,6 +320,35 @@ def test_count_q12_last_indexing():
 # --------------------------------------------------- EveryPatternTestCase
 
 
+def test_every_single_state_emits_per_match():
+    # EveryPatternTestCase:488 — `every e1=S[price>20]` alone
+    m, rt, c = build(TWO_STREAMS + """
+        from every e1=Stream1[price>20]
+        select e1.price as p1 insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(1000, ["MSFT", 55.6, 100])
+    s1.send(1100, ["WSO2", 57.6, 100])
+    m.shutdown()
+    assert _rows(c) == [(55.6,), (57.6,)]
+
+
+def test_every_duplicate_ref_id_resolves_first_capture():
+    # EveryPatternTestCase:549 — `every e1=[MSFT] -> e1=[WSO2]` reuses
+    # one reference id; the select's e1 reads the FIRST state's capture
+    # (reference expects the MSFT prices, one per pending chain)
+    m, rt, c = build(TWO_STREAMS + """
+        from every e1=Stream1[symbol == 'MSFT'] -> e1=Stream1[symbol == 'WSO2']
+        select e1.price as p1 insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(1000, ["MSFT", 55.6, 100])
+    s1.send(1100, ["MSFT", 77.6, 100])
+    s1.send(1200, ["WSO2", 57.6, 100])
+    m.shutdown()
+    assert sorted(_rows(c)) == [(55.6,), (77.6,)]
+
+
 def test_every_group_chain_restarts_per_group():
     # EveryPatternTestCase:227 — every (e1 -> e3) -> e2[price > e1.price]
     m, rt, c = build(TWO_STREAMS + """
