@@ -1,0 +1,142 @@
+"""Reference absent-pattern corpus — scenarios ported verbatim from
+``query/pattern/absent/AbsentPatternTestCase.java`` (tail/head/mid
+`not ... for t` shapes with exact feeds; sleeps become playback clock
+jumps, with a final drain event to release pending deadlines)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+THREE = """@app:playback
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+    define stream Stream3 (symbol string, price float, volume int);
+    define stream Tick (x int);
+    from Tick select x insert into TickOut;
+"""
+
+
+def build(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback("OutputStream", c)
+    return m, rt, c
+
+
+def _rows(c):
+    return [tuple(round(v, 4) if isinstance(v, float) else v
+                  for v in e.data) for e in c.events]
+
+
+TAIL_NOT = THREE + """
+    from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+    select e1.symbol as s1 insert into OutputStream;
+"""
+
+
+def test_absent_q1_tail_not_completes_at_deadline():
+    # AbsentPatternTestCase.testQueryAbsent1 (adapted callback): quiet
+    # second after e1 -> match at the deadline
+    m, rt, c = build(TAIL_NOT)
+    rt.get_input_handler("Stream1").send(1000, ["WSO2", 55.6, 100])
+    rt.get_input_handler("Tick").send(3000, [0])   # clock past deadline
+    m.shutdown()
+    assert _rows(c) == [("WSO2",)]
+
+
+def test_absent_q3_tail_not_violated():
+    # testQueryAbsent3: a higher-priced Stream2 event inside the window
+    # kills the wait
+    m, rt, c = build(TAIL_NOT)
+    rt.get_input_handler("Stream1").send(1000, ["WSO2", 55.6, 100])
+    rt.get_input_handler("Stream2").send(1100, ["IBM", 58.7, 100])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q5_head_not_then_stream():
+    # testQueryAbsent5: quiet first second, then e2 -> match
+    m, rt, c = build(THREE + """
+        from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+        select e2.symbol as s1 insert into OutputStream;
+    """)
+    rt.get_input_handler("Tick").send(1000, [0])    # playback clock start
+    rt.get_input_handler("Stream2").send(2200, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM",)]
+
+
+MID_TAIL = THREE + """
+    from e1=Stream1[price>10] -> e2=Stream2[price>20]
+      -> not Stream3[price>30] for 1 sec
+    select e1.symbol as s1, e2.symbol as s2 insert into OutputStream;
+"""
+
+
+def test_absent_q9_chain_then_not_violated():
+    m, rt, c = build(MID_TAIL)
+    rt.get_input_handler("Stream1").send(1000, ["WSO2", 15.6, 100])
+    rt.get_input_handler("Stream2").send(1100, ["IBM", 28.7, 100])
+    rt.get_input_handler("Stream3").send(1200, ["GOOGLE", 55.7, 100])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q10_chain_then_not_nonmatching_event_ok():
+    # testQueryAbsent10: the Stream3 event fails the not-filter -> match
+    m, rt, c = build(MID_TAIL)
+    rt.get_input_handler("Stream1").send(1000, ["WSO2", 15.6, 100])
+    rt.get_input_handler("Stream2").send(1100, ["IBM", 28.7, 100])
+    rt.get_input_handler("Stream3").send(1200, ["GOOGLE", 25.7, 100])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "IBM")]
+
+
+MID_NOT = THREE + """
+    from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+      -> e3=Stream3[price>30]
+    select e1.symbol as s1, e3.symbol as s3 insert into OutputStream;
+"""
+
+
+def test_absent_q12_mid_not_quiet_then_e3():
+    # testQueryAbsent12: quiet second, then e3 -> match
+    m, rt, c = build(MID_NOT)
+    rt.get_input_handler("Stream1").send(1000, ["WSO2", 15.6, 100])
+    rt.get_input_handler("Stream3").send(2200, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "GOOGLE")]
+
+
+def test_absent_q13_mid_not_nonmatching_stream2_ok():
+    # testQueryAbsent13: a Stream2 event FAILING the not-filter does not
+    # violate the wait
+    m, rt, c = build(MID_NOT)
+    rt.get_input_handler("Stream1").send(1000, ["WSO2", 15.6, 100])
+    rt.get_input_handler("Stream2").send(1100, ["IBM", 8.7, 100])
+    rt.get_input_handler("Stream3").send(2300, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "GOOGLE")]
+
+
+def test_absent_q14_mid_not_violated_before_e3():
+    # testQueryAbsent14: a matching Stream2 event inside the window kills
+    # the chain; the later e3 finds nothing
+    m, rt, c = build(MID_NOT)
+    rt.get_input_handler("Stream1").send(1000, ["WSO2", 15.6, 100])
+    rt.get_input_handler("Stream2").send(1100, ["IBM", 28.7, 100])
+    rt.get_input_handler("Stream3").send(1200, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
