@@ -210,6 +210,97 @@ def bench_e2e():
     return eps_str, eps_pre
 
 
+def bench_host_pipeline():
+    """Host-pipeline throughput with the device step STUBBED: the full
+    ingest pump — string columns -> dictionary encode (native strdict.cpp)
+    -> HostBatch -> junction -> group keyer -> step dispatch/defer/flush
+    bookkeeping -> emit — with the jitted device function replaced by a
+    host no-op. Isolates Python/host cost from device compute: on a live
+    TPU the e2e ceiling is min(host_pipeline, device, encode-overlap).
+    Reference counterpart: the whole JVM engine IS this pipeline
+    (StreamJunction.java:156-165 -> ProcessStreamReceiver.java:74-184),
+    measured at ~8.5M eps by tools/baseline_cpp.
+
+    Also measures ingest_csv_eps: the same pump fed by the NATIVE CSV
+    loader (csv_loader.cpp) parsing raw transport bytes, the analog of the
+    reference's source->mapper->event path."""
+    from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
+
+    manager, rt, Counter = _make_e2e_runtime()
+    h = rt.get_input_handler("StockStream")
+    q = rt.query_runtimes["bench"]
+
+    rng = np.random.default_rng(3)
+    B = BATCH
+    sym_strings = np.array([f"S{i}" for i in range(NUM_KEYS)], dtype=object)
+
+    def make_cols(i):
+        ids = rng.integers(0, NUM_KEYS, B, dtype=np.int64)
+        return {
+            "symbol": sym_strings[ids],
+            "price": (rng.random(B) * 100.0).astype(np.float32),
+            "volume": rng.integers(1, 1000, B, dtype=np.int64),
+        }, np.arange(i * B, (i + 1) * B, dtype=np.int64)
+
+    warm_sym = sym_strings[np.arange(B, dtype=np.int64) % NUM_KEYS]
+    h.send_columns({"symbol": warm_sym,
+                    "price": np.ones(B, np.float32),
+                    "volume": np.ones(B, np.int64)},
+                   timestamps=np.zeros(B, np.int64))
+    pre = [make_cols(i + 1) for i in range(4)]
+    h.send_columns(pre[0][0], timestamps=pre[0][1])
+
+    # stub the device step: state passes through untouched, the output is
+    # an empty (all-invalid) packed batch whose __meta__ says
+    # overflow=0/notify=-1/size=0 — every HOST stage still runs for real
+    empty_meta = np.array([0, -1, 0], np.int64)
+
+    def stub_step(state, cols, now):
+        return state, {
+            VALID_KEY: np.zeros(1, bool),
+            TS_KEY: np.zeros(1, np.int64),
+            TYPE_KEY: np.zeros(1, np.int8),
+            "__meta__": empty_meta,
+        }
+
+    q._step = stub_step
+
+    t0 = time.perf_counter()
+    n = 0
+    i = 0
+    while time.perf_counter() - t0 < MEASURE_SECONDS:
+        cols, ts = pre[i % len(pre)]
+        h.send_columns(cols, timestamps=ts)
+        n += B
+        i += 1
+    eps_pipeline = n / (time.perf_counter() - t0)
+
+    # ---- native CSV ingest -> the same stubbed pump
+    from siddhi_tpu.native import CsvLoader
+
+    loader = CsvLoader(rt.stream_definitions["StockStream"],
+                       rt.app_context.string_dictionary)
+    lines = []
+    ids = rng.integers(0, NUM_KEYS, B)
+    prices = rng.random(B) * 100.0
+    vols = rng.integers(1, 1000, B)
+    for j in range(B):
+        lines.append(f"S{ids[j]},{prices[j]:.4f},{vols[j]}")
+    payload = ("\n".join(lines) + "\n").encode()
+    cols0, nrows = loader.parse(payload)
+    h.send_columns(cols0, timestamps=np.arange(nrows, dtype=np.int64))
+
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < MEASURE_SECONDS:
+        cols_j, nrows = loader.parse(payload)
+        h.send_columns(cols_j, timestamps=np.arange(nrows, dtype=np.int64))
+        n += nrows
+    eps_csv = n / (time.perf_counter() - t0)
+    manager.shutdown()
+    return eps_pipeline, eps_csv
+
+
 def bench_nfa_p99():
     """Config #4: `every e1=A -> e2=B[e2.v > e1.v] within 5 sec` over 10k
     partition keys; per-batch latency (ms) through the full host path,
@@ -358,6 +449,8 @@ def main():
         "e2e_events_per_sec": None,            # genuine string ingest
         "e2e_preencoded_events_per_sec": None,  # int ids (no dict encode)
         "e2e_cpu_events_per_sec": None,         # string ingest, CPU backend
+        "host_pipeline_events_per_sec": None,   # device step stubbed
+        "ingest_csv_events_per_sec": None,      # native CSV loader -> pump
         "nfa_p99_ms_per_batch": None,
         "nfa_events_per_sec": None,
         "batch": BATCH,
@@ -409,6 +502,13 @@ def main():
         result["sections_failed"].append("nfa:skipped-wedged-tunnel")
 
     # ---- CPU sections: can't wedge, run even after a tunnel stall
+    out, _ = _run_section_once("host_pipeline_cpu", min(180.0, remaining()))
+    if out is not None:
+        result["host_pipeline_events_per_sec"] = round(out["eps_pipeline"], 1)
+        result["ingest_csv_events_per_sec"] = round(out["eps_csv"], 1)
+    else:
+        result["sections_failed"].append("host_pipeline")
+    emit()
     out, _ = _run_section_once("e2e_cpu", min(240.0, remaining()))
     if out is not None:
         result["e2e_cpu_events_per_sec"] = round(out["eps_str"], 1)
@@ -449,6 +549,10 @@ if __name__ == "__main__":
         elif section == "e2e":
             eps_str, eps_pre = bench_e2e()
             print(json.dumps({"eps_str": eps_str, "eps_pre": eps_pre}))
+        elif section == "host_pipeline":
+            eps_pipeline, eps_csv = bench_host_pipeline()
+            print(json.dumps({"eps_pipeline": eps_pipeline,
+                              "eps_csv": eps_csv}))
         elif section == "nfa":
             p99, eps = bench_nfa_p99()
             print(json.dumps({"p99_ms": p99, "eps": eps}))

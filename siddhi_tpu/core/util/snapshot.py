@@ -177,8 +177,7 @@ class SnapshotService:
             raise ValueError(
                 "snapshot belongs to a different app (string dictionaries diverge)"
             )
-        dictionary._to_str = list(strings)
-        dictionary._to_id = {s: i for i, s in enumerate(strings)}
+        dictionary.restore_strings(strings)
 
         # resume the event clock: re-armed timers and window deadlines
         # must anchor to restored EVENT time, not wall time. Forced (not

@@ -29,6 +29,10 @@ _SRC = os.path.join(_HERE, "csv_loader.cpp")
 _SO = os.path.join(_HERE, "_csv_loader.so")
 _LOCK = threading.Lock()
 _LIB = None
+_STRDICT_SRC = os.path.join(_HERE, "strdict.cpp")
+_STRDICT_SO = os.path.join(_HERE, "_strdict.so")
+_STRDICT_LIB = None
+_STRDICT_FAILED = False
 
 _TYPE_CODES = {
     AttrType.INT: 0, AttrType.LONG: 0,
@@ -76,6 +80,45 @@ def _lib():
         ]
         _LIB = lib
         return lib
+
+
+def strdict_lib():
+    """The native string-dictionary encoder (strdict.cpp), or None when it
+    can't build — callers fall back to the pure-Python path. Loaded with
+    PyDLL: strdict_encode walks PyObject* arrays and must hold the GIL."""
+    global _STRDICT_LIB, _STRDICT_FAILED
+    with _LOCK:
+        if _STRDICT_LIB is not None or _STRDICT_FAILED:
+            return _STRDICT_LIB
+        try:
+            import sysconfig
+
+            if (not os.path.exists(_STRDICT_SO)
+                    or os.path.getmtime(_STRDICT_SO)
+                    < os.path.getmtime(_STRDICT_SRC)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-I", sysconfig.get_paths()["include"],
+                     _STRDICT_SRC, "-o", _STRDICT_SO],
+                    check=True, capture_output=True)
+            lib = ctypes.PyDLL(_STRDICT_SO)
+            lib.strdict_new.restype = ctypes.c_void_p
+            lib.strdict_free.argtypes = [ctypes.c_void_p]
+            lib.strdict_clear.argtypes = [ctypes.c_void_p]
+            lib.strdict_count.restype = ctypes.c_int64
+            lib.strdict_count.argtypes = [ctypes.c_void_p]
+            lib.strdict_insert.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int64]
+            lib.strdict_encode.restype = ctypes.c_int64
+            lib.strdict_encode.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.c_int64]
+            _STRDICT_LIB = lib
+        except Exception:
+            _STRDICT_FAILED = True
+        return _STRDICT_LIB
 
 
 class CsvLoader:

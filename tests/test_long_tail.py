@@ -313,3 +313,48 @@ def test_uuid_function_unique_per_row():
     ids = [e.data[1] for e in c.events]
     assert len(ids) == 2 and ids[0] != ids[1]
     assert all(isinstance(i, str) and len(i) == 36 for i in ids)
+
+
+def test_null_group_key_forms_its_own_group():
+    """A null group-by key is its own group — distinct from every real
+    string (including whichever string holds dict id 0) — matching the
+    reference's String.valueOf(null) -> "null" keying
+    (GroupByKeyGenerator.java:37). Regression: the null placeholder value
+    0 used to alias the group of the first-encoded string."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v long);
+        @info(name = 'q')
+        from S select sym, sum(v) as s group by sym insert into Out;
+    """)
+    cb = Collector()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])          # "a" takes dict id 0
+    h.send([None, 10])        # null key must NOT join "a"'s group
+    h.send(["a", 2])
+    h.send([None, 20])
+    m.shutdown()
+    got = [(e.data[0], e.data[1]) for e in cb.events]
+    assert got == [("a", 1), (None, 10), ("a", 3), (None, 30)], got
+
+
+def test_null_int_group_key_distinct_from_zero():
+    """Group-by on an int attribute: a null value (placeholder 0) must not
+    merge with a genuine 0 key."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (k int, g string, v long);
+        @info(name = 'q')
+        from S select k, g, sum(v) as s group by k, g insert into Out;
+    """)
+    cb = Collector()
+    rt.add_callback("Out", cb)
+    h = rt.get_input_handler("S")
+    h.send([0, "x", 1])
+    h.send([None, "x", 10])
+    h.send([0, "x", 2])
+    h.send([None, "x", 20])
+    m.shutdown()
+    got = [(e.data[0], e.data[2]) for e in cb.events]
+    assert got == [(0, 1), (None, 10), (0, 3), (None, 30)], got
