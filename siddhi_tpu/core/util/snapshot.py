@@ -27,7 +27,10 @@ import numpy as np
 # 'host_window'
 # v3: aggregation snapshots carry base_keys (avg gained per-output cnt@
 # bases; positional slot lists would misalign against v2 snapshots)
-FORMAT_VERSION = 3
+# v4: GroupKeyer key tuples gained null-mask elements (general path) and
+# the single-string LUT moved to shifted dict ids — older keyer_map
+# snapshots would silently orphan their aggregate rows
+FORMAT_VERSION = 4
 
 
 def _to_host(tree):

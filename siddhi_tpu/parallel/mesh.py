@@ -129,9 +129,11 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
     st_sh = state_shardings(runtime._state, mesh, num_keys,
                             win_keys=getattr(runtime, "_win_keys", 1))
     state = jax.device_put(runtime._state, st_sh)
+    out_sh = _out_shardings(mesh, st_sh)
     jitted = jax.jit(
         step,
         in_shardings=(st_sh, None, None),
+        out_shardings=out_sh,
         donate_argnums=(0,) if donate else (),
     )
     # hand the runtime the sharded timeline so junction-fed batches
@@ -149,6 +151,19 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
     return jitted, state
 
 
+def _out_shardings(mesh: Mesh, st_sh):
+    """(state', out) output shardings for a sharded query step: state keeps
+    its key-axis sharding; the OUT batch is forced replicated. On one host
+    this is what the host pull does anyway; on a multi-process mesh it is
+    required — ``jax.device_get`` can only read fully-addressable arrays,
+    so a partially-sharded output would strand rows on the other host.
+    ``None`` (let XLA choose) when the mesh is single-process: forcing a
+    replicate there costs a gather with no benefit."""
+    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
+        return None
+    return (st_sh, NamedSharding(mesh, P()))
+
+
 def sharded_jit_for(runtime, fn, n_state_args: int = 1, n_plain_args: int = 2):
     """Jit ``fn(state, *plain)`` with the runtime's recorded mesh shardings
     (used by NFAQueryRuntime for per-stream and timer steps)."""
@@ -158,5 +173,6 @@ def sharded_jit_for(runtime, fn, n_state_args: int = 1, n_plain_args: int = 2):
     return jax.jit(
         fn,
         in_shardings=(st_sh,) + (None,) * n_plain_args,
+        out_shardings=_out_shardings(mesh, st_sh),
         donate_argnums=(0,),
     )

@@ -1,8 +1,12 @@
-"""Multi-host smoke: two REAL `jax.distributed` CPU processes form one
-cluster (`initialize_cluster` + `global_mesh`) and run a sharded query
-step whose output must equal the single-process run — the DCN-facing
-half of the comm backend (reference NCCL/MPI transports ->
-jax.distributed + XLA collectives)."""
+"""Multi-host: two REAL ``jax.distributed`` CPU processes form one cluster
+and run ACTUAL query runtimes — the flagship group-by aggregation and a
+partitioned NFA pattern — with their keyed state sharded over the global
+mesh (``shard_query_step``), through the real host pump
+(``InputHandler.send`` -> junction -> jitted step -> ``StreamCallback``).
+Both processes must produce the single-process runtime's exact outputs.
+This is the DCN-facing half of the comm backend (reference NCCL/MPI
+transports -> jax.distributed + XLA collectives, SURVEY.md §2.13/§5.8).
+"""
 
 import json
 import os
@@ -13,6 +17,11 @@ import textwrap
 
 import pytest
 
+# Runs a SPMD worker: every process feeds IDENTICAL event sequences (the
+# multi-controller contract — replicated jit inputs must agree), state is
+# key-sharded across BOTH processes, outputs are pulled host-side (the
+# sharded step replicates its OUT batch across processes; see
+# parallel/mesh._out_shardings).
 _WORKER = textwrap.dedent("""
     import json
     import os
@@ -43,35 +52,68 @@ _WORKER = textwrap.dedent("""
     assert info["process_count"] == nproc, info
     assert info["global_devices"] == 2 * nproc, info
 
-    # one sharded step over the global mesh: a per-key segment sum of
-    # [K, W] rows sharded on the key axis across BOTH hosts
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.parallel.mesh import shard_query_step
 
-    mesh = global_mesh()
+    class C(StreamCallback):
+        def __init__(self):
+            self.rows = []
 
-    K, W = 8, 4
-    vals_h = (np.arange(K * W, dtype=np.float64).reshape(K, W) + 1.0)
+        def receive(self, events):
+            self.rows.extend([e.timestamp] + list(e.data) for e in events)
 
-    @jax.jit
-    def step(vals):
-        return jnp.sum(vals, axis=1) * 2.0
+    results = {}
 
-    sharding = NamedSharding(mesh, P("keys", None))
-    with mesh:
-        vals = jax.make_array_from_callback(
-            (K, W), sharding, lambda idx: vals_h[idx])
-        out = jax.jit(step, out_shardings=NamedSharding(mesh, P("keys")))(vals)
-        # cross-host collective: a global sum over the sharded axis
-        total = jax.jit(lambda v: jnp.sum(v))(vals)
-    # each process can read only ITS addressable shards of the global
-    # array; the parent reassembles both halves
-    local = [((s.index[0].start or 0), np.asarray(s.data).ravel().tolist())
-             for s in out.addressable_shards]
-    tot = float(np.asarray(total.addressable_shards[0].data))
-    print(json.dumps({"local": local, "total": tot}), flush=True)
+    # ---- flagship: group-by window aggregation, selector state [_, K]
+    # sharded across the 2-process global mesh
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime('''
+        define stream S (symbol string, price double, volume long);
+        @info(name = 'q')
+        from S#window.length(8)
+        select symbol, avg(price) as ap, sum(volume) as tv
+        group by symbol
+        insert into Out;
+    ''')
+    c = C()
+    rt.add_callback("Out", c)
+    shard_query_step(rt.query_runtimes["q"], global_mesh())
+    h = rt.get_input_handler("S")
+    for i in range(96):
+        h.send(1000 + i, [f"K{i % 24}", float(i % 13) + 0.5, int(i)])
+    m.shutdown()
+    results["flagship"] = c.rows
+
+    # ---- partitioned NFA pattern over the same global mesh
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime('''
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        partition with (k of A, k of B)
+        begin
+          @info(name = 'q')
+          from every e1=A -> e2=B[e2.v > e1.v] within 5 sec
+          select e1.v as v1, e2.v as v2
+          insert into Out;
+        end;
+    ''')
+    c2 = C()
+    rt2.add_callback("Out", c2)
+    shard_query_step(rt2.query_runtimes["q"], global_mesh())
+    ha = rt2.get_input_handler("A")
+    hb = rt2.get_input_handler("B")
+    t = 1000
+    for i in range(48):
+        k = f"P{(i * 7) % 16}"
+        va = float((i * 3) % 11)
+        ha.send(t, [k, va])
+        hb.send(t + 1, [k, va + (1.0 if i % 3 else -1.0)])
+        t += 50
+    m2.shutdown()
+    results["nfa"] = c2.rows
+
+    print(json.dumps(results), flush=True)
 """)
 
 
@@ -83,9 +125,62 @@ def _free_port() -> int:
     return p
 
 
-def test_two_process_cluster_matches_single_process():
-    import numpy as np
+def _single_process_expected():
+    """The same two feeds against plain single-process runtimes."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
 
+    class C(StreamCallback):
+        def __init__(self):
+            self.rows = []
+
+        def receive(self, events):
+            self.rows.extend([e.timestamp] + list(e.data) for e in events)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double, volume long);
+        @info(name = 'q')
+        from S#window.length(8)
+        select symbol, avg(price) as ap, sum(volume) as tv
+        group by symbol
+        insert into Out;
+    """)
+    c = C()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    for i in range(96):
+        h.send(1000 + i, [f"K{i % 24}", float(i % 13) + 0.5, int(i)])
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime("""
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        partition with (k of A, k of B)
+        begin
+          @info(name = 'q')
+          from every e1=A -> e2=B[e2.v > e1.v] within 5 sec
+          select e1.v as v1, e2.v as v2
+          insert into Out;
+        end;
+    """)
+    c2 = C()
+    rt2.add_callback("Out", c2)
+    ha = rt2.get_input_handler("A")
+    hb = rt2.get_input_handler("B")
+    t = 1000
+    for i in range(48):
+        k = f"P{(i * 7) % 16}"
+        va = float((i * 3) % 11)
+        ha.send(t, [k, va])
+        hb.send(t + 1, [k, va + (1.0 if i % 3 else -1.0)])
+        t += 50
+    m2.shutdown()
+    return {"flagship": c.rows, "nfa": c2.rows}
+
+
+def test_two_process_cluster_runs_real_queries():
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     env = {k: v for k, v in os.environ.items()
@@ -100,7 +195,7 @@ def test_two_process_cluster_matches_single_process():
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=200)
+            out, err = p.communicate(timeout=400)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -108,14 +203,10 @@ def test_two_process_cluster_matches_single_process():
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out)
 
-    # single-process reference result
-    K, W = 8, 4
-    vals = np.arange(K * W, dtype=np.float64).reshape(K, W) + 1.0
-    expect = (vals.sum(axis=1) * 2.0).tolist()
-    merged = [None] * K
+    expected = _single_process_expected()
+    assert len(expected["flagship"]) == 96
+    assert len(expected["nfa"]) > 0
     for o in outs:
         payload = json.loads(o.strip().splitlines()[-1])
-        assert payload["total"] == float(vals.sum())   # global collective
-        for start, chunk in payload["local"]:
-            merged[start:start + len(chunk)] = chunk
-    assert merged == expect
+        assert payload["flagship"] == expected["flagship"]
+        assert payload["nfa"] == expected["nfa"]
