@@ -157,6 +157,14 @@ class SiddhiAppRuntime:
             tid: create_table(tdef, dictionary, siddhi_context.extensions)
             for tid, tdef in siddhi_app.table_definitions.items()
         }
+        # cache retention clocks wire at BUILD time: a row cached before
+        # start() (lazy start, on-demand reads) must stamp the same
+        # event-aware clock the expirer sweeps with — mixing wall time in
+        # would make @app:playback rows immortal
+        for t in self.tables.values():
+            cache = getattr(t, "cache", None)
+            if cache is not None:
+                cache.now_fn = self.app_context.timestamp_generator.current_time
         self.named_windows: Dict[str, NamedWindowRuntime] = {}
         for wid, wdef in siddhi_app.window_definitions.items():
             w = NamedWindowRuntime(wdef, self.app_context, dictionary)
@@ -573,8 +581,18 @@ class SiddhiAppRuntime:
                     scheduler.schedule_periodic(
                         agg.purge_interval_ms,
                         lambda ts, a=agg: a.purge(ts))
+            # cache-table retention sweeps (reference CacheExpirer: a
+            # periodic task deletes cache rows older than retention.period)
+            for t in self.tables.values():
+                cache = getattr(t, "cache", None)
+                if (cache is not None and cache.retention_ms is not None
+                        and scheduler is not None):
+                    scheduler.schedule_periodic(
+                        cache.purge_interval_ms,
+                        lambda _ts, c=cache: c.expire())
             if self.app_context.statistics_manager is not None:
                 self.app_context.statistics_manager.start_reporting(scheduler)
+                self._register_statistic_probes()
             for pctx in self.partition_contexts:
                 if pctx.purge_interval_ms is not None and scheduler is not None:
                     scheduler.schedule_periodic(
@@ -591,10 +609,53 @@ class SiddhiAppRuntime:
             self._debugger = SiddhiDebugger(self)
         return self._debugger
 
+    def _register_statistic_probes(self):
+        """DETAIL memory + buffered-events probes for every stateful
+        element — the analog of ``SiddhiAppRuntimeImpl.
+        monitorQueryMemoryUsage:757-782`` (reflective deep size there;
+        exact pytree/array nbytes here) and ``monitorBufferedEvents:
+        784-821`` (@Async ring fill there; junction queue depth + deferred
+        device outputs here). Idempotent — probes are keyed by name."""
+        from siddhi_tpu.core.util.statistics import pytree_nbytes
+
+        sm = self.app_context.statistics_manager
+        if sm is None:
+            return
+        # dirty-guard: probe sets only change when runtimes are built, so
+        # a statistics() polling loop must not rebuild closures per poll
+        sig = (len(self.query_runtimes), len(self.tables),
+               len(self.named_windows), len(self.aggregations),
+               len(self.junctions))
+        if getattr(self, "_probe_sig", None) == sig:
+            return
+        self._probe_sig = sig
+        for name, qr in self.query_runtimes.items():
+            sm.register_memory_probe(
+                f"query.{name}", lambda q=qr: pytree_nbytes(q._state))
+            sm.register_buffer_probe(
+                f"query.{name}.deferred_outputs",
+                lambda q=qr: len(q._deferred))
+        for name, t in self.tables.items():
+            sm.register_memory_probe(
+                f"table.{name}", lambda tb=t: _element_state_bytes(tb))
+        for name, w in self.named_windows.items():
+            sm.register_memory_probe(
+                f"window.{name}", lambda win=w: _element_state_bytes(win))
+        for name, agg in self.aggregations.items():
+            sm.register_memory_probe(
+                f"aggregation.{name}", lambda a=agg: _agg_store_bytes(a))
+        for sid, j in self.junctions.items():
+            if getattr(j, "_queue", None) is not None:
+                sm.register_buffer_probe(
+                    f"junction.{sid}", lambda jn=j: jn._queue.qsize())
+
     def statistics(self) -> dict:
         """Metrics snapshot (reference SiddhiAppRuntime.getStatistics)."""
         sm = self.app_context.statistics_manager
-        return sm.report() if sm is not None else {"level": "off"}
+        if sm is None:
+            return {"level": "off"}
+        self._register_statistic_probes()   # cover late-built runtimes
+        return sm.report()
 
     def set_statistics_level(self, level: str):
         """'off' | 'basic' | 'detail' (reference setStatisticsLevel)."""
@@ -603,6 +664,7 @@ class SiddhiAppRuntime:
         if self.app_context.statistics_manager is None:
             self.app_context.statistics_manager = StatisticsManager()
         self.app_context.statistics_manager.set_level(parse_level(level))
+        self._register_statistic_probes()
 
     setStatisticsLevel = set_statistics_level
 
@@ -737,3 +799,47 @@ class SiddhiAppRuntime:
     @property
     def query_names(self) -> List[str]:
         return list(self.query_runtimes)
+
+
+def _element_state_bytes(el) -> int:
+    """State footprint of a table or named window, whatever its backing:
+    dense arrays (``state``), a store-backed adapter (row count x columnar
+    row width, incl. its cache rows), or a host-mode window's columnar
+    probe surface."""
+    from siddhi_tpu.core.util.statistics import pytree_nbytes
+
+    st = getattr(el, "state", None)
+    if st is not None:
+        return pytree_nbytes(st)
+    if hasattr(el, "count") and hasattr(el, "col_specs"):
+        # RecordTableAdapter: rows live behind the SPI; size them by the
+        # columnar row width this adapter would encode them at
+        import numpy as np
+
+        row = sum(np.dtype(d).itemsize + 1 for d in el.col_specs.values())
+        n = int(el.count)
+        cache = getattr(el, "cache", None)
+        return n * row + (len(cache) * row if cache is not None else 0)
+    if hasattr(el, "contents"):
+        c = el.contents()   # host-mode named window
+        return pytree_nbytes(c[0] if isinstance(c, tuple) else c)
+    return 0
+
+
+def _agg_store_bytes(agg) -> int:
+    """State footprint of an incremental aggregation: the host cube's
+    stored base values (8 bytes each — floats/longs in per-group lists)
+    plus any array-valued running state. The reference sizes this with a
+    reflective object walk (ObjectSizeCalculator.java:66); the dense cube
+    makes it a direct count."""
+    from siddhi_tpu.core.util.statistics import pytree_nbytes
+
+    total = 0
+    for dstore in getattr(agg, "store", {}).values():
+        for groups in dstore.values():
+            for vals in groups.values():
+                total += 8 * len(vals)
+    for v in vars(agg).values():
+        if hasattr(v, "nbytes"):
+            total += int(v.nbytes)
+    return total

@@ -14,6 +14,38 @@ from siddhi_tpu.core.context import SiddhiContext
 from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
 
+def _strip_transports(app: SiddhiApp) -> SiddhiApp:
+    """Sandbox filter (reference ``SiddhiManager.
+    removeSourceSinkAndStoreAnnotations``): drop every @source/@sink whose
+    type is not inMemory from stream definitions, and every @store from
+    table definitions. Definitions are shallow-copied so a caller-owned
+    SiddhiApp object is not mutated."""
+    import dataclasses
+
+    def keep_stream_ann(a) -> bool:
+        if a.name.lower() not in ("source", "sink"):
+            return True
+        t = (a.element("type") or "").lower()
+        return t in ("inmemory", "memory")
+
+    streams = {}
+    for sid, sdef in app.stream_definitions.items():
+        if any(not keep_stream_ann(a) for a in sdef.annotations or []):
+            sdef = dataclasses.replace(
+                sdef, annotations=[a for a in sdef.annotations
+                                   if keep_stream_ann(a)])
+        streams[sid] = sdef
+    tables = {}
+    for tid, tdef in app.table_definitions.items():
+        if any(a.name.lower() == "store" for a in tdef.annotations or []):
+            tdef = dataclasses.replace(
+                tdef, annotations=[a for a in tdef.annotations
+                                   if a.name.lower() != "store"])
+        tables[tid] = tdef
+    return dataclasses.replace(
+        app, stream_definitions=streams, table_definitions=tables)
+
+
 class SiddhiManager:
     def __init__(self):
         self.siddhi_context = SiddhiContext()
@@ -29,6 +61,23 @@ class SiddhiManager:
         return runtime
 
     createSiddhiAppRuntime = create_siddhi_app_runtime
+
+    def create_sandbox_siddhi_app_runtime(
+            self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        """Create a runtime with external transports/stores stripped for
+        testing (reference ``SiddhiManager.createSandboxSiddhiAppRuntime``
+        :104-116 + ``removeSourceSinkAndStoreAnnotations``): every
+        non-inMemory @source/@sink on a stream and every @store on a table
+        is removed, so the app runs fully in-process — feed it with
+        InputHandlers/InMemoryBroker, observe with callbacks."""
+        if isinstance(app, str):
+            app = SiddhiCompiler.parse(SiddhiCompiler.update_variables(app))
+        app = _strip_transports(app)
+        runtime = SiddhiAppRuntime(app, self.siddhi_context)
+        self.app_runtimes[runtime.name] = runtime
+        return runtime
+
+    createSandboxSiddhiAppRuntime = create_sandbox_siddhi_app_runtime
 
     def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
         return self.app_runtimes.get(name)

@@ -78,17 +78,33 @@ class InMemoryRecordTable(RecordTable):
 
 class RowCache:
     """Bounded row cache with FIFO / LRU / LFU eviction (reference
-    CacheTableFIFO / CacheTableLRU / CacheTableLFU)."""
+    CacheTableFIFO / CacheTableLRU / CacheTableLFU) and optional
+    retention-period expiry (reference ``util/cache/CacheExpirer.java``:
+    rows carry a timestamp-added; a periodic sweep deletes rows older
+    than ``retention.period``). ``now_fn`` is the app's event-aware clock
+    (the reference expirer also reads TimestampGenerator.currentTime)."""
 
-    def __init__(self, max_size: int, policy: str = "FIFO"):
+    def __init__(self, max_size: int, policy: str = "FIFO",
+                 retention_ms: Optional[int] = None):
         policy = policy.upper()
         if policy not in ("FIFO", "LRU", "LFU"):
             raise ValueError(f"unknown cache policy '{policy}'")
         self.max_size = max_size
         self.policy = policy
+        self.retention_ms = retention_ms
+        self.purge_interval_ms = None  # sweep cadence (set by create_table)
+        self.now_fn = None            # wired to the app clock at build
         self._rows: Dict[object, list] = {}
         self._order: List[object] = []        # FIFO/LRU order
         self._freq: Dict[object, int] = {}    # LFU
+        self._added: Dict[object, int] = {}   # CACHE_TABLE_TIMESTAMP_ADDED
+
+    def _now(self) -> int:
+        if self.now_fn is not None:
+            return int(self.now_fn())
+        import time
+
+        return int(time.time() * 1000)
 
     def __contains__(self, key):
         return key in self._rows
@@ -100,6 +116,11 @@ class RowCache:
         row = self._rows.get(key)
         if row is None:
             return None
+        if (self.retention_ms is not None
+                and self._now() - self._added.get(key, 0) > self.retention_ms):
+            # expired-but-not-yet-swept rows must not serve stale data
+            self.drop(key)
+            return None
         if self.policy == "LRU":
             self._order.remove(key)
             self._order.append(key)
@@ -110,12 +131,26 @@ class RowCache:
     def put(self, key, row: list):
         if key in self._rows:
             self._rows[key] = row
+            self._added[key] = self._now()
             return
         while len(self._rows) >= self.max_size:
             self._evict_one()
         self._rows[key] = row
         self._order.append(key)
         self._freq[key] = 0
+        self._added[key] = self._now()
+
+    def expire(self, now_ms: Optional[int] = None) -> int:
+        """Drop every row older than the retention period; returns the
+        count dropped (the CacheExpirer sweep body)."""
+        if self.retention_ms is None:
+            return 0
+        now = int(now_ms) if now_ms is not None else self._now()
+        victims = [k for k, t in self._added.items()
+                   if now - t > self.retention_ms]
+        for k in victims:
+            self.drop(k)
+        return len(victims)
 
     def _evict_one(self):
         if self.policy in ("FIFO", "LRU"):
@@ -131,6 +166,7 @@ class RowCache:
             self._rows.pop(key)
             self._order.remove(key)
             self._freq.pop(key, None)
+            self._added.pop(key, None)
 
     def keys(self):
         return list(self._order)
@@ -339,6 +375,19 @@ def create_table(definition: TableDefinition, dictionary, extensions: Dict[str, 
         copts = {k: v for k, v in cache_ann.elements if k is not None}
         size = int(copts.get("size", copts.get("max.size", 128)))
         policy = copts.get("cache.policy", copts.get("policy", "FIFO"))
-        cache = RowCache(size, policy)
+        retention = copts.get("retention.period")
+        retention_ms = None
+        purge_interval_ms = None
+        if retention is not None:
+            from siddhi_tpu.core.aggregation.incremental import _parse_time_str
+
+            # reference AbstractQueryableRecordTable.java:156-163: a
+            # retention period implies expiry; purge.interval defaults to
+            # the retention period itself when absent
+            retention_ms = _parse_time_str(retention)
+            purge_interval_ms = _parse_time_str(
+                copts.get("purge.interval", retention))
+        cache = RowCache(size, policy, retention_ms=retention_ms)
+        cache.purge_interval_ms = purge_interval_ms
     return RecordTableAdapter(record, definition, dictionary, cache=cache,
                               primary_key=primary_key)

@@ -1,5 +1,5 @@
-"""Statistics: throughput/latency/memory trackers with OFF/BASIC/DETAIL
-levels.
+"""Statistics: throughput/latency trackers plus DETAIL-level memory and
+buffered-events probes, with OFF/BASIC/DETAIL levels.
 
 Mirror of reference ``util/statistics/SiddhiStatisticsManager.java:35`` +
 ``ThroughputTracker`` / ``LatencyTracker`` metrics hung off junctions and
@@ -8,17 +8,39 @@ ints guarded by the GIL (incremented at batch granularity, not per event —
 the columnar pump makes per-batch the natural unit).
 
 Levels: OFF (no collection), BASIC (throughput per junction/query),
-DETAIL (adds per-query step latency). Enable with
-``@app:statistics('true')`` or ``@app:statistics(level='detail',
-reporter='console', interval='5 sec')``; snapshot programmatically with
-``SiddhiAppRuntime.statistics()``.
+DETAIL (adds per-query step latency, per-element state memory and
+buffered-event depths). Memory is the dense-state answer to the
+reference's reflective deep-size walk
+(``util/statistics/memory/ObjectSizeCalculator.java:66``,
+``SiddhiAppRuntimeImpl.monitorQueryMemoryUsage:757-782``): every stateful
+element is a pytree of arrays, so its footprint is the sum of leaf
+``nbytes`` — exact and O(leaves), where the reference pays a reflective
+object-graph walk. Buffered events mirror ``monitorBufferedEvents``
+(``SiddhiAppRuntimeImpl.java:784-821`` / ``StreamJunction.
+getBufferedEvents:356-361``): @Async junction queue depths + deferred
+device outputs. Enable with ``@app:statistics('true')`` or
+``@app:statistics(level='detail', reporter='console', interval='5 sec')``;
+snapshot programmatically with ``SiddhiAppRuntime.statistics()``.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
+
+
+def pytree_nbytes(tree) -> int:
+    """Total bytes of every array leaf in a (possibly nested) pytree —
+    exact state footprint for dense device/host arrays."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
 
 OFF, BASIC, DETAIL = 0, 1, 2
 
@@ -94,6 +116,10 @@ class StatisticsManager:
         self._lock = threading.RLock()
         self.throughput: Dict[str, ThroughputTracker] = {}
         self.latency: Dict[str, LatencyTracker] = {}
+        # DETAIL probes, polled at report time (state footprints move with
+        # every batch — sampling at the report beats tracking per step)
+        self.memory_probes: Dict[str, Callable[[], int]] = {}
+        self.buffer_probes: Dict[str, Callable[[], int]] = {}
         self._job = None
 
     # ------------------------------------------------------------ trackers
@@ -111,6 +137,19 @@ class StatisticsManager:
             if t is None:
                 t = self.latency[name] = LatencyTracker(name)
             return t
+
+    def register_memory_probe(self, name: str, probe: Callable[[], int]):
+        """Register a state-footprint probe (bytes), polled at DETAIL
+        report time — the analog of monitorQueryMemoryUsage registering a
+        MemoryUsageTracker per query/table/window/aggregation."""
+        with self._lock:
+            self.memory_probes[name] = probe
+
+    def register_buffer_probe(self, name: str, probe: Callable[[], int]):
+        """Register a buffered-events probe (pending event/batch count) —
+        the analog of monitorBufferedEvents on @Async junctions."""
+        with self._lock:
+            self.buffer_probes[name] = probe
 
     # ------------------------------------------------------------- control
 
@@ -131,7 +170,7 @@ class StatisticsManager:
 
     def report(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "level": {OFF: "off", BASIC: "basic", DETAIL: "detail"}[self.level],
                 "throughput": {
                     n: {"events": t.count, "batches": t.batches,
@@ -144,6 +183,24 @@ class StatisticsManager:
                     for n, t in self.latency.items()
                 },
             }
+            if self.level >= DETAIL:
+                mem = {}
+                for n, probe in self.memory_probes.items():
+                    try:
+                        mem[n] = int(probe())
+                    except Exception:
+                        mem[n] = -1   # probe raced a teardown/regrow
+                out["memory_bytes"] = mem
+                out["memory_total_bytes"] = sum(v for v in mem.values()
+                                                if v > 0)
+                buf = {}
+                for n, probe in self.buffer_probes.items():
+                    try:
+                        buf[n] = int(probe())
+                    except Exception:
+                        buf[n] = -1
+                out["buffered_events"] = buf
+            return out
 
     def format_report(self) -> str:
         import json

@@ -188,3 +188,81 @@ def test_cached_store_pk_lookup():
     row = t.find_by_pk(("A",))          # miss -> loads from the store
     m.shutdown()
     assert row == ["A", 1.0]
+
+
+def test_row_cache_retention_expiry():
+    """CacheExpirer analog (util/cache/CacheExpirer.java): rows older than
+    retention.period expire — both on the periodic sweep and lazily on
+    get() so a stale row is never served between sweeps."""
+    clock = {"t": 1_000}
+    c = RowCache(8, "FIFO", retention_ms=500)
+    c.now_fn = lambda: clock["t"]
+    c.put(("a",), ["a", 1])
+    clock["t"] += 400
+    c.put(("b",), ["b", 2])
+    assert c.get(("a",)) == ["a", 1]      # age 400 < 500: still served
+    clock["t"] += 200                     # a: 600 > 500; b: 200 ok
+    assert c.expire() == 1
+    assert ("a",) not in c and c.get(("b",)) == ["b", 2]
+    clock["t"] += 400                     # b now 600 old; no sweep yet
+    assert c.get(("b",)) is None          # lazy expiry on read
+    assert len(c) == 0
+
+
+def test_cached_store_retention_sweep_scheduled():
+    """@cache(retention.period=...) wires a periodic expirer onto the app
+    scheduler (AbstractQueryableRecordTable.java:156-163: purge.interval
+    defaults to the retention period)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream S (symbol string, price double);
+        @store(type='inMemory',
+               @cache(size='8', cache.policy='FIFO',
+                      retention.period='1 sec'))
+        @primaryKey('symbol')
+        define table T (symbol string, price double);
+        from S insert into T;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(1_000, [["A", 1.0]][0])
+    t = rt.tables["T"]
+    assert t.cache.retention_ms == 1_000
+    assert t.cache.purge_interval_ms == 1_000
+    assert ("A",) in t.cache
+    # playback clock jumps past the retention period; the store keeps the
+    # row (expiry only empties the CACHE), the next lookup re-loads it
+    h.send(3_000, [["B", 2.0]][0])
+    assert t.cache.get(("A",)) is None    # expired (lazily or by sweep)
+    assert t.find_by_pk(("A",)) == ["A", 1.0]   # reloaded from the store
+    m.shutdown()
+
+
+def test_on_demand_group_by_returns_one_row_per_group():
+    """Ported from OnDemandQueryTableTestCase.java test3 (:137-190): a
+    grouped/aggregated FIND returns ONE row per group with the aggregate
+    over the whole store (2 symbols -> 2 rows; having filters groups;
+    3 (symbol, price) pairs -> 3 rows)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price double, volume long);
+        define table StockTable (symbol string, price double, volume long);
+        @info(name = 'query1')
+        from StockStream insert into StockTable;
+    """)
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 100])
+    h.send(["WSO2", 57.6, 100])
+    r = rt.query("from StockTable on price > 5 "
+                 "select symbol, sum(volume) as totalVolume "
+                 "group by symbol having totalVolume > 150")
+    assert [e.data for e in r] == [["WSO2", 200]]
+    r = rt.query("from StockTable on price > 5 "
+                 "select symbol, sum(volume) as totalVolume group by symbol")
+    assert len(r) == 2
+    r = rt.query("from StockTable on price > 5 "
+                 "select symbol, sum(volume) as totalVolume "
+                 "group by symbol, price")
+    assert len(r) == 3
+    m.shutdown()

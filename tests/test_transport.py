@@ -183,3 +183,78 @@ def test_persist_pauses_sources():
     assert not sr.is_paused     # resumed after the checkpoint
     InMemoryBroker.publish("pin", ["A", 1.0])   # still deliverable
     m.shutdown()
+
+
+def test_sandbox_runtime_strips_sources_sinks_and_stores():
+    """createSandboxSiddhiAppRuntime (SiddhiManager.java:104-116): every
+    non-inMemory @source/@sink and every @store is stripped, so the app
+    runs fully in-process; inMemory transports are KEPT (the reference
+    filter only removes non-inMemory types)."""
+
+    class Exploding(Source):
+        """Would fail on connect — sandbox must never instantiate it."""
+
+        def connect(self):
+            raise ConnectionUnavailableException("must not be called")
+
+    m = SiddhiManager()
+    m.set_extension("source:kafkaish", Exploding)
+    rt = m.create_sandbox_siddhi_app_runtime("""
+        @source(type='kafkaish', topic='t')
+        define stream S (symbol string, price double);
+        @sink(type='inMemory', topic='sandbox.out')
+        define stream OutStream (symbol string, price double);
+        @store(type='someRdbms')
+        define table T (symbol string, price double);
+        from S[price > 10] select symbol, price insert into OutStream;
+        from S select symbol, price insert into T;
+    """)
+    got = []
+
+    class Sub(InMemoryBroker.Subscriber):
+        topic = "sandbox.out"
+
+        def on_message(self, payload):
+            got.append(payload)
+
+    InMemoryBroker.subscribe(Sub())
+    rt.start()
+    assert rt.source_runtimes == []          # external source stripped
+    from siddhi_tpu.core.table.in_memory_table import InMemoryTable
+
+    assert isinstance(rt.tables["T"], InMemoryTable)   # @store stripped
+    h = rt.get_input_handler("S")            # feedable directly
+    h.send(["WSO2", 55.5])
+    h.send(["IBM", 5.5])
+    m.shutdown()
+    assert got == [["WSO2", 55.5]]           # inMemory sink survived
+
+
+def test_on_demand_runtime_cache():
+    """Compiled on-demand FIND runtimes are cached per query text, capped
+    at 50 oldest-evicted (SiddhiAppRuntimeImpl.java:344-351)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double);
+        define table T (symbol string, price double);
+        from S insert into T;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    h.send(["B", 2.0])
+    q = "from T on price > 1.5 select symbol, price"
+    r1 = rt.query(q)
+    assert [e.data for e in r1] == [["B", 2.0]]
+    assert q in rt._on_demand_cache
+    compiled = rt._on_demand_cache[q]
+    # cache HIT serves fresh data through the same compiled runtime
+    h.send(["C", 3.0])
+    r2 = rt.query(q)
+    assert rt._on_demand_cache[q] is compiled
+    assert [e.data for e in r2] == [["B", 2.0], ["C", 3.0]]
+    # cap: 50 entries, oldest evicted first
+    for i in range(51):
+        rt.query(f"from T on price > {i}.5 select symbol")
+    assert len(rt._on_demand_cache) == 50
+    assert q not in rt._on_demand_cache
+    m.shutdown()
