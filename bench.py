@@ -410,7 +410,7 @@ def bench_nfa_p99():
     hb = rt.get_input_handler("BStream")
 
     rng = np.random.default_rng(2)
-    B = 1024
+    B = int(os.environ.get("BENCH_NFA_BATCH", 1024))
 
     # pre-size the key space so key registration never grows capacity
     # mid-run (each pow2 growth would re-jit the [K, S] step), and warm

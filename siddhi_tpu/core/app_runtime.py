@@ -245,9 +245,18 @@ class SiddhiAppRuntime:
                     f"@app:enforceOrder is incompatible with @Async on "
                     f"stream '{sdef.id}': async buffering can interleave "
                     f"producer batches out of timestamp order")
+            from siddhi_tpu.core.aggregation.incremental import _parse_time_str
+
             buffer_size = int(async_ann.element("buffer.size") or 1024)
             batch_size = int(async_ann.element("batch.size") or 256)
-            j.enable_async(buffer_size, batch_size)
+            max_delay = async_ann.element("max.delay")
+            latency_target = async_ann.element("latency.target")
+            j.enable_async(
+                buffer_size, batch_size,
+                max_delay_ms=_parse_time_str(max_delay)
+                if max_delay else None,
+                latency_target_ms=_parse_time_str(latency_target)
+                if latency_target else None)
         self.junctions[sdef.id] = j
         return j
 

@@ -44,7 +44,8 @@ class TimestampGenerator:
     def set_current_timestamp(self, ts: int):
         if ts > self._last_event_ts:
             self._last_event_ts = ts
-            for listener in self._increment_listeners:
+            # snapshot: one-shot listeners remove themselves mid-iteration
+            for listener in tuple(self._increment_listeners):
                 listener(ts)
 
     def reset_timestamp(self, ts: int):
@@ -55,6 +56,14 @@ class TimestampGenerator:
 
     def add_time_change_listener(self, fn):
         self._increment_listeners.append(fn)
+
+    def remove_time_change_listener(self, fn):
+        """One-shot listeners (e.g. playback head-wait arming) unregister
+        themselves so the per-event clock path stays listener-free."""
+        try:
+            self._increment_listeners.remove(fn)
+        except ValueError:
+            pass
 
 
 class SiddhiAppContext:

@@ -563,6 +563,40 @@ def plan_nfa_query(
     dictionary = app_context.string_dictionary
     plan = build_nfa_plan(state_stream, definitions, app_context.nfa_slots)
 
+    if query.selector.select_all or not query.selector.selection_list:
+        # `select *` on a pattern expands to every attribute of every
+        # pattern element in order (reference SelectorParser over the
+        # MetaStateEvent) — sides without captures (pure absent steps)
+        # project null columns. Duplicate names reject, as the reference's
+        # output-definition validation would.
+        from siddhi_tpu.query_api.execution import OutputAttribute
+        from siddhi_tpu.query_api.expressions import Constant, Variable
+
+        seen_refs = {}
+        for st in plan.steps:
+            for side in st.sides:
+                r = (side.capture.ref_id if side.capture is not None
+                     and side.capture.ref_id else side.stream_id)
+                seen_refs.setdefault(r, (side.stream_id,
+                                         side.capture is not None))
+        selection = []
+        names = set()
+        for ref, (sid, has_cap) in seen_refs.items():
+            for attr in definitions[sid].attributes:
+                if attr.name in names:
+                    raise CompileError(
+                        f"query '{query_name}': select * is ambiguous — "
+                        f"attribute '{attr.name}' appears in more than one "
+                        f"pattern element; use an explicit select list")
+                names.add(attr.name)
+                # capture-less elements (pure absent steps) project null
+                expr = (Variable(attribute_name=attr.name, stream_id=ref)
+                        if has_cap else Constant(value=None, type=attr.type))
+                selection.append(OutputAttribute(rename=attr.name,
+                                                 expression=expr))
+        query.selector.selection_list = selection
+        query.selector.select_all = False
+
     # size indexed capture storage (e1[i].attr) from every expression that
     # can reference captures: side filters, selections, having
     idx_exprs = [e for st in plan.steps for side in st.sides for e in side.filter_exprs]
@@ -585,12 +619,6 @@ def plan_nfa_query(
                     return r
 
                 side.cond = combined
-
-    if query.selector.select_all or not query.selector.selection_list:
-        raise CompileError(
-            f"query '{query_name}': pattern/sequence queries need an explicit "
-            f"select list (e.g. select e1.price, e2.price)"
-        )
 
     out_resolver = NFAOutputResolver(plan, dictionary)
     output_event_type = query.output_stream.output_event_type if query.output_stream else "current"

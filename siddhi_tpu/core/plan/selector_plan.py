@@ -189,6 +189,11 @@ class SelectorPlan:
                 v = xp.broadcast_to(v, (B,))
             out[name] = v
             if m is not None:
+                m = xp.asarray(m)
+                if m.ndim == 0:
+                    # scalar masks (typed null literals) must take row
+                    # shape: to_events indexes mask columns per row
+                    m = xp.broadcast_to(m, (B,))
                 out[name + "?"] = m
         for name, src in self.set_cols:
             # a set-valued output's element snapshot rides beside its count

@@ -108,21 +108,44 @@ class NFAQueryRuntime(QueryRuntime):
     def arm_initial(self):
         """Arm key 0's head wait at app start (reference: absent pre-state
         processors schedule their first deadline when the runtime starts —
-        ``AbsentStreamPreStateProcessor.java`` partitionCreated/start)."""
+        ``AbsentStreamPreStateProcessor.java`` partitionCreated/start).
+
+        Playback timelines have no wall origin, so the wait is anchored at
+        the app clock's FIRST value instead (the playback analog of
+        runtime-start wall time), via a one-shot time-change listener —
+        the first event on ANY stream starts the quiet window. Anchoring
+        at t=0 would let a successor at any realistic epoch timestamp sail
+        past the deadline without any quiet period elapsing
+        (AbsentPatternTestCase q7/q27)."""
         plan = self.stage.plan
         arm_j = plan.arm_step()
         if arm_j is None or self.partition_ctx is not None:
             return
+        if self.app_context.playback:
+            self._arm_pending = True
+            tsg = self.app_context.timestamp_generator
+
+            def on_first_ts(ts):
+                if self._arm_pending:
+                    self._arm_pending = False
+                    self._arm_at(int(ts))
+                tsg.remove_time_change_listener(on_first_ts)
+
+            tsg.add_time_change_listener(on_first_ts)
+            return
+        self._arm_at(int(self.app_context.timestamp_generator.current_time()))
+
+    _arm_pending = False
+
+    def _arm_at(self, now: int):
+        plan = self.stage.plan
+        arm_j = plan.arm_step()
         with self._lock:
             if self._state is None:
                 self._state = self._init_state()
             nfa = {k: np.asarray(v) for k, v in self._state["nfa"].items()}
             if nfa["armed"][0]:
                 return
-            # playback timelines have no wall origin: arm at t=0 so the
-            # head wait is counted from the timeline start
-            now = 0 if self.app_context.playback else int(
-                self.app_context.timestamp_generator.current_time())
             nfa["armed"] = nfa["armed"].copy()
             nfa["armed"][0] = True
             nfa["active"] = nfa["active"].copy()
@@ -130,8 +153,13 @@ class NFAQueryRuntime(QueryRuntime):
             nfa["stepi"] = nfa["stepi"].copy()
             nfa["stepi"][0, 0] = arm_j
             nfa["sts"] = nfa["sts"].copy()
-            nfa["sts"][0, 0] = now
             st = plan.steps[arm_j]
+            # capture-less armed head: `within` anchors at the first
+            # CAPTURE (T0 sentinel min()ed down there — ops/nfa._T0_FAR)
+            from siddhi_tpu.ops.nfa import _T0_FAR
+
+            capless = all(s.capture is None for s in st.sides)
+            nfa["sts"][0, 0] = int(_T0_FAR) if capless else now
             next_dl = None
             if st.kind == "absent":
                 nfa["adl"] = nfa["adl"].copy()
@@ -145,13 +173,9 @@ class NFAQueryRuntime(QueryRuntime):
                         nfa[key][0, 0] = now + side.wait_ms
                         dl = now + side.wait_ms
                         next_dl = dl if next_dl is None else min(next_dl, dl)
-            for g, (a, b, t) in enumerate(plan.scopes):
-                if a == arm_j and plan.steps[arm_j].waitish:
-                    col = f"wts{g}"
-                    nfa[col] = nfa[col].copy()
-                    nfa[col][0, 0] = now
-                    nfa["capdone"] = nfa["capdone"].copy()
-                    nfa["capdone"][0, 0] |= plan.scope_bit(g)
+            # scopes starting at the armed (capture-less) wait do NOT start
+            # counting here — `within` measures across captured events
+            # (see NFAStage._start_capture_scopes)
             self._state["nfa"] = {k: jnp.asarray(v) for k, v in nfa.items()}
         if next_dl is not None and self.scheduler is not None:
             self.scheduler.notify_at(int(next_dl), self._timer_cb)

@@ -101,7 +101,14 @@ class InputHandler:
                                 f"@app:enforceOrder: non-monotone timestamps "
                                 f"inside a batch on stream '{self.stream_id}'")
                         self._check_order(int(ts_arr[0]), int(ts_arr[-1]))
-                    tsg.set_current_timestamp(int(ts_arr.max()))
+                    # advance in two hops so clock listeners observe the
+                    # batch's EARLIEST timestamp first (a head-absent wait
+                    # must anchor at the first event, not the batch max)
+                    lo = int(ts_arr.min())
+                    hi = int(ts_arr.max())
+                    if lo != hi:
+                        tsg.set_current_timestamp(lo)
+                    tsg.set_current_timestamp(hi)
             self.junction.send_batch(batch)
 
 

@@ -88,6 +88,16 @@ def _const(value, attr_type: AttrType) -> Compiled:
 def compile_expr(expr: Expression, resolver: Resolver) -> Compiled:
     """Lower `expr`; returns (fn, result_type)."""
     if isinstance(expr, Constant):
+        if expr.value is None:
+            # typed null literal (select * over capture-less pattern
+            # elements): zero placeholder + an always-true null mask
+            zero = (np.int32(0) if expr.type == AttrType.STRING
+                    else np.zeros((), T.dtype_of(expr.type))[()])
+
+            def null_fn(cols, ctx, _z=zero):
+                return _z, np.True_
+
+            return null_fn, expr.type
         if expr.type == AttrType.STRING:
             return _const(np.int32(resolver.encode_string(expr.value)), AttrType.STRING)
         return _const(np.asarray(expr.value, dtype=T.dtype_of(expr.type))[()], expr.type)

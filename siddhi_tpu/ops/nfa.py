@@ -104,6 +104,9 @@ from siddhi_tpu.query_api.expressions import Expression, Variable
 CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
 ANY_MAX = 2 ** 30
 FAR_FUTURE = jnp.int64(2 ** 62)
+# T0 sentinel for capture-less armed heads: within counts from the first
+# capture; 2**60 keeps T0 + within far below int64 overflow
+_T0_FAR = jnp.int64(2 ** 60)
 
 
 # --------------------------------------------------------------------- plan
@@ -720,18 +723,30 @@ class NFAStage:
                     if side.absent and side.wait_ms is not None:
                         key = "ADL" if side.bit == 1 else "AD2"
                         V[key] = w(V[key], ts2d + jnp.int64(side.wait_ms))
-            # scopes that start when a slot *arrives* at an absent-ish step
-            for g, (a, b, t) in enumerate(plan.scopes):
-                if a == j and st.waitish:
-                    V["SC"][g] = w(V["SC"][g], ts2d)
-                    V["CD"] = w(V["CD"], V["CD"] | plan.scope_bit(g))
         return V
 
     def _start_capture_scopes(self, V: dict, mask2d, j: int, ts2d):
-        """Scopes whose start step j captured its first event now."""
+        """Scopes whose start step j captured its first event now.
+
+        A scope starting at a capture-LESS absent step does NOT start at
+        arrival or arming — the reference measures `within` across
+        captured events only (a head-absent StateEvent has no events, so
+        its timestamp stays -1 and isExpired can't fire:
+        AbsentPatternTestCase q42, `not A for 1 sec -> e2 within 2 sec`
+        matches however long the quiet stretch was). Such scopes anchor
+        at their first capturing successor via the `ST > a` branch below."""
         plan = self.plan
         for g, (a, b, t) in enumerate(plan.scopes):
-            if a == j and not plan.steps[j].waitish:
+            # a capture AT the scope's start step anchors it — including
+            # captures on the present side of a MIXED waitish logical head
+            # (`not A for t and e2=B`): within counts from e2's capture
+            starts_here = a == j
+            # first capture AFTER a capture-less waitish scope head (the
+            # `started` guard keeps only the earliest capture's timestamp)
+            enters_here = (a < j <= b and plan.steps[a].waitish
+                           and all(s.capture is None
+                                   for s in plan.steps[a].sides))
+            if starts_here or enters_here:
                 started = (V["CD"] & plan.scope_bit(g)) != 0
                 m = mask2d & ~started
                 V["SC"][g] = jnp.where(m, ts2d, V["SC"][g])
@@ -996,12 +1011,19 @@ class NFAStage:
             ARMD = armed[pk]
             ts2d = ts[:, None]
 
-            # ---- arming: a key's very first row arms the head wait
+            # ---- arming: a key's very first row arms the head wait.
+            # A capture-LESS armed head (pure absent) starts `within` from
+            # its FIRST CAPTURE, not from arming — T0 arms at a far-future
+            # sentinel that every later capture min()s down to its ts
+            # (AbsentPatternTestCase q42: the quiet stretch does not count)
+            arm_capless = arm_j is not None and all(
+                s.capture is None for s in plan.steps[arm_j].sides)
             if arm_j is not None:
                 need = m & ~ARMD
                 onehot0 = need[:, None] & (jnp.arange(S)[None, :] == 0)
                 V["A"] = V["A"] | onehot0
-                V["T0"] = jnp.where(onehot0, ts2d, V["T0"])
+                V["T0"] = jnp.where(
+                    onehot0, _T0_FAR if arm_capless else ts2d, V["T0"])
                 V = self._enter(V, onehot0, arm_j, ts2d)
                 ARMD = ARMD | need
 
@@ -1116,8 +1138,13 @@ class NFAStage:
                 v = viols[oi]
                 j = st.index
                 if st.kind == "absent":
-                    if st.sticky:
-                        # every-not: the violated interval restarts
+                    if st.sticky or st.index == arm_j:
+                        # every-not restarts its interval; a HEAD wait
+                        # (armed start state) re-inits after violation
+                        # even without `every` — reference start states
+                        # re-initialize per chunk, so the quiet window
+                        # re-anchors at the violating event
+                        # (AbsentPatternTestCase q6/q18)
                         ADL2_ = jnp.where(v, ts2d + jnp.int64(st.wait_ms), ADL2_)
                     else:
                         A2 = A2 & ~v
@@ -1186,6 +1213,8 @@ class NFAStage:
                     # entering resets the counter; absorbing continues it
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
+                    if arm_capless:
+                        T0 = jnp.where(eff, jnp.minimum(T0, ts2d), T0)
                     ST2 = jnp.where(eff, j, ST2)
                     if (j < L and not st.sticky
                             and st.min_count == st.max_count):
@@ -1225,6 +1254,8 @@ class NFAStage:
                 elif st.kind == "stream":
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
+                    if arm_capless:
+                        T0 = jnp.where(eff, jnp.minimum(T0, ts2d), T0)
                     if j == L:
                         emit2 = emit2 | eff
                         kill = kill | eff
@@ -1242,6 +1273,8 @@ class NFAStage:
                 else:  # and / or
                     CP2, CD2 = capture_current(CP2, CD2, eff, cap,
                                                reset_counter=False)
+                    if arm_capless:
+                        T0 = jnp.where(eff, jnp.minimum(T0, ts2d), T0)
                     bt2 = BT2 | jnp.where(eff, side.bit, 0)
                     nb = st.need_bits
                     if st.kind == "and":

@@ -140,3 +140,492 @@ def test_absent_q14_mid_not_violated_before_e3():
     rt.get_input_handler("Stream3").send(1200, ["GOOGLE", 55.7, 100])
     m.shutdown()
     assert _rows(c) == []
+
+
+# ---------------------------------------------------------------------------
+# Round-4 expansion: the remaining AbsentPatternTestCase.java scenarios
+# (testQueryAbsent2..43, feeds and expected counts verbatim; sleeps become
+# playback timestamps offset from the 1000 ms clock-start, with a trailing
+# Tick where a deadline must fire before shutdown).
+
+FOUR = """@app:playback
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+    define stream Stream3 (symbol string, price float, volume int);
+    define stream Stream4 (symbol string, price float, volume int);
+    define stream Tick (x int);
+    from Tick select x insert into TickOut;
+"""
+
+HEAD_NOT = THREE + """
+    from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+    select e2.symbol as s insert into OutputStream;
+"""
+
+HEAD_CHAIN = THREE + """
+    from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+      -> e3=Stream3[price>30]
+    select e2.symbol as s2, e3.symbol as s3 insert into OutputStream;
+"""
+
+E123_NOT4 = FOUR + """
+    from e1=Stream1[price>10] -> e2=Stream2[price>20] -> e3=Stream3[price>30]
+      -> not Stream4[price>40] for 1 sec
+    select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3
+    insert into OutputStream;
+"""
+
+E12_NOT3_E4 = FOUR + """
+    from e1=Stream1[price>10] -> e2=Stream2[price>20]
+      -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+    select e1.symbol as s1, e2.symbol as s2, e4.symbol as s4
+    insert into OutputStream;
+"""
+
+NOT1_E234 = FOUR + """
+    from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+      -> e3=Stream3[price>30] -> e4=Stream4[price>40]
+    select e2.symbol as s2, e3.symbol as s3, e4.symbol as s4
+    insert into OutputStream;
+"""
+
+NOT1_E2_NOT3_E4 = FOUR + """
+    from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+      -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+    select e2.symbol as s2, e4.symbol as s4 insert into OutputStream;
+"""
+
+E1_NOT2_AND = FOUR + """
+    from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+      -> e2=Stream3[price>30] and e3=Stream4[price>40]
+    select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3
+    insert into OutputStream;
+"""
+
+E1_NOT2_OR = FOUR + """
+    from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+      -> e2=Stream3[price>30] or e3=Stream4[price>40]
+    select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3
+    insert into OutputStream;
+"""
+
+NOT1_COUNT = THREE + """
+    from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]<2:5>
+    select e2[0].symbol as s0, e2[1].symbol as s1, e2[2].symbol as s2,
+           e2[3].symbol as s3
+    insert into OutputStream;
+"""
+
+
+def _send(rt, stream, ts, row):
+    rt.get_input_handler(stream).send(ts, row)
+
+
+def test_absent_q2_tail_not_violation_after_deadline():
+    # testQueryAbsent2: the violating Stream2 event arrives AFTER the
+    # 1-sec deadline -> the match already fired
+    m, rt, c = build(TAIL_NOT)
+    _send(rt, "Stream1", 1000, ["WSO2", 55.6, 100])
+    _send(rt, "Stream2", 2100, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2",)]
+
+
+def test_absent_q4_tail_not_nonmatching_stream2_ok():
+    # testQueryAbsent4: Stream2 event fails [price>e1.price] -> no
+    # violation, match at the deadline
+    m, rt, c = build(TAIL_NOT)
+    _send(rt, "Stream1", 1000, ["WSO2", 55.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 50.7, 100])
+    _send(rt, "Tick", 2100, [0])
+    m.shutdown()
+    assert _rows(c) == [("WSO2",)]
+
+
+def test_absent_q6_head_not_rearms_after_violation():
+    # testQueryAbsent6: Stream1 kills the first wait; a later quiet
+    # second + e2 still match (head wait re-arms)
+    m, rt, c = build(HEAD_NOT)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream1", 1100, ["WSO2", 59.6, 100])
+    _send(rt, "Stream2", 3200, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM",)]
+
+
+def test_absent_q7_head_not_e2_before_deadline():
+    # testQueryAbsent7: non-violating Stream1, but e2 arrives inside the
+    # quiet window -> no match
+    m, rt, c = build(HEAD_NOT)
+    _send(rt, "Stream1", 1000, ["WSO2", 5.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q8_head_not_violated_then_e2_early():
+    # testQueryAbsent8: violation then e2 before the re-armed deadline
+    m, rt, c = build(HEAD_NOT)
+    _send(rt, "Stream1", 1000, ["WSO2", 55.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q11_chain_then_not_quiet():
+    # testQueryAbsent11: e1, e2, quiet second -> match at deadline
+    m, rt, c = build(MID_TAIL)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Tick", 2200, [0])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "IBM")]
+
+
+def test_absent_q15_head_chain_violated():
+    # testQueryAbsent15: Stream1 violates the head wait -> no match
+    m, rt, c = build(HEAD_CHAIN)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 1200, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q16_head_chain_quiet_then_e2_e3():
+    # testQueryAbsent16: quiet head window, then e2 -> e3
+    m, rt, c = build(HEAD_CHAIN)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream2", 3200, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 3300, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "GOOGLE")]
+
+
+def test_absent_q17_head_chain_nonviolating_stream1():
+    # testQueryAbsent17: a Stream1 event FAILING [price>10] inside the
+    # wait does not violate it
+    m, rt, c = build(HEAD_CHAIN)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream1", 1500, ["WSO2", 5.6, 100])
+    _send(rt, "Stream2", 2100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 2200, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "GOOGLE")]
+
+
+def test_absent_q18_head_chain_violation_then_rearm():
+    # testQueryAbsent18: violation at start; after a quiet re-armed
+    # second, e2 -> e3 match
+    m, rt, c = build(HEAD_CHAIN)
+    _send(rt, "Stream1", 1000, ["WSO2", 25.6, 100])
+    _send(rt, "Stream2", 2100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 2200, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "GOOGLE")]
+
+
+def test_absent_q19_three_then_tail_not_quiet():
+    # testQueryAbsent19: e1 -> e2 -> e3 then a quiet second on Stream4
+    m, rt, c = build(E123_NOT4)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 1200, ["GOOGLE", 35.7, 100])
+    _send(rt, "Tick", 2300, [0])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "IBM", "GOOGLE")]
+
+
+def test_absent_q20_three_then_tail_not_violated():
+    # testQueryAbsent20: Stream4 inside the window -> no match
+    m, rt, c = build(E123_NOT4)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 1200, ["GOOGLE", 35.7, 100])
+    _send(rt, "Stream4", 1300, ["ORACLE", 44.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q21_mid_not_then_e4():
+    # testQueryAbsent21: e1, e2, quiet second, e4 -> match
+    m, rt, c = build(E12_NOT3_E4)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream4", 2200, ["ORACLE", 44.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "IBM", "ORACLE")]
+
+
+def test_absent_q22_mid_not_violated_then_e4():
+    # testQueryAbsent22: Stream3 violates the mid wait; the later e4
+    # cannot complete the chain
+    m, rt, c = build(E12_NOT3_E4)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 1200, ["GOOGLE", 38.7, 100])
+    _send(rt, "Stream4", 2300, ["ORACLE", 44.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q23_head_not_violated_chain_dead():
+    # testQueryAbsent23: head wait violated -> e2/e3/e4 never accepted
+    m, rt, c = build(NOT1_E234)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 1200, ["GOOGLE", 38.7, 100])
+    _send(rt, "Stream4", 1300, ["ORACLE", 44.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q24_double_not_both_quiet():
+    # testQueryAbsent24: quiet, e2, quiet, e4 -> match
+    m, rt, c = build(NOT1_E2_NOT3_E4)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream2", 2100, ["IBM", 28.7, 100])
+    _send(rt, "Stream4", 3300, ["ORACLE", 44.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "ORACLE")]
+
+
+def test_absent_q25_double_not_first_violated():
+    # testQueryAbsent25: Stream1 violates head; nothing matches
+    m, rt, c = build(NOT1_E2_NOT3_E4)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 1200, ["GOOGLE", 38.7, 100])
+    _send(rt, "Stream4", 1300, ["ORACLE", 44.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q26_double_not_e2_before_head_deadline():
+    # testQueryAbsent26: e2 arrives before the head wait completes
+    m, rt, c = build(NOT1_E2_NOT3_E4)
+    _send(rt, "Stream2", 1000, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 1100, ["GOOGLE", 38.7, 100])
+    _send(rt, "Stream4", 1200, ["ORACLE", 44.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q27_head_not_e2_immediately():
+    # testQueryAbsent27: e2 at clock start, quiet second not elapsed
+    m, rt, c = build(HEAD_NOT)
+    _send(rt, "Stream2", 1000, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q28_mid_not_then_and_pair():
+    # testQueryAbsent28: quiet second then e3 AND e4 -> one match
+    m, rt, c = build(E1_NOT2_AND)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream3", 2100, ["WSO2", 35.0, 100])
+    _send(rt, "Stream4", 2200, ["GOOGLE", 56.86, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "WSO2", "GOOGLE")]
+
+
+def test_absent_q29_mid_not_and_pair_too_early():
+    # testQueryAbsent29: the and-pair arrives inside the quiet window
+    m, rt, c = build(E1_NOT2_AND)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream3", 1100, ["WSO2", 35.0, 100])
+    _send(rt, "Stream4", 1200, ["GOOGLE", 56.86, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q30_mid_not_then_or_left():
+    # testQueryAbsent30: quiet second then the left or-side alone
+    m, rt, c = build(E1_NOT2_OR)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream3", 2100, ["WSO2", 35.0, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "WSO2", None)]
+
+
+def test_absent_q31_mid_not_then_or_right():
+    # testQueryAbsent31: quiet second then the right or-side alone
+    m, rt, c = build(E1_NOT2_OR)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream4", 2100, ["GOOGLE", 56.86, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM", None, "GOOGLE")]
+
+
+def test_absent_q32_mid_not_or_too_early():
+    # testQueryAbsent32: or-sides inside the quiet window -> nothing
+    m, rt, c = build(E1_NOT2_OR)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream3", 1100, ["WSO2", 35.0, 100])
+    _send(rt, "Stream4", 1200, ["GOOGLE", 56.86, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q33_mid_not_violated_and_pair():
+    # testQueryAbsent33: Stream2 violates the wait; and-pair wasted
+    m, rt, c = build(E1_NOT2_AND)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream2", 1100, ["ORACLE", 25.0, 100])
+    _send(rt, "Stream3", 1200, ["WSO2", 35.0, 100])
+    _send(rt, "Stream4", 1300, ["GOOGLE", 56.86, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q34_mid_not_violated_or_pair():
+    # testQueryAbsent34: same with or
+    m, rt, c = build(E1_NOT2_OR)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream2", 1100, ["ORACLE", 25.0, 100])
+    _send(rt, "Stream3", 1200, ["WSO2", 35.0, 100])
+    _send(rt, "Stream4", 1300, ["GOOGLE", 56.86, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q35_head_not_violated_count_tail():
+    # testQueryAbsent35: violated head wait -> the <2:5> count never
+    # starts collecting
+    m, rt, c = build(NOT1_COUNT)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.0, 100])
+    _send(rt, "Stream2", 1100, ["GOOGLE", 35.0, 100])
+    _send(rt, "Stream2", 1200, ["ORACLE", 45.0, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q36_head_not_quiet_count_tail():
+    # testQueryAbsent36: quiet second then two Stream2 events satisfy
+    # the <2:5> minimum -> one match with e2[0], e2[1] captured
+    m, rt, c = build(NOT1_COUNT)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream2", 2100, ["WSO2", 35.0, 100])
+    _send(rt, "Stream2", 2200, ["IBM", 45.0, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "IBM", None, None)]
+
+
+def test_absent_q37_head_not_single_match_no_every():
+    # testQueryAbsent37: without `every`, only the first e2 after the
+    # quiet second matches
+    m, rt, c = build(THREE + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+        select e2.symbol as s insert into OutputStream;
+    """)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream2", 3100, ["WSO2", 35.0, 100])
+    _send(rt, "Stream2", 3200, ["IBM", 45.0, 100])
+    m.shutdown()
+    assert _rows(c) == [("WSO2",)]
+
+
+def test_absent_q38_mid_not_violated_then_late_e3():
+    # testQueryAbsent38: Stream2 violates inside the window; e3 after the
+    # deadline cannot resurrect the chain
+    m, rt, c = build(MID_NOT)
+    _send(rt, "Stream1", 1000, ["WSO2", 15.6, 100])
+    _send(rt, "Stream2", 1100, ["IBM", 28.7, 100])
+    _send(rt, "Stream3", 2200, ["GOOGLE", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q39_mid_not_violated_or_after_delay():
+    # testQueryAbsent39: violation, then the or-side after the deadline
+    m, rt, c = build(E1_NOT2_OR)
+    _send(rt, "Stream1", 1000, ["IBM", 18.7, 100])
+    _send(rt, "Stream2", 1100, ["WSO2", 25.5, 100])
+    _send(rt, "Stream4", 2200, ["GOOGLE", 56.86, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q40_head_not_no_rearm_second_e2():
+    # testQueryAbsent40: after the first match, a second quiet period +
+    # e2 do NOT match again (no `every`)
+    m, rt, c = build(HEAD_NOT)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream2", 2100, ["IBM", 58.7, 100])
+    _send(rt, "Stream2", 3300, ["WSO2", 68.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM",)]
+
+
+def test_absent_q41_every_not_violated_no_output_yet():
+    # testQueryAbsent41: `every not ... for 1 sec` select *; the matching
+    # Stream1 event kills the current wait and nothing has fired by then
+    m, rt, c = build(THREE + """
+        from every not Stream1[price>20] for 1 sec
+        select * insert into OutputStream;
+    """)
+    _send(rt, "Stream1", 1000, ["WSO2", 55.6, 100])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+def test_absent_q42_head_not_within_counts_captured_events():
+    # testQueryAbsent42: `within 2 sec` measures across CAPTURED events;
+    # with only e2 captured it cannot be violated even 3 sec in
+    m, rt, c = build(THREE + """
+        from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+          within 2 sec
+        select e2.symbol as s insert into OutputStream;
+    """)
+    _send(rt, "Tick", 1000, [0])
+    _send(rt, "Stream2", 4100, ["IBM", 58.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("IBM",)]
+
+
+def test_absent_q43_partitioned_same_stream_absence():
+    # testQueryAbsent43: partitioned e1 -> not same-stream same-key for
+    # 1 sec; customerA stays quiet -> matches, customerB repeats -> killed
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream CustomerStream (customerId string);
+        define stream Tick (x int);
+        from Tick select x insert into TickOut;
+        partition with (customerId of CustomerStream)
+        begin
+          from e1=CustomerStream
+            -> not CustomerStream[customerId == e1.customerId] for 1 sec
+          select e1.customerId insert into OutputStream;
+        end;
+    """)
+    c = Collector()
+    rt.add_callback("OutputStream", c)
+    h = rt.get_input_handler("CustomerStream")
+    h.send(1000, ["customerA"])
+    h.send(1000, ["customerB"])
+    h.send(1500, ["customerB"])
+    rt.get_input_handler("Tick").send(2600, [0])
+    m.shutdown()
+    assert _rows(c) == [("customerA",)]
+
+
+def test_select_star_emits_null_columns_for_absent_elements():
+    """select * on a pattern with a capture-less absent element EMITS a
+    row: captured attrs filled, absent element's attrs null (regression:
+    the typed-null scalar mask crashed event decoding). Distinct attr
+    names via two differently-shaped streams."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream Alerts (aName string, aLevel int);
+        define stream Metrics (mName string, mValue double);
+        define stream Tick (x int);
+        from Tick select x insert into TickOut;
+        from not Alerts[aLevel > 2] for 1 sec -> e2=Metrics[mValue > 10.0]
+        select * insert into OutputStream;
+    """)
+    c = Collector()
+    rt.add_callback("OutputStream", c)
+    rt.get_input_handler("Tick").send(1000, [0])
+    rt.get_input_handler("Metrics").send(2500, ["cpu", 55.5])
+    m.shutdown()
+    assert _rows(c) == [(None, None, "cpu", 55.5)]

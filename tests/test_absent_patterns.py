@@ -93,7 +93,11 @@ def test_head_absent_then_stream():
         select e2.symbol as symbol2
         insert into OutStream;
     """)
+    s1 = rt.get_input_handler("Stream1")
     s2 = rt.get_input_handler("Stream2")
+    # playback head waits anchor at the app clock's FIRST value: start
+    # the timeline with a non-violating event (price <= 10)
+    s1.send(0, ["start", 5.0, 100])
     s2.send(1500, ["IBM", 30.0, 100])    # past the armed deadline: match
     s2.send(1600, ["DUP", 35.0, 100])    # chain consumed: single match
     m.shutdown()
@@ -109,8 +113,11 @@ def test_head_absent_violated():
     """)
     s1 = rt.get_input_handler("Stream1")
     s2 = rt.get_input_handler("Stream2")
+    s1.send(0, ["start", 5.0, 100])      # clock start (non-violating)
     s1.send(500, ["V", 20.0, 100])       # violates inside the window
-    s2.send(1500, ["IBM", 30.0, 100])
+    # the violated head RE-ARMS at 500 (AbsentPatternTestCase q6/q8):
+    # e2 inside the re-armed window still finds no completed absence
+    s2.send(1400, ["IBM", 30.0, 100])
     m.shutdown()
     assert c.events == []
 
@@ -253,7 +260,9 @@ def test_both_absent_and_completes():
         select e3.symbol as s3
         insert into OutStream;
     """)
+    s1 = rt.get_input_handler("Stream1")
     s3 = rt.get_input_handler("Stream3")
+    s1.send(0, ["start", 5.0, 100])      # clock start (non-violating)
     s3.send(1500, ["C", 40.0, 100])
     m.shutdown()
     got = [tuple(e.data) for e in c.events]

@@ -87,7 +87,11 @@ def test_seq_head_absent_then_event():
         select e2.symbol as symbol
         insert into OutStream;
     """)
+    s1 = rt.get_input_handler("Stream1")
     s2 = rt.get_input_handler("Stream2")
+    # head waits anchor at the clock's first value: start the timeline
+    # with a non-violating Stream1 event (price <= 20)
+    s1.send(0, ["start", 5.0, 100])
     s2.send(2500, ["IBM", 45.0, 100])   # quiet first second passed
     m.shutdown()
     assert [tuple(e.data) for e in c.events] == [("IBM",)]
@@ -134,7 +138,9 @@ def test_seq_every_head_absent_rearms():
     # EveryAbsentSequenceTestCase testQueryAbsent2 shape: each event after
     # its own quiet window matches
     m, rt, c = build(EVERY_HEAD)
+    s1 = rt.get_input_handler("Stream1")
     s2 = rt.get_input_handler("Stream2")
+    s1.send(0, ["start", 5.0, 100])     # clock start (non-violating)
     s2.send(2200, ["IBM", 58.7, 100])
     s2.send(3300, ["WSO2", 68.7, 100])
     m.shutdown()
@@ -144,7 +150,9 @@ def test_seq_every_head_absent_rearms():
 def test_seq_every_head_absent_single_pending():
     # a long quiet stretch yields ONE pending state, not one per second
     m, rt, c = build(EVERY_HEAD)
+    s1 = rt.get_input_handler("Stream1")
     s2 = rt.get_input_handler("Stream2")
+    s1.send(0, ["start", 5.0, 100])     # clock start (non-violating)
     s2.send(5100, ["IBM", 58.7, 100])
     m.shutdown()
     assert [tuple(e.data) for e in c.events] == [("IBM",)]
@@ -198,7 +206,9 @@ def test_seq_every_logical_absent_head_rearms():
         from every not A[v > 0] for 1 sec and not B[v > 0] for 1 sec, e3=Cs
         select e3.v as c insert into OutStream;
     """)
+    ha = rt.get_input_handler("A")
     h = rt.get_input_handler("Cs")
+    ha.send(0, [0])                     # clock start (v=0: non-violating)
     h.send(2500, [1])
     h.send(4000, [2])
     m.shutdown()

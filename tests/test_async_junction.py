@@ -1,0 +1,106 @@
+"""@Async junction behavior: re-batching, max.delay coalescing, and the
+latency-target adaptive batch cap (SURVEY §7 hard part 6 — the knob the
+reference's Disruptor ring does not have; its analog is StreamHandler
+re-batching up to batch.size, StreamHandler.java:57-71)."""
+
+import time
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
+from siddhi_tpu.query_api.definitions import Attribute, AttrType, StreamDefinition
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def _wait_for(predicate, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_async_app_delivers_all_events_with_max_delay():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @Async(buffer.size='256', batch.size='64', max.delay='5 ms')
+        define stream S (sym string, v long);
+        @info(name = 'q')
+        from S select sym, v insert into Out;
+    """)
+    c = Collector()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    for i in range(40):          # trickle: one event per send
+        h.send([f"K{i % 4}", i])
+    assert _wait_for(lambda: len(c.events) == 40), len(c.events)
+    assert [e.data[1] for e in c.events] == list(range(40))  # order kept
+    m.shutdown()
+
+
+def _mk_junction(app_context):
+    sdef = StreamDefinition(id="S", attributes=[
+        Attribute("v", AttrType.LONG)])
+    return StreamJunction(sdef, app_context)
+
+
+class _SlowReceiver(Receiver):
+    def __init__(self, sleep_s):
+        self.sleep_s = sleep_s
+        self.batches = []
+
+    def receive(self, events):
+        time.sleep(self.sleep_s)
+        self.batches.append(len(events))
+
+
+def test_latency_target_shrinks_then_regrows_batch_cap():
+    from siddhi_tpu.core.context import SiddhiAppContext, SiddhiContext
+
+    ctx = SiddhiAppContext(SiddhiContext(), "t")
+    j = _mk_junction(ctx)
+    j.enable_async(buffer_size=4096, batch_size=256,
+                   latency_target_ms=5.0)
+    slow = _SlowReceiver(0.02)   # 20 ms per delivery >> 5 ms target
+    j.subscribe(slow)
+    j.start_processing()
+    for i in range(600):
+        j.send_events([Event(timestamp=i, data=[i])])
+    assert _wait_for(lambda: sum(slow.batches) == 600), sum(slow.batches)
+    assert j._cur_batch < 256, j._cur_batch   # overshoot shrank the cap
+    shrunk = j._cur_batch
+    # receiver turns fast: sustained headroom regrows the cap
+    slow.sleep_s = 0.0
+    for i in range(600):
+        j.send_events([Event(timestamp=i, data=[i])])
+    assert _wait_for(lambda: sum(slow.batches) == 1200), sum(slow.batches)
+    assert j._cur_batch > shrunk, (j._cur_batch, shrunk)
+    j.stop_processing()
+
+
+def test_max_delay_coalesces_trickled_events():
+    from siddhi_tpu.core.context import SiddhiAppContext, SiddhiContext
+
+    ctx = SiddhiAppContext(SiddhiContext(), "t")
+    j = _mk_junction(ctx)
+    j.enable_async(buffer_size=4096, batch_size=1024, max_delay_ms=50.0)
+    rec = _SlowReceiver(0.0)
+    j.subscribe(rec)
+    j.start_processing()
+    # 20 events arriving faster than max.delay coalesce into FEW batches
+    # (without max.delay, an empty queue flushes 1-event batches)
+    for i in range(20):
+        j.send_events([Event(timestamp=i, data=[i])])
+        time.sleep(0.002)
+    assert _wait_for(lambda: sum(rec.batches) == 20), sum(rec.batches)
+    assert len(rec.batches) <= 5, rec.batches
+    j.stop_processing()
