@@ -800,6 +800,11 @@ class Parser:
                 self.next()
                 self.expect_kw("events")
                 return kw
+        if self.peek().is_kw("events"):
+            # bare `insert events into` == current events (SiddhiQL.g4
+            # output_event_type: the type qualifier is optional)
+            self.next()
+            return "current"
         return None
 
     def parse_output_action(self):
@@ -1045,6 +1050,16 @@ class Parser:
             return Constant(False, AttrType.BOOL)
         if t.kind in ("id", "keyword"):
             return self.parse_name_expression()
+        if t.is_op("#"):
+            # inner-stream qualified variable: '#Stream.attr' inside a
+            # partition (SiddhiQL.g4 stream_id: '#'? name)
+            self.next()
+            e = self.parse_name_expression()
+            if not isinstance(e, Variable) or e.stream_id is None:
+                self.error("expected '#stream.attribute' reference", t)
+            return Variable(attribute_name=e.attribute_name,
+                            stream_id="#" + e.stream_id,
+                            stream_index=e.stream_index)
         self.error("expected expression")
 
     def parse_name_expression(self) -> Expression:

@@ -308,6 +308,30 @@ class SiddhiAppRuntime:
             else:
                 raise SiddhiAppValidationException(f"unknown partition type {ptype!r}")
 
+        # streams PRODUCED by queries inside this partition (non-inner
+        # insert targets): a later partition query may consume them, and
+        # their events stay in the producing instance's flow (reference
+        # partition ThreadLocal flow — WindowPartitionTestCase q6 chains
+        # `insert events into OutputStream` -> `from OutputStream`)
+        produced = {
+            q.output_stream.target_id
+            for q in partition.queries
+            if isinstance(q.output_stream, InsertIntoStream)
+            and not q.output_stream.is_inner_stream
+        }
+        consumed = set()
+        for q in partition.queries:
+            ist = q.input_stream
+            for s in ("stream_id", "unique_stream_id"):
+                sid = getattr(ist, s, None)
+                if isinstance(sid, str):
+                    consumed.add(sid)
+            for side in ("left_input_stream", "right_input_stream"):
+                sub = getattr(ist, side, None)
+                sid = getattr(sub, "stream_id", None)
+                if isinstance(sid, str):
+                    consumed.add(sid)
+        pctx.local_streams = produced & consumed
         for query in partition.queries:
             q_index += 1
             self._add_query(query, q_index, partition_ctx=pctx)
@@ -463,6 +487,12 @@ class SiddhiAppRuntime:
                     self.stream_definitions[target] = sdef
                     self._create_junction(sdef)
                 runtime.output_junction = self.junctions[target]
+                if (partition_ctx is not None
+                        and target in getattr(partition_ctx,
+                                              "local_streams", ())):
+                    # a partition-mate consumes this stream: outputs must
+                    # carry the producing instance's pk
+                    runtime.attach_pk = True
                 # record set-element types on the target stream so later
                 # queries (unionSet/sizeOfSet over this stream) and event
                 # decode know how to interpret object set columns
@@ -520,6 +550,13 @@ class SiddhiAppRuntime:
                 sid = s.unique_stream_id
                 if sid in self.named_windows:
                     self.named_windows[sid].out_junction.subscribe(proxies[side_key])
+                elif (partition_ctx is not None and s.is_inner_stream):
+                    if sid not in partition_ctx.inner_junctions:
+                        raise SiddhiAppValidationException(
+                            f"inner stream '{sid}' is consumed before any "
+                            f"query in this partition produces it")
+                    partition_ctx.inner_junctions[sid].subscribe(
+                        proxies[side_key])
                 else:
                     self.junctions[sid].subscribe(proxies[side_key])
         elif partition_ctx is not None and query.input_stream.is_inner_stream:

@@ -168,6 +168,11 @@ class RangePartitionKeyer:
 
     def __init__(self, conditions: List[Tuple[str, Callable]]):
         self._conditions = conditions  # [(label, condition fn)]
+        # highest range id a keyed event has actually hit + 1: range
+        # instances are lazily created too (reference initPartition), so
+        # only instances this watermark covers may receive global-side
+        # broadcast events
+        self.seen_keys = 0
 
     def __len__(self):
         return len(self._conditions)
@@ -190,6 +195,8 @@ class RangePartitionKeyer:
         keep_once = valid & ~is_cur  # TIMER etc. — not range-matched
 
         rows_cur, rngs = np.nonzero(masks)          # row-major: event order kept
+        if rngs.size:
+            self.seen_keys = max(self.seen_keys, int(rngs.max()) + 1)
         rows_other = np.nonzero(keep_once)[0]
         rows = np.concatenate([rows_cur, rows_other])
         pk_out = np.concatenate([rngs, np.zeros(len(rows_other), np.int64)]).astype(np.int32)
@@ -236,6 +243,15 @@ class PartitionContext:
     def num_keys(self) -> int:
         static = [k.static_keys for k in self.keyers.values() if k.static_keys]
         return max(max(static, default=0), len(self.keyspace), 1)
+
+    def active_keys(self) -> int:
+        """Keys whose instances actually EXIST (no 1-floor, no static
+        floor): bounds which instances receive a global stream's events —
+        an instance created later must not see earlier events (reference
+        lazy initPartition). Range keyers report their seen-id watermark,
+        value keyers the allocated keyspace."""
+        seen = [getattr(k, "seen_keys", 0) for k in self.keyers.values()]
+        return max(max(seen, default=0), len(self.keyspace))
 
     def purge(self, now_ms: Optional[int] = None) -> List[int]:
         """Retire idle partition keys, reset their dense state rows in

@@ -345,6 +345,19 @@ def plan_join_query(
             raise CompileError(f"query '{query_name}': stream '{sid}' is not defined")
         sdef = definitions[sid]
         resolver = SingleStreamResolver(sdef, dictionary, ref_id=s.stream_reference_id)
+        # inside a partition EVERY join side keeps per-key window state —
+        # including a GLOBAL (non-partitioned) stream side: the reference
+        # instantiates the whole query per key, so each instance holds its
+        # OWN copy of the global stream's window, fed only with events
+        # that arrived while the instance existed (JoinPartitionTestCase
+        # test10: a late-created instance's twitter window starts empty).
+        # Global-side ingestion broadcasts each event into every ACTIVE
+        # key (join_runtime.process_side_batch).
+        side_keyed = partition_ctx is not None
+        side_global = partition_ctx is not None and not (
+            s.is_inner_stream
+            or sid in partition_ctx.keyers
+            or sid in getattr(partition_ctx, "local_streams", ()))
         filters = []
         post_filters = []
         window_stage = None
@@ -360,7 +373,7 @@ def plan_join_query(
             elif isinstance(h, Window):
                 if window_stage is not None:
                     raise CompileError("only one #window per join side is allowed")
-                if partition_ctx is not None:
+                if side_keyed:
                     from siddhi_tpu.ops.keyed_windows import create_keyed_window_stage
 
                     window_stage = create_keyed_window_stage(
@@ -396,11 +409,7 @@ def plan_join_query(
 
             window_stage = PassthroughWindowStage(window_col_specs(ext_sdef))
         keyer = None
-        if partition_ctx is not None:
-            if sid not in partition_ctx.keyers:
-                raise CompileError(
-                    f"query '{query_name}': join stream '{sid}' is consumed "
-                    f"inside a partition but has no partition-with clause")
+        if partition_ctx is not None and sid in partition_ctx.keyers:
             keyer = partition_ctx.keyers[sid]
         triggers = (
             join.trigger == EventTrigger.ALL
@@ -426,10 +435,19 @@ def plan_join_query(
             transforms=transforms,
             input_definition=sdef if ext_sdef is not sdef else None,
             post_filters=post_filters,
+            global_side=side_global,
+            carried_pk=partition_ctx is not None and (
+                s.is_inner_stream
+                or sid in getattr(partition_ctx, "local_streams", ())),
         )
 
     left = build_side("left", join.left)
     right = build_side("right", join.right)
+    for sd in (left, right):
+        if getattr(sd, "global_side", False) and sd.outer:
+            raise CompileError(
+                f"query '{query_name}': outer join on the non-partitioned "
+                f"side '{sd.stream_id}' inside a partition is not supported")
     if (join.within is not None or join.per is not None) and not any(
         isinstance(s.store, AggregationJoinStore) for s in (left, right)
     ):
@@ -575,10 +593,16 @@ def plan_nfa_query(
         seen_refs = {}
         for st in plan.steps:
             for side in st.sides:
-                r = (side.capture.ref_id if side.capture is not None
-                     and side.capture.ref_id else side.stream_id)
-                seen_refs.setdefault(r, (side.stream_id,
-                                         side.capture is not None))
+                if side.capture is not None and side.capture.ref_id:
+                    key = side.capture.ref_id      # one entry per ref
+                else:
+                    # capture-less (absent) elements are distinct per
+                    # STEP: two `not A` elements must both expand (and
+                    # then hit the duplicate-name rejection below, as the
+                    # reference's output-definition validation would)
+                    key = (st.index, side.stream_id)
+                seen_refs.setdefault(key, (side.stream_id,
+                                           side.capture is not None))
         selection = []
         names = set()
         for ref, (sid, has_cap) in seen_refs.items():
@@ -699,6 +723,10 @@ def plan_query(
             carried_pk = True  # '#stream' rows carry their pk id
         elif stream_id in partition_ctx.keyers:
             partition_keyer = partition_ctx.keyers[stream_id]
+        elif stream_id in getattr(partition_ctx, "local_streams", ()):
+            # produced by a query in the SAME partition: its events carry
+            # the producing instance's pk (reference partition flow ids)
+            carried_pk = True
         else:
             raise CompileError(
                 f"query '{query_name}': stream '{stream_id}' is consumed inside a "

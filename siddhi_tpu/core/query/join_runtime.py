@@ -71,6 +71,12 @@ class JoinSide:
     input_definition: Optional[StreamDefinition] = None
     # filters after the window: mask this side's emitted (trigger) rows
     post_filters: List = field(default_factory=list)
+    # inside a partition: a NON-partitioned stream side — one shared
+    # (unkeyed) window, events visible to every partition instance
+    # (reference: non-partitioned streams reach all instances)
+    global_side: bool = False
+    # inner '#stream' / partition-local side: rows carry their pk
+    carried_pk: bool = False
 
     @property
     def pack_definition(self) -> StreamDefinition:
@@ -147,6 +153,37 @@ class JoinSideProxy(Receiver):
 
     def receive(self, events: List[Event]):
         side = self.runtime.sides[self.side_key]
+        if side.carried_pk:
+            # inner-'#stream' / partition-local side: rows keep the
+            # producing instance's pk. Events WITHOUT a pk (the stream is
+            # a global junction anyone can feed) are broadcast to every
+            # active instance like a global side — attributing them to
+            # instance 0 would corrupt key 0's join state.
+            keyed = [e for e in events if e.pk is not None]
+            bare = [e for e in events if e.pk is None]
+            if keyed:
+                batch = HostBatch.from_events(
+                    keyed, side.pack_definition, self.runtime.dictionary)
+                pk = np.zeros(batch.capacity, np.int32)
+                for i, e in enumerate(keyed):
+                    pk[i] = e.pk
+                batch.cols[PK_KEY] = pk
+                self.runtime.process_side_batch(self.side_key, batch)
+            if bare:
+                n = self.runtime.partition_ctx.active_keys() \
+                    if self.runtime.partition_ctx is not None else 0
+                if n > 0:
+                    rep = [Event(timestamp=e.timestamp, data=e.data,
+                                 is_expired=e.is_expired, pk=k)
+                           for e in bare for k in range(n)]
+                    batch = HostBatch.from_events(
+                        rep, side.pack_definition, self.runtime.dictionary)
+                    pk = np.zeros(batch.capacity, np.int32)
+                    for i, e in enumerate(rep):
+                        pk[i] = e.pk
+                    batch.cols[PK_KEY] = pk
+                    self.runtime.process_side_batch(self.side_key, batch)
+            return
         batch = HostBatch.from_events(events, side.pack_definition, self.runtime.dictionary)
         self.runtime.process_side_batch(self.side_key, batch)
 
@@ -416,9 +453,31 @@ class JoinQueryRuntime(QueryRuntime):
                     cols, pk = side.keyer.apply(cols)
                     batch = HostBatch(cols)
                     cols[PK_KEY] = np.asarray(pk, np.int32)
+                elif side.global_side:
+                    # non-partitioned stream inside a partition: the
+                    # reference hands the event to every EXISTING
+                    # instance (each holds its own window copy), so
+                    # broadcast each row across the key axis, valid only
+                    # for keys active at arrival — a later-created
+                    # instance must NOT see earlier global events
+                    # (JoinPartitionTestCase test10). _ensure_capacity
+                    # runs before K is read so growth precedes the tile.
+                    self._ensure_capacity()
+                    n_active = self.partition_ctx.active_keys()
+                    K = self._win_keys
+                    B = batch.capacity
+                    rep = {}
+                    for name, v in cols.items():
+                        rep[name] = np.repeat(np.asarray(v), K, axis=0)
+                    pk_tile = np.tile(np.arange(K, dtype=np.int32), B)
+                    rep[PK_KEY] = pk_tile
+                    rep[VALID_KEY] = rep[VALID_KEY] & (pk_tile < n_active)
+                    cols = rep
+                    batch = HostBatch(cols)
                 elif PK_KEY not in cols:
                     cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
-                self._ensure_capacity()
+                if not side.global_side:   # global branch ensured already
+                    self._ensure_capacity()
             if side.host_window is not None:
                 now_h = int(self.app_context.timestamp_generator.current_time())
                 hctx = {"xp": np, "current_time": now_h}

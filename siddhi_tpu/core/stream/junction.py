@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 import traceback
 from typing import List, Optional
 
@@ -162,15 +163,11 @@ class StreamJunction:
                                       int(self._cur_batch * 1.25)))
 
     def _timed_deliver(self, events: List[Event]):
-        import time
-
         t0 = time.perf_counter()
         self._deliver(events)
         self._adapt((time.perf_counter() - t0) * 1000.0)
 
     def _drain(self):
-        import time
-
         while True:
             item = self._queue.get()
             if item is None:

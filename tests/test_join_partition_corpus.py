@@ -1,0 +1,340 @@
+"""Reference join-partition corpus — scenarios ported verbatim from
+``query/partition/JoinPartitionTestCase.java`` (feeds + expected counts;
+sleeps become playback clock jumps). Covers keyed/keyed joins, inner
+'#stream' sides, GLOBAL (non-partitioned) sides visible to every
+partition instance, range partitions and unidirectional triggers."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="outputStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+TICK = """
+    define stream Tick (x int);
+    from Tick select x insert into TickOut;
+"""
+
+CSE_TW = """@app:playback
+    define stream cseEventStream (symbol string, user string, volume int);
+    define stream twitterStream (user string, tweet string, company string);
+""" + TICK
+
+
+def test_join_partition_1_both_sides_keyed():
+    """testJoinPartition1 (:46-81): both sides partitioned by user; 2
+    tweets x 1 cse row -> 2 current + 2 expired = 4."""
+    m, rt, c = build(CSE_TW + """
+        partition with (user of cseEventStream, user of twitterStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.time(1 sec)
+            join twitterStream#window.time(1 sec)
+            on cseEventStream.symbol == twitterStream.company
+          select cseEventStream.symbol as symbol, twitterStream.tweet,
+                 cseEventStream.volume
+          insert all events into outputStream;
+        end;
+    """)
+    rt.get_input_handler("cseEventStream").send(1000, ["WSO2", "User1", 100])
+    tw = rt.get_input_handler("twitterStream")
+    tw.send(1100, ["User1", "Hello World", "WSO2"])
+    tw.send(1150, ["User1", "Hellno World", "WSO2"])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert len(c.events) == 4
+
+
+def test_join_partition_2_two_users():
+    """testJoinPartition2 (:87-130): two separate user instances, 2
+    tweets each -> 8 events total."""
+    m, rt, c = build(CSE_TW + """
+        partition with (user of cseEventStream, user of twitterStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.time(1 sec)
+            join twitterStream#window.time(1 sec)
+            on cseEventStream.symbol == twitterStream.company
+          select cseEventStream.symbol as symbol,
+                 cseEventStream.user as user, twitterStream.tweet,
+                 cseEventStream.volume
+          insert all events into outputStream;
+        end;
+    """)
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    cse.send(1000, ["WSO2", "User1", 100])
+    tw.send(1100, ["User1", "Hello World", "WSO2"])
+    tw.send(1150, ["User1", "World", "WSO2"])
+    cse.send(1200, ["IBM", "User2", 100])
+    tw.send(1250, ["User2", "Hello World", "IBM"])
+    tw.send(1300, ["User2", "World", "IBM"])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert len(c.events) == 8
+    users = {tuple(e.data[:2]) for e in c.events}
+    assert users == {("WSO2", "User1"), ("IBM", "User2")}
+
+
+_INNER_CHAIN = CSE_TW + """
+    partition with (user of cseEventStream, user of twitterStream) begin
+      @info(name = 'query1')
+      from cseEventStream#window.time(1 sec)
+        join twitterStream#window.time(1 sec)
+        on cseEventStream.symbol == twitterStream.company
+      select cseEventStream.symbol as symbol, cseEventStream.user as user,
+             twitterStream.tweet, cseEventStream.volume
+      insert all events into #outputStream;
+      @info(name = 'query2')
+      from #outputStream select symbol, user
+      insert all events into {target};
+    end;
+"""
+
+
+def test_join_partition_3_into_inner_stream():
+    """testJoinPartition3 (:137-184): the joined rows flow through an
+    inner '#outputStream' into a second partition query -> 8 events."""
+    m, rt, c = build(_INNER_CHAIN.format(target="outStream"), out="outStream")
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    cse.send(1000, ["WSO2", "User1", 100])
+    tw.send(1100, ["User1", "Hello World", "WSO2"])
+    tw.send(1150, ["User1", "World", "WSO2"])
+    cse.send(1200, ["IBM", "User2", 100])
+    tw.send(1250, ["User2", "Hello World", "IBM"])
+    tw.send(1300, ["User2", "World", "IBM"])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert len(c.events) == 8
+    assert {tuple(e.data) for e in c.events} == {
+        ("WSO2", "User1"), ("IBM", "User2")}
+
+
+def test_join_partition_4_inner_chain_plus_direct_sends():
+    """testJoinPartition4 (:191-237): same inner chain targeting the
+    GLOBAL outputStream, which is ALSO fed directly -> 8 + 2 = 10."""
+    m, rt, c = build(_INNER_CHAIN.format(target="outputStream"))
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    cse.send(1000, ["WSO2", "User1", 100])
+    tw.send(1100, ["User1", "Hello World", "WSO2"])
+    tw.send(1150, ["User1", "World", "WSO2"])
+    cse.send(1200, ["IBM", "User1", 100])
+    tw.send(1250, ["User1", "Hello World", "IBM"])
+    tw.send(1300, ["User1", "World", "IBM"])
+    out_h = rt.get_input_handler("outputStream")
+    out_h.send(1400, ["GOOG", "new_user_1"])
+    out_h.send(1450, ["GOOG", "new_user_2"])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert len(c.events) == 10
+
+
+def test_join_partition_5_inner_join_global_side():
+    """testJoinPartition5 (:243-288): a partitioned inner '#stream' side
+    joined with a GLOBAL twitterStream — global events probe EVERY
+    instance's window (User1's IBM tweet matches User2's row) -> 4."""
+    m, rt, c = build("""@app:playback
+        define stream cseEventStream (symbol string, user string, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+    """ + TICK + """
+        partition with (user of cseEventStream) begin
+          @info(name = 'query2')
+          from cseEventStream
+          select symbol, user, sum(volume) as volume
+          insert all events into #cseInnerStream;
+          @info(name = 'query1')
+          from #cseInnerStream#window.time(1 sec)
+            join twitterStream#window.time(1 sec)
+            on twitterStream.company == #cseInnerStream.symbol
+          select #cseInnerStream.user as user, twitterStream.tweet as tweet,
+                 twitterStream.company, #cseInnerStream.volume as volume
+          insert all events into outputStream;
+        end;
+    """)
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    cse.send(1000, ["WSO2", "User1", 200])
+    cse.send(1100, ["IBM", "User2", 500])
+    tw.send(1200, ["User1", "Hello World", "WSO2"])
+    tw.send(1250, ["User1", "Hello World", "IBM"])
+    tw.send(1300, ["User3", "Hello World", "GOOG"])
+    rt.get_input_handler("Tick").send(3500, [0])
+    m.shutdown()
+    assert len(c.events) == 4
+    pairs = {(e.data[0], e.data[2]) for e in c.events}
+    assert pairs == {("User1", "WSO2"), ("User2", "IBM")}
+
+
+def test_join_partition_6_inner_shadowing_stream_name():
+    """testJoinPartition6 (:295-341): the inner stream shares the outer
+    stream's NAME ('#cseEventStream' vs 'cseEventStream') — ids stay
+    distinct -> 4 events."""
+    m, rt, c = build("""@app:playback
+        define stream cseEventStream (symbol string, user string, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+    """ + TICK + """
+        partition with (user of cseEventStream) begin
+          @info(name = 'query2')
+          from cseEventStream
+          select symbol, user, sum(volume) as volume
+          insert all events into #cseEventStream;
+          @info(name = 'query1')
+          from #cseEventStream#window.time(1 sec)
+            join twitterStream#window.time(1 sec)
+            on twitterStream.company == #cseEventStream.symbol
+          select #cseEventStream.user as user, twitterStream.tweet as tweet,
+                 twitterStream.company, #cseEventStream.volume as volume
+          insert all events into outputStream;
+        end;
+    """)
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    cse.send(1000, ["WSO2", "User1", 200])
+    cse.send(1100, ["IBM", "User2", 500])
+    tw.send(1200, ["User1", "Hello World", "IBM"])
+    tw.send(1250, ["User1", "Hello World", "WSO2"])
+    rt.get_input_handler("Tick").send(3500, [0])
+    m.shutdown()
+    assert len(c.events) == 4
+
+
+def test_join_partition_7_range_partition():
+    """testJoinPartition7 (:342-390): RANGE partition (volume>=100 as
+    'large', volume<100 as 'small') on both streams, on user==user ->
+    2 matches per range instance -> 8 events."""
+    m, rt, c = build("""@app:playback
+        define stream cseEventStream (symbol string, user string, volume int);
+        define stream twitterStream (user string, tweet string,
+                                     company string, volume int);
+    """ + TICK + """
+        partition with (volume >= 100 as 'large' or volume < 100 as 'small'
+                          of cseEventStream,
+                        volume >= 100 as 'large' or volume < 100 as 'small'
+                          of twitterStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.time(1 sec)
+            join twitterStream#window.time(1 sec)
+            on cseEventStream.user == twitterStream.user
+          select cseEventStream.symbol as symbol,
+                 cseEventStream.user as user, twitterStream.tweet,
+                 cseEventStream.volume
+          insert all events into outputStream;
+        end;
+    """)
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    cse.send(1000, ["WSO2", "User1", 200])
+    tw.send(1100, ["User1", "Hello World", "WSO2", 200])
+    tw.send(1150, ["User1", "World", "WSO2", 200])
+    cse.send(1200, ["IBM", "User1", 10])
+    tw.send(1250, ["User1", "Hello World", "WSO2", 10])
+    tw.send(1300, ["User1", "World", "IBM", 10])
+    rt.get_input_handler("Tick").send(3500, [0])
+    m.shutdown()
+    assert len(c.events) == 8
+    assert {e.data[0] for e in c.events} == {"WSO2", "IBM"}
+
+
+def test_join_partition_8_global_twitter_side():
+    """testJoinPartition8 (:97-133 of second half): only cseEventStream
+    is partitioned; the GLOBAL twitter side's tweets (any user) probe the
+    keyed cse windows -> 3 current + 3 expired = 6."""
+    m, rt, c = build(CSE_TW + """
+        partition with (user of cseEventStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.time(1 sec)
+            join twitterStream#window.time(1 sec)
+            on cseEventStream.symbol == twitterStream.company
+          select cseEventStream.symbol as symbol, twitterStream.tweet,
+                 cseEventStream.volume
+          insert all events into outputStream;
+        end;
+    """)
+    rt.get_input_handler("cseEventStream").send(1000, ["WSO2", "User1", 100])
+    tw = rt.get_input_handler("twitterStream")
+    tw.send(1100, ["User1", "Hello World", "WSO2"])
+    tw.send(1150, ["User2", "Hellno World", "WSO2"])
+    tw.send(1200, ["User3", "Hellno World", "WSO2"])
+    rt.get_input_handler("Tick").send(3000, [0])
+    m.shutdown()
+    assert len(c.events) == 6
+
+
+def test_join_partition_9_unidirectional_length_windows():
+    """testJoinPartition9 (:139-180): unidirectional cse trigger,
+    length(1) windows per user -> only cse events arriving AFTER their
+    user's tweet match -> 2."""
+    m, rt, c = build(CSE_TW + """
+        partition with (user of cseEventStream, user of twitterStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.length(1) unidirectional
+            join twitterStream#window.length(1)
+            on cseEventStream.symbol == twitterStream.company
+          select cseEventStream.user, cseEventStream.symbol as symbol,
+                 twitterStream.tweet, cseEventStream.volume
+          insert all events into outputStream;
+        end;
+    """)
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    tw.send(1000, ["User1", "Hello World", "WSO2"])
+    cse.send(1100, ["WSO2", "User1", 100])
+    cse.send(1200, ["WSO2", "User2", 100])
+    tw.send(1250, ["User2", "Hello World", "WSO2"])
+    tw.send(1300, ["User3", "Hello World", "WSO2"])
+    cse.send(1350, ["WSO2", "User3", 100])
+    m.shutdown()
+    assert len(c.events) == 2
+    assert {e.data[0] for e in c.events} == {"User1", "User3"}
+
+
+def test_join_partition_10_chained_partitions_global_side():
+    """testJoinPartition10 (:187-241): partition1's unidirectional join
+    (no on-clause) feeds outputStream1; partition2 re-partitions it and
+    cross-joins the GLOBAL twitter length(1) window — including the
+    expired outputStream1 row displaced from its length(1) window -> 3."""
+    m, rt, c = build("""@app:playback
+        define stream cseEventStream (symbol string, user string, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+    """ + TICK + """
+        partition with (user of cseEventStream, user of twitterStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.length(1) unidirectional
+            join twitterStream#window.length(1)
+          select cseEventStream.symbol as symbol, twitterStream.tweet,
+                 cseEventStream.volume, cseEventStream.user
+          insert all events into outputStream1;
+        end;
+        partition with (user of outputStream1) begin
+          @info(name = 'query2')
+          from outputStream1#window.length(1)
+            join twitterStream#window.length(1)
+          select outputStream1.symbol as symbol, twitterStream.tweet,
+                 outputStream1.volume
+          insert all events into outputStream;
+        end;
+    """)
+    cse = rt.get_input_handler("cseEventStream")
+    tw = rt.get_input_handler("twitterStream")
+    tw.send(1000, ["User1", "Hello World", "WSO2"])
+    cse.send(1100, ["WSO2", "User1", 100])
+    cse.send(1200, ["WSO2", "User2", 100])
+    tw.send(1250, ["User2", "Hello World", "WSO2"])
+    tw.send(1300, ["User3", "Hello World", "WSO2"])
+    cse.send(1350, ["WSO2", "User3", 100])
+    m.shutdown()
+    assert len(c.events) == 3

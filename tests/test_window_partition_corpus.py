@@ -1,0 +1,190 @@
+"""Reference window-partition corpus — scenarios ported verbatim from
+``query/partition/WindowPartitionTestCase.java`` (feeds and expected
+outputs; sleeps become playback clock jumps where timers must fire)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(app, out="OutStockStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+def _rows(c):
+    return [tuple(e.data) for e in c.events]
+
+
+def test_window_partition_q1_length_expired_events():
+    """testWindowPartitionQuery1 (:49-92): per-key length(2) + sum,
+    `insert expired events` — the expired row's aggregate DECREMENTS
+    before the current event applies (chunk order expired-then-current,
+    LengthWindowProcessor.java:124-137): IBM's third event expires 70
+    when sum was 170 -> 100; WSO2's third expires 700 from 1700 -> 1000."""
+    m, rt, c = build("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        partition with (symbol of cseEventStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.length(2)
+          select symbol, sum(price) as price, volume
+          insert expired events into OutStockStream;
+        end;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    for row in [["IBM", 70.0, 100], ["WSO2", 700.0, 100], ["IBM", 100.0, 100],
+                ["IBM", 200.0, 100], ["ORACLE", 75.6, 100],
+                ["WSO2", 1000.0, 100], ["WSO2", 500.0, 100]]:
+        h.send(row)
+    m.shutdown()
+    # stream-callback view: re-publish into the output junction flips
+    # EXPIRED to CURRENT (InsertIntoStreamCallback.java:52-55) — the
+    # reference test's counter name notwithstanding
+    assert len(c.events) == 2
+    assert _rows(c) == [("IBM", 100.0, 100), ("WSO2", 1000.0, 100)]
+
+
+def test_window_partition_q2_length_batch_all_events():
+    """testWindowPartitionQuery2 (:96-137): per-key lengthBatch(2) + sum,
+    `insert all events` — one flush per completed per-key pair."""
+    m, rt, c = build("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        partition with (symbol of cseEventStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.lengthBatch(2)
+          select symbol, sum(price) as price, volume
+          insert all events into OutStockStream;
+        end;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    for row in [["IBM", 70.0, 100], ["WSO2", 700.0, 100], ["IBM", 100.0, 100],
+                ["IBM", 200.0, 100], ["WSO2", 1000.0, 100]]:
+        h.send(row)
+    m.shutdown()
+    current = [e for e in c.events if not e.is_expired]
+    assert [tuple(e.data) for e in current] == [
+        ("IBM", 170.0, 100), ("WSO2", 1700.0, 100)]
+
+
+def test_window_partition_q3_time_window_default_sum():
+    """testWindowPartitionQuery3 (:141-216): per-key time(1 sec) window,
+    `default(sum(price), 0.0)` keeps expired-to-empty outputs at 0.0;
+    per-key current/expired interleavings match the reference callback's
+    asserted sequences."""
+    m, rt, c = build("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream Tick (x int);
+        partition with (symbol of cseEventStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.time(1 sec)
+          select symbol, default(sum(price), 0.0) as price, volume
+          insert all events into OutStockStream;
+        end;
+        from Tick select x insert into TickOut;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 70.0, 100])
+    h.send(1100, ["WSO2", 700.0, 100])
+    h.send(1200, ["IBM", 100.0, 200])
+    tick.send(4200, [0])                 # Thread.sleep(3000): all expire
+    h.send(4300, ["IBM", 200.0, 300])
+    h.send(4400, ["WSO2", 1000.0, 100])
+    tick.send(6500, [0])                 # final drain past expiries
+    m.shutdown()
+    wso2 = [round(e.data[1], 4) for e in c.events if e.data[0] == "WSO2"]
+    ibm = [round(e.data[1], 4) for e in c.events if e.data[0] == "IBM"]
+    assert wso2 == [700.0, 0.0, 1000.0, 0.0]
+    assert ibm == [70.0, 170.0, 100.0, 0.0, 200.0, 0.0]
+
+
+def test_window_partition_q4_length_current_running_sums():
+    """testWindowPartitionQuery4 (:223-...): per-key length(2) + sum,
+    current events only — running per-key sums in arrival order."""
+    m, rt, c = build("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        partition with (symbol of cseEventStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.length(2)
+          select symbol, sum(price) as price, volume
+          insert into OutStockStream;
+        end;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    for row in [["IBM", 70.0, 100], ["WSO2", 700.0, 100], ["IBM", 100.0, 100],
+                ["IBM", 200.0, 100], ["ORACLE", 75.6, 100],
+                ["WSO2", 1000.0, 100], ["WSO2", 500.0, 100]]:
+        h.send(row)
+    m.shutdown()
+    got = [round(e.data[1], 3) for e in c.events]
+    assert got == [70.0, 700.0, 170.0, 300.0, 75.6, 1700.0, 1500.0], got
+    assert not any(e.is_expired for e in c.events)
+
+
+def test_window_partition_q5_time_batch():
+    """testWindowPartitionQuery5: per-key timeBatch(5 sec) + sum — one
+    aggregate row per key at the batch flush."""
+    m, rt, c = build("""@app:playback
+        define stream cseEventStream (symbol string, price double, volume int);
+        define stream Tick (x int);
+        partition with (symbol of cseEventStream) begin
+          @info(name = 'query1')
+          from cseEventStream#window.timeBatch(5 sec)
+          select symbol, sum(price) as price, volume
+          insert into OutStockStream;
+        end;
+        from Tick select x insert into TickOut;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    for row in [["IBM", 70.0, 100], ["WSO2", 700.0, 100], ["IBM", 100.0, 100],
+                ["IBM", 200.0, 100], ["ORACLE", 75.6, 100],
+                ["WSO2", 1000.0, 100], ["WSO2", 500.0, 100]]:
+        h.send(1000, row)
+    rt.get_input_handler("Tick").send(7000, [0])   # Thread.sleep(7000)
+    m.shutdown()
+    by_sym = {e.data[0]: e.data[1] for e in c.events}
+    assert by_sym == {"IBM": 370.0, "WSO2": 2200.0, "ORACLE": 75.6}
+    assert not any(e.is_expired for e in c.events)
+
+
+def test_window_partition_q6_length_batch_chained_query():
+    """testWindowPartitionQuery6: partitioned lengthBatch(2) feeding a
+    second pass-through query — both streams carry each key's flushed
+    pair, 12 output events total."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream SensorStream (id string, sensorValue double);
+        partition with (id of SensorStream) begin
+          @info(name = 'query1')
+          from SensorStream#window.lengthBatch(2)
+          select id, sensorValue
+          insert events into OutputStream;
+          @info(name = 'query2')
+          from OutputStream select * insert into TempStream;
+        end;
+    """)
+    c1, c2 = Collector(), Collector()
+    rt.add_callback("OutputStream", c1)
+    rt.add_callback("TempStream", c2)
+    h = rt.get_input_handler("SensorStream")
+    for row in [["id1", 111.0], ["id1", 112.0], ["id2", 121.0],
+                ["id2", 122.0], ["id3", 131.0], ["id3", 132.0]]:
+        h.send(row)
+    m.shutdown()
+    expected = [("id1", 111.0), ("id1", 112.0), ("id2", 121.0),
+                ("id2", 122.0), ("id3", 131.0), ("id3", 132.0)]
+    assert _rows(c1) == expected
+    assert [tuple(e.data) for e in c2.events] == expected
+    assert len(c1.events) + len(c2.events) == 12
