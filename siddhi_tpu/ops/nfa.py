@@ -1420,6 +1420,23 @@ class NFAStage:
                 in_head_group = jnp.any(A & (ST <= head_gend), axis=1)
             else:
                 in_head_group = None
+            # SEQUENCE: an event absorbed into an ONGOING (pre-completion)
+            # count collection belongs to that chain alone — it must not
+            # also seed a fresh `every` iteration. Collections at/after
+            # eager_tail_start are already complete (their chain emitted),
+            # so absorbs there DO let the event seed the next iteration
+            # (SequencePartitionTestCase q11 vs q3: the rising-run absorb
+            # suppresses, a trailing-star absorb does not).
+            seq_absorbing = None
+            if plan.sequence and plan.every:
+                terms = [jnp.any(at_masks[oi2] & (win == oi2), axis=1)
+                         for oi2, (st2, side2) in enumerate(ops)
+                         if st2.kind == "count" and not side2.absent
+                         and st2.index < plan.eager_tail_start]
+                if terms:
+                    seq_absorbing = terms[0]
+                    for t in terms[1:]:
+                        seq_absorbing = seq_absorbing | t
             fresh_any = jnp.zeros((B,), bool)
             direct = jnp.zeros((B,), bool)
             direct_op = jnp.full((B,), -1, jnp.int32)
@@ -1433,6 +1450,8 @@ class NFAStage:
                 fcond = (side.cond(ev_fresh, ctx)[:, 0]
                          if side.cond is not None else jnp.ones((B,), bool))
                 f = m & every_ok & fcond
+                if seq_absorbing is not None:
+                    f = f & ~seq_absorbing
                 if in_head_group is not None and j <= head_gend:
                     f = f & ~in_head_group
                 if st.kind == "count":

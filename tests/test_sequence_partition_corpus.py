@@ -1,0 +1,402 @@
+"""Reference sequence-partition corpus — all 16 scenarios ported verbatim
+from ``query/partition/SequencePartitionTestCase.java`` (feeds + expected
+rows/counts; float32 prices compared rounded)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def build(query, streams=None, partition=None):
+    streams = streams or """
+        define stream Stream1 (symbol string, price float, volume int);
+        define stream Stream2 (symbol string, price float, volume int);
+    """
+    partition = partition or "partition with (volume of Stream1, volume of Stream2)"
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        streams + partition + " begin @info(name = 'query1') "
+        + query + " end;")
+    c = Collector()
+    rt.add_callback("OutputStream", c)
+    return m, rt, c
+
+
+def _rows(c):
+    out = []
+    for e in c.events:
+        out.append(tuple(round(v, 4) if isinstance(v, float) else v
+                         for v in e.data))
+    return out
+
+
+def test_seq_partition_1_basic_per_key():
+    m, rt, c = build("""
+        from e1=Stream1[price>20], e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 55.6, 100])
+    s1.send(["BIRT", 55.6, 200])
+    s2.send(["GOOG", 55.7, 200])
+    s2.send(["IBM", 55.7, 100])
+    m.shutdown()
+    assert _rows(c) == [("BIRT", "GOOG"), ("WSO2", "IBM")]
+
+
+def test_seq_partition_2_strict_continuity_per_key():
+    """testSequencePartitionQuery2: in a SEQUENCE the second Stream1 event
+    kills the first pending match per key — only the 300-volume instance
+    (single e1 then e2) emits."""
+    m, rt, c = build("""
+        from every e1=Stream1[price>20], e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 55.6, 100])
+    s1.send(["GOOG", 57.6, 100])
+    s2.send(["IBM", 65.7, 100])
+    s1.send(["WSO2", 55.6, 100])
+    s1.send(["GOOG", 57.6, 200])
+    s2.send(["IBM", 65.7, 300])
+    m.shutdown()
+    assert _rows(c) == [("GOOG", "IBM")]
+
+
+def test_seq_partition_3_trailing_star_eager():
+    m, rt, c = build("""
+        from every e1=Stream1[price>20], e2=Stream2[price>e1.price]*
+        select e1.symbol as symbol1, e2[0].symbol as symbol2,
+               e2[1].symbol as symbol3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(["WSO2", 55.6, 100])
+    s1.send(["IBM", 55.7, 100])
+    s1.send(["BIRT", 55.6, 200])
+    s1.send(["GOOG", 55.7, 200])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", None, None), ("IBM", None, None),
+                        ("BIRT", None, None), ("GOOG", None, None)]
+
+
+def test_seq_partition_4_leading_star_per_key():
+    m, rt, c = build("""
+        from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2,
+               e2.price as price3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 59.6, 100])
+    s2.send(["WSO2", 55.6, 100])
+    s1.send(["BIRT", 69.6, 200])
+    s2.send(["BIRT", 65.6, 200])
+    s2.send(["IBM", 55.7, 100])
+    s2.send(["GOOG", 75.7, 200])
+    s1.send(["WSO2", 57.6, 100])
+    s1.send(["BIRT", 87.6, 200])
+    m.shutdown()
+    assert _rows(c) == [(55.6, 55.7, 57.6), (65.6, 75.7, 87.6)]
+
+
+def test_seq_partition_5_leading_star_two_rounds():
+    m, rt, c = build("""
+        from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2,
+               e2.price as price3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 59.6, 100])
+    s2.send(["WSO2", 55.6, 100])
+    s2.send(["IBM", 55.0, 100])
+    s1.send(["WSO2", 57.6, 100])
+    s2.send(["WSO2", 85.6, 1000])
+    s2.send(["IBM", 85.0, 1000])
+    s1.send(["WSO2", 87.6, 1000])
+    m.shutdown()
+    assert _rows(c) == [(55.6, 55.0, 57.6), (85.6, 85.0, 87.6)]
+
+
+def test_seq_partition_6_optional_head_no_match():
+    m, rt, c = build("""
+        from every e1=Stream2[price>20]?, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e2.price as price3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 59.6, 100])
+    s2.send(["WSO2", 55.6, 100])
+    s2.send(["IBM", 55.7, 100])
+    s1.send(["WSO2", 57.6, 200])
+    m.shutdown()
+    assert _rows(c) == []
+
+
+_OR_SEQ = """
+    from every e1=Stream2[price>20], e2=Stream2[price>e1.price]
+         or e3=Stream2[symbol=='IBM']
+    select e1.price as price1, e2.price as price2, e3.price as price3
+    insert into OutputStream;
+"""
+
+
+def test_seq_partition_7_or_left_priority():
+    m, rt, c = build(_OR_SEQ)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["WSO2", 59.6, 100])
+    s2.send(["WSO2", 55.6, 100])
+    s2.send(["IBM", 55.7, 100])
+    s2.send(["WSO2", 57.6, 100])
+    s2.send(["WSO2", 599.6, 4100])
+    s2.send(["WSO2", 55.6, 4100])
+    s2.send(["IBM", 155.7, 4100])
+    s2.send(["WSO2", 457.6, 4100])
+    m.shutdown()
+    assert _rows(c) == [(55.6, 55.7, None), (55.7, 57.6, None),
+                        (55.6, 155.7, None), (155.7, 457.6, None)]
+
+
+def test_seq_partition_8_or_right_fires():
+    m, rt, c = build(_OR_SEQ)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["WSO2", 59.6, 100])
+    s2.send(["WSO2", 259.6, 200])
+    s2.send(["WSO2", 55.6, 100])
+    s2.send(["WSO2", 155.6, 200])
+    s2.send(["IBM", 55.0, 100])
+    s2.send(["IBM", 95.0, 200])
+    s2.send(["WSO2", 57.6, 100])
+    s2.send(["WSO2", 207.6, 200])
+    m.shutdown()
+    assert _rows(c) == [(55.6, None, 55.0), (155.6, None, 95.0),
+                        (55.0, 57.6, None), (95.0, 207.6, None)]
+
+
+def test_seq_partition_9_or_mixed():
+    m, rt, c = build(_OR_SEQ)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["WSO2", 59.6, 100])
+    s2.send(["WSO2", 155.6, 200])
+    s2.send(["WSO2", 55.6, 100])
+    s2.send(["WSO2", 57.6, 100])
+    s2.send(["IBM", 55.7, 100])
+    s2.send(["WSO2", 207.6, 200])
+    m.shutdown()
+    assert _rows(c) == [(55.6, 57.6, None), (57.6, None, 55.7),
+                        (155.6, 207.6, None)]
+
+
+def test_seq_partition_10_plus_min_one():
+    m, rt, c = build("""
+        from every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2,
+               e2.price as price3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 59.6, 100])
+    s2.send(["WSO2", 55.6, 100])
+    s1.send(["WSO2", 57.6, 100])
+    s2.send(["WSO2", 55.6, 120])
+    s1.send(["WSO2", 57.6, 150])
+    m.shutdown()
+    assert _rows(c) == [(55.6, None, 57.6)]
+
+
+def test_seq_partition_11_rising_run_then_drop():
+    """testSequencePartitionQuery11: collect a non-decreasing run with a
+    self-referencing count condition, emit on the first drop — per key."""
+    m, rt, c = build("""
+        from every e1=Stream1[price>20],
+             e2=Stream1[((e2[last].price is null) and price>=e1.price)
+                  or ((not (e2[last].price is null))
+                      and price>=e2[last].price)]+,
+             e3=Stream1[price<e2[last].price]
+        select e1.price as price1, e2[0].price as price2,
+               e2[1].price as price3, e3.price as price4
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(["WSO2", 29.6, 100])
+    s1.send(["WSO2", 35.6, 100])
+    s1.send(["WSO2", 57.6, 100])
+    s1.send(["IBM", 47.6, 100])
+    s1.send(["WSO2", 129.6, 10])
+    s1.send(["WSO2", 135.6, 10])
+    s1.send(["WSO2", 157.6, 10])
+    s1.send(["IBM", 147.6, 10])
+    m.shutdown()
+    assert _rows(c) == [(29.6, 35.6, 57.6, 47.6),
+                        (129.6, 135.6, 157.6, 147.6)]
+
+
+STOCK_TWITTER = """
+    define stream StockStream (symbol string, price float, volume int,
+                               name string);
+    define stream TwitterStream (symbol string, count int, user string);
+"""
+
+
+def test_seq_partition_12_cross_stream_keys():
+    m, rt, c = build("""
+        from every e1=StockStream[price >= 50 and volume > 100],
+             e2=TwitterStream[count > 10]
+        select e1.price as price, e1.symbol as symbol, e2.count as count
+        insert into OutputStream;
+    """, streams=STOCK_TWITTER,
+        partition="partition with (name of StockStream, user of TwitterStream)")
+    stock = rt.get_input_handler("StockStream")
+    tw = rt.get_input_handler("TwitterStream")
+    stock.send(["IBM", 75.6, 105, "user"])
+    stock.send(["GOOG", 51.0, 101, "user"])
+    stock.send(["IBM", 76.6, 111, "user"])
+    stock.send(["IBM", 76.6, 111, "user2"])
+    tw.send(["IBM", 20, "user"])
+    stock.send(["WSO2", 45.6, 100, "user"])
+    tw.send(["GOOG", 20, "user"])
+    m.shutdown()
+    assert _rows(c) == [(76.6, "IBM", 20)]
+
+
+def test_seq_partition_13_star_mid_chain():
+    m, rt, c = build("""
+        from every e1=StockStream[price >= 50 and volume > 100],
+             e2=StockStream[price <= 40]*, e3=StockStream[volume <= 70]
+        select e1.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.symbol as symbol3
+        insert into OutputStream;
+    """, streams=STOCK_TWITTER,
+        partition="partition with (name of StockStream, user of TwitterStream)")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["IBM", 75.6, 105, "user"])
+    stock.send(["GOOG", 21.0, 81, "user"])
+    stock.send(["WSO2", 176.6, 65, "user"])
+    stock.send(["GOOG", 75.6, 105, "user2"])
+    stock.send(["BIRT", 21.0, 81, "user2"])
+    stock.send(["DDD", 176.6, 65, "user2"])
+    m.shutdown()
+    assert _rows(c) == [("IBM", "GOOG", "WSO2"), ("GOOG", "BIRT", "DDD")]
+
+
+STOCK12 = """
+    define stream StockStream1 (symbol string, price float, volume int,
+                                quantity int);
+    define stream StockStream2 (symbol string, price float, volume int,
+                                quantity int);
+"""
+_Q14_FEED_BLOCK = [
+    ("StockStream1", ["IBM", 75.6, 105]),
+    ("StockStream2", ["GOOG", 21.0, 81]),
+    ("StockStream2", ["WSO2", 176.6, 65]),
+    ("StockStream1", ["BIRT", 21.0, 81]),
+    ("StockStream1", ["AMBA", 126.6, 165]),
+    ("StockStream2", ["DDD", 23.0, 181]),
+    ("StockStream2", ["BIRT", 21.0, 86]),
+    ("StockStream2", ["BIRT", 21.0, 82]),
+    ("StockStream2", ["WSO2", 176.6, 60]),
+    ("StockStream1", ["AMBA", 126.6, 165]),
+    ("StockStream2", ["DOX", 16.2, 25]),
+]
+
+
+def test_seq_partition_14_two_quantities():
+    m, rt, c = build("""
+        from every e1=StockStream1[price >= 50 and volume > 100],
+             e2=StockStream2[price <= 40]*, e3=StockStream2[volume <= 70]
+        select e3.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.volume as volume
+        insert into OutputStream;
+    """, streams=STOCK12,
+        partition="partition with (quantity of StockStream1, quantity of StockStream2)")
+    for q in (2, 22):
+        for sid, row in _Q14_FEED_BLOCK:
+            rt.get_input_handler(sid).send(row + [q])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "GOOG", 65), ("WSO2", "DDD", 60),
+                        ("DOX", None, 25)] * 2
+
+
+def test_seq_partition_15_cross_capture_filter():
+    m, rt, c = build("""
+        from every e1=StockStream1[price >= 50 and volume > 100],
+             e2=StockStream2[e1.symbol != 'AMBA']*,
+             e3=StockStream2[volume <= 70]
+        select e3.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.volume as volume
+        insert into OutputStream;
+    """, streams=STOCK12,
+        partition="partition with (quantity of StockStream1, quantity of StockStream2)")
+    for q in (10, 100):
+        for sid, row in _Q14_FEED_BLOCK:
+            rt.get_input_handler(sid).send(row + [q])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "GOOG", 65), ("DOX", None, 25)] * 2
+
+
+def test_seq_partition_16_interleaved_keys():
+    """testSequencePartitionQuery16: three quantity instances interleaved
+    mid-feed — per-key chains stay independent."""
+    m, rt, c = build("""
+        from every e1=StockStream1, e2=StockStream2[e1.symbol != 'AMBA']*,
+             e3=StockStream2[volume <= 70]
+        select e3.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.volume as volume, e1.quantity as quantity
+        insert into OutputStream;
+    """, streams=STOCK12,
+        partition="partition with (quantity of StockStream1, quantity of StockStream2)")
+    s1 = rt.get_input_handler("StockStream1")
+    s2 = rt.get_input_handler("StockStream2")
+    s1.send(["IBM", 75.6, 105, 5])
+    s2.send(["GOOG", 21.0, 81, 5])
+    s2.send(["WSO2", 176.6, 65, 5])
+    s1.send(["BIRT", 21.0, 81, 5])
+    s1.send(["AMBA", 126.6, 165, 5])
+    s1.send(["IBM", 75.6, 105, 155])
+    s2.send(["GOOG", 21.0, 81, 155])
+    s2.send(["WSO2", 176.6, 65, 155])
+    s1.send(["BIRT", 21.0, 81, 155])
+    s2.send(["DDD", 23.0, 181, 5])
+    s2.send(["BIRT", 21.0, 86, 5])
+    s2.send(["BIRT", 21.0, 82, 5])
+    s2.send(["WSO2", 176.6, 60, 5])
+    s1.send(["AMBA", 126.6, 165, 5])
+    s2.send(["DOX", 16.2, 25, 5])
+    s1.send(["AMBA", 126.6, 165, 155])
+    s2.send(["DDD", 23.0, 181, 155])
+    s2.send(["BIRT", 21.0, 86, 155])
+    s2.send(["BIRT", 21.0, 82, 155])
+    s2.send(["WSO2", 176.6, 60, 155])
+    s1.send(["IBM", 75.6, 105, 55])
+    s2.send(["GOOG", 21.0, 81, 55])
+    s2.send(["WSO2", 176.6, 65, 55])
+    s1.send(["BIRT", 21.0, 81, 55])
+    s1.send(["AMBA", 126.6, 165, 55])
+    s2.send(["DDD", 23.0, 181, 55])
+    s2.send(["BIRT", 21.0, 86, 55])
+    s2.send(["BIRT", 21.0, 82, 55])
+    s2.send(["WSO2", 176.6, 60, 55])
+    s1.send(["AMBA", 126.6, 165, 55])
+    s2.send(["DOX", 16.2, 25, 55])
+    s1.send(["AMBA", 126.6, 165, 155])
+    s2.send(["DOX", 16.2, 25, 155])
+    m.shutdown()
+    assert _rows(c) == [("WSO2", "GOOG", 65, 5), ("WSO2", "GOOG", 65, 155),
+                        ("DOX", None, 25, 5), ("WSO2", "GOOG", 65, 55),
+                        ("DOX", None, 25, 55), ("DOX", None, 25, 155)]
