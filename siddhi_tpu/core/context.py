@@ -65,6 +65,23 @@ class TimestampGenerator:
         except ValueError:
             pass
 
+    def once_first_time(self, fn):
+        """Run ``fn(first_ts)`` when the event clock first advances
+        (playback arming: wall time is unreachable by the event clock, so
+        periodic cycles and quiet windows anchor at the FIRST event ts).
+        Returns a cancel() callable — callers MUST cancel on re-arm or
+        job cancellation, or a stale anchor starts a second chain."""
+        def _listener(ts: int):
+            self.remove_time_change_listener(_listener)
+            fn(ts)
+
+        self.add_time_change_listener(_listener)
+
+        def cancel():
+            self.remove_time_change_listener(_listener)
+
+        return cancel
+
 
 class SiddhiAppContext:
     """Per-app context (reference ``core/config/SiddhiAppContext.java``)."""

@@ -122,20 +122,10 @@ class NFAQueryRuntime(QueryRuntime):
         if arm_j is None or self.partition_ctx is not None:
             return
         if self.app_context.playback:
-            self._arm_pending = True
             tsg = self.app_context.timestamp_generator
-
-            def on_first_ts(ts):
-                if self._arm_pending:
-                    self._arm_pending = False
-                    self._arm_at(int(ts))
-                tsg.remove_time_change_listener(on_first_ts)
-
-            tsg.add_time_change_listener(on_first_ts)
+            tsg.once_first_time(lambda ts: self._arm_at(int(ts)))
             return
         self._arm_at(int(self.app_context.timestamp_generator.current_time()))
-
-    _arm_pending = False
 
     def _arm_at(self, now: int):
         plan = self.stage.plan

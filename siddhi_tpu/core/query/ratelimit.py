@@ -141,29 +141,36 @@ class GroupEventRateLimiter(OutputRateLimiter):
         self.kind = kind
         self.key_fn = key_fn
         self._counter = 0
-        self._first_seen: set = set()
+        self._group_counts: dict = {}
         self._last: dict = {}
 
     def reset(self):
         self._counter = 0
-        self._first_seen.clear()
+        self._group_counts.clear()
         self._last.clear()
 
     def process(self, events: List[Event]):
         out: List[Event] = []
         for ev in events:
-            self._counter += 1
             k = self.key_fn(ev)
             if self.kind == "first":
-                if k not in self._first_seen:
-                    self._first_seen.add(k)
+                # per-group counter: emit the group's 1st event, swallow its
+                # next value-1, then re-arm (FirstGroupByPerEventOutput
+                # RateLimiter.java:58-68 — entry removed at count value-1)
+                count = self._group_counts.get(k)
+                if count is None:
+                    self._group_counts[k] = 1
                     out.append(ev)
-            else:  # last
+                elif count == self.value - 1:
+                    del self._group_counts[k]
+                else:
+                    self._group_counts[k] = count + 1
+            else:  # last: GLOBAL counter over last-per-group insertion-order
+                # map (LastGroupByPerEventOutputRateLimiter.java:63-72)
+                self._counter += 1
                 self._last[k] = ev
-            if self._counter == self.value:
-                self._counter = 0
-                self._first_seen.clear()
-                if self.kind == "last":
+                if self._counter == self.value:
+                    self._counter = 0
                     out.extend(self._last.values())
                     self._last.clear()
         if out:

@@ -85,6 +85,9 @@ class Scheduler:
 
     def cancel(self, job):
         job.cancelled = True
+        if getattr(job, "_anchor_cancel", None) is not None:
+            job._anchor_cancel()
+            job._anchor_cancel = None
 
     def clear_pending(self):
         """Drop every pending timer of the abandoned timeline (snapshot
@@ -119,8 +122,26 @@ class _PeriodicJob:
         self.callback = callback
         self.cancelled = False
 
+    _anchor_cancel = None
+
     def arm(self):
-        now = self.scheduler.app_context.timestamp_generator.current_time()
+        ctx = self.scheduler.app_context
+        if self._anchor_cancel is not None:
+            # re-arm (snapshot-restore clear_pending): a stale first-event
+            # anchor would start a second interleaved periodic chain
+            self._anchor_cancel()
+            self._anchor_cancel = None
+        if ctx.playback and ctx.timestamp_generator._last_event_ts < 0:
+            def _anchor(first_ts: int):
+                self._anchor_cancel = None
+                if self.cancelled:
+                    return
+                self.next_ts = first_ts + self.interval_ms
+                self.scheduler.notify_at(self.next_ts, self._tick)
+
+            self._anchor_cancel = ctx.timestamp_generator.once_first_time(_anchor)
+            return
+        now = ctx.timestamp_generator.current_time()
         self.next_ts = now + self.interval_ms
         self.scheduler.notify_at(self.next_ts, self._tick)
 

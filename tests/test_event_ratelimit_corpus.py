@@ -1,0 +1,277 @@
+"""Reference event-rate-limit corpus — all 18 scenarios ported verbatim from
+``query/ratelimit/EventOutputRateLimitTestCase.java`` (feeds and expected
+output counts; the reference's 1 s sleeps need no analog because event-count
+limiters fire synchronously).
+
+Semantics under test (reference ``query/output/ratelimit/event/*.java``):
+- ``output all every N events``: accumulate, flush all N at the N-th event
+  (AllPerEventOutputRateLimiter.java:49-76).
+- ``output first every N events``: emit the 1st event of each N-window.
+- ``output last every N events``: emit the N-th event of each N-window.
+- group-by + first: per-group counter, re-armed after the group's N-th event
+  (FirstGroupByPerEventOutputRateLimiter.java:49-76).
+- group-by + last: GLOBAL counter, last-per-group LinkedHashMap flushed at
+  the N-th event (LastGroupByPerEventOutputRateLimiter.java:50-83).
+"""
+
+from siddhi_tpu import SiddhiManager, QueryCallback
+
+
+class Counter(QueryCallback):
+    def __init__(self):
+        self.count = 0
+        self.remove_count = 0
+        self.in_rows = []
+        self.remove_rows = []
+        self.arrived = False
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.count += len(in_events)
+            self.in_rows.extend(tuple(e.data) for e in in_events)
+        if remove_events:
+            self.remove_count += len(remove_events)
+            self.remove_rows.extend(tuple(e.data) for e in remove_events)
+        self.arrived = True
+
+
+def run(output_clause, feed, select="select ip", window=""):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+        define stream LoginEvents (timestamp long, ip string);
+        @info(name = 'query1')
+        from LoginEvents{window}
+        {select}
+        {output_clause}
+        insert into uniqueIps;
+    """)
+    c = Counter()
+    rt.add_callback("query1", c)
+    h = rt.get_input_handler("LoginEvents")
+    rt.start()
+    for ip in feed:
+        h.send([0, ip])
+    m.shutdown()
+    return c
+
+
+FEED5 = ["192.10.1.3", "192.10.1.3", "192.10.1.4", "192.10.1.3", "192.10.1.5"]
+FEED8 = ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+         "192.10.1.4", "192.10.1.4", "192.10.1.4", "192.10.1.30"]
+FEED12 = ["192.10.1.5", "192.10.1.3", "192.10.1.3", "192.10.1.9",
+          "192.10.1.3", "192.10.1.4", "192.10.1.4", "192.10.1.4",
+          "192.10.1.30", "192.10.1.31", "192.10.1.32", "192.10.1.33"]
+
+
+def test_event_rate_q1_all_every_2():
+    """testEventOutputRateLimitQuery1 (:45-97): `output all every 2 events`,
+    5 sends -> two full pairs flushed = 4; trailing odd event held back."""
+    c = run("output all every 2 events", FEED5)
+    assert c.arrived and c.remove_count == 0
+    assert c.count == 4
+
+
+def test_event_rate_q2_bare_output_every_2():
+    """testEventOutputRateLimitQuery2 (:99-149): bare `output every 2 events`
+    defaults to ALL (OutputRate.java type default) — same 4 as q1."""
+    c = run("output every 2 events", FEED5)
+    assert c.arrived and c.remove_count == 0
+    assert c.count == 4
+
+
+def test_event_rate_q3_all_every_5_of_8():
+    """testEventOutputRateLimitQuery3 (:151-205): every 5 of 8 sends -> one
+    flush of 5; the trailing 3 are held."""
+    c = run("output every 5 events", FEED8)
+    assert c.arrived and c.remove_count == 0
+    assert c.count == 5
+
+
+def test_event_rate_q4_first_every_2():
+    """testEventOutputRateLimitQuery4 (:207-260): `output first every 2
+    events` over 5 sends emits events 1,3,5 — the reference also asserts
+    every emitted ip is one of .5/.9/.3."""
+    feed = ["192.10.1.5", "192.10.1.3", "192.10.1.9", "192.10.1.4", "192.10.1.3"]
+    c = run("output first every 2 events", feed)
+    assert c.count == 3
+    assert [r[0] for r in c.in_rows] == ["192.10.1.5", "192.10.1.9", "192.10.1.3"]
+
+
+def test_event_rate_q5_first_every_3():
+    """testEventOutputRateLimitQuery5 (:262-314): first every 3 over 5 sends
+    emits events 1,4 (.5 and .4)."""
+    feed = ["192.10.1.5", "192.10.1.3", "192.10.1.9", "192.10.1.4", "192.10.1.3"]
+    c = run("output first every 3 events", feed)
+    assert c.count == 2
+    assert [r[0] for r in c.in_rows] == ["192.10.1.5", "192.10.1.4"]
+
+
+def test_event_rate_q6_last_every_2():
+    """testEventOutputRateLimitQuery6 (:316-368): last every 2 over 5 sends
+    emits events 2,4 (.5 and .4); trailing odd event held."""
+    feed = ["192.10.1.3", "192.10.1.5", "192.10.1.3", "192.10.1.4", "192.10.1.3"]
+    c = run("output last every 2 events", feed)
+    assert c.count == 2
+    assert [r[0] for r in c.in_rows] == ["192.10.1.5", "192.10.1.4"]
+
+
+def test_event_rate_q7_last_every_4():
+    """testEventOutputRateLimitQuery7 (:370-421): last every 4 over 5 sends
+    emits only event 4 (.4)."""
+    feed = ["192.10.1.3", "192.10.1.5", "192.10.1.3", "192.10.1.4", "192.10.1.3"]
+    c = run("output last every 4 events", feed)
+    assert c.count == 1
+    assert [r[0] for r in c.in_rows] == ["192.10.1.4"]
+
+
+def test_event_rate_q8_group_by_first_every_5():
+    """testEventOutputRateLimitQuery8 (:423-476): group by ip + first every 5:
+    per-group counters -> .5,.3,.9,.4,.30 each emit on first sight = 5."""
+    c = run("output first every 5 events", FEED8, select="select ip group by ip")
+    assert c.count == 5
+
+
+def test_event_rate_q9_group_by_last_every_5():
+    """testEventOutputRateLimitQuery9 (:478-533): group by ip + last every 5:
+    GLOBAL counter hits 5 once in 8 events -> flush last-per-group
+    {.5,.3,.9,.4} = 4."""
+    c = run("output last every 5 events", FEED8, select="select ip group by ip")
+    assert c.count == 4
+
+
+def test_event_rate_q10_group_by_first_rearm():
+    """testEventOutputRateLimitQuery10 (:535-590): first every 5 with a group
+    seen 6x: the 5th occurrence re-arms the group but does NOT emit; the 6th
+    (per-group) occurrence would emit — here .4's run of 5 re-arms at its
+    5th so only the initial sighting of each of 5 groups emits = 5."""
+    feed = ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+            "192.10.1.4", "192.10.1.4", "192.10.1.4", "192.10.1.4",
+            "192.10.1.4", "192.10.1.30"]
+    c = run("output first every 5 events", feed, select="select ip group by ip")
+    assert c.count == 5
+
+
+def test_event_rate_q11_group_by_last_two_flushes():
+    """testEventOutputRateLimitQuery11 (:592-648): last every 5 group-by over
+    10 events: flush at event 5 = {.5,.3,.9,.4} (4), flush at event 10 =
+    {.4,.30,.3} (3) -> 7."""
+    feed = ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+            "192.10.1.4", "192.10.1.4", "192.10.1.4", "192.10.1.30",
+            "192.10.1.3", "192.10.1.30"]
+    c = run("output last every 5 events", feed, select="select ip group by ip")
+    assert c.count == 7
+
+
+def test_event_rate_q12_batch_window_group_by_last():
+    """testEventOutputRateLimitQuery12 (:651-710): lengthBatch(4) + group-by
+    selector emits ONE event per group per batch (QuerySelector batched
+    group-by path); limiter sees 3+2+4 selector outputs, global counter hits
+    5 once -> flush last-per-group {.5,.3,.9,.4} = 4."""
+    c = run("output last every 5 events", FEED12,
+            select="select ip, count() as total group by ip",
+            window="#window.lengthBatch(4)")
+    assert c.count == 4
+
+
+def test_event_rate_q13_batch_window_last_every_2():
+    """testEventOutputRateLimitQuery13 (:712-769): lengthBatch(4) without
+    group-by emits one aggregated event per batch (3 batches); last every 2
+    fires once at the 2nd batch output -> 1."""
+    c = run("output last every 2 events", FEED12,
+            select="select ip, count() as total",
+            window="#window.lengthBatch(4)")
+    assert c.count == 1
+
+
+def test_event_rate_q14_batch_window_last_expired():
+    """testEventOutputRateLimitQuery14 (:771-828): as q13 but `insert expired
+    events` — the limiter counts currents AND expireds; exactly 1 expired
+    event reaches the callback and no currents."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream LoginEvents (timestamp long, ip string);
+        @info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip, count() as total
+        output last every 2 events
+        insert expired events into uniqueIps;
+    """)
+    c = Counter()
+    rt.add_callback("query1", c)
+    h = rt.get_input_handler("LoginEvents")
+    rt.start()
+    for ip in FEED12:
+        h.send([0, ip])
+    m.shutdown()
+    assert c.count == 0
+    assert c.remove_count == 1
+
+
+def test_event_rate_q15_batch_window_all_expired():
+    """testEventOutputRateLimitQuery15 (:831-888): all every 2 + `insert
+    expired events` over 3 lengthBatch(4) flushes -> 2 expired events reach
+    the callback (the 3rd is held in an incomplete pair)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream LoginEvents (timestamp long, ip string);
+        @info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip, count() as total
+        output all every 2 events
+        insert expired events into uniqueIps;
+    """)
+    c = Counter()
+    rt.add_callback("query1", c)
+    h = rt.get_input_handler("LoginEvents")
+    rt.start()
+    for ip in FEED12:
+        h.send([0, ip])
+    m.shutdown()
+    assert c.count == 0
+    assert c.remove_count == 2
+
+
+def test_event_rate_q16_batch_window_group_by_all_expired():
+    """testEventOutputRateLimitQuery16 (:890-948): group-by + all every 2 +
+    `insert expired events`: 4 expired events reach the callback."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream LoginEvents (timestamp long, ip string);
+        @info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip, count() as total
+        group by ip
+        output all every 2 events
+        insert expired events into uniqueIps;
+    """)
+    c = Counter()
+    rt.add_callback("query1", c)
+    h = rt.get_input_handler("LoginEvents")
+    rt.start()
+    for ip in FEED12:
+        h.send([0, ip])
+    m.shutdown()
+    assert c.count == 0
+    assert c.remove_count == 4
+
+
+def test_event_rate_q17_group_by_first_every_2():
+    """testEventOutputRateLimitQuery17 (:950-1006): first every 2 group-by:
+    per-group window of 2 (emit 1st, swallow 2nd, re-arm) over 11 events = 8."""
+    feed = ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.5",
+            "192.10.1.5", "192.10.1.9", "192.10.1.4", "192.10.1.4",
+            "192.10.1.4", "192.10.1.5", "192.10.1.30"]
+    c = run("output first every 2 events", feed, select="select ip group by ip")
+    assert c.count == 8
+
+
+def test_event_rate_q18_first_every_2_values():
+    """testEventOutputRateLimitQuery18 (:1008-1067): first every 2 (no
+    group-by) over 11 events emits positions 1,3,5,7,9,11 = 6, every emitted
+    ip in {.5,.4} per the feed layout."""
+    feed = ["192.10.1.5", "192.10.1.3", "192.10.1.5", "192.10.1.5",
+            "192.10.1.5", "192.10.1.9", "192.10.1.4", "192.10.1.4",
+            "192.10.1.4", "192.10.1.30", "192.10.1.5"]
+    c = run("output first every 2 events", feed)
+    assert c.count == 6
+    assert all(r[0] in ("192.10.1.5", "192.10.1.4") for r in c.in_rows)

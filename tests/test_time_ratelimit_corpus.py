@@ -1,0 +1,331 @@
+"""Reference time-rate-limit corpus — all 17 scenarios ported verbatim from
+``query/ratelimit/TimeOutputRateLimitTestCase.java`` (feeds and expected
+counts; Thread.sleep boundaries become playback timestamps, with the limiter
+cycle anchored at the first event ts = 1000, so ticks land at 2000, 3000, …).
+
+Semantics under test (reference ``query/output/ratelimit/time/*.java``):
+- ``output [all] every T``: accumulate, flush everything on each tick.
+- ``output first every T``: emit the window's 1st event immediately, reset
+  on tick; group-by variant = first sighting of each group per window.
+- ``output last every T``: flush the held last (or last-per-group) on tick.
+- With lengthBatch + group-by + `insert all events`, the selector's batched
+  group-by map is keyed by group ONLY, so a same-chunk CURRENT overwrites
+  the EXPIRED of its group (QuerySelector.java:315-338) — this collapse is
+  what produces the reference's remove-counts below.
+"""
+
+from siddhi_tpu import SiddhiManager, QueryCallback, StreamCallback
+
+
+class Counter(QueryCallback):
+    def __init__(self):
+        self.in_count = 0
+        self.remove_count = 0
+        self.in_rows = []
+        self.remove_rows = []
+        self.arrived = False
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.in_count += len(in_events)
+            self.in_rows.extend(tuple(e.data) for e in in_events)
+        if remove_events:
+            self.remove_count += len(remove_events)
+            self.remove_rows.extend(tuple(e.data) for e in remove_events)
+        self.arrived = True
+
+
+def build(query_body):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""@app:playback
+        define stream LoginEvents (timestamp long, ip string);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        {query_body}
+        from Tick select x insert into TickOut;
+    """)
+    c = Counter()
+    rt.add_callback("query1", c)
+    rt.start()
+    return m, rt, c, rt.get_input_handler("LoginEvents"), rt.get_input_handler("Tick")
+
+
+def feed(h, ts, ips):
+    for ip in ips:
+        h.send(ts, [ts, ip])
+
+
+def test_time_rate_q1_all_every_sec():
+    """testTimeOutputRateLimitQuery1 (:52-107): 2+2+1+1 events across four
+    1 s windows, each flushed whole on its tick = 6."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip output every 1 sec insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.3"])
+    feed(h, 2100, ["192.10.1.9", "192.10.1.4"])
+    feed(h, 3200, ["192.10.1.30"])
+    feed(h, 5200, ["192.10.1.40"])
+    tick.send(6500, [0])
+    assert c.arrived and c.remove_count == 0
+    assert c.in_count == 6
+    m.shutdown()
+
+
+def test_time_rate_q2_all_keyword_every_sec():
+    """testTimeOutputRateLimitQuery2 (:109-164): explicit `output all every
+    1 sec`, same flush-per-window accounting = 6."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip output all every 1 sec insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.3"])
+    feed(h, 2100, ["192.10.1.9", "192.10.1.4"])
+    feed(h, 3200, ["192.10.1.30"])
+    feed(h, 4700, ["192.10.1.40"])
+    tick.send(6500, [0])
+    assert c.arrived and c.remove_count == 0
+    assert c.in_count == 6
+    m.shutdown()
+
+
+def test_time_rate_q3_all_bursts():
+    """testTimeOutputRateLimitQuery3 (:166-221): bursts of 5 then 3 in
+    consecutive windows = 8."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip output every 1 sec insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.arrived and c.remove_count == 0
+    assert c.in_count == 8
+    m.shutdown()
+
+
+def test_time_rate_q4_first_every_sec():
+    """testTimeOutputRateLimitQuery4 (:223-280): first of each window:
+    .5 (w1), .9 (w2), .30 (w3) = 3."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip output first every 1 sec insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.3"])
+    feed(h, 2100, ["192.10.1.9", "192.10.1.4"])
+    feed(h, 3200, ["192.10.1.30"])
+    tick.send(4500, [0])
+    assert c.in_count == 3 and c.remove_count == 0
+    assert [r[0] for r in c.in_rows] == ["192.10.1.5", "192.10.1.9", "192.10.1.30"]
+    m.shutdown()
+
+
+def test_time_rate_q5_last_every_sec():
+    """testTimeOutputRateLimitQuery5 (:282-339): last of each window flushed
+    on its tick: .3, .4, .30 = 3 (reference asserts >= 3 for timing slop;
+    playback is exact)."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip output last every 1 sec insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.3"])
+    feed(h, 2100, ["192.10.1.9", "192.10.1.4"])
+    feed(h, 3200, ["192.10.1.30"])
+    tick.send(4500, [0])
+    assert c.in_count == 3 and c.remove_count == 0
+    assert [r[0] for r in c.in_rows] == ["192.10.1.3", "192.10.1.4", "192.10.1.30"]
+    m.shutdown()
+
+
+def test_time_rate_q6_group_by_first():
+    """testTimeOutputRateLimitQuery6 (:341-398): first-per-group per window:
+    {.5,.3,.9,.4} then {.4,.30} = 6."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip group by ip output first every 1 sec "
+        "insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 6 and c.remove_count == 0
+    m.shutdown()
+
+
+def test_time_rate_q7_group_by_last():
+    """testTimeOutputRateLimitQuery7 (:400-457): last-per-group flushed per
+    window: {.5,.3,.9,.4} then {.4,.30} = 6."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip group by ip output last every 1 sec "
+        "insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 6 and c.remove_count == 0
+    m.shutdown()
+
+
+def test_time_rate_q8_batch_window_group_by_last():
+    """testTimeOutputRateLimitQuery8 (:459-516): lengthBatch(2) batched
+    group-by emits one current per group per batch; window flushes
+    {.5,.3,.9} then {.4,.30} = 5."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(2) select ip group by ip "
+        "output last every 1 sec insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 5 and c.remove_count == 0
+    m.shutdown()
+
+
+def test_time_rate_q9_batch_window_group_by_last_expired():
+    """testTimeOutputRateLimitQuery9 (:518-575): `insert expired events`
+    admits only EXPIRED selector outputs; windows flush {.5} then
+    {.3,.9,.4} = 4 removes, zero currents."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(2) select ip group by ip "
+        "output last every 1 sec insert expired events into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 0
+    assert c.remove_count == 4
+    m.shutdown()
+
+
+def test_time_rate_q10_batch_window_group_by_first_expired():
+    """testTimeOutputRateLimitQuery10 (:577-633): first-per-group over the
+    expired-only stream: {.5} then {.3,.9,.4} = 4 removes."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(2) select ip, count() as total "
+        "group by ip output first every 1 sec insert expired events into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 0
+    assert c.remove_count == 4
+    m.shutdown()
+
+
+def test_time_rate_q11_batch_window_group_by_first_all_events():
+    """testTimeOutputRateLimitQuery11 (:636-695): `insert all events` —
+    first sighting of each group per window, expired or current: w1 emits
+    cur .5, cur .3, cur .9 (exp .5 is a repeat sighting); w2 emits exp .3,
+    exp .9, cur .4, cur .30 (the batch-collapse removed exp .4):
+    in=5, remove=2."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(2) select ip, count() as total "
+        "group by ip output first every 1 sec insert all events into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 5
+    assert c.remove_count == 2
+    m.shutdown()
+
+
+def test_time_rate_q12_batch_window_group_by_last_all_events():
+    """testTimeOutputRateLimitQuery12 (:697-756): last-per-group with type
+    kept: w1 flush {.5:exp, .3:cur, .9:cur}; w2 flush {.3:exp, .9:exp,
+    .4:cur, .30:cur} -> in=4, remove=3."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(2) select ip, count() as total "
+        "group by ip output last every 1 sec insert all events into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 4
+    assert c.remove_count == 3
+    m.shutdown()
+
+
+def test_time_rate_q13_batch_window_group_by_all_all_events():
+    """testTimeOutputRateLimitQuery13 (:758-817): accumulate-everything per
+    window: w1 = 3 cur + 1 exp, w2 = 3 cur + 2 exp (exp .4 collapsed away
+    by the same-chunk current) -> in=6, remove=3."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(2) select ip, count() as total "
+        "group by ip output all every 1 sec insert all events into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.4", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 6
+    assert c.remove_count == 3
+    m.shutdown()
+
+
+def test_time_rate_q14_partitioned_group_by_last():
+    """testTimeOutputRateLimitQuery14 (:819-873): partition by symbol +
+    group-by + last every 1 sec, StreamCallback: one flush per window =
+    .3, .4, .30 (3 events)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream LoginEvents (timestamp long, ip string, symbol string);
+        define stream Tick (x int);
+        partition with (symbol of LoginEvents) begin
+          @info(name = 'query1')
+          from LoginEvents
+          select ip
+          group by symbol
+          output last every 1 sec
+          insert into uniqueIps;
+        end;
+        from Tick select x insert into TickOut;
+    """)
+    rows = []
+    cb = StreamCallback()
+    cb.receive = lambda events: rows.extend(tuple(e.data) for e in events)
+    rt.add_callback("uniqueIps", cb)
+    rt.start()
+    h = rt.get_input_handler("LoginEvents")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, [1000, "192.10.1.5", "WSO2"])
+    h.send(1000, [1000, "192.10.1.3", "WSO2"])
+    h.send(2100, [2100, "192.10.1.9", "WSO2"])
+    h.send(2100, [2100, "192.10.1.4", "WSO2"])
+    h.send(3200, [3200, "192.10.1.30", "WSO2"])
+    tick.send(4500, [0])
+    assert [r[0] for r in rows] == ["192.10.1.3", "192.10.1.4", "192.10.1.30"]
+    m.shutdown()
+
+
+def test_time_rate_q15_first_emits_immediately():
+    """testTimeOutputRateLimitQuery15 (:875-928): `output first every 1 sec`
+    emits the very first event synchronously — asserted BEFORE any tick."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip, count() as total output first every 1 sec "
+        "insert all events into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    assert c.arrived
+    assert c.in_count == 1
+    assert c.remove_count == 0
+    m.shutdown()
+
+
+def test_time_rate_q16_group_by_first_emits_immediately():
+    """testTimeOutputRateLimitQuery16 (:930-984): group-by first emits each
+    new group synchronously: 4 groups -> in=4 before any tick."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents select ip, count() as total group by ip "
+        "output first every 1 sec insert all events into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    assert c.arrived
+    assert c.in_count == 4
+    assert c.remove_count == 0
+    m.shutdown()
+
+
+def test_time_rate_q17_batch_window_group_by_first_currents():
+    """testTimeOutputRateLimitQuery17 (:986-1045): lengthBatch(2) + group-by
+    + first, currents only: w1 emits .5,.3,.9; w2 emits .4 (batch3), .5 and
+    .30 (batch4) -> in=6, remove=0."""
+    m, rt, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(2) select ip, count() as total "
+        "group by ip output first every 1 sec insert into uniqueIps;")
+    feed(h, 1000, ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4"])
+    feed(h, 2100, ["192.10.1.4", "192.10.1.5", "192.10.1.30"])
+    tick.send(3500, [0])
+    assert c.in_count == 6
+    assert c.remove_count == 0
+    m.shutdown()
