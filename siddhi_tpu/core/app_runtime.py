@@ -512,9 +512,24 @@ class SiddhiAppRuntime:
             raise SiddhiAppValidationException(
                 f"unsupported output action {type(out).__name__}")
 
+        from siddhi_tpu.query_api.execution import JoinInputStream as _JIS
+
+        sp = getattr(runtime, "selector_plan", None)
+        agg_positions = tuple(getattr(sp, "agg_positions", ()) or ())
+        # every join counts as windowed (QueryParser.java:149); a named
+        # window source is windowed too (the window junction delivers its
+        # expireds); else a #window handler on the single stream
+        src_id = getattr(query.input_stream, "unique_stream_id", None)
+        windowed = (isinstance(query.input_stream, _JIS)
+                    or src_id in self.named_windows
+                    or getattr(runtime, "window_stage", None) is not None
+                    or getattr(runtime, "host_window", None) is not None)
+        from siddhi_tpu.core.query.ratelimit import rate_uses_group_key
+
         group_key_fn = None
-        if query.selector.group_by_list and query.output_rate is not None:
-            # grouped queries get per-group first/last limiters (reference
+        if query.selector.group_by_list and rate_uses_group_key(
+                query.output_rate, windowed, agg_positions):
+            # grouped queries get per-group limiter variants (reference
             # OutputParser picks the GroupBy limiter classes)
             gb_names = {v.attribute_name for v in query.selector.group_by_list}
             positions = tuple(i for i, (n, _t) in enumerate(runtime.output_attrs)
@@ -522,6 +537,16 @@ class SiddhiAppRuntime:
             if positions:
                 group_key_fn = lambda ev, _p=positions: tuple(  # noqa: E731
                     ev.data[i] for i in _p)
+            else:
+                # group key not projected (`select sum(calls) group by ip`):
+                # ride the dense group-id column into Event.gk — the
+                # reference keys its limiters on GroupedComplexEvent's
+                # groupKey, which exists whether or not it is selected.
+                # Inside partitions GK already folds the partition id in
+                # (GroupKeyer keys on (pk, group)), so grouping stays
+                # correct per partition instance.
+                runtime.limiter_needs_gk = True
+                group_key_fn = lambda ev: ev.gk  # noqa: E731
         # inside a partition each key is its OWN query instance in the
         # reference — wrap the limiter per partition key (events carry pk)
         limiter_partitioned = (partition_ctx is not None
@@ -530,7 +555,11 @@ class SiddhiAppRuntime:
             runtime.limiter_needs_pk = True
         runtime.rate_limiter = create_rate_limiter(
             query.output_rate, runtime.send_to_callbacks, group_key_fn,
-            partitioned=limiter_partitioned)
+            partitioned=limiter_partitioned,
+            windowed=windowed,
+            agg_positions=agg_positions,
+            out_size=len(getattr(runtime, "output_attrs", ()) or ()),
+            empty_send=getattr(runtime, "send_empty_to_query_callbacks", None))
         runtime.scheduler = self.app_context.scheduler
 
         from siddhi_tpu.query_api.execution import JoinInputStream, StateInputStream

@@ -41,6 +41,9 @@ class Event:
     # analog of the reference's ThreadLocal partition flow id,
     # SiddhiAppContext.java:55). None outside partitions.
     pk: Optional[int] = None
+    # dense group-key id (GroupedComplexEvent.getGroupKey analog) — attached
+    # only when a grouped rate limiter needs a key that isn't projected
+    gk: Optional[int] = None
 
     def __repr__(self):
         return f"Event{{timestamp={self.timestamp}, data={list(self.data)}, isExpired={self.is_expired}}}"
@@ -410,11 +413,13 @@ class HostBatch:
         dictionary: StringDictionary,
         types_wanted: Optional[Sequence[int]] = None,
         pk_key: Optional[str] = None,
+        gk_key: Optional[str] = None,
         object_meta: Optional[Dict[str, object]] = None,
         object_multi: Optional[set] = None,
     ) -> List[Event]:
         """Decode valid rows into Events (optionally filtered by type).
-        ``pk_key`` names a partition-id column to attach as Event.pk.
+        ``pk_key`` names a partition-id column to attach as Event.pk;
+        ``gk_key`` a group-id column to attach as Event.gk.
         ``object_meta`` maps OBJECT (set-valued) attr names to their
         element AttrType (raw int codes without it); ``object_multi``
         names the attrs that are MULTI-element sets — decoding one whose
@@ -424,6 +429,7 @@ class HostBatch:
         types = np.asarray(self.cols[TYPE_KEY])
         ts = np.asarray(self.cols[TS_KEY])
         pk_col = self.cols.get(pk_key) if pk_key is not None else None
+        gk_col = self.cols.get(gk_key) if gk_key is not None else None
         keep = valid
         if types_wanted is not None:
             keep = keep & np.isin(types, list(types_wanted))
@@ -492,4 +498,8 @@ class HostBatch:
             pks = np.asarray(pk_col)[idx].tolist()
             for ev, p in zip(out, pks):
                 ev.pk = int(p)
+        if gk_col is not None:
+            gks = np.asarray(gk_col)[idx].tolist()
+            for ev, g in zip(out, gks):
+                ev.gk = int(g)
         return out

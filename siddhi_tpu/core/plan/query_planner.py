@@ -13,6 +13,7 @@ from siddhi_tpu.core.context import SiddhiAppContext
 from siddhi_tpu.core.plan.resolvers import SingleStreamResolver
 from siddhi_tpu.core.plan.selector_plan import plan_selector
 from siddhi_tpu.core.query.runtime import GroupKeyer, QueryRuntime
+from siddhi_tpu.compiler.errors import SiddhiAppValidationException
 from siddhi_tpu.ops.expressions import CompileError, compile_condition, compile_expr
 from siddhi_tpu.query_api.definitions import StreamDefinition
 from siddhi_tpu.query_api.execution import (
@@ -22,6 +23,7 @@ from siddhi_tpu.query_api.execution import (
     JoinType,
     Query,
     SingleInputStream,
+    SnapshotOutputRate,
     StateInputStream,
     StreamFunction,
     Window,
@@ -698,6 +700,16 @@ def plan_query(
     partition_ctx=None,
 ) -> QueryRuntime:
     input_stream = query.input_stream
+    if isinstance(query.output_rate, SnapshotOutputRate):
+        # snapshot rate limiting requires `insert all events` on EVERY query
+        # shape — single stream, join, pattern (QueryParser.java:120-128)
+        oet = (query.output_stream.output_event_type
+               if query.output_stream else "current")
+        if oet != "all":
+            raise SiddhiAppValidationException(
+                "As the query is performing snapshot rate limiting, it can "
+                "only insert 'ALL_EVENTS' but it is inserting "
+                f"'{oet.upper()}_EVENTS'!")
     if isinstance(input_stream, StateInputStream):
         return plan_nfa_query(query, query_name, app_context, definitions, partition_ctx)
     if isinstance(input_stream, JoinInputStream):
@@ -818,6 +830,11 @@ def plan_query(
                     log_stages.append(log_stage)
 
     output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
+    if isinstance(query.output_rate, SnapshotOutputRate):
+        # snapshot rate limiting disables the selector's batch collapse
+        # (QueryParser.java:221-223; `insert all events` is validated at
+        # the plan_query entry for every query shape)
+        batch_mode = False
     selector_plan = plan_selector(
         selector=query.selector,
         input_attrs=[(a.name, a.type) for a in ext_def.attributes],

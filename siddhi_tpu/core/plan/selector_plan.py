@@ -153,6 +153,10 @@ class SelectorPlan:
     # outputs that are MULTI-element sets (unionSet results): their base
     # column is the live COUNT; singletons' base column is the element code
     object_multi: List[str] = field(default_factory=list)
+    # output positions whose projection contains an aggregator call —
+    # drives snapshot-limiter variant selection
+    # (WrappedSnapshotOutputRateLimiter.java:67-74)
+    agg_positions: List[int] = field(default_factory=list)
 
     @property
     def contains_aggregator(self) -> bool:
@@ -289,9 +293,17 @@ def plan_selector(
     set_cols: List[Tuple[str, str]] = []
     object_meta: Dict[str, Optional[AttrType]] = {}
     object_multi: List[str] = []
+    agg_positions: List[int] = []
     for name, expr in selections:
         n_specs = len(specs)
         rewritten = _rewrite_aggregators(expr, specs, resolver)
+        if (len(specs) > n_specs and isinstance(rewritten, Variable)
+                and rewritten.attribute_name.startswith("__agg")):
+            # only TOP-LEVEL aggregator projections count — `sum(v)+0` is a
+            # non-aggregate output to the snapshot-variant chooser
+            # (WrappedSnapshotOutputRateLimiter.java:70 checks the outermost
+            # executor's type)
+            agg_positions.append(len(output_attrs))
         # synthetic agg columns resolve through the same resolver
         _augment_synthetic(resolver, specs)
         fn, t = compile_expr(rewritten, resolver)
@@ -354,6 +366,7 @@ def plan_selector(
         set_cols=set_cols,
         object_meta=object_meta,
         object_multi=object_multi,
+        agg_positions=agg_positions,
     )
 
 

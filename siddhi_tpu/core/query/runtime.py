@@ -152,6 +152,7 @@ class QueryRuntime(Receiver):
         self.carried_pk = carried_pk      # input is an inner '#stream': rows carry pk
         self.attach_pk = False            # output goes to an inner '#stream'
         self.limiter_needs_pk = False     # partitioned rate limiter routing
+        self.limiter_needs_gk = False     # grouped limiter, key not projected
         self._win_keys = 1
         if partition_ctx is not None:
             self._win_keys = max(_pow2(partition_ctx.num_keys()), 16)
@@ -704,6 +705,7 @@ class QueryRuntime(Receiver):
         events = out.to_events(
             self.output_attrs, self.dictionary,
             pk_key=PK_KEY if want_pk else None,
+            gk_key=GK_KEY if self.limiter_needs_gk else None,
             object_meta=self.selector_plan.object_meta or None,
             object_multi=set(self.selector_plan.object_multi) or None,
         )
@@ -711,6 +713,14 @@ class QueryRuntime(Receiver):
             self.rate_limiter.process(events)
         else:
             self.send_to_callbacks(events)
+
+    def send_empty_to_query_callbacks(self):
+        """Snapshot limiters deliver EMPTY flushes to QueryCallbacks as
+        (null, null) — SnapshotOutputRateLimitTestCase q21 counts them —
+        while stream junctions/actions see nothing."""
+        ts = self.app_context.timestamp_generator.current_time()
+        for cb in self.query_callbacks:
+            cb.receive(ts, None, None)
 
     def send_to_callbacks(self, events: List[Event]):
         if not events:

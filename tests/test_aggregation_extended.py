@@ -65,11 +65,13 @@ def test_purge_retention():
     agg = rt.aggregations["TradeAgg"]
     _send(rt, 10_000, [["A", 1.0, 1]])
     _send(rt, 400_000, [["A", 2.0, 1]])
-    purged = agg.purge(now=400_000)       # sec retention 120s: 10s bucket dies
+    # the 10s-interval purge job rides the playback event clock, so the
+    # jump to 400s already swept the expired sec bucket; an explicit purge
+    # afterwards finds nothing more to do
+    agg.purge(now=400_000)                # sec retention 120s: 10s bucket dies
     rows = {r[0]: r[2] for r in agg.rows(Duration.SECONDS)}
     min_rows = {r[0]: r[2] for r in agg.rows(Duration.MINUTES)}
     m.shutdown()
-    assert purged >= 1
     assert rows == {400_000: 2.0}
     # the minute store still holds the older data (coarse retention)
     assert min_rows == {0: 1.0, 360_000: 2.0}

@@ -88,9 +88,17 @@ def test_annotation_nesting_and_elements():
 
 def test_output_rate_forms():
     for clause in ["output every 3 events", "output last every 1 sec",
-                   "output first every 2 events", "output all every 1 min",
-                   "output snapshot every 1 sec"]:
+                   "output first every 2 events", "output all every 1 min"]:
         builds(BASE + f"from S select sym, price {clause} insert into O;")
+    # snapshot rate limiting REQUIRES `insert all events`
+    # (QueryParser.java:120-128)
+    builds(BASE + "from S select sym, price output snapshot every 1 sec "
+                  "insert all events into O;")
+    import pytest
+    from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+    with pytest.raises(SiddhiAppValidationException):
+        builds(BASE + "from S select sym, price output snapshot every 1 sec "
+                      "insert into O;")
 
 
 def test_join_type_keywords():

@@ -1,0 +1,555 @@
+"""Reference snapshot-rate-limit corpus — all 28 scenarios ported verbatim
+from ``query/ratelimit/SnapshotOutputRateLimitTestCase.java``.
+
+Timing convention: the reference anchors the snapshot cycle at app START
+(scheduledTime = start + value); here a priming Tick at ts=0 pins the
+playback anchor to 0, events use the reference's cumulative sleep offsets,
+and a final Tick at the reference's assert moment drains the pending ticks
+— bundle/event counts then map 1:1.
+
+Variant semantics (reference ``ratelimit/snapshot/*.java``):
+- no window:            re-emit last event / last-per-group each tick
+- window, no agg:       re-emit the window's contents (group-by ignored)
+- window, ALL agg:      re-emit last aggregate row; expiry clears it
+  (per-group holders with live counts when grouped)
+- window, some agg:     window contents with aggregate positions patched to
+  the latest values; ONE row per group when grouped
+- empty flushes reach QueryCallbacks as (null, null) (q21) but never
+  stream callbacks (q12).
+"""
+
+from siddhi_tpu import SiddhiManager, QueryCallback, StreamCallback
+
+
+class Bundles(StreamCallback):
+    """Collects each delivery as one bundle of (data...) rows."""
+
+    def __init__(self):
+        super().__init__()
+        self.bundles = []
+
+    def receive(self, events):
+        self.bundles.append([tuple(e.data) for e in events])
+
+    @property
+    def events(self):
+        return [r for b in self.bundles for r in b]
+
+
+class QBundles(QueryCallback):
+    def __init__(self):
+        self.receives = 0          # every receive, incl. (null, null)
+        self.in_bundles = []       # non-null inEvents deliveries
+
+    def receive(self, timestamp, in_events, remove_events):
+        self.receives += 1
+        if in_events:
+            self.in_bundles.append([tuple(e.data) for e in in_events])
+
+    @property
+    def in_events(self):
+        return [r for b in self.in_bundles for r in b]
+
+
+def build(query_body, stream_attrs="timestamp long, ip string", cb=None,
+          on="uniqueIps"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""@app:playback
+        define stream LoginEvents ({stream_attrs});
+        define stream Tick (x int);
+        @info(name = 'query1')
+        {query_body}
+        from Tick select x insert into TickOut;
+    """)
+    c = cb if cb is not None else Bundles()
+    rt.add_callback(on, c)
+    rt.start()
+    h = rt.get_input_handler("LoginEvents")
+    tick = rt.get_input_handler("Tick")
+    tick.send(0, [0])  # pin the snapshot anchor at t=0 (= reference app start)
+    return m, c, h, tick
+
+
+IP5, IP3, IP9, IP4 = "192.10.1.5", "192.10.1.3", "192.10.1.9", "192.10.1.4"
+IP6, IP7, IP8, IP30 = "192.10.1.6", "192.10.1.7", "192.10.1.8", "192.10.1.30"
+
+
+def test_snapshot_q1_last_event_reemitted():
+    """q1 (:53-107): no window — each tick re-emits only the LAST event."""
+    m, c, h, tick = build(
+        "from LoginEvents select ip output snapshot every 1 sec "
+        "insert all events into uniqueIps;")
+    h.send(0, [0, IP5])
+    h.send(10, [10, IP3])
+    tick.send(1500, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP3,)]]
+
+
+def test_snapshot_q2_last_repeats_every_tick():
+    """q2 (:110-162): the held last event re-emits on EVERY tick (2 ticks
+    before shutdown -> 2 copies); the empty pre-event tick emits nothing."""
+    m, c, h, tick = build(
+        "from LoginEvents select ip output snapshot every 1 sec "
+        "insert all events into uniqueIps;")
+    h.send(1200, [0, IP5])
+    h.send(1700, [0, IP3])
+    tick.send(3900, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP3,)], [(IP3,)]]
+
+
+def test_snapshot_q3_last_switches_mid_stream():
+    """q3 (:165-224): last-event snapshot follows the newest event."""
+    m, c, h, tick = build(
+        "from LoginEvents select ip output snapshot every 1 sec "
+        "insert all events into uniqueIps;")
+    h.send(0, [0, IP5])
+    h.send(100, [0, IP3])
+    h.send(2300, [0, IP9])
+    h.send(2400, [0, IP4])
+    tick.send(3500, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP3,)], [(IP3,)], [(IP4,)]]
+
+
+def test_snapshot_q4_group_by_last_per_group():
+    """q4 (:225-283): group-by without window — last-per-group map only
+    GROWS (groups never retire): 3 bundles, 2+2+3 = 7 events."""
+    m, c, h, tick = build(
+        "from LoginEvents select ip group by ip output snapshot every 1 sec "
+        "insert all events into uniqueIps;")
+    h.send(1100, [0, IP5])
+    h.send(1100, [0, IP3])
+    h.send(3300, [0, IP5])
+    h.send(3300, [0, IP4])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP5,), (IP3,)], [(IP5,), (IP3,)],
+                         [(IP5,), (IP3,), (IP4,)]]
+
+
+def test_snapshot_q5_group_by_running_sums():
+    """q5 (:285-346): unwindowed sum group-by — snapshots carry the RUNNING
+    per-group sums; bundle 3 shows (5, 16) after the second pair."""
+    m, c, h, tick = build(
+        "from LoginEvents select ip, sum(calls) as totalCalls group by ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1100, [0, IP5, 3])
+    h.send(1100, [0, IP3, 6])
+    h.send(3300, [0, IP5, 2])
+    h.send(3300, [0, IP3, 10])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert len(c.bundles) == 3
+    assert c.bundles[0] == [(IP5, 3), (IP3, 6)]
+    assert c.bundles[2] == [(IP5, 5), (IP3, 16)]
+
+
+def test_snapshot_q5_1_windowed_group_by_count_dedup():
+    """q5_1 (:348-397): time(2s) + count() group-by — some-agg grouped
+    snapshots emit ONE row per group (constructOutputChunk dedup): every
+    bundle has 2 rows, counts (2, 2)."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(2 sec) select ip, count() as totalCalls "
+        "group by ip output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    for ip, calls in [(IP5, 3), (IP3, 6), (IP5, 2), (IP3, 10)]:
+        h.send(1100, [0, ip, calls])
+    tick.send(4100, [0])
+    m.shutdown()
+    assert len(c.bundles) == 2
+    for b in c.bundles:
+        assert b == [(IP5, 2), (IP3, 2)]
+
+
+def test_snapshot_q6_windowed_all_agg_group_by():
+    """q6 (:399-454): time(1s) + `select sum(calls)` group-by (ALL outputs
+    aggregated): per-group last-value holders; a group whose window empties
+    stops emitting. Bundles: (3,6) then (2,10)."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select sum(calls) as totalCalls "
+        "group by ip output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1100, [0, IP5, 3])
+    h.send(1100, [0, IP3, 6])
+    h.send(3300, [0, IP5, 2])
+    h.send(3300, [0, IP3, 10])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert c.bundles == [[(3,), (6,)], [(2,), (10,)]]
+
+
+def test_snapshot_q7_all_agg_group_by_long_window():
+    """q7 (:456-511): time(5s) sum group-by — overlapping pairs: 7 bundles
+    of 2 rows = 14 events; values (3,6) -> (5,16) -> (2,10)."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(5 sec) select sum(calls) as totalCalls "
+        "group by ip output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1100, [0, IP5, 3])
+    h.send(1100, [0, IP3, 6])
+    h.send(3400, [0, IP5, 2])
+    h.send(3400, [0, IP3, 10])
+    tick.send(10600, [0])
+    m.shutdown()
+    assert len(c.bundles) == 7
+    assert len(c.events) == 14
+    assert c.bundles[0] == [(3,), (6,)]
+    assert c.bundles[2] == [(5,), (16,)]
+    assert c.bundles[5] == [(2,), (10,)]
+
+
+def test_snapshot_q8_all_agg_no_group():
+    """q8 (:513-567): time(1s) sum (no group-by): last aggregate row,
+    CLEARED by expiry — bundles (9) then (12)."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select sum(calls) as totalCalls "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1100, [0, IP5, 3])
+    h.send(1200, [0, IP3, 6])
+    h.send(3400, [0, IP5, 2])
+    h.send(3500, [0, IP3, 10])
+    tick.send(4700, [0])
+    m.shutdown()
+    assert c.bundles == [[(9,)], [(12,)]]
+
+
+def test_snapshot_q9_all_agg_no_group_long_window():
+    """q9 (:569-625): time(5s) sum — (9), (9), (21) across three ticks."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(5 sec) select sum(calls) as totalCalls "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1100, [0, IP5, 3])
+    h.send(1200, [0, IP3, 6])
+    h.send(3400, [0, IP5, 2])
+    h.send(3500, [0, IP3, 10])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert c.bundles == [[(9,)], [(9,)], [(21,)]]
+
+
+def test_snapshot_q10_window_contents_at_boundary():
+    """q10 (:627-680): time(2s) window + snapshot every 2s, tick and expiry
+    tie at t=2000 — the limiter flush (armed first) wins: both rows emit."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(2 sec) select ip "
+        "output snapshot every 2 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(0, [0, IP5, None])
+    h.send(0, [0, IP3, None])
+    tick.send(2000, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP5,), (IP3,)]]
+
+
+def test_snapshot_q11_window_contents_before_expiry():
+    """q11 (:682-735): time(1s), events at 1.2s: the 2s tick sees them
+    (expiry 2.2s), the 3s tick sees an empty window."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1200, [0, IP5, None])
+    h.send(1200, [0, IP3, None])
+    tick.send(3400, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP5,), (IP3,)]]
+
+
+def test_snapshot_q12_one_bundle_then_window_empties():
+    """q12 (:737-782): events at 0.1s expire at 1.1s — only the 1s tick
+    flushes (one bundle); empty flushes never reach stream callbacks."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(100, [0, IP5, None])
+    h.send(100, [0, IP3, None])
+    tick.send(2300, [0])
+    m.shutdown()
+    assert len(c.bundles) == 1
+
+
+def test_snapshot_q13_long_window_two_full_bundles():
+    """q13 (:784-838): time(5s): both ticks re-emit both rows = 4 events."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(5 sec) select ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(0, [0, IP5, None])
+    h.send(0, [0, IP3, None])
+    tick.send(2200, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP5,), (IP3,)], [(IP5,), (IP3,)]]
+
+
+def test_snapshot_q14_tie_at_two_seconds():
+    """q14 (:838-890): time(2s) + snapshot 2s, single tick at the tie."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(2 sec) select ip "
+        "output snapshot every 2 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(0, [0, IP5, None])
+    h.send(0, [0, IP3, None])
+    tick.send(2000, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP5,), (IP3,)]]
+
+
+def test_snapshot_q15_two_generations_two_bundles():
+    """q15 (:890-945): two event pairs in disjoint windows -> exactly 2
+    non-empty flushes (QueryCallback `value` counts only non-null)."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    h.send(100, [0, IP5, None])
+    h.send(100, [0, IP3, None])
+    h.send(2300, [0, IP5, None])
+    h.send(2300, [0, IP3, None])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert len(qc.in_bundles) == 2
+
+
+def test_snapshot_q16_group_by_ignored_without_agg():
+    """q16 (:945-1002): time(1s) `select ip group by ip` — no aggregation,
+    so the WINDOWED snapshot (not the group-by one) applies: window
+    contents re-emit; 2 bundles, 4 events."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select ip group by ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1100, [0, IP5, None])
+    h.send(1100, [0, IP3, None])
+    h.send(3300, [0, IP5, None])
+    h.send(3300, [0, IP3, None])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert c.bundles == [[(IP5,), (IP3,)], [(IP5,), (IP3,)]]
+
+
+def test_snapshot_q17_long_window_overlap():
+    """q17 (:1004-1059): time(5s) no-agg: 2+2+4+4+4+2+2 = 20 events over
+    7 bundles as the two pairs overlap then retire."""
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(5 sec) select ip group by ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int")
+    h.send(1100, [0, IP5, None])
+    h.send(1100, [0, IP3, None])
+    h.send(3300, [0, IP5, None])
+    h.send(3300, [0, IP3, None])
+    tick.send(10500, [0])
+    m.shutdown()
+    assert len(c.bundles) == 7
+    assert len(c.events) == 20
+    assert [len(b) for b in c.bundles] == [2, 2, 4, 4, 4, 2, 2]
+
+
+def test_snapshot_q18_some_agg_patches_rows():
+    """q18 (:1059-1116): time(1s) `select ip, sum(calls)` — window rows
+    re-emit with the aggregate position patched to the LATEST sum: both
+    first-bundle rows show 9, both second-bundle rows show 12."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select ip, sum(calls) as totalCalls "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    h.send(1100, [0, IP5, 3])
+    h.send(1200, [0, IP3, 6])
+    h.send(3400, [0, IP5, 2])
+    h.send(3500, [0, IP3, 10])
+    tick.send(4700, [0])
+    m.shutdown()
+    assert qc.in_bundles == [[(IP5, 9), (IP3, 9)], [(IP5, 12), (IP3, 12)]]
+
+
+def test_snapshot_q19_some_agg_long_window():
+    """q19 (:1116-1180): time(5s): 7 non-empty bundles; rows show 9 then 21
+    (4 rows) then 12 as events expire."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(5 sec) select ip, sum(calls) as totalCalls "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    h.send(1100, [0, IP5, 3])
+    h.send(1200, [0, IP3, 6])
+    h.send(3400, [0, IP5, 2])
+    h.send(3500, [0, IP3, 10])
+    tick.send(10600, [0])
+    m.shutdown()
+    assert len(qc.in_bundles) == 7
+    assert qc.in_bundles[0] == [(IP5, 9), (IP3, 9)]
+    assert qc.in_bundles[2] == [(IP5, 21), (IP3, 21), (IP5, 21), (IP3, 21)]
+    assert qc.in_bundles[5] == [(IP5, 12), (IP3, 12)]
+
+
+def test_snapshot_q20_some_agg_group_by_one_row_per_group():
+    """q20 (:1180-1243): time(5s) sum group-by — ONE row per group per
+    bundle (7 bundles, 14 events): (3,6) -> (5,16) -> (2,10)."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(5 sec) select ip, sum(calls) as totalCalls "
+        "group by ip output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    h.send(1100, [0, IP5, 3])
+    h.send(1100, [0, IP3, 6])
+    h.send(3300, [0, IP5, 2])
+    h.send(3300, [0, IP3, 10])
+    tick.send(9500, [0])
+    m.shutdown()
+    assert len(qc.in_bundles) == 7
+    assert len(qc.in_events) == 14
+    assert qc.in_bundles[0] == [(IP5, 3), (IP3, 6)]
+    assert qc.in_bundles[2] == [(IP5, 5), (IP3, 16)]
+    assert qc.in_bundles[5] == [(IP5, 2), (IP3, 10)]
+
+
+def test_snapshot_q21_empty_flushes_reach_query_callback():
+    """q21 (:1245-1306): time(1s) sum group-by — EMPTY snapshot flushes are
+    delivered to QueryCallbacks as (null, null): 4 receives total (empty,
+    data, empty, data), 4 events."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.time(1 sec) select ip, sum(calls) as totalCalls "
+        "group by ip output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    h.send(1100, [0, IP5, 3])
+    h.send(1100, [0, IP3, 6])
+    h.send(3300, [0, IP5, 2])
+    h.send(3300, [0, IP3, 10])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert qc.receives == 4
+    assert qc.in_bundles == [[(IP5, 3), (IP3, 6)], [(IP5, 2), (IP3, 10)]]
+
+
+BATCH7 = [(IP5, 3), (IP3, 6), (IP4, 2), (IP5, 1), (IP6, 1), (IP7, 2),
+          (IP8, 10)]
+
+
+def _batch7_feed(h):
+    for ip, calls in BATCH7:
+        h.send(100, [0, ip, calls])
+
+
+def test_snapshot_q22_length_batch_window_contents():
+    """q22 (:1306-1370): lengthBatch(3): at the 1s tick the snapshot holds
+    only the SECOND batch (.5, .6, .7) — one bundle, 3 events."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(3) select ip, calls "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    _batch7_feed(h)
+    tick.send(1300, [0])
+    m.shutdown()
+    assert qc.in_bundles == [[(IP5, 1), (IP6, 1), (IP7, 2)]]
+
+
+def test_snapshot_q23_length_batch_some_agg():
+    """q23 (:1370-1433): lengthBatch(3) + sum: second batch's rows patched
+    to its batch sum (1+1+2 = 4)."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(3) select ip, sum(calls) as totalCalls "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    _batch7_feed(h)
+    tick.send(1300, [0])
+    m.shutdown()
+    assert qc.in_bundles == [[(IP5, 4), (IP6, 4), (IP7, 4)]]
+
+
+def test_snapshot_q24_length_batch_all_agg():
+    """q24 (:1433-1492): lengthBatch(3) + `select sum(calls)` only: a single
+    aggregate row (4)."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(3) select sum(calls) as totalCalls "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    _batch7_feed(h)
+    tick.send(1300, [0])
+    m.shutdown()
+    assert qc.in_bundles == [[(4,)]]
+
+
+def test_snapshot_q25_length_batch_all_agg_group_by():
+    """q25 (:1492-1557): lengthBatch(3) + sum group-by (key NOT projected):
+    per-group holders of the second batch: (1), (1), (2)."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(3) select sum(calls) as totalCalls "
+        "group by ip output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    _batch7_feed(h)
+    tick.send(1300, [0])
+    m.shutdown()
+    assert qc.in_bundles == [[(1,), (1,), (2,)]]
+
+
+def test_snapshot_q26_length_batch_some_agg_group_by():
+    """q26 (:1557-1621): lengthBatch(3) + ip,sum group-by: one row per
+    group with per-group sums 1, 1, 2."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(3) select ip, sum(calls) as totalCalls "
+        "group by ip output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    _batch7_feed(h)
+    tick.send(1300, [0])
+    m.shutdown()
+    assert qc.in_bundles == [[(IP5, 1), (IP6, 1), (IP7, 2)]]
+
+
+def test_snapshot_q27_length_batch_group_by_no_agg():
+    """q27 (:1621-1686): lengthBatch(3) `select ip group by ip` — no agg,
+    windowed snapshot: second batch contents."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(3) select ip group by ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    _batch7_feed(h)
+    tick.send(1300, [0])
+    m.shutdown()
+    assert qc.in_bundles == [[(IP5,), (IP6,), (IP7,)]]
+
+
+def test_snapshot_q28_batches_straddling_ticks():
+    """q28 (:1686-...): batches land at 2.1s and 3.3s: ticks 1/2 flush empty
+    (QueryCallback receives count them), tick 3 shows batch 1, tick 4 shows
+    batch 2 — 6 data events (.5,.3,.4 then .5,.6,.7)."""
+    qc = QBundles()
+    m, c, h, tick = build(
+        "from LoginEvents#window.lengthBatch(3) select ip group by ip "
+        "output snapshot every 1 sec insert all events into uniqueIps;",
+        stream_attrs="timestamp long, ip string, calls int",
+        cb=qc, on="query1")
+    for ip, calls in [(IP5, 3), (IP3, 6), (IP4, 2), (IP5, 1)]:
+        h.send(2100, [0, ip, calls])
+    for ip, calls in [(IP6, 1), (IP7, 2), (IP8, 10)]:
+        h.send(3300, [0, ip, calls])
+    tick.send(4500, [0])
+    m.shutdown()
+    assert qc.receives > 2
+    assert qc.in_bundles == [[(IP5,), (IP3,), (IP4,)],
+                             [(IP5,), (IP6,), (IP7,)]]
