@@ -19,7 +19,8 @@ from siddhi_tpu.core.context import SiddhiAppContext, SiddhiContext
 from siddhi_tpu.core.event import Event
 from siddhi_tpu.core.plan.query_planner import plan_query
 from siddhi_tpu.core.query.callback import QueryCallback
-from siddhi_tpu.core.query.ratelimit import create_rate_limiter
+from siddhi_tpu.core.query.ratelimit import (create_rate_limiter,
+                                             rate_uses_group_key)
 from siddhi_tpu.core.query.runtime import QueryRuntime
 from siddhi_tpu.core.stream.input.input_handler import InputHandler, InputManager
 from siddhi_tpu.core.stream.junction import StreamJunction
@@ -512,7 +513,7 @@ class SiddhiAppRuntime:
             raise SiddhiAppValidationException(
                 f"unsupported output action {type(out).__name__}")
 
-        from siddhi_tpu.query_api.execution import JoinInputStream as _JIS
+        from siddhi_tpu.query_api.execution import JoinInputStream, StateInputStream
 
         sp = getattr(runtime, "selector_plan", None)
         agg_positions = tuple(getattr(sp, "agg_positions", ()) or ())
@@ -520,12 +521,10 @@ class SiddhiAppRuntime:
         # window source is windowed too (the window junction delivers its
         # expireds); else a #window handler on the single stream
         src_id = getattr(query.input_stream, "unique_stream_id", None)
-        windowed = (isinstance(query.input_stream, _JIS)
+        windowed = (isinstance(query.input_stream, JoinInputStream)
                     or src_id in self.named_windows
                     or getattr(runtime, "window_stage", None) is not None
                     or getattr(runtime, "host_window", None) is not None)
-        from siddhi_tpu.core.query.ratelimit import rate_uses_group_key
-
         group_key_fn = None
         if query.selector.group_by_list and rate_uses_group_key(
                 query.output_rate, windowed, agg_positions):
@@ -561,8 +560,6 @@ class SiddhiAppRuntime:
             out_size=len(getattr(runtime, "output_attrs", ()) or ()),
             empty_send=getattr(runtime, "send_empty_to_query_callbacks", None))
         runtime.scheduler = self.app_context.scheduler
-
-        from siddhi_tpu.query_api.execution import JoinInputStream, StateInputStream
 
         if isinstance(query.input_stream, StateInputStream):
             # pattern/sequence: one proxy receiver per consumed stream

@@ -66,12 +66,32 @@ def _rewrite_aggregators(expr: Expression, specs: List[agg_ops.AggSpec], resolve
     if isinstance(expr, AttributeFunction) and not expr.namespace \
             and expr.name.lower() in agg_ops.supported_aggregators():
         kind = expr.name.lower()
+        # arity/type validation mirroring the reference executors'
+        # @ParameterOverload contracts (e.g. SumAttributeAggregatorExecutor
+        # accepts exactly one numeric attribute; extra or string arguments
+        # fail app creation)
+        if kind == "count":
+            if len(expr.parameters) > 1:
+                raise CompileError("count() accepts at most one argument")
+        elif len(expr.parameters) != 1:
+            raise CompileError(f"{kind}() expects exactly one argument, "
+                               f"found {len(expr.parameters)}")
         if expr.parameters:
             arg_f, arg_t = compile_expr(expr.parameters[0], resolver)
         else:
             arg_f, arg_t = None, None
         if kind != "count" and arg_f is None:
             raise CompileError(f"{kind}() requires an argument")
+        if kind in ("sum", "avg", "stddev", "min", "max",
+                    "minforever", "maxforever") and arg_t not in (
+                AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE):
+            raise CompileError(
+                f"{kind}() expects a numeric attribute but found "
+                f"{arg_t.value if arg_t else None}")
+        if kind in ("and", "or") and arg_t != AttrType.BOOL:
+            raise CompileError(
+                f"{kind}() expects a bool attribute but found "
+                f"{arg_t.value if arg_t else None}")
         out_key = f"__agg{len(specs)}__"
         out_type = agg_ops.agg_result_type(kind, arg_t)
         spec = agg_ops.AggSpec(kind=kind, arg_fn=arg_f, arg_type=arg_t,

@@ -995,7 +995,8 @@ class KeyedBatchWindowStage(WindowStage):
 def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:
     """Keyed (partitioned) window factory. Capacity per key comes from
     ``app_context.partition_window_capacity``."""
-    from siddhi_tpu.ops.windows import _const_param, window_col_specs
+    from siddhi_tpu.ops.windows import (_const_param, _expect_arity,
+                                        _int_const_param, window_col_specs)
 
     name = window.name.lower()
     col_specs = window_col_specs(input_def, extra=(PK_KEY,))
@@ -1003,41 +1004,60 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
     capacity = getattr(app_context, "partition_window_capacity", 256)
 
     if name == "length":
-        return KeyedLengthWindowStage(int(_const_param(window, 0, "length")), col_specs)
+        _expect_arity(window, 1, 1)
+        return KeyedLengthWindowStage(_int_const_param(window, 0, "length"), col_specs)
     if name == "time":
-        return KeyedTimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
+        _expect_arity(window, 1, 1)
+        return KeyedTimeWindowStage(_int_const_param(window, 0, "time"), col_specs, capacity)
     if name == "externaltime":
         # externalTime(tsAttr, time) — per-key cutoff clock from the named
         # timestamp attribute
         from siddhi_tpu.ops.windows import _external_ts_key
 
-        return KeyedTimeWindowStage(int(_const_param(window, 1, "time")),
+        _expect_arity(window, 2, 2)
+        return KeyedTimeWindowStage(_int_const_param(window, 1, "time"),
                                     col_specs, capacity, external=True,
                                     ts_key=_external_ts_key(window, input_def))
     if name == "timelength":
-        return KeyedTimeWindowStage(int(_const_param(window, 0, "time")),
+        _expect_arity(window, 2, 2)
+        return KeyedTimeWindowStage(_int_const_param(window, 0, "time"),
                                     col_specs, capacity,
-                                    max_len=int(_const_param(window, 1, "length")))
+                                    max_len=_int_const_param(window, 1, "length"))
     if name == "delay":
         # delay is key-independent: the unkeyed stage (its ring carries the
         # pk column) behaves identically per key and shards per device
         from siddhi_tpu.ops.windows import DelayWindowStage
 
-        return DelayWindowStage(int(_const_param(window, 0, "delay")),
+        _expect_arity(window, 1, 1)
+        return DelayWindowStage(_int_const_param(window, 0, "delay"),
                                 col_specs,
                                 getattr(app_context, "window_capacity", 4096))
     if name == "lengthbatch":
+        if len(window.parameters) > 1:
+            raise CompileError(
+                "lengthBatch streamCurrentEvents is not supported inside a "
+                "partition yet")
+        _expect_arity(window, 1, 1)
         return KeyedLengthBatchWindowStage(
-            int(_const_param(window, 0, "length")), col_specs)
+            _int_const_param(window, 0, "length"), col_specs)
     if name == "timebatch":
+        if len(window.parameters) > 1:
+            raise CompileError(
+                "timeBatch startTime/streamCurrentEvents are not supported "
+                "inside a partition yet")
+        _expect_arity(window, 1, 1)
         return KeyedTimeBatchWindowStage(
-            int(_const_param(window, 0, "time")), col_specs, capacity)
+            _int_const_param(window, 0, "time"), col_specs, capacity)
     if name == "batch":
+        if window.parameters:
+            raise CompileError(
+                "batch chunkLength is not supported inside a partition yet")
         return KeyedBatchWindowStage(col_specs, capacity)
     if name == "hopping":
+        _expect_arity(window, 2, 2)
         return KeyedHoppingWindowStage(
-            int(_const_param(window, 0, "windowTime")),
-            int(_const_param(window, 1, "hopTime")), col_specs, capacity)
+            _int_const_param(window, 0, "windowTime"),
+            _int_const_param(window, 1, "hopTime"), col_specs, capacity)
     if name == "session":
         if len(window.parameters) >= 2:
             # session with its own key attribute and/or allowedLatency:

@@ -158,6 +158,34 @@ def _const_param(window: Window, i: int, name: str):
     raise CompileError(f"{window.name} window parameter '{name}' must be a constant")
 
 
+def _int_const_param(window: Window, i: int, name: str):
+    """A parameter that must be an int/long constant (or time constant) —
+    the reference processors reject FLOAT/DOUBLE here at init
+    (e.g. ``LengthWindowProcessor.init``, ``TimeWindowProcessor.init``)."""
+    v = _const_param(window, i, name)
+    if isinstance(v, (float, str, bool)):
+        raise CompileError(
+            f"{window.name} window parameter '{name}' must be int or long, "
+            f"found a {type(v).__name__} constant")
+    return int(v)
+
+
+def _bool_const_param(window: Window, i: int, name: str) -> bool:
+    p = window.parameters[i]
+    if not (isinstance(p, Constant) and isinstance(p.value, bool)):
+        raise CompileError(
+            f"{window.name} window parameter '{name}' must be a bool constant")
+    return p.value
+
+
+def _expect_arity(window: Window, low: int, high: int):
+    n = len(window.parameters)
+    if not (low <= n <= high):
+        want = str(low) if low == high else f"{low}..{high}"
+        raise CompileError(
+            f"{window.name} window expects {want} parameter(s), found {n}")
+
+
 # ------------------------------------------------------------------ length
 
 class LengthWindowStage(WindowStage):
@@ -367,16 +395,24 @@ def _first_later_covering(ts, valid, t):
 class LengthBatchWindowStage(WindowStage):
     """Tumbling count window; flushes exactly at count boundaries, possibly
     several times within one device batch. Each flush emits
-    [EXPIRED(prev flush, ts=now), RESET, CURRENT rows]."""
+    [EXPIRED(prev flush, ts=now), RESET, CURRENT rows].
+
+    ``stream_current`` mirrors the reference's streamCurrentEvents overload
+    (``LengthBatchWindowProcessor.processStreamCurrentEvents``): every
+    arrival is emitted as CURRENT immediately; when the (W+1)-th event of a
+    cycle arrives, [EXPIRED(previous W events, ts=now), RESET] are emitted
+    just before it."""
 
     batch_mode = True
 
-    def __init__(self, length: int, col_specs: Dict[str, np.dtype], expired_needed: bool = True):
+    def __init__(self, length: int, col_specs: Dict[str, np.dtype], expired_needed: bool = True,
+                 stream_current: bool = False):
         if length <= 0:
             raise CompileError("lengthBatch window needs a positive length")
         self.length = length
         self.col_specs = col_specs
         self.expired_needed = expired_needed
+        self.stream_current = stream_current
 
     def init_state(self, num_keys: int = 1) -> dict:
         W = self.length
@@ -384,7 +420,73 @@ class LengthBatchWindowStage(WindowStage):
         return {"cur": zero(), "prev": zero(),
                 "count": jnp.int64(0), "prev_count": jnp.int64(0)}
 
+    def _apply_stream(self, state, cols, ctx):
+        """streamCurrentEvents mode: CURRENT rows pass through at arrival;
+        each cycle boundary (an arrival at seq ≡ 0 mod W, seq > 0) first
+        emits [EXPIRED(previous W events, ts=now), RESET]."""
+        W = self.length
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+
+        count0 = state["count"]           # events buffered since last boundary
+        rank, n_ins = _insert_ranks(valid_cur)
+        seq = count0 + rank               # position since the last boundary
+        total_after = count0 + n_ins
+        S = jnp.int64(W + 2)              # per-trigger span: W expired, RESET, CURRENT
+        lead = jnp.arange(W, dtype=jnp.int64)
+
+        parts = []
+        if self.expired_needed:
+            # buffered rows all expire at the first boundary (trigger rank
+            # r0 = W - count0), batch rows at the boundary closing their cycle
+            r0 = jnp.int64(W) - count0
+            buf_valid = (lead < count0) & (n_ins > r0)
+            buf_rows = {k: state["cur"][k][lead.astype(jnp.int32)] for k in state["cur"]}
+            buf_rows[TS_KEY] = jnp.where(buf_valid, now, buf_rows[TS_KEY])
+            parts.append((buf_rows, jnp.full((W,), EXPIRED, jnp.int8), buf_valid, r0 * S + lead))
+
+            rb = (seq // W + 1) * W - count0      # trigger rank of the closing boundary
+            bexp_valid = valid_cur & (n_ins > rb)
+            bexp = {k: cols[k] for k in keys}
+            bexp[TS_KEY] = jnp.where(bexp_valid, now, cols[TS_KEY])
+            parts.append((bexp, jnp.full((B,), EXPIRED, jnp.int8), bexp_valid, rb * S + seq % W))
+
+        is_bnd = valid_cur & (seq > 0) & (seq % W == 0)
+        reset_rows = _zero_rows(cols, B)
+        reset_rows[TS_KEY] = jnp.where(is_bnd, now, jnp.int64(0))
+        parts.append((reset_rows, jnp.full((B,), RESET, jnp.int8), is_bnd, rank * S + W))
+
+        parts.append(({k: cols[k] for k in keys}, jnp.full((B,), CURRENT, jnp.int8),
+                      valid_cur, rank * S + W + 1))
+
+        out, okeys = _order_emit(parts)
+        # selector chunk segmentation (QuerySelector batch dedup): each
+        # passed-through CURRENT is its own chunk; a boundary's EXPIRED rows
+        # share one chunk and collapse to their last aggregate row
+        out[FLUSH_KEY] = jnp.where(
+            okeys == _BIG, 0,
+            okeys // S * 2 + (okeys % S == W + 1)).astype(jnp.int32)
+
+        # state: rows of the still-open cycle stay buffered
+        new_count = jnp.where(total_after > 0,
+                              total_after - W * ((total_after - 1) // W),
+                              jnp.int64(0))
+        base_seq = total_after - new_count
+        keep_old = base_seq == 0
+        is_rem = valid_cur & (seq >= base_seq)
+        slot = jnp.where(is_rem, (seq - base_seq).astype(jnp.int32), W)
+        new_cur = {}
+        for k in state["cur"]:
+            base = jnp.where(keep_old, state["cur"][k], jnp.zeros_like(state["cur"][k]))
+            new_cur[k] = base.at[slot].set(cols[k], mode="drop")
+        return {"cur": new_cur, "prev": state["prev"],
+                "count": new_count, "prev_count": state["prev_count"]}, out
+
     def apply(self, state, cols, ctx):
+        if self.stream_current:
+            return self._apply_stream(state, cols, ctx)
         W = self.length
         keys = _data_keys(cols)
         B = cols[VALID_KEY].shape[0]
@@ -480,18 +582,25 @@ class LengthBatchWindowStage(WindowStage):
 
 class TimeBatchWindowStage(WindowStage):
     """Tumbling time window; flush check once per chunk (arriving rows join
-    the flushing batch), exactly as the reference processes chunks."""
+    the flushing batch), exactly as the reference processes chunks.
+
+    ``stream_current`` mirrors the reference's streamCurrentEvents overload
+    (``TimeBatchWindowProcessor.java:297-335``): CURRENT rows pass through
+    at arrival (never queued); each flush emits [EXPIRED(arrivals since the
+    last flush, ts=now), RESET] after any currents of the flushing chunk."""
 
     batch_mode = True
     needs_scheduler = True
 
     def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int,
-                 expired_needed: bool = True, start_time: int = -1):
+                 expired_needed: bool = True, start_time: int = -1,
+                 stream_current: bool = False):
         self.time_ms = time_ms
         self.capacity = capacity
         self.col_specs = col_specs
         self.expired_needed = expired_needed
         self.start_time = start_time
+        self.stream_current = stream_current
 
     def init_state(self, num_keys: int = 1) -> dict:
         Wc = self.capacity
@@ -525,6 +634,40 @@ class TimeBatchWindowStage(WindowStage):
         count = count0 + n_ins
 
         widx = jnp.arange(Wc, dtype=jnp.int64)
+
+        if self.stream_current:
+            B = cols[VALID_KEY].shape[0]
+            parts = [({k: cols[k] for k in keys},
+                      jnp.full((B,), CURRENT, jnp.int8), valid_cur, rank)]
+            if self.expired_needed:
+                # the whole queue — arrivals before AND inside the flushing
+                # chunk — expires at the flush (clones join the queue before
+                # it drains, TimeBatchWindowProcessor.java:298-314)
+                qrows = {k: cur_buf[k][widx.astype(jnp.int32)] for k in cur_buf}
+                q_valid = (widx < count) & send
+                qrows[TS_KEY] = jnp.where(q_valid, now, qrows[TS_KEY])
+                parts.append((qrows, jnp.full((Wc,), EXPIRED, jnp.int8),
+                              q_valid, jnp.int64(B) + widx))
+            reset_rows = _zero_rows(cols, 1)
+            reset_rows[TS_KEY] = jnp.broadcast_to(now, (1,))
+            parts.append((reset_rows, jnp.full((1,), RESET, jnp.int8),
+                          jnp.broadcast_to(send & (count > 0), (1,)),
+                          jnp.full((1,), jnp.int64(B) + Wc, jnp.int64)))
+            out, okeys = _order_emit(parts)
+            # chunk ids for the selector's per-chunk collapse: currents are
+            # singleton chunks; the flush's EXPIRED rows share one chunk
+            out[FLUSH_KEY] = jnp.minimum(okeys, jnp.int64(B)).astype(jnp.int32)
+            new_state = {
+                "cur": {k: jnp.where(send, jnp.zeros_like(v), v) for k, v in cur_buf.items()},
+                "prev": state["prev"],
+                "count": jnp.where(send, jnp.int64(0), count),
+                "prev_count": state["prev_count"],
+                "next_emit": next_emit,
+            }
+            out[NOTIFY_KEY] = next_emit
+            out[OVERFLOW_KEY] = (count > Wc).astype(jnp.int32)
+            return new_state, out
+
         parts = []
         if self.expired_needed:
             prev_valid = (widx < state["prev_count"]) & send
@@ -663,15 +806,23 @@ class HoppingWindowStage(WindowStage):
 # ------------------------------------------------------------------- batch
 
 class BatchWindowStage(WindowStage):
-    """`#window.batch()`: each chunk is its own batch; the previous chunk
-    expires first (``BatchWindowProcessor``)."""
+    """`#window.batch([chunkLength])`: each chunk is its own batch; the
+    previous chunk expires first. With ``chunkLength`` the arriving chunk is
+    split into sub-batches of at most that many rows, each flushed in turn
+    (``BatchWindowProcessor.java:91-118``; the trailing partial group still
+    flushes at chunk end — nothing carries over unflushed)."""
 
     batch_mode = True
 
-    def __init__(self, col_specs: Dict[str, np.dtype], capacity: int, expired_needed: bool = True):
+    def __init__(self, col_specs: Dict[str, np.dtype], capacity: int, expired_needed: bool = True,
+                 chunk_length: int = 0):
+        if chunk_length < 0:
+            raise CompileError(
+                "batch window chunkLength must be greater than zero")
         self.col_specs = col_specs
         self.capacity = capacity
         self.expired_needed = expired_needed
+        self.chunk_length = chunk_length
 
     def init_state(self, num_keys: int = 1) -> dict:
         Wc = self.capacity
@@ -685,8 +836,54 @@ class BatchWindowStage(WindowStage):
         now = jnp.int64(ctx["current_time"])
         valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
         any_cur = jnp.any(valid_cur)
+        rank, n_ins = _insert_ranks(valid_cur)
 
         widx = jnp.arange(Wc, dtype=jnp.int64)
+
+        if self.chunk_length:
+            # split the chunk into n-row flushes: flush f emits
+            # [EXPIRED(flush f-1, or prev chunk for f=0), RESET, CURRENTs]
+            n = jnp.int64(self.chunk_length)
+            flush_id = rank // n
+            n_flush = (n_ins + n - 1) // n
+            S = jnp.int64(Wc + 1 + self.chunk_length)
+
+            parts = []
+            if self.expired_needed:
+                prev_valid = (widx < state["prev_count"]) & any_cur
+                prev_rows = {k: state["prev"][k][widx.astype(jnp.int32)] for k in state["prev"]}
+                prev_rows[TS_KEY] = jnp.where(prev_valid, now, prev_rows[TS_KEY])
+                parts.append((prev_rows, jnp.full((Wc,), EXPIRED, jnp.int8), prev_valid, widx))
+                bexp_valid = valid_cur & (flush_id + 1 < n_flush)
+                bexp = {k: cols[k] for k in keys}
+                bexp[TS_KEY] = jnp.where(bexp_valid, now, cols[TS_KEY])
+                parts.append((bexp, jnp.full((B,), EXPIRED, jnp.int8), bexp_valid,
+                              (flush_id + 1) * S + rank % n))
+            n_reset_cap = B // self.chunk_length + 2
+            ridx = jnp.arange(n_reset_cap, dtype=jnp.int64)
+            reset_valid = (ridx < n_flush) & ((ridx > 0) | (state["prev_count"] > 0))
+            reset_rows = _zero_rows(cols, n_reset_cap)
+            reset_rows[TS_KEY] = jnp.where(reset_valid, now, jnp.int64(0))
+            parts.append((reset_rows, jnp.full((n_reset_cap,), RESET, jnp.int8),
+                          reset_valid, ridx * S + Wc))
+            parts.append(({k: cols[k] for k in keys}, jnp.full((B,), CURRENT, jnp.int8),
+                          valid_cur, flush_id * S + Wc + 1 + rank % n))
+            out, okeys = _order_emit(parts)
+            out[FLUSH_KEY] = jnp.where(okeys == _BIG, 0, okeys // S).astype(jnp.int32)
+
+            # prev <- rows of the trailing (possibly partial) flush
+            last = n_flush - 1
+            base_rank = last * n
+            is_last = valid_cur & (flush_id == last)
+            slot = jnp.where(is_last, (rank - base_rank).astype(jnp.int32), Wc)
+            new_prev = {}
+            for k in state["prev"]:
+                base = jnp.where(any_cur, jnp.zeros_like(state["prev"][k]), state["prev"][k])
+                new_prev[k] = base.at[slot].set(cols[k], mode="drop")
+            new_count = jnp.where(any_cur, n_ins - base_rank, state["prev_count"])
+            out[OVERFLOW_KEY] = jnp.int32(0)
+            return {"prev": new_prev, "prev_count": new_count}, out
+
         parts = []
         if self.expired_needed:
             prev_valid = (widx < state["prev_count"]) & any_cur
@@ -703,7 +900,6 @@ class BatchWindowStage(WindowStage):
         out, _ = _order_emit(parts)
         out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
 
-        rank, n_ins = _insert_ranks(valid_cur)
         slot = jnp.where(valid_cur, rank.astype(jnp.int32), Wc)
         new_prev = {}
         for k in state["prev"]:
@@ -1074,8 +1270,8 @@ class ExternalTimeBatchWindowStage(WindowStage):
 # ----------------------------------------------------------------- factory
 
 def _external_ts_key(window, input_def) -> str:
-    """externalTime clock column: a plain LONG attribute reference, else
-    the event timestamp."""
+    """externalTime clock column: must be a plain LONG attribute reference
+    (anything else fails app creation, as in the reference processor)."""
     from siddhi_tpu.query_api.expressions import Variable
 
     p0 = window.parameters[0] if window.parameters else None
@@ -1085,7 +1281,9 @@ def _external_ts_key(window, input_def) -> str:
             raise CompileError(
                 "externalTime timestamp attribute must be long (ms epoch)")
         return attr.name
-    return TS_KEY
+    raise CompileError(
+        f"{window.name} window's first parameter must be a long attribute "
+        "reference (the external timestamp)")
 
 
 def window_col_specs(input_def, extra: Tuple[str, ...] = ()) -> Dict[str, np.dtype]:
@@ -1114,37 +1312,70 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
     capacity = getattr(app_context, "window_capacity", 4096)
 
     if name == "length":
-        return LengthWindowStage(int(_const_param(window, 0, "length")), col_specs)
+        _expect_arity(window, 1, 1)
+        return LengthWindowStage(_int_const_param(window, 0, "length"), col_specs)
     if name == "lengthbatch":
-        return LengthBatchWindowStage(int(_const_param(window, 0, "length")), col_specs)
+        # lengthBatch(length[, streamCurrentEvents])
+        _expect_arity(window, 1, 2)
+        stream_current = False
+        if len(window.parameters) == 2:
+            stream_current = _bool_const_param(window, 1, "streamCurrentEvents")
+        return LengthBatchWindowStage(_int_const_param(window, 0, "length"), col_specs,
+                                      stream_current=stream_current)
     if name == "time":
-        return TimeWindowStage(int(_const_param(window, 0, "time")), col_specs, capacity)
+        _expect_arity(window, 1, 1)
+        return TimeWindowStage(_int_const_param(window, 0, "time"), col_specs, capacity)
     if name == "externaltime":
         # externalTime(tsAttr, time) — expiry driven by the named
-        # timestamp attribute (event ts when the expression isn't a plain
-        # long attribute)
+        # long timestamp attribute
+        _expect_arity(window, 2, 2)
         ts_key = _external_ts_key(window, input_def)
-        return TimeWindowStage(int(_const_param(window, 1, "time")), col_specs, capacity,
+        return TimeWindowStage(_int_const_param(window, 1, "time"), col_specs, capacity,
                                external=True, ts_key=ts_key)
     if name == "timebatch":
+        # overloads (TimeBatchWindowProcessor.init): (time),
+        # (time, startTime int/long), (time, streamCurrentEvents bool),
+        # (time, startTime, streamCurrentEvents)
+        _expect_arity(window, 1, 3)
         start_time = -1
-        if len(window.parameters) >= 2:
-            p2 = window.parameters[1]
-            if isinstance(p2, Constant) and p2.type in (AttrType.INT, AttrType.LONG):
-                start_time = int(p2.value)
-        return TimeBatchWindowStage(int(_const_param(window, 0, "time")), col_specs,
-                                    capacity, start_time=start_time)
+        stream_current = False
+        if len(window.parameters) == 2:
+            p1 = window.parameters[1]
+            if isinstance(p1, Constant) and isinstance(p1.value, bool):
+                stream_current = p1.value
+            elif (isinstance(p1, TimeConstant)
+                  or (isinstance(p1, Constant)
+                      and p1.type in (AttrType.INT, AttrType.LONG))):
+                start_time = int(p1.value)
+            else:
+                raise CompileError(
+                    "timeBatch second parameter must be an int/long startTime "
+                    "or a bool streamCurrentEvents constant")
+        elif len(window.parameters) == 3:
+            start_time = _int_const_param(window, 1, "startTime")
+            stream_current = _bool_const_param(window, 2, "streamCurrentEvents")
+        return TimeBatchWindowStage(_int_const_param(window, 0, "time"), col_specs,
+                                    capacity, start_time=start_time,
+                                    stream_current=stream_current)
     if name == "batch":
-        return BatchWindowStage(col_specs, capacity)
+        # batch([chunkLength]) — BatchWindowProcessor.java:107-118
+        _expect_arity(window, 0, 1)
+        chunk_length = 0
+        if window.parameters:
+            chunk_length = _int_const_param(window, 0, "chunkLength")
+        return BatchWindowStage(col_specs, capacity, chunk_length=chunk_length)
     if name == "timelength":
-        return TimeLengthWindowStage(int(_const_param(window, 0, "time")),
-                                     int(_const_param(window, 1, "length")), col_specs)
+        _expect_arity(window, 2, 2)
+        return TimeLengthWindowStage(_int_const_param(window, 0, "time"),
+                                     _int_const_param(window, 1, "length"), col_specs)
     if name == "delay":
-        return DelayWindowStage(int(_const_param(window, 0, "delay")), col_specs, capacity)
+        _expect_arity(window, 1, 1)
+        return DelayWindowStage(_int_const_param(window, 0, "delay"), col_specs, capacity)
     if name == "externaltimebatch":
         # externalTimeBatch(tsAttr, time[, startTime[, timeout]])
         from siddhi_tpu.ops.expressions import compile_expr
 
+        _expect_arity(window, 2, 4)
         ts_fn, _t = compile_expr(window.parameters[0], resolver)
         start_time = -1
         if len(window.parameters) >= 3:
@@ -1155,14 +1386,15 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
             start_time = int(p.value)
         timeout = 0
         if len(window.parameters) >= 4:
-            timeout = int(_const_param(window, 3, "timeout"))
+            timeout = _int_const_param(window, 3, "timeout")
         return ExternalTimeBatchWindowStage(
-            ts_fn, int(_const_param(window, 1, "time")), col_specs, capacity,
+            ts_fn, _int_const_param(window, 1, "time"), col_specs, capacity,
             start_time=start_time, timeout=timeout)
     if name == "hopping":
+        _expect_arity(window, 2, 2)
         return HoppingWindowStage(
-            int(_const_param(window, 0, "windowTime")),
-            int(_const_param(window, 1, "hopTime")), col_specs, capacity)
+            _int_const_param(window, 0, "windowTime"),
+            _int_const_param(window, 1, "hopTime"), col_specs, capacity)
     if name in ("sort", "frequent", "lossyfrequent", "session", "cron",
                 "expression", "expressionbatch"):
         from siddhi_tpu.ops.host_windows import create_host_window_stage

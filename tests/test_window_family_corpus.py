@@ -1,0 +1,992 @@
+"""Reference per-family window corpus — scenarios ported verbatim from
+``query/window/{Length,Time,ExternalTime,Sort,Frequent,LossyFrequent,Cron}
+WindowTestCase.java`` (feeds and expected outputs; Thread.sleep becomes
+playback clock jumps where timers must fire)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.query.callback import QueryCallback
+from siddhi_tpu.compiler.errors import (SiddhiParserException,
+                                        SiddhiAppValidationException)
+from siddhi_tpu.ops.expressions import CompileError
+
+CREATION_ERRORS = (CompileError, SiddhiParserException,
+                   SiddhiAppValidationException)
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []       # in_events (CURRENT)
+        self.expired = []      # remove_events (EXPIRED)
+        self.order = []        # interleaved arrival order: ('in'|'rm', data)
+
+    def receive(self, timestamp, in_events, remove_events):
+        for e in (in_events or []):
+            self.events.append(e)
+            self.order.append(("in", tuple(e.data)))
+        for e in (remove_events or []):
+            self.expired.append(e)
+            self.order.append(("rm", tuple(e.data)))
+
+
+def build(app, out="OutStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback(out, c)
+    return m, rt, c
+
+
+def build_q(app, query="query1"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback(query, q)
+    return m, rt, q
+
+
+# --------------------------------------------------- LengthWindowTestCase
+
+
+def test_length_window_fewer_events_than_size():
+    """lengthWindowTest1 (:52-84): 2 events into length(4) — all CURRENT,
+    none expired, arrival order preserved."""
+    m, rt, q = build_q("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.length(4)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 700.0, 0])
+    h.send(["WSO2", 60.5, 1])
+    m.shutdown()
+    assert [e.data[2] for e in q.events] == [0, 1]
+    assert q.expired == []
+
+
+def test_length_window_overflow_stream_view_order():
+    """lengthWindowTest2 (:86-133): 6 events into length(4), StreamCallback
+    view — the 5th/6th arrivals each emit [expired oldest, current new];
+    expired rows precede their triggering current row
+    (LengthWindowProcessor.java:124-137)."""
+    m, rt, c = build("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.length(4)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    for v in range(1, 7):
+        h.send(["IBM" if v % 2 else "WSO2", 700.0 if v % 2 else 60.5, v])
+    m.shutdown()
+    assert [e.data[2] for e in c.events] == [1, 2, 3, 4, 1, 5, 2, 6]
+
+
+def test_length_window_overflow_query_view_counts():
+    """lengthWindowTest3 (:135-187): same feed, QueryCallback view — 6 in
+    events, 2 remove events."""
+    m, rt, q = build_q("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.length(4)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    for v in range(1, 7):
+        h.send(["IBM" if v % 2 else "WSO2", 700.0 if v % 2 else 60.5, v])
+    m.shutdown()
+    assert len(q.events) == 6
+    assert len(q.expired) == 2
+    assert [e.data[2] for e in q.expired] == [1, 2]
+
+
+def test_length_window_null_rows_do_not_move_aggregates():
+    """lengthWindowTest4 (:190-253): all-aggregator projection over
+    length(4) with interleaved all-null rows — the null row after the 2nd
+    event leaves min/sum/avg unchanged (aggregators skip nulls)."""
+    m, rt, q = build_q("""
+        define stream cseEventStream (symbol string, price float, volume int,
+                                      price2 double, volume2 long, active bool);
+        @info(name = 'query1')
+        from cseEventStream#window.length(4)
+        select max(price) as maxp, min(price) as minp, sum(price) as sump,
+               avg(price) as avgp, stdDev(price) as stdp, count() as cp,
+               distinctCount(price) as dcp, max(volume) as maxv,
+               min(volume) as minv, sum(volume) as sumv,
+               max(price2) as maxp2, sum(price2) as sump2,
+               max(volume2) as maxv2, sum(volume2) as sumv2
+        insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send([None, None, None, None, None, None])
+    h.send(["IBM", 700.0, 0, 0.0, 5, True])
+    h.send([None, None, None, None, None, None])
+    for _ in range(5):
+        h.send(["IBM", 700.0, 0, 0.0, 5, True])
+    m.shutdown()
+    assert len(q.events) == 8
+    second, third = q.events[1], q.events[2]
+    # reference asserts data(1..3): minp, sump, avgp unchanged by the null
+    assert third.data[1] == second.data[1] == 700.0
+    assert third.data[2] == second.data[2] == 700.0
+    assert third.data[3] == second.data[3] == 700.0
+
+
+def test_length_window_rejects_second_parameter():
+    """lengthWindowTest5 (:255-281): window.length(2, price) fails app
+    creation (single-int @ParameterOverload)."""
+    m = SiddhiManager()
+    with pytest.raises(CREATION_ERRORS):
+        m.create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, price float, volume int);
+            from cseEventStream#window.length(2, price)
+            select symbol, price, volume insert all events into OutStream;
+        """)
+
+
+def test_sum_rejects_two_arguments():
+    """sumAggregatorTest57 (:283-316): sum(weight, deviceId) fails app
+    creation."""
+    m = SiddhiManager()
+    with pytest.raises(CREATION_ERRORS):
+        m.create_siddhi_app_runtime("""
+            define stream cseEventStream (weight double, deviceId string);
+            from cseEventStream#window.length(3)
+            select sum(weight, deviceId) as total insert into OutStream;
+        """)
+
+
+def test_sum_rejects_string_argument():
+    """sumAggregatorTest58 (:318-351): sum over a string attribute fails
+    app creation."""
+    m = SiddhiManager()
+    with pytest.raises(CREATION_ERRORS):
+        m.create_siddhi_app_runtime("""
+            define stream cseEventStream (weight double, deviceId string);
+            from cseEventStream#window.length(3)
+            select sum(deviceId) as total insert into OutStream;
+        """)
+
+
+def test_avg_rejects_two_arguments():
+    """avgAggregatorTest59 (:353-389): avg(weight, deviceId) fails app
+    creation."""
+    m = SiddhiManager()
+    with pytest.raises(CREATION_ERRORS):
+        m.create_siddhi_app_runtime("""
+            define stream cseEventStream (weight double, deviceId string);
+            from cseEventStream#window.length(5)
+            select avg(weight, deviceId) as avgWeight insert into OutStream;
+        """)
+
+
+# ----------------------------------------------------- TimeWindowTestCase
+
+
+TIME_APP = """@app:playback
+    define stream cseEventStream (symbol string, price float, volume int);
+    define stream Tick (x int);
+    @info(name = 'query1')
+    from cseEventStream#window.time({dur})
+    select symbol, price, volume insert all events into OutStream;
+    from Tick select x insert into TickOut;
+"""
+
+
+def test_time_window_expires_all_after_duration():
+    """timeWindowTest1 (:45-86): 2 events into time(2 sec); after the
+    duration both expire; in events always precede their removes."""
+    m, rt, q = build_q(TIME_APP.format(dur="2 sec"))
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 0])
+    h.send(1010, ["WSO2", 60.5, 1])
+    tick.send(5100, [0])                    # Thread.sleep(4000)
+    m.shutdown()
+    assert len(q.events) == 2
+    assert len(q.expired) == 2
+    # in-before-remove: the interleaved order never shows a remove first
+    seen_in = 0
+    for kind, _ in q.order:
+        if kind == "rm":
+            assert seen_in > 0
+        else:
+            seen_in += 1
+
+
+def test_time_window_rolling_batches_expire_in_order():
+    """timeWindowTest2 (:94-139): three pairs spaced over 1 sec into
+    time(1 sec) — 6 in, 6 remove."""
+    m, rt, q = build_q(TIME_APP.format(dur="1 sec"))
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 1])
+    h.send(1010, ["WSO2", 60.5, 2])
+    h.send(2110, ["IBM", 700.0, 3])         # Thread.sleep(1100)
+    h.send(2120, ["WSO2", 60.5, 4])
+    h.send(3220, ["IBM", 700.0, 5])         # Thread.sleep(1100)
+    h.send(3230, ["WSO2", 60.5, 6])
+    tick.send(7300, [0])                    # Thread.sleep(4000)
+    m.shutdown()
+    assert [e.data[2] for e in q.events] == [1, 2, 3, 4, 5, 6]
+    assert [e.data[2] for e in q.expired] == [1, 2, 3, 4, 5, 6]
+
+
+def test_time_window_expired_feed_downstream_query():
+    """timeWindowTest3 (:141-176): `insert expired events` output of a
+    time(30 ms) window feeds a second query; both device ids arrive on the
+    intermediate stream."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream fireAlarmEventStream (deviceID string, sonar double);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        from fireAlarmEventStream#window.time(30 milliseconds)
+        select deviceID insert expired events into analyzeStream;
+        @info(name = 'query2')
+        from analyzeStream select deviceID insert into bulbOnStream;
+        from Tick select x insert into TickOut;
+    """)
+    mid, out = Collector(), Collector()
+    rt.add_callback("analyzeStream", mid)
+    rt.add_callback("bulbOnStream", out)
+    h = rt.get_input_handler("fireAlarmEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["id1", 20.0])
+    h.send(1005, ["id2", 20.0])
+    tick.send(3100, [0])                    # Thread.sleep(2000)
+    m.shutdown()
+    assert [e.data[0] for e in mid.events] == ["id1", "id2"]
+    assert [e.data[0] for e in out.events] == ["id1", "id2"]
+
+
+def test_time_window_rejects_second_parameter():
+    """timeWindowTest4 (:178-192): window.time(2 sec, 5) fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, price float, volume int);
+            from cseEventStream#window.time(2 sec, 5)
+            select symbol, price, volume insert all events into OutStream;
+        """)
+
+
+def test_time_window_rejects_variable_parameter():
+    """timeWindowTest5 (:194-208): window.time(time) with an attribute
+    parameter fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, time long, volume int);
+            from cseEventStream#window.time(time)
+            select symbol, time, volume insert all events into OutStream;
+        """)
+
+
+def test_time_window_rejects_float_duration():
+    """timeWindowTest6 (:210-224): window.time(4.7) fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, time long, volume int);
+            from cseEventStream#window.time(4.7)
+            select symbol, time, volume insert all events into OutStream;
+        """)
+
+
+# --------------------------------------------- ExternalTimeWindowTestCase
+
+
+def test_external_time_window_event_driven_expiry():
+    """externalTimeWindowTest1 (:48-97): externalTime(timestamp, 5 sec)
+    over the reference's five login events — 5 in, 4 remove, expiry driven
+    purely by the timestamp attribute."""
+    m, rt, q = build_q("""
+        define stream LoginEvents (timestamp long, ip string);
+        @info(name = 'query1')
+        from LoginEvents#window.externalTime(timestamp, 5 sec)
+        select timestamp, ip insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("LoginEvents")
+    h.send([1366335804341, "192.10.1.3"])
+    h.send([1366335804342, "192.10.1.4"])
+    h.send([1366335814341, "192.10.1.5"])
+    h.send([1366335814345, "192.10.1.6"])
+    h.send([1366335824341, "192.10.1.7"])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 4
+    assert [e.data[1] for e in q.expired] == [
+        "192.10.1.3", "192.10.1.4", "192.10.1.5", "192.10.1.6"]
+
+
+def test_external_time_window_rejects_missing_duration():
+    """externalTimeWindowTest2 (:99-149): externalTime(timestamp) without
+    a duration fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream LoginEvents (timestamp long, ip string);
+            from LoginEvents#window.externalTime(timestamp)
+            select timestamp, ip insert all events into OutStream;
+        """)
+
+
+def test_external_time_window_rejects_int_timestamp():
+    """externalTimeWindowTest3 (:151-185): an INT timestamp attribute
+    fails creation (must be LONG)."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream LoginEvents (timestamp int, ip string);
+            from LoginEvents#window.externalTime(timestamp, 5 sec)
+            select timestamp, ip insert all events into OutStream;
+        """)
+
+
+def test_external_time_window_rejects_string_literal_timestamp():
+    """externalTimeWindowTest4 (:187-225): a string constant in place of
+    the timestamp attribute fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream LoginEvents (timestamp long, ip string);
+            from LoginEvents#window.externalTime('timestamp', 5 sec)
+            select timestamp, ip insert all events into OutStream;
+        """)
+
+
+# ----------------------------------------------------- SortWindowTestCase
+
+
+def test_sort_window_single_key_counts():
+    """sortWindowTest1 (:53-99): sort(2, volume, 'asc') keeps the two
+    smallest volumes; 5 in, 3 remove."""
+    m, rt, q = build_q("""
+        define stream cseEventStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from cseEventStream#window.sort(2, volume, 'asc')
+        select volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 300])
+    h.send(["WSO2", 57.6, 200])
+    h.send(["WSO2", 55.6, 20])
+    h.send(["WSO2", 57.6, 40])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 3
+    # evictions: 300 (on 200's arrival), 200 (on 20's), 100 (on 40's)
+    assert [e.data[0] for e in q.expired] == [300, 200, 100]
+
+
+def test_sort_window_two_key_counts():
+    """sortWindowTest2 (:101-148): sort(2, volume, 'asc', price, 'desc') —
+    secondary descending price breaks volume ties; 5 in, 3 remove."""
+    m, rt, q = build_q("""@app:name('sortWindow2')
+        define stream cseEventStream (symbol string, price int, volume long);
+        @info(name = 'query1')
+        from cseEventStream#window.sort(2, volume, 'asc', price, 'desc')
+        select price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["WSO2", 50, 100])
+    h.send(["IBM", 20, 100])
+    h.send(["WSO2", 40, 50])
+    h.send(["WSO2", 100, 20])
+    h.send(["WSO2", 50, 50])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 3
+
+
+def test_sort_window_join():
+    """sortWindowTest3 (:150-196): join of two sort(2, ...) windows on
+    symbol == company — 3 joined outputs."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, index int);
+        define stream twitterStream (id int, tweet string, company string);
+        @info(name = 'query1')
+        from cseEventStream#window.sort(2, index) join twitterStream#window.sort(2, id)
+        on cseEventStream.symbol == twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    cse = rt.get_input_handler("cseEventStream")
+    twitter = rt.get_input_handler("twitterStream")
+    cse.send(["WSO2", 55.6, 100])
+    cse.send(["IBM", 59.6, 101])
+    twitter.send([10, "Hello World", "WSO2"])
+    twitter.send([15, "Hello World2", "WSO2"])
+    cse.send(["IBM", 75.6, 90])
+    twitter.send([5, "Hello World2", "IBM"])
+    m.shutdown()
+    assert len(q.events) == 3
+
+
+def test_sort_window_rejects_float_length():
+    """sortWindowTest4 (:198-210): window.sort(2.5) fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, price float, volume int);
+            from cseEventStream#window.sort(2.5)
+            select symbol, price, volume insert all events into OutStream;
+        """)
+
+
+def test_sort_window_rejects_constant_sort_key():
+    """sortWindowTest5 (:212-223): window.sort(2, 8) — a constant where an
+    attribute is required fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, time long, volume int);
+            from cseEventStream#window.sort(2, 8)
+            select symbol, volume insert all events into OutStream;
+        """)
+
+
+def test_sort_window_rejects_bad_order_literal():
+    """sortWindowTest6 (:225-235): 'ecs' is not a valid sort order."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, time long, volume int);
+            from cseEventStream#window.sort(2, volume, 'ecs')
+            select symbol, volume insert all events into OutStream;
+        """)
+
+
+# ------------------------------------------------- FrequentWindowTestCase
+
+
+def test_frequent_window_all_attributes():
+    """frequentUniqueWindowTest1 (:46-93): frequent(2) keyed on the whole
+    row, 4 distinct rows fed twice — 8 in, 6 remove (Misra-Gries counter
+    eviction, FrequentWindowProcessor)."""
+    m, rt, q = build_q("""
+        define stream purchase (cardNo string, price float);
+        @info(name = 'query1')
+        from purchase[price >= 30]#window.frequent(2)
+        select cardNo, price insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("purchase")
+    for _ in range(2):
+        h.send(["3234-3244-2432-4124", 73.36])
+        h.send(["1234-3244-2432-123", 46.36])
+        h.send(["5768-3244-2432-5646", 48.36])
+        h.send(["9853-3244-2432-4125", 78.36])
+    m.shutdown()
+    assert len(q.events) == 8
+    assert len(q.expired) == 6
+
+
+def test_frequent_window_keyed_attribute():
+    """frequentUniqueWindowTest2 (:96-146): frequent(2, cardNo) with two
+    dominant cards — 8 in, 0 remove (the third card never displaces)."""
+    m, rt, q = build_q("""
+        define stream purchase (cardNo string, price float);
+        @info(name = 'query1')
+        from purchase[price >= 30]#window.frequent(2, cardNo)
+        select cardNo, price insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("purchase")
+    for _ in range(2):
+        h.send(["3234-3244-2432-4124", 73.36])
+        h.send(["1234-3244-2432-123", 46.36])
+        h.send(["3234-3244-2432-4124", 78.36])
+        h.send(["1234-3244-2432-123", 86.36])
+        h.send(["5768-3244-2432-5646", 48.36])
+    m.shutdown()
+    assert len(q.events) == 8
+    assert len(q.expired) == 0
+
+
+# --------------------------------------------- LossyFrequentWindowTestCase
+
+
+def test_lossy_frequent_window_all_supported():
+    """lossyFrequentUniqueWindowTest1 (:46-96): lossyFrequent(0.1, 0.01)
+    over 4 rows × 25 — all 100 pass, the 2 tail events don't surface."""
+    m, rt, q = build_q("""
+        define stream purchase (cardNo string, price float);
+        @info(name = 'query1')
+        from purchase[price >= 30]#window.lossyFrequent(0.1, 0.01)
+        select cardNo, price insert into OutStream;
+    """)
+    h = rt.get_input_handler("purchase")
+    for _ in range(25):
+        h.send(["3234-3244-2432-4124", 73.36])
+        h.send(["1234-3244-2432-123", 46.36])
+        h.send(["5768-3244-2432-5646", 48.36])
+        h.send(["9853-3244-2432-4125", 78.36])
+    h.send(["1124-3244-2432-4126", 78.36])
+    h.send(["1124-3244-2432-4126", 78.36])
+    m.shutdown()
+    assert len(q.events) == 100
+    assert len(q.expired) == 0
+
+
+def test_lossy_frequent_window_support_threshold_eviction():
+    """frequentUniqueWindowTest2 (:99-152): lossyFrequent(0.3, 0.05) — the
+    lone first-card event is evicted when the frequency sweep runs; exactly
+    1 remove."""
+    m, rt, q = build_q("""
+        define stream purchase (cardNo string, price float);
+        @info(name = 'query1')
+        from purchase[price >= 30]#window.lossyFrequent(0.3, 0.05)
+        select cardNo, price insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("purchase")
+    h.send(["3224-3244-2432-4124", 73.36])
+    for _ in range(25):
+        h.send(["3234-3244-2432-4124", 73.36])
+        h.send(["3234-3244-2432-4124", 78.36])
+        h.send(["1234-3244-2432-123", 86.36])
+        h.send(["5768-3244-2432-5646", 48.36])
+    m.shutdown()
+    assert len(q.expired) == 1
+
+
+def test_lossy_frequent_window_keyed_attribute():
+    """frequentUniqueWindowTest3 (:155-198): lossyFrequent(0.3, 0.05,
+    cardNo) — keying on cardNo admits the third-priced row; 101 in, 1
+    remove."""
+    m, rt, q = build_q("""
+        define stream purchase (cardNo string, price float);
+        @info(name = 'query1')
+        from purchase[price >= 30]#window.lossyFrequent(0.3, 0.05, cardNo)
+        select cardNo, price insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("purchase")
+    h.send(["3224-3244-2432-4124", 73.36])
+    for _ in range(25):
+        h.send(["3234-3244-2432-4124", 73.36])
+        h.send(["3234-3244-2432-4124", 78.36])
+        h.send(["1234-3244-2432-123", 86.36])
+        h.send(["3234-3244-2432-4124", 48.36])
+    m.shutdown()
+    assert len(q.events) == 101
+    assert len(q.expired) == 1
+
+
+# ----------------------------------------------------- CronWindowTestCase
+
+
+CRON_APP = """@app:playback
+    define stream cseEventStream (symbol string, price float, volume int);
+    define stream Tick (x int);
+    @info(name = 'query1')
+    from cseEventStream#window.cron('*/5 * * * * ?')
+    select symbol, price, volume insert {mode} into OutStream;
+    from Tick select x insert into TickOut;
+"""
+
+
+def test_cron_window_current_events():
+    """cronWindowTest1 (:46-91): three pairs sent across three */5 fires —
+    6 current events flushed on the schedule."""
+    m, rt, c = build(CRON_APP.format(mode=""))
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 0])
+    h.send(1100, ["WSO2", 60.5, 1])
+    tick.send(7000, [0])                 # Thread.sleep(7000): fire at 5000
+    h.send(7100, ["IBM1", 700.0, 0])
+    h.send(7200, ["WSO22", 60.5, 1])
+    tick.send(14000, [0])                # fire at 10000
+    h.send(14100, ["IBM43", 700.0, 0])
+    h.send(14200, ["WSO4343", 60.5, 1])
+    tick.send(21000, [0])                # fire at 15000/20000
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [
+        "IBM", "WSO2", "IBM1", "WSO22", "IBM43", "WSO4343"]
+
+
+def test_cron_window_expired_events():
+    """cronWindowTest2 (:94-136): same feed, `insert expired events` — each
+    fire expires the previous batch: 4 expired rows by the third fire."""
+    m, rt, c = build(CRON_APP.format(mode="expired events"))
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 0])
+    h.send(1100, ["WSO2", 60.5, 1])
+    tick.send(7000, [0])
+    h.send(7100, ["IBM1", 700.0, 0])
+    h.send(7200, ["WSO22", 60.5, 1])
+    tick.send(14000, [0])
+    h.send(14100, ["IBM43", 700.0, 0])
+    h.send(14200, ["WSO4343", 60.5, 1])
+    # the reference polls until exactly 4 removes then shuts down — stop
+    # the clock after the 15000 fire but before 20000 expires batch 3
+    tick.send(16000, [0])
+    m.shutdown()
+    assert [e.data[0] for e in c.events] == [
+        "IBM", "WSO2", "IBM1", "WSO22"]
+
+
+# ----------------------------------------------- TimeLengthWindowTestCase
+
+
+TL_APP = """@app:playback
+    define stream S (symbol string, price float, volume int);
+    define stream Tick (x int);
+    @info(name = 'query1')
+    from S#window.timeLength({params})
+    select symbol, price, volume insert all events into OutStream;
+    from Tick select x insert into TickOut;
+"""
+
+
+def test_time_length_under_both_bounds():
+    """timeLengthWindowTest1 (:52-96): 4 events inside both the 4 sec and
+    10-length bounds — all 4 expire by time after the wait."""
+    m, rt, q = build_q(TL_APP.format(params="4 sec, 10"))
+    h = rt.get_input_handler("S")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 1])
+    h.send(1500, ["WSO2", 60.5, 2])
+    h.send(2000, ["IBM", 700.0, 3])
+    h.send(2500, ["WSO2", 60.5, 4])
+    tick.send(7600, [0])                 # Thread.sleep(5000)
+    m.shutdown()
+    assert len(q.events) == 4
+    assert [e.data[2] for e in q.expired] == [1, 2, 3, 4]
+
+
+def test_time_length_time_expiry_between_arrivals():
+    """timeLengthWindowTest2 (:102-150): arrivals spaced past the 2 sec
+    bound — each expires before the suite ends; 4 in, 4 remove."""
+    m, rt, q = build_q(TL_APP.format(params="2 sec, 10"))
+    h = rt.get_input_handler("S")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 0])
+    h.send(2200, ["WSO2", 60.5, 1])
+    h.send(3400, ["Google", 80.5, 2])
+    h.send(4600, ["Yahoo", 90.5, 3])
+    tick.send(8700, [0])                 # Thread.sleep(4000)
+    m.shutdown()
+    assert len(q.events) == 4
+    assert [e.data[2] for e in q.expired] == [0, 1, 2, 3]
+
+
+def test_time_length_length_evictions_only():
+    """timeLengthWindowTest3 (:156-212): 8 events within the 10 sec bound
+    into length 4 — the 4 oldest are evicted by the length bound."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream sensorStream (id string, sensorValue double);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        from sensorStream#window.timeLength(10 sec, 4)
+        select id, sensorValue insert all events into OutStream;
+        from Tick select x insert into TickOut;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    h = rt.get_input_handler("sensorStream")
+    tick = rt.get_input_handler("Tick")
+    for i, v in enumerate([10.0, 20.0, 30.0, 40.0, 50.0, 60.0, 70.0, 80.0]):
+        h.send(1000 + 500 * i, [f"id{i + 1}", v])
+    tick.send(6600, [0])                 # Thread.sleep(2000)
+    m.shutdown()
+    assert len(q.events) == 8
+    assert [e.data[0] for e in q.expired] == ["id1", "id2", "id3", "id4"]
+
+
+def test_time_length_mixed_expiry():
+    """timeLengthWindowTest4 (:215-260): 6 events, 2 sec / length 4 — every
+    event leaves (by time or by eviction); 6 in, 6 remove."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream sensorStream (id string, sensorValue double);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        from sensorStream#window.timeLength(2 sec, 4)
+        select id, sensorValue insert all events into OutStream;
+        from Tick select x insert into TickOut;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    h = rt.get_input_handler("sensorStream")
+    tick = rt.get_input_handler("Tick")
+    for i, v in enumerate([10.0, 20.0, 30.0, 40.0, 50.0, 60.0]):
+        h.send(1000 + 500 * i, [f"id{i + 1}", v])
+    tick.send(5600, [0])                 # Thread.sleep(2100)
+    m.shutdown()
+    assert len(q.events) == 6
+    assert len(q.expired) == 6
+
+
+def test_time_length_window_length_five():
+    """timeLengthWindowTest(:398-456): 8 events into timeLength(10 sec, 5)
+    — 3 length evictions, no time expiry before shutdown."""
+    m, rt, q = build_q(TL_APP.format(params="10 sec, 5"))
+    h = rt.get_input_handler("S")
+    tick = rt.get_input_handler("Tick")
+    vols = [10, 20, 20, 40, 50, 60, 70, 80]
+    for i, v in enumerate(vols):
+        h.send(1000 + 500 * i, ["IBM" if i % 2 == 0 else "WSO2",
+                                700.0 if i % 2 == 0 else 60.5, v])
+    tick.send(9600, [0])                 # Thread.sleep(5000) < 10 sec bound
+    m.shutdown()
+    assert len(q.events) == 8
+    assert len(q.expired) == 3
+
+
+def test_time_length_rejects_single_parameter():
+    """timeLengthWindowTest11 (:458-...): timeLength(4 sec) fails
+    creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream S (symbol string, price float, volume int);
+            from S#window.timeLength(4 sec)
+            select symbol, price, volume insert all events into OutStream;
+        """)
+
+
+def test_time_length_rejects_expression_duration():
+    """timeLengthWindowTest12: timeLength(1/2 sec, 4) — a computed
+    duration fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream S (symbol string, price float, volume int);
+            from S#window.timeLength(1/2 sec, 4)
+            select symbol, price, volume insert all events into OutStream;
+        """)
+
+
+def test_time_length_rejects_string_duration():
+    """timeLengthWindowTest13: timeLength('4 sec', 4) fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime("""
+            define stream S (symbol string, price float, volume int);
+            from S#window.timeLength('4 sec', 4)
+            select symbol, price, volume insert all events into OutStream;
+        """)
+
+
+# -------------------------------------- LengthBatch streamCurrentEvents
+
+
+class ChunkCollector(StreamCallback):
+    """Records per-delivery chunk sizes (the reference's StreamCallback
+    receives one Event[] per output chunk)."""
+
+    def __init__(self):
+        super().__init__()
+        self.chunks = []
+        self.events = []
+
+    def receive(self, events):
+        self.chunks.append(len(events))
+        self.events.extend(events)
+
+
+def test_length_batch_stream_current_chunk_shapes():
+    """lengthBatchWindowTest10 (:477-531): lengthBatch(4, true) `insert all
+    events` — every arrival passes through as its own chunk; each cycle
+    boundary delivers a 5-event chunk [4 expired, current]; 17 rows total
+    (7 singles + 2 fives)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(4, true)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("cseEventStream")
+    for v in [1, 2, 3, 4, 5, 6, 4, 5, 6]:
+        h.send(["IBM", 700.0, v])
+    m.shutdown()
+    assert sum(c.chunks) == 17
+    assert sum(1 for n in c.chunks if n == 1) == 7
+    assert sum(1 for n in c.chunks if n == 5) == 2
+
+
+def test_length_batch_stream_current_running_count():
+    """lengthBatchWindowTest11 (:533-590): lengthBatch(4, true) + count()
+    `insert into` — 9 single-row outputs whose count cycles within 1..4."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(4, true)
+        select symbol, price, count() as volumes insert into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("cseEventStream")
+    for v in [1, 2, 3, 4, 5, 6, 4, 5, 6]:
+        h.send(["IBM", 700.0, v])
+    m.shutdown()
+    assert len(c.events) == 9
+    assert all(n == 1 for n in c.chunks)
+    counts = [e.data[2] for e in c.events]
+    assert all(1 <= n <= 4 for n in counts)
+    assert counts == [1, 2, 3, 4, 1, 2, 3, 4, 1]
+
+
+def test_length_batch_stream_current_expired_collapse():
+    """lengthBatchWindowTest12 (:592-645): lengthBatch(4, true) + count()
+    `insert expired events` — each boundary's expired chunk collapses to
+    one row whose count has decremented back to 0."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(4, true)
+        select symbol, price, count() as volumes insert expired events into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("cseEventStream")
+    for v in [1, 2, 3, 4, 5, 6, 4, 5, 6]:
+        h.send(["IBM", 700.0, v])
+    m.shutdown()
+    assert len(c.events) == 2
+    assert all(e.data[2] == 0 for e in c.events)
+
+
+def test_length_batch_stream_current_join():
+    """lengthBatchWindowTest13 (:647-694): join of two lengthBatch(2, true)
+    sides — 2 in events, 1 remove."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(2, true) join twitterStream#window.lengthBatch(2, true)
+        on cseEventStream.symbol == twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert all events into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    cse = rt.get_input_handler("cseEventStream")
+    twitter = rt.get_input_handler("twitterStream")
+    cse.send(["WSO2", 55.6, 100])
+    twitter.send(["User1", "Hello World", "WSO2"])
+    cse.send(["IBM", 75.6, 100])
+    cse.send(["WSO2", 57.6, 100])
+    m.shutdown()
+    assert len(q.events) == 2
+    assert len(q.expired) == 1
+
+
+# ---------------------------------------- TimeBatch streamCurrentEvents
+
+
+TB_STREAM_APP = """@app:playback
+    define stream cseEventStream (symbol string, price float, volume int);
+    define stream Tick (x int);
+    @info(name = 'query1')
+    from cseEventStream#window.timeBatch(1 sec, true)
+    select {sel} insert all events into OutStream;
+    from Tick select x insert into TickOut;
+"""
+
+
+def _feed_tb_stream(rt):
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 1])
+    tick.send(2100, [0])                 # Thread.sleep(1100): flush {1}
+    h.send(2150, ["WSO2", 60.5, 2])
+    h.send(2160, ["IBM", 700.0, 3])
+    h.send(2170, ["WSO2", 60.5, 4])
+    tick.send(3300, [0])                 # flush {2,3,4}
+    h.send(3350, ["IBM", 700.0, 5])
+    h.send(3360, ["WSO2", 60.5, 6])
+    tick.send(4600, [0])                 # flush {5,6}
+
+
+def test_time_batch_stream_current_passthrough():
+    """timeWindowBatchTest9 (:432-476): timeBatch(1 sec, true) no
+    aggregate — 6 pass-through currents, 6 expired at the three flushes."""
+    m, rt, q = build_q(TB_STREAM_APP.format(sel="symbol, price"))
+    _feed_tb_stream(rt)
+    m.shutdown()
+    assert len(q.events) == 6
+    assert len(q.expired) == 6
+
+
+def test_time_batch_stream_current_sum_collapse():
+    """timeWindowBatchTest10 (:478-529): timeBatch(1 sec, true) + sum —
+    currents stream individually (6) while each flush's expired chunk
+    collapses to a single aggregate row (3)."""
+    m, rt, q = build_q(TB_STREAM_APP.format(sel="symbol, sum(price) as total"))
+    _feed_tb_stream(rt)
+    m.shutdown()
+    assert len(q.events) == 6
+    assert len(q.expired) == 3
+
+
+def test_time_batch_rejects_bad_overloads():
+    """timeWindowBatchTest11-16 (:531-1027): invalid second/third
+    parameters fail creation; valid startTime forms are accepted."""
+    bad = [
+        "timeBatch(1 sec, 1/2)",
+        "timeBatch(2 sec, 'string')",
+        "timeBatch('2 sec', 0)",
+        "timeBatch(1/2, 0)",
+        "timeBatch(1 sec, true, 100)",
+        "timeBatch(1 sec, 1/2, 100)",
+        "timeBatch(1 sec, 0, 1/2)",
+        "timeBatch(1 sec, 123L, 'true')",
+        "timeBatch(1 sec, 123L, true, 100)",
+    ]
+    for w in bad:
+        with pytest.raises(CREATION_ERRORS):
+            SiddhiManager().create_siddhi_app_runtime(
+                "define stream S (symbol string, price float, volume int); "
+                f"from S#window.{w} select symbol insert all events into OutStream;")
+    for w in ["timeBatch(2 sec, 0)", "timeBatch(2 sec, 123L)",
+              "timeBatch(2 sec, 5 sec)", "timeBatch(1 sec, 123L, true)"]:
+        m = SiddhiManager()
+        m.create_siddhi_app_runtime(
+            "define stream S (symbol string, price float, volume int); "
+            f"from S#window.{w} select symbol insert all events into OutStream;")
+        m.shutdown()
+
+
+# --------------------------------------------------- batch(chunkLength)
+
+
+def test_batch_window_chunk_length_splits_bulk_sends():
+    """BatchWindowProcessor.java:107-118: batch(2) splits a 5-row chunk
+    into flushes of ≤2 rows — running sums reset per sub-batch; batch()
+    keeps the whole chunk as one batch."""
+    import numpy as np
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (k string, v int);
+        @info(name = 'query1')
+        from S#window.batch(2) select k, sum(v) as t insert into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("S")
+    h.send_columns({"k": np.array(["a", "b", "c", "d", "e"]),
+                    "v": np.array([1, 2, 3, 4, 5], np.int64)})
+    m.shutdown()
+    # flushes {1,2}, {3,4}, {5} — sum aggregates collapse per flush
+    assert [e.data[1] for e in c.events] == [3, 7, 5]
+
+
+def test_batch_window_rejects_string_length():
+    """batch('2') fails creation (chunkLength must be int)."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime(
+            "define stream S (k string, v int); "
+            "from S#window.batch('2') select k insert into OutStream;")
