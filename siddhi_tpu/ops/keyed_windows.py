@@ -497,10 +497,14 @@ class KeyedLengthBatchWindowStage(WindowStage):
                 "prev_full": new_prev_full}, out
 
     def contents(self, state):
+        """Join/find probes see the last COMPLETED batch per key — the
+        reference's ``expiredEventQueue``
+        (LengthBatchWindowProcessor.java:288-299), matching the unkeyed
+        stage."""
         N = self.length
-        part = (state["cnt"] % N)[:, None]
-        valid = jnp.arange(N, dtype=jnp.int64)[None, :] < part
-        return dict(state["cur"]), valid
+        K = state["prev_full"].shape[0]
+        valid = jnp.broadcast_to(state["prev_full"][:, None], (K, N))
+        return dict(state["prev"]), valid
 
     def reset_keys(self, state, ids):
         return {"cur": state["cur"], "prev": state["prev"],
@@ -624,9 +628,13 @@ class KeyedTimeBatchWindowStage(WindowStage):
                 "prev_cnt": new_prev_cnt, "next_emit": new_next}, out
 
     def contents(self, state):
+        """Join/find probes see the last flushed batch per key — the
+        reference's ``expiredEventQueue``
+        (TimeBatchWindowProcessor.java:368-380), matching the unkeyed
+        stage."""
         valid = (jnp.arange(self.capacity, dtype=jnp.int32)[None, :]
-                 < state["cnt"][:, None])
-        return dict(state["buf"]), valid
+                 < state["prev_cnt"][:, None])
+        return dict(state["prev"]), valid
 
     def reset_keys(self, state, ids):
         return {"buf": state["buf"], "prev": state["prev"],
@@ -1038,8 +1046,11 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
                 "lengthBatch streamCurrentEvents is not supported inside a "
                 "partition yet")
         _expect_arity(window, 1, 1)
-        return KeyedLengthBatchWindowStage(
-            _int_const_param(window, 0, "length"), col_specs)
+        length = _int_const_param(window, 0, "length")
+        if length == 0:
+            raise CompileError(
+                "lengthBatch(0) is not supported inside a partition yet")
+        return KeyedLengthBatchWindowStage(length, col_specs)
     if name == "timebatch":
         if len(window.parameters) > 1:
             raise CompileError(

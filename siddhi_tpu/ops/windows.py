@@ -407,12 +407,35 @@ class LengthBatchWindowStage(WindowStage):
 
     def __init__(self, length: int, col_specs: Dict[str, np.dtype], expired_needed: bool = True,
                  stream_current: bool = False):
-        if length <= 0:
-            raise CompileError("lengthBatch window needs a positive length")
+        if length < 0:
+            raise CompileError("lengthBatch window needs a non-negative length")
         self.length = length
         self.col_specs = col_specs
         self.expired_needed = expired_needed
         self.stream_current = stream_current
+
+    def _apply_zero(self, state, cols, ctx):
+        """length 0: every arrival is its own instant batch —
+        [CURRENT, EXPIRED(clone, ts=now), RESET] per event
+        (``LengthBatchWindowProcessor.processLengthZeroBatch``)."""
+        keys = _data_keys(cols)
+        B = cols[VALID_KEY].shape[0]
+        now = jnp.int64(ctx["current_time"])
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        rank, _n = _insert_ranks(valid_cur)
+
+        parts = [({k: cols[k] for k in keys},
+                  jnp.full((B,), CURRENT, jnp.int8), valid_cur, rank * 3)]
+        if self.expired_needed:
+            exp = {k: cols[k] for k in keys}
+            exp[TS_KEY] = jnp.where(valid_cur, now, cols[TS_KEY])
+            parts.append((exp, jnp.full((B,), EXPIRED, jnp.int8), valid_cur, rank * 3 + 1))
+        reset_rows = _zero_rows(cols, B)
+        reset_rows[TS_KEY] = jnp.where(valid_cur, now, jnp.int64(0))
+        parts.append((reset_rows, jnp.full((B,), RESET, jnp.int8), valid_cur, rank * 3 + 2))
+        out, okeys = _order_emit(parts)
+        out[FLUSH_KEY] = jnp.where(okeys == _BIG, 0, okeys // 3).astype(jnp.int32)
+        return state, out
 
     def init_state(self, num_keys: int = 1) -> dict:
         W = self.length
@@ -463,11 +486,11 @@ class LengthBatchWindowStage(WindowStage):
 
         out, okeys = _order_emit(parts)
         # selector chunk segmentation (QuerySelector batch dedup): each
-        # passed-through CURRENT is its own chunk; a boundary's EXPIRED rows
-        # share one chunk and collapse to their last aggregate row
-        out[FLUSH_KEY] = jnp.where(
-            okeys == _BIG, 0,
-            okeys // S * 2 + (okeys % S == W + 1)).astype(jnp.int32)
+        # arrival is one reference chunk — at a boundary that chunk holds
+        # [expired×W, RESET, current] and collapses to its LAST type-valid
+        # row (the current for `all events`, the last expired for
+        # `expired events` — LengthBatchWindowTestCase test21/test12)
+        out[FLUSH_KEY] = jnp.where(okeys == _BIG, 0, okeys // S).astype(jnp.int32)
 
         # state: rows of the still-open cycle stay buffered
         new_count = jnp.where(total_after > 0,
@@ -485,6 +508,8 @@ class LengthBatchWindowStage(WindowStage):
                 "count": new_count, "prev_count": state["prev_count"]}, out
 
     def apply(self, state, cols, ctx):
+        if self.length == 0:
+            return self._apply_zero(state, cols, ctx)
         if self.stream_current:
             return self._apply_stream(state, cols, ctx)
         W = self.length
@@ -574,8 +599,17 @@ class LengthBatchWindowStage(WindowStage):
                 "count": new_count, "prev_count": new_prev_count}, out
 
     def contents(self, state):
-        valid = jnp.arange(self.length, dtype=jnp.int64) < state["count"]
-        return dict(state["cur"]), valid
+        """Join/find probes hit the reference's ``expiredEventQueue``
+        (LengthBatchWindowProcessor.java:288-299): the LAST COMPLETED batch
+        in full-batch mode; the current cycle's arrivals in
+        streamCurrentEvents mode (clones queue on arrival there)."""
+        if self.length == 0:
+            return dict(state["cur"]), jnp.zeros((0,), bool)
+        if self.stream_current:
+            valid = jnp.arange(self.length, dtype=jnp.int64) < state["count"]
+            return dict(state["cur"]), valid
+        valid = jnp.arange(self.length, dtype=jnp.int64) < state["prev_count"]
+        return dict(state["prev"]), valid
 
 
 # --------------------------------------------------------------- timeBatch
@@ -697,8 +731,15 @@ class TimeBatchWindowStage(WindowStage):
         return new_state, out
 
     def contents(self, state):
-        valid = jnp.arange(self.capacity, dtype=jnp.int64) < state["count"]
-        return dict(state["cur"]), valid
+        """Join/find probes hit the reference's ``expiredEventQueue``
+        (TimeBatchWindowProcessor.java:368-380): the last flushed batch in
+        full-batch mode; the arrivals since the last flush in
+        streamCurrentEvents mode."""
+        if self.stream_current:
+            valid = jnp.arange(self.capacity, dtype=jnp.int64) < state["count"]
+            return dict(state["cur"]), valid
+        valid = jnp.arange(self.capacity, dtype=jnp.int64) < state["prev_count"]
+        return dict(state["prev"]), valid
 
 
 class HoppingWindowStage(WindowStage):

@@ -990,3 +990,227 @@ def test_batch_window_rejects_string_length():
         SiddhiManager().create_siddhi_app_runtime(
             "define stream S (k string, v int); "
             "from S#window.batch('2') select k insert into OutStream;")
+
+
+# ------------------------------------------- LengthBatchWindowTestCase
+
+
+LB_APP = """
+    define stream cseEventStream (symbol string, price float, volume int);
+    @info(name = 'query1')
+    from cseEventStream#window.lengthBatch({params})
+    select {sel} insert {mode} into OutStream;
+"""
+
+
+def _feed6(h):
+    for v in range(1, 7):
+        h.send(["IBM" if v % 2 else "WSO2", 700.0 if v % 2 else 60.5, v])
+
+
+def test_length_batch_no_flush_below_length():
+    """lengthBatchWindowTest1 (:51-88): 2 events into lengthBatch(4) —
+    nothing flushes."""
+    m, rt, q = build_q(LB_APP.format(params="4", sel="symbol, price, volume",
+                                     mode=""))
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 700.0, 0])
+    h.send(["WSO2", 60.5, 1])
+    m.shutdown()
+    assert q.events == [] and q.expired == []
+
+
+def test_length_batch_single_flush_order():
+    """lengthBatchWindowTest2 (:90-132): 6 events into lengthBatch(4) —
+    one flush of the first 4, in order."""
+    m, rt, c = build(LB_APP.format(params="4", sel="symbol, price, volume",
+                                   mode=""))
+    h = rt.get_input_handler("cseEventStream")
+    _feed6(h)
+    m.shutdown()
+    assert [e.data[2] for e in c.events] == [1, 2, 3, 4]
+
+
+def test_length_batch_all_events_expiry_interleave():
+    """lengthBatchWindowTest3 (:134-190): lengthBatch(2) `insert all
+    events` — flushes alternate [currents],[expired prev + currents]: the
+    stream view sees 1,2 then 1,2,3,4 then 3,4,5,6."""
+    m, rt, c = build(LB_APP.format(params="2", sel="symbol, price, volume",
+                                   mode="all events"))
+    h = rt.get_input_handler("cseEventStream")
+    _feed6(h)
+    m.shutdown()
+    assert [e.data[2] for e in c.events] == [1, 2, 1, 2, 3, 4, 3, 4, 5, 6]
+
+
+def test_length_batch_sum_single_row_per_flush():
+    """lengthBatchWindowTest4 (:192-234): lengthBatch(4) + sum `insert
+    into` — one row per flush, sum of the batch (100.0)."""
+    m, rt, c = build(LB_APP.format(params="4",
+                                   sel="symbol, sum(price) as sumPrice, volume",
+                                   mode=""))
+    h = rt.get_input_handler("cseEventStream")
+    for sym, p, v in [("IBM", 10.0, 0), ("WSO2", 20.0, 1), ("IBM", 30.0, 0),
+                      ("WSO2", 40.0, 1), ("IBM", 50.0, 0), ("WSO2", 60.0, 1)]:
+        h.send([sym, p, v])
+    m.shutdown()
+    assert [e.data[1] for e in c.events] == [100.0]
+
+
+def test_length_batch_expired_only_view():
+    """lengthBatchWindowTest5 (:236-277): lengthBatch(2) `insert expired
+    events` — the first batch expires when the second flushes: rows 1-4."""
+    m, rt, c = build(LB_APP.format(params="2", sel="symbol, price, volume",
+                                   mode="expired events"))
+    h = rt.get_input_handler("cseEventStream")
+    _feed6(h)
+    m.shutdown()
+    assert [e.data[2] for e in c.events] == [1, 2, 3, 4]
+
+
+def test_length_batch_sum_all_events_collapse():
+    """lengthBatchWindowTest6 (:279-326) / test7 (:329-373): lengthBatch(4)
+    + sum `insert all events` — each flush chunk collapses to its LAST row
+    (the final current), so the expired decrements never surface: 100.0
+    then 240.0 (QuerySelector.processInBatchNoGroupBy keeps one lastEvent
+    per chunk across both types)."""
+    m, rt, q = build_q(LB_APP.format(params="4",
+                                     sel="symbol, sum(price) as sumPrice, volume",
+                                     mode="all events"))
+    h = rt.get_input_handler("cseEventStream")
+    for sym, p, v in [("IBM", 10.0, 0), ("WSO2", 20.0, 1), ("IBM", 30.0, 0),
+                      ("WSO2", 40.0, 1), ("IBM", 50.0, 0), ("WSO2", 60.0, 1),
+                      ("WSO2", 60.0, 1), ("IBM", 70.0, 0), ("WSO2", 80.0, 1)]:
+        h.send([sym, p, v])
+    m.shutdown()
+    assert [e.data[1] for e in q.events] == [100.0, 240.0]
+    assert q.expired == []
+
+
+def test_length_batch_join():
+    """lengthBatchWindowTest8 (:379-426): join of two lengthBatch(2) sides
+    `insert all events` — 4 in, 2 remove."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(2) join twitterStream#window.lengthBatch(2)
+        on cseEventStream.symbol == twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert all events into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    cse = rt.get_input_handler("cseEventStream")
+    twitter = rt.get_input_handler("twitterStream")
+    cse.send(["WSO2", 55.6, 100])
+    cse.send(["IBM", 59.6, 100])
+    twitter.send(["User1", "Hello World", "WSO2"])
+    twitter.send(["User2", "Hello World2", "WSO2"])
+    cse.send(["IBM", 75.6, 100])
+    cse.send(["WSO2", 57.6, 100])
+    m.shutdown()
+    assert len(q.events) == 4
+    assert len(q.expired) == 2
+
+
+def test_length_batch_join_current_only():
+    """lengthBatchWindowTest9 (:428-475): same join `insert into` — only
+    the 4 in events."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(2) join twitterStream#window.lengthBatch(2)
+        on cseEventStream.symbol == twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    cse = rt.get_input_handler("cseEventStream")
+    twitter = rt.get_input_handler("twitterStream")
+    cse.send(["WSO2", 55.6, 100])
+    cse.send(["IBM", 59.6, 100])
+    twitter.send(["User1", "Hello World", "WSO2"])
+    twitter.send(["User2", "Hello World2", "WSO2"])
+    cse.send(["IBM", 75.6, 100])
+    cse.send(["WSO2", 57.6, 100])
+    m.shutdown()
+    assert len(q.events) == 4
+    assert q.expired == []
+
+
+def test_length_batch_stream_current_boundary_collapses_with_count():
+    """lengthBatchWindowTest21 (:1045-1099): lengthBatch(3, true) + count()
+    `insert all events` — 9 single-row chunks, counts cycling 1..3; the
+    boundary chunk [expired×3, RESET, current] collapses to the current."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(3, true)
+        select symbol, price, count() as volumes insert all events into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("cseEventStream")
+    for v in [1, 2, 3, 4, 5, 6, 4, 5, 6]:
+        h.send(["IBM", 700.0, v])
+    m.shutdown()
+    assert all(n == 1 for n in c.chunks)
+    assert [e.data[2] for e in c.events] == [1, 2, 3, 1, 2, 3, 1, 2, 3]
+
+
+def test_length_batch_length_one():
+    """lengthBatchWindowTest16 (:798-852): lengthBatch(1) + count() — every
+    event is its own batch; 9 single-row chunks with count 1."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(1)
+        select symbol, price, count() as volumes insert all events into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("cseEventStream")
+    for v in [1, 2, 3, 4, 5, 6, 4, 5, 6]:
+        h.send(["IBM", 700.0, v])
+    m.shutdown()
+    assert all(n == 1 for n in c.chunks)
+    assert [e.data[2] for e in c.events] == [1] * 9
+
+
+def test_length_batch_length_zero():
+    """lengthBatchWindowTest17 (:854-910): lengthBatch(0) + count() — each
+    event is an instant batch [current, expired, RESET]; the chunk
+    collapses to the expired clone whose count decremented back to 0."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.lengthBatch(0)
+        select symbol, price, count() as volumes insert all events into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("cseEventStream")
+    for v in [1, 2, 3, 4, 5, 6, 4, 5, 6]:
+        h.send(["IBM", 700.0, v])
+    m.shutdown()
+    assert all(n == 1 for n in c.chunks)
+    assert [e.data[2] for e in c.events] == [0] * 9
+
+
+def test_length_batch_rejects_bad_params():
+    """lengthBatchWindowTest18-20 (:911-1044): three params, an expression
+    length, and a non-bool second parameter all fail creation."""
+    for w in ["lengthBatch(1, true, 100)", "lengthBatch(1/2)",
+              "lengthBatch(3, 1/2)"]:
+        with pytest.raises(CREATION_ERRORS):
+            SiddhiManager().create_siddhi_app_runtime(
+                "define stream S (symbol string, price float, volume int); "
+                f"from S#window.{w} select symbol insert all events into OutStream;")
