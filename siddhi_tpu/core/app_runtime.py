@@ -63,6 +63,27 @@ def _compile_script_function(fdef):
     return _Script
 
 
+def _parse_playback_time(s: str, what: str) -> int:
+    """Strict time-constant string for @app:playback elements — requires
+    '<int> <unit>' pairs; bare numbers or empty strings fail creation the
+    way the reference's SiddhiCompiler.parseTimeConstantDefinition does
+    (PlaybackTestCase test9/test10)."""
+    from siddhi_tpu.compiler.errors import SiddhiParserException
+    from siddhi_tpu.compiler.tokenizer import is_time_unit, time_unit_ms
+
+    parts = (s or "").split()
+    if not parts or len(parts) % 2 != 0:
+        raise SiddhiParserException(
+            f"Invalid {what} constant '{s}' in playback annotation")
+    total = 0
+    for num, unit in zip(parts[::2], parts[1::2]):
+        if not num.isdigit() or not is_time_unit(unit.lower()):
+            raise SiddhiParserException(
+                f"Invalid {what} constant '{s}' in playback annotation")
+        total += int(num) * time_unit_ms(unit.lower())
+    return total
+
+
 def _default_app_name(siddhi_app: SiddhiApp) -> str:
     """Deterministic fallback name so snapshots of the same (unnamed) app
     text restore across process restarts."""
@@ -79,16 +100,36 @@ class SiddhiAppRuntime:
         self.name = siddhi_app.name or _default_app_name(siddhi_app)
         self.app_context = SiddhiAppContext(siddhi_context, self.name)
         self._barrier = threading.RLock()
+        self.app_context.timestamp_generator.set_heartbeat_barrier(self._barrier)
         self.stream_definitions: Dict[str, StreamDefinition] = dict(siddhi_app.stream_definitions)
         self.junctions: Dict[str, StreamJunction] = {}
         self.query_runtimes: Dict[str, QueryRuntime] = {}
         self._stream_callback_adapters: List = []
         self._started = False
 
-        # @app:playback (reference SiddhiAppParser.java:171-212)
-        if siddhi_app.app_annotation("playback") is not None:
+        # @app:playback (reference SiddhiAppParser.java:171-212): optional
+        # idle.time + increment enable the idle heartbeat — when no event
+        # arrives for idle.time of wall time, the event clock advances by
+        # increment so time windows keep draining
+        pb = siddhi_app.app_annotation("playback")
+        if pb is not None:
             self.app_context.playback = True
             self.app_context.timestamp_generator.playback = True
+            elems = pb.elements_map()
+            unknown = [k for k in elems if k not in ("idle.time", "increment")]
+            if unknown:
+                raise SiddhiAppValidationException(
+                    "Playback annotation accepts only idle.time and "
+                    f"increment but found {unknown[0]}")
+            idle_s, inc_s = elems.get("idle.time"), elems.get("increment")
+            if (idle_s is None) != (inc_s is None):
+                raise SiddhiAppValidationException(
+                    "Playback annotation requires both idle.time and "
+                    "increment when either is given")
+            if idle_s is not None:
+                self.app_context.timestamp_generator.configure_heartbeat(
+                    _parse_playback_time(idle_s, "idle.time"),
+                    _parse_playback_time(inc_s, "increment"))
         if siddhi_app.app_annotation("enforceOrder") is not None:
             self.app_context.enforce_order = True
         prec = siddhi_app.app_annotation("precision")
@@ -763,6 +804,7 @@ class SiddhiAppRuntime:
         self._tracing = False
 
     def shutdown(self):
+        self.app_context.timestamp_generator.stop_heartbeat()
         for qr in self.query_runtimes.values():
             if getattr(qr, "_deferred", None):
                 try:

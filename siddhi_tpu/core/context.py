@@ -35,6 +35,13 @@ class TimestampGenerator:
         self.playback = False
         self._last_event_ts: int = -1
         self._increment_listeners = []
+        # @app:playback(idle.time, increment) heartbeat (reference
+        # TimestampGeneratorImpl idle task): when no event arrives for
+        # idle_ms of WALL time, the event clock advances by increment_ms
+        self._hb_idle_ms: int = 0
+        self._hb_increment_ms: int = 0
+        self._hb_thread = None
+        self._hb_stop = None
 
     def current_time(self) -> int:
         if self.playback and self._last_event_ts >= 0:
@@ -47,6 +54,49 @@ class TimestampGenerator:
             # snapshot: one-shot listeners remove themselves mid-iteration
             for listener in tuple(self._increment_listeners):
                 listener(ts)
+        if self._hb_idle_ms and self._hb_thread is None:
+            self._start_heartbeat()
+
+    def configure_heartbeat(self, idle_ms: int, increment_ms: int):
+        self._hb_idle_ms = int(idle_ms)
+        self._hb_increment_ms = int(increment_ms)
+
+    def set_heartbeat_barrier(self, lock):
+        """The app's ingestion barrier (snapshot quiesce gate): heartbeat
+        ticks advance the clock under it so they serialize with
+        InputHandler.send and persistence snapshots."""
+        self._hb_barrier = lock
+
+    def _start_heartbeat(self):
+        import threading
+
+        self._hb_stop = threading.Event()
+        stop = self._hb_stop
+        barrier = getattr(self, "_hb_barrier", None) or threading.RLock()
+
+        def _run():
+            seen = self._last_event_ts
+            while not stop.wait(self._hb_idle_ms / 1000.0):
+                with barrier:
+                    cur = self._last_event_ts
+                    if cur == seen and cur >= 0 and not stop.is_set():
+                        # idle: advance the event clock (fires timers)
+                        self.set_current_timestamp(cur + self._hb_increment_ms)
+                    seen = self._last_event_ts
+
+        self._hb_thread = threading.Thread(
+            target=_run, name="playback-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        # zero idle_ms FIRST: the lazy-start guard in set_current_timestamp
+        # must never resurrect a thread after shutdown (a tick in flight
+        # could otherwise re-enter it with _hb_thread already None)
+        self._hb_idle_ms = 0
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_thread = None
+            self._hb_stop = None
 
     def reset_timestamp(self, ts: int):
         """Force the event clock (restore/rollback): unlike

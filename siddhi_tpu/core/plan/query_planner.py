@@ -292,6 +292,12 @@ def plan_join_query(
 
     join: JoinInputStream = query.input_stream
     dictionary = app_context.string_dictionary
+    # outputExpectsExpiredEvents (JoinInputStreamParser): `insert into`
+    # joins never drain batch windows' findable queues, so probes keep
+    # seeing the last non-empty batch across empty timer flushes
+    _oet = (query.output_stream.output_event_type
+            if query.output_stream else "current")
+    side_expired_needed = _oet != "current"
 
     def build_side(key: str, s: SingleInputStream) -> JoinSide:
         sid = s.unique_stream_id
@@ -379,13 +385,16 @@ def plan_join_query(
                     from siddhi_tpu.ops.keyed_windows import create_keyed_window_stage
 
                     window_stage = create_keyed_window_stage(
-                        h, ext_sdef, resolver, app_context)
+                        h, ext_sdef, resolver, app_context,
+                        expired_needed=side_expired_needed)
                     if not getattr(window_stage, "keyed", False):
                         raise CompileError(
                             f"window '{h.name}' cannot be a join side inside "
                             f"a partition (no per-key probe surface)")
                 else:
-                    window_stage = create_window_stage(h, ext_sdef, resolver, app_context)
+                    window_stage = create_window_stage(
+                        h, ext_sdef, resolver, app_context,
+                        expired_needed=side_expired_needed)
                 if getattr(window_stage, "host_mode", False):
                     # sort/frequent/... run host-side; emissions trigger the
                     # join, contents() is the probe surface

@@ -525,12 +525,19 @@ class KeyedTimeBatchWindowStage(WindowStage):
     batch_mode = True
     needs_scheduler = True
 
-    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int):
+    def __init__(self, time_ms: int, col_specs: Dict[str, np.dtype], capacity: int,
+                 expired_needed: bool = True):
         if time_ms <= 0:
             raise CompileError("timeBatch window needs a positive time")
         self.time_ms = time_ms
         self.capacity = capacity
         self.col_specs = col_specs
+        # outputExpectsExpiredEvents=False (insert-into join sides): a key
+        # whose batch is empty never flushes, so the findable prev batch is
+        # retained for probes instead of drained (matches the unkeyed
+        # TimeBatchWindowStage and the reference's undrained
+        # expiredEventQueue)
+        self.expired_needed = expired_needed
 
     def init_state(self, num_keys: int = 1) -> dict:
         Wc = self.capacity
@@ -572,8 +579,9 @@ class KeyedTimeBatchWindowStage(WindowStage):
 
         # ---- compacted flush of due keys
         D = min(64, K)
+        exp_need = jnp.bool_(self.expired_needed)
         due = (next_emit > 0) & (now >= next_emit) \
-            & ((cnt > 0) | (state["prev_cnt"] > 0))
+            & ((cnt > 0) | (exp_need & (state["prev_cnt"] > 0)))
         korder = jnp.argsort(~due)
         kids = korder[:D]
         ksel = due[kids]
@@ -618,12 +626,11 @@ class KeyedTimeBatchWindowStage(WindowStage):
 
         out[OVERFLOW_KEY] = jnp.any(overflow_now > Wc).astype(jnp.int32)
         started = new_next > 0
-        nxt = jnp.min(jnp.where(started & ((new_cnt > 0) | (new_prev_cnt > 0)),
-                                new_next, _BIG))
+        sched_need = (new_cnt > 0) | (exp_need & (new_prev_cnt > 0))
+        nxt = jnp.min(jnp.where(started & sched_need, new_next, _BIG))
         nxt = jnp.where(leftover, now, nxt)
         out[NOTIFY_KEY] = jnp.where(
-            jnp.any(started & ((new_cnt > 0) | (new_prev_cnt > 0))) | leftover,
-            nxt, jnp.int64(-1))
+            jnp.any(started & sched_need) | leftover, nxt, jnp.int64(-1))
         return {"buf": buf, "prev": new_prev, "cnt": new_cnt,
                 "prev_cnt": new_prev_cnt, "next_emit": new_next}, out
 
@@ -1000,7 +1007,8 @@ class KeyedBatchWindowStage(WindowStage):
                 "prev_count": state["prev_count"].at[ids].set(0)}
 
 
-def create_keyed_window_stage(window, input_def, resolver, app_context) -> WindowStage:
+def create_keyed_window_stage(window, input_def, resolver, app_context,
+                              expired_needed: bool = True) -> WindowStage:
     """Keyed (partitioned) window factory. Capacity per key comes from
     ``app_context.partition_window_capacity``."""
     from siddhi_tpu.ops.windows import (_const_param, _expect_arity,
@@ -1058,7 +1066,8 @@ def create_keyed_window_stage(window, input_def, resolver, app_context) -> Windo
                 "inside a partition yet")
         _expect_arity(window, 1, 1)
         return KeyedTimeBatchWindowStage(
-            _int_const_param(window, 0, "time"), col_specs, capacity)
+            _int_const_param(window, 0, "time"), col_specs, capacity,
+            expired_needed=expired_needed)
     if name == "batch":
         if window.parameters:
             raise CompileError(

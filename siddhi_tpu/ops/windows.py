@@ -719,11 +719,18 @@ class TimeBatchWindowStage(WindowStage):
         out[FLUSH_KEY] = jnp.zeros_like(out[TS_KEY], dtype=jnp.int32)
 
         zero_count = jnp.int64(0)
+        # prev (the findable expiredEventQueue): with expired outputs an
+        # empty flush drains it (its expireds were just emitted); find-only
+        # queries never drain it, so an empty flush RETAINS the last batch
+        # for join probes (TimeBatchWindowProcessor flush: the expired
+        # drain is gated on outputExpectsExpiredEvents)
+        replace_prev = send & (self.expired_needed | (count > 0))
         new_state = {
             "cur": {k: jnp.where(send, jnp.zeros_like(v), v) for k, v in cur_buf.items()},
-            "prev": {k: jnp.where(send, cur_buf[k], state["prev"][k]) for k in state["prev"]},
+            "prev": {k: jnp.where(replace_prev, cur_buf[k], state["prev"][k])
+                     for k in state["prev"]},
             "count": jnp.where(send, zero_count, count),
-            "prev_count": jnp.where(send, count, state["prev_count"]),
+            "prev_count": jnp.where(replace_prev, count, state["prev_count"]),
             "next_emit": next_emit,
         }
         out[NOTIFY_KEY] = next_emit
@@ -1133,7 +1140,9 @@ class ExternalTimeBatchWindowStage(WindowStage):
     batch_mode = True
 
     def __init__(self, ts_fn, time_ms: int, col_specs: Dict[str, np.dtype],
-                 capacity: int, start_time: int = -1, timeout: int = 0):
+                 capacity: int, expired_needed: bool = True,
+                 start_time: int = -1, timeout: int = 0):
+        self.expired_needed = expired_needed
         self.ts_fn = ts_fn          # compiled expr for the time attribute
         self.time_ms = time_ms
         self.capacity = capacity
@@ -1177,11 +1186,20 @@ class ExternalTimeBatchWindowStage(WindowStage):
             init_end = first_ts + t
         end0 = jnp.where(state["end"] < 0, init_end, state["end"])
 
-        # window index per row relative to end0 (0 = accumulating window),
-        # monotone-ized against out-of-order timestamps
+        # Grid distance per row (how many whole windows past end0 its ts
+        # lies), monotone-ized against out-of-order timestamps. Flushes are
+        # ORDINAL: one per crossing event, regardless of how far the time
+        # jumped — the reference emits a single flush and snaps endTime to
+        # cover the event (ExternalTimeBatchWindowProcessor.java:285-297),
+        # never synthesizing empty intermediate batches. b_i = the ordinal
+        # batch a row belongs to (0 = the carried open window).
         raw_b = jnp.where(tsv >= end0, (tsv - end0) // t + 1, 0)
-        b_i = lax.cummax(jnp.where(valid_cur, raw_b, jnp.int64(0)))
+        rawm = lax.cummax(jnp.where(valid_cur, raw_b, jnp.int64(0)))
+        prev_rawm = jnp.concatenate([jnp.zeros((1,), jnp.int64), rawm[:-1]])
+        jump = valid_cur & (rawm > prev_rawm)
+        b_i = jnp.cumsum(jump.astype(jnp.int64))
         n_flush = b_i[B - 1]
+        max_raw = rawm[B - 1]             # grid distance the end advances by
 
         count0 = state["count"]
         flushed0 = state["flushed"]
@@ -1288,14 +1306,21 @@ class ExternalTimeBatchWindowStage(WindowStage):
 
         any_first = jnp.any(valid_cur)
         new_end = jnp.where(state["end"] < 0,
-                            jnp.where(any_first, end0 + n_flush * t, jnp.int64(-1)),
-                            end0 + n_flush * t)
+                            jnp.where(any_first, end0 + max_raw * t, jnp.int64(-1)),
+                            end0 + max_raw * t)
         out[OVERFLOW_KEY] = ((new_count > Wc) | (new_prev_count > Wc)).astype(jnp.int32)
         new_flushed = jnp.where(n_flush > 0, jnp.bool_(False),
                                 jnp.where(due, jnp.bool_(True), flushed0))
         new_sched = last_sched0
         if self.timeout > 0:
-            resched = due | (n_flush > 0) | ((state["end"] < 0) & any_first)
+            # a firing timer ALWAYS advances the schedule, due or not —
+            # the reference's timer branch reschedules unconditionally
+            # (ExternalTimeBatchWindowProcessor.java:270-274); leaving a
+            # stale last_sched <= now would re-notify the same past instant
+            # and spin the playback sweep forever
+            timer_fired = has_timer & (last_sched0 >= 0) & (now >= last_sched0)
+            resched = (due | timer_fired | (n_flush > 0)
+                       | ((state["end"] < 0) & any_first))
             new_sched = jnp.where(resched, now + jnp.int64(self.timeout),
                                   last_sched0)
             out[NOTIFY_KEY] = jnp.where(new_sched >= 0, new_sched, jnp.int64(-1))
@@ -1343,10 +1368,16 @@ def window_col_specs(input_def, extra: Tuple[str, ...] = ()) -> Dict[str, np.dty
     return col_specs
 
 
-def create_window_stage(window: Window, input_def, resolver, app_context) -> WindowStage:
+def create_window_stage(window: Window, input_def, resolver, app_context,
+                        expired_needed: bool = True) -> WindowStage:
     """Build a window stage from a ``#window.<name>(params)`` handler — the
     factory role of reference ``SingleInputStreamParser.generateProcessor``
-    plus each window's ``init`` validation."""
+    plus each window's ``init`` validation.
+
+    ``expired_needed=False`` mirrors the reference's
+    outputExpectsExpiredEvents=false: batch windows skip expired emission
+    and their findable queue is never drained by empty flushes (join sides
+    of `insert into` queries keep probing the last non-empty batch)."""
     name = window.name.lower()
     col_specs = window_col_specs(input_def)
 
@@ -1362,6 +1393,7 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
         if len(window.parameters) == 2:
             stream_current = _bool_const_param(window, 1, "streamCurrentEvents")
         return LengthBatchWindowStage(_int_const_param(window, 0, "length"), col_specs,
+                                      expired_needed=expired_needed,
                                       stream_current=stream_current)
     if name == "time":
         _expect_arity(window, 1, 1)
@@ -1396,7 +1428,8 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
             start_time = _int_const_param(window, 1, "startTime")
             stream_current = _bool_const_param(window, 2, "streamCurrentEvents")
         return TimeBatchWindowStage(_int_const_param(window, 0, "time"), col_specs,
-                                    capacity, start_time=start_time,
+                                    capacity, expired_needed=expired_needed,
+                                    start_time=start_time,
                                     stream_current=stream_current)
     if name == "batch":
         # batch([chunkLength]) — BatchWindowProcessor.java:107-118
@@ -1404,7 +1437,8 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
         chunk_length = 0
         if window.parameters:
             chunk_length = _int_const_param(window, 0, "chunkLength")
-        return BatchWindowStage(col_specs, capacity, chunk_length=chunk_length)
+        return BatchWindowStage(col_specs, capacity, expired_needed=expired_needed,
+                                chunk_length=chunk_length)
     if name == "timelength":
         _expect_arity(window, 2, 2)
         return TimeLengthWindowStage(_int_const_param(window, 0, "time"),
@@ -1430,7 +1464,8 @@ def create_window_stage(window: Window, input_def, resolver, app_context) -> Win
             timeout = _int_const_param(window, 3, "timeout")
         return ExternalTimeBatchWindowStage(
             ts_fn, _int_const_param(window, 1, "time"), col_specs, capacity,
-            start_time=start_time, timeout=timeout)
+            expired_needed=expired_needed, start_time=start_time,
+            timeout=timeout)
     if name == "hopping":
         _expect_arity(window, 2, 2)
         return HoppingWindowStage(

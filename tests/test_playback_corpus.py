@@ -1,0 +1,305 @@
+"""Reference playback corpus — scenarios ported from
+``managment/PlaybackTestCase.java``: the event-time clock drives timers,
+and the ``@app:playback(idle.time, increment)`` heartbeat advances the
+clock through quiet wall-time periods (TimestampGeneratorImpl idle task).
+Heartbeat tests use real (short) wall sleeps, as the reference does."""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.compiler.errors import (SiddhiParserException,
+                                        SiddhiAppValidationException)
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+def build_q(app, query="query1"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback(query, q)
+    return m, rt, q
+
+
+def wait_for(cond, timeout=10.0):
+    """SiddhiTestHelper.waitForEvents: poll until cond() or timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return cond()
+
+
+def test_playback_time_batch_event_driven():
+    """playbackTest1 (:48-106): timeBatch(1 sec) driven purely by event
+    timestamps — 3 in, 2 remove."""
+    m, rt, q = build_q("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.timeBatch(1 sec)
+        select * insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    ts = 1700000000000
+    h.send(ts, ["IBM", 700.0, 0])
+    h.send(ts + 500, ["WSO2", 60.5, 1])
+    h.send(ts + 1000, ["GOOGLE", 85.0, 1])
+    h.send(ts + 2000, ["ORACLE", 90.5, 1])
+    m.shutdown()
+    assert len(q.events) == 3
+    assert len(q.expired) == 2
+
+
+def test_playback_time_batch_start_time():
+    """playbackTest2 (:109-168): timeBatch(2 sec, 0) + sum — three
+    non-empty batches collapse to 3 in rows."""
+    m, rt, q = build_q("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.timeBatch(2 sec, 0)
+        select symbol, sum(price) as sumPrice, volume insert into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(0, ["IBM", 700.0, 0])
+    h.send(0, ["WSO2", 60.5, 1])
+    h.send(8500, ["WSO2", 60.5, 1])
+    h.send(8500, ["II", 60.5, 1])
+    h.send(21500, ["TT", 60.5, 1])
+    h.send(21500, ["YY", 60.5, 1])
+    h.send(26500, ["ZZ", 0.0, 0])
+    m.shutdown()
+    assert len(q.events) == 3
+    assert q.expired == []
+
+
+def test_playback_heartbeat_flushes_last_batch():
+    """playbackTest3 (:171-228): the heartbeat drains the final timeBatch
+    batch with no trailing event. idle.time is scaled to 1 sec (reference:
+    100 ms) so first-compile pauses between sends cannot fire it
+    mid-feed — the JVM's sends are microseconds apart."""
+    m, rt, q = build_q("""
+        @app:playback(idle.time = '1 sec', increment = '2 sec')
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.timeBatch(2 sec, 0)
+        select symbol, sum(price) as sumPrice, volume insert into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(0, ["IBM", 700.0, 0])
+    h.send(0, ["WSO2", 60.5, 1])
+    h.send(8500, ["WSO2", 60.5, 1])
+    h.send(8500, ["II", 60.5, 1])
+    h.send(21500, ["TT", 60.5, 1])
+    h.send(21500, ["YY", 60.5, 1])
+    assert wait_for(lambda: len(q.events) >= 3)
+    m.shutdown()
+    assert len(q.events) == 3
+    assert q.expired == []
+
+
+def test_playback_heartbeat_join():
+    """playbackTest4 (:230-279): joined timeBatch(1 sec) sides drained by
+    the heartbeat — 2 in events, none removed. idle.time scaled to 1 sec
+    (see test_playback_heartbeat_flushes_last_batch); the app is built and
+    fed once first so the timed run hits warm jit caches instead of
+    multi-second first compiles mid-feed."""
+    APP = """
+        @app:playback(idle.time = '3 sec', increment = '1 sec')
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+        @info(name = 'query1')
+        from cseEventStream#window.timeBatch(1 sec) join twitterStream#window.timeBatch(1 sec)
+        on cseEventStream.symbol == twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert into OutStream;
+    """
+
+    def run():
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(APP)
+        q = QCollect()
+        rt.add_callback("query1", q)
+        cse = rt.get_input_handler("cseEventStream")
+        twitter = rt.get_input_handler("twitterStream")
+        ts = 1700000000000
+        cse.send(ts, ["WSO2", 55.6, 100])
+        twitter.send(ts, ["User1", "Hello World", "WSO2"])
+        cse.send(ts, ["IBM", 75.6, 100])
+        cse.send(ts + 1100, ["WSO2", 57.6, 100])
+        ok = wait_for(lambda: len(q.events) >= 2, timeout=25.0)
+        m.shutdown()
+        return ok, q
+
+    run()                        # warm the jit caches
+    ok, q = run()
+    assert ok
+    assert len(q.events) == 2
+    assert q.expired == []
+
+
+def test_playback_time_length_event_driven():
+    """playbackTest5 (:281-330): timeLength(4 sec, 10) — the 5 sec jump
+    expires the first four; 5 in, 4 remove."""
+    m, rt, q = build_q("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.timeLength(4 sec, 10)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    ts = 1700000000000
+    for i, (sym, p, v) in enumerate([("IBM", 700.0, 1), ("WSO2", 60.5, 2),
+                                     ("IBM", 700.0, 3), ("WSO2", 60.5, 4)]):
+        h.send(ts + 500 * i, [sym, p, v])
+    h.send(ts + 1500 + 5000, ["GOOGLE", 90.5, 5])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert [e.data[2] for e in q.expired] == [1, 2, 3, 4]
+
+
+def test_playback_heartbeat_time_length():
+    """playbackTest6 (:332-381): heartbeat increment 4 sec expires all four
+    timeLength rows with no trailing event — 4 in, 4 remove."""
+    m, rt, q = build_q("""
+        @app:playback(idle.time = '100 millisecond', increment = '4 sec')
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.timeLength(4 sec, 10)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    ts = 1700000000000
+    for i, (sym, p, v) in enumerate([("IBM", 700.0, 1), ("WSO2", 60.5, 2),
+                                     ("IBM", 700.0, 3), ("WSO2", 60.5, 4)]):
+        h.send(ts + 500 * i, [sym, p, v])
+    assert wait_for(lambda: len(q.expired) >= 4)
+    m.shutdown()
+    assert len(q.events) == 4
+    assert len(q.expired) == 4
+
+
+def test_playback_time_window_event_driven():
+    """playbackTest7 (:383-432): time(2 sec) — the 2 sec jump expires the
+    first two; 3 in, 2 remove."""
+    m, rt, q = build_q("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.time(2 sec)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    ts = 1700000000000
+    h.send(ts, ["IBM", 700.0, 0])
+    h.send(ts, ["WSO2", 60.5, 1])
+    h.send(ts + 2000, ["GOOGLE", 0.0, 1])
+    m.shutdown()
+    assert len(q.events) == 3
+    assert len(q.expired) == 2
+
+
+def test_playback_heartbeat_time_window():
+    """playbackTest8 (:434-481): heartbeat increment 2 sec expires both
+    rows with no trailing event."""
+    m, rt, q = build_q("""
+        @app:playback(idle.time = '100 millisecond', increment = '2 sec')
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.time(2 sec)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    ts = 1700000000000
+    h.send(ts, ["IBM", 700.0, 0])
+    h.send(ts, ["WSO2", 60.5, 1])
+    assert wait_for(lambda: len(q.expired) >= 2)
+    m.shutdown()
+    assert len(q.events) == 2
+    assert len(q.expired) == 2
+
+
+def test_playback_rejects_unitless_increment():
+    """playbackTest9 (:483-499): increment '2' (no unit) fails creation."""
+    with pytest.raises(SiddhiParserException):
+        SiddhiManager().create_siddhi_app_runtime("""
+            @app:playback(idle.time = '100 millisecond', increment = '2')
+            define stream S (symbol string, price float, volume int);
+            from S#window.time(2 sec) select symbol insert all events into OutStream;
+        """)
+
+
+def test_playback_rejects_empty_idle_time():
+    """playbackTest10 (:501-517): idle.time '' fails creation."""
+    with pytest.raises(SiddhiParserException):
+        SiddhiManager().create_siddhi_app_runtime("""
+            @app:playback(idle.time = '', increment = '2 sec')
+            define stream S (symbol string, price float, volume int);
+            from S#window.time(2 sec) select symbol insert all events into OutStream;
+        """)
+
+
+def test_playback_requires_both_heartbeat_elements():
+    """SiddhiAppParser.java:191-197: idle.time without increment (and vice
+    versa) fails creation."""
+    with pytest.raises(SiddhiAppValidationException):
+        SiddhiManager().create_siddhi_app_runtime("""
+            @app:playback(idle.time = '100 millisecond')
+            define stream S (symbol string, price float, volume int);
+            from S select symbol insert into OutStream;
+        """)
+
+
+def test_playback_heartbeat_out_of_order_event():
+    """playbackTest11 (:519-570): an out-of-order event below the advanced
+    clock joins the open batch without moving the clock backward — 3 in,
+    3 remove once the heartbeat drains the batches."""
+    m, rt, q = build_q("""
+        @app:playback(idle.time = '100 millisecond', increment = '1 sec')
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.timeBatch(2 sec)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(100, ["IBM", 700.0, 0])
+    h.send(200, ["WSO2", 600.5, 1])
+    time.sleep(0.15)
+    h.send(1150, ["ORACLE", 500.0, 2])
+    assert wait_for(lambda: len(q.events) >= 3 and len(q.expired) >= 3)
+    m.shutdown()
+    assert len(q.events) == 3
+    assert len(q.expired) == 3
+
+
+def test_playback_heartbeat_ahead_of_clock_event():
+    """playbackTest12 (:573-625): an event ahead of the heartbeat-advanced
+    clock re-anchors it — 3 in, 3 remove."""
+    m, rt, q = build_q("""
+        @app:playback(idle.time = '100 millisecond', increment = '1 sec')
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.timeBatch(2 sec)
+        select symbol, price, volume insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(100, ["IBM", 700.0, 0])
+    h.send(200, ["WSO2", 600.5, 1])
+    time.sleep(0.15)
+    h.send(1900, ["ORACLE", 500.0, 2])
+    assert wait_for(lambda: len(q.events) >= 3 and len(q.expired) >= 3)
+    m.shutdown()
+    assert len(q.events) == 3
+    assert len(q.expired) == 3

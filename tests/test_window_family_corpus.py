@@ -1214,3 +1214,465 @@ def test_length_batch_rejects_bad_params():
             SiddhiManager().create_siddhi_app_runtime(
                 "define stream S (symbol string, price float, volume int); "
                 f"from S#window.{w} select symbol insert all events into OutStream;")
+
+
+# --------------------------------------------- TimeBatchWindowTestCase
+
+
+TB_APP = """@app:playback
+    define stream cseEventStream (symbol string, price float, volume int);
+    define stream Tick (x int);
+    @info(name = 'query1')
+    from cseEventStream#window.timeBatch({params})
+    select {sel} insert {mode} into OutStream;
+    from Tick select x insert into TickOut;
+"""
+
+
+def test_time_batch_first_flush_then_expiry():
+    """timeWindowBatchTest1 (:47-90): 2 events in the first period of
+    timeBatch(1 sec) + sum — one in row at the first flush, one remove row
+    when the batch expires a period later."""
+    m, rt, q = build_q(TB_APP.format(params="1 sec",
+                                     sel="symbol, sum(price) as sumPrice, volume",
+                                     mode="all events"))
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 0])
+    h.send(1010, ["WSO2", 60.5, 1])
+    tick.send(4100, [0])                 # Thread.sleep(3000)
+    m.shutdown()
+    assert len(q.events) == 1
+    assert len(q.expired) == 1
+    assert q.events[0].data[1] == 760.5
+
+
+def _feed_tb(rt):
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 1])
+    h.send(2150, ["WSO2", 60.5, 2])      # Thread.sleep(1100)
+    h.send(2160, ["IBM", 700.0, 3])
+    h.send(2170, ["WSO2", 60.5, 4])
+    h.send(3300, ["IBM", 700.0, 5])      # Thread.sleep(1100)
+    h.send(3310, ["WSO2", 60.5, 6])
+    tick.send(5400, [0])                 # Thread.sleep(2000)
+
+
+def test_time_batch_sum_all_events():
+    """timeWindowBatchTest2 (:92-137): three non-empty batches collapse to
+    3 in rows; the final period's expiry adds 1 remove row."""
+    m, rt, q = build_q(TB_APP.format(params="1 sec",
+                                     sel="symbol, sum(price) as price",
+                                     mode="all events"))
+    _feed_tb(rt)
+    m.shutdown()
+    assert len(q.events) == 3
+    assert len(q.expired) == 1
+
+
+def test_time_batch_sum_current_only():
+    """timeWindowBatchTest3 (:139-184): `insert into` — 3 in rows, no
+    removes."""
+    m, rt, q = build_q(TB_APP.format(params="1 sec",
+                                     sel="symbol, sum(price) as price",
+                                     mode=""))
+    _feed_tb(rt)
+    m.shutdown()
+    assert len(q.events) == 3
+    assert q.expired == []
+
+
+def test_time_batch_sum_expired_only():
+    """timeWindowBatchTest4 (:186-231): `insert expired events` — each
+    flush's expired chunk collapses to one row: 3 removes, no ins."""
+    m, rt, q = build_q(TB_APP.format(params="1 sec",
+                                     sel="symbol, sum(price) as price",
+                                     mode="expired events"))
+    _feed_tb(rt)
+    m.shutdown()
+    assert q.events == []
+    assert len(q.expired) == 3
+
+
+def _tb_join_app(window):
+    return f"""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        from cseEventStream#window.{window} join twitterStream#window.{window}
+        on cseEventStream.symbol == twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert {{mode}} into OutStream;
+        from Tick select x insert into TickOut;
+    """
+
+
+def _feed_tb_join(rt, end_ts):
+    cse = rt.get_input_handler("cseEventStream")
+    twitter = rt.get_input_handler("twitterStream")
+    tick = rt.get_input_handler("Tick")
+    cse.send(1000, ["WSO2", 55.6, 100])
+    twitter.send(1010, ["User1", "Hello World", "WSO2"])
+    cse.send(1020, ["IBM", 75.6, 100])
+    tick.send(2150, [0])                 # Thread.sleep(1100)
+    cse.send(2200, ["WSO2", 57.6, 100])
+    tick.send(end_ts, [0])               # final sleep
+    return rt
+
+
+def test_time_batch_join_all_events():
+    """timeWindowBatchTest5 (:233-280): join of two timeBatch(1 sec) sides
+    `insert all events` — the reference accepts 1..2 in and 1..2 remove."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(_tb_join_app("timeBatch(1 sec)").format(mode="all events"))
+    q = QCollect()
+    rt.add_callback("query1", q)
+    _feed_tb_join(rt, 3250)
+    m.shutdown()
+    assert 1 <= len(q.events) <= 2
+    assert 1 <= len(q.expired) <= 2
+
+
+def test_time_batch_join_current_only():
+    """timeWindowBatchTest6 (:282-328): same join `insert into` — no
+    removes reach the callback."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(_tb_join_app("timeBatch(1 sec)").format(mode=""))
+    q = QCollect()
+    rt.add_callback("query1", q)
+    _feed_tb_join(rt, 3300)
+    m.shutdown()
+    assert q.expired == []
+
+
+def test_time_batch_start_time_anchored_batches():
+    """timeWindowBatchTest7 (:330-384): timeBatch(2 sec, 0) anchors
+    boundaries at even seconds — three non-empty batches, three in rows,
+    no removes for `insert into`."""
+    m, rt, q = build_q(TB_APP.format(params="2 sec, 0",
+                                     sel="symbol, sum(price) as sumPrice, volume",
+                                     mode=""))
+    h = rt.get_input_handler("cseEventStream")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, ["IBM", 700.0, 0])
+    h.send(1010, ["WSO2", 60.5, 1])
+    tick.send(9600, [0])                 # Thread.sleep(8500)
+    h.send(9700, ["WSO2", 60.5, 1])
+    h.send(9710, ["II", 60.5, 1])
+    tick.send(22700, [0])                # Thread.sleep(13000)
+    h.send(22800, ["TT", 60.5, 1])
+    h.send(22810, ["YY", 60.5, 1])
+    tick.send(27900, [0])                # Thread.sleep(5000)
+    m.shutdown()
+    assert len(q.events) == 3
+    assert q.expired == []
+
+
+def test_time_batch_stream_current_join():
+    """timeWindowBatchTest8 (:386-430): join of two timeBatch(1 sec, true)
+    sides — exactly one remove event."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        _tb_join_app("timeBatch(1 sec, true)").format(mode="all events"))
+    q = QCollect()
+    rt.add_callback("query1", q)
+    _feed_tb_join(rt, 3650)
+    m.shutdown()
+    assert len(q.expired) == 1
+
+
+# ------------------------------------- ExternalTimeBatchWindowTestCase
+
+
+ETB_APP = """@app:playback
+    define stream LoginEvents (timestamp long, ip string);
+    define stream Tick (x int);
+    @info(name = 'query1')
+    from LoginEvents#window.externalTimeBatch({params})
+    select timestamp, ip, count() as total insert all events into OutStream;
+    from Tick select x insert into TickOut;
+"""
+
+
+def test_etb_no_crossing_no_output():
+    """test02NoMsg (:56-82): five events inside one 10 sec window — no
+    crossing, no output."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream jmxMetric (cpu int, timestamp long);
+        @info(name = 'query')
+        from jmxMetric#window.externalTimeBatch(timestamp, 10 sec)
+        select avg(cpu) as avgCpu, count() as c insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query", q)
+    h = rt.get_input_handler("jmxMetric")
+    now = 1700000000000
+    for i in range(5):
+        h.send(now + i * 1000, [15, now + i * 1000])
+    m.shutdown()
+    assert q.events == []
+
+
+def test_etb_edge_case_rounds_do_not_mix():
+    """test05EdgeCase (:100-142): the crossing event starts the next batch
+    and never joins the flushing one — avg 15 then avg 85, count 3 both."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream jmxMetric (cpu int, timestamp long);
+        @info(name = 'query')
+        from jmxMetric#window.externalTimeBatch(timestamp, 10 sec)
+        select avg(cpu) as avgCpu, count() as c insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query", q)
+    h = rt.get_input_handler("jmxMetric")
+    for i in range(3):
+        h.send(1000 + i, [15, i * 10])
+    for i in range(3):
+        h.send(2000 + i, [85, 10000 + i * 10])
+    h.send(3000, [10000, 100000])
+    m.shutdown()
+    assert [(e.data[0], e.data[1]) for e in q.events] == [(15.0, 3), (85.0, 3)]
+
+
+def test_etb_down_sampling_one_row_per_round():
+    """test01DownSampling (:144-209): 5 rounds of 3 events 10 sec apart —
+    the aggregate projection emits exactly one row per completed round (4),
+    while the raw stream callback sees all 15."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream jmxMetric (cpu int, memory int, timestamp long);
+        @info(name = 'downSample')
+        from jmxMetric#window.externalTimeBatch(timestamp, 10 sec)
+        select avg(cpu) as avgCpu, max(cpu) as maxCpu, min(cpu) as minCpu,
+               avg(memory) as avgMem, timestamp as timeWindowEnds,
+               count() as metric_count
+        insert into OutStream;
+    """)
+    raw, q = Collector(), QCollect()
+    rt.add_callback("jmxMetric", raw)
+    rt.add_callback("downSample", q)
+    h = rt.get_input_handler("jmxMetric")
+    base = 1700000000000
+    for ite in range(5):
+        for i in range(3):
+            h.send(base + ite * 10000 + i * 50,
+                   [15 + 10 * i * ite, 1500 + 10 * i * ite,
+                    base + ite * 10000 + i * 50])
+    m.shutdown()
+    assert len(raw.events) == 15
+    assert len(q.events) == 4
+    assert all(e.data[5] == 3 for e in q.events)
+
+
+def test_etb_first_event_anchors_batches():
+    """test1 (:226-286): externalTimeBatch(currentTime, 5 sec) without a
+    startTime anchors on the first event — flushes lead with values 1, 6,
+    11."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream inputStream (currentTime long, value int);
+        @info(name = 'query')
+        from inputStream#window.externalTimeBatch(currentTime, 5 sec)
+        select value insert into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("inputStream")
+    feed = [(10000, 1), (11000, 2), (12000, 3), (13000, 4), (14000, 5),
+            (15000, 6), (16500, 7), (17000, 8), (18000, 9), (19000, 10),
+            (20000, 11), (20500, 12), (22000, 13), (25000, 14)]
+    for ts, v in feed:
+        h.send(ts, [ts, v])
+    m.shutdown()
+    assert len(c.chunks) == 3
+    firsts = []
+    i = 0
+    for n in c.chunks:
+        firsts.append(c.events[i].data[0])
+        i += n
+    assert firsts == [1, 6, 11]
+
+
+def test_etb_start_time_anchors_batches():
+    """test2 (:288-324): externalTimeBatch(currentTime, 5 sec, 1200) —
+    boundaries at 1200+5000k: the first flush is values 0..11, the second
+    starts at 12."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream inputStream (currentTime long, value int);
+        @info(name = 'query')
+        from inputStream#window.externalTimeBatch(currentTime, 5 sec, 1200)
+        select value insert into OutStream;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("inputStream")
+    for i in range(0, 10000, 100):
+        h.send(i + 10000, [i + 10000, i // 100])
+    m.shutdown()
+    assert len(c.chunks) == 2
+    assert c.events[0].data[0] == 0
+    assert c.events[c.chunks[0] - 1].data[0] == 11
+    assert c.events[c.chunks[0]].data[0] == 12
+
+
+def test_etb_scheduler_flushes_last_batch():
+    """schedulerLastBatchTriggerTest (:326-393): with a 6 sec timeout the
+    trailing batches flush on the scheduler — flush heads 1, 6, 11, 14,
+    15."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream inputStream (currentTime long, value int);
+        define stream Tick (x int);
+        @info(name = 'query')
+        from inputStream#window.externalTimeBatch(currentTime, 5 sec, 0, 6 sec)
+        select value, currentTime insert current events into OutStream;
+        from Tick select x insert into TickOut;
+    """)
+    c = ChunkCollector()
+    rt.add_callback("OutStream", c)
+    h = rt.get_input_handler("inputStream")
+    tick = rt.get_input_handler("Tick")
+    feed = [(10000, 1), (11000, 2), (12000, 3), (13000, 4), (14000, 5),
+            (15000, 6), (16500, 7), (17000, 8), (18000, 9), (19000, 10),
+            (20100, 11), (20500, 12), (22000, 13), (25000, 14),
+            (32000, 15), (33000, 16)]
+    for ts, v in feed:
+        h.send(ts, [ts, v])
+    tick.send(40000, [0])                # Thread.sleep(6000): timeout flush
+    m.shutdown()
+    firsts = []
+    i = 0
+    for n in c.chunks:
+        firsts.append(c.events[i].data[0])
+        i += n
+    assert firsts[:4] == [1, 6, 11, 14]
+    assert 15 in firsts
+
+
+def test_etb_timeout_batches_with_count():
+    """externalTimeBatchWindowTest1 (:395-441): (timestamp, 1 sec, 0,
+    6 sec) + count() `insert all events` — two crossings before the
+    timeout would fire: 2 in rows, 0 removes."""
+    m, rt, q = build_q(ETB_APP.format(params="timestamp, 1 sec, 0, 6 sec"))
+    h = rt.get_input_handler("LoginEvents")
+    for ts, ip in [(1366335804341, "192.10.1.3"), (1366335804342, "192.10.1.4"),
+                   (1366335814341, "192.10.1.5"), (1366335814345, "192.10.1.6"),
+                   (1366335824341, "192.10.1.7")]:
+        h.send(ts, [ts, ip])
+    m.shutdown()
+    assert len(q.events) == 2
+    assert q.expired == []
+
+
+def test_etb_first_anchor_keeps_sub_window_event():
+    """externalTimeBatchWindowTest2 (:443-491): without startTime the
+    window anchors at the first event's ts, so 805340 (< 804341+1000)
+    stays in batch 1 — 2 in rows."""
+    m, rt, q = build_q(ETB_APP.format(params="timestamp, 1 sec"))
+    h = rt.get_input_handler("LoginEvents")
+    for ts, ip in [(1366335804341, "192.10.1.3"), (1366335804342, "192.10.1.4"),
+                   (1366335805340, "192.10.1.4"), (1366335814341, "192.10.1.5"),
+                   (1366335814345, "192.10.1.6"), (1366335824341, "192.10.1.7")]:
+        h.send(ts, [ts, ip])
+    m.shutdown()
+    assert len(q.events) == 2
+    assert q.expired == []
+
+
+def test_etb_first_anchor_crossing_event():
+    """externalTimeBatchWindowTest3 (:493-541): 805341 (== 804341+1000)
+    crosses the anchored boundary — 3 in rows."""
+    m, rt, q = build_q(ETB_APP.format(params="timestamp, 1 sec"))
+    h = rt.get_input_handler("LoginEvents")
+    for ts, ip in [(1366335804341, "192.10.1.3"), (1366335804342, "192.10.1.4"),
+                   (1366335805341, "192.10.1.4"), (1366335814341, "192.10.1.5"),
+                   (1366335814345, "192.10.1.6"), (1366335824341, "192.10.1.7")]:
+        h.send(ts, [ts, ip])
+    m.shutdown()
+    assert len(q.events) == 3
+    assert q.expired == []
+
+
+def test_etb_absolute_second_boundaries():
+    """externalTimeBatchWindowTest4 (:543-592): startTime 0 pins
+    boundaries to absolute seconds — 805000 and 806000 cross: 3 in rows."""
+    m, rt, q = build_q(ETB_APP.format(params="timestamp, 1 sec, 0, 6 sec"))
+    h = rt.get_input_handler("LoginEvents")
+    for ts, ip in [(1366335804341, "192.10.1.3"), (1366335804999, "192.10.1.4"),
+                   (1366335805000, "192.10.1.4"), (1366335805999, "192.10.1.5"),
+                   (1366335806000, "192.10.1.6"), (1366335806001, "192.10.1.6"),
+                   (1366335824341, "192.10.1.7")]:
+        h.send(ts, [ts, ip])
+    m.shutdown()
+    assert len(q.events) == 3
+    assert q.expired == []
+
+
+def test_etb_timeout_flushes_single_batch():
+    """externalTimeBatchWindowTest5 (:594-641): four events in one window,
+    3 sec timeout — the scheduler flushes the lone batch: 1 in row."""
+    m, rt, q = build_q(ETB_APP.format(params="timestamp, 1 sec, 0, 3 sec"))
+    h = rt.get_input_handler("LoginEvents")
+    for ts, ip in [(1366335804341, "192.10.1.3"), (1366335804599, "192.10.1.4"),
+                   (1366335804600, "192.10.1.5"), (1366335804607, "192.10.1.6")]:
+        h.send(ts, [ts, ip])
+    tick = rt.get_input_handler("Tick")
+    tick.send(1366335809700, [0])        # Thread.sleep(5000)
+    m.shutdown()
+    assert len(q.events) == 1
+    assert q.expired == []
+
+
+def test_etb_timeout_splits_two_batches():
+    """externalTimeBatchWindowTest6 (:643-692): 1 sec windows with a 3 sec
+    timeout — the crossing flushes batch 1, the scheduler flushes batch 2:
+    2 in rows, 0 removes."""
+    m, rt, q = build_q(ETB_APP.format(params="timestamp, 1 sec, 0, 3 sec"))
+    h = rt.get_input_handler("LoginEvents")
+    for ts, ip in [(1366335804341, "192.10.1.3"), (1366335804599, "192.10.1.4"),
+                   (1366335804600, "192.10.1.5"), (1366335804607, "192.10.1.6"),
+                   (1366335805599, "192.10.1.4"), (1366335805600, "192.10.1.5"),
+                   (1366335805607, "192.10.1.6")]:
+        h.send(ts, [ts, ip])
+    tick = rt.get_input_handler("Tick")
+    tick.send(1366335810700, [0])        # Thread.sleep(5000)
+    m.shutdown()
+    assert len(q.events) == 2
+    assert q.expired == []
+
+
+def test_etb_append_after_timeout_counts():
+    """externalTimeBatchWindowTest8 (:750-816): 1 sec windows, 2 sec
+    timeout, out-of-order stragglers appended after timeout flushes — the
+    running counts are 4, 3, 5, 7, 2 (appends continue the batch count
+    without a RESET)."""
+    m, rt, q = build_q(ETB_APP.format(params="timestamp, 1 sec, 0, 2 sec"))
+    h = rt.get_input_handler("LoginEvents")
+    tick = rt.get_input_handler("Tick")
+    # wall clock (send ts) advances monotonically; the attribute carries
+    # the reference feed verbatim, including the out-of-order stragglers
+    feed1 = [(1366335804341, "192.10.1.3"), (1366335804599, "192.10.1.4"),
+             (1366335804600, "192.10.1.5"), (1366335804607, "192.10.1.6"),
+             (1366335805599, "192.10.1.4"), (1366335805600, "192.10.1.5"),
+             (1366335805607, "192.10.1.6")]
+    wall = 1000
+    for ts, ip in feed1:
+        h.send(wall, [ts, ip]); wall += 10
+    tick.send(wall + 2100, [0])          # Thread.sleep(2100): timeout flush
+    wall += 2200
+    for ts, ip in [(1366335805606, "192.10.1.7"), (1366335805605, "192.10.1.8")]:
+        h.send(wall, [ts, ip]); wall += 10
+    tick.send(wall + 2100, [0])          # timeout append flush
+    wall += 2200
+    for ts, ip in [(1366335805606, "192.10.1.91"), (1366335805605, "192.10.1.92"),
+                   (1366335806606, "192.10.1.9"), (1366335806690, "192.10.1.10")]:
+        h.send(wall, [ts, ip]); wall += 10
+    tick.send(wall + 3100, [0])          # final timeout flush
+    m.shutdown()
+    assert [e.data[2] for e in q.events] == [4, 3, 5, 7, 2]
+    assert q.expired == []

@@ -188,20 +188,27 @@ def test_session_latency_revive_bounded_by_due_same_batch():
 
 
 def test_etb_timeout_flush_then_double_crossing_expires_prev():
-    # rows flushed by the idle timer must still emit EXPIRED when the next
-    # event crosses 2+ window boundaries (prev expires at flush 2)
+    # rows flushed by the idle timer must still emit EXPIRED at the NEXT
+    # actual flush — a crossing that jumps several window boundaries is
+    # still ONE flush (the reference snaps endTime to cover the event,
+    # ExternalTimeBatchWindowProcessor.java:285-297, and never synthesizes
+    # empty intermediate batches)
     m, rt, c = build("""@app:playback define stream S (ets long, v int);
         from S#window.externalTimeBatch(ets, 10 sec, 0, 1 sec)
         select v insert all events into OutStream;
     """)
     h = rt.get_input_handler("S")
     h.send(1000, [1000, 5])
-    h.send(2500, [1100, 7])      # timer flush {5} happened; 7 joins window 0
-    h.send(2600, [25000, 9])     # crosses 2 boundaries
+    h.send(2500, [1100, 7])      # timer flush {5} happened; 7 appends
+    h.send(2600, [25000, 9])     # single flush (append {7}); 9 accumulates
+    fives_before = [e for e in c.events if e.data[0] == 5]
+    h.send(4000, [26000, 1])     # clock passes 3600: timeout flush of {9}
     m.shutdown()
+    # the 3600 timeout flush emits EXPIRED {5, 7} before CURRENT {9}
     fives = [e for e in c.events if e.data[0] == 5]
-    # 5 appears as CURRENT (arrival-flush) AND as EXPIRED eventually
-    assert len(fives) >= 2
+    assert len(fives_before) == 1          # no premature expiry at 2600
+    assert len(fives) == 2
+    assert [e.data[0] for e in c.events if e.data[0] == 7] == [7, 7]
 
 
 def test_session_latency_validation():
