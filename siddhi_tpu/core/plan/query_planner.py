@@ -129,6 +129,7 @@ def _rewrite_string_casts(expr, input_def, resolver, transforms, ext_state,
                 ext_state["casts"][key] = name
                 transforms.append(stage)
                 ext_state["attrs"].extend(stage.out_attrs)
+                ext_state.setdefault("internal", set()).add(name)
             return Variable(attribute_name=name)
     return expr
 
@@ -178,8 +179,37 @@ def _rewrite_in_conditions(expr, input_def, ref_id, resolver, app_context,
         resolver.synthetic[name] = AttrType.BOOL
         transforms.append(stage)
         ext_state["attrs"].extend(stage.out_attrs)
+        ext_state.setdefault("internal", set()).add(name)
         return Variable(attribute_name=name)
     return expr
+
+
+def _selector_has_aggregator(selector) -> bool:
+    """Does any selection/having expression call an attribute aggregator?
+    (the detection ExpressionParser does via extension holders)."""
+    from siddhi_tpu.ops.aggregators import supported_aggregators
+    from siddhi_tpu.query_api.expressions import AttributeFunction, Expression
+
+    names = supported_aggregators()
+
+    def scan(expr) -> bool:
+        if not isinstance(expr, Expression):
+            return False
+        if (isinstance(expr, AttributeFunction) and not expr.namespace
+                and expr.name.lower() in names):
+            return True
+        for attr in ("left", "right", "expression"):
+            child = getattr(expr, attr, None)
+            if isinstance(child, Expression) and scan(child):
+                return True
+        if isinstance(expr, AttributeFunction):
+            return any(scan(p) for p in expr.parameters)
+        return False
+
+    exprs = [oa.expression for oa in (selector.selection_list or [])]
+    if selector.having is not None:
+        exprs.append(selector.having)
+    return any(scan(e) for e in exprs)
 
 
 def _probe_type_safe(attr_t, val_t) -> bool:
@@ -298,6 +328,18 @@ def plan_join_query(
     _oet = (query.output_stream.output_event_type
             if query.output_stream else "current")
     side_expired_needed = _oet != "current"
+    # EmptyWindowProcessor semantics (per-event [CURRENT, EXPIRED?, RESET])
+    # only matter when the selector aggregates or groups — the RESET rows
+    # exist solely to restart per-trigger aggregate state, and a RESET from
+    # a NON-triggering side would wrongly wipe it, so plain passthrough is
+    # kept for non-triggering or non-aggregating cases
+    _needs_reset = bool(query.selector.group_by_list) or _selector_has_aggregator(
+        query.selector)
+
+    def _side_triggers(key: str) -> bool:
+        return (join.trigger == EventTrigger.ALL
+                or (join.trigger == EventTrigger.LEFT and key == "left")
+                or (join.trigger == EventTrigger.RIGHT and key == "right"))
 
     def build_side(key: str, s: SingleInputStream) -> JoinSide:
         sid = s.unique_stream_id
@@ -418,7 +460,12 @@ def plan_join_query(
                     f"explicit #window on stream side '{sid}'")
             from siddhi_tpu.ops.windows import window_col_specs
 
-            window_stage = PassthroughWindowStage(window_col_specs(ext_sdef))
+            window_stage = PassthroughWindowStage(
+                window_col_specs(ext_sdef),
+                empty_window=(_needs_reset or side_expired_needed)
+                and _side_triggers(key),
+                expired_needed=side_expired_needed,
+                emit_reset=_needs_reset)
         keyer = None
         if partition_ctx is not None and sid in partition_ctx.keyers:
             keyer = partition_ctx.keyers[sid]
@@ -500,12 +547,17 @@ def plan_join_query(
         )
 
     output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
+    # every reference chunk is batch-processed by QuerySelector (isBatch()
+    # is hardwired true, ComplexEventChunk.java:267); JoinProcessor builds
+    # one chunk per trigger event, so grouped/aggregated joins collapse to
+    # the last row per (trigger event, group) — JoinTableTestCase query9.
+    # The join step stamps FLUSH_KEY with the trigger row index.
     selector_plan = plan_selector(
         selector=query.selector,
         input_attrs=[],
         resolver=resolver,
         output_event_type=output_event_type,
-        batch_mode=False,
+        batch_mode=True,
         dictionary=dictionary,
         app_context=app_context,
     )
@@ -852,6 +904,7 @@ def plan_query(
         batch_mode=batch_mode,
         dictionary=dictionary,
         app_context=app_context,
+        internal_names=cast_state.get("internal", frozenset()),
     )
     selector_plan.num_keys = app_context.initial_key_capacity
 

@@ -290,12 +290,19 @@ def plan_selector(
     batch_mode: bool,
     dictionary,
     app_context=None,
+    internal_names=frozenset(),
 ) -> SelectorPlan:
     specs: List[agg_ops.AggSpec] = []
 
     selections: List[Tuple[str, Expression]] = []
     if selector.select_all or not selector.selection_list:
         for name, _t in input_attrs:
+            if name in internal_names:
+                # synthetic planner internals (the `<cond> in Table`
+                # exists-probe column, string-cast LUT columns) never reach
+                # `select *` output — the reference's in-condition is a
+                # plain filter expression
+                continue
             selections.append((name, Variable(attribute_name=name)))
     else:
         for oa in selector.selection_list:

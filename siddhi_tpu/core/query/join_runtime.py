@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
-from siddhi_tpu.core.plan.selector_plan import GK_KEY
+from siddhi_tpu.core.plan.selector_plan import FLUSH_KEY, GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
 from siddhi_tpu.core.stream.junction import Receiver
 from siddhi_tpu.ops.expressions import (
@@ -413,6 +413,10 @@ class JoinQueryRuntime(QueryRuntime):
                 joined[GK_KEY] = pk_out
             else:
                 joined[GK_KEY] = jnp.zeros(NW, jnp.int32)
+            # one reference chunk per trigger event (JoinProcessor.execute):
+            # the selector's batch collapse keys on (trigger row, group)
+            joined[FLUSH_KEY] = jnp.repeat(
+                jnp.arange(N, dtype=jnp.int32), W + 1)
 
             if idx_overflow is not None:
                 # candidate window saturated: surfacing it beats silently

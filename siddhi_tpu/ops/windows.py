@@ -126,14 +126,45 @@ class PassthroughWindowStage(WindowStage):
     window's emission stream (``pass_expired=True``: the shared window
     already emitted typed CURRENT/EXPIRED events)."""
 
-    def __init__(self, col_specs: Dict[str, np.dtype], pass_expired: bool = False):
+    def __init__(self, col_specs: Dict[str, np.dtype], pass_expired: bool = False,
+                 empty_window: bool = False, expired_needed: bool = False,
+                 emit_reset: bool = True):
         self.col_specs = col_specs
         self.pass_expired = pass_expired
+        # empty_window: reference EmptyWindowProcessor.java:84 — every
+        # arriving event becomes [CURRENT, EXPIRED(clone, ts=now) when the
+        # output expects expireds, RESET], so per-trigger aggregates in
+        # windowless joins restart per event (JoinTableTestCase query9).
+        # emit_reset=False skips the RESET rows when the query has no
+        # aggregate state to restart (pure projection joins).
+        self.empty_window = empty_window
+        self.expired_needed = expired_needed
+        self.emit_reset = emit_reset
 
     def init_state(self, num_keys: int = 1) -> dict:
         return {"empty": jnp.zeros((1,), jnp.int32)}
 
     def apply(self, state, cols, ctx):
+        if self.empty_window:
+            keys = _data_keys(cols)
+            B = cols[VALID_KEY].shape[0]
+            now = jnp.int64(ctx["current_time"])
+            valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+            rank, _n = _insert_ranks(valid_cur)
+            parts = [({k: cols[k] for k in keys},
+                      jnp.full((B,), CURRENT, jnp.int8), valid_cur, rank * 3)]
+            if self.expired_needed:
+                exp = {k: cols[k] for k in keys}
+                exp[TS_KEY] = jnp.where(valid_cur, now, cols[TS_KEY])
+                parts.append((exp, jnp.full((B,), EXPIRED, jnp.int8),
+                              valid_cur, rank * 3 + 1))
+            if self.emit_reset:
+                reset_rows = _zero_rows(cols, B)
+                reset_rows[TS_KEY] = jnp.where(valid_cur, now, jnp.int64(0))
+                parts.append((reset_rows, jnp.full((B,), RESET, jnp.int8),
+                              valid_cur, rank * 3 + 2))
+            out, _ = _order_emit(parts)
+            return state, out
         out = {k: cols[k] for k in _data_keys(cols)}
         out[TYPE_KEY] = cols[TYPE_KEY]
         live = cols[TYPE_KEY] == CURRENT

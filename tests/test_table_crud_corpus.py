@@ -1,0 +1,445 @@
+"""Reference table CRUD corpus — scenarios ported verbatim from
+``query/table/{DeleteFrom,UpdateFrom,UpdateOrInsert,Logical}TableTestCase
+.java``. The reference's assert-free smoke tests additionally verify the
+final table contents through on-demand queries (the observable surface the
+reference checks via subsequent in-condition probes)."""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+def rows(rt, table="StockTable"):
+    return sorted(tuple(e.data) for e in rt.query(f"from {table} select *"))
+
+
+STOCK_DEFS = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream DeleteStockStream (symbol string, price float, volume long);
+    define stream UpdateStockStream (symbol string, price float, volume long);
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def _feed3(rt):
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 100])
+    h.send(["WSO2", 57.6, 100])
+
+
+# --------------------------------------------- DeleteFromTableTestCase
+
+
+def test_delete_on_unqualified_symbol_binds_to_stream():
+    """deleteFromTableTest1/test3 (:76-...): bare `symbol` in the delete
+    condition binds to the TRIGGER stream's attribute — a WSO2 trigger
+    deletes nothing; an IBM trigger makes the condition row-independent
+    true and empties the table."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK_DEFS + """
+        @info(name = 'query2')
+        from DeleteStockStream delete StockTable on symbol == 'IBM';
+    """)
+    _feed3(rt)
+    rt.get_input_handler("DeleteStockStream").send(["WSO2", 57.6, 100])
+    assert len(rows(rt)) == 3           # trigger symbol != 'IBM': no-op
+    rt.get_input_handler("DeleteStockStream").send(["IBM", 57.6, 100])
+    assert rows(rt) == []               # condition true: all rows deleted
+    m.shutdown()
+
+
+def test_delete_on_qualified_constant_condition():
+    """deleteFromTableTest2: the table-qualified form
+    `on StockTable.symbol=='IBM'` behaves identically."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK_DEFS + """
+        @info(name = 'query2')
+        from DeleteStockStream delete StockTable on StockTable.symbol == 'IBM';
+    """)
+    _feed3(rt)
+    rt.get_input_handler("DeleteStockStream").send(["WSO2", 57.6, 100])
+    assert [r[0] for r in rows(rt)] == ["WSO2", "WSO2"]
+    m.shutdown()
+
+
+def test_delete_on_stream_attribute():
+    """deleteFromTableTest4/5 shape: `on StockTable.symbol == symbol`
+    deletes the rows matching each delete-trigger event."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK_DEFS + """
+        @info(name = 'query2')
+        from DeleteStockStream delete StockTable on StockTable.symbol == symbol;
+    """)
+    _feed3(rt)
+    rt.get_input_handler("DeleteStockStream").send(["WSO2", 0.0, 0])
+    assert [r[0] for r in rows(rt)] == ["IBM"]
+    m.shutdown()
+
+
+# --------------------------------------------- UpdateFromTableTestCase
+
+
+def test_update_on_qualified_constant():
+    """updateFromTableTest1 (:46-81) with the table-qualified condition:
+    `update ... on StockTable.symbol=='IBM'` rewrites the IBM row with the
+    GOOG trigger's full values."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK_DEFS + """
+        @info(name = 'query2')
+        from UpdateStockStream update StockTable on StockTable.symbol == 'IBM';
+    """)
+    _feed3(rt)
+    rt.get_input_handler("UpdateStockStream").send(["GOOG", 10.6, 100])
+    got = rows(rt)
+    # the matched IBM row took the update event's full values
+    assert ("GOOG", 10.600000381469727, 100) in got
+    assert len(got) == 3
+    m.shutdown()
+
+
+def test_update_in_condition_sees_new_values():
+    """updateFromTableTest3 (:120-200): after `update ... on symbol==symbol`
+    with (IBM, 77.6, 200), in-condition checks see IBM only at the OLD
+    volume probe failing and the new row at 200 — the reference asserts
+    IBM@100 matches before the update and fails after."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        define stream UpdateStockStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from UpdateStockStream update StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol == StockTable.symbol and volume == StockTable.volume) in StockTable]
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query3", q)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    update.send(["IBM", 77.6, 200])
+    check.send(["IBM", 100])       # no longer matches
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("WSO2", 100), ("WSO2", 100)]
+
+
+def test_update_with_projection():
+    """updateFromTableTest4 (:203-280): `select comp as symbol, vol as
+    volume update ...` — only the projected columns change."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        define stream UpdateStockStream (comp string, vol long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from UpdateStockStream
+        select comp as symbol, vol as volume
+        update StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol == StockTable.symbol and volume == StockTable.volume) in StockTable]
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query3", q)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    update.send(["IBM", 200])
+    check.send(["IBM", 100])       # volume now 200
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("WSO2", 100), ("WSO2", 100)]
+    # the price column survived the partial update
+    assert ("IBM", 55.599998474121094, 200) in rows(rt)
+
+
+# ------------------------------------------ UpdateOrInsertTableTestCase
+
+
+def test_update_or_insert_no_match_inserts():
+    """updateOrInsertTableTest1 (:48-77): a GOOG trigger with a
+    non-matching constant condition inserts a new row."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK_DEFS + """
+        @info(name = 'query2')
+        from UpdateStockStream
+        update or insert into StockTable on StockTable.symbol == 'IBM';
+    """)
+    _feed3(rt)
+    rt.get_input_handler("UpdateStockStream").send(["GOOG", 10.6, 100])
+    got = rows(rt)
+    assert len(got) == 3            # IBM row was REPLACED by GOOG
+    assert ("GOOG", 10.600000381469727, 100) in got
+    m.shutdown()
+
+
+def test_update_or_insert_self_stream():
+    """updateOrInsertTableTest2 (:79-105): the same stream upserts keyed on
+    symbol — last write wins per symbol."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query2')
+        from StockStream
+        update or insert into StockTable on StockTable.symbol == symbol;
+    """)
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 100])
+    h.send(["WSO2", 57.6, 100])
+    h.send(["WSO2", 10.0, 100])
+    got = rows(rt)
+    assert len(got) == 2
+    assert ("WSO2", 10.0, 100) in got
+    m.shutdown()
+
+
+def test_update_or_insert_then_in_condition():
+    """updateOrInsertTableTest3 (:107-270): checks straddle an upsert —
+    IBM@100 matches before, fails after the volume moves to 200."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        define stream UpdateStockStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from UpdateStockStream
+        update or insert into StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol == StockTable.symbol and volume == StockTable.volume) in StockTable]
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query3", q)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    update.send(["IBM", 77.6, 200])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("WSO2", 100), ("WSO2", 100)]
+
+
+def test_update_or_insert_partial_projection():
+    """updateOrInsertTableTest6 (:338-...): partial `select comp as symbol,
+    0f as price, vol as volume` upserts — the IBM update rewrites volume,
+    the FB miss inserts a fresh row."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        define stream UpdateStockStream (comp string, vol long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from UpdateStockStream
+        select comp as symbol, 0f as price, vol as volume
+        update or insert into StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol == StockTable.symbol and volume == StockTable.volume) in StockTable]
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query3", q)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    update.send(["IBM", 200])
+    update.send(["FB", 300])
+    check.send(["IBM", 100])       # volume now 200: no match
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("WSO2", 100), ("WSO2", 100)]
+    got = rows(rt)
+    assert ("FB", 0.0, 300) in got
+    assert ("IBM", 0.0, 200) in got
+
+
+def test_update_or_insert_updated_row_values():
+    """updateOrInsertTableTest7 (:430-...): after the partial upsert the
+    in-condition matching on all three columns sees (IBM, 200, 0f)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define stream UpdateStockStream (comp string, vol long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from UpdateStockStream
+        select comp as symbol, 0f as price, vol as volume
+        update or insert into StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol == StockTable.symbol and volume == StockTable.volume
+                               and price == StockTable.price) in StockTable]
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query3", q)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 155.6, 100])
+    check.send(["IBM", 100, 155.6])
+    check.send(["WSO2", 100, 155.6])
+    update.send(["IBM", 200])
+    check.send(["IBM", 200, 0.0])
+    check.send(["WSO2", 100, 155.6])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100, 155.60000610351562), ("IBM", 200, 0.0)]
+
+
+# ------------------------------------------------- LogicalTableTestCase
+
+
+LOGICAL = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+    @info(name = 'query2')
+    from CheckStockStream join StockTable
+    on {cond}
+    select CheckStockStream.symbol, StockTable.volume
+    insert into OutStream;
+"""
+
+
+def _run_logical(cond, stock_rows, check_rows):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(LOGICAL.format(cond=cond))
+    q = QCollect()
+    rt.add_callback("query2", q)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    for r in stock_rows:
+        stock.send(list(r))
+    for r in check_rows:
+        check.send(list(r))
+    m.shutdown()
+    return sorted(tuple(e.data) for e in q.events), q
+
+
+STOCK3 = [("WSO2", 55.6, 100), ("IBM", 55.6, 300), ("GOOG", 55.6, 300)]
+
+
+def test_logical_stream_side_constant_conjunct():
+    """logicalTableTest1 (:56-120): `symbol match and CheckStockStream
+    .volume==200` gates on the trigger's own attribute."""
+    got, q = _run_logical(
+        "CheckStockStream.symbol == StockTable.symbol and CheckStockStream.volume == 200",
+        STOCK3, [("IBM", 200), ("WSO2", 200), ("GOOG", 100)])
+    assert got == [("IBM", 300), ("WSO2", 100)]
+    assert q.expired == []
+
+
+def test_logical_table_side_constant_conjunct():
+    """logicalTableTest2 (:123-187): `and StockTable.volume==300` filters
+    the probed rows."""
+    got, q = _run_logical(
+        "CheckStockStream.symbol == StockTable.symbol and StockTable.volume == 300",
+        STOCK3, [("IBM", 200), ("WSO2", 200), ("GOOG", 100)])
+    assert got == [("GOOG", 300), ("IBM", 300)]
+
+
+def test_logical_cross_side_equality_conjunct():
+    """logicalTableTest3 (:190-255): two cross-side equalities."""
+    got, q = _run_logical(
+        "CheckStockStream.symbol == StockTable.symbol and StockTable.volume == CheckStockStream.volume",
+        STOCK3, [("IBM", 300), ("WSO2", 100), ("GOOG", 100)])
+    assert got == [("IBM", 300), ("WSO2", 100)]
+
+
+def test_logical_relational_conjunct():
+    """logicalTableTest4 (:258-320): `StockTable.volume <=
+    CheckStockStream.volume`."""
+    got, q = _run_logical(
+        "CheckStockStream.symbol == StockTable.symbol and StockTable.volume <= CheckStockStream.volume",
+        [("WSO2", 55.6, 100), ("IBM", 55.6, 50), ("GOOG", 55.6, 300)],
+        [("IBM", 300), ("WSO2", 100), ("GOOG", 100)])
+    assert got == [("IBM", 50), ("WSO2", 100)]
+
+
+def test_logical_constant_left_operand():
+    """logicalTableTest5 (:326-...): a literal on the LEFT of the compare
+    (`55.6f == StockTable.price`) plus a relational conjunct — one trigger
+    matches two rows."""
+    got, q = _run_logical(
+        "55.6f == StockTable.price and StockTable.volume <= CheckStockStream.volume",
+        [("WSO2", 55.6, 100), ("IBM", 55.6, 50), ("GOOG", 55.6, 300)],
+        [("IBM", 150)])
+    assert got == [("IBM", 50), ("IBM", 100)]
+
+
+def test_logical_three_conjuncts():
+    """logicalTableTest6 (:393-460): three conjuncts spanning both sides."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol == StockTable.symbol
+           and StockTable.volume == CheckStockStream.volume
+           and StockTable.price <= CheckStockStream.price
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query2", q)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    for r in [("WSO2", 55.6, 100), ("IBM", 55.6, 50), ("GOOG", 55.6, 300)]:
+        stock.send(list(r))
+    check.send(["IBM", 55.6, 50])
+    check.send(["WSO2", 55.6, 100])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", 50), ("WSO2", 100)]

@@ -1,0 +1,257 @@
+"""Reference table-join corpus — scenarios ported verbatim from
+``query/table/JoinTableTestCase.java`` (feeds and expected outputs)."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+class Chunks(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.chunks = []
+
+    def receive(self, events):
+        self.chunks.append([tuple(e.data) for e in events])
+
+
+def build_q(app, query):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback(query, q)
+    return m, rt, q
+
+
+STOCKS = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string);
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def test_table_join_unconditional():
+    """testTableJoinQuery1 (:47-104): windowed stream joins every table
+    row (no on-condition)."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol2 string, price2 float, volume2 long);
+        define stream CheckStockStream (symbol1 string);
+        define table StockTable (symbol2 string, price2 float, volume2 long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from CheckStockStream#window.length(1) join StockTable
+        select symbol1, symbol2, volume2 insert into OutputStream;
+    """, "query2")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 75.6, 10])
+    check.send(["WSO2"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("WSO2", "WSO2", 100), ("WSO2", "IBM", 10)]
+    assert q.expired == []
+
+
+def test_table_join_on_equality():
+    """testTableJoinQuery2 (:106-171): on-condition narrows to the
+    matching row."""
+    m, rt, q = build_q(STOCKS + """
+        @info(name = 'query2')
+        from CheckStockStream#window.length(1) join StockTable
+        on CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol as checkSymbol, StockTable.symbol as symbol,
+               StockTable.volume as volume
+        insert into OutputStream;
+    """, "query2")
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("CheckStockStream").send(["WSO2"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("WSO2", "WSO2", 100)]
+
+
+def test_table_join_inequality_with_alias():
+    """testTableJoinQuery3 (:173-238): `join StockTable as t` with a !=
+    condition matches the other row."""
+    m, rt, q = build_q(STOCKS + """
+        @info(name = 'query2')
+        from CheckStockStream#window.length(1) join StockTable as t
+        on CheckStockStream.symbol != t.symbol
+        select CheckStockStream.symbol as checkSymbol, t.symbol as symbol,
+               t.volume as volume
+        insert into OutputStream;
+    """, "query2")
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("CheckStockStream").send(["WSO2"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("WSO2", "IBM", 10)]
+
+
+def test_table_join_windowless_stream():
+    """testTableJoinQuery5 (:340-397): a bare (window-less) stream side
+    joins the full table per arrival."""
+    m, rt, q = build_q(STOCKS + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        select CheckStockStream.symbol as checkSymbol, StockTable.symbol as symbol,
+               StockTable.volume as volume
+        insert into OutputStream;
+    """, "query2")
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("CheckStockStream").send(["WSO2"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("WSO2", "WSO2", 100), ("WSO2", "IBM", 10)]
+
+
+def test_table_join_recursive_route():
+    """testTableJoinQuery6 (:399-394+): recursive routing — a request A→D
+    walks the TimeTable hop by hop through a cyclic stream graph and the
+    total elapsed time (25+10+60) reaches ResultStream."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream RequestStream (start string, end string);
+        define stream TimeTableStream (start string, end string, elapsedTime int, startTime string);
+        define stream ResultStream (totalElapsedTime int);
+        define table TimeTable (start string, end string, elapsedTime int, startTime string);
+        from TimeTableStream select * insert into TimeTable;
+        from RequestStream join TimeTable
+        on TimeTable.start == RequestStream.start
+        select TimeTable.start as start, TimeTable.end as end,
+               TimeTable.elapsedTime as elapsedTime, RequestStream.end as destination
+        insert into intermediateResultStream;
+        @info(name = 'query1')
+        from intermediateResultStream[end == destination]
+        select intermediateResultStream.elapsedTime as totalElapsedTime
+        insert into ResultStream;
+        from intermediateResultStream[end != destination]
+        insert into intermediateResultStream2;
+        from intermediateResultStream2 join TimeTable
+        on TimeTable.start == intermediateResultStream2.end
+        select TimeTable.start as start, TimeTable.end as end,
+               (intermediateResultStream2.elapsedTime + TimeTable.elapsedTime) as elapsedTime,
+               destination
+        insert into intermediateResultStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    tt = rt.get_input_handler("TimeTableStream")
+    req = rt.get_input_handler("RequestStream")
+    tt.send(["A", "B", 25, "1.27PM"])
+    tt.send(["B", "C", 10, "1.52PM"])
+    tt.send(["C", "D", 60, "2.52PM"])
+    req.send(["A", "D"])
+    m.shutdown()
+    assert [e.data[0] for e in q.events] == [95]
+
+
+def test_table_join_unqualified_attribute_condition():
+    """testTableJoinQuery7 (:470-530): bare attribute names in the
+    on-condition resolve across sides (symbol1 == symbol2)."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol2 string, price2 float, volume2 long);
+        define stream CheckStockStream (symbol1 string);
+        define table StockTable (symbol2 string, price2 float, volume2 long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from CheckStockStream#window.length(1) join StockTable
+        on symbol1 == symbol2
+        select symbol1, symbol2, volume2 insert into OutputStream;
+    """, "query2")
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("CheckStockStream").send(["WSO2"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("WSO2", "WSO2", 100)]
+
+
+def test_table_join_compound_condition():
+    """testTableJoinQuery8 (:532-596): and-of-comparisons over string and
+    long attributes (a.volume1 > b.volume1)."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol1 string, price1 string, volume1 long);
+        define stream CheckStockStream (symbol1 string, price1 string, volume1 long);
+        define table StockTable (symbol1 string, price1 string, volume1 long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from CheckStockStream as a join StockTable as b
+        on a.symbol1 == b.symbol1 and a.price1 == b.price1 and a.volume1 > b.volume1
+        select a.symbol1 insert into OutputStream;
+    """, "query2")
+    rt.get_input_handler("StockStream").send(["WSO2", "55.6f", 100])
+    rt.get_input_handler("StockStream").send(["IBM", "75.6f", 10])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", "55.6f", 200])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("WSO2",)]
+
+
+def test_table_join_group_by_aggregate():
+    """testTableJoinQuery9 (:598-670): group-by sum over the table side —
+    each 2-event trigger chunk emits 4 rows (2 triggers × 2 groups) with
+    running totals 120.0 / 4.0 repeated."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol1 string, price1 float, volume1 long);
+        define stream CheckStockStream (symbol1 string, price1 float, volume1 long);
+        define table StockTable (symbol1 string, price1 float, volume1 long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from CheckStockStream as a join StockTable as b
+        select b.symbol1, sum(b.price1) as total
+        group by b.symbol1
+        insert into OutputStream;
+    """)
+    c = Chunks()
+    rt.add_callback("OutputStream", c)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["IBM", 50.0, 100])
+    stock.send(["IBM", 70.0, 10])
+    stock.send(["WSO2", 1.0, 10])
+    stock.send(["WSO2", 1.0, 10])
+    stock.send(["WSO2", 2.0, 10])
+    import numpy as np
+    check.send_columns({"symbol1": np.array(["Foo", "Foo"]),
+                        "price1": np.array([55.6, 55.6], np.float32),
+                        "volume1": np.array([200, 200], np.int64)})
+    check.send_columns({"symbol1": np.array(["Foo", "Foo"]),
+                        "price1": np.array([55.6, 55.6], np.float32),
+                        "volume1": np.array([200, 200], np.int64)})
+    m.shutdown()
+    assert len(c.chunks) == 2
+    for chunk in c.chunks:
+        assert [row[1] for row in chunk] == [120.0, 4.0, 120.0, 4.0]
+
+
+def test_table_join_filtered_trigger():
+    """testTableJoinQuery10 (:672-735): a filter on the trigger side gates
+    the join."""
+    m, rt, q = build_q(STOCKS + """
+        @info(name = 'query2')
+        from CheckStockStream[symbol == 'WSO2'] join StockTable
+        select CheckStockStream.symbol as checkSymbol, StockTable.symbol as symbol,
+               StockTable.volume as volume
+        insert into OutputStream;
+    """, "query2")
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("CheckStockStream").send(["IBM"])   # filtered out
+    rt.get_input_handler("CheckStockStream").send(["WSO2"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("WSO2", "WSO2", 100), ("WSO2", "IBM", 10)]
