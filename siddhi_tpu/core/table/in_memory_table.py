@@ -334,6 +334,42 @@ class InMemoryTable:
                     mk = jnp.zeros(C, bool)
                 new_cols[col_name + "?"] = jnp.where(
                     hit, mk, new_cols[col_name + "?"])
+            if self.primary_key and any(
+                    col in self.primary_key for col, _f, _t in assignments):
+                # an update that would move a row onto ANOTHER row's primary
+                # key is rejected per row (reference IndexEventHolder primary
+                # key violation — the event is dropped, the row unchanged)
+                live = np.asarray(self.state["valid"], bool)
+                hit_h = np.asarray(hit, bool) & live
+                old_k = {a: np.asarray(self.state["cols"][a]) for a in self.primary_key}
+                new_k = {a: np.asarray(new_cols[a]) for a in self.primary_key}
+                if self._pk_dirty:
+                    self._rebuild_pk_map()
+                keys = dict(self._pk_map)
+                reject = np.zeros(C, bool)
+                # apply in EVENT order (the reference walks the chunk
+                # sequentially): rows ordered by their winning event index
+                win_h = np.asarray(win)
+                hits = sorted((int(i) for i in np.nonzero(hit_h)[0]),
+                              key=lambda i: (int(win_h[i]), i))
+                for i in hits:
+                    ok_key = self._pk_of_host(old_k, i)
+                    nk = self._pk_of_host(new_k, i)
+                    if nk == ok_key:
+                        continue
+                    if nk in keys:
+                        reject[i] = True
+                    else:
+                        del keys[ok_key]
+                        keys[nk] = i
+                if reject.any():
+                    rj = jnp.asarray(reject)
+                    for col_name, _f, _t in assignments:
+                        new_cols[col_name] = jnp.where(
+                            rj, self.state["cols"][col_name], new_cols[col_name])
+                        new_cols[col_name + "?"] = jnp.where(
+                            rj, self.state["cols"][col_name + "?"],
+                            new_cols[col_name + "?"])
             self.state = {"cols": new_cols, "valid": self.state["valid"]}
             self._pk_dirty = True
             self._idx_dirty = True

@@ -1,0 +1,243 @@
+"""Reference primary-key table corpus — scenarios ported verbatim from
+``query/table/PrimaryKeyTableTestCase.java``: @PrimaryKey uniqueness on
+insert/update/upsert plus indexed and non-indexed join probes."""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+def build_q(app, query="query2"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback(query, q)
+    return m, rt, q
+
+
+PK_SYMBOL = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define stream UpdateStockStream (symbol string, price float, volume long);
+    @PrimaryKey('symbol')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+PK_VOLUME = PK_SYMBOL.replace("@PrimaryKey('symbol')", "@PrimaryKey('volume')")
+
+
+def test_pk_duplicate_insert_keeps_first():
+    """primaryKeyTableTest1 (:57-120): a second insert with an existing
+    primary key is rejected — the IBM probe still sees volume 100."""
+    m, rt, q = build_q(PK_SYMBOL + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    stock.send(["IBM", 56.6, 200])     # duplicate PK: dropped
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 100), ("WSO2", 100)]
+
+
+def test_pk_inequality_join():
+    """primaryKeyTableTest2 (:123-185): != probe over a PK table matches
+    every other row."""
+    m, rt, q = build_q(PK_SYMBOL + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol != StockTable.symbol
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["GOOG", 100])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("GOOG", "IBM", 100), ("GOOG", "WSO2", 100)]
+
+
+def test_pk_numeric_key_range_join():
+    """primaryKeyTableTest6 (:409-...): numeric @PrimaryKey('volume') with
+    a > probe."""
+    m, rt, q = build_q(PK_VOLUME + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume > CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["GOOG", 50.6, 50])
+    stock.send(["ABC", 5.6, 70])
+    rt.get_input_handler("CheckStockStream").send(["IBM", 50])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", "ABC", 70), ("IBM", "WSO2", 200)]
+
+
+def test_pk_upsert_on_key_then_range_join():
+    """primaryKeyTableTest8 (:538-610): `update or insert on volume ==
+    StockTable.volume` — the WSO2 row replaces FOO at volume 200."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from StockStream
+        update or insert into StockTable on volume == StockTable.volume;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume >= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["FOO", 50.6, 200])
+    stock.send(["WSO2", 55.6, 200])    # upsert replaces FOO
+    stock.send(["GOOG", 50.6, 50])
+    stock.send(["ABC", 5.6, 70])
+    rt.get_input_handler("CheckStockStream").send(["IBM", 70])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", "ABC", 70), ("IBM", "WSO2", 200)]
+
+
+def test_pk_violating_update_is_rejected():
+    """primaryKeyTableTest10 (:688-762): an update whose new symbol would
+    collide with an existing primary key is dropped — the table is
+    unchanged afterwards."""
+    m, rt, q = build_q(PK_SYMBOL + """
+        @info(name = 'query2')
+        from UpdateStockStream update StockTable on StockTable.symbol != symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol != StockTable.symbol
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    update.send(["IBM", 77.6, 200])    # would rewrite WSO2's key to IBM
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("WSO2", 100), ("IBM", 100), ("IBM", 100)]
+
+
+def test_pk_delete_on_key():
+    """primaryKeyTableTest15 (:1076-1152): delete on the primary key, then
+    an unconditional join sees only the surviving row."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        define stream DeleteStockStream (symbol string, price float, volume long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from DeleteStockStream delete StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    delete = rt.get_input_handler("DeleteStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["WSO2", 100])
+    delete.send(["IBM", 77.6, 200])
+    check.send(["FOO", 100])
+    m.shutdown()
+    got = [tuple(e.data) for e in q.events]
+    assert sorted(got[:2]) == [("IBM", 100), ("WSO2", 100)]
+    assert got[2:] == [("WSO2", 100)]
+
+
+def test_pk_in_condition_probe():
+    """primaryKeyTableTest21 (:1544-1605): `(symbol==StockTable.symbol) in
+    StockTable` — only the WSO2 probe passes."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from CheckStockStream[(symbol == StockTable.symbol) in StockTable]
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["BAR", 55.6, 150])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["FOO", 100])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("WSO2", 100)]
+
+
+def test_pk_left_outer_join_upsert():
+    """primaryKeyTableTest27 (:1930-...): a left-outer self-enrichment
+    upsert — misses insert with price 0, hits keep the joined price; the
+    three-column in-condition verifies both rows."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define stream UpdateStockStream (comp string, vol long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from UpdateStockStream left outer join StockTable
+        on UpdateStockStream.comp == StockTable.symbol
+        select comp as symbol, ifThenElse(price is null, 0f, price) as price, vol as volume
+        update or insert into StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol == StockTable.symbol and volume == StockTable.volume
+                               and price == StockTable.price) in StockTable]
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    check.send(["IBM", 100, 155.6])    # no match
+    check.send(["WSO2", 100, 155.6])   # wrong price: no match
+    update.send(["IBM", 200])          # miss -> insert (IBM, 0f, 200)
+    update.send(["WSO2", 300])         # hit  -> update (WSO2, 55.6, 300)
+    check.send(["IBM", 200, 0.0])
+    check.send(["WSO2", 300, 55.6])
+    m.shutdown()
+    assert len(q.events) == 2
+    assert [e.data[0] for e in q.events] == ["IBM", "WSO2"]
