@@ -19,3 +19,9 @@ class SiddhiParserException(Exception):
 
 class SiddhiAppValidationException(Exception):
     pass
+
+
+class DuplicateDefinitionException(SiddhiAppValidationException):
+    """Conflicting (re)definition of a stream/table/window id — same-id
+    redefinitions are legal only when attribute lists are identical
+    (reference ``AbstractDefinition.checkEquivalency``)."""

@@ -249,12 +249,12 @@ class Parser:
             self.next()
             d = StreamDefinition(id=self.name(), annotations=element_annotations)
             d.attributes = self.parse_attribute_list()
-            app.stream_definitions[d.id] = d
+            app.define_stream(d)
         elif t.is_kw("table"):
             self.next()
             d = TableDefinition(id=self.name(), annotations=element_annotations)
             d.attributes = self.parse_attribute_list()
-            app.table_definitions[d.id] = d
+            app.define_table(d)
         elif t.is_kw("window"):
             self.next()
             d = WindowDefinition(id=self.name(), annotations=element_annotations)
@@ -264,7 +264,7 @@ class Parser:
                 ev = self.expect_kw("current", "expired", "all").text.lower()
                 self.expect_kw("events")
                 d.output_event_type = ev
-            app.window_definitions[d.id] = d
+            app.define_window(d)
         elif t.is_kw("trigger"):
             self.next()
             d = TriggerDefinition(id=self.name(), annotations=element_annotations)
@@ -279,7 +279,7 @@ class Parser:
                     d.cron = s
             else:
                 self.error("expected 'every <time>' or a quoted cron/'start'")
-            app.trigger_definitions[d.id] = d
+            app.define_trigger(d)
         elif t.is_kw("function"):
             self.next()
             d = FunctionDefinition(id=self.name())
@@ -305,7 +305,7 @@ class Parser:
                 d.aggregate_attribute = self.parse_variable()
             self.expect_kw("every")
             d.time_period = self.parse_time_period()
-            app.aggregation_definitions[d.id] = d
+            app.define_aggregation(d)
         else:
             self.error("expected stream/table/window/trigger/function/aggregation")
         self.accept_op(";")

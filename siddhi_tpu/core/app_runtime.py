@@ -132,6 +132,12 @@ class SiddhiAppRuntime:
                     _parse_playback_time(inc_s, "increment"))
         if siddhi_app.app_annotation("enforceOrder") is not None:
             self.app_context.enforce_order = True
+        if siddhi_app.app_annotation("async") is not None:
+            # reference SiddhiAppParser.java:105-111: @Async is a STREAM
+            # annotation; the app-level form fails creation
+            raise SiddhiAppValidationException(
+                "@Async not supported in SiddhiApp level, instead use "
+                "@Async with streams")
         prec = siddhi_app.app_annotation("precision")
         if prec is not None:
             v = (prec.element() or "").lower()
@@ -804,6 +810,7 @@ class SiddhiAppRuntime:
         self._tracing = False
 
     def shutdown(self):
+        self.app_context.stopped = True
         self.app_context.timestamp_generator.stop_heartbeat()
         for qr in self.query_runtimes.values():
             if getattr(qr, "_deferred", None):
@@ -913,6 +920,11 @@ class SiddhiAppRuntime:
     @property
     def query_names(self) -> List[str]:
         return list(self.query_runtimes)
+
+    def get_queries(self) -> List:
+        """Query runtimes in declaration order (reference
+        ``SiddhiAppRuntime.getQueries``)."""
+        return list(self.query_runtimes.values())
 
 
 def _element_state_bytes(el) -> int:

@@ -62,6 +62,17 @@ class SiddhiManager:
 
     createSiddhiAppRuntime = create_siddhi_app_runtime
 
+    def validate_siddhi_app(self, app: Union[str, SiddhiApp]) -> None:
+        """Parse and fully build the app, then discard it — creation-time
+        errors surface, nothing is registered or started (reference
+        ``SiddhiManager.validateSiddhiApp``)."""
+        if isinstance(app, str):
+            app = SiddhiCompiler.parse(SiddhiCompiler.update_variables(app))
+        runtime = SiddhiAppRuntime(app, self.siddhi_context)
+        runtime.shutdown()
+
+    validateSiddhiApp = validate_siddhi_app
+
     def create_sandbox_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
         """Create a runtime with external transports/stores stripped for

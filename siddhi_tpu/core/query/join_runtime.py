@@ -189,6 +189,10 @@ class JoinSideProxy(Receiver):
 
 
 class JoinQueryRuntime(QueryRuntime):
+    def is_stateful(self) -> bool:
+        # window/NFA state is always snapshot-relevant
+        return True
+
     def __init__(self, name, app_context, left: JoinSide, right: JoinSide,
                  on_cond: Optional[Callable], selector_plan, dictionary,
                  partition_ctx=None, group_keyer=None):

@@ -49,6 +49,10 @@ class StreamProxy(Receiver):
 
 
 class NFAQueryRuntime(QueryRuntime):
+    def is_stateful(self) -> bool:
+        # window/NFA state is always snapshot-relevant
+        return True
+
     def __init__(
         self,
         name: str,

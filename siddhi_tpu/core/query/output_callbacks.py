@@ -39,6 +39,19 @@ class InsertIntoTableCallback:
                 f"{len(output_attrs)} attributes, table has "
                 f"{len(table.definition.attributes)}"
             )
+        for (name, t), attr in zip(output_attrs, table.definition.attributes):
+            if t != attr.type:
+                # the inferred output definition must be equivalent to the
+                # table's (reference DuplicateDefinitionException when the
+                # insert-into target is a defined table with other types)
+                from siddhi_tpu.compiler.errors import (
+                    DuplicateDefinitionException,
+                )
+
+                raise DuplicateDefinitionException(
+                    f"insert into table '{table.definition.id}': output "
+                    f"attribute '{name}' is {t.value} but the table column "
+                    f"'{attr.name}' is {attr.type.value}")
         self.table = table
         self.dictionary = dictionary
 

@@ -176,6 +176,21 @@ class QueryRuntime(Receiver):
     def output_attrs(self) -> List[Tuple[str, AttrType]]:
         return self.selector_plan.output_attrs
 
+    def is_stateful(self) -> bool:
+        """Does this query hold state a snapshot must capture? — a window,
+        an aggregator/group-by, or a non-passthrough rate limiter
+        (reference ``QueryRuntimeImpl.isStateful``, StateTestCase)."""
+        from siddhi_tpu.core.query.ratelimit import PassThroughRateLimiter
+
+        if (self.window_stage is not None
+                or getattr(self, "host_window", None) is not None):
+            return True
+        if (self.selector_plan.contains_aggregator
+                or self.selector_plan.group_by):
+            return True
+        rl = self.rate_limiter
+        return rl is not None and not isinstance(rl, PassThroughRateLimiter)
+
     def _init_state(self) -> dict:
         state = {"sel": self.selector_plan.init_state()}
         if self.window_stage is not None:

@@ -40,6 +40,12 @@ class InputHandler:
 
     def send(self, *args):
         """send(data_list) | send(ts, data_list) | send(Event) | send([Event,...])"""
+        if getattr(self.app_context, "stopped", False):
+            # reference: sends after shutdown fail (the disruptor is gone,
+            # StartStopTestCase test1 expects an exception)
+            raise RuntimeError(
+                f"SiddhiApp '{self.app_context.name}' has been shut down — "
+                f"cannot send to '{self.stream_id}'")
         if self._ensure_started is not None:
             self._ensure_started()
         tsg = self.app_context.timestamp_generator

@@ -317,59 +317,67 @@ class InMemoryTable:
                     ev[EV_PREFIX + k] = jnp.asarray(v)[:, None]
             for k, v in self.state["cols"].items():
                 ev[TBL_PREFIX + k] = v[None, :]
-            # winning (last matching) event per table row; B when none
-            ridx = jnp.arange(B, dtype=jnp.int32)
-            win = jnp.max(jnp.where(m, ridx[:, None] + 1, 0), axis=0) - 1  # [C]
-            hit = win >= 0
-            wsafe = jnp.clip(win, 0, B - 1)
-            new_cols = dict(self.state["cols"])
+            mats = []     # (col_name, values [B,C], mask [B,C] or None)
             for col_name, fn, _t in assignments:
                 v, mask = fn(ev, ctx)
-                v = jnp.broadcast_to(jnp.asarray(v), (B, C))
+                mats.append((col_name,
+                             jnp.broadcast_to(jnp.asarray(v), (B, C)),
+                             None if mask is None else
+                             jnp.broadcast_to(jnp.asarray(mask), (B, C))))
+
+            pk_touched = self.primary_key and any(
+                col in self.primary_key for col, _f, _t in assignments)
+            if not pk_touched:
+                # winning (last matching) event per table row; B when none
+                ridx = jnp.arange(B, dtype=jnp.int32)
+                win = jnp.max(jnp.where(m, ridx[:, None] + 1, 0), axis=0) - 1
+                hit = win >= 0
+            else:
+                # primary-key assignments follow the reference's SEQUENTIAL
+                # chunk walk: events apply in order, and an event that would
+                # move a row onto another row's CURRENT key is dropped
+                # (IndexEventHolder primary-key violation) — earlier
+                # accepted events on the same row stand
+                live = np.asarray(self.state["valid"], bool)
+                m_h = np.asarray(m, bool) & live[None, :]
+                pk_vals = {col: np.asarray(v)
+                           for col, v, _mk in mats if col in self.primary_key}
+                if self._pk_dirty:
+                    self._rebuild_pk_map()
+                keys = dict(self._pk_map)
+                old_k = {a: np.asarray(self.state["cols"][a])
+                         for a in self.primary_key}
+                cur_key = {int(c): self._pk_of_host(old_k, int(c))
+                           for c in np.nonzero(live)[0]}
+                win2 = np.full(C, -1, np.int64)
+                for b in range(B):
+                    for c in np.nonzero(m_h[b])[0]:
+                        c = int(c)
+                        nk = tuple(
+                            pk_vals[a][b, c].item() if a in pk_vals
+                            else cur_key[c][i]
+                            for i, a in enumerate(self.primary_key))
+                        if nk != cur_key[c] and keys.get(nk, c) != c:
+                            continue               # violation: event dropped
+                        if nk != cur_key[c]:
+                            del keys[cur_key[c]]
+                            keys[nk] = c
+                            cur_key[c] = nk
+                        win2[c] = b
+                win = jnp.asarray(win2, jnp.int32)
+                hit = win >= 0
+
+            wsafe = jnp.clip(win, 0, B - 1)
+            new_cols = dict(self.state["cols"])
+            for col_name, v, mask in mats:
                 val = v[wsafe, jnp.arange(C)]
                 new_cols[col_name] = jnp.where(hit, val, new_cols[col_name])
                 if mask is not None:
-                    mk = jnp.broadcast_to(jnp.asarray(mask), (B, C))[wsafe, jnp.arange(C)]
+                    mk = mask[wsafe, jnp.arange(C)]
                 else:
                     mk = jnp.zeros(C, bool)
                 new_cols[col_name + "?"] = jnp.where(
                     hit, mk, new_cols[col_name + "?"])
-            if self.primary_key and any(
-                    col in self.primary_key for col, _f, _t in assignments):
-                # an update that would move a row onto ANOTHER row's primary
-                # key is rejected per row (reference IndexEventHolder primary
-                # key violation — the event is dropped, the row unchanged)
-                live = np.asarray(self.state["valid"], bool)
-                hit_h = np.asarray(hit, bool) & live
-                old_k = {a: np.asarray(self.state["cols"][a]) for a in self.primary_key}
-                new_k = {a: np.asarray(new_cols[a]) for a in self.primary_key}
-                if self._pk_dirty:
-                    self._rebuild_pk_map()
-                keys = dict(self._pk_map)
-                reject = np.zeros(C, bool)
-                # apply in EVENT order (the reference walks the chunk
-                # sequentially): rows ordered by their winning event index
-                win_h = np.asarray(win)
-                hits = sorted((int(i) for i in np.nonzero(hit_h)[0]),
-                              key=lambda i: (int(win_h[i]), i))
-                for i in hits:
-                    ok_key = self._pk_of_host(old_k, i)
-                    nk = self._pk_of_host(new_k, i)
-                    if nk == ok_key:
-                        continue
-                    if nk in keys:
-                        reject[i] = True
-                    else:
-                        del keys[ok_key]
-                        keys[nk] = i
-                if reject.any():
-                    rj = jnp.asarray(reject)
-                    for col_name, _f, _t in assignments:
-                        new_cols[col_name] = jnp.where(
-                            rj, self.state["cols"][col_name], new_cols[col_name])
-                        new_cols[col_name + "?"] = jnp.where(
-                            rj, self.state["cols"][col_name + "?"],
-                            new_cols[col_name + "?"])
             self.state = {"cols": new_cols, "valid": self.state["valid"]}
             self._pk_dirty = True
             self._idx_dirty = True

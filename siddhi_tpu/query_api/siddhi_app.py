@@ -57,16 +57,57 @@ class SiddhiApp:
     def partitions(self) -> List[Partition]:
         return [e for e in self.execution_elements if isinstance(e, Partition)]
 
+    def _check_duplicate(self, d, kind: str):
+        """Same-id redefinitions must be attribute-identical; any same-id
+        definition of a DIFFERENT kind conflicts (reference
+        ``AbstractDefinition.checkEquivalency`` via SiddhiAppRuntimeBuilder's
+        DuplicateDefinitionException paths)."""
+        from siddhi_tpu.compiler.errors import DuplicateDefinitionException
+
+        pools = {"stream": self.stream_definitions,
+                 "table": self.table_definitions,
+                 "window": self.window_definitions,
+                 "trigger": self.trigger_definitions,
+                 "aggregation": self.aggregation_definitions}
+        for k, pool in pools.items():
+            prev = pool.get(d.id)
+            if prev is None:
+                continue
+            if k != kind:
+                raise DuplicateDefinitionException(
+                    f"'{d.id}' is already defined as a {k}")
+            prev_attrs = [(a.name, a.type)
+                          for a in getattr(prev, "attributes", [])]
+            new_attrs = [(a.name, a.type)
+                         for a in getattr(d, "attributes", [])]
+            if prev_attrs != new_attrs:
+                raise DuplicateDefinitionException(
+                    f"{kind} '{d.id}' is already defined with a different "
+                    f"attribute list")
+
     def define_stream(self, d: StreamDefinition) -> "SiddhiApp":
+        self._check_duplicate(d, "stream")
         self.stream_definitions[d.id] = d
         return self
 
     def define_table(self, d: TableDefinition) -> "SiddhiApp":
+        self._check_duplicate(d, "table")
         self.table_definitions[d.id] = d
         return self
 
     def define_window(self, d: WindowDefinition) -> "SiddhiApp":
+        self._check_duplicate(d, "window")
         self.window_definitions[d.id] = d
+        return self
+
+    def define_trigger(self, d: TriggerDefinition) -> "SiddhiApp":
+        self._check_duplicate(d, "trigger")
+        self.trigger_definitions[d.id] = d
+        return self
+
+    def define_aggregation(self, d: AggregationDefinition) -> "SiddhiApp":
+        self._check_duplicate(d, "aggregation")
+        self.aggregation_definitions[d.id] = d
         return self
 
     def add_query(self, q: Query) -> "SiddhiApp":

@@ -1,0 +1,226 @@
+"""Reference management corpus — scenarios ported from
+``managment/{Validate,StartStop,State,Async,Sandbox}TestCase.java``."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.compiler.errors import (SiddhiParserException,
+                                        SiddhiAppValidationException)
+from siddhi_tpu.ops.expressions import CompileError
+
+CREATION_ERRORS = (CompileError, SiddhiParserException,
+                   SiddhiAppValidationException)
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+# ------------------------------------------------------- ValidateTestCase
+
+
+def test_validate_accepts_valid_app():
+    """validateTest1 (:45-63): a valid app validates without being
+    registered or started."""
+    m = SiddhiManager()
+    m.validate_siddhi_app("""
+        @app:name('validateTest')
+        define stream cseEventStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from cseEventStream[symbol is null]
+        select symbol, price insert into outputStream;
+    """)
+    assert m.get_siddhi_app_runtime("validateTest") is None
+    m.shutdown()
+
+
+def test_validate_rejects_unknown_stream():
+    """validateTest2 (:64-84): a query over an undefined stream fails
+    validation."""
+    m = SiddhiManager()
+    with pytest.raises(CREATION_ERRORS):
+        m.validate_siddhi_app("""
+            @app:name('validateTest')
+            define stream cseEventStream (symbol string, price float, volume long);
+            @info(name = 'query1')
+            from cseEventStreamA[symbol is null]
+            select symbol, price insert into outputStream;
+        """)
+    m.shutdown()
+
+
+def test_validate_substitutes_variables():
+    """validateTest3 (:85-107): `${var}` in definitions resolves from the
+    environment before validation."""
+    import os
+
+    os.environ["stream"] = "cseEventStream"
+    try:
+        SiddhiManager().validate_siddhi_app("""
+            @app:name('validateTest')
+            define stream ${stream} (symbol string, price float, volume long);
+            @info(name = 'query1')
+            from cseEventStream select symbol, price insert into outputStream;
+        """)
+    finally:
+        del os.environ["stream"]
+
+
+def test_validate_unresolved_variable_fails():
+    """validateTest4 (:108-129): an unresolvable `${stream}` placeholder
+    fails parsing."""
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().validate_siddhi_app("""
+            @app:name('validateTest')
+            define stream ${stream} (symbol string, price float, volume long);
+            @info(name = 'query1')
+            from cseEventStream select symbol, price insert into outputStream;
+        """)
+
+
+# ------------------------------------------------------ StartStopTestCase
+
+
+STARTSTOP_APP = """
+    define stream cseEventStream (symbol string, price float, volume int);
+    define stream cseEventStream2 (symbol string, price float, volume int);
+    @info(name = 'query1')
+    from cseEventStream select 1 as eventFrom insert into outputStream;
+    @info(name = 'query2')
+    from cseEventStream2 select 2 as eventFrom insert into outputStream;
+"""
+
+
+def test_send_after_shutdown_raises():
+    """startStopTest1 (:46-75): sending through a handler after shutdown
+    raises."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STARTSTOP_APP)
+    h = rt.get_input_handler("cseEventStream2")
+    rt.start()
+    m.shutdown()
+    with pytest.raises(Exception):
+        h.send(["WSO2", 55.6, 100])
+
+
+def test_two_queries_share_output_stream():
+    """startStopTest2 (:77-...): both queries publish into one output
+    stream; each source stream's constant marker arrives."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STARTSTOP_APP)
+    c = Collector()
+    rt.add_callback("outputStream", c)
+    rt.get_input_handler("cseEventStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("cseEventStream2").send(["IBM", 75.6, 100])
+    m.shutdown()
+    assert sorted(e.data[0] for e in c.events) == [1, 2]
+
+
+# ---------------------------------------------------------- StateTestCase
+
+
+def test_query_statefulness_flags():
+    """stateTest (:45-100): a plain projection is stateless; windowed,
+    aggregating, and rate-limited queries are stateful."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream select * insert all events into outputStream;
+        @info(name = 'query2')
+        from cseEventStream#window.timeBatch(1 sec) select * insert all events into outputStream;
+        @info(name = 'query3')
+        from cseEventStream select sum(price) as total insert all events into outputStream1;
+        @info(name = 'query4')
+        from cseEventStream select * output every 5 min insert all events into outputStream;
+    """)
+    flags = [q.is_stateful() for q in rt.get_queries()]
+    assert flags == [False, True, True, True]
+    m.shutdown()
+
+
+# ---------------------------------------------------------- AsyncTestCase
+
+
+def test_app_level_async_rejected():
+    """asyncTest1/2 (:48-95): @app:async (with or without parameters) is
+    invalid — @Async belongs on streams."""
+    for ann in ("@app:async", "@app:async(buffer.size='2')"):
+        with pytest.raises(CREATION_ERRORS):
+            SiddhiManager().create_siddhi_app_runtime(f"""
+                {ann}
+                define stream cseEventStream (symbol string, price float, volume int);
+                @info(name = 'query1')
+                from cseEventStream[70 > price] select * insert into outputStream;
+            """)
+
+
+def test_stream_level_async_delivers():
+    """asyncTest3 (:97-160): @async buffering on a stream still delivers
+    every event to a slow consumer."""
+    import time
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @async(buffer.size='2')
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream[70 > price] select * insert into outputStream;
+    """)
+    c = Collector()
+    rt.add_callback("outputStream", c)
+    h = rt.get_input_handler("cseEventStream")
+    for row in [["WSO2", 55.6, 100], ["IBM", 9.6, 100], ["FB", 7.6, 100],
+                ["GOOG", 5.6, 100], ["WSO2", 15.6, 100]]:
+        h.send(row)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(c.events) < 5:
+        time.sleep(0.05)
+    m.shutdown()
+    assert len(c.events) == 5
+
+
+# -------------------------------------------------------- SandboxTestCase
+
+
+def test_sandbox_strips_external_transports():
+    """sandboxTest1 (:54-120): createSandboxSiddhiAppRuntime keeps only the
+    in-memory transports and drops @store, so the app runs fully
+    in-process."""
+    m = SiddhiManager()
+    rt = m.create_sandbox_siddhi_app_runtime("""
+        @source(type='foo')
+        @source(type='foo1')
+        @source(type='inMemory', topic='myTopic')
+        define stream StockStream (symbol string, price float, vol long);
+        @sink(type='foo1')
+        @sink(type='inMemory', topic='myTopic1')
+        define stream DeleteStockStream (symbol string, price float, vol long);
+        @store(type='rdbms')
+        define table StockTable (symbol string, price float, volume long);
+        define stream CountStockStream (symbol string);
+        @info(name = 'query1')
+        from StockStream select symbol, price, vol as volume insert into StockTable;
+        @info(name = 'query2')
+        from DeleteStockStream[vol >= 100]
+        delete StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CountStockStream join StockTable
+        on CountStockStream.symbol == StockTable.symbol
+        select CountStockStream.symbol as symbol
+        insert into CountResultsStream;
+    """)
+    assert len(rt.source_runtimes) == 1
+    assert len(rt.sink_runtimes) == 1
+    # the rdbms @store was stripped: plain in-memory table CRUD works
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 100])
+    rt.get_input_handler("DeleteStockStream").send(["IBM", 75.6, 100])
+    rows = rt.query("from StockTable select *")
+    assert [e.data[0] for e in rows] == ["WSO2"]
+    m.shutdown()

@@ -1,0 +1,180 @@
+"""Reference table-definition corpus — scenarios ported from
+``query/table/DefineTableTestCase.java`` and
+``query/table/InsertIntoTableTestCase.java``: duplicate/conflicting
+definitions and insert-into schema equivalence."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.compiler.errors import (DuplicateDefinitionException,
+                                        SiddhiAppValidationException,
+                                        SiddhiParserException)
+from siddhi_tpu.ops.expressions import CompileError
+
+CREATION_ERRORS = (CompileError, SiddhiParserException,
+                   SiddhiAppValidationException)
+
+
+def build(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    return m, rt
+
+
+def test_define_single_table():
+    """testQuery1/2 (:45-68): plain table definitions compile."""
+    m, _rt = build("define table TestTable(symbol string, price int, volume float);")
+    m.shutdown()
+
+
+def test_redefine_table_different_attribute_name():
+    """testQuery3 (:70-79): same id, different attribute name — duplicate
+    definition error."""
+    with pytest.raises(DuplicateDefinitionException):
+        build("""define table TestTable(symbol string, price int, volume float);
+                 define table TestTable(symbols string, price int, volume float);""")
+
+
+def test_redefine_table_different_arity():
+    """testQuery4 (:81-90): same id, fewer attributes — duplicate
+    definition error."""
+    with pytest.raises(DuplicateDefinitionException):
+        build("""define table TestTable(symbol string, volume float);
+                 define table TestTable(symbols string, price int, volume float);""")
+
+
+def test_redefine_table_identical_is_legal():
+    """testQuery5 (:92-101): an identical re-definition is accepted."""
+    m, _rt = build("""define table TestTable(symbol string, price int, volume float);
+                      define table TestTable(symbol string, price int, volume float);""")
+    m.shutdown()
+
+
+def test_stream_then_table_same_id():
+    """testQuery6 (:103-112): a table re-using a stream id conflicts."""
+    with pytest.raises(DuplicateDefinitionException):
+        build("""define stream TestTable(symbol string, price int, volume float);
+                 define table TestTable(symbol string, price int, volume float);""")
+
+
+def test_table_then_stream_same_id():
+    """testQuery7 (:114-123): a stream re-using a table id conflicts."""
+    with pytest.raises(DuplicateDefinitionException):
+        build("""define table TestTable(symbol string, price int, volume float);
+                 define stream TestTable(symbol string, price int, volume float);""")
+
+
+def test_insert_into_table_type_conflict():
+    """testQuery8/9 (:125-157): a query inserting (string,int,float) into a
+    table defined (string,float,long) fails creation whichever side is
+    declared first."""
+    for app in [
+        """define stream StockStream(symbol string, price int, volume float);
+           from StockStream select symbol, price, volume insert into OutputStream;
+           define table OutputStream (symbol string, price float, volume long);""",
+        """define stream StockStream(symbol string, price int, volume float);
+           define table OutputStream (symbol string, price float, volume long);
+           from StockStream select symbol, price, volume insert into OutputStream;""",
+    ]:
+        with pytest.raises(CREATION_ERRORS):
+            build(app)
+
+
+def test_insert_into_table_arity_conflict():
+    """testQuery10 (:159-173): inserting 2 columns into a 3-column table
+    fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        build("""define stream StockStream(symbol string, price int, volume float);
+                 define table OutputStream (symbol string, price float, volume long);
+                 from StockStream select symbol, price insert into OutputStream;""")
+
+
+def test_insert_into_matching_table():
+    """testQuery11/12 (:175-205): schema-equivalent inserts (explicit and
+    `select *`) compile and run."""
+    for sel in ("symbol, price, volume", "*"):
+        m, rt = build(f"""define stream StockStream(symbol string, price int, volume float);
+            define table OutputStream (symbol string, price int, volume float);
+            from StockStream select {sel} insert into OutputStream;""")
+        rt.get_input_handler("StockStream").send(["IBM", 10, 1.5])
+        assert len(rt.query("from OutputStream select *")) == 1
+        m.shutdown()
+
+
+def test_select_star_arity_conflicts():
+    """testQuery13/14 (:207-237): `select *` into a wider table or a table
+    with a different column type fails creation."""
+    with pytest.raises(CREATION_ERRORS):
+        build("""define stream StockStream(symbol string, price int, volume float);
+                 define table OutputStream (symbol string, price int, volume float, time long);
+                 from StockStream select * insert into OutputStream;""")
+    with pytest.raises(CREATION_ERRORS):
+        build("""define stream StockStream(symbol string, price int, volume float);
+                 define table OutputStream (symbol string, price int, volume int);
+                 from StockStream select * insert into OutputStream;""")
+
+
+def test_query_from_table_as_stream_rejected():
+    """testQuery15 (:239-253): `from <table>` as a plain stream source
+    fails creation (tables are consumed via joins or on-demand queries)."""
+    with pytest.raises(CREATION_ERRORS):
+        build("""define stream StockStream(symbol string, price int, volume float);
+                 define table OutputStream (symbol string, price int, volume float);
+                 from OutputStream select symbol, price, volume insert into StockStream;""")
+
+
+# ------------------------------------------- InsertIntoTableTestCase
+
+
+def test_insert_then_join_sees_rows():
+    """InsertIntoTableTestCase shape: inserted rows are visible to a
+    subsequent join probe."""
+    m, rt = build("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol == StockTable.symbol
+        select StockTable.symbol, StockTable.price, StockTable.volume
+        insert into OutStream;
+    """)
+    from siddhi_tpu.core.query.callback import QueryCallback
+
+    class Q(QueryCallback):
+        def __init__(self):
+            self.events = []
+
+        def receive(self, ts, ins, rms):
+            if ins:
+                self.events.extend(ins)
+
+    q = Q()
+    rt.add_callback("query2", q)
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("CheckStockStream").send(["IBM"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 75.5999984741211, 10)]
+
+
+def test_insert_expired_events_from_window_into_table():
+    """InsertIntoTableTestCase expired-mode shape: `insert expired events`
+    from a length window lands the evicted rows in the table."""
+    m, rt = build("""
+        define stream StockStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from StockStream#window.length(2)
+        select symbol, price, volume
+        insert expired events into StockTable;
+    """)
+    h = rt.get_input_handler("StockStream")
+    h.send(["A", 1.0, 1])
+    h.send(["B", 2.0, 2])
+    h.send(["C", 3.0, 3])   # evicts A
+    h.send(["D", 4.0, 4])   # evicts B
+    got = sorted(e.data[0] for e in rt.query("from StockTable select *"))
+    assert got == ["A", "B"]
+    m.shutdown()
