@@ -621,15 +621,53 @@ def _eval_window_expr(expr, rows: List[dict], new_row: Optional[dict],
     return bool(ev(expr))
 
 
-class ExpressionWindowStage(HostWindowStage):
+def _parse_window_expr(src: str):
+    from siddhi_tpu.compiler.parser import Parser
+    from siddhi_tpu.compiler.tokenizer import tokenize
+
+    return Parser(tokenize(src)).parse_expression()
+
+
+class _DynamicExprMixin:
+    """Dynamic ``expression(exprAttr)`` support: the retention expression
+    rides on each event; a change re-parses (cached) and applies from that
+    event on."""
+
+    def _init_dynamic(self, dictionary, expr_attr):
+        self.dictionary = dictionary
+        self.expr_attr = expr_attr
+        self._expr_sid = None
+        self._expr_cache: dict = {}
+
+    def _refresh_expr(self, r: dict):
+        if self.expr_attr is None:
+            return
+        sid = r.get(self.expr_attr)
+        # null expressions (NULL_ID < 0) keep the previous one in force
+        if sid is None or int(sid) < 0 or sid == self._expr_sid:
+            return
+        src = self.dictionary.decode(int(sid))
+        if not src:
+            return
+        cached = self._expr_cache.get(src)
+        if cached is None:
+            # parse BEFORE recording the sid: a malformed expression must
+            # not poison the dedup guard for identical later values
+            cached = _parse_window_expr(src)
+            self._expr_cache[src] = cached
+        self._expr_sid = sid
+        self.expr = cached
+
+
+class ExpressionWindowStage(_DynamicExprMixin, HostWindowStage):
     """``expression('<expr>')`` sliding retention: after each arrival the
     oldest events are evicted until the expression holds (reference
     ExpressionWindowProcessor)."""
 
-    def __init__(self, expr, col_specs, dictionary):
+    def __init__(self, expr, col_specs, dictionary, expr_attr=None):
         super().__init__(col_specs)
         self.expr = expr
-        self.dictionary = dictionary
+        self._init_dynamic(dictionary, expr_attr)
         self._rows: List[dict] = []
 
     def process(self, batch, now: int):
@@ -638,6 +676,7 @@ class ExpressionWindowStage(HostWindowStage):
         valid = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
         for i in np.nonzero(valid)[0]:
             r = _row(cols, int(i))
+            self._refresh_expr(r)
             self._rows.append(r)
             rr = dict(r)
             rr[TYPE_KEY] = CURRENT
@@ -662,17 +701,17 @@ class ExpressionWindowStage(HostWindowStage):
         self._rows = list(snap["rows"])
 
 
-class ExpressionBatchWindowStage(HostWindowStage):
+class ExpressionBatchWindowStage(_DynamicExprMixin, HostWindowStage):
     """``expressionBatch('<expr>')``: when an arrival breaks the
     expression, the collected batch flushes and a new one starts with the
     breaking event (reference ExpressionBatchWindowProcessor)."""
 
     batch_mode = True
 
-    def __init__(self, expr, col_specs, dictionary):
+    def __init__(self, expr, col_specs, dictionary, expr_attr=None):
         super().__init__(col_specs)
         self.expr = expr
-        self.dictionary = dictionary
+        self._init_dynamic(dictionary, expr_attr)
         self._rows: List[dict] = []
         self._prev: List[dict] = []
 
@@ -682,6 +721,7 @@ class ExpressionBatchWindowStage(HostWindowStage):
         valid = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
         for i in np.nonzero(valid)[0]:
             r = _row(cols, int(i))
+            self._refresh_expr(r)
             self._rows.append(r)
             if not _eval_window_expr(self.expr, self._rows, r, now,
                                      self.dictionary):
@@ -877,15 +917,31 @@ def create_host_window_stage(window, input_def, resolver, app_context) -> HostWi
         return CronWindowStage(CronSchedule(expr), col_specs)
 
     if name in ("expression", "expressionbatch"):
+        from siddhi_tpu.query_api.definitions import AttrType
+        from siddhi_tpu.query_api.expressions import Variable as _Var
+
+        cls = (ExpressionWindowStage if name == "expression"
+               else ExpressionBatchWindowStage)
+        p0 = window.parameters[0] if window.parameters else None
+        if isinstance(p0, _Var):
+            # dynamic form — expression(exprAttr): each event CARRIES its
+            # retention expression; a change re-parses and re-applies it
+            # (reference ExpressionWindowProcessor dynamic parameter)
+            try:
+                attr = input_def.attribute(p0.attribute_name)
+            except Exception:
+                raise CompileError(
+                    f"{window.name} window: unknown attribute "
+                    f"'{p0.attribute_name}'")
+            if attr.type != AttrType.STRING:
+                raise CompileError(
+                    f"{window.name} window's dynamic expression attribute "
+                    f"must be a string")
+            return cls(None, col_specs, resolver.dictionary,
+                       expr_attr=attr.name)
         src = _const_param(window, 0, "expression")
         if not isinstance(src, str):
             raise CompileError(f"{window.name} window needs a quoted expression")
-        from siddhi_tpu.compiler.parser import Parser
-        from siddhi_tpu.compiler.tokenizer import tokenize
-
-        expr = Parser(tokenize(src)).parse_expression()
-        cls = (ExpressionWindowStage if name == "expression"
-               else ExpressionBatchWindowStage)
-        return cls(expr, col_specs, resolver.dictionary)
+        return cls(_parse_window_expr(src), col_specs, resolver.dictionary)
 
     raise CompileError(f"host window '{window.name}' is not implemented")

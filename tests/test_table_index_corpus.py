@@ -1,0 +1,198 @@
+"""Reference @Index table corpus — scenarios ported verbatim from
+``query/table/IndexTableTestCase.java``: secondary-index probes across
+compare operators, and updates through the indexed column."""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+def build_q(app, query="query2"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback(query, q)
+    return m, rt, q
+
+
+IDX_SYMBOL = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define stream UpdateStockStream (symbol string, price float, volume long);
+    @Index('symbol')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+IDX_VOLUME = IDX_SYMBOL.replace("@Index('symbol')", "@Index('volume')")
+
+
+def test_index_equality_pair_join():
+    """indexTableTest1 (:56-119): two equality conjuncts, one through the
+    @Index column."""
+    m, rt, q = build_q(IDX_SYMBOL + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume == StockTable.volume AND CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["IBM", 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 100), ("WSO2", 100)]
+
+
+def test_index_inequality_join():
+    """indexTableTest2 (:121-184): != through the indexed column falls back
+    to a scan of the other rows."""
+    m, rt, q = build_q(IDX_SYMBOL + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol != StockTable.symbol
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["GOOG", 100])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("GOOG", "IBM", 100), ("GOOG", "WSO2", 100)]
+
+
+def test_index_range_gt_join():
+    """indexTableTest3 (:186-256): `CheckStockStream.volume >
+    StockTable.volume` over the numeric index."""
+    m, rt, q = build_q(IDX_VOLUME + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume > StockTable.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["GOOG", 50.6, 50])
+    stock.send(["ABC", 5.6, 70])
+    check.send(["IBM", 100])
+    check.send(["FOO", 60])
+    m.shutdown()
+    got = [tuple(e.data) for e in q.events]
+    assert sorted(got[:2]) == [("IBM", "ABC", 70), ("IBM", "GOOG", 50)]
+    assert got[2:] == [("FOO", "GOOG", 50)]
+
+
+def test_index_range_ge_join():
+    """indexTableTest7 (:456-520): `StockTable.volume >=
+    CheckStockStream.volume`."""
+    m, rt, q = build_q(IDX_VOLUME + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume >= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["GOOG", 50.6, 50])
+    stock.send(["ABC", 5.6, 70])
+    rt.get_input_handler("CheckStockStream").send(["IBM", 70])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", "ABC", 70), ("IBM", "WSO2", 200)]
+
+
+def test_index_duplicate_key_rows_both_match():
+    """indexTableTest8 (:522-590): @Index (unlike @PrimaryKey) keeps BOTH
+    volume-200 rows and a >= probe returns all three matches."""
+    m, rt, q = build_q(IDX_VOLUME + """
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume >= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["FOO", 50.6, 200])
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["GOOG", 50.6, 50])
+    stock.send(["ABC", 5.6, 70])
+    rt.get_input_handler("CheckStockStream").send(["IBM", 70])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", "ABC", 70), ("IBM", "FOO", 200), ("IBM", "WSO2", 200)]
+
+
+def test_index_update_through_indexed_column():
+    """indexTableTest9 (:592-666): an update through the indexed symbol is
+    visible to later joins at the NEW volume."""
+    m, rt, q = build_q(IDX_SYMBOL + """
+        @info(name = 'query2')
+        from UpdateStockStream update StockTable on StockTable.symbol == symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    update.send(["IBM", 77.6, 200])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("WSO2", 100), ("IBM", 200), ("WSO2", 100)]
+
+
+def test_index_relational_update_condition():
+    """indexTableTest13 (:914-...): `update ... on StockTable.volume >=
+    volume` through the numeric index rewrites the matching row's price."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        define stream UpdateStockStream (symbol string, price float, volume long);
+        @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2')
+        from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume >= volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    update = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["WSO2", 200])
+    update.send(["FOO", 77.6, 200])
+    check.send(["BAR", 200])
+    m.shutdown()
+    assert [(round(e.data[0], 4), e.data[1]) for e in q.events] == [
+        (55.6, 200), (77.6, 200)]

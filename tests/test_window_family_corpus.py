@@ -1676,3 +1676,100 @@ def test_etb_append_after_timeout_counts():
     m.shutdown()
     assert [e.data[2] for e in q.events] == [4, 3, 5, 7, 2]
     assert q.expired == []
+
+
+# ---------------------------------------------- ExpressionWindowTestCase
+
+
+EXPR_APP = """@app:playback
+    define stream cseEventStream (symbol string, price float, volume int);
+    @info(name = 'query1')
+    from cseEventStream#window.expression({expr})
+    select symbol, price insert all events into OutStream;
+"""
+
+
+def test_expression_window_count_retention():
+    """expressionWindowTest1 (:50-92): count() <= 2 behaves as a sliding
+    length(2); 5 in, 3 remove."""
+    m, rt, q = build_q(EXPR_APP.format(expr="'count() <= 2'"))
+    h = rt.get_input_handler("cseEventStream")
+    for ts, (sym, p, v) in enumerate([("IBM", 700.0, 0), ("WSO2", 60.5, 1),
+                                      ("WSO2", 61.5, 2), ("WSO2", 62.5, 3),
+                                      ("WSO2", 63.5, 4)]):
+        h.send(1000 + ts, [sym, p, v])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 3
+
+
+def test_expression_window_attribute_delta_retention():
+    """expressionWindowTest2 (:94-135): last.volume - first.volume <= 2
+    retains a value-bounded span; 5 in, 2 remove."""
+    m, rt, q = build_q(EXPR_APP.format(
+        expr="'last.volume - first.volume <= 2'"))
+    h = rt.get_input_handler("cseEventStream")
+    for ts, v in enumerate(range(5)):
+        h.send(1000 + ts, ["WSO2", 60.5 + v, v])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 2
+
+
+def test_expression_window_timestamp_retention():
+    """expressionWindowTest3 (:137-178): eventTimestamp(last) -
+    eventTimestamp(first) <= 2 over ms-spaced sends; 5 in, 2 remove."""
+    m, rt, q = build_q(EXPR_APP.format(
+        expr="'eventTimestamp(last) - eventTimestamp(first) <= 2'"))
+    h = rt.get_input_handler("cseEventStream")
+    for ts in range(5):
+        h.send(ts, ["WSO2", 60.5, ts])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 2
+
+
+def test_expression_window_dynamic_attribute():
+    """expressionWindowTest5 (:227-269): the retention expression rides on
+    a stream attribute; 5 in, 2 remove."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int, expr string);
+        @info(name = 'query1')
+        from cseEventStream#window.expression(expr)
+        select symbol, price insert all events into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    h = rt.get_input_handler("cseEventStream")
+    expr = "eventTimestamp(last) - eventTimestamp(first) <= 2"
+    for ts in range(5):
+        h.send(ts, ["WSO2", 60.5 + ts, ts, expr])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 2
+
+
+def test_expression_window_dynamic_attribute_change():
+    """expressionWindowTest6 (:270-312): loosening the expression
+    mid-stream widens retention; 5 in, 1 remove."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int, expr string);
+        @info(name = 'query1')
+        from cseEventStream#window.expression(expr)
+        select symbol, price insert all events into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    h = rt.get_input_handler("cseEventStream")
+    e1 = "eventTimestamp(last) - eventTimestamp(first) < 2"
+    e2 = "eventTimestamp(last) - eventTimestamp(first) < 4"
+    h.send(0, ["WSO2", 60.5, 0, e1])
+    h.send(1, ["WSO2", 61.5, 1, e1])
+    h.send(2, ["WSO2", 62.5, 2, e2])
+    h.send(3, ["WSO2", 63.5, 3, e2])
+    h.send(4, ["WSO2", 64.5, 4, e2])
+    m.shutdown()
+    assert len(q.events) == 5
+    assert len(q.expired) == 1
