@@ -636,27 +636,32 @@ class _DynamicExprMixin:
     def _init_dynamic(self, dictionary, expr_attr):
         self.dictionary = dictionary
         self.expr_attr = expr_attr
-        self._expr_sid = None
-        self._expr_cache: dict = {}
+        self._expr_src = None      # source text of the expression in force
 
     def _refresh_expr(self, r: dict):
         if self.expr_attr is None:
             return
+        # null expressions keep the previous one in force — nulls surface
+        # as the '<attr>?' mask column (the sid itself clamps to 0)
+        if r.get(self.expr_attr + "?"):
+            return
         sid = r.get(self.expr_attr)
-        # null expressions (NULL_ID < 0) keep the previous one in force
-        if sid is None or int(sid) < 0 or sid == self._expr_sid:
+        if sid is None or int(sid) < 0:
             return
         src = self.dictionary.decode(int(sid))
-        if not src:
+        if not src or src == self._expr_src:
             return
-        cached = self._expr_cache.get(src)
-        if cached is None:
-            # parse BEFORE recording the sid: a malformed expression must
-            # not poison the dedup guard for identical later values
-            cached = _parse_window_expr(src)
-            self._expr_cache[src] = cached
-        self._expr_sid = sid
-        self.expr = cached
+        # parse BEFORE recording: a malformed expression must not poison
+        # the change detector for identical later values
+        parsed = _parse_window_expr(src)
+        self._expr_src = src
+        self.expr = parsed
+
+    def _restore_expr(self, src):
+        """Re-arm the in-force dynamic expression after a restore."""
+        if src:
+            self._expr_src = src
+            self.expr = _parse_window_expr(src)
 
 
 class ExpressionWindowStage(_DynamicExprMixin, HostWindowStage):
@@ -681,7 +686,9 @@ class ExpressionWindowStage(_DynamicExprMixin, HostWindowStage):
             rr = dict(r)
             rr[TYPE_KEY] = CURRENT
             out_rows.append(rr)
-            while self._rows and not _eval_window_expr(
+            # no expression in force yet (dynamic form before the first
+            # non-null value): retain everything
+            while self.expr is not None and self._rows and not _eval_window_expr(
                 self.expr, self._rows, r, now, self.dictionary
             ):
                 old = self._rows.pop(0)
@@ -695,10 +702,11 @@ class ExpressionWindowStage(_DynamicExprMixin, HostWindowStage):
         return list(self._rows)
 
     def snapshot(self):
-        return {"rows": self._rows}
+        return {"rows": self._rows, "expr_src": self._expr_src}
 
     def restore(self, snap):
         self._rows = list(snap["rows"])
+        self._restore_expr(snap.get("expr_src"))
 
 
 class ExpressionBatchWindowStage(_DynamicExprMixin, HostWindowStage):
@@ -723,8 +731,8 @@ class ExpressionBatchWindowStage(_DynamicExprMixin, HostWindowStage):
             r = _row(cols, int(i))
             self._refresh_expr(r)
             self._rows.append(r)
-            if not _eval_window_expr(self.expr, self._rows, r, now,
-                                     self.dictionary):
+            if self.expr is not None and not _eval_window_expr(
+                    self.expr, self._rows, r, now, self.dictionary):
                 flush = self._rows[:-1]
                 if flush:
                     for p in self._prev:
@@ -744,11 +752,13 @@ class ExpressionBatchWindowStage(_DynamicExprMixin, HostWindowStage):
         return list(self._rows)
 
     def snapshot(self):
-        return {"rows": self._rows, "prev": self._prev}
+        return {"rows": self._rows, "prev": self._prev,
+                "expr_src": self._expr_src}
 
     def restore(self, snap):
         self._rows = list(snap["rows"])
         self._prev = list(snap["prev"])
+        self._restore_expr(snap.get("expr_src"))
 
 
 class PartitionedHostWindow(HostWindowStage):

@@ -1,0 +1,117 @@
+"""Reference @synchronized corpus — scenarios from
+``managment/QuerySyncTestCase.java``. Synchronization is by construction
+here (single host pump + per-query lock), so the corpus pins that the
+annotation parses everywhere the reference allows it and the query
+behavior is unchanged."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+        self.expired = []
+
+    def receive(self, events):
+        for e in events:
+            (self.expired if e.is_expired else self.events).append(e)
+
+
+def test_synchronized_time_window():
+    """querySyncTest1 (:51-95): a @synchronized time(2 sec) query — 2 in,
+    2 remove."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        @synchronized('true')
+        from cseEventStream#window.time(2 sec)
+        select symbol, price, volume insert all events into OutStream;
+        from Tick select x insert into TickOut;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(1000, ["IBM", 700.0, 0])
+    h.send(1010, ["WSO2", 60.5, 1])
+    rt.get_input_handler("Tick").send(4100, [0])
+    m.shutdown()
+    assert len(q.events) == 2
+    assert len(q.expired) == 2
+
+
+def test_synchronized_snapshot_rate_limit():
+    """querySyncTest2 (:97-155): @synchronized + `output snapshot every
+    1 sec` — only the live snapshot rows surface, never removes."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        @app:name('SnapshotOutputRateLimitTest3')
+        define stream LoginEvents (timestamp long, ip string);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        @synchronized('true')
+        from LoginEvents
+        select ip
+        output snapshot every 1 sec
+        insert all events into uniqueIps;
+        from Tick select x insert into TickOut;
+    """)
+    c = Collector()
+    rt.add_callback("uniqueIps", c)
+    h = rt.get_input_handler("LoginEvents")
+    tick = rt.get_input_handler("Tick")
+    h.send(1000, [1000, "192.10.1.5"])
+    h.send(1100, [1100, "192.10.1.3"])
+    tick.send(3300, [0])                 # snapshots at 2000/3000: last = .3
+    h.send(3400, [3400, "192.10.1.9"])
+    h.send(3500, [3500, "192.10.1.4"])
+    tick.send(4600, [0])                 # snapshot at 4000: last = .4
+    m.shutdown()
+    assert c.expired == []
+    assert c.events                      # snapshots arrived
+    assert all(e.data[0] in ("192.10.1.3", "192.10.1.4") for e in c.events)
+
+
+def test_synchronized_join():
+    """querySyncTest3 (:157-205): @synchronized join of two time(1 sec)
+    windows — 2 in events, 2 removes."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        define stream twitterStream (user string, tweet string, company string);
+        define stream Tick (x int);
+        @info(name = 'query1')
+        @synchronized('true')
+        from cseEventStream#window.time(1 sec) as a join twitterStream#window.time(1 sec) as b
+        on a.symbol == b.company
+        select a.symbol as symbol, b.tweet, a.price
+        insert all events into OutStream;
+        from Tick select x insert into TickOut;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    cse = rt.get_input_handler("cseEventStream")
+    twitter = rt.get_input_handler("twitterStream")
+    cse.send(1000, ["WSO2", 55.6, 100])
+    twitter.send(1010, ["User1", "Hello World", "WSO2"])
+    cse.send(1020, ["IBM", 75.6, 100])
+    cse.send(1520, ["WSO2", 57.6, 100])  # Thread.sleep(500)
+    rt.get_input_handler("Tick").send(3200, [0])
+    m.shutdown()
+    assert len(q.events) == 2            # tweet x 55.6, then 57.6 x tweet
+    assert len(q.expired) == 2

@@ -1,0 +1,119 @@
+"""Reference update-`set` corpus — scenarios from
+``query/table/set/SetUpdate{,OrInsert}InMemoryTableTestCase.java``. The
+reference smokes assert nothing; final table contents are pinned here via
+on-demand queries."""
+
+from siddhi_tpu import SiddhiManager
+
+
+def build(query):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream UpdateStockStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+    """ + query)
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 100])
+    h.send(["WSO2", 57.6, 100])
+    return m, rt
+
+
+def rows(rt):
+    return sorted((e.data[0], round(e.data[1], 2), e.data[2])
+                  for e in rt.query("from StockTable select *"))
+
+
+def test_set_all_columns():
+    """SetUpdate test1 (:50-82): set every column from the trigger."""
+    m, rt = build("""
+        @info(name = 'query2')
+        from UpdateStockStream
+        update StockTable
+        set StockTable.price = price, StockTable.symbol = symbol,
+            StockTable.volume = volume
+        on StockTable.symbol == symbol;
+    """)
+    rt.get_input_handler("UpdateStockStream").send(["IBM", 100.0, 200])
+    assert ("IBM", 100.0, 200) in rows(rt)
+    m.shutdown()
+
+
+def test_set_subset_of_columns():
+    """SetUpdate test2 (:84-115): a subset `set` leaves other columns."""
+    m, rt = build("""
+        @info(name = 'query2')
+        from UpdateStockStream
+        update StockTable
+        set StockTable.price = price
+        on StockTable.symbol == symbol;
+    """)
+    rt.get_input_handler("UpdateStockStream").send(["IBM", 100.0, 999])
+    assert ("IBM", 100.0, 100) in rows(rt)     # volume untouched
+    m.shutdown()
+
+
+def test_set_constant_value():
+    """SetUpdate test3 (:117-148): a constant assignment expression."""
+    m, rt = build("""
+        @info(name = 'query2')
+        from UpdateStockStream
+        update StockTable
+        set StockTable.price = 10
+        on StockTable.symbol == symbol;
+    """)
+    rt.get_input_handler("UpdateStockStream").send(["IBM", 100.0, 100])
+    assert ("IBM", 10.0, 100) in rows(rt)
+    m.shutdown()
+
+
+def test_set_renamed_output_attribute():
+    """SetUpdate test4 (:150-183): the assignment reads a projected
+    (renamed) attribute."""
+    m, rt = build("""
+        @info(name = 'query2')
+        from UpdateStockStream
+        select symbol, price as newPrice
+        update StockTable
+        set StockTable.price = newPrice
+        on StockTable.symbol == symbol;
+    """)
+    rt.get_input_handler("UpdateStockStream").send(["IBM", 100.0, 100])
+    assert ("IBM", 100.0, 100) in rows(rt)
+    m.shutdown()
+
+
+def test_set_arithmetic_expression():
+    """SetUpdate test5 (:185-...): arithmetic over a projected attribute."""
+    m, rt = build("""
+        @info(name = 'query2')
+        from UpdateStockStream
+        select symbol, price as newPrice
+        update StockTable
+        set StockTable.price = newPrice + 100
+        on StockTable.symbol == symbol;
+    """)
+    rt.get_input_handler("UpdateStockStream").send(["IBM", 100.0, 100])
+    assert ("IBM", 200.0, 100) in rows(rt)
+    m.shutdown()
+
+
+def test_set_update_or_insert_miss_inserts():
+    """SetUpdateOrInsert shape: a non-matching trigger inserts the full
+    row; a matching one applies only the set clause."""
+    m, rt = build("""
+        @info(name = 'query2')
+        from UpdateStockStream
+        update or insert into StockTable
+        set StockTable.price = price
+        on StockTable.symbol == symbol;
+    """)
+    u = rt.get_input_handler("UpdateStockStream")
+    u.send(["FB", 33.0, 300])          # miss: full insert
+    u.send(["IBM", 200.0, 999])        # hit: only price changes
+    got = rows(rt)
+    assert ("FB", 33.0, 300) in got
+    assert ("IBM", 200.0, 100) in got
+    m.shutdown()

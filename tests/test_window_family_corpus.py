@@ -1773,3 +1773,25 @@ def test_expression_window_dynamic_attribute_change():
     m.shutdown()
     assert len(q.events) == 5
     assert len(q.expired) == 1
+
+
+def test_expression_window_dynamic_null_keeps_previous():
+    """Dynamic expression windows: null expression values keep the one in
+    force; leading nulls (no expression yet) retain everything."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int, expr string);
+        @info(name = 'query1')
+        from cseEventStream#window.expression(expr)
+        select symbol, price insert all events into OutStream;
+    """)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    h = rt.get_input_handler("cseEventStream")
+    h.send(0, ["WSO2", 60.5, 0, None])           # no expression yet
+    h.send(1, ["WSO2", 61.5, 1, "count() <= 2"])  # now a length-2 bound
+    h.send(2, ["WSO2", 62.5, 2, None])            # null: bound stays
+    h.send(3, ["WSO2", 63.5, 3, None])
+    m.shutdown()
+    assert len(q.events) == 4
+    assert len(q.expired) == 2
