@@ -113,12 +113,13 @@ def test_playback_heartbeat_flushes_last_batch():
 
 def test_playback_heartbeat_join():
     """playbackTest4 (:230-279): joined timeBatch(1 sec) sides drained by
-    the heartbeat — 2 in events, none removed. idle.time scaled to 1 sec
-    (see test_playback_heartbeat_flushes_last_batch); the app is built and
-    fed once first so the timed run hits warm jit caches instead of
-    multi-second first compiles mid-feed."""
+    the heartbeat — 2 in events, none removed. idle.time scaled to 10 sec
+    (reference: 100 ms): each new runtime re-traces the join step for
+    seconds per side, and under a loaded xdist run even a warm-cache feed
+    can stall past shorter idle windows, firing the heartbeat mid-feed;
+    the app is also built and fed once first to warm the jit caches."""
     APP = """
-        @app:playback(idle.time = '3 sec', increment = '1 sec')
+        @app:playback(idle.time = '10 sec', increment = '1 sec')
         define stream cseEventStream (symbol string, price float, volume int);
         define stream twitterStream (user string, tweet string, company string);
         @info(name = 'query1')
@@ -140,7 +141,7 @@ def test_playback_heartbeat_join():
         twitter.send(ts, ["User1", "Hello World", "WSO2"])
         cse.send(ts, ["IBM", 75.6, 100])
         cse.send(ts + 1100, ["WSO2", 57.6, 100])
-        ok = wait_for(lambda: len(q.events) >= 2, timeout=25.0)
+        ok = wait_for(lambda: len(q.events) >= 2, timeout=40.0)
         m.shutdown()
         return ok, q
 

@@ -1795,3 +1795,84 @@ def test_expression_window_dynamic_null_keeps_previous():
     m.shutdown()
     assert len(q.events) == 4
     assert len(q.expired) == 2
+
+
+# ----------------------------------------- ExpressionBatchWindowTestCase
+
+
+EXPRB_APP = """@app:playback
+    define stream cseEventStream (symbol string, price float, volume int);
+    @info(name = 'query1')
+    from cseEventStream#window.expressionBatch({expr})
+    select symbol, price insert all events into OutStream;
+"""
+
+
+def _feed_exprb(rt, n=5):
+    h = rt.get_input_handler("cseEventStream")
+    rows = [("IBM", 700.0, 0), ("WSO2", 60.5, 1), ("WSO2", 61.5, 2),
+            ("WSO2", 62.5, 3), ("WSO2", 63.5, 4), ("WSO2", 64.5, 5),
+            ("WSO2", 65.5, 6)]
+    for ts, (sym, p, v) in enumerate(rows[:n]):
+        h.send(ts, [sym, p, v])
+
+
+def test_expression_batch_count_tumbles():
+    """expressionBatchWindowTest1 (:51-93): count() <= 2 tumbles in pairs —
+    two 2-row flushes (4 in), first batch expired once (2 removes)."""
+    m, rt, q = build_q(EXPRB_APP.format(expr="'count() <= 2'"))
+    _feed_exprb(rt)
+    m.shutdown()
+    assert len(q.events) == 4
+    assert len(q.expired) == 2
+
+
+def test_expression_batch_attribute_delta():
+    """expressionBatchWindowTest2 (:95-136): last.volume - first.volume < 2
+    — same pair tumbling on the attribute span."""
+    m, rt, q = build_q(EXPRB_APP.format(
+        expr="'last.volume - first.volume < 2'"))
+    _feed_exprb(rt)
+    m.shutdown()
+    assert len(q.events) == 4
+    assert len(q.expired) == 2
+
+
+def test_expression_batch_timestamp_span():
+    """expressionBatchWindowTest3 (:138-179): eventTimestamp span < 2 ms
+    with 1 ms sends — pairs again."""
+    m, rt, q = build_q(EXPRB_APP.format(
+        expr="'eventTimestamp(last) - eventTimestamp(first) < 2'"))
+    _feed_exprb(rt)
+    m.shutdown()
+    assert len(q.events) == 4
+    assert len(q.expired) == 2
+
+
+def test_expression_batch_timestamp_span_triples():
+    """expressionBatchWindowTest4 (:181-228): span <= 2 admits triples —
+    two 3-row flushes from 7 events (6 in, 3 removes)."""
+    m, rt, q = build_q(EXPRB_APP.format(
+        expr="'eventTimestamp(last) - eventTimestamp(first) <= 2'"))
+    _feed_exprb(rt, n=7)
+    m.shutdown()
+    assert len(q.events) == 6
+    assert len(q.expired) == 3
+
+
+def test_expression_batch_dynamic_attribute():
+    """expressionBatchWindowTest5 (:230-273): the batch expression rides a
+    stream attribute."""
+    m, rt, q = build_q("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int, expr string);
+        @info(name = 'query1')
+        from cseEventStream#window.expressionBatch(expr)
+        select symbol, price insert all events into OutStream;
+    """)
+    h = rt.get_input_handler("cseEventStream")
+    expr = "count() <= 2"
+    for ts in range(5):
+        h.send(ts, ["WSO2", 60.5 + ts, ts, expr])
+    m.shutdown()
+    assert len(q.events) == 4
+    assert len(q.expired) == 2
