@@ -26,6 +26,7 @@ merging over collectives lands with the multi-host runner.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -45,6 +46,8 @@ from siddhi_tpu.query_api.definitions import (
 from siddhi_tpu.query_api.expressions import AttributeFunction, Expression, Variable
 
 AGG_TS = "AGG_TIMESTAMP"
+
+_LOG = logging.getLogger("siddhi_tpu.aggregation")
 
 _DUR_ORDER = [Duration.SECONDS, Duration.MINUTES, Duration.HOURS, Duration.DAYS,
               Duration.MONTHS, Duration.YEARS]
@@ -125,6 +128,8 @@ class _BaseSpec:
             return a + b
         if self.kind == "distinct":
             return a | b          # sets of observed values
+        if self.kind == "last":
+            return b              # latest arrival wins (bare selections)
         return min(a, b) if self.kind == "min" else max(a, b)
 
 
@@ -154,11 +159,21 @@ class IncrementalAggregationRuntime(Receiver):
         self.input_stream_id = sid
         resolver = SingleStreamResolver(self.input_def, dictionary)
 
-        # time attribute (`aggregate by attr`, default: event timestamp)
+        # time attribute (`aggregate by attr`, default: event timestamp);
+        # STRING attributes carry 'yyyy-MM-dd HH:mm:ss [±HH:MM]' dates,
+        # parsed per event — unparsable rows are dropped with a log, not
+        # raised (reference IncrementalUnixTimeFunctionExecutor +
+        # Aggregation1TestCase test16/test38)
+        self.ts_is_string = False
+        self._ts_memo: Dict[int, Optional[int]] = {}
         if definition.aggregate_attribute is not None:
             fn, t = compile_expr(definition.aggregate_attribute, resolver)
-            if t not in (AttrType.LONG, AttrType.INT):
-                raise CompileError("aggregate by attribute must be long (ms epoch)")
+            if t == AttrType.STRING:
+                self.ts_is_string = True
+            elif t not in (AttrType.LONG, AttrType.INT):
+                raise CompileError(
+                    "aggregate by attribute must be long (ms epoch) or a "
+                    "'yyyy-MM-dd HH:mm:ss' string")
             self.ts_fn = fn
         else:
             self.ts_fn = None
@@ -195,15 +210,19 @@ class IncrementalAggregationRuntime(Receiver):
                     next(a.type for a in self.group_attrs
                          if a.name == expr.attribute_name)))
                 continue
-            if not isinstance(expr, AttributeFunction):
-                raise CompileError(
-                    f"aggregation selection '{name}' must be an aggregator call "
-                    f"or a group-by attribute")
-            kind = expr.name.lower()
-            if kind not in ("sum", "count", "avg", "min", "max", "distinctcount"):
-                raise CompileError(
-                    f"incremental aggregator '{kind}' is not supported "
-                    f"(sum/count/avg/min/max/distinctCount)")
+            kind = expr.name.lower() if isinstance(expr, AttributeFunction) \
+                else None
+            if kind not in ("sum", "count", "avg", "min", "max",
+                            "distinctcount"):
+                # bare expression (`(price * quantity) as lastTradeValue`):
+                # the LATEST arrival's value per (bucket, group) — reference
+                # AggregationParser keeps non-aggregate selections with
+                # last-value semantics (Aggregation1TestCase test5; null
+                # arguments leave the stored value untouched)
+                arg_fn, arg_t = compile_expr(expr, resolver)
+                base = self._base(f"last@{name}", arg_fn, arg_t, kind="last")
+                self.outputs.append(_OutSpec(name, "last", [base], arg_t))
+                continue
             arg_fn, arg_t = (compile_expr(expr.parameters[0], resolver)
                              if expr.parameters else (None, None))
             if kind == "count":
@@ -360,7 +379,36 @@ class IncrementalAggregationRuntime(Receiver):
             return
         if self.ts_fn is not None:
             tsv, _m = self.ts_fn(cols, ctx)
-            tsv = np.broadcast_to(np.asarray(tsv, np.int64), valid.shape)
+            if self.ts_is_string:
+                from siddhi_tpu.core.aggregation.within_time import unix_ms
+
+                ids = np.broadcast_to(np.asarray(tsv, np.int64), valid.shape)
+                tsv = np.zeros(valid.shape, np.int64)
+                ok = np.zeros(valid.shape, bool)
+                for j in idx:
+                    i = int(ids[j])
+                    if i not in self._ts_memo:
+                        s = self.dictionary.decode(i)
+                        try:
+                            self._ts_memo[i] = unix_ms(s) if s else None
+                        except Exception:
+                            self._ts_memo[i] = None
+                        if self._ts_memo[i] is None:
+                            _LOG.warning(
+                                "aggregation '%s': '%s' doesn't match the "
+                                "supported formats <yyyy>-<MM>-<dd> "
+                                "<HH>:<mm>:<ss> (GMT) or with a <Z> offset; "
+                                "dropping event", self.definition.id, s)
+                    ms = self._ts_memo[i]
+                    if ms is not None:
+                        tsv[j] = ms
+                        ok[j] = True
+                valid = valid & ok
+                idx = np.nonzero(valid)[0]
+                if idx.size == 0:
+                    return
+            else:
+                tsv = np.broadcast_to(np.asarray(tsv, np.int64), valid.shape)
         else:
             tsv = np.asarray(cols[TS_KEY], np.int64)
 
@@ -430,6 +478,14 @@ class IncrementalAggregationRuntime(Receiver):
                 f"aggregation '{self.definition.id}' does not keep "
                 f"'{duration.value}' granularity")
         base_keys = list(self.bases)
+        if within is not None:
+            # the reference truncates the within-START down to the queried
+            # duration's bucket start (IncrementalTimeConverterUtil via
+            # IncrementalAggregateCompileCondition): a range falling inside
+            # one bucket still selects that bucket (Aggregation1TestCase
+            # test44: a 1-second range read `per "hours"`)
+            start = int(bucket_starts(np.asarray([within[0]]), duration)[0])
+            within = (start, within[1])
         out_rows: List[list] = []
         with self._lock:
             for b in sorted(self.store[duration]):

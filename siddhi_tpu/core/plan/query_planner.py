@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Dict
 
+import numpy as np
+
 from siddhi_tpu.core.context import SiddhiAppContext
 from siddhi_tpu.core.plan.resolvers import SingleStreamResolver
 from siddhi_tpu.core.plan.selector_plan import plan_selector
@@ -354,8 +356,9 @@ def plan_join_query(
                 raise CompileError(
                     f"query '{query_name}': handlers on the aggregation join "
                     f"side '{sid}' are not supported")
-            duration, within = _agg_join_range(join, query_name)
+            duration, within, dyn = _agg_join_range(join, query_name)
             store = AggregationJoinStore(agg, duration, within)
+            store.dynamic_raw = dyn
             return JoinSide(
                 key=key, stream_id=sid, ref_id=s.stream_reference_id,
                 definition=store.definition, window_stage=None, filters=[],
@@ -525,6 +528,10 @@ def plan_join_query(
             f"query '{query_name}': no join side can trigger output — the "
             f"unidirectional/trigger side must be a stream or named window"
         )
+    for _sd, _ot in ((left, right), (right, left)):
+        if (isinstance(_sd.store, AggregationJoinStore)
+                and getattr(_sd.store, "dynamic_raw", None)):
+            _compile_dynamic_agg_range(_sd.store, _ot, dictionary)
     resolver = JoinResolver(left, right, dictionary)
 
     on_cond = None
@@ -587,37 +594,111 @@ def plan_join_query(
 
 
 def _agg_join_range(join: JoinInputStream, query_name: str):
-    """Parse `within .. per ..` of an aggregation join into (Duration,
-    (start, end) | None). Single time-constant `within t` means the
-    sliding last-t range, resolved at probe time by the store."""
+    """Parse `within .. per ..` of an aggregation join into (Duration | None,
+    (start, end) | None, dynamic_raw | None). Constants (unix-ms longs,
+    'yyyy-MM-dd HH:mm:ss' strings, single wildcard patterns) resolve at
+    plan time; expressions over the stream side (``per i.perValue``) are
+    returned raw for per-event resolution (reference AggregationRuntime's
+    startTimeEndTime/per executors run per matching event)."""
     from siddhi_tpu.core.aggregation.incremental import parse_duration_name
+    from siddhi_tpu.core.aggregation.within_time import (
+        WithinFormatError, resolve_within_pair, single_within_range)
     from siddhi_tpu.query_api.expressions import Constant, TimeConstant
 
+    dynamic: dict = {}
     if join.per is None:
         raise CompileError(
             f"query '{query_name}': an aggregation join needs `per '<duration>'`")
-    if not isinstance(join.per, Constant) or not isinstance(join.per.value, str):
-        raise CompileError(f"query '{query_name}': `per` must be a string constant")
-    duration = parse_duration_name(join.per.value)
+    if isinstance(join.per, Constant) and isinstance(join.per.value, str):
+        duration = parse_duration_name(join.per.value)
+    else:
+        duration = None
+        dynamic["per"] = join.per
+
+    def _const(x):
+        return x.value if isinstance(x, (Constant, TimeConstant)) else None
 
     w = join.within
-    if w is None:
-        return duration, None
+    within = None
+    try:
+        if w is None:
+            pass
+        elif isinstance(w, tuple):
+            a, b = _const(w[0]), _const(w[1])
+            if a is None or b is None:
+                dynamic["within"] = w
+            else:
+                within = resolve_within_pair(a, b)
+        elif isinstance(w, Constant) and isinstance(w.value, str):
+            # single wildcard pattern: the whole calendar unit it names
+            within = single_within_range(w.value)
+        elif isinstance(w, (Constant, TimeConstant)):
+            # single-bound within must be a date-pattern STRING (reference
+            # startTimeEndTime single-arg validation — test36)
+            raise CompileError(
+                f"query '{query_name}': a single within bound must be a "
+                f"date-pattern string ('**' wildcards allowed)")
+        else:
+            dynamic["within"] = (w,)
+    except WithinFormatError as e:
+        raise CompileError(f"query '{query_name}': {e}") from None
+    return duration, within, (dynamic or None)
 
-    def _ms(x):
-        if isinstance(x, (Constant, TimeConstant)) and not isinstance(
-            getattr(x, "value", None), str
-        ):
-            return int(x.value)
-        raise CompileError(
-            f"query '{query_name}': within bounds must be millisecond epoch "
-            f"constants (string date patterns are not supported yet)")
 
-    if isinstance(w, tuple):
-        return duration, (_ms(w[0]), _ms(w[1]))
-    # single bound: include everything from `start` on (reference single-arg
-    # within is a wildcard pattern; the numeric analog is an open range)
-    return duration, (_ms(w), 2 ** 62)
+def _compile_dynamic_agg_range(store, stream_side, dictionary):
+    """Compile per-event `within`/`per` expressions of an aggregation join
+    against the STREAM side's row columns; the store resolves them per
+    trigger event at probe time (reference AggregationRuntime per-event
+    startTimeEndTime/per executors — Aggregation1TestCase test6's
+    ``within i.startTime, i.endTime per i.perValue``). The compiled
+    closures return RAW per-row values (strings decoded from the
+    dictionary); parsing happens per row in the store so one bad row
+    can't void a whole batch."""
+    from siddhi_tpu.ops.expressions import VALID_KEY, compile_expr
+    from siddhi_tpu.query_api.definitions import AttrType
+
+    resolver = SingleStreamResolver(
+        stream_side.definition, dictionary, ref_id=stream_side.ref_id)
+
+    def host_values(expr):
+        fn, t = compile_expr(expr, resolver)
+        is_str = t == AttrType.STRING
+
+        def values(cols, ctx):
+            v, _m = fn(cols, ctx)
+            # constant sub-expressions compile to 0-d scalars — broadcast
+            # against the batch before iterating per row
+            v = np.broadcast_to(np.asarray(v), np.shape(cols[VALID_KEY]))
+            if is_str:
+                return [dictionary.decode(int(i)) for i in v]
+            return [int(x) for x in v]
+
+        return values, t
+
+    raw = store.dynamic_raw
+    per_of = None
+    if raw.get("per") is not None:
+        per_of, _t = host_values(raw["per"])
+    within_of = None
+    w = raw.get("within")
+    if w is not None:
+        if isinstance(w, tuple) and len(w) == 2:
+            (b0, _t0), (b1, _t1) = host_values(w[0]), host_values(w[1])
+
+            def within_of(cols, ctx):
+                return list(zip(b0(cols, ctx), b1(cols, ctx)))
+        else:
+            bv, t = host_values(w[0] if isinstance(w, tuple) else w)
+            if t != AttrType.STRING:
+                # same single-bound rule as the static path: must be a
+                # date-pattern string (startTimeEndTime single-arg)
+                raise CompileError(
+                    "a single within bound must be a date-pattern string "
+                    "('**' wildcards allowed)")
+
+            def within_of(cols, ctx):
+                return bv(cols, ctx)
+    store.dynamic = (per_of, within_of)
 
 
 def plan_nfa_query(

@@ -21,6 +21,7 @@ Extensions beyond the basic stream-stream shape:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -43,6 +44,8 @@ from siddhi_tpu.ops.expressions import (
 )
 from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
 from siddhi_tpu.query_api.expressions import Variable
+
+_LOG = logging.getLogger("siddhi_tpu.join")
 
 CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
 
@@ -101,10 +104,70 @@ class AggregationJoinStore:
         self.duration = duration
         self.within = within
         self.definition = agg.output_definition()
+        self.dynamic = None      # (per_of, within_of) raw-value closures
+        self.dynamic_raw = None  # uncompiled expressions (set by the planner)
 
     def contents(self):
         _defn, cols, valid = self.agg.contents(self.duration, self.within)
         return cols, valid
+
+    def resolve_groups(self, cols, ctx):
+        """Group trigger rows by their per-event (duration, within) values
+        (``within i.startTime, i.endTime per i.perValue``); each group
+        probes its own stitched-bucket surface. Timer rows and rows whose
+        values don't parse ride the first group (they only advance window
+        clocks — no probe of their own)."""
+        from siddhi_tpu.core.aggregation.incremental import parse_duration_name
+        from siddhi_tpu.core.aggregation.within_time import (
+            bound_ms, single_within_range)
+        from siddhi_tpu.ops.expressions import TYPE_KEY, VALID_KEY
+
+        per_of, within_of = self.dynamic
+        valid = np.asarray(cols[VALID_KEY])
+        is_timer = np.asarray(cols[TYPE_KEY]) == TIMER
+        n = len(valid)
+        pers = per_of(cols, ctx) if per_of is not None else None
+        wins = within_of(cols, ctx) if within_of is not None else None
+        groups: dict = {}
+        carry = []
+        for i in range(n):
+            if not valid[i]:
+                continue
+            if is_timer[i]:
+                carry.append(i)
+                continue
+            try:
+                dur = parse_duration_name(pers[i]) if pers is not None \
+                    else self.duration
+                if wins is not None:
+                    w = wins[i]
+                    if isinstance(w, tuple):
+                        win = (bound_ms(w[0]), bound_ms(w[1]))
+                        if not win[0] < win[1]:
+                            raise ValueError("within start must be < end")
+                    elif isinstance(w, str):
+                        win = single_within_range(w)
+                    else:
+                        win = (int(w), 2 ** 62)
+                else:
+                    win = self.within
+            except Exception as e:
+                # reference logs at the processor and drops the event
+                _LOG.warning("aggregation join: dropping trigger row with "
+                             "unresolvable within/per: %s", e)
+                continue
+            groups.setdefault((dur, win), []).append(i)
+        if not groups:
+            groups[(self.duration or parse_duration_name("seconds"),
+                    self.within)] = []
+        out = []
+        for gi, ((dur, win), idx) in enumerate(groups.items()):
+            mask = np.zeros(n, bool)
+            mask[idx] = True
+            if gi == 0:
+                mask[carry] = True
+            out.append((mask, dur, win))
+        return out
 
 
 class JoinResolver(Resolver):
@@ -507,19 +570,65 @@ class JoinQueryRuntime(QueryRuntime):
                 jitted = jax.jit(self.build_side_step_fn(side_key), donate_argnums=0)
                 self._steps[side_key] = jitted
             other = self.sides["right" if side_key == "left" else "left"]
-            if other.store is not None:
-                probe_cols, probe_valid = other.store.contents()
-            elif other.host_window is not None:
-                probe_cols, probe_valid = other.host_window.contents()
-            else:  # placeholders; the step reads its own state instead
-                probe_cols, probe_valid = {}, jnp.zeros((1,), bool)
+            _ovf_msg = ("join window capacity exceeded — raise "
+                        "app_context.window_capacity")
+            if (other.store is not None
+                    and getattr(other.store, "dynamic", None) is not None):
+                # per-event within/per: group trigger rows by their resolved
+                # (duration, within) and probe each group's stitched surface
+                now_h = int(self.app_context.timestamp_generator.current_time())
+                groups = other.store.resolve_groups(
+                    cols, {"xp": np, "current_time": now_h})
+                notify = None
+                base_valid = np.asarray(cols[VALID_KEY])
+                saved = (other.store.duration, other.store.within)
+                try:
+                    for mask, dur, win in groups:
+                        other.store.duration = dur
+                        other.store.within = win
+                        try:
+                            probe_cols, probe_valid = other.store.contents()
+                        except CompileError as e:
+                            _LOG.error("query '%s': %s — dropping trigger "
+                                       "events", self.name, e)
+                            continue
+                        sub = dict(cols)
+                        sub[VALID_KEY] = base_valid & mask
 
-            def call(st, cols, now):
-                return jitted(st, probe_cols, probe_valid, cols, now)
+                        def call(st, c, now, _pc=probe_cols, _pv=probe_valid):
+                            return jitted(st, _pc, _pv, c, now)
 
-            notify = self._finish_device_batch(
-                call, cols,
-                "join window capacity exceeded — raise app_context.window_capacity")
+                        n = self._finish_device_batch(call, sub, _ovf_msg)
+                        if n is not None:
+                            notify = n if notify is None else min(notify, n)
+                finally:
+                    # leave the planner-assigned static view on the shared
+                    # store — the per-event values must not outlive the batch
+                    other.store.duration, other.store.within = saved
+            else:
+                probe_ok = True
+                if other.store is not None:
+                    try:
+                        probe_cols, probe_valid = other.store.contents()
+                    except CompileError as e:
+                        # e.g. `per "days"` against a sec...hour aggregation:
+                        # the reference logs at the stream processor and
+                        # drops the event (Aggregation1TestCase test22) —
+                        # notify_host below must still be honored
+                        _LOG.error("query '%s': %s — dropping trigger "
+                                   "events", self.name, e)
+                        probe_ok = False
+                elif other.host_window is not None:
+                    probe_cols, probe_valid = other.host_window.contents()
+                else:  # placeholders; the step reads its own state instead
+                    probe_cols, probe_valid = {}, jnp.zeros((1,), bool)
+
+                notify = None
+                if probe_ok:
+                    def call(st, cols, now):
+                        return jitted(st, probe_cols, probe_valid, cols, now)
+
+                    notify = self._finish_device_batch(call, cols, _ovf_msg)
         if notify_host is not None:
             notify = notify_host if notify is None else min(notify, notify_host)
         if notify is not None and self.scheduler is not None:

@@ -51,19 +51,31 @@ def _aggregation_contents(agg, oq: OnDemandQuery, dictionary):
     within = None
     w = store.within
     if w is not None:
-        def _ms(x):
-            if isinstance(x, (Constant, TimeConstant)) and not isinstance(
-                getattr(x, "value", None), str
-            ):
-                return int(x.value)
-            raise CompileError(
-                "within bounds must be millisecond epoch constants "
-                "(string date patterns are not supported yet)")
+        from siddhi_tpu.core.aggregation.within_time import (
+            WithinFormatError, resolve_within_pair, single_within_range)
 
-        if isinstance(w, tuple):
-            within = (_ms(w[0]), _ms(w[1]))
-        else:
-            raise CompileError("within needs `start, end` bounds for aggregations")
+        def _const(x):
+            if isinstance(x, (Constant, TimeConstant)):
+                return x.value
+            raise CompileError(
+                "within bounds must be constants (unix ms or "
+                "'yyyy-MM-dd HH:mm:ss' date strings)")
+
+        try:
+            if isinstance(w, tuple):
+                within = resolve_within_pair(_const(w[0]), _const(w[1]))
+            elif isinstance(w, Constant) and isinstance(w.value, str):
+                # single wildcard pattern: the whole calendar unit it names
+                # (IncrementalStartTimeEndTimeFunctionExecutor.java:139-200)
+                within = single_within_range(w.value)
+            else:
+                # single-bound within must be a date-pattern STRING
+                # (startTimeEndTime single-arg validation)
+                raise CompileError(
+                    "a single within bound must be a date-pattern string "
+                    "('yyyy-MM-dd HH:mm:ss', '**' wildcards allowed)")
+        except WithinFormatError as e:
+            raise CompileError(str(e)) from None
 
     definition, cols, valid = agg.contents(duration, within)
     return definition, {k: jnp.asarray(v) for k, v in cols.items()}, jnp.asarray(valid)

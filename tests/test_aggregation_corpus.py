@@ -1,0 +1,944 @@
+"""Reference incremental-aggregation corpus — scenarios ported verbatim
+from ``aggregation/Aggregation1TestCase.java`` (feeds and expected
+outputs; sec…year cascades, wildcard/offset ``within`` date strings,
+per-event dynamic ``within``/``per`` on aggregation joins, string
+``aggregate by`` timestamps, and the validation-error battery)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.compiler.errors import (
+    SiddhiAppValidationException,
+    SiddhiParserException,
+)
+from siddhi_tpu.core.query.callback import QueryCallback
+from siddhi_tpu.ops.expressions import CompileError
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+STOCK = ("define stream stockStream (symbol string, price float, "
+         "lastClosingPrice float, volume long, quantity int, "
+         "timestamp long);")
+STOCK_STR_TS = STOCK.replace("timestamp long", "timestamp string")
+INPUT = ("define stream inputStream (symbol string, value int, "
+         "startTime string, endTime string, perValue string);")
+AGG = ("define aggregation stockAggregation from stockStream "
+       "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+       "(price * quantity) as lastTradeValue "
+       "group by symbol aggregate by timestamp every sec...hour;")
+
+FEED_6SEC = [
+    ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+    ["WSO2", 70.0, None, 40, 10, 1496289950000],
+    ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+    ["WSO2", 100.0, None, 200, 16, 1496289952000],
+    ["IBM", 100.0, None, 200, 26, 1496289954000],
+    ["IBM", 100.0, None, 200, 96, 1496289954000],
+]
+EXPECT_6SEC = [
+    (1496289950000, "WSO2", 60.0, 120.0, 700.0),
+    (1496289952000, "WSO2", 80.0, 160.0, 1600.0),
+    (1496289954000, "IBM", 100.0, 200.0, 9600.0),
+]
+
+
+def _feed(rt, rows, stream="stockStream"):
+    h = rt.get_input_handler(stream)
+    for r in rows:
+        h.send(list(r))
+
+
+# ------------------------------------------------------ creation corpus
+
+
+def test_creation_sec_to_min():
+    """incrementalStreamProcessorTest1 (:63-79): aggregate by attr every
+    sec ... min compiles."""
+    m = SiddhiManager()
+    m.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, "
+        "price float, volume int); "
+        "@info(name = 'query1') define aggregation stockAggregation "
+        "from stockStream select sum(price) as sumPrice "
+        "aggregate by arrival every sec ... min")
+    m.shutdown()
+
+
+def test_creation_no_by_attribute():
+    """incrementalStreamProcessorTest2 (:81-97): `aggregate every` without
+    an explicit time attribute compiles."""
+    m = SiddhiManager()
+    m.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, "
+        "price float, volume int); "
+        "define aggregation stockAggregation from stockStream "
+        "select sum(price) as sumPrice aggregate every sec ... min")
+    m.shutdown()
+
+
+def test_creation_group_by_lists():
+    """incrementalStreamProcessorTest3/4/15 (:99-136, :644-661): explicit
+    duration lists and multi-attribute group by compile."""
+    m = SiddhiManager()
+    m.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, "
+        "price float, volume int); "
+        "define aggregation a1 from stockStream "
+        "select sum(price) as sumPrice group by price "
+        "aggregate every sec, min, hour, day")
+    m.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, "
+        "price float, volume int); "
+        "define aggregation a2 from stockStream "
+        "select sum(price) as sumPrice group by price, volume "
+        "aggregate every sec, min, hour, day")
+    m.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, "
+        "price float, volume int); "
+        "define aggregation a3 from stockStream "
+        "select sum(price) as sumPrice group by price "
+        "aggregate every sec, hour, day")
+    m.shutdown()
+
+
+def test_creation_undefined_stream_rejected():
+    """incrementalStreamProcessorTest13 (:610-624): aggregation over an
+    undefined stream is a creation-time error."""
+    m = SiddhiManager()
+    with pytest.raises((CompileError, SiddhiAppValidationException)):
+        m.create_siddhi_app_runtime(
+            "@info(name = 'query1') define aggregation stockAggregation "
+            "from stockStream select sum(price) as sumPrice "
+            "aggregate by arrival every sec ... min")
+    m.shutdown()
+
+
+def test_creation_week_duration_rejected():
+    """incrementalStreamProcessorTest14 (:626-642): `every week` is not a
+    supported duration."""
+    m = SiddhiManager()
+    with pytest.raises((CompileError, SiddhiParserException)):
+        m.create_siddhi_app_runtime(
+            "define stream stockStream (arrival long, symbol string, "
+            "price float, volume int); "
+            "@info(name = 'query1') define aggregation stockAggregation "
+            "from stockStream select sum(price) as sumPrice "
+            "aggregate by arrival every week")
+    m.shutdown()
+
+
+def test_join_undefined_aggregation_rejected():
+    """incrementalStreamProcessorTest19 (:973-989): joining an undefined
+    aggregation is a creation-time error."""
+    m = SiddhiManager()
+    with pytest.raises((CompileError, SiddhiAppValidationException,
+                        SiddhiParserException)):
+        m.create_siddhi_app_runtime(
+            INPUT +
+            " @info(name = 'query1') "
+            "from inputStream as i join stockAggregation as s "
+            'within "2017-01-01 00:00:00", "2021-01-01 00:00:00" '
+            'per "months" select s.symbol, avgPrice, totalPrice '
+            "insert all events into outputStream;")
+    m.shutdown()
+
+
+# ----------------------------------------------- on-demand read corpus
+
+
+def test_on_demand_month_wildcard_within():
+    """incrementalStreamProcessorTest5 (:137-189): seconds buckets read
+    back with a month-wildcard within pattern."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate by timestamp every sec...hour;")
+    rt.start()
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+        ["WSO2", 100.0, None, 200, 16, 1496289952500],
+        ["IBM", 100.0, None, 200, 26, 1496289954000],
+        ["IBM", 100.0, None, 200, 96, 1496289954500],
+    ])
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds"')
+    got = sorted(tuple(e.data) for e in events)
+    assert got == sorted([
+        (1496289952000, "WSO2", 80.0, 160.0, 1600.0),
+        (1496289950000, "WSO2", 60.0, 120.0, 700.0),
+        (1496289954000, "IBM", 100.0, 200.0, 9600.0),
+    ])
+    m.shutdown()
+
+
+def test_on_demand_unsorted_match():
+    """incrementalStreamProcessorTest24 (:1084-1135): wildcard within,
+    results match as a set."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    _feed(rt, FEED_6SEC)
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds"')
+    assert sorted(tuple(e.data) for e in events) == sorted(EXPECT_6SEC)
+    m.shutdown()
+
+
+def test_on_demand_select_star_order_by():
+    """incrementalStreamProcessorTest25 (:1137-1199): `select * order by
+    AGG_TIMESTAMP` returns buckets in time order."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    _feed(rt, FEED_6SEC)
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds" '
+                      "select * order by AGG_TIMESTAMP ;")
+    assert [tuple(e.data) for e in events] == EXPECT_6SEC
+    m.shutdown()
+
+
+def test_on_demand_year_wildcard():
+    """incrementalStreamProcessorTest31 (:1409-1478): year-wildcard within
+    spans buckets months apart."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    _feed(rt, FEED_6SEC + [
+        ["CISCO", 100.0, None, 200, 26, 1513578087000],
+        ["CISCO", 100.0, None, 200, 96, 1513578087000],
+    ])
+    events = rt.query('from stockAggregation '
+                      'within "2017-**-** **:**:**" per "seconds" '
+                      "select * order by AGG_TIMESTAMP ;")
+    assert [tuple(e.data) for e in events] == EXPECT_6SEC + [
+        (1513578087000, "CISCO", 100.0, 200.0, 9600.0)]
+    m.shutdown()
+
+
+@pytest.mark.parametrize("within", [
+    '"2017-12-18 **:**:**"',            # test32: day range
+    '"2017-12-18 06:**:**"',            # test33: hour range
+    '"2017-12-18 06:21:**"',            # test34: minute range
+    '"2017-12-18 11:51:27 +05:30"',     # test35: full second, +05:30
+])
+def test_on_demand_narrowing_wildcards(within):
+    """incrementalStreamProcessorTest32-35 (:1480-1680): successively
+    narrower within patterns isolate the CISCO second-bucket."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    _feed(rt, FEED_6SEC + [
+        ["CISCO", 100.0, None, 200, 26, 1513578087000],
+        ["CISCO", 100.0, None, 200, 96, 1513578087000],
+    ])
+    events = rt.query(f'from stockAggregation within {within} '
+                      f'per "seconds" select * order by AGG_TIMESTAMP ;')
+    assert [tuple(e.data) for e in events] == [
+        (1513578087000, "CISCO", 100.0, 200.0, 9600.0)]
+    m.shutdown()
+
+
+def test_on_demand_wall_clock_on_condition():
+    """incrementalStreamProcessorTest11 (:429-484): `aggregate every`
+    without a by-attribute uses arrival wall-clock; read back with an
+    `on` filter and the current month's +05:30 pattern."""
+    from datetime import datetime, timedelta, timezone
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate every sec...hour;")
+    rt.start()
+    _feed(rt, FEED_6SEC)
+    now = datetime.now(timezone(timedelta(hours=5, minutes=30)))
+    events = rt.query(
+        'from stockAggregation on symbol == "IBM" '
+        f'within "{now.year}-{now.month:02d}-** **:**:** +05:30" '
+        'per "seconds"; ')
+    assert len(events) == 1
+    assert tuple(events[0].data)[1:] == ("IBM", 100.0, 200.0, 9600.0)
+    m.shutdown()
+
+
+def test_out_of_order_beyond_buffer_group_by():
+    """incrementalStreamProcessorTest45 (:2348-2397): out-of-order events
+    across group-by keys still land in their buckets (5 distinct
+    (second, symbol) windows)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, sum(price) as totalPrice "
+        "group by symbol aggregate by timestamp every sec...year ;")
+    rt.start()
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["IBM", 100.0, None, 200, 16, 1496289951011],
+        ["IBM", 400.0, None, 200, 9, 1496289952000],
+        ["IBM", 900.0, None, 200, 60, 1496289950000],
+        ["WSO2", 500.0, None, 200, 7, 1496289951011],
+        ["IBM", 100.0, None, 200, 26, 1496289953000],
+        ["WSO2", 100.0, None, 200, 96, 1496289953000],
+    ])
+    events = rt.query("from stockAggregation within 0L, 1496289953000L "
+                      "per 'seconds' select AGG_TIMESTAMP, symbol, "
+                      "totalPrice")
+    assert len(events) == 5
+    m.shutdown()
+
+
+# --------------------------------------------- on-demand error corpus
+
+
+def test_on_demand_undefined_aggregation():
+    """incrementalStreamProcessorTest20 (:991-1010): store query on an
+    undefined aggregation raises."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK)
+    rt.start()
+    with pytest.raises((CompileError, SiddhiAppValidationException)):
+        rt.query('from stockAggregation on symbol == "IBM" '
+                 'within "2017-**-** **:**:** +05:30" per "seconds"; ')
+    m.shutdown()
+
+
+def test_on_demand_unkept_granularity():
+    """incrementalStreamProcessorTest21 (:1013-1041): `per "days"` when
+    the aggregation keeps sec...hour raises."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    with pytest.raises(CompileError):
+        rt.query('from stockAggregation within "2017-06-** **:**:**" '
+                 'per "days"')
+    m.shutdown()
+
+
+def test_on_demand_non_string_per():
+    """incrementalStreamProcessorTest27 (:1296-1326): numeric `per`
+    raises."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    with pytest.raises(CompileError):
+        rt.query('from stockAggregation within "2017-06-** **:**:**" '
+                 "per 1000")
+    m.shutdown()
+
+
+def test_on_demand_start_after_end():
+    """incrementalStreamProcessorTest28 (:1328-1358): within start must be
+    before end."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    with pytest.raises(CompileError):
+        rt.query('from stockAggregation within "2017-06-02 00:00:00", '
+                 '"2017-06-01 00:00:00" per "hours"')
+    m.shutdown()
+
+
+def test_on_demand_bad_patterns():
+    """incrementalStreamProcessorTest29/30 (:1360-1407): malformed within
+    patterns raise (extra field; hour given under a day wildcard)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    with pytest.raises(CompileError):
+        rt.query('from stockAggregation within '
+                 '"2017-06-** **:**:**:1000" per "hours"')
+    with pytest.raises(CompileError):
+        rt.query('from stockAggregation within "2017-06-** 12:**:**" '
+                 'per "hours"')
+    m.shutdown()
+
+
+def test_on_demand_single_numeric_within():
+    """incrementalStreamProcessorTest36 (:1682-1712): a single numeric
+    within bound is rejected (must be a date-pattern string)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    with pytest.raises(CompileError):
+        rt.query('from stockAggregation within 1513578087000L '
+                 'per "hours"')
+    m.shutdown()
+
+
+def test_on_demand_mixed_bounds_start_after_end():
+    """incrementalStreamProcessorTest37 (:1714-1744): date-string start
+    with a tiny numeric end -> start >= end raises."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    with pytest.raises(CompileError):
+        rt.query('from stockAggregation within '
+                 '"2017-12-18 11:51:27 +05:30", 156 per "hours"')
+    m.shutdown()
+
+
+def test_repeated_reads_same_runtime():
+    """incrementalStreamProcessorTest44 (:2293-2345): back-to-back
+    on-demand reads at different granularities both work (parsed-runtime
+    cache safety)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue, count() as count "
+        "aggregate by timestamp every sec...year ;")
+    rt.start()
+    _feed(rt, FEED_6SEC)
+    e1 = rt.query("from stockAggregation within 1496289949000L, "
+                  "1496289950001L per 'hours' "
+                  "select AGG_TIMESTAMP, avgPrice")
+    e2 = rt.query("from stockAggregation within 1496289949000L, "
+                  "1496289950001L per 'days' "
+                  "select AGG_TIMESTAMP, avgPrice")
+    assert len(e1) == 1 and len(e2) == 1
+    m.shutdown()
+
+
+# --------------------------------------------------------- join corpus
+
+
+def _join_collect(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    rt.start()
+    return m, rt, q
+
+
+def test_join_dynamic_string_bounds():
+    """incrementalStreamProcessorTest6 (:190-298): per-event
+    `within i.startTime, i.endTime per i.perValue` date strings."""
+    m, rt, q = _join_collect(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate by timestamp every sec...year ; "
+        + INPUT +
+        " @info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        "within i.startTime, i.endTime per i.perValue "
+        "select AGG_TIMESTAMP, s.symbol, avgPrice, totalPrice as sumPrice, "
+        "lastTradeValue order by AGG_TIMESTAMP "
+        "insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["IBM", 100.0, None, 200, 26, 1496289951000],
+        ["IBM", 100.0, None, 200, 96, 1496289951000],
+        ["IBM", 900.0, None, 200, 60, 1496289952000],
+        ["IBM", 500.0, None, 200, 7, 1496289952000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289953000],
+        ["WSO2", 100.0, None, 200, 16, 1496289953000],
+        ["IBM", 400.0, None, 200, 9, 1496289953000],
+        ["WSO2", 140.0, None, 200, 11, 1496289953000],
+        ["IBM", 600.0, None, 200, 6, 1496289954000],
+        ["IBM", 1000.0, None, 200, 9, 1496290016000],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 04:05:50", "2017-06-01 04:06:57", "seconds"])
+    assert [tuple(e.data) for e in q.events] == [
+        (1496289950000, "WSO2", 60.0, 240.0, 700.0),
+        (1496289951000, "IBM", 100.0, 200.0, 9600.0),
+        (1496289952000, "IBM", 700.0, 1400.0, 3500.0),
+        (1496289953000, "WSO2", 100.0, 300.0, 1540.0),
+        (1496289953000, "IBM", 400.0, 400.0, 3600.0),
+        (1496289954000, "IBM", 600.0, 600.0, 3600.0),
+        (1496290016000, "IBM", 1000.0, 1000.0, 9000.0),
+    ]
+    m.shutdown()
+
+
+def test_join_dynamic_long_bounds():
+    """incrementalStreamProcessorTest26 (:1201-1294): per-event unix-ms
+    long within bounds on the trigger event."""
+    m, rt, q = _join_collect(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate by timestamp every sec...year ; "
+        "define stream inputStream (symbol string, value int, "
+        "startTime long, endTime long, perValue string); "
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        "within i.startTime, i.endTime per i.perValue "
+        "select AGG_TIMESTAMP, s.symbol, avgPrice, totalPrice as sumPrice, "
+        "lastTradeValue insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["IBM", 100.0, None, 200, 26, 1496289951000],
+        ["IBM", 100.0, None, 200, 96, 1496289951000],
+        ["IBM", 900.0, None, 200, 60, 1496289952000],
+        ["IBM", 500.0, None, 200, 7, 1496289952000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289953000],
+        ["WSO2", 100.0, None, 200, 16, 1496289953000],
+        ["IBM", 400.0, None, 200, 9, 1496289953000],
+        ["WSO2", 140.0, None, 200, 11, 1496289953000],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, 1496289951000, 1496289952001, "seconds"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        (1496289951000, "IBM", 100.0, 200.0, 9600.0),
+        (1496289952000, "IBM", 700.0, 1400.0, 3500.0),
+    ])
+    m.shutdown()
+
+
+def test_join_static_long_bounds_days():
+    """incrementalStreamProcessorTest9 (:300-427): static long within over
+    day buckets with count()."""
+    m, rt, q = _join_collect(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue, count() as count "
+        "aggregate by timestamp every min, day, year ; "
+        + INPUT +
+        " @info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596434876000L per "days" '
+        "select AGG_TIMESTAMP, s.avgPrice, totalPrice, lastTradeValue, "
+        "count order by AGG_TIMESTAMP "
+        "insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+        ["WSO2", 100.0, None, 200, 16, 1496289952000],
+        ["IBM", 100.0, None, 200, 26, 1496289954000],
+        ["IBM", 100.0, None, 200, 96, 1496289954000],
+        ["IBM", 900.0, None, 200, 60, 1496289956000],
+        ["IBM", 500.0, None, 200, 7, 1496289956000],
+        ["IBM", 400.0, None, 200, 9, 1496290016000],
+        ["IBM", 600.0, None, 200, 6, 1496290076000],
+        ["CISCO", 700.0, None, 200, 20, 1496293676000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496297276000],
+        ["CISCO", 800.0, None, 100, 10, 1496383676000],
+        ["CISCO", 900.0, None, 100, 15, 1496470076000],
+        ["IBM", 100.0, None, 200, 96, 1499062076000],
+        ["IBM", 400.0, None, 200, 9, 1501740476000],
+        ["WSO2", 60.0, 44.0, 200, 6, 1533276476000],
+        ["WSO2", 260.0, 44.0, 200, 16, 1564812476000],
+        ["CISCO", 260.0, 44.0, 200, 16, 1596434876000],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "seconds"])
+    assert [tuple(e.data) for e in q.events] == [
+        (1496275200000, 303.3333333333333, 3640.0, 3360.0, 12),
+        (1496361600000, 800.0, 800.0, 8000.0, 1),
+        (1496448000000, 900.0, 900.0, 13500.0, 1),
+        (1499040000000, 100.0, 100.0, 9600.0, 1),
+        (1501718400000, 400.0, 400.0, 3600.0, 1),
+        (1533254400000, 60.0, 60.0, 360.0, 1),
+        (1564790400000, 260.0, 260.0, 4160.0, 1),
+        (1596412800000, 260.0, 260.0, 4160.0, 1),
+    ]
+    m.shutdown()
+
+
+def test_join_static_string_bounds_chained():
+    """incrementalStreamProcessorTest12 (:486-608): GMT date-string static
+    within, min/max aggregators, output chained through tempStream; ties
+    at one AGG_TIMESTAMP may arrive in either side order."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue, max(price) as maxPrice, "
+        "min(price) as minPrice "
+        "group by symbol aggregate by timestamp every sec...year ; "
+        + INPUT +
+        " from inputStream as i join stockAggregation as s "
+        'within "2017-06-01 04:05:50", "2017-06-01 04:06:57" '
+        'per "seconds" '
+        "select AGG_TIMESTAMP, totalPrice, avgPrice, lastTradeValue, "
+        "s.symbol, maxPrice, minPrice order by AGG_TIMESTAMP "
+        "insert into tempStream; "
+        "@info(name = 'query1') from tempStream "
+        "select AGG_TIMESTAMP, totalPrice, avgPrice, lastTradeValue, "
+        "symbol, maxPrice, minPrice insert into outputStream ")
+    q = QCollect()
+    rt.add_callback("query1", q)
+    rt.start()
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289953000],
+        ["WSO2", 100.0, None, 200, 16, 1496289953000],
+        ["IBM", 900.0, None, 200, 60, 1496289952000],
+        ["IBM", 500.0, None, 200, 7, 1496289952000],
+        ["IBM", 100.0, None, 200, 26, 1496289951000],
+        ["IBM", 100.0, None, 200, 96, 1496289951000],
+        ["IBM", 400.0, None, 200, 9, 1496289953000],
+        ["WSO2", 140.0, None, 200, 11, 1496289953000],
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["IBM", 600.0, None, 200, 6, 1496289954000],
+        ["IBM", 1000.0, None, 200, 9, 1496290016000],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "seconds"])
+    got = [tuple(e.data) for e in q.events]
+    expected1 = [
+        (1496289950000, 240.0, 60.0, 700.0, "WSO2", 70.0, 50.0),
+        (1496289951000, 200.0, 100.0, 9600.0, "IBM", 100.0, 100.0),
+        (1496289952000, 1400.0, 700.0, 3500.0, "IBM", 900.0, 500.0),
+        (1496289953000, 400.0, 400.0, 3600.0, "IBM", 400.0, 400.0),
+        (1496289953000, 300.0, 100.0, 1540.0, "WSO2", 140.0, 60.0),
+        (1496289954000, 600.0, 600.0, 3600.0, "IBM", 600.0, 600.0),
+        (1496290016000, 1000.0, 1000.0, 9000.0, "IBM", 1000.0, 1000.0),
+    ]
+    expected2 = [expected1[0], expected1[1], expected1[2], expected1[4],
+                 expected1[3], expected1[5], expected1[6]]
+    assert got in (expected1, expected2)
+    m.shutdown()
+
+
+def test_join_months_granularity():
+    """incrementalStreamProcessorTest17 (:704-838): months buckets are
+    calendar-truncated; out-of-order feeds merge."""
+    m, rt, q = _join_collect(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate by timestamp every sec...year; "
+        + INPUT +
+        " @info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within "2017-01-01 00:00:00", "2021-01-01 00:00:00" '
+        'per "months" '
+        "select AGG_TIMESTAMP, s.symbol, avgPrice, totalPrice "
+        "order by AGG_TIMESTAMP insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+        ["WSO2", 100.0, None, 200, 16, 1496289952000],
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["IBM", 100.0, None, 200, 26, 1496289954000],
+        ["IBM", 100.0, None, 200, 96, 1496289954000],
+        ["IBM", 900.0, None, 200, 60, 1496289956000],
+        ["IBM", 500.0, None, 200, 7, 1496289956000],
+        ["IBM", 400.0, None, 200, 9, 1496290016000],
+        ["IBM", 600.0, None, 200, 6, 1496290076000],
+        ["CISCO", 700.0, None, 200, 20, 1496293676000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496297276000],
+        ["CISCO", 800.0, None, 100, 10, 1496383676000],
+        ["CISCO", 900.0, None, 100, 15, 1496470076000],
+        ["IBM", 100.0, None, 200, 96, 1499062076000],
+        ["IBM", 400.0, None, 200, 9, 1501740476000],
+        ["WSO2", 60.0, 44.0, 200, 6, 1533276476000],
+        ["WSO2", 260.0, 44.0, 200, 16, 1564812476000],
+        ["CISCO", 260.0, 44.0, 200, 16, 1596434876000],
+        ["CISCO", 260.0, 44.0, 200, 16, 1606975676000],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "seconds"])
+    got = [tuple(e.data) for e in q.events]
+    assert len(got) == 9
+    assert sorted(got) == sorted([
+        (1496275200000, "WSO2", 65.71428571428571, 460.0),
+        (1496275200000, "CISCO", 800.0, 2400.0),
+        (1496275200000, "IBM", 433.3333333333333, 2600.0),
+        (1498867200000, "IBM", 100.0, 100.0),
+        (1501545600000, "IBM", 400.0, 400.0),
+        (1533081600000, "WSO2", 60.0, 60.0),
+        (1564617600000, "WSO2", 260.0, 260.0),
+        (1596240000000, "CISCO", 260.0, 260.0),
+        (1606780800000, "CISCO", 260.0, 260.0),
+    ])
+    m.shutdown()
+
+
+def test_join_years_granularity():
+    """incrementalStreamProcessorTest18 (:840-971): years buckets."""
+    m, rt, q = _join_collect(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate by timestamp every sec...year; "
+        + INPUT +
+        " @info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within "2017-01-01 00:00:00", "2021-01-01 00:00:00" '
+        'per "years" '
+        "select AGG_TIMESTAMP, s.symbol, avgPrice, totalPrice "
+        "order by AGG_TIMESTAMP insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+        ["WSO2", 100.0, None, 200, 16, 1496289952000],
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["IBM", 100.0, None, 200, 26, 1496289954000],
+        ["IBM", 100.0, None, 200, 96, 1496289954000],
+        ["IBM", 900.0, None, 200, 60, 1496289956000],
+        ["IBM", 500.0, None, 200, 7, 1496289956000],
+        ["IBM", 400.0, None, 200, 9, 1496290016000],
+        ["IBM", 600.0, None, 200, 6, 1496290076000],
+        ["CISCO", 700.0, None, 200, 20, 1496293676000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496297276000],
+        ["CISCO", 800.0, None, 100, 10, 1496383676000],
+        ["CISCO", 900.0, None, 100, 15, 1496470076000],
+        ["IBM", 100.0, None, 200, 96, 1499062076000],
+        ["IBM", 400.0, None, 200, 9, 1501740476000],
+        ["WSO2", 60.0, 44.0, 200, 6, 1533276476000],
+        ["WSO2", 260.0, 44.0, 200, 16, 1564812476000],
+        ["CISCO", 260.0, 44.0, 200, 16, 1596434876000],
+        ["CISCO", 260.0, 44.0, 200, 16, 1606975676000],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "seconds"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        (1483228800000, "CISCO", 800.0, 2400.0),
+        (1483228800000, "IBM", 387.5, 3100.0),
+        (1483228800000, "WSO2", 65.71428571428571, 460.0),
+        (1514764800000, "WSO2", 60.0, 60.0),
+        (1546300800000, "WSO2", 260.0, 260.0),
+        (1577836800000, "CISCO", 260.0, 520.0),
+    ])
+    m.shutdown()
+
+
+def test_join_minute_wildcard_count():
+    """incrementalStreamProcessorTest41 (:2005-2101): minute-wildcard
+    within isolates five second-buckets with counts."""
+    m, rt, q = _join_collect(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue, count() as count "
+        "aggregate by timestamp every sec...year ; "
+        + INPUT +
+        " @info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within "2017-06-01 04:05:**" per "seconds" '
+        "select AGG_TIMESTAMP, s.avgPrice, totalPrice, lastTradeValue, "
+        "count order by AGG_TIMESTAMP "
+        "insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289949000],
+        ["WSO2", 100.0, None, 200, 16, 1496289949000],
+        ["IBM", 100.0, None, 200, 26, 1496289948000],
+        ["IBM", 100.0, None, 200, 96, 1496289948000],
+        ["IBM", 900.0, None, 200, 60, 1496289947000],
+        ["IBM", 500.0, None, 200, 7, 1496289947000],
+        ["IBM", 400.0, None, 200, 9, 1496289946000],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "seconds"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        (1496289946000, 400.0, 400.0, 3600.0, 1),
+        (1496289947000, 700.0, 1400.0, 3500.0, 2),
+        (1496289948000, 100.0, 200.0, 9600.0, 2),
+        (1496289949000, 80.0, 160.0, 1600.0, 2),
+        (1496289950000, 60.0, 120.0, 700.0, 2),
+    ])
+    m.shutdown()
+
+
+def test_join_unkept_granularity_drops_event():
+    """incrementalStreamProcessorTest22 (:1043-1082): `per "days"` against
+    a sec...hour aggregation logs at the processor and DROPS the trigger
+    event — no exception escapes send, no output."""
+    m, rt, q = _join_collect(
+        STOCK + AGG + INPUT +
+        " @info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within "2017-06-** **:**:**" per "days" '
+        "select s.symbol, avgPrice, totalPrice as sumPrice, lastTradeValue "
+        "insert all events into outputStream; ")
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "seconds"])
+    assert q.events == []
+    m.shutdown()
+
+
+# ------------------------------------- string aggregate-by timestamps
+
+
+def test_string_timestamp_bad_format_dropped():
+    """incrementalStreamProcessorTest16 (:663-702): a non-ISO date string
+    in `aggregate by` drops the event with a log, no exception."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK_STR_TS +
+        " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate by timestamp every sec...year ; ")
+    rt.start()
+    rt.get_input_handler("stockStream").send(
+        ["WSO2", 50.0, 60.0, 90, 6, "June 1, 2017 4:05:50 AM"])
+    # dropped: nothing aggregated
+    events = rt.query('from stockAggregation '
+                      'within "2017-**-** **:**:**" per "seconds"')
+    assert list(events) == []
+    m.shutdown()
+
+
+def test_string_timestamp_out_of_order():
+    """incrementalStreamProcessorTest39 (:1841-1962): GMT date-string
+    aggregate-by with out-of-order arrivals; ten second-buckets."""
+    m, rt, q = _join_collect(
+        STOCK_STR_TS +
+        " define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "aggregate by timestamp every sec...year; "
+        + INPUT +
+        " @info(name = 'query1') from inputStream join stockAggregation "
+        'within "2017-06-01 04:05:49", "2017-06-01 05:07:57" '
+        'per "seconds" '
+        "select AGG_TIMESTAMP, avgPrice, totalPrice as sumPrice, "
+        "lastTradeValue order by AGG_TIMESTAMP "
+        "insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:51"],
+        ["WSO2", 60.0, 44.0, 200, 56, "2017-06-01 04:05:47"],
+        ["WSO2", 60.0, 44.0, 200, 56, "2017-06-01 04:05:49"],
+        ["WSO2", 100.0, None, 200, 16, "2017-06-01 04:05:52"],
+        ["WSO2", 70.0, None, 40, 10, "2017-06-01 04:05:50"],
+        ["IBM", 100.0, None, 200, 26, "2017-06-01 04:05:53"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 100.0, None, 200, 96, "2017-06-01 04:05:54"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 900.0, None, 200, 60, "2017-06-01 04:05:56"],
+        ["IBM", 500.0, None, 200, 7, "2017-06-01 04:05:56"],
+        ["IBM", 400.0, None, 200, 9, "2017-06-01 04:06:56"],
+        ["IBM", 600.0, None, 200, 6, "2017-06-01 04:07:56"],
+        ["IBM", 700.0, None, 200, 20, "2017-06-01 05:07:56"],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "seconds"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        (1496289949000, 60.0, 60.0, 3360.0),
+        (1496289950000, 55.0, 220.0, 300.0),
+        (1496289951000, 50.0, 50.0, 300.0),
+        (1496289952000, 100.0, 100.0, 1600.0),
+        (1496289953000, 100.0, 100.0, 2600.0),
+        (1496289954000, 100.0, 100.0, 9600.0),
+        (1496289956000, 700.0, 1400.0, 3500.0),
+        (1496290016000, 400.0, 400.0, 3600.0),
+        (1496290076000, 600.0, 600.0, 3600.0),
+        (1496293676000, 700.0, 700.0, 14000.0),
+    ])
+    m.shutdown()
+
+
+def test_string_timestamp_offset_bounds_minutes():
+    """incrementalStreamProcessorTest38 (:1746-1839): +05:30 static string
+    bounds, bare-variable `per perValue`, minute buckets."""
+    m, rt, q = _join_collect(
+        STOCK_STR_TS +
+        " define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "aggregate by timestamp every sec...year; "
+        + INPUT +
+        " @info(name = 'query1') from inputStream join stockAggregation "
+        'within "2017-06-01 09:35:00 +05:30", "2017-06-01 10:37:57 +05:30" '
+        "per perValue "
+        "select AGG_TIMESTAMP, avgPrice, totalPrice as sumPrice, "
+        "lastTradeValue insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:51"],
+        ["WSO2", 60.0, 44.0, 200, 56, "2017-06-01 04:05:52"],
+        ["WSO2", 100.0, None, 200, 16, "2017-06-01 04:05:52"],
+        ["WSO2", 70.0, None, 40, 10, "2017-06-01 04:05:50"],
+        ["IBM", 100.0, None, 200, 26, "2017-06-01 04:05:54"],
+        ["IBM", 100.0, None, 200, 96, "2017-06-01 04:05:54"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 900.0, None, 200, 60, "2017-06-01 04:05:56"],
+        ["IBM", 500.0, None, 200, 7, "2017-06-01 04:05:56"],
+        ["IBM", 400.0, None, 200, 9, "2017-06-01 04:06:56"],
+        ["IBM", 600.0, None, 200, 6, "2017-06-01 04:07:56"],
+        ["IBM", 700.0, None, 200, 20, "2017-06-01 05:07:56"],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "minutes"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        (1496289900000, 198.0, 1980.0, 3500.0),
+        (1496289960000, 400.0, 400.0, 3600.0),
+        (1496293620000, 700.0, 700.0, 14000.0),
+        (1496290020000, 600.0, 600.0, 3600.0),
+    ])
+    m.shutdown()
+
+
+def test_string_timestamp_mixed_timezones_dynamic():
+    """incrementalStreamProcessorTest46 (:2400-2502): mixed-offset event
+    dates, bare-variable dynamic within/per, month buckets."""
+    m, rt, q = _join_collect(
+        STOCK_STR_TS +
+        " define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "aggregate by timestamp every sec...year; "
+        + INPUT +
+        " @info(name = 'query1') from inputStream join stockAggregation "
+        "within startTime, endTime per perValue "
+        "select AGG_TIMESTAMP, avgPrice, totalPrice as sumPrice "
+        "insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, "2017-06-01 04:35:49 +05:30"],
+        ["WSO2", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:51"],
+        ["WSO2", 60.0, 44.0, 200, 56, "2017-06-01 04:05:52"],
+        ["WSO2", 100.0, None, 200, 16, "2017-06-01 04:05:52"],
+        ["IBM", 100.0, None, 200, 26, "2017-06-01 04:05:54"],
+        ["IBM", 100.0, None, 200, 96, "2017-06-01 04:05:54"],
+        ["IBM", 900.0, None, 200, 60, "2017-06-01 04:05:56"],
+        ["IBM", 500.0, None, 200, 7, "2017-06-01 04:05:56"],
+        ["IBM", 400.0, None, 200, 9, "2017-06-01 04:06:56"],
+        ["IBM", 600.0, None, 200, 6, "2017-06-01 09:36:58 +05:30"],
+        ["IBM", 600.0, None, 200, 6, "2017-06-01 04:07:56 +05:30"],
+        ["IBM", 700.0, None, 200, 20, "2017-06-01 11:07:56 +05:30"],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2016-05-30 08:35:51 +05:30",
+         "2018-06-02 10:35:52 +05:30", "months"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        (1493596800000, 325.0, 650.0),
+        (1496275200000, 323.6363636363636, 3560.0),
+    ])
+    m.shutdown()
