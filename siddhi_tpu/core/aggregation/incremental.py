@@ -74,7 +74,7 @@ _TIME_UNITS_MS = {
     "ms": 1, "millisecond": 1, "milliseconds": 1,
     "sec": 1000, "second": 1000, "seconds": 1000,
     "min": 60_000, "minute": 60_000, "minutes": 60_000,
-    "hour": 3600_000, "hours": 3600_000,
+    "h": 3600_000, "hour": 3600_000, "hours": 3600_000,
     "day": 86_400_000, "days": 86_400_000,
     "month": 31 * 86_400_000, "months": 31 * 86_400_000,
     "year": 366 * 86_400_000, "years": 366 * 86_400_000,
@@ -129,7 +129,16 @@ class _BaseSpec:
         if self.kind == "distinct":
             return a | b          # sets of observed values
         if self.kind == "last":
-            return b              # latest arrival wins (bare selections)
+            # bare selections keep the value of the LATEST event TIME in
+            # the bucket — an out-of-order arrival with an older timestamp
+            # must not displace it (LatestAggregationTestCase test1);
+            # slots are (event_ts, value) pairs. Bare values from snapshots
+            # or shard blobs written before the pair layout sort oldest.
+            if not isinstance(a, tuple):
+                a = (-2 ** 62, a)
+            if not isinstance(b, tuple):
+                b = (-2 ** 62, b)
+            return b if b[0] >= a[0] else a
         return min(a, b) if self.kind == "min" else max(a, b)
 
 
@@ -295,16 +304,25 @@ class IncrementalAggregationRuntime(Receiver):
         # only its shard's events; rows are tagged so a reader can stitch
         # shards (reference AggregationParser.java:171-197 shardId columns)
         pbi = find_annotation(definition.annotations or [], "PartitionById")
-        self.shard_mode = pbi is not None and (
+        cm = getattr(app_context.siddhi_context, "config_manager", None)
+        ann_enabled = pbi is not None and (
             (pbi.element("enable") or "true").lower() == "true")
+        sys_enabled = ((cm.get_property("partitionById") or "")
+                       if cm is not None else "").lower() == "true"
+        # the `partitionById` system property enables shard mode even when
+        # the annotation disables it (Aggregation2TestCase test55/56)
+        self.shard_mode = ann_enabled or sys_enabled
         self.shard_id = None
         if self.shard_mode:
-            # the reference requires a configured shardId
-            # (AggregationParser.java:173-186); we fall back to node_id/0
-            cm = getattr(app_context.siddhi_context, "config_manager", None)
             cfg = cm.get_property("shardId") if cm is not None else None
-            self.shard_id = (cfg or getattr(app_context, "node_id", None)
-                             or "0")
+            if not cfg:
+                # the reference requires a configured shardId
+                # (AggregationParser.java:173-186; Aggregation2TestCase
+                # test52/53 expect creation to fail without one)
+                raise CompileError(
+                    f"aggregation '{definition.id}': @PartitionById needs a "
+                    f"configured 'shardId' property")
+            self.shard_id = cfg
 
     def purge(self, now: Optional[int] = None) -> int:
         """Drop buckets older than each duration's retention; returns the
@@ -450,8 +468,11 @@ class IncrementalAggregationRuntime(Receiver):
                             continue  # null arg leaves the base untouched
                         spec = self.bases[k]
                         v = base_vals[k][i].item()
-                        slot[j] = spec.fold(slot[j],
-                                            {v} if spec.kind == "distinct" else v)
+                        if spec.kind == "distinct":
+                            v = {v}
+                        elif spec.kind == "last":
+                            v = (int(tsv[i]), v)   # event-time-tagged
+                        slot[j] = spec.fold(slot[j], v)
 
     # -------------------------------------------------------------- query
 
@@ -506,6 +527,10 @@ class IncrementalAggregationRuntime(Receiver):
                         elif o.kind == "distinctcount":
                             s = by_key[o.bases[0]]
                             row.append(len(s) if s else 0)
+                        elif o.kind == "last":
+                            v = by_key[o.bases[0]]  # (event_ts, value) pair
+                            # bare pre-pair-layout snapshot values pass through
+                            row.append(v[1] if isinstance(v, tuple) else v)
                         else:
                             row.append(by_key[o.bases[0]])  # None -> null output
                     onames = {o.name for o in self.outputs}

@@ -253,7 +253,10 @@ class SelectorPlan:
             scalar_ov = out.pop("__overflow__", None)  # 0-d: not row-shaped
             keys = []
             for col, desc in reversed(self.order_by):
-                k = out[col]
+                # order-by may name a non-projected INPUT column (reference
+                # `order by AGG_TIMESTAMP` without selecting it) — input
+                # rows are index-aligned with the outputs
+                k = out[col] if col in out else cols[col]
                 if k.dtype == jnp.bool_:
                     k = k.astype(jnp.int32)
                 keys.append(-k if desc else k)

@@ -942,3 +942,537 @@ def test_string_timestamp_mixed_timezones_dynamic():
         (1496275200000, 323.6363636363636, 3560.0),
     ])
     m.shutdown()
+
+
+# ---------------------------------------- Aggregation2TestCase corpus
+
+
+def test_minutes_granularity_long_bounds():
+    """incrementalStreamProcessorTest47 (Aggregation2TestCase:62-131):
+    minute buckets folded across out-of-order seconds."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, sum(price) as totalPrice, avg(price) as avgPrice "
+        "group by symbol aggregate by timestamp every sec...year ;")
+    rt.start()
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["IBM", 100.0, None, 200, 16, 1496289951011],
+        ["IBM", 400.0, None, 200, 9, 1496289952000],
+        ["IBM", 900.0, None, 200, 60, 1496289950000],
+        ["WSO2", 500.0, None, 200, 7, 1496289951011],
+        ["IBM", 100.0, None, 200, 26, 1496289953000],
+        ["WSO2", 100.0, None, 200, 96, 1496289953000],
+    ])
+    events = rt.query("from stockAggregation within 0L, 1543664151000L per "
+                      "'minutes' select AGG_TIMESTAMP, symbol, totalPrice, "
+                      "avgPrice ")
+    assert sorted(tuple(e.data) for e in events) == sorted([
+        (1496289900000, "WSO2", 650.0, 216.66666666666666),
+        (1496289900000, "IBM", 1500.0, 375.0),
+    ])
+    m.shutdown()
+
+
+def test_seconds_granularity_long_bounds():
+    """incrementalStreamProcessorTest48 (Aggregation2TestCase:132-199):
+    seven second-buckets."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, sum(price) as totalPrice "
+        "group by symbol aggregate by timestamp every sec...year ;")
+    rt.start()
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["IBM", 100.0, None, 200, 16, 1496289951011],
+        ["IBM", 400.0, None, 200, 9, 1496289952000],
+        ["IBM", 900.0, None, 200, 60, 1496289950000],
+        ["WSO2", 500.0, None, 200, 7, 1496289951011],
+        ["IBM", 100.0, None, 200, 26, 1496289953000],
+        ["WSO2", 100.0, None, 200, 96, 1496289953000],
+    ])
+    events = rt.query("from stockAggregation within 0L, 1543664151000L per "
+                      "'seconds' select AGG_TIMESTAMP, symbol, totalPrice ")
+    assert sorted(tuple(e.data) for e in events) == sorted([
+        (1496289950000, "WSO2", 50.0),
+        (1496289950000, "IBM", 900.0),
+        (1496289951000, "IBM", 100.0),
+        (1496289951000, "WSO2", 500.0),
+        (1496289952000, "IBM", 400.0),
+        (1496289953000, "IBM", 100.0),
+        (1496289953000, "WSO2", 100.0),
+    ])
+    m.shutdown()
+
+
+def test_single_dynamic_wildcard_bound():
+    """incrementalStreamProcessorTest49 (Aggregation2TestCase:200-303):
+    wall-clock aggregation read back through a join whose single within
+    bound is a per-event year-wildcard pattern, per "years"."""
+    from datetime import datetime, timezone
+
+    m, rt, q = _join_collect(
+        STOCK_STR_TS +
+        " define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue aggregate every sec...year; "
+        + INPUT +
+        " @info(name = 'query1') from inputStream join stockAggregation "
+        "within startTime per perValue "
+        "select avgPrice, totalPrice as sumPrice, lastTradeValue "
+        "insert all events into outputStream; ")
+    # timestamp attr is unused (`aggregate every` = arrival wall clock)
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, "x"],
+        ["IBM", 50.0, 60.0, 90, 6, "x"],
+        ["WSO2", 60.0, 44.0, 200, 56, "x"],
+        ["WSO2", 100.0, None, 200, 16, "x"],
+        ["WSO2", 70.0, None, 40, 10, "x"],
+        ["IBM", 100.0, None, 200, 26, "x"],
+        ["IBM", 100.0, None, 200, 96, "x"],
+        ["IBM", 50.0, 60.0, 90, 6, "x"],
+        ["IBM", 900.0, None, 200, 60, "x"],
+        ["IBM", 500.0, None, 200, 7, "x"],
+        ["IBM", 400.0, None, 200, 9, "x"],
+        ["IBM", 600.0, None, 200, 6, "x"],
+        ["IBM", 700.0, None, 200, 20, "x"],
+    ])
+    year = datetime.now(timezone.utc).year
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, f"{year}-**-** **:**:**", "unused", "years"])
+    assert [tuple(e.data) for e in q.events] == [
+        (283.0769230769231, 3680.0, 14000.0)]
+    m.shutdown()
+
+
+def test_on_demand_needs_per():
+    """incrementalStreamProcessorTest50 (Aggregation2TestCase:304-329):
+    a store query without within/per raises."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    rt.start()
+    with pytest.raises(CompileError):
+        rt.query("from stockAggregation  select * ")
+    m.shutdown()
+
+
+def test_repeated_identical_reads_match():
+    """incrementalStreamProcessorTest51 (Aggregation2TestCase:330-444):
+    the same read twice (join and on-demand) returns identical rows."""
+    m, rt, q = _join_collect(
+        "define stream stockStream (symbol string, price float, "
+        "lastClosingPrice float, volume long, quantity int); "
+        "define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue aggregate every sec...year; "
+        "define stream inputStream (symbol string, value int, "
+        "startTime long, endTime long, perValue string); "
+        "@info(name = 'query1') from inputStream join stockAggregation "
+        "within startTime, endTime per perValue "
+        "select AGG_TIMESTAMP, avgPrice, totalPrice as sumPrice "
+        "insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6],
+        ["WSO2", 50.0, 60.0, 90, 6],
+        ["IBM", 50.0, 60.0, 90, 6],
+        ["WSO2", 60.0, 44.0, 200, 56],
+        ["WSO2", 100.0, None, 200, 16],
+        ["IBM", 100.0, None, 200, 26],
+        ["IBM", 100.0, None, 200, 96],
+        ["IBM", 900.0, None, 200, 60],
+        ["IBM", 500.0, None, 200, 7],
+        ["IBM", 400.0, None, 200, 9],
+        ["IBM", 600.0, None, 200, 6],
+        ["IBM", 600.0, None, 200, 6],
+        ["IBM", 700.0, None, 200, 20],
+    ])
+    import time as _time
+
+    end = int(_time.time() * 1000) + 1_000_000
+    hq = rt.get_input_handler("inputStream")
+    hq.send(["IBM", 1, 0, end, "hours"])
+    hq.send(["IBM", 1, 0, end, "hours"])
+    e1 = rt.query(f"from stockAggregation within 0L, {end}L per 'hours' "
+                  "select AGG_TIMESTAMP, avgPrice, totalPrice as sumPrice")
+    e2 = rt.query(f"from stockAggregation within 0L, {end}L per 'hours' "
+                  "select AGG_TIMESTAMP, avgPrice, totalPrice as sumPrice")
+    assert len(q.events) == 2
+    assert tuple(q.events[0].data) == tuple(q.events[1].data)
+    assert [tuple(e.data) for e in e1] == [tuple(e.data) for e in e2]
+    m.shutdown()
+
+
+def test_partition_by_id_requires_shard_id():
+    """incrementalStreamProcessorTest52/53 (Aggregation2TestCase:444-483):
+    @PartitionById (bare or enable='true') without a configured shardId
+    fails at creation."""
+    base = ("define stream stockStream (symbol string, price float, "
+            "lastClosingPrice float, volume long, quantity int);\n")
+    agg = ("define aggregation stockAggregation from stockStream "
+           "select avg(price) as avgPrice, sum(price) as totalPrice, "
+           "(price * quantity) as lastTradeValue "
+           "aggregate every sec...year; ")
+    for ann in ("@PartitionById ", "@PartitionById(enable='true') "):
+        m = SiddhiManager()
+        with pytest.raises(CompileError):
+            m.create_siddhi_app_runtime(base + ann + agg)
+        m.shutdown()
+
+
+def test_partition_by_id_disabled_ok():
+    """incrementalStreamProcessorTest54 (Aggregation2TestCase:484-503):
+    enable='false' needs no shardId."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream stockStream (symbol string, price float, "
+        "lastClosingPrice float, volume long, quantity int);\n"
+        "@PartitionById(enable='false') "
+        "define aggregation stockAggregation from stockStream "
+        "select avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "aggregate every sec...year; ")
+    rt.start()
+    m.shutdown()
+
+
+def test_partition_by_id_system_property_overrides():
+    """incrementalStreamProcessorTest55/56 (Aggregation2TestCase:504-553):
+    the `partitionById` system property enables shard mode (even over
+    enable='false') — without a shardId creation fails."""
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    base = ("define stream stockStream (symbol string, price float, "
+            "lastClosingPrice float, volume long, quantity int);\n")
+    agg = ("define aggregation stockAggregation from stockStream "
+           "select avg(price) as avgPrice, sum(price) as totalPrice, "
+           "(price * quantity) as lastTradeValue "
+           "aggregate every sec...year; ")
+    for ann in ("@PartitionById(enable='false') ", ""):
+        m = SiddhiManager()
+        m.set_config_manager(InMemoryConfigManager({"partitionById": "true"}))
+        with pytest.raises(CompileError):
+            m.create_siddhi_app_runtime(base + ann + agg)
+        m.shutdown()
+
+
+def test_shutdown_during_send_is_clean():
+    """incrementalStreamProcessorTest57 (Aggregation2TestCase:554-630):
+    shutting down while another thread sends batches must not error."""
+    import threading
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STOCK + AGG)
+    h = rt.get_input_handler("stockStream")
+    rt.start()
+    batch = [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO2", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+        ["WSO2", 100.0, None, 200, 16, 1496289952000],
+        ["IBM", 100.0, None, 200, 96, 1496289954000],
+        ["IBM", 100.0, None, 200, 26, 1496289954000],
+    ]
+    errors = []
+
+    def sender():
+        for _ in range(3):
+            for r in batch:
+                try:
+                    h.send(list(r))
+                except RuntimeError as e:
+                    # the documented refusal once shutdown has landed
+                    if "shut down" not in str(e):
+                        errors.append(e)
+                    return
+                except Exception as e:  # anything else IS the bug under test
+                    errors.append(e)
+                    return
+
+    t = threading.Thread(target=sender)
+    t.start()
+    rt.shutdown()
+    t.join()
+    assert errors == []
+    m.shutdown()
+
+
+# ------------------------- AggregationFilter / DistinctCount corpora
+
+
+def test_join_on_condition_with_dynamic_per():
+    """aggregationFilterTestCase1 (AggregationFilterTestCase:35-136): an
+    `on i.symbol == s.symbol` filter composed with a per-event `per`."""
+    m, rt, q = _join_collect(
+        STOCK_STR_TS +
+        " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue "
+        "group by symbol aggregate by timestamp every sec...year; "
+        + INPUT +
+        " @info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        "on i.symbol == s.symbol "
+        'within "2017-06-01 09:35:00 +05:30", "2017-06-01 10:37:57 +05:30" '
+        "per i.perValue "
+        "select s.symbol, avgPrice, totalPrice as sumPrice, lastTradeValue "
+        "insert all events into outputStream; ")
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:51"],
+        ["WSO2", 60.0, 44.0, 200, 56, "2017-06-01 04:05:52"],
+        ["WSO2", 100.0, None, 200, 16, "2017-06-01 04:05:52"],
+        ["WSO2", 70.0, None, 40, 10, "2017-06-01 04:05:50"],
+        ["IBM", 100.0, None, 200, 26, "2017-06-01 04:05:54"],
+        ["IBM", 100.0, None, 200, 96, "2017-06-01 04:05:54"],
+        ["IBM", 50.0, 60.0, 90, 6, "2017-06-01 04:05:50"],
+        ["IBM", 900.0, None, 200, 60, "2017-06-01 04:05:56"],
+        ["IBM", 500.0, None, 200, 7, "2017-06-01 04:05:56"],
+        ["IBM", 400.0, None, 200, 9, "2017-06-01 04:06:56"],
+        ["IBM", 600.0, None, 200, 6, "2017-06-01 04:07:56"],
+        ["IBM", 700.0, None, 200, 20, "2017-06-01 05:07:56"],
+    ])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+         "2017-06-01 09:35:52 +05:30", "minutes"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        ("IBM", 283.3333333333333, 1700.0, 3500.0),
+        ("IBM", 400.0, 400.0, 3600.0),
+        ("IBM", 700.0, 700.0, 14000.0),
+        ("IBM", 600.0, 600.0, 3600.0),
+    ])
+    m.shutdown()
+
+
+def test_distinct_count_aggregator_days():
+    """DistinctCountAggregationTestCase test1 (:57-186): distinctCount
+    per day bucket; remove events mirror in events."""
+    got_removed = []
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select distinctCount(symbol) as distinctCnt "
+        "aggregate by timestamp every sec...year ;"
+        "define stream inputStream (symbol string); "
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596535449000L per "days" '
+        "select AGG_TIMESTAMP, s.distinctCnt order by AGG_TIMESTAMP "
+        "insert all events into outputStream; ")
+
+    class QC(QueryCallback):
+        def __init__(self):
+            self.events = []
+
+        def receive(self, timestamp, in_events, remove_events):
+            if in_events:
+                self.events.extend(in_events)
+            if remove_events:
+                got_removed.extend(remove_events)
+
+    q = QC()
+    rt.add_callback("query1", q)
+    rt.start()
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["WSO22", 70.0, None, 40, 10, 1496289950000],
+        ["WSO23", 60.0, 44.0, 200, 56, 1496289952000],
+        ["WSO24", 100.0, None, 200, 16, 1496289952000],
+        ["IBM", 101.0, None, 200, 26, 1496289954000],
+        ["IBM1", 102.0, None, 200, 96, 1496289954000],
+        ["IBM", 900.0, None, 200, 60, 1496289956000],
+        ["IBM1", 500.0, None, 200, 7, 1496289956000],
+        ["IBM", 400.0, None, 200, 9, 1496290016000],
+        ["IBM2", 600.0, None, 200, 6, 1496290076000],
+        ["CISCO", 700.0, None, 200, 20, 1496293676000],
+        ["WSO2", 61.0, 44.0, 200, 56, 1496297276000],
+        ["CISCO", 801.0, None, 100, 10, 1496383676000],
+        ["CISCO", 901.0, None, 100, 15, 1496470076000],
+        ["IBM", 101.0, None, 200, 96, 1499062076000],
+        ["IBM", 402.0, None, 200, 9, 1501740476000],
+        ["WSO2", 63.0, 44.0, 200, 6, 1533276476000],
+        ["WSO2", 260.0, 44.0, 200, 16, 1564812476000],
+        ["CISCO", 26.0, 44.0, 200, 16, 1596434876000],
+    ])
+    rt.get_input_handler("inputStream").send(["IBM"])
+    expected = [
+        (1496275200000, 8),
+        (1496361600000, 1),
+        (1496448000000, 1),
+        (1499040000000, 1),
+        (1501718400000, 1),
+        (1533254400000, 1),
+        (1564790400000, 1),
+        (1596412800000, 1),
+    ]
+    assert [tuple(e.data) for e in q.events] == expected
+    assert [tuple(e.data) for e in got_removed] == expected
+    m.shutdown()
+
+
+# -------------------------------------- LatestAggregationTestCase corpus
+
+LATEST_FEED = [
+    ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+    ["WSO22", 75.0, None, 40, 10, 1496289950100],
+    ["WSO23", 60.0, 44.0, 200, 56, 1496289952000],
+    ["WSO24", 100.0, None, 200, 16, 1496289952000],
+    ["WSO23", 70.0, None, 40, 10, 1496289950090],  # out of order: older ts
+    ["IBM", 101.0, None, 200, 26, 1496289954000],
+    ["IBM1", 102.0, None, 200, 100, 1496289954000],
+    ["IBM", 900.0, None, 200, 60, 1496289956000],
+    ["IBM1", 500.0, None, 200, 7, 1496289956000],
+]
+LATEST_AGG = (
+    " define aggregation stockAggregation from stockStream "
+    "select symbol, avg(price) as avgPrice, (price * quantity) as "
+    "latestPrice aggregate by timestamp every sec...year ;"
+    "define stream inputStream (symbol string); ")
+
+
+def test_latest_value_ignores_older_out_of_order():
+    """latestTestCase1 (LatestAggregationTestCase:57-153): bare selections
+    keep the max-event-time value; an out-of-order OLDER arrival must not
+    displace it."""
+    m, rt, q = _join_collect(
+        STOCK + LATEST_AGG +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596535449000L per "seconds" '
+        "select AGG_TIMESTAMP, s.symbol, s.latestPrice "
+        "order by AGG_TIMESTAMP insert all events into outputStream; ")
+    _feed(rt, LATEST_FEED)
+    rt.get_input_handler("inputStream").send(["IBM"])
+    assert [tuple(e.data) for e in q.events] == [
+        (1496289950000, "WSO22", 750.0),
+        (1496289952000, "WSO24", 1600.0),
+        (1496289954000, "IBM1", 10200.0),
+        (1496289956000, "IBM1", 3500.0),
+    ]
+    m.shutdown()
+
+
+def test_latest_value_join_group_by():
+    """latestTestCase2 (:155-250): a join-side `group by s.symbol`
+    collapses to the last row per symbol in the trigger chunk."""
+    m, rt, q = _join_collect(
+        STOCK + LATEST_AGG +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596535449000L per "seconds" '
+        "select s.symbol, s.latestPrice group by s.symbol "
+        "order by AGG_TIMESTAMP insert all events into outputStream; ")
+    _feed(rt, LATEST_FEED)
+    rt.get_input_handler("inputStream").send(["IBM"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        ("WSO22", 750.0),
+        ("WSO24", 1600.0),
+        ("IBM1", 3500.0),
+    ])
+    m.shutdown()
+
+
+def test_latest_value_with_avg():
+    """latestTestCase3 (:253-350): latest value and avg of the same bucket
+    read together."""
+    m, rt, q = _join_collect(
+        STOCK + LATEST_AGG +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596535449000L per "seconds" '
+        "select AGG_TIMESTAMP, s.symbol, s.latestPrice, s.avgPrice "
+        "order by AGG_TIMESTAMP insert all events into outputStream; ")
+    _feed(rt, LATEST_FEED)
+    rt.get_input_handler("inputStream").send(["IBM"])
+    assert [tuple(e.data) for e in q.events] == [
+        (1496289950000, "WSO22", 750.0, 65.0),
+        (1496289952000, "WSO24", 1600.0, 80.0),
+        (1496289954000, "IBM1", 10200.0, 101.5),
+        (1496289956000, "IBM1", 3500.0, 700.0),
+    ]
+    m.shutdown()
+
+
+def test_latest_value_join_side_aggregation():
+    """latestTestCase4 (:352-436): the join selector re-aggregates probe
+    rows (`sum(s.avgPrice)` per symbol) around latest values."""
+    m, rt, q = _join_collect(
+        STOCK + LATEST_AGG +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596535449000L per "seconds" '
+        "select s.symbol, s.latestPrice, sum(s.avgPrice) as totalAvg "
+        "group by s.symbol "
+        "order by AGG_TIMESTAMP insert all events into outputStream; ")
+    _feed(rt, LATEST_FEED)
+    rt.get_input_handler("inputStream").send(["IBM"])
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        ("WSO22", 750.0, 65.0),
+        ("WSO24", 1600.0, 80.0),
+        ("IBM1", 3500.0, 801.5),
+    ])
+    m.shutdown()
+
+
+# ------------------------------------------------ PurgingTestCase corpus
+
+
+def test_purge_annotation_creation():
+    """incrementalPurgingTest1 (PurgingTestCase:42-53): @purge with
+    @retentionPeriod parses at creation."""
+    m = SiddhiManager()
+    m.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, "
+        "price float, volume int); "
+        "@info(name = 'query1') "
+        "@purge(enable='true',interval='1 min',"
+        "@retentionPeriod(sec='120 sec',min='2 h',hours='25 h'))"
+        " define aggregation stockAggregation from stockStream "
+        "select sum(price) as sumPrice aggregate by arrival every sec...min")
+    m.shutdown()
+
+
+def test_purge_drops_expired_second_buckets():
+    """incrementalPurgingTestCase3 (PurgingTestCase:106-174): second
+    buckets older than the 120s retention vanish after a purge sweep
+    (the reference waits 80 s of wall clock; the sweep is triggered
+    directly here)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " @purge(enable='true',interval='10 sec',"
+        "@retentionPeriod(sec='120 sec',min='all',hours='all',"
+        "days='all',months='all',years='all')) "
+        "define aggregation stockAggregation from stockStream "
+        "select symbol, sum(price) as totalPrice "
+        "group by symbol aggregate by timestamp every sec...year ;")
+    rt.start()
+    _feed(rt, [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["IBM", 100.0, None, 200, 16, 1496289951011],
+        ["IBM", 400.0, None, 200, 9, 1496289952000],
+        ["IBM", 900.0, None, 200, 60, 1496289950000],
+        ["WSO2", 500.0, None, 200, 7, 1496289951011],
+        ["IBM", 100.0, None, 200, 26, 1496289953000],
+        ["WSO2", 100.0, None, 200, 96, 1496289953000],
+    ])
+    events = rt.query("from stockAggregation within 0L, 1543664151000L per "
+                      "'seconds' select AGG_TIMESTAMP, symbol, totalPrice ")
+    assert sorted(tuple(e.data) for e in events) == sorted([
+        (1496289950000, "WSO2", 50.0),
+        (1496289950000, "IBM", 900.0),
+        (1496289951000, "IBM", 100.0),
+        (1496289951000, "WSO2", 500.0),
+        (1496289952000, "IBM", 400.0),
+        (1496289953000, "IBM", 100.0),
+        (1496289953000, "WSO2", 100.0),
+    ])
+    agg = rt.aggregations["stockAggregation"]
+    # reference: Thread.sleep(80000) lets the 10s-interval purger run
+    # with 'now' far past the 2017 event times; trigger the sweep directly
+    agg.purge(now=1496289953000 + 200_000)
+    events = rt.query("from stockAggregation within 0L, 1543664151000L per "
+                      "'seconds' select AGG_TIMESTAMP, symbol, totalPrice ")
+    assert list(events) == []
+    m.shutdown()

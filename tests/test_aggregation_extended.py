@@ -111,11 +111,14 @@ SHARD_APP = """
 
 
 def test_shard_mode_flag():
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
     m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager({"shardId": "node-7"}))
     rt = m.create_siddhi_app_runtime(SHARD_APP)
     agg = rt.aggregations["Agg"]
     m.shutdown()
-    assert agg.shard_mode and agg.shard_id is not None
+    assert agg.shard_mode and agg.shard_id == "node-7"
 
 
 def test_distributed_aggregation_two_shards_stitch():
@@ -147,8 +150,10 @@ def test_distributed_aggregation_two_shards_stitch():
         aggs.append((m, agg))
 
     # reader: a third runtime with the same store stitches both shards
+    # (every @PartitionById node needs its own configured shardId)
     mr = SiddhiManager()
     mr.set_persistence_store(shared)
+    mr.set_config_manager(InMemoryConfigManager({"shardId": "reader"}))
     rtr = mr.create_siddhi_app_runtime(SHARD_APP)
     reader = rtr.aggregations["Agg"]
     assert reader.stitch_shards() == 2
