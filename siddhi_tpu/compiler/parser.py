@@ -1092,9 +1092,14 @@ class Parser:
             else:
                 self.error("expected event index", idx_tok)
             self.expect_op("]")
-            self.expect_op(".")
             stream_id = nm
-            attr = self.name()
+            if self.peek().is_op("."):
+                self.next()
+                attr = self.name()
+            else:
+                # bare indexed event ref (`e2[last-1] is null` — reference
+                # SiddhiQL nullCheck over a StateEvent position)
+                attr = None
         elif self.peek().is_op("."):
             self.next()
             stream_id = nm

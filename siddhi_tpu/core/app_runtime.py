@@ -534,6 +534,18 @@ class SiddhiAppRuntime:
                     )
                     self.stream_definitions[target] = sdef
                     self._create_junction(sdef)
+                else:
+                    # inserting into an existing stream requires an
+                    # equivalent schema (reference
+                    # AbstractDefinition.checkEquivalency via OutputParser —
+                    # SimpleQueryValidatorTestCase duplicate-definition)
+                    existing = self.stream_definitions[target]
+                    dattrs = [(a.name, a.type) for a in existing.attributes]
+                    if list(runtime.output_attrs) != dattrs:
+                        raise SiddhiAppValidationException(
+                            f"query '{query_name}' inserts "
+                            f"{list(runtime.output_attrs)} into stream "
+                            f"'{target}' defined as {dattrs}")
                 runtime.output_junction = self.junctions[target]
                 if (partition_ctx is not None
                         and target in getattr(partition_ctx,
@@ -675,6 +687,23 @@ class SiddhiAppRuntime:
             raise TypeError(f"unsupported callback type {type(callback)}")
 
     addCallback = add_callback
+
+    def remove_callback(self, callback):
+        """Detach a previously added Stream/QueryCallback (reference
+        SiddhiAppRuntimeImpl.removeCallback — CallbackTestCase: events
+        sent after removal no longer reach it)."""
+        if isinstance(callback, StreamCallback):
+            j = self.junctions.get(getattr(callback, "stream_id", ""))
+            if j is not None and callback in j.receivers:
+                j.receivers.remove(callback)
+            if callback in self._stream_callback_adapters:
+                self._stream_callback_adapters.remove(callback)
+        elif isinstance(callback, QueryCallback):
+            for qr in self.query_runtimes.values():
+                if callback in qr.query_callbacks:
+                    qr.query_callbacks.remove(callback)
+
+    removeCallback = remove_callback
 
     def start(self):
         with self._barrier:  # lazy start can race concurrent first sends

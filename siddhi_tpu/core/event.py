@@ -116,6 +116,28 @@ class StringDictionary:
             return None
         return self._to_str[i]
 
+    def rank_table(self, min_capacity: int = 16) -> np.ndarray:
+        """Lexicographic rank per id, padded to a pow2 capacity so growth
+        rarely changes the array SHAPE (ids are assigned in arrival order,
+        so `order by` on a string column must sort by rank, not id —
+        OrderByLimitTestCase limitTest2). Cached per dictionary size."""
+        n = len(self._to_str)
+        cap = max(min_capacity, 16)
+        while cap < n + 1:   # keep >= one pad slot: id -1 wraps to table[-1]
+            cap *= 2
+        cached = getattr(self, "_rank_cache", None)
+        if cached is not None and cached[0] == n and len(cached[1]) == cap:
+            return cached[1]
+        # padding (including the wrapped null id -1) ranks AFTER every
+        # real string, so nulls sort last
+        table = np.full(cap, n, np.int32)
+        if n:
+            order = sorted(range(n), key=lambda i: self._to_str[i])
+            for r, i in enumerate(order):
+                table[i] = r
+        self._rank_cache = (n, table)
+        return table
+
     _MISS = -2
 
     def encode_array(self, values: np.ndarray) -> np.ndarray:

@@ -57,6 +57,7 @@ from siddhi_tpu.query_api.expressions import (
 CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
 GK_KEY = "__gk__"
 FLUSH_KEY = "__flush__"
+STR_RANK = "__strrank__"   # [dict_capacity] lexicographic rank per string id
 
 
 def _rewrite_aggregators(expr: Expression, specs: List[agg_ops.AggSpec], resolver: Resolver) -> Expression:
@@ -146,6 +147,12 @@ def _is_multi(resolver, var: Variable) -> bool:
 class SelectorPlan:
     """Compiled selector; `apply` is traced inside the query step."""
 
+    @property
+    def needs_str_rank(self) -> bool:
+        """True when an order-by key is a string column — the runtime must
+        inject the dictionary's lexicographic rank table as cols[STR_RANK]."""
+        return any(is_str for _c, _d, is_str in self.order_by)
+
     specs: List[agg_ops.AggSpec]
     projections: List[Tuple[str, Callable, AttrType]]  # (out name, fn, type)
     output_attrs: List[Tuple[str, AttrType]]
@@ -155,7 +162,7 @@ class SelectorPlan:
     current_on: bool
     expired_on: bool
     batch_mode: bool          # upstream emits batch chunks (batch windows)
-    order_by: List[Tuple[str, bool]]  # (out col, descending)
+    order_by: List[Tuple[str, bool, bool]]  # (out col, descending, is_str)
     limit: Optional[int]
     offset: Optional[int]
     num_keys: int = 16
@@ -252,11 +259,15 @@ class SelectorPlan:
             # jnp.lexsort: last key is the primary sort key
             scalar_ov = out.pop("__overflow__", None)  # 0-d: not row-shaped
             keys = []
-            for col, desc in reversed(self.order_by):
+            for col, desc, is_str in reversed(self.order_by):
                 # order-by may name a non-projected INPUT column (reference
                 # `order by AGG_TIMESTAMP` without selecting it) — input
                 # rows are index-aligned with the outputs
                 k = out[col] if col in out else cols[col]
+                if is_str and STR_RANK in cols:
+                    # dictionary ids -> lexicographic ranks (nulls, id -1,
+                    # wrap to the table's end and sort last among equals)
+                    k = cols[STR_RANK][jnp.asarray(k, jnp.int32)]
                 if k.dtype == jnp.bool_:
                     k = k.astype(jnp.int32)
                 keys.append(-k if desc else k)
@@ -368,7 +379,10 @@ def plan_selector(
     order_by = []
     for ob in selector.order_by_list:
         ref = out_resolver.resolve(ob.variable)
-        order_by.append((ref.key, ob.order == "desc"))
+        # string keys are dictionary ids (arrival order) — sort them by
+        # the lexicographic rank table the runtime injects per batch
+        order_by.append((ref.key, ob.order == "desc",
+                         ref.type == AttrType.STRING))
 
     current_on = output_event_type in ("current", "all")
     expired_on = output_event_type in ("expired", "all")

@@ -330,8 +330,13 @@ class JoinQueryRuntime(QueryRuntime):
         probe_width = int(getattr(self.app_context, "index_probe_width", 64))
 
         def step(state, probe_cols, probe_valid, cols, current_time):
+            from siddhi_tpu.core.plan.selector_plan import STR_RANK
+
             ctx = {"xp": jnp, "current_time": current_time}
             cols = dict(cols)
+            # the rank table rides to the SELECTOR only — window stages
+            # must not see the non-row-shaped extra column
+            strrank = cols.pop(STR_RANK, None)
             for t in transforms:
                 cols = t.apply(cols, ctx)
             valid = cols[VALID_KEY]
@@ -491,6 +496,9 @@ class JoinQueryRuntime(QueryRuntime):
                 overflow = idx_overflow if overflow is None else jnp.maximum(
                     jnp.asarray(overflow).astype(jnp.int32), idx_overflow)
 
+            if strrank is not None:   # string order-by: rank table -> selector
+                joined[STR_RANK] = strrank
+
             if split:
                 # host keyer computes GK from joined columns; the selector
                 # runs as a separate jitted step (_host_keyed_select)
@@ -644,6 +652,10 @@ class JoinQueryRuntime(QueryRuntime):
         if self.keyer is None:
             return super()._finish_device_batch(step, cols, overflow_msg)
         now = np.int64(self.app_context.timestamp_generator.current_time())
+        if self.selector_plan.needs_str_rank:
+            from siddhi_tpu.core.plan.selector_plan import STR_RANK
+
+            cols[STR_RANK] = self.dictionary.rank_table()
         self._state, out = step(self._state, cols, now)
         out_host = LazyColumns(out)
         meta = out_host.pop("__meta__", None)

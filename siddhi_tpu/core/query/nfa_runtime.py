@@ -185,11 +185,17 @@ class NFAQueryRuntime(QueryRuntime):
         split = self.keyer is not None
 
         def step(state, cols, current_time):
+            from siddhi_tpu.core.plan.selector_plan import STR_RANK
+
             ctx = {"xp": jnp, "current_time": current_time}
+            cols = dict(cols)
+            strrank = cols.pop(STR_RANK, None)   # selector-only side input
             new_nfa, out_cols = stage.apply_stream(stream_id, state["nfa"], cols, ctx)
             out_cols = dict(out_cols)
             overflow = out_cols.pop("__overflow__", None)
             notify = out_cols.pop("__notify__", None)
+            if strrank is not None:
+                out_cols[STR_RANK] = strrank
             if split:
                 out_cols["__overflow__"] = overflow
                 out_cols["__notify__"] = notify
@@ -262,6 +268,10 @@ class NFAQueryRuntime(QueryRuntime):
                     step = jax.jit(fn, donate_argnums=0)
                 self._steps[stream_id] = step
             jcols = dict(cols) if isinstance(cols, LazyColumns) else cols
+            if self.selector_plan.needs_str_rank:
+                from siddhi_tpu.core.plan.selector_plan import STR_RANK
+
+                jcols[STR_RANK] = self.dictionary.rank_table()
             notify = self._run_nfa_step(lambda: step(
                 self._state, jcols,
                 np.int64(self.app_context.timestamp_generator.current_time())))

@@ -314,8 +314,11 @@ class QueryRuntime(Receiver):
         win = self.window_stage
 
         def step(state, cols, current_time):
+            from siddhi_tpu.core.plan.selector_plan import STR_RANK
+
             ctx = {"xp": jnp, "current_time": current_time}
             cols = dict(cols)
+            strrank = cols.pop(STR_RANK, None)  # window stages rebuild cols
             for t in transforms:
                 cols = t.apply(cols, ctx)
             valid = cols[VALID_KEY]
@@ -340,6 +343,8 @@ class QueryRuntime(Receiver):
                     else:
                         cols[VALID_KEY] = cols[VALID_KEY] & (
                             obj(cols, ctx) | ptimer)
+            if strrank is not None:
+                cols[STR_RANK] = strrank
             new_state["sel"], out = sel.apply(state["sel"], cols, ctx)
             if notify is not None:
                 out["__notify__"] = notify
@@ -594,6 +599,11 @@ class QueryRuntime(Receiver):
         now = np.int64(self._now())
         if isinstance(cols, LazyColumns):
             cols = dict(cols)   # jit boundary: raw (possibly device) arrays
+        if self.selector_plan.needs_str_rank:
+            # string order-by keys sort by lexicographic rank, not id
+            from siddhi_tpu.core.plan.selector_plan import STR_RANK
+
+            cols[STR_RANK] = self.dictionary.rank_table()
         self._state, out = step(self._state, cols, now)
         # lazy pull: only columns a consumer actually reads cross the
         # device->host link; overflow/notify/size travel as ONE packed

@@ -123,6 +123,10 @@ class CaptureSpec:
     is_count: bool = False
     n_idx: int = 0               # indexed slots kept (max referenced idx + 1)
     last_offsets: set = field(default_factory=set)  # e[last - k] offsets used
+    last_ring: int = 0           # shift-register depth for e[last - k] on
+    #                              OPEN-ENDED counts (`+`/`<n:>`): the last
+    #                              k+1 events ride dense ring columns instead
+    #                              of bounded indexed slots
 
 
 @dataclass
@@ -469,14 +473,16 @@ def assign_indexed_captures(plan: NFAPlan, exprs: List) -> None:
                 if not cap.is_count:
                     raise CompileError(
                         "e[last - k] needs a count capture (e<min:max>)")
-                # the k-th from the end is a runtime position: keep every
-                # indexed slot up to the step's bounded max occurrence
                 mx = _count_max_of(plan, cap)
                 if mx >= ANY_MAX:
-                    raise CompileError(
-                        "e[last - k] needs a bounded count (e<min:max>), "
-                        "not an open-ended one")
-                cap.n_idx = max(cap.n_idx, mx)
+                    # open-ended count (`+`, `<n:>`): the last k+1 events
+                    # ride a dense shift register (ring columns) — the
+                    # bounded-slot scheme can't size the chain
+                    cap.last_ring = max(cap.last_ring, k)
+                else:
+                    # bounded: the k-th from the end is a runtime position;
+                    # keep every indexed slot up to the bounded max
+                    cap.n_idx = max(cap.n_idx, mx)
                 cap.last_offsets.add(k)
                 return
             if not isinstance(idx, int):
@@ -507,6 +513,17 @@ def cap_cnt_col(cid: int) -> str:
     return f"c{cid}__#n"
 
 
+def cap_lastk_col(cid: int, j: int, attr: str) -> str:
+    """Ring column: the j-th-from-last captured event of an OPEN count —
+    'R' namespace, distinct from cap_last_col's bounded-slot 'L' derived
+    columns so the two storage schemes can never alias."""
+    return f"c{cid}R{j}__{attr}"
+
+
+PRESENT = "@present"   # synthetic attr: StateEvent presence (bare `e2 is
+#                        null` / `e2[last-k] is null` checks read its mask)
+
+
 def cap_last_col(cid: int, k: int, attr: str) -> str:
     return f"c{cid}L{k}__{attr}"
 
@@ -524,7 +541,16 @@ def scope_col(g: int) -> str:
 
 
 def _resolve_cap(plan: NFAPlan, var: Variable) -> Optional[Tuple[CaptureSpec, object]]:
+    from siddhi_tpu.query_api.definitions import Attribute
+
     sid = var.stream_id
+    if var.attribute_name is None:
+        # bare indexed ref (`e2[last-1] is null`): StateEvent presence —
+        # a synthetic BOOL column whose null mask is exactly absence
+        for cap in plan.captures:
+            if sid in (cap.ref_id, cap.stream_id):
+                return cap, Attribute(PRESENT, AttrType.BOOL)
+        return None
     for cap in plan.captures:
         if sid is not None and sid not in (cap.ref_id, cap.stream_id):
             continue
@@ -533,6 +559,11 @@ def _resolve_cap(plan: NFAPlan, var: Variable) -> Optional[Tuple[CaptureSpec, ob
         except Exception:
             continue
         return cap, attr
+    if sid is None:
+        # bare capture name (`e2 is null` — StateEvent presence check)
+        for cap in plan.captures:
+            if var.attribute_name == cap.ref_id:
+                return cap, Attribute(PRESENT, AttrType.BOOL)
     return None
 
 
@@ -546,8 +577,14 @@ def _cap_ref(plan: NFAPlan, var: Variable) -> Optional[ColumnRef]:
         if idx == "last":
             return ColumnRef(cap_col(cap.cid, attr.name), attr.type)
         if isinstance(idx, tuple) and idx[0] == "last":
-            # derived column materialized by the flatten stage
-            return ColumnRef(cap_last_col(cap.cid, -idx[1], attr.name), attr.type)
+            k = -idx[1]
+            if cap.last_ring >= k > 0:
+                # open-count shift register: live in state, so usable in
+                # mid-chain side filters too
+                return ColumnRef(cap_lastk_col(cap.cid, k, attr.name),
+                                 attr.type)
+            # bounded count: derived column materialized by the flatten stage
+            return ColumnRef(cap_last_col(cap.cid, k, attr.name), attr.type)
         if not isinstance(idx, int):
             raise CompileError(
                 "only e[<int>], e[last], e[last - k] indexing is supported")
@@ -636,6 +673,9 @@ def _cap_state_cols(plan: NFAPlan) -> Dict[str, np.dtype]:
             for i in range(cap.n_idx):
                 cols[cap_idx_col(cap.cid, i, a.name)] = dtype_of(a.type)
                 cols[cap_idx_col(cap.cid, i, a.name) + "?"] = np.bool_
+            for j in range(1, cap.last_ring + 1):
+                cols[cap_lastk_col(cap.cid, j, a.name)] = dtype_of(a.type)
+                cols[cap_lastk_col(cap.cid, j, a.name) + "?"] = np.bool_
         cols[cap_col(cap.cid, TS_KEY)] = np.int64
         if cap.is_count:
             cols[cap_cnt_col(cap.cid)] = np.int32
@@ -969,6 +1009,18 @@ class NFAStage:
             """Write the current event into a capture (last + indexed slot +
             counter) for slots selected by mask2d [B,S]."""
             cid = cap.cid
+            if cap.last_ring:
+                # shift the ring BEFORE the new event overwrites `last`:
+                # L[j] <- L[j-1], L[1] <- old last. Staleness across count
+                # restarts is masked by the counter at read time.
+                for j in range(cap.last_ring, 0, -1):
+                    for a in cap.definition.attributes:
+                        src = (cap_lastk_col(cid, j - 1, a.name) if j > 1
+                               else cap_col(cid, a.name))
+                        dst = cap_lastk_col(cid, j, a.name)
+                        CP[dst] = jnp.where(mask2d, CP[src], CP[dst])
+                        CP[dst + "?"] = jnp.where(mask2d, CP[src + "?"],
+                                                  CP[dst + "?"])
             for a in cap.definition.attributes:
                 n = cap_col(cid, a.name)
                 CP[n] = jnp.where(mask2d, cols[a.name][:, None], CP[n])
@@ -1041,6 +1093,37 @@ class NFAStage:
 
             # eval dict: current attrs [B,1], captures [B,S]
             ev = dict(CP)
+            # a count capture with no occurrences yet reads NULL (the
+            # reference's empty StateEvent chain): mask `last` by cnt==0
+            # and ring slot j by cnt<=j — this also cures ring staleness
+            # across count restarts. `@present` synthetics carry the bare
+            # StateEvent presence checks (`e2 is null`, `e2[last-k] is
+            # null`): their null mask IS absence.
+            ones2d = jnp.ones((B, S), bool)
+            pres_cols: List[str] = []
+
+            def _pres(ev_d, name, absent):
+                ev_d[name] = ones2d
+                ev_d[name + "?"] = absent
+                pres_cols.append(name)
+
+            for cap in plan.captures:
+                if not cap.is_count:
+                    _pres(ev, cap_col(cap.cid, PRESENT),
+                          (V["CD"] & (1 << cap.cid)) == 0)
+                    continue
+                cnt = CP[cap_cnt_col(cap.cid)]
+                _pres(ev, cap_col(cap.cid, PRESENT), cnt == 0)
+                for j in range(1, cap.last_ring + 1):
+                    _pres(ev, cap_lastk_col(cap.cid, j, PRESENT), cnt <= j)
+                for i in range(cap.n_idx):
+                    _pres(ev, cap_idx_col(cap.cid, i, PRESENT), cnt <= i)
+                for a in cap.definition.attributes:
+                    n = cap_col(cap.cid, a.name) + "?"
+                    ev[n] = CP[n] | (cnt == 0)
+                    for j in range(1, cap.last_ring + 1):
+                        nj = cap_lastk_col(cap.cid, j, a.name) + "?"
+                        ev[nj] = CP[nj] | (cnt <= j)
             if in_def is not None:
                 for a in in_def.attributes:
                     ev[a.name] = cols[a.name][:, None]
@@ -1055,6 +1138,8 @@ class NFAStage:
                     ev_fresh[n] = jnp.ones((B, 1), ev[n].dtype)
                 else:
                     ev_fresh[n] = jnp.zeros((B, 1), ev[n].dtype)
+            for n in pres_cols:   # fresh chains have captured nothing
+                ev_fresh[n + "?"] = jnp.ones((B, 1), bool)
 
             # ---- phase 1: match masks against pre-event state; the
             # furthest-advanced op wins a slot (no per-event forking)
@@ -1683,12 +1768,18 @@ class NFAStage:
                     out[ni] = emit_CP[ni].reshape(N)
                     out[ni + "?"] = (emit_CP[ni + "?"].reshape(N) | ~got
                                      | (cnt_flat <= i))
+                for j in range(1, cap.last_ring + 1):
+                    nj = cap_lastk_col(cap.cid, j, a.name)
+                    out[nj] = emit_CP[nj].reshape(N)
+                    out[nj + "?"] = (emit_CP[nj + "?"].reshape(N) | ~got
+                                     | (cnt_flat <= j))
             n = cap_col(cap.cid, TS_KEY)
             out[n] = emit_CP[n].reshape(N)
             if cap.is_count:
                 out[cap_cnt_col(cap.cid)] = cnt_flat
             _emit_last_cols(out, cap,
                             lambda nm: emit_CP[nm].reshape(N), got, cnt_flat)
+            _emit_present_cols(out, cap, got, cnt_flat, N)
         out[VALID_KEY] = emit.reshape(N)
         out[TS_KEY] = ets.reshape(N)
         out[TYPE_KEY] = jnp.zeros(N, jnp.int8)
@@ -1719,12 +1810,18 @@ class NFAStage:
                     out[ni] = out_caps[ni].reshape(N)
                     out[ni + "?"] = (out_caps[ni + "?"].reshape(N) | ~got
                                      | (cnt_flat <= i))
+                for j in range(1, cap.last_ring + 1):
+                    nj = cap_lastk_col(cap.cid, j, a.name)
+                    out[nj] = out_caps[nj].reshape(N)
+                    out[nj + "?"] = (out_caps[nj + "?"].reshape(N) | ~got
+                                     | (cnt_flat <= j))
             n = cap_col(cap.cid, TS_KEY)
             out[n] = out_caps[n].reshape(N)
             if cap.is_count:
                 out[cap_cnt_col(cap.cid)] = cnt_flat
             _emit_last_cols(out, cap,
                             lambda nm: out_caps[nm].reshape(N), got, cnt_flat)
+            _emit_present_cols(out, cap, got, cnt_flat, N)
         out[VALID_KEY] = out_valid.reshape(N)
         out[TS_KEY] = out_ts.reshape(N)
         out[TYPE_KEY] = jnp.zeros(N, jnp.int8)  # matches emit as CURRENT
@@ -1736,8 +1833,9 @@ class NFAStage:
 
 def _emit_last_cols(out: Dict, cap: CaptureSpec, flat_of, got, cnt_flat):
     """Materialize ``e[last - k]`` derived columns: the value at runtime
-    position cnt-1-k selected across the capture's indexed slots."""
-    if not cap.last_offsets or cnt_flat is None:
+    position cnt-1-k selected across the capture's indexed slots. Open
+    counts (cap.last_ring) emit from ring columns instead — never here."""
+    if not cap.last_offsets or cnt_flat is None or cap.last_ring:
         return
     for k in sorted(cap.last_offsets):
         pos = cnt_flat - 1 - k
@@ -1757,6 +1855,28 @@ def _emit_last_cols(out: Dict, cap: CaptureSpec, flat_of, got, cnt_flat):
             out[cap_last_col(cap.cid, k, a.name)] = acc
             out[cap_last_col(cap.cid, k, a.name) + "?"] = (
                 mk | ~got | (pos < 0))
+
+
+def _emit_present_cols(out: Dict, cap: CaptureSpec, got, cnt_flat, N: int):
+    """`@present` synthetics on emitted rows: null mask = StateEvent
+    absence (bare `e2 is null` / `e2[last-k] is null` in selectors)."""
+    ones = jnp.ones(N, bool)
+    out[cap_col(cap.cid, PRESENT)] = ones
+    out[cap_col(cap.cid, PRESENT) + "?"] = (
+        ~got if cnt_flat is None else ~got | (cnt_flat == 0))
+    if cnt_flat is None:
+        return
+    for i in range(cap.n_idx):
+        out[cap_idx_col(cap.cid, i, PRESENT)] = ones
+        out[cap_idx_col(cap.cid, i, PRESENT) + "?"] = ~got | (cnt_flat <= i)
+    for j in range(1, cap.last_ring + 1):
+        out[cap_lastk_col(cap.cid, j, PRESENT)] = ones
+        out[cap_lastk_col(cap.cid, j, PRESENT) + "?"] = ~got | (cnt_flat <= j)
+    if not cap.last_ring:
+        for k in sorted(cap.last_offsets):
+            out[cap_last_col(cap.cid, k, PRESENT)] = ones
+            out[cap_last_col(cap.cid, k, PRESENT) + "?"] = (
+                ~got | (cnt_flat - 1 - k < 0))
 
 
 def fresh_cap_step(plan: NFAPlan, rest_step: int, bits_val: int) -> int:

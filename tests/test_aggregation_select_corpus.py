@@ -1,0 +1,290 @@
+"""Reference select-optimisation aggregation corpus — scenarios ported
+verbatim from ``aggregation/SelectOptimisationAggregationTestCase.java``:
+re-aggregating bucket reads in the join/on-demand SELECT (``sum(count)``,
+``sum(totalPrice)``) with same/different/absent group-bys."""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+
+STOCK = ("define stream stockStream (symbol string, price float, "
+         "lastClosingPrice float, volume long, quantity int, "
+         "timestamp long);")
+STOCK_NAMED = STOCK.replace(
+    "symbol string,", "symbol string, name string,")
+INPUT = ("define stream inputStream (symbol string, value int, "
+         "startTime string, endTime string, perValue string); ")
+
+FEED = [
+    ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+    ["WSO2", 70.0, None, 40, 10, 1496289950000],
+    ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+    ["WSO2", 100.0, None, 200, 16, 1496289952000],
+    ["IBM", 100.0, None, 200, 26, 1496289954000],
+    ["IBM", 100.0, None, 200, 96, 1496289954000],
+    ["IBM", 900.0, None, 200, 60, 1496289956000],
+    ["IBM", 500.0, None, 200, 7, 1496289956000],
+    ["IBM", 400.0, None, 200, 9, 1496290016000],
+    ["IBM", 600.0, None, 200, 6, 1496290076000],
+    ["CISCO", 700.0, None, 200, 20, 1496293676000],
+]
+# the same feed with a per-symbol name column (testcase5/6/7)
+FEED_NAMED = [[r[0], nm] + r[1:] for r, nm in zip(
+    FEED, ["WSO2", "WSO2", "WSO2", "WSO2", "IBM", "IBM", "IBM", "IBM",
+           "IBM", "IBM", "CISCO"])]
+
+
+class QCollect(QueryCallback):
+    def __init__(self):
+        self.events = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+
+
+def _run(app, feed, trigger=None, stream="stockStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback("query1", q)
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for r in feed:
+        h.send(list(r))
+    if trigger is not None:
+        rt.get_input_handler("inputStream").send(list(trigger))
+    return m, rt, q
+
+
+TRIGGER = ["IBM", 1, "2017-06-01 09:35:51 +05:30",
+           "2017-06-01 09:35:52 +05:30", "seconds"]
+
+
+def test_count_per_second_buckets():
+    """aggregationFunctionTestcase2 (:155-247): count() without group by,
+    read per seconds (external timestamps)."""
+    m, rt, q = _run(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select count() as count aggregate by timestamp every sec, min ;"
+        + INPUT +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596434876000L per "seconds" '
+        "select AGG_TIMESTAMP, count order by AGG_TIMESTAMP "
+        "insert all events into outputStream; ",
+        FEED, TRIGGER)
+    assert [tuple(e.data) for e in q.events] == [
+        (1496289950000, 2), (1496289952000, 2), (1496289954000, 2),
+        (1496289956000, 2), (1496290016000, 1), (1496290076000, 1),
+        (1496293676000, 1)]
+    m.shutdown()
+
+
+def test_grouped_count_read_back():
+    """aggregationFunctionTestcase3 (:248-342): per-symbol counts read
+    back bucket by bucket."""
+    m, rt, q = _run(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, count() as count group by symbol "
+        "aggregate by timestamp every sec, min ;"
+        + INPUT +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596434876000L per "seconds" '
+        "select AGG_TIMESTAMP, s.symbol, s.count "
+        "insert all events into outputStream; ",
+        FEED, TRIGGER)
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        (1496289950000, "WSO2", 2), (1496289952000, "WSO2", 2),
+        (1496289954000, "IBM", 2), (1496289956000, "IBM", 2),
+        (1496290016000, "IBM", 1), (1496290076000, "IBM", 1),
+        (1496293676000, "CISCO", 1)])
+    m.shutdown()
+
+
+def test_sum_count_same_group_by():
+    """aggregationFunctionTestcase4 (:344-433): the join select
+    re-aggregates bucket counts per symbol (`sum(count)`)."""
+    m, rt, q = _run(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, count() as count group by symbol "
+        "aggregate by timestamp every sec, min ;"
+        + INPUT +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596434876000L per "seconds" '
+        "select s.symbol, sum(count) as count group by s.symbol "
+        "insert all events into outputStream; ",
+        FEED, TRIGGER)
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        ("WSO2", 4), ("IBM", 6), ("CISCO", 1)])
+    m.shutdown()
+
+
+def test_sum_count_coarser_group_by_keeps_last_name():
+    """aggregationFunctionTestcase5 (:435-525): aggregation groups by
+    (symbol, name) but the join select groups by symbol only — name rides
+    as the group's last value."""
+    m, rt, q = _run(
+        STOCK_NAMED +
+        " define aggregation stockAggregation from stockStream "
+        "select symbol, name, count() as count group by symbol, name "
+        "aggregate by timestamp every sec, min ;"
+        + INPUT +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596434876000L per "seconds" '
+        "select s.symbol, s.name, sum(count) as count group by s.symbol "
+        "insert all events into outputStream; ",
+        FEED_NAMED, TRIGGER)
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        ("WSO2", "WSO2", 4), ("IBM", "IBM", 6), ("CISCO", "CISCO", 1)])
+    m.shutdown()
+
+
+def test_sum_count_project_one_of_two_groups():
+    """aggregationFunctionTestcase6 (:527-617): same but only symbol
+    projected."""
+    m, rt, q = _run(
+        STOCK_NAMED +
+        " define aggregation stockAggregation from stockStream "
+        "select symbol, name, count() as count group by symbol, name "
+        "aggregate by timestamp every sec, min ;"
+        + INPUT +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596434876000L per "seconds" '
+        "select s.symbol, sum(count) as count group by s.symbol "
+        "insert all events into outputStream; ",
+        FEED_NAMED, TRIGGER)
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        ("WSO2", 4), ("IBM", 6), ("CISCO", 1)])
+    m.shutdown()
+
+
+def test_sum_count_distinct_names():
+    """aggregationFunctionTestcase7 (:619-709): name values differ from
+    symbols; the coarser group keeps each symbol's last name."""
+    named = [[r[0], nm] + r[1:] for r, nm in zip(
+        FEED, ["WSO21", "WSO22", "WSO21", "WSO22", "IBM1", "IBM1", "IBM1",
+               "IBM1", "IBM1", "IBM1", "CISCO1"])]
+    m, rt, q = _run(
+        STOCK_NAMED +
+        " define aggregation stockAggregation from stockStream "
+        "select symbol, name, count() as count group by symbol, name "
+        "aggregate by timestamp every sec, min ;"
+        + INPUT +
+        "@info(name = 'query1') "
+        "from inputStream as i join stockAggregation as s "
+        'within 1496200000000L, 1596434876000L per "seconds" '
+        "select s.symbol, s.name, sum(count) as count group by s.symbol "
+        "insert all events into outputStream; ",
+        named, TRIGGER)
+    assert sorted(tuple(e.data) for e in q.events) == sorted([
+        ("WSO2", "WSO22", 4), ("IBM", "IBM1", 6), ("CISCO", "CISCO1", 1)])
+    m.shutdown()
+
+
+def test_on_demand_count_read():
+    """aggregationFunctionTestcase8 (:711-787): on-demand count per
+    bucket, ordered."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select count() as count aggregate by timestamp every sec, min ;")
+    rt.start()
+    h = rt.get_input_handler("stockStream")
+    for r in FEED:
+        h.send(list(r))
+    events = rt.query(
+        "from stockAggregation within 1496200000000L, 1596434876000L "
+        'per "seconds" select AGG_TIMESTAMP, count order by AGG_TIMESTAMP;')
+    assert [tuple(e.data) for e in events] == [
+        (1496289950000, 2), (1496289952000, 2), (1496289954000, 2),
+        (1496289956000, 2), (1496290016000, 1), (1496290076000, 1),
+        (1496293676000, 1)]
+    m.shutdown()
+
+
+def test_on_demand_sum_count_group_by():
+    """aggregationFunctionTestcase9 (:789-862): on-demand re-aggregation
+    `sum(count) group by symbol`."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, count() as count group by symbol "
+        "aggregate by timestamp every sec, min ;")
+    rt.start()
+    h = rt.get_input_handler("stockStream")
+    for r in FEED:
+        h.send(list(r))
+    events = rt.query(
+        "from stockAggregation within 1496200000000L, 1596434876000L "
+        'per "seconds" select symbol, sum(count) as count '
+        "group by symbol;")
+    assert sorted(tuple(e.data) for e in events) == sorted([
+        ("WSO2", 4), ("IBM", 6), ("CISCO", 1)])
+    m.shutdown()
+
+
+def test_join_on_condition_sum_total_price():
+    """aggregationFunctionTestcase12 (:1125-1200): on-condition narrows to
+    IBM, wildcard minute within, `sum(totalPrice)` re-aggregation."""
+    m, rt, q = _run(
+        STOCK + " define aggregation stockAggregation from stockStream "
+        "select symbol, avg(price) as avgPrice, sum(price) as totalPrice, "
+        "(price * quantity) as lastTradeValue, count() as count "
+        "group by symbol aggregate by timestamp every sec...year ;"
+        + INPUT +
+        "@info(name = 'query1') "
+        "from inputStream join stockAggregation "
+        "on inputStream.symbol == stockAggregation.symbol "
+        'within "2017-06-01 04:05:**" per "seconds" '
+        "select stockAggregation.symbol, sum(totalPrice) as totalPrice "
+        "group by stockAggregation.symbol order by AGG_TIMESTAMP "
+        "insert all events into outputStream; ",
+        [
+            ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+            ["WSO2", 70.0, None, 40, 10, 1496289950000],
+            ["WSO2", 60.0, 44.0, 200, 56, 1496289949000],
+            ["WSO2", 100.0, None, 200, 16, 1496289949000],
+            ["IBM", 100.0, None, 200, 26, 1496289948000],
+            ["IBM", 100.0, None, 200, 96, 1496289948000],
+            ["IBM", 900.0, None, 200, 60, 1496289947000],
+            ["IBM", 500.0, None, 200, 7, 1496289947000],
+            ["IBM", 400.0, None, 200, 9, 1496289946000],
+        ], TRIGGER)
+    assert len(q.events) == 1
+    assert tuple(q.events[0].data) == ("IBM", 2000.0)
+    m.shutdown()
+
+
+def test_on_demand_sum_group_by_agg_timestamp():
+    """last test (:1205-1267): on-demand `sum(totalPrice) group by
+    AGG_TIMESTAMP` folds the per-symbol buckets per second."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        STOCK + " @purge(enable='false') "
+        "define aggregation stockAggregation from stockStream "
+        "select symbol, sum(price) as totalPrice "
+        "group by symbol aggregate by timestamp every sec...hour ;")
+    rt.start()
+    h = rt.get_input_handler("stockStream")
+    for r in [
+        ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+        ["IBM", 70.0, None, 40, 10, 1496289950000],
+        ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+        ["IBM", 100.0, None, 200, 16, 1496289952500],
+        ["IBM", 100.0, None, 200, 26, 1496289954000],
+        ["WSO2", 100.0, None, 200, 96, 1496289954500],
+    ]:
+        h.send(list(r))
+    events = rt.query(
+        'from stockAggregation within "2017-06-** **:**:**" per "seconds" '
+        "select AGG_TIMESTAMP, sum(totalPrice) as totalPrice "
+        "group by AGG_TIMESTAMP;")
+    assert sorted(tuple(e.data) for e in events) == sorted([
+        (1496289950000, 120.0),
+        (1496289952000, 160.0),
+        (1496289954000, 200.0)])
+    m.shutdown()

@@ -1,0 +1,811 @@
+"""Reference filter corpus — scenario table extracted verbatim from
+``query/FilterTestCase1.java`` and ``query/FilterTestCase2.java`` (the
+SiddhiQL string tests plus the programmatic query-API tests expressed as
+their SiddhiQL equivalents): comparison operators over every numeric
+type pairing, bool/string equality, and/or/not compositions, and
+constant-vs-attribute orderings. Each entry is (name, stream attrs,
+filter, select, feed rows, expected pass count)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+
+SCENARIOS = [
+    ('filterTest1',
+     'symbol string, price float, volume long',
+     '70 > price',
+     'symbol, price',
+     [['IBM', 700.0, 100], ['WSO2', 60.5, 200]],
+     1),
+    ('filterTest2',
+     'symbol string, price float, volume long',
+     '150 > volume',
+     'symbol,price',
+     [['IBM', 700.0, 100], ['WSO2', 60.5, 200]],
+     1),
+    ('testFilterQuery3',
+     'symbol string, price float, volume int',
+     '70 > price',
+     'symbol,price',
+     [['WSO2', 55.6, 100], ['IBM', 75.6, 100], ['WSO2', 57.6, 200]],
+     2),
+    ('testFilterQuery4',
+     'symbol string, price float, volume long',
+     'volume > 50f',
+     'symbol,price,volume',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery5',
+     'symbol string, price float, volume long',
+     'volume > 50L',
+     'symbol,price,volume',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery6',
+     'symbol string, price float, volume int',
+     'volume > 50L',
+     'symbol,price,volume',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery7',
+     'symbol string, price float, volume double',
+     'volume > 50L',
+     'symbol,price,volume',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery8',
+     'symbol string, price float, volume float',
+     'volume > 50L',
+     'symbol,price,volume',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery9',
+     'symbol string, price float, volume float',
+     'volume > 50f',
+     'symbol,price',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery10',
+     'symbol string, price float, volume double',
+     'volume > 50d',
+     'symbol,price',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery11',
+     'symbol string, price float, volume double',
+     'volume > 50f',
+     'symbol,price',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery12',
+     'symbol string, price float, volume double',
+     'volume > 45',
+     'symbol,price',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery13',
+     'symbol string, price float, volume float',
+     'volume > 50d',
+     'symbol,price',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery14',
+     'symbol string, price float, volume float',
+     'volume > 45',
+     'symbol,price',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery15',
+     'symbol string, price float, volume float, quantity int',
+     'quantity > 4d',
+     'symbol,price,quantity',
+     [['WSO2', 50.0, 60.0, 5], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 200.0, 4]],
+     1),
+    ('testFilterQuery16',
+     'symbol string, price float, volume long',
+     'volume > 50d',
+     'symbol,price,volume',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery17',
+     'symbol string, price float, volume long',
+     'volume > 45',
+     'symbol, volume',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery18',
+     'symbol string, price float, volume int',
+     '70 > volume',
+     'symbol, price',
+     [['WSO2', 55.6, 50], ['IBM', 75.6, 100], ['WSO2', 57.6, 30]],
+     2),
+    ('testFilterQuery20',
+     'symbol string, price float, volume long',
+     'volume < 100',
+     'symbol, price, volume',
+     [['WSO2', 55.6, 103], ['WSO2', 57.6, 10]],
+     1),
+    ('testFilterQuery21',
+     'symbol string, price float, volume long',
+     'volume != 100',
+     'symbol,price,volume',
+     [['WSO2', 55.6, 100], ['WSO2', 57.6, 10]],
+     1),
+    ('testFilterQuery22',
+     'symbol string, price float, volume double',
+     'volume > 12L and price < 56',
+     'symbol,price,volume',
+     [['WSO2', 55.6, 100.0], ['WSO2', 57.6, 10.0]],
+     1),
+    ('testFilterQuery23',
+     'symbol string, price float, volume long',
+     "symbol != 'WSO2' and volume != 55L and price != 45f ",
+     'symbol,price,volume',
+     [['WSO2', 45.0, 100], ['IBM', 35.0, 50]],
+     1),
+    ('testFilterQuery24',
+     'symbol string, price float, volume long',
+     'volume != 50f',
+     'symbol,price',
+     [['WSO2', 45.0, 100], ['IBM', 35.0, 50]],
+     1),
+    ('testFilterQuery25',
+     'symbol string, price float, volume long',
+     'price != 35L',
+     'symbol,price',
+     [['WSO2', 45.0, 100], ['IBM', 35.0, 50]],
+     1),
+    ('testFilterQuery26',
+     'symbol string, price float, volume long',
+     'volume != 100 and volume != 70d',
+     'symbol,price,volume',
+     [['WSO2', 55.6, 100], ['IBM', 57.6, 10]],
+     1),
+    ('testFilterQuery27',
+     'symbol string, price float, volume long',
+     'price != 53.6d or price != 87',
+     'symbol,price,volume',
+     [['WSO2', 55.6, 100], ['IBM', 57.6, 10]],
+     2),
+    ('testFilterQuery28',
+     'symbol string, price float, volume int',
+     'volume != 40f and volume != 400',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40], ['WSO2', 53.5, 50], ['WSO2', 50.5, 400]],
+     1),
+    ('testFilterQuery29',
+     'symbol string, price float, volume int',
+     'volume != 40d and volume != 400d',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40], ['WSO2', 53.5, 50], ['WSO2', 50.5, 400]],
+     1),
+    ('testFilterQuery30',
+     'symbol string, price float, available bool',
+     'available != true ',
+     'symbol,price,available',
+     [['IBM', 55.6, True], ['WSO2', 57.6, False]],
+     1),
+    ('testFilterQuery31',
+     'symbol string, price float, available bool',
+     'available != true',
+     'symbol, price, available',
+     [['IBM', 55.6, True], ['WSO2', 57.6, False]],
+     1),
+    ('testFilterQuery32',
+     'symbol string, price float, volume int',
+     'price != 50 and volume != 50L',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40], ['WSO2', 53.5, 50]],
+     1),
+    ('testFilterQuery33',
+     'symbol string, price float, volume double',
+     'volume != 50d',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40.0], ['WSO2', 53.5, 50.0]],
+     1),
+    ('testFilterQuery34',
+     'symbol string, price float, volume double',
+     'volume != 50f  or volume != 50',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40.0], ['WSO2', 53.5, 50.0]],
+     1),
+    ('testFilterQuery35',
+     'symbol string, price float, volume double',
+     'volume != 50L',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40.0], ['WSO2', 53.5, 50.0]],
+     1),
+    ('testFilterQuery36',
+     'symbol string, price float, available bool',
+     'available == true',
+     'symbol, price, available',
+     [['IBM', 55.6, True], ['WSO2', 57.6, False]],
+     1),
+    ('testFilterQuery37',
+     'symbol string, price float, volume double',
+     'volume == 50d',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40.0], ['WSO2', 53.5, 50.0]],
+     1),
+    ('testFilterQuery38',
+     'symbol string, price float, volume double',
+     "symbol == 'IBM'",
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40.0], ['IBM', 53.5, 50.0]],
+     1),
+    ('testFilterQuery39',
+     'symbol string, price float, volume double',
+     'price <= 53.5f',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40.0], ['WSO2', 53.5, 50.0]],
+     1),
+    ('testFilterQuery40',
+     'symbol string, price float, volume double',
+     'price <= 54',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40.0], ['WSO2', 53.5, 50.0]],
+     1),
+    ('testFilterQuery41',
+     'symbol string, price float, volume int',
+     'volume <= 40',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40], ['WSO2', 53.5, 50]],
+     1),
+    ('testFilterQuery42',
+     'symbol string, price float, volume int',
+     'price >= 54',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40], ['WSO2', 53.5, 50]],
+     1),
+    ('testFilterQuery43',
+     'symbol string, price float, volume long',
+     'volume >= 50',
+     'symbol,price,volume',
+     [['WSO2', 55.5, 40], ['WSO2', 53.5, 50]],
+     1),
+    ('testFilterQuery51',
+     'symbol string, price float, volume double',
+     'volume == 60f',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery52',
+     'symbol string, price float, volume double',
+     'volume == 60',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery53',
+     'symbol string, price float, volume double',
+     'volume == 60L',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 60.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery54',
+     'symbol string, price float, volume double',
+     'price == 50.0',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery55',
+     'symbol string, price float, volume double',
+     'price == 50f',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery56',
+     'symbol string, price float, volume double',
+     'price == 70',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery57',
+     'symbol string, price float, volume double',
+     'price == 60L',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 60.0], ['WSO2', 60.0, 200.0]],
+     1),
+    ('testFilterQuery58',
+     'symbol string, price float, volume double, quantity int',
+     'quantity == 5.0',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 5], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 200.0, 4]],
+     1),
+    ('testFilterQuery59',
+     'symbol string, price float, volume double, quantity int',
+     'quantity == 5f',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 5], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 200.0, 4]],
+     1),
+    ('testFilterQuery60',
+     'symbol string, price float, volume double, quantity int',
+     'quantity == 2',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 5], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 200.0, 4]],
+     1),
+    ('testFilterQuery61',
+     'symbol string, price float, volume double, quantity int',
+     'quantity == 4L',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 5], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 200.0, 4]],
+     1),
+    ('testFilterQuery62',
+     'symbol string, price float, volume long, quantity int',
+     'volume == 200L',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60, 5], ['WSO2', 70.0, 60, 2], ['WSO2', 60.0, 200, 4]],
+     1),
+    ('testFilterQuery63',
+     'symbol string, price float, volume long',
+     'volume == 40.0',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     1),
+    ('testFilterQuery64',
+     'symbol string, price float, volume long',
+     'volume == 40f',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     1),
+    ('testFilterQuery65',
+     'symbol string, price float, volume long',
+     'volume == 40',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     1),
+    ('testFilterQuery67',
+     'symbol string, price double, volume long',
+     'price <= 60.0',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery68',
+     'symbol string, price double, volume long',
+     'price <= 100f',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     3),
+    ('testFilterQuery69',
+     'symbol string, price double, volume long',
+     'price <= 50',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery70',
+     'symbol string, price float, volume double, quantity int',
+     'volume <= 200L',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 5], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 300.0, 4]],
+     2),
+    ('testFilterQuery71',
+     'symbol string, price float, volume long',
+     'price <= 50.0',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery72',
+     'symbol string, price float, volume double, quantity int',
+     'price <= 200L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 60.0, 5], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 300.0, 4]],
+     2),
+    ('testFilterQuery73',
+     'symbol string, price float, volume double, quantity int',
+     'quantity <= 5.0',
+     'symbol, quantity',
+     [['WSO2', 500.0, 60.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 300.0, 4]],
+     2),
+    ('testFilterQuery74',
+     'symbol string, price float, volume double, quantity int',
+     'quantity <= 5f',
+     'symbol, quantity',
+     [['WSO2', 500.0, 60.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 300.0, 4]],
+     2),
+    ('testFilterQuery75',
+     'symbol string, price float, volume double, quantity int',
+     'quantity <= 3L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 60.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 300.0, 4]],
+     1),
+    ('testFilterQuery76',
+     'symbol string, price float, volume long',
+     'volume <= 50.0',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     1),
+    ('testFilterQuery77',
+     'symbol string, price float, volume long',
+     'volume <= 50f',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     1),
+    ('testFilterQuery78',
+     'symbol string, price float, volume long',
+     'volume <= 50',
+     'symbol',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     1),
+    ('testFilterQuery79',
+     'symbol string, price float, volume long, quantity int',
+     'volume <= 60L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 60, 6], ['WSO2', 70.0, 60, 2], ['WSO2', 60.0, 300, 4]],
+     2),
+    ('testFilterQuery80',
+     'symbol string, price float, volume double',
+     'volume < 50.0',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery81',
+     'symbol string, price float, volume double',
+     'volume < 70f',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery82',
+     'symbol string, price double, volume double',
+     'price < 50',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery83',
+     'symbol string, price float, volume long',
+     'volume > 45',
+     'symbol, volume',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),
+    ('testFilterQuery83',
+     'symbol string, price float, volume double, quantity int',
+     'volume < 60L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 300.0, 4]],
+     1),
+    ('testFilterQuery84',
+     'symbol string, price float, volume double, quantity int',
+     'price < 60L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 50.0, 300.0, 4]],
+     1),
+    ('testFilterQuery85',
+     'symbol string, price float, volume double, quantity int',
+     'quantity < 4L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 50.0, 300.0, 4]],
+     1),
+    ('testFilterQuery86',
+     'symbol string, price float, volume long, quantity int',
+     'volume < 40L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50, 6], ['WSO2', 70.0, 20, 2], ['WSO2', 50.0, 300, 4]],
+     1),
+    ('testFilterQuery87',
+     'symbol string, price float, volume double',
+     'price < 50.0',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery88',
+     'symbol string, price float, volume double',
+     'price < 55f',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery89',
+     'symbol string, price float, volume double, quantity int',
+     'quantity < 50.0',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 6], ['WSO2', 70.0, 40.0, 10], ['WSO2', 44.0, 200.0, 56]],
+     2),
+    ('testFilterQuery90',
+     'symbol string, price float, volume double, quantity int',
+     'quantity < 10f',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 6], ['WSO2', 70.0, 40.0, 10], ['WSO2', 44.0, 200.0, 56]],
+     1),
+    ('testFilterQuery91',
+     'symbol string, price float, volume double, quantity int',
+     'quantity < 15',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 6], ['WSO2', 70.0, 40.0, 10], ['WSO2', 44.0, 200.0, 56]],
+     2),
+    ('testFilterQuery92',
+     'symbol string, price float, volume long, quantity int',
+     'volume < 100.0',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60, 6], ['WSO2', 70.0, 40, 10], ['WSO2', 44.0, 200, 56]],
+     2),
+    ('testFilterQuery93',
+     'symbol string, price float, volume long, quantity int',
+     'volume < 100f',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60, 6], ['WSO2', 70.0, 40, 10], ['WSO2', 44.0, 200, 56]],
+     2),
+    ('testFilterQuery94',
+     'symbol string, price float, volume double',
+     'volume >= 50.0',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery95',
+     'symbol string, price float, volume double',
+     'volume >= 70f',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery96',
+     'symbol string, price double, volume double',
+     'price >= 50',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery97',
+     'symbol string, price float, volume double, quantity int',
+     'volume >= 60L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 60.0, 300.0, 4]],
+     2),
+    ('testFilterQuery98',
+     'symbol string, price float, volume double, quantity int',
+     'price >= 60L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 50.0, 300.0, 4]],
+     2),
+    ('testFilterQuery99',
+     'symbol string, price float, volume double, quantity int',
+     'quantity >= 4L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50.0, 6], ['WSO2', 70.0, 60.0, 2], ['WSO2', 50.0, 300.0, 4]],
+     2),
+    ('testFilterQuery100',
+     'symbol string, price float, volume long, quantity int',
+     'volume >= 40L',
+     'symbol, quantity',
+     [['WSO2', 500.0, 50, 6], ['WSO2', 70.0, 20, 2], ['WSO2', 50.0, 300, 4]],
+     2),
+    ('testFilterQuery101',
+     'symbol string, price float, volume double',
+     'price >= 50.0',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     2),
+    ('testFilterQuery102',
+     'symbol string, price float, volume double',
+     'price >= 55f',
+     'symbol',
+     [['WSO2', 50.0, 60.0], ['WSO2', 70.0, 40.0], ['WSO2', 44.0, 200.0]],
+     1),
+    ('testFilterQuery103',
+     'symbol string, price float, volume double, quantity int',
+     'quantity >= 50.0',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 6], ['WSO2', 70.0, 40.0, 10], ['WSO2', 44.0, 200.0, 56]],
+     1),
+    ('testFilterQuery104',
+     'symbol string, price float, volume double, quantity int',
+     'quantity >= 10f',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 6], ['WSO2', 70.0, 40.0, 10], ['WSO2', 44.0, 200.0, 56]],
+     2),
+    ('testFilterQuery105',
+     'symbol string, price float, volume double, quantity int',
+     'quantity >= 15',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60.0, 6], ['WSO2', 70.0, 40.0, 10], ['WSO2', 44.0, 200.0, 56]],
+     1),
+    ('testFilterQuery106',
+     'symbol string, price float, volume long, quantity int',
+     'volume >= 100.0',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60, 6], ['WSO2', 70.0, 40, 10], ['WSO2', 44.0, 200, 56]],
+     1),
+    ('testFilterQuery107',
+     'symbol string, price float, volume long, quantity int',
+     'volume >= 100f',
+     'symbol, quantity',
+     [['WSO2', 50.0, 60, 6], ['WSO2', 70.0, 40, 10], ['WSO2', 44.0, 200, 56]],
+     1),
+    ('filterTest121',
+     'symbol string, price float, volume long',
+     '150 > volume',
+     'symbol,price , symbol as sym1',
+     [['IBM', 700.0, 100], ['WSO2', 60.5, 200]],
+     1),
+    ('testFilterQuery66',
+     'symbol string, price float, volume long',
+     'not (volume == 40)',
+     'symbol, price',
+     [['WSO2', 50.0, 60], ['WSO2', 70.0, 40], ['WSO2', 44.0, 200]],
+     2),]
+
+
+@pytest.mark.parametrize(
+    "name,stream,filt,sel,feed,expected", SCENARIOS,
+    ids=[s[0] for s in SCENARIOS])
+def test_filter_scenario(name, stream, filt, sel, feed, expected):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        f"define stream cseEventStream ({stream});"
+        f"@info(name = 'query1') from cseEventStream[{filt}] "
+        f"select {sel} insert into outputStream ;")
+
+    events = []
+
+    class QC(QueryCallback):
+        def receive(self, ts, in_events, remove_events):
+            if in_events:
+                events.extend(in_events)
+
+    rt.add_callback("query1", QC())
+    h = rt.get_input_handler("cseEventStream")
+    rt.start()
+    for row in feed:
+        h.send(list(row))
+    m.shutdown()
+    assert len(events) == expected, (
+        f"{name}: [{filt}] passed {len(events)} of {len(feed)} rows, "
+        f"expected {expected}")
+
+
+def _collect(app, query="query1"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    events = []
+
+    class QC(QueryCallback):
+        def receive(self, ts, in_events, remove_events):
+            if in_events:
+                events.extend(in_events)
+
+    rt.add_callback(query, QC())
+    rt.start()
+    return m, rt, events
+
+
+@pytest.mark.parametrize("filt", [
+    "volume >= 50 and volume",   # testFilterQuery44 (:1505-1517)
+    "price and volume >= 50",    # testFilterQuery45 (:1519-1530)
+    "volume >= 50 or volume",    # testFilterQuery46 (:1532-1543)
+    "price or volume >= 50",     # testFilterQuery47 (:1545-1556)
+])
+def test_non_boolean_logical_operand_rejected(filt):
+    """testFilterQuery44-47 (FilterTestCase1.java:1505-1556): and/or over
+    a non-boolean operand fails at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (symbol string, price float, "
+            "volume long);"
+            f"@info(name = 'query1') from cseEventStream[{filt}] "
+            "select symbol,price,volume insert into outputStream ;")
+    m.shutdown()
+
+
+def test_not_over_non_boolean_rejected():
+    """testFilterQuery48 (FilterTestCase1.java:1558-1587): not(price) on a
+    float attribute fails at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (symbol string, price float, "
+            "available bool);"
+            "@info(name = 'query1') from cseEventStream[not (price)] "
+            "select symbol, price insert into outputStream ;")
+    m.shutdown()
+
+
+def test_arithmetic_add_mixed_types():
+    """testFilterQuery109 (FilterTestCase2.java:1102-1160): constant +
+    float/double/int/long keeps each side's promoted type."""
+    m, rt, events = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume double, quantity int, awards long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, 100 + price as increasedPrice, "
+        "50 + volume as increasedVolume, 4 + quantity as increasedQuantity, "
+        "10 + awards as increasedAwards insert into outputStream ;")
+    rt.get_input_handler("cseEventStream").send(["WSO2", 55.5, 100.0, 5, 10])
+    m.shutdown()
+    assert len(events) == 1
+    d = events[0].data
+    assert d[1:] == [155.5, 150.0, 9, 20]
+    assert isinstance(d[3], int) and isinstance(d[4], int)
+
+
+def test_arithmetic_subtract_mixed_types():
+    """testFilterQuery110 (FilterTestCase2.java:1162-1222)."""
+    m, rt, events = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume double, quantity int, awards long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, price - 20 as decreasedPrice, "
+        "volume - 50 as decreasedVolume, quantity - 4 as decreasedQuantity, "
+        "awards - 10 as decreasedAwards insert into outputStream ;")
+    rt.get_input_handler("cseEventStream").send(["WSO2", 55.5, 100.0, 5, 10])
+    m.shutdown()
+    assert len(events) == 1
+    assert events[0].data[1:] == [35.5, 50.0, 1, 0]
+
+
+def test_arithmetic_divide_mixed_types():
+    """testFilterQuery111 (FilterTestCase2.java:1224-1283): int/int and
+    long/int divisions stay integral (Java semantics)."""
+    m, rt, events = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume double, quantity int, awards long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, price / 2 as dividedPrice, "
+        "volume / 2 as dividedVolume, quantity / 5 as dividedQuantity, "
+        "awards / 10 as dividedAwards insert into outputStream ;")
+    rt.get_input_handler("cseEventStream").send(["WSO2", 60.0, 100.0, 100, 70])
+    m.shutdown()
+    assert len(events) == 1
+    d = events[0].data
+    assert d[1:] == [30.0, 50.0, 20, 7]
+    assert isinstance(d[3], int) and isinstance(d[4], int)
+
+
+def test_arithmetic_multiply_mixed_types():
+    """testFilterQuery112 (FilterTestCase2.java:1285-1345)."""
+    m, rt, events = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume double, quantity int, awards long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, quantity * 4 as multipliedQuantity, "
+        "price * 2 as multipliedPrice, volume * 3 as multipliedVolume, "
+        "awards * 5 as multipliedAwards insert into outputStream ;")
+    rt.get_input_handler("cseEventStream").send(["WSO2", 55.5, 100.0, 5, 3])
+    m.shutdown()
+    assert len(events) == 1
+    assert events[0].data[1:] == [20, 111.0, 300.0, 15]
+
+
+def test_arithmetic_mod_mixed_types():
+    """testFilterQuery113 (FilterTestCase2.java:1347-1407)."""
+    m, rt, events = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume double, quantity int, awards long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, price % 2 as modPrice, volume % 2 as modVolume, "
+        "quantity % 2 as modQuantity, awards % 2 as modAwards "
+        "insert into outputStream ;")
+    rt.get_input_handler("cseEventStream").send(["WSO2", 55.5, 101.0, 5, 7])
+    m.shutdown()
+    assert len(events) == 1
+    assert events[0].data[1:] == [1.5, 1.0, 1, 1]
+
+
+def test_select_arithmetic_windowless():
+    """filterTest116 (FilterTestCase2.java:1455-1490): `price+5 as price`
+    passes every event through."""
+    m, rt, events = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, price+5 as price insert into outputStream ;")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 700.0, 100])
+    h.send(["WSO2", 60.5, 200])
+    h.send(["IBM", 700.0, 100])
+    m.shutdown()
+    assert [e.data[1] for e in events] == [705.0, 65.5, 705.0]
+
+
+def test_sum_plus_constant_time_batch():
+    """filterTest117 (FilterTestCase2.java:1492-1529): `sum(price)+5` over
+    a timeBatch flush (playback clock instead of a 500 ms sleep)."""
+    m, rt, events = _collect(
+        "@app:playback "
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream#window.timeBatch(500) "
+        "select symbol, sum(price)+5 as price insert into outputStream ;")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(1000, ["IBM", 700.0, 100])
+    h.send(1000, ["WSO2", 60.5, 200])
+    h.send(1000, ["IBM", 700.0, 100])
+    h.send(1600, ["IBM", 1.0, 100])  # advances the clock past the flush
+    m.shutdown()
+    assert len(events) >= 1
+    assert events[0].data[1] == 1465.5

@@ -1,0 +1,282 @@
+"""Reference order-by/limit/offset corpus — scenarios ported verbatim
+from ``query/OrderByLimitTestCase.java`` (per-flush chunk sizes and
+total counts over lengthBatch/length windows)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+
+FEED8 = [
+    ["IBM", 700.0, 0], ["WSO2", 60.5, 1], ["WSO2", 60.5, 2],
+    ["WSO2", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+    ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+]
+
+
+class Chunks(QueryCallback):
+    def __init__(self):
+        self.chunks = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.chunks.append([tuple(e.data) for e in in_events])
+
+
+def _run(query, feed):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        f"@info(name = 'query1') {query}")
+    q = Chunks()
+    rt.add_callback("query1", q)
+    rt.start()
+    h = rt.get_input_handler("cseEventStream")
+    for r in feed:
+        h.send(list(r))
+    m.shutdown()
+    return q.chunks
+
+
+def test_limit_on_length_batch():
+    """limitTest1 (:52-92): limit 2 caps each 4-event flush at 2."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, price, volume limit 2 insert into outputStream;",
+        FEED8)
+    assert [len(c) for c in chunks] == [2, 2]
+
+
+def test_order_by_then_limit():
+    """limitTest2 (:95-136): order by symbol, limit 3 — the first three in
+    symbol order per flush."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, price, volume order by symbol limit 3 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["WSO2", 60.5, 1], ["AAA", 60.5, 2],
+            ["IBM", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["IBM", 601.5, 6], ["BBB", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [3, 3]
+    assert [r[0] for r in chunks[0]] == ["AAA", "IBM", "IBM"]
+    assert [r[0] for r in chunks[1]] == ["BBB", "IBM", "IBM"]
+
+
+def test_limit_with_ungrouped_aggregate():
+    """limitTest3 (:139-179): an ungrouped sum collapses each flush to one
+    row; limit 2 leaves it alone."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, sum(price) as totalPrice, volume limit 2 "
+        "insert into outputStream;",
+        FEED8)
+    assert [len(c) for c in chunks] == [1, 1]
+
+
+def test_order_by_with_ungrouped_aggregate():
+    """limitTest4 (:182-223)."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, sum(price) as totalPrice, volume "
+        "order by symbol limit 2 insert into outputStream;",
+        FEED8)
+    assert [len(c) for c in chunks] == [1, 1]
+
+
+def test_order_by_two_keys():
+    """limitTest5 (:226-268): order by price, totalVolume with group by
+    symbol; limit 2 per flush."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, sum(volume) as totalVolume, volume, price "
+        "group by symbol order by price, totalVolume limit 2 "
+        "insert into outputStream;",
+        [
+            ["IBM", 60.5, 0], ["WSO2", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 60.5, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [2, 2]
+
+
+def test_group_by_order_by_aggregate():
+    """limitTest6 (:271-313): group-by flush rows ordered by totalPrice,
+    limited to 2."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, sum(price) as totalPrice, volume "
+        "group by symbol order by totalPrice limit 2 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["WSO2", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [2, 2]
+
+
+def test_group_by_without_aggregate():
+    """limitTest7 (:316-357): group by without an aggregate keeps the last
+    row per group; limit 2."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, price, volume group by symbol order by price "
+        "limit 2 insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [2, 2]
+
+
+def test_sliding_window_limit_per_event():
+    """limitTest9 (:362-402): a sliding length window emits per event;
+    limit 2 never binds on 1-row chunks (8 outputs)."""
+    chunks = _run(
+        "from cseEventStream#window.length(4) "
+        "select symbol, price, volume group by symbol order by price "
+        "limit 2 insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [1] * 8
+
+
+def test_order_by_desc():
+    """limitTest10 (:406-447): order by totalPrice desc, limit 2 — the two
+    biggest groups lead each flush."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, sum(price) as totalPrice, volume "
+        "group by symbol order by totalPrice desc limit 2 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 7060.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [2, 2]
+    assert chunks[0][0][0] == "WSO2"  # 7060.5 leads descending
+
+
+def test_order_by_asc_sliding():
+    """limitTest11 (:451-490): explicit `asc`, sliding window — 8 1-row
+    chunks."""
+    chunks = _run(
+        "from cseEventStream#window.length(4) "
+        "select symbol, price, volume order by price asc limit 2 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [1] * 8
+
+
+def test_offset_drops_leading_rows():
+    """limitTest12 (:494-536): offset 1 drops the top group per flush."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, sum(price) as totalPrice, volume "
+        "group by symbol order by totalPrice desc offset 1 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 7060.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["XYZ", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [2, 2]
+
+
+def test_offset_without_limit():
+    """limitTest13 (:540-578): offset 2 on 4-row flushes leaves 2."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, price, volume order by price asc offset 2 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [2, 2]
+
+
+def test_limit_and_offset():
+    """limitTest14 (:583-625): limit 1 offset 1 — the runner-up group."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, sum(price) as totalPrice, volume "
+        "group by symbol order by totalPrice desc limit 1 offset 1 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 7060.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["XYZ", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [1, 1]
+
+
+def test_limit_and_offset_plain():
+    """limitTest15 (:629-669): limit 2 offset 2 over 4-row flushes."""
+    chunks = _run(
+        "from cseEventStream#window.lengthBatch(4) "
+        "select symbol, price, volume order by price asc limit 2 offset 2 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [2, 2]
+
+
+def test_offset_beyond_chunk_silences_sliding():
+    """limitTest16 (:673-712): sliding 1-row chunks with offset 1 emit
+    nothing."""
+    chunks = _run(
+        "from cseEventStream#window.length(4) "
+        "select symbol, price, volume order by price asc limit 1 offset 1 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert chunks == []
+
+
+def test_offset_zero_is_noop():
+    """limitTest17 (:715-756): offset 0 changes nothing — 8 chunks."""
+    chunks = _run(
+        "from cseEventStream#window.length(4) "
+        "select symbol, price, volume order by price asc limit 1 offset 0 "
+        "insert into outputStream;",
+        [
+            ["IBM", 700.0, 0], ["IBM", 60.5, 1], ["WSO2", 60.5, 2],
+            ["XYZ", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+            ["WSO2", 60.5, 6], ["WSO2", 60.5, 7],
+        ])
+    assert [len(c) for c in chunks] == [1] * 8
+
+
+@pytest.mark.parametrize("clause", ["limit -1 offset 0", "limit 1 offset -1"])
+def test_negative_limit_offset_rejected(clause):
+    """limitTest18/19 (:758-827): negative limit or offset fails at
+    creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (symbol string, price float, "
+            "volume long);"
+            "@info(name = 'query1') from cseEventStream#window.length(4) "
+            f"select symbol, price, volume order by price asc {clause} "
+            "insert into outputStream;")
+    m.shutdown()

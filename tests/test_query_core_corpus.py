@@ -1,0 +1,356 @@
+"""Reference core-query corpus — scenarios ported verbatim from the
+top-level ``query/`` test classes: IsNullTestCase, StringCompareTestCase,
+BooleanCompareTestCase, GroupByTestCase, CallbackTestCase,
+PassThroughTestCase, and SimpleQueryValidatorTestCase."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QC(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+def _collect(app, query="query1"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QC()
+    rt.add_callback(query, q)
+    rt.start()
+    return m, rt, q
+
+
+# ------------------------------------------------------ IsNullTestCase
+
+
+def test_is_null_filter():
+    """isNullTest1 (IsNullTestCase:43-96): `symbol is null` passes only
+    the null-symbol row."""
+    m, rt, q = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream[symbol is null] "
+        "select symbol, price insert into outputStream ;")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 700.0, 100])
+    h.send([None, 60.5, 200])
+    h.send(["WSO2", 60.5, 200])
+    m.shutdown()
+    assert len(q.events) == 1
+    assert q.events[0].data == [None, 60.5]
+
+
+def test_is_null_on_kleene_captures():
+    """isNullTest2 (IsNullTestCase:97-165): `e2[last-k] is null` inside a
+    Kleene condition and the select; exact captured row asserted."""
+    m, rt, q = _collect(
+        "define stream Stream1 (symbol string, price float, volume int); "
+        "define stream Stream2 (symbol string, price float, volume int); "
+        "@info(name = 'query1') "
+        "from every e1=Stream1[price>20], "
+        "   e2=Stream1[(price>=e2[last].price and not e2[last-1] is null "
+        "and price>=e2[last-1].price+5)  or ("
+        " e2[last-1] is null and price>=e1.price+5 )]+, "
+        "   e3=Stream1[price<e2[last].price]"
+        "select e1.price as price1, e2[0].price as price2, "
+        "e2[last-2] is null as check1, e2[last-1].price as price3, "
+        "e2[last].price as price4, e3.price as price5, "
+        "e2 is null as check2 "
+        "insert into OutputStream ;")
+    h = rt.get_input_handler("Stream1")
+    for row in [
+        ["WSO2", 29.6, 100], ["WSO2", 25.0, 100], ["WSO2", 35.6, 100],
+        ["WSO2", 41.5, 100], ["WSO2", 42.6, 100], ["WSO2", 43.6, 100],
+        ["IBM", 58.7, 100], ["IBM", 45.6, 100],
+    ]:
+        h.send(row)
+    m.shutdown()
+    assert len(q.events) == 1
+    d = q.events[0].data
+    assert d[2] is True and d[3] is None and d[6] is False
+    assert [round(x, 4) for x in (d[0], d[1], d[4], d[5])] == [
+        43.6, 58.7, 58.7, 45.6]
+    assert q.expired == []
+
+
+# -------------------------- String/Boolean compare validation batteries
+
+_OPS = ["x > y", "x < y", "x >= y", "x <= y", "x == y", "x != y"]
+_STRING_DEFS = ["x string, y int", "x int, y string", "x long, y string",
+                "x float, y string", "x double, y string"]
+_BOOL_DEFS = ["x bool, y int", "x int, y bool", "x long, y bool",
+              "x float, y bool", "x double, y bool"]
+
+
+@pytest.mark.parametrize("cond", _OPS)
+@pytest.mark.parametrize("defs", _STRING_DEFS)
+def test_string_numeric_compare_rejected(cond, defs):
+    """StringCompareTestCase test1-30 (:40-225): every comparison between
+    a string and a numeric attribute fails at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            f"define stream cseEventStream ({defs}, symbol string, "
+            f"price float);"
+            f"@info(name = 'query1') from cseEventStream[{cond}] "
+            f"select symbol, price insert into outputStream;")
+    m.shutdown()
+
+
+@pytest.mark.parametrize("cond", _OPS)
+@pytest.mark.parametrize("defs", _BOOL_DEFS)
+def test_bool_numeric_compare_rejected(cond, defs):
+    """BooleanCompareTestCase test1-30: every comparison between a bool
+    and a numeric attribute fails at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            f"define stream cseEventStream ({defs}, symbol string, "
+            f"price float);"
+            f"@info(name = 'query1') from cseEventStream[{cond}] "
+            f"select symbol, price insert into outputStream;")
+    m.shutdown()
+
+
+# ------------------------------------------------------ GroupByTestCase
+
+
+def test_group_by_sliding_time_window():
+    """testGroupByQuery1 (GroupByTestCase:50-95): sliding time(1 sec)
+    group-by emits one output per arriving event (playback clock replaces
+    the reference's sleeps)."""
+    m, rt, q = _collect(
+        "@app:playback "
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream#window.time(1 sec) "
+        "select symbol, sum(volume) as totalVolume, avg(price) as avgPrice "
+        "group by symbol insert into outputStream;")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(100, ["IBM", 50.0, 200])
+    h.send(100, ["WSO2", 50.0, 200])
+    h.send(300, ["WSO2", 50.0, 200])
+    h.send(300, ["IBM", 50.0, 200])
+    h.send(4500, ["WSO2", 50.0, 200])
+    h.send(4500, ["WSO2", 50.0, 200])
+    m.shutdown()
+    assert len(q.events) == 6
+
+
+def test_group_by_time_batch_window():
+    """testGroupByQuery2 (GroupByTestCase:97-147): timeBatch(1 sec)
+    group-by flushes one output per group per batch (4 events -> 2
+    groups, then 2 WSO2 -> 1)."""
+    m, rt, q = _collect(
+        "@app:playback "
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream#window.timeBatch(1 sec) "
+        "select symbol, sum(volume) as totalVolume, avg(price) as avgPrice "
+        "group by symbol insert into outputStream;")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(100, ["IBM", 50.0, 200])
+    h.send(100, ["WSO2", 50.0, 200])
+    h.send(300, ["WSO2", 50.0, 200])
+    h.send(300, ["IBM", 50.0, 200])
+    h.send(3500, ["WSO2", 50.0, 200])
+    h.send(3500, ["WSO2", 50.0, 200])
+    h.send(5000, ["XYZ", 1.0, 1])   # advances the clock past the flush
+    m.shutdown()
+    assert len(q.events) == 3
+    got = {tuple(e.data) for e in q.events[:2]}
+    assert got == {("IBM", 400, 50.0), ("WSO2", 400, 50.0)}
+    assert tuple(q.events[2].data) == ("WSO2", 400, 50.0)
+
+
+# ----------------------------------------------------- CallbackTestCase
+
+
+def test_remove_query_callback():
+    """testCallback1 (CallbackTestCase:44-85): a removed QueryCallback
+    stops receiving."""
+    m, rt, q = _collect(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, price , symbol as sym1 insert into outputStream ;")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 0.0, 100])
+    rt.remove_callback(q)
+    h.send(["WSO2", 0.0, 100])
+    m.shutdown()
+    assert len(q.events) == 1
+
+
+def test_remove_stream_callback():
+    """removeCallback also detaches StreamCallbacks."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream S (symbol string);"
+        "@info(name = 'query1') from S select symbol insert into O ;")
+    got = []
+
+    class SC(StreamCallback):
+        def receive(self, events):
+            got.extend(events)
+
+    sc = SC()
+    rt.add_callback("O", sc)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["a"])
+    rt.remove_callback(sc)
+    h.send(["b"])
+    m.shutdown()
+    assert len(got) == 1
+
+
+# -------------------------------------------------- PassThroughTestCase
+
+
+def test_passthrough_simple():
+    """testPassThroughQuery1 (PassThroughTestCase:50-96)."""
+    m, rt, q = _collect(
+        "define stream cseEventStream (symbol string, price int);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, price insert into StockQuote ;")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 100])
+    h.send(["WSO2", 100])
+    m.shutdown()
+    assert len(q.events) == 2
+
+
+def test_passthrough_other_stream_gets_nothing():
+    """testPassThroughQuery2 (:98-143): events sent to an unrelated
+    stream produce no query output."""
+    m, rt, q = _collect(
+        "define stream cseEventStream (symbol string, price int);"
+        "define stream cseEventStream1 (symbol string, price int);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, price insert into StockQuote ;")
+    h1 = rt.get_input_handler("cseEventStream1")
+    h1.send(["IBM", 100])
+    h1.send(["WSO2", 100])
+    m.shutdown()
+    assert q.events == []
+
+
+def test_passthrough_duplicate_projection():
+    """testPassThroughQuery3 (:145-196): the same attribute projected
+    under two names; the unrelated stream's events don't count."""
+    m, rt, q = _collect(
+        "define stream cseEventStream (symbol string, price int);"
+        "define stream cseEventStream1 (symbol string, price int);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, symbol as price2 insert into StockQuote ;")
+    rt.get_input_handler("cseEventStream").send(["IBM", 100])
+    rt.get_input_handler("cseEventStream").send(["WSO2", 100])
+    rt.get_input_handler("cseEventStream1").send(["ORACLE", 100])
+    rt.get_input_handler("cseEventStream1").send(["ABC", 100])
+    m.shutdown()
+    assert len(q.events) == 2
+    assert [e.data for e in q.events] == [["IBM", "IBM"], ["WSO2", "WSO2"]]
+
+
+def test_passthrough_chained_select_star():
+    """testPassThroughQuery4 (:198-247): `select *` chained through two
+    streams."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream "
+        "insert into outputStream;"
+        "@info(name = 'query2') from outputStream select * "
+        "insert into outputStream2 ;")
+    q = QC()
+    rt.add_callback("query2", q)
+    rt.start()
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["WSO2", 700.0, 100])
+    h.send(["WSO2", 60.5, 200])
+    m.shutdown()
+    assert len(q.events) == 2
+    assert q.events[0].data == ["WSO2", 700.0, 100]
+
+
+# ------------------------------------------- SimpleQueryValidatorTestCase
+
+
+@pytest.mark.parametrize("app", [
+    # testQueryWithNotExistingAttributes (:38-47)
+    ("define stream cseEventStream (symbol string, price float, "
+     "volume long);"
+     "@info(name = 'query1') from cseEventStream[volume >= 50] "
+     "select symbol1,price,volume insert into outputStream ;"),
+    # testQueryWithDuplicateDefinition (:49-58): outputStream already
+    # defined with an incompatible schema
+    ("define stream \n cseEventStream (symbol string, price float, "
+     "volume long);"
+     "define stream outputStream (symbol string, price float);"
+     "@info(name = 'query1') from cseEventStream[volume >= 50] "
+     "select symbol,price,volume insert into outputStream ;"),
+    # testInvalidFilterCondition1/2 (:60-78)
+    ("define stream cseEventStream (symbol string, price float, "
+     "volume long);"
+     "@info(name = 'query1') from cseEventStream[volume >= 50 and volume] "
+     "select symbol,price,volume insert into outputStream ;"),
+    ("define stream cseEventStream (symbol string, price float, "
+     "volume long);"
+     "@info(name = 'query1') from cseEventStream[not(price)] "
+     "select symbol,price,volume insert into outputStream ;"),
+    # testQueryWithTable / testQueryWithEveryTable (:102-112, :131-141)
+    ("define table TestTable(symbol string, volume float); "
+     "from TestTable select * insert into OutputStream; "),
+    ("define table TestTable(symbol string, volume float);\n"
+     "from every TestTable select * insert into OutputStream; "),
+    # testQueryWithAggregation / testQueryWithEveryAggregation (:114-158)
+    ("define stream TradeStream (symbol string, price double, "
+     "volume long, timestamp long);\n"
+     "define aggregation TradeAggregation\n"
+     "  from TradeStream\n"
+     "  select symbol, avg(price) as avgPrice, sum(price) as total\n"
+     "    group by symbol\n"
+     "    aggregate by timestamp every sec ... year; "
+     "from every TradeAggregation \nselect * \ninsert into OutputStream; "),
+    ("define stream TradeStream (symbol string, price double, "
+     "volume long, timestamp long);\n"
+     "define aggregation TradeAggregation\n"
+     "  from TradeStream\n"
+     "  select symbol, avg(price) as avgPrice, sum(price) as total\n"
+     "    group by symbol\n"
+     "    aggregate by timestamp every sec ... year; "
+     "from every TradeAggregation select * insert into OutputStream; "),
+])
+def test_invalid_apps_rejected(app):
+    """SimpleQueryValidatorTestCase error battery: undefined attributes,
+    incompatible duplicate definitions, non-boolean logical operands, and
+    tables/aggregations as plain stream sources all fail at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(app)
+    m.shutdown()
+
+
+@pytest.mark.parametrize("filt", ["available", "available and price>50"])
+def test_bool_attribute_filters_compile(filt):
+    """testComplexFilterQuery1/2 (:80-99): a bare bool attribute is a
+    valid filter condition."""
+    m = SiddhiManager()
+    m.create_siddhi_app_runtime(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long, available bool);"
+        f"@info(name = 'query1') from cseEventStream[{filt}] "
+        "select symbol,price,volume insert into outputStream ;")
+    m.shutdown()
