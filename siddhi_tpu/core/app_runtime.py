@@ -234,10 +234,17 @@ class SiddhiAppRuntime:
 
         self.trigger_runtimes: List[TriggerRuntime] = []
         for tid, tdef in siddhi_app.trigger_definitions.items():
-            sdef = StreamDefinition(
-                id=tid, attributes=[Attribute("triggered_time", AttrType.LONG)])
-            self.stream_definitions[tid] = sdef
-            junction = self._create_junction(sdef)
+            if tid in self.junctions:
+                # an explicitly defined `(triggered_time long)` stream may
+                # share the trigger's id (TriggerTestCase testQuery4) —
+                # reuse its junction so @async config and subscribers stay
+                junction = self.junctions[tid]
+            else:
+                sdef = StreamDefinition(
+                    id=tid,
+                    attributes=[Attribute("triggered_time", AttrType.LONG)])
+                self.stream_definitions[tid] = sdef
+                junction = self._create_junction(sdef)
             self.trigger_runtimes.append(
                 TriggerRuntime(tdef, junction, self.app_context,
                                barrier=self._barrier))

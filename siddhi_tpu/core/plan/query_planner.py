@@ -85,8 +85,11 @@ def _rewrite_string_casts(expr, input_def, resolver, transforms, ext_state,
             _rewrite_string_casts(p, input_def, resolver, transforms,
                                   ext_state, dictionary)
             for p in expr.parameters]
-        numeric = {"int": AttrType.INT, "long": AttrType.LONG,
-                   "float": AttrType.FLOAT, "double": AttrType.DOUBLE}
+        from siddhi_tpu.ops.expressions import _TYPE_NAMES
+
+        # every castable target except string (those go the other way)
+        numeric = {k: v for k, v in _TYPE_NAMES.items()
+                   if v != AttrType.STRING}
         if (not expr.namespace and expr.name.lower() in ("cast", "convert")
                 and len(expr.parameters) == 2
                 and isinstance(expr.parameters[1], Constant)

@@ -289,17 +289,31 @@ def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
 
     if name in ("cast", "convert"):
         # cast(x, 'double') — reference Cast/ConvertFunctionExecutor
+        if len(args) != 2:
+            raise CompileError(
+                f"{name}() needs exactly (value, '<type>'), got {len(args)} "
+                f"arguments")
         src_f, src_t = compile_expr(args[0], resolver)
         if not isinstance(args[1], Constant) or args[1].type != AttrType.STRING:
             raise CompileError(f"{name}() target type must be a string constant")
+        if args[1].value.lower() not in _TYPE_NAMES:
+            raise CompileError(
+                f"{name}() target '{args[1].value}' is not a type name")
         target = _TYPE_NAMES[args[1].value.lower()]
         if AttrType.STRING in (src_t, target) and src_t != target:
             raise CompileError("string<->numeric cast runs host-side; not supported on device yet")
         dtype = T.dtype_of(target)
 
-        def fn(cols, ctx):
-            v, m = src_f(cols, ctx)
-            return ctx["xp"].asarray(v).astype(dtype), m
+        if target == AttrType.BOOL and src_t != AttrType.BOOL:
+            # numeric -> bool is `value == 1` (ConvertFunctionExecutor:
+            # 2f converts to false, 1f to true — ConvertFunctionTestCase)
+            def fn(cols, ctx):
+                v, m = src_f(cols, ctx)
+                return ctx["xp"].asarray(v) == 1, m
+        else:
+            def fn(cols, ctx):
+                v, m = src_f(cols, ctx)
+                return ctx["xp"].asarray(v).astype(dtype), m
 
         return fn, target
 
@@ -350,6 +364,10 @@ def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
         return fn, out_t
 
     if name == "default":
+        if len(args) != 2:
+            raise CompileError(
+                f"default() needs exactly (attribute, value), got "
+                f"{len(args)} arguments")
         src_f, src_t = compile_expr(args[0], resolver)
         dft_f, dft_t = compile_expr(args[1], resolver)
         if src_t != dft_t:
@@ -405,6 +423,10 @@ def _compile_function(expr: AttributeFunction, resolver) -> Compiled:
         return fn, AttrType.BOOL
 
     if name == "eventtimestamp":
+        if args:
+            raise CompileError(
+                f"eventTimestamp() takes no arguments, got {len(args)}")
+
         def fn(cols, ctx):
             return cols[TS_KEY], None
 

@@ -205,6 +205,12 @@ class StringParseCastStage(TransformStage):
         }
         for i in range(self._vals.shape[0], n):
             s = self._dict.decode(i)
+            if self._target == AttrType.BOOL:
+                # Boolean.parseBoolean: only (case-insensitive) 'true' is
+                # True; anything else — padded strings included — is
+                # False, never null
+                vals[i] = (s or "").lower() == "true"
+                continue
             try:
                 f = float(s)
                 if self._target in int_bounds:

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 from siddhi_tpu.query_api.annotations import Annotation
 from siddhi_tpu.query_api.definitions import (
     AggregationDefinition,
+    AttrType,
     FunctionDefinition,
     StreamDefinition,
     TableDefinition,
@@ -74,6 +75,18 @@ class SiddhiApp:
             if prev is None:
                 continue
             if k != kind:
+                if {k, kind} == {"stream", "trigger"}:
+                    # a trigger IS a `(triggered_time long)` stream — the id
+                    # may collide with a stream of exactly that shape
+                    # (TriggerTestCase testQuery3 vs testQuery4)
+                    sdef = prev if k == "stream" else d
+                    attrs = [(a.name, a.type)
+                             for a in getattr(sdef, "attributes", [])]
+                    if attrs == [("triggered_time", AttrType.LONG)]:
+                        continue
+                    raise DuplicateDefinitionException(
+                        f"trigger '{d.id}' collides with a stream of a "
+                        f"different attribute list")
                 raise DuplicateDefinitionException(
                     f"'{d.id}' is already defined as a {k}")
             prev_attrs = [(a.name, a.type)

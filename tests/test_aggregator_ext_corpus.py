@@ -1,0 +1,214 @@
+"""Reference attribute-aggregator corpus — scenarios ported verbatim from
+``query/aggregator/``: And/Or over lengthBatch flushes, maxForever/
+minForever running extremes, and arg-validation errors."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+class Collect(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(tuple(e.data) for e in events)
+
+
+def _run(app, stream, feed, out="outputStream"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collect()
+    rt.add_callback(out, c)
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for r in feed:
+        h.send(list(r))
+    m.shutdown()
+    return c.rows
+
+
+CSC = "define stream cscStream(messageID string, isFraud bool, price double);"
+
+
+def test_and_true_only():
+    """testAndAggregatorTrueOnlyScenario (AndAggregatorExtension:49-95)."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(3) "
+        "select messageID, and(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", True, 35.75]] * 3)
+    assert rows == [("messageId1", True)]
+
+
+def test_and_false_only():
+    """testAndAggregatorFalseOnlyScenario (:97-143)."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(4) "
+        "select messageID, and(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", False, 35.75]] * 4)
+    assert rows == [("messageId1", False)]
+
+
+def test_and_mixed():
+    """testAndAggregatorTrueFalseScenario (:145-191)."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(4) "
+        "select messageID, and(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", False, 35.75], ["messageId1", True, 35.75],
+         ["messageId1", False, 35.75], ["messageId1", True, 35.75]])
+    assert rows == [("messageId1", False)]
+
+
+def test_and_two_batches():
+    """testAndAggregatorMoreEventsBatchScenario (:193-241): each flush
+    re-evaluates from its own events."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(2) "
+        "select messageID, and(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", False, 35.75], ["messageId1", True, 35.75],
+         ["messageId1", True, 35.75], ["messageId1", True, 35.75]])
+    assert rows == [("messageId1", False), ("messageId1", True)]
+
+
+def test_or_true_only():
+    """testOrAggregatorTrueOnlyScenario (OrAggregatorExtension:49-95)."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(3) "
+        "select messageID, or(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", True, 35.75]] * 3)
+    assert rows == [("messageId1", True)]
+
+
+def test_or_false_only():
+    """testOrAggregatorFalseOnlyScenario (:97-143)."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(4) "
+        "select messageID, or(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", False, 35.75]] * 4)
+    assert rows == [("messageId1", False)]
+
+
+def test_or_mixed():
+    """testOrAggregatorTrueFalseScenario (:145-191)."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(4) "
+        "select messageID, or(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", False, 35.75], ["messageId1", True, 35.75],
+         ["messageId1", False, 35.75], ["messageId1", True, 35.75]])
+    assert rows == [("messageId1", True)]
+
+
+def test_or_two_batches():
+    """testORAggregatorMoreEventsBatchScenario (:193-243)."""
+    rows = _run(
+        CSC + "@info(name = 'query1') from cscStream#window.lengthBatch(2) "
+        "select messageID, or(isFraud) as isValidTransaction "
+        "group by messageID insert all events into outputStream;",
+        "cscStream",
+        [["messageId1", False, 35.75], ["messageId1", False, 35.75],
+         ["messageId1", True, 35.75], ["messageId1", True, 35.75]])
+    assert rows == [("messageId1", False), ("messageId1", True)]
+
+
+@pytest.mark.parametrize("agg", ["and", "or"])
+def test_bool_aggregator_rejects_non_bool(agg):
+    """andAggregatorTest5 / orAggregatorTest1 (:243+): and/or over a
+    string attribute fails at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (name string, isFraud bool);"
+            "@info(name = 'query1') from cseEventStream#window.lengthBatch(2) "
+            f"select {agg}(name) as x insert into outputStream;")
+    m.shutdown()
+
+
+def test_max_forever_double():
+    """testMaxForeverAggregatorExtension1 (MaxForever:47-110): running
+    max that never expires — windowless per-event outputs."""
+    rows = _run(
+        "define stream inputStream (price1 double,price2 double, "
+        "price3 double);"
+        "@info(name = 'query1') from inputStream "
+        "select maxForever(price1) as maxForeverValue "
+        "insert into outputStream;",
+        "inputStream",
+        [[36.0, 36.75, 35.75], [37.88, 38.12, 37.62], [39.00, 39.25, 38.62],
+         [36.88, 37.75, 36.75], [38.12, 38.12, 37.75], [38.12, 40.0, 37.75]])
+    assert [r[0] for r in rows] == [36.0, 37.88, 39.0, 39.0, 39.0, 39.0]
+
+
+def test_max_forever_int():
+    """testMaxForeverAggregatorExtension2 (:112-162)."""
+    rows = _run(
+        "define stream inputStream (price1 int,price2 int, price3 int);"
+        "@info(name = 'query1') from inputStream "
+        "select maxForever(price1) as maxForeverValue "
+        "insert into outputStream;",
+        "inputStream",
+        [[36, 38, 74], [78, 38, 37], [9, 39, 38]])
+    assert [r[0] for r in rows] == [36, 78, 78]
+
+
+def test_min_forever_double():
+    """testMinForeverAggregatorExtension1 (MinForever:47-110)."""
+    rows = _run(
+        "define stream inputStream (price1 double,price2 double, "
+        "price3 double);"
+        "@info(name = 'query1') from inputStream "
+        "select minForever(price1) as minForeverValue "
+        "insert into outputStream;",
+        "inputStream",
+        [[36.0, 36.75, 35.75], [37.88, 38.12, 37.62], [39.00, 39.25, 38.62],
+         [35.88, 37.75, 36.75]])
+    assert [r[0] for r in rows] == [36.0, 36.0, 36.0, 35.88]
+
+
+def test_min_forever_survives_window_expiry():
+    """minForever keeps the all-time extreme even when the carrying event
+    expires from a sliding window (the 'forever' semantics)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream S (v double);"
+        "@info(name = 'query1') from S#window.length(2) "
+        "select minForever(v) as mn insert into outputStream;")
+    c = Collect()
+    rt.add_callback("outputStream", c)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in [5.0, 9.0, 8.0, 7.0]:   # 5.0 expires after the 3rd event
+        h.send([v])
+    m.shutdown()
+    assert [r[0] for r in c.rows] == [5.0, 5.0, 5.0, 5.0]
+
+
+@pytest.mark.parametrize("sel", [
+    "max(weight, deviceId)",        # MaxAggregatorExtension:105-143
+    "min(weight, deviceId)",        # :144-182
+    "maxForever(weight, deviceId)",  # MaxForever:279+
+    "minForever(weight, deviceId)",  # MinForever:278+
+])
+def test_extreme_aggregators_reject_two_args(sel):
+    """max/min/maxForever/minForever accept exactly one argument."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (weight double, deviceId string);"
+            "@info(name = 'query1') from cseEventStream#window.lengthBatch(5) "
+            f"select {sel} as m insert into outputStream;")
+    m.shutdown()

@@ -1,0 +1,250 @@
+"""Reference built-in function corpus — scenarios ported verbatim from
+``query/function/``: coalesce/default/eventTimestamp (FunctionTestCase),
+the full convert() type matrix, ifThenElse, maximum/minimum, and uuid."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QC(QueryCallback):
+    def __init__(self):
+        self.events = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+
+
+def _run(app, stream, feed):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QC()
+    rt.add_callback("query1", q)
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for r in feed:
+        h.send(list(r))
+    m.shutdown()
+    return [e.data for e in q.events]
+
+
+def test_coalesce_same_type():
+    """functionTest1 (FunctionTestCase:57-117): first non-null of two
+    floats; both null -> null."""
+    rows = _run(
+        "define stream cseEventStream (symbol string, price1 float, "
+        "price2 float);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol, coalesce(price1, price2) as price "
+        "insert into StockQuote;",
+        "cseEventStream",
+        [["IBM", 55.6, 70.6], ["WSO2", 65.7, 12.8], ["WSO2", 23.6, None],
+         ["WSO2", None, 34.6], ["WSO2", None, None]])
+    assert [round(r[1], 4) if r[1] is not None else None for r in rows] == [
+        55.6, 65.7, 23.6, 34.6, None]
+
+
+def test_coalesce_in_filter():
+    """functionTest3 (:164-207): coalesce in the filter condition; the
+    all-null row fails the > comparison and is dropped."""
+    rows = _run(
+        "define stream cseEventStream (symbol string, price1 float, "
+        "price2 float, volume long, quantity int);"
+        "@info(name = 'query1') from "
+        "cseEventStream[coalesce(price1,price2) > 0f] select symbol, "
+        "coalesce(price1,price2) as price,quantity "
+        "insert into outputStream ;",
+        "cseEventStream",
+        [["WSO2", 50.0, 60.0, 60, 6], ["WSO2", 70.0, None, 40, 10],
+         ["WSO2", None, 44.0, 200, 56], ["WSO2", None, None, 200, 56]])
+    assert [r[1] for r in rows] == [50.0, 70.0, 44.0]
+
+
+def test_coalesce_no_args_rejected():
+    """functionTest4 (:208-251): coalesce() without arguments fails at
+    creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (symbol string, price1 float, "
+            "price2 float, volume long, quantity int);"
+            "@info(name = 'query1') from "
+            "cseEventStream[coalesce(price1,price2) > 0f] select symbol, "
+            "coalesce() as price,quantity insert into outputStream ;")
+    m.shutdown()
+
+
+@pytest.mark.parametrize("sel", [
+    "default(temp,0.0,deviceId)",    # testFunctionQuery5: 3 args
+    "default(temp,123)",             # testFunctionQuery6: type mismatch
+    "eventTimestamp(time)",          # testFunctionQuery7: takes no args
+])
+def test_function_arg_validation(sel):
+    """testFunctionQuery5/6/7 (FunctionTestCase:252-303): arg-count and
+    arg-type validation fails at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (temp double, roomNo int, "
+            "deviceId long, symbol string, time string);"
+            f"@info(name = 'query1') from cseEventStream "
+            f"select {sel} as x insert into outputStream;")
+    m.shutdown()
+
+
+def test_event_timestamp():
+    """testFunctionQuery7_1 (:304-340): eventTimestamp() returns the
+    event's own timestamp."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream fooStream (symbol string, time string);"
+        "@info(name = 'query1') from fooStream "
+        "select symbol as name, eventTimestamp() as eventTimestamp "
+        "insert into barStream;")
+    q = QC()
+    rt.add_callback("query1", q)
+    rt.start()
+    rt.get_input_handler("fooStream").send(10, ["WSO2", "t"])
+    m.shutdown()
+    assert q.events[0].data == ["WSO2", 10]
+
+
+def test_convert_type_matrix():
+    """convertFunctionTest2 (ConvertFunctionTestCase:88-183): every
+    source type converted to every target type; unparsable strings
+    become null, string->bool of non-'true' is False."""
+    sels = []
+    for src in ["typeS", "typeF", "typeD", "typeI", "typeL", "typeB"]:
+        for tgt in ["string", "float", "double", "int", "long", "bool"]:
+            sels.append(f"convert({src},'{tgt}') as v{len(sels)}")
+    rows = _run(
+        "define stream typeStream (typeS string, typeF float, "
+        "typeD double, typeI int, typeL long, typeB bool);"
+        "@info(name = 'query1') from typeStream select "
+        + ", ".join(sels) + " insert into outputStream;",
+        "typeStream",
+        [["WSO2", 2.0, 3.0, 4, 5, True]])
+    d = rows[0]
+    # string source: only string/bool produce values
+    assert d[0] == "WSO2"
+    assert d[1] is None and d[2] is None and d[3] is None and d[4] is None
+    assert d[5] is False
+    # float source 2.0
+    assert isinstance(d[6], str) and d[7] == 2.0 and d[8] == 2.0
+    assert d[9] == 2 and isinstance(d[9], int) and d[10] == 2
+    assert d[11] is False
+    # double source 3.0
+    assert d[13] == 3.0 and d[15] == 3 and d[17] is False
+    # int source 4
+    assert d[18] == "4" and d[19] == 4.0 and d[21] == 4 and d[23] is False
+    # long source 5
+    assert d[24] == "5" and d[27] == 5 and d[29] is False
+    # bool source true
+    assert isinstance(d[30], str) and d[35] is True
+
+
+def test_convert_to_bool_truthy():
+    """convertFunctionTest3 (:185-223): 'true', 1f, 1d, 1, 1L, true all
+    convert to bool True."""
+    rows = _run(
+        "define stream typeStream (typeS string, typeF float, "
+        "typeD double, typeI int, typeL long, typeB bool);"
+        "@info(name = 'query1') from typeStream "
+        "select convert(typeS,'bool') as b1, convert(typeF,'bool') as b2, "
+        "convert(typeD,'bool') as b3, convert(typeI,'bool') as b4, "
+        "convert(typeL,'bool') as b5, convert(typeB,'bool') as b6 "
+        "insert into outputStream;",
+        "typeStream",
+        [["true", 1.0, 1.0, 1, 1, True]])
+    assert rows[0] == [True] * 6
+
+
+@pytest.mark.parametrize("sel", [
+    "convert(typeS)",                 # test4: missing target
+    "convert(typeS,'string','int')",  # test5: too many args
+    "convert(typeS,'234')",           # test7: unknown target type name
+])
+def test_convert_validation(sel):
+    """convertFunctionTest4/5/7 (:225-300): malformed convert calls fail
+    at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream typeStream (typeS string, typeF float, "
+            "typeD double, typeI int, typeL long, typeB bool);"
+            f"@info(name = 'query1') from typeStream select {sel} as v "
+            "insert into outputStream;")
+    m.shutdown()
+
+
+def test_if_then_else():
+    """ifFunctionExtensionTestCase1 (IfThenElse:43-86)."""
+    rows = _run(
+        "define stream sensorEventStream (sensorValue double, "
+        "status string);"
+        "@info(name = 'query1') from sensorEventStream "
+        "select sensorValue, ifThenElse(sensorValue>35,'High','Low') "
+        "as status insert into outputStream;",
+        "sensorEventStream",
+        [[50.4, "x"], [20.4, "x"]])
+    assert [tuple(r) for r in rows] == [(50.4, "High"), (20.4, "Low")]
+
+
+@pytest.mark.parametrize("sel", [
+    "ifThenElse(sensorValue>35,'High',5)",   # branch type mismatch
+    "ifThenElse(35,'High','Low')",           # non-bool condition
+])
+def test_if_then_else_validation(sel):
+    """ifFunctionExtensionTestCase2/3 (:88-180)."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream sensorEventStream (sensorValue double, "
+            "status string);"
+            f"@info(name = 'query1') from sensorEventStream "
+            f"select sensorValue, {sel} as status insert into outputStream;")
+    m.shutdown()
+
+
+def test_maximum_per_row():
+    """testMaxFunctionExtension1 (MaximumFunctionExtension:48-103):
+    row-wise max of three columns."""
+    rows = _run(
+        "define stream inputStream (price1 double, price2 double, "
+        "price3 double);"
+        "@info(name = 'query1') from inputStream "
+        "select maximum(price1, price2, price3) as max "
+        "insert into outputStream;",
+        "inputStream",
+        [[36.0, 36.75, 35.75], [37.88, 38.12, 37.62], [39.00, 39.25, 38.62],
+         [36.88, 37.75, 36.75], [38.12, 38.12, 37.75], [38.12, 40.0, 37.75]])
+    assert [r[0] for r in rows] == [36.75, 38.12, 39.25, 37.75, 38.12, 40.0]
+
+
+def test_minimum_per_row():
+    """testMinFunctionExtension1 (MinimumFunctionExtension:48-103)."""
+    rows = _run(
+        "define stream inputStream (price1 double, price2 double, "
+        "price3 double);"
+        "@info(name = 'query1') from inputStream "
+        "select minimum(price1, price2, price3) as min "
+        "insert into outputStream;",
+        "inputStream",
+        [[36.0, 36.75, 35.75], [37.88, 38.12, 37.62], [39.00, 39.25, 38.62]])
+    assert [r[0] for r in rows] == [35.75, 37.62, 38.62]
+
+
+def test_uuid_generates_distinct():
+    """UUIDFunctionTestCase (:44-80): uuid() yields a distinct string per
+    event."""
+    rows = _run(
+        "define stream S (symbol string);"
+        "@info(name = 'query1') from S select symbol, uuid() as id "
+        "insert into outputStream;",
+        "S",
+        [["a"], ["b"], ["c"]])
+    ids = [r[1] for r in rows]
+    assert len(set(ids)) == 3
+    assert all(isinstance(i, str) and len(i) == 36 for i in ids)
