@@ -248,3 +248,52 @@ def test_uuid_generates_distinct():
     ids = [r[1] for r in rows]
     assert len(set(ids)) == 3
     assert all(isinstance(i, str) and len(i) == 36 for i in ids)
+
+
+# --------------------------------------------- ExtensionTestCase corpus
+
+
+def test_custom_function_extension():
+    """extensionTest2 (ExtensionTestCase:84-126): a registered custom
+    scalar function (`custom:plus`) runs in the select."""
+    from siddhi_tpu.extension import ScalarFunction
+    from siddhi_tpu.query_api.definitions import AttrType
+
+    class Plus(ScalarFunction):
+        return_type = AttrType.LONG
+
+        @staticmethod
+        def apply(xp, a, b):
+            return a + b
+
+    m = SiddhiManager()
+    m.set_extension("function:custom:plus", Plus)
+    rt = m.create_siddhi_app_runtime(
+        "define stream cseEventStream (symbol string, price long, "
+        "volume long);"
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol , custom:plus(price,volume) as totalCount "
+        "insert into mailOutput;")
+    q = QC()
+    rt.add_callback("query1", q)
+    rt.start()
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 700, 100])
+    h.send(["WSO2", 605, 200])
+    h.send(["ABC", 60, 200])
+    m.shutdown()
+    assert [e.data[1] for e in q.events] == [800, 805, 260]
+
+
+def test_unknown_extension_rejected():
+    """extensionTest3 (ExtensionTestCase:127-170): referencing an
+    unregistered namespace:function fails at creation."""
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(
+            "define stream cseEventStream (symbol string, price long, "
+            "volume long);"
+            "@info(name = 'query1') from cseEventStream "
+            "select price , email:getAllNew(symbol,'') as toConcat "
+            "insert into mailOutput;")
+    m.shutdown()
