@@ -366,6 +366,11 @@ class OnDemandFindRuntime:
             sel_cols[GK_KEY] = jnp.asarray(keyer(host_cols))
             plan.num_keys = max(16, len(keyer))
 
+        if plan.needs_str_rank:
+            # string order-by keys sort lexicographically, not by id
+            from siddhi_tpu.core.plan.selector_plan import STR_RANK
+
+            sel_cols[STR_RANK] = jnp.asarray(dictionary.rank_table())
         state = plan.init_state()
         _state, out = plan.apply(
             state, sel_cols, {"xp": jnp, "current_time": jnp.int64(0)})

@@ -158,8 +158,13 @@ class StatisticsManager:
 
     def start_reporting(self, scheduler):
         if self.reporter == "console" and scheduler is not None:
+            # with statistics OFF the tick prints nothing (the reference
+            # stops its reporter when stats are disabled —
+            # StatisticsTestCase test2)
             self._job = scheduler.schedule_periodic(
-                self.interval_ms, lambda ts: print(self.format_report()))
+                self.interval_ms,
+                lambda ts: print(self.format_report())
+                if self.level > OFF else None)
 
     def stop_reporting(self, scheduler):
         if self._job is not None and scheduler is not None:

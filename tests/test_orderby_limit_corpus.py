@@ -280,3 +280,22 @@ def test_negative_limit_offset_rejected(clause):
             f"select symbol, price, volume order by price asc {clause} "
             "insert into outputStream;")
     m.shutdown()
+
+
+def test_on_demand_string_order_by():
+    """On-demand reads order string columns lexicographically too (same
+    rank-table path as live queries)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream S (sym string, v int);"
+        "define table T (sym string, v int);"
+        "@info(name = 'q') from S insert into T;")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in [["zeta", 1], ["alpha", 2], ["mid", 3]]:
+        h.send(row)
+    events = rt.query("from T select sym, v order by sym;")
+    assert [e.data[0] for e in events] == ["alpha", "mid", "zeta"]
+    events = rt.query("from T select sym, v order by sym desc;")
+    assert [e.data[0] for e in events] == ["zeta", "mid", "alpha"]
+    m.shutdown()

@@ -88,3 +88,72 @@ def test_profiler_trace_roundtrip(tmp_path):
     for root, _dirs, files in os.walk(d):
         found += files
     assert found  # trace events written
+
+
+def test_console_reporter_detail_report(capsys):
+    """statisticsTest1 (managment/StatisticsTestCase:53-107): the console
+    reporter at DETAIL level prints throughput, latency, and memory
+    metrics; both filter queries stay live (3 outputs)."""
+    import time
+
+    got = []
+
+    class C(StreamCallback):
+        def receive(self, events):
+            got.extend(events)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:statistics(reporter = 'console', interval = '1 sec' )"
+        " define stream cseEventStream (symbol string, price float, "
+        "volume int);"
+        "define stream cseEventStream2 (symbol string, price float, "
+        "volume int);"
+        "@info(name = 'query1') from cseEventStream[70 > price] select * "
+        "insert into outputStream ;"
+        "@info(name = 'query2') from cseEventStream[volume > 90] select * "
+        "insert into outputStream ;")
+    rt.add_callback("outputStream", C())
+    rt.start()
+    rt.set_statistics_level("detail")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["WSO2", 55.6, 100])
+    h.send(["IBM", 75.6, 100])
+    time.sleep(1.6)           # let the periodic reporter fire once
+    m.shutdown()
+    assert len(got) == 3
+    assert all(e.data[0] in ("IBM", "WSO2") for e in got)
+    out = capsys.readouterr().out
+    assert "query1" in out and "latency" in out.lower()
+    assert "memory" in out.lower()
+    assert "cseEventStream" in out
+
+
+def test_console_reporter_off_level_silent(capsys):
+    """statisticsTest2 (:122-192): with statistics OFF nothing is
+    reported but events still flow."""
+    import time
+
+    got = []
+
+    class C(StreamCallback):
+        def receive(self, events):
+            got.extend(events)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:statistics(reporter = 'console', interval = '1 sec' )"
+        " define stream cseEventStream (symbol string, price float, "
+        "volume int);"
+        "@info(name = 'query1') from cseEventStream[70 > price] select * "
+        "insert into outputStream ;")
+    rt.add_callback("outputStream", C())
+    rt.start()
+    rt.set_statistics_level("off")
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["WSO2", 55.6, 100])
+    time.sleep(1.3)
+    m.shutdown()
+    assert len(got) == 1
+    out = capsys.readouterr().out
+    assert "latency" not in out.lower()
