@@ -189,8 +189,8 @@ class SiddhiAppRuntime:
         self._extensions = {**siddhi_context.extensions, **self._script_functions}
         _expr_mod.set_active_extensions(self._extensions)
 
-        for sid, sdef in self.stream_definitions.items():
-            self._create_junction(sdef)
+        for sid, sdef in list(self.stream_definitions.items()):
+            self._create_junction(sdef)   # may register '!sid' fault streams
 
         # tables, named windows, triggers (reference
         # SiddhiAppRuntimeBuilder.defineTable/defineWindow/defineTrigger)
@@ -312,6 +312,22 @@ class SiddhiAppRuntime:
                 if max_delay else None,
                 latency_target_ms=_parse_time_str(latency_target)
                 if latency_target else None)
+        onerr = find_annotation(sdef.annotations, "OnError")
+        if onerr is not None and (
+                onerr.element("action") or "log").lower() == "stream":
+            # @OnError(action='stream'): failing events route to the
+            # '!stream' fault junction with an appended `_error` column
+            # (reference StreamJunction.handleError +
+            # FaultStreamEventConverter — FaultStreamTestCase test3-5)
+            fdef = StreamDefinition(
+                id="!" + sdef.id,
+                attributes=list(sdef.attributes)
+                + [Attribute("_error", AttrType.STRING)])
+            fj = StreamJunction(fdef, self.app_context)
+            self.junctions[fdef.id] = fj
+            self.stream_definitions[fdef.id] = fdef
+            j.fault_junction = fj
+            j.on_error_action = "STREAM"
         self.junctions[sdef.id] = j
         return j
 

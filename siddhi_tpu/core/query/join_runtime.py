@@ -32,7 +32,7 @@ import numpy as np
 from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
 from siddhi_tpu.core.plan.selector_plan import FLUSH_KEY, GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
-from siddhi_tpu.core.stream.junction import Receiver
+from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
 from siddhi_tpu.ops.expressions import (
     PK_KEY,
     TS_KEY,
@@ -668,7 +668,7 @@ class JoinQueryRuntime(QueryRuntime):
             nt = out_host.pop("__notify__", None)
             notify = int(nt) if nt is not None else -1
         if overflow > 0:
-            raise RuntimeError(f"query '{self.name}': {overflow_msg}")
+            raise FatalQueryError(f"query '{self.name}': {overflow_msg}")
         out_host = self._host_keyed_select(out_host)
         self._emit(HostBatch(out_host))
         if notify >= 0:

@@ -24,7 +24,7 @@ import numpy as np
 from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
 from siddhi_tpu.core.plan.selector_plan import GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
-from siddhi_tpu.core.stream.junction import Receiver
+from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
 from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
 from siddhi_tpu.ops.nfa import NFAStage
 from siddhi_tpu.query_api.definitions import StreamDefinition
@@ -329,7 +329,7 @@ class NFAQueryRuntime(QueryRuntime):
             nt = out_host.pop("__notify__", None)
             notify = int(nt) if nt is not None else -1
         if overflow > 0:
-            raise RuntimeError(
+            raise FatalQueryError(
                 f"query '{self.name}': pattern match-slot capacity exceeded — "
                 f"raise app_context.nfa_slots before creating the runtime"
             )

@@ -22,7 +22,7 @@ import numpy as np
 from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, LazyColumns, StringDictionary
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
-from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
+from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver, StreamJunction
 from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
 from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
 
@@ -581,7 +581,7 @@ class QueryRuntime(Receiver):
         if meta is not None and int(np.asarray(meta)[0]) != 0:
             # the selector step's own overflow (distinctCount value-table
             # saturation) must not be silently clamped on the split path
-            raise RuntimeError(
+            raise FatalQueryError(
                 "selector aggregation overflow — raise "
                 "app_context.distinct_values_capacity")
         return out
@@ -633,7 +633,7 @@ class QueryRuntime(Receiver):
             notify = int(meta[1])
             size_hint = int(meta[2])
             if overflow > 0:
-                raise RuntimeError(
+                raise FatalQueryError(
                     f"query '{self.name}': {overflow_msg} before creating the runtime")
             if t0 is not None:
                 import time as _time
@@ -646,7 +646,7 @@ class QueryRuntime(Receiver):
             return None
         overflow = out_host.pop("__overflow__", None)
         if overflow is not None and int(overflow) > 0:
-            raise RuntimeError(
+            raise FatalQueryError(
                 f"query '{self.name}': {overflow_msg} before creating the runtime"
             )
         notify = out_host.pop("__notify__", None)
@@ -687,7 +687,7 @@ class QueryRuntime(Receiver):
                 if notify >= 0:
                     notify_min = notify if notify_min is None else min(notify_min, notify)
             if overflow_err is not None:
-                raise RuntimeError(
+                raise FatalQueryError(
                     f"query '{self.name}': {overflow_err} before creating "
                     f"the runtime")
             return notify_min

@@ -25,6 +25,13 @@ from siddhi_tpu.query_api.definitions import StreamDefinition
 log = logging.getLogger(__name__)
 
 
+class FatalQueryError(RuntimeError):
+    """Framework-infrastructure failure (dense-capacity overflow knobs):
+    unlike per-event processing errors — which the junction logs/routes
+    per @OnError like the reference — these always propagate to the
+    sender."""
+
+
 class Receiver:
     """Subscriber interface (reference StreamJunction.Receiver)."""
 
@@ -54,6 +61,7 @@ class StreamJunction:
         self._latency_target_ms: Optional[float] = None
         self._lat_ewma = 0.0
         self._running = False
+        self._fatal: Optional[Exception] = None  # async worker's FatalQueryError
 
     def subscribe(self, receiver: Receiver):
         if receiver not in self.receivers:
@@ -107,6 +115,10 @@ class StreamJunction:
         sm = self.app_context.statistics_manager
         if sm is not None and sm.level >= 1:
             sm.throughput_tracker(self.definition.id).add(len(events))
+        if self._fatal is not None:
+            # the async worker died on a framework failure — surface it to
+            # the producer instead of blocking on a queue nobody drains
+            raise self._fatal
         if self._async and self._running:
             self._queue.put(events)
         else:
@@ -127,6 +139,8 @@ class StreamJunction:
         sm = self.app_context.statistics_manager
         if sm is not None and sm.level >= 1:
             sm.throughput_tracker(self.definition.id).add(int(batch.size))
+        if self._fatal is not None:
+            raise self._fatal
         if self._async and self._running:
             self._queue.put(batch)
         else:
@@ -217,6 +231,17 @@ class StreamJunction:
                 self.handle_error(events, e)
 
     def handle_error(self, events: List[Event], e: Exception):
+        from siddhi_tpu.ops.expressions import CompileError
+
+        if isinstance(e, (FatalQueryError, CompileError)):
+            # framework-infrastructure failures (capacity overflow knobs)
+            # and deferred compile errors (first-trace design diagnostics)
+            # always surface to the sender — routing them to a fault
+            # stream would hide a misconfigured deployment. On an @Async
+            # junction the raise unwinds the worker; the stored error makes
+            # every later send re-raise instead of hanging on a full queue.
+            self._fatal = e
+            raise e
         if self.on_error_action == "STREAM" and self.fault_junction is not None:
             # fault stream schema = original attrs + _error (reference
             # FaultStreamEventConverter)
@@ -225,8 +250,10 @@ class StreamJunction:
             ]
             self.fault_junction.send_events(fault_events)
         else:
+            # default/LOG action: log and DROP — the reference's
+            # StreamJunction never propagates processing errors back to
+            # the sender (FaultStreamTestCase test1/test2)
             log.error(
                 "error processing events in stream '%s': %s\n%s",
                 self.definition.id, e, traceback.format_exc(),
             )
-            raise e

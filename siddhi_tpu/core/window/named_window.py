@@ -103,7 +103,9 @@ class NamedWindowRuntime(Receiver):
                 overflow = out_host.pop("__overflow__", None)
                 notify = out_host.pop("__notify__", None)
             if overflow is not None and int(overflow) > 0:
-                raise RuntimeError(
+                from siddhi_tpu.core.stream.junction import FatalQueryError
+
+                raise FatalQueryError(
                     f"window '{self.definition.id}': buffer capacity exceeded — "
                     f"raise app_context.window_capacity before creating the runtime"
                 )

@@ -1,0 +1,159 @@
+"""Reference fault-stream corpus — scenarios ported verbatim from
+``stream/FaultStreamTestCase.java``: default log-and-drop error handling,
+@OnError(action='log'|'stream'), `!stream` fault routing with the
+appended `_error` column, and sender-side non-propagation."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.query.callback import QueryCallback
+from siddhi_tpu.extension import ScalarFunction
+from siddhi_tpu.query_api.definitions import AttrType
+
+
+class FaultFn(ScalarFunction):
+    """The reference's FaultFunctionExtension: throws on every call."""
+
+    return_type = AttrType.LONG
+
+    @staticmethod
+    def apply(xp, *args):
+        raise RuntimeError("Error when running faultAdd()")
+
+
+class QCount(QueryCallback):
+    def __init__(self):
+        self.events = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+
+
+class SCollect(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def _mk(app):
+    m = SiddhiManager()
+    m.set_extension("function:custom:fault", FaultFn)
+    rt = m.create_siddhi_app_runtime(app)
+    return m, rt
+
+
+FAULTY_QUERY = (
+    "@info(name = 'query1') "
+    "from cseEventStream[custom:fault() > volume] "
+    "select symbol, price , symbol as sym1 "
+    "insert into outputStream ;")
+
+
+def test_default_logs_and_drops(caplog):
+    """faultStreamTest1 (:61-106): without @OnError the error is logged,
+    the event dropped, and send() does NOT raise."""
+    m, rt = _mk(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);" + FAULTY_QUERY)
+    q = QCount()
+    rt.add_callback("query1", q)
+    rt.start()
+    with caplog.at_level("ERROR"):
+        rt.get_input_handler("cseEventStream").send(["IBM", 0.0, 100])
+    m.shutdown()
+    assert q.events == []
+    assert any("faultAdd" in r.message or "faultAdd" in str(r.exc_info)
+               or "error processing events" in r.message
+               for r in caplog.records)
+
+
+def test_onerror_log_action(caplog):
+    """faultStreamTest2 (:109-155): @OnError(action='log') behaves like
+    the default."""
+    m, rt = _mk(
+        "@OnError(action='log')"
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);" + FAULTY_QUERY)
+    q = QCount()
+    rt.add_callback("query1", q)
+    rt.start()
+    with caplog.at_level("ERROR"):
+        rt.get_input_handler("cseEventStream").send(["IBM", 0.0, 100])
+    m.shutdown()
+    assert q.events == []
+    assert any("error processing events" in r.message
+               for r in caplog.records)
+
+
+def test_onerror_stream_no_subscriber():
+    """faultStreamTest3 (:157-203): @OnError(action='stream') with nobody
+    on the fault stream — event vanishes quietly, nothing raises."""
+    m, rt = _mk(
+        "@OnError(action='stream')"
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);" + FAULTY_QUERY)
+    q = QCount()
+    rt.add_callback("query1", q)
+    rt.start()
+    rt.get_input_handler("cseEventStream").send(["IBM", 0.0, 100])
+    m.shutdown()
+    assert q.events == []
+
+
+def test_fault_stream_query():
+    """faultStreamTest4 (:206-255): a `from !cseEventStream` query sees
+    the failing event with its original attributes."""
+    m, rt = _mk(
+        "@OnError(action='stream')"
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);" + FAULTY_QUERY +
+        "@info(name = 'query2') from !cseEventStream select * "
+        "insert into faultStream;")
+    c = SCollect()
+    rt.add_callback("faultStream", c)
+    rt.start()
+    rt.get_input_handler("cseEventStream").send(["IBM", 0.0, 100])
+    m.shutdown()
+    assert len(c.events) == 1
+    assert c.events[0].data[0] == "IBM"
+    assert c.events[0].data[3] is not None   # _error carries the cause
+
+
+def test_fault_stream_direct_callback():
+    """faultStreamTest5 (:258-293): subscribing to '!cseEventStream'
+    directly delivers the failing event; data[3] is the error text."""
+    m, rt = _mk(
+        "@OnError(action='stream')"
+        "define stream cseEventStream (symbol string, price float, "
+        "volume long);" + FAULTY_QUERY)
+    c = SCollect()
+    rt.add_callback("!cseEventStream", c)
+    rt.start()
+    rt.get_input_handler("cseEventStream").send(["IBM", 0.0, 100])
+    m.shutdown()
+    assert len(c.events) == 1
+    assert c.events[0].data[3] is not None
+    assert "faultAdd" in c.events[0].data[3]
+
+
+def test_capacity_overflow_still_raises():
+    """Our framework-infrastructure failures (dense capacity knobs) keep
+    propagating to the sender even under the log-and-drop default."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream S (v long);"
+        "@info(name = 'q') from S#window.length(100) "
+        "select distinctCount(v) as n insert into O;")
+    q = next(iter(rt.query_runtimes.values()))
+    for spec in q.selector_plan.specs:
+        spec.distinct_capacity = 4
+    rt.start()
+    h = rt.get_input_handler("S")
+    with pytest.raises(RuntimeError, match="distinct_values_capacity"):
+        for i in range(10):
+            h.send([i])
+    m.shutdown()
