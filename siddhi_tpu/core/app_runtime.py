@@ -651,12 +651,27 @@ class SiddhiAppRuntime:
             # table sides have no proxy; named-window sides subscribe to the
             # window's emission junction, stream sides to their junction
             proxies = runtime.make_proxies()
+            _left_sid = query.input_stream.left.unique_stream_id
+            _right_sid = query.input_stream.right.unique_stream_id
             for side_key, s in (("left", query.input_stream.left),
                                 ("right", query.input_stream.right)):
                 if side_key not in proxies:
                     continue
                 sid = s.unique_stream_id
                 if sid in self.named_windows:
+                    if _left_sid == _right_sid:
+                        # a window joined with ITSELF processes each
+                        # emission through ONE side chain only (reference
+                        # MultiProcessStreamReceiver with processCount=1 —
+                        # JoinInputStreamParser.java:129-135; both sides
+                        # triggering would emit every match twice). Keep
+                        # the TRIGGERING side (unidirectional joins pin it).
+                        from siddhi_tpu.query_api.execution import EventTrigger
+
+                        keep = ("right" if query.input_stream.trigger
+                                == EventTrigger.RIGHT else "left")
+                        if side_key != keep:
+                            continue
                     self.named_windows[sid].out_junction.subscribe(proxies[side_key])
                 elif (partition_ctx is not None and s.is_inner_stream):
                     if sid not in partition_ctx.inner_junctions:

@@ -974,6 +974,15 @@ def plan_query(
                 if log_stage is not None:
                     log_stages.append(log_stage)
 
+    if (window_stage is None and host_window is None
+            and stream_id in getattr(app_context, "named_windows", {})):
+        # a consumer of a BATCH-type named window receives its flush chunks:
+        # the selector collapses aggregates per chunk exactly like reading
+        # the batch window directly (CustomJoinWindowTestCase
+        # testMultipleStreamsToWindow: one output per lengthBatch flush)
+        w = app_context.named_windows[stream_id]
+        batch_mode = bool(getattr(w.stage, "batch_mode", False))
+
     output_event_type = query.output_stream.output_event_type if query.output_stream else "current"
     if isinstance(query.output_rate, SnapshotOutputRate):
         # snapshot rate limiting disables the selector's batch collapse

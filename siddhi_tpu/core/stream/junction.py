@@ -105,7 +105,16 @@ class StreamJunction:
     def stop_processing(self):
         self._running = False
         if self._worker is not None:
-            self._queue.put(None)
+            if self._fatal is None:
+                self._queue.put(None)
+            else:
+                # the worker died on a fatal error with producers possibly
+                # having filled the queue — a blocking put would hang
+                # shutdown on a queue nobody drains
+                try:
+                    self._queue.put_nowait(None)
+                except queue.Full:
+                    pass
             self._worker.join(timeout=5)
             self._worker = None
 

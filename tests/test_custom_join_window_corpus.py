@@ -1,0 +1,180 @@
+"""Reference custom-join-window corpus — scenarios ported verbatim from
+``window/CustomJoinWindowTestCase.java``: named windows joined with
+tables, other named windows, raw streams, themselves, and fed from many
+producer streams."""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.query.callback import QueryCallback
+
+
+class QC(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+class SC(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+
+def test_join_window_with_table():
+    """testJoinWindowWithTable (CustomJoinWindowTestCase:55-125)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream StockStream (symbol string, price float, "
+        "volume long); "
+        "define stream CheckStockStream (symbol string); "
+        "define window CheckStockWindow(symbol string) length(1) "
+        "output all events; "
+        "define table StockTable (symbol string, price float, "
+        "volume long); "
+        "@info(name = 'query0') from StockStream insert into StockTable ;"
+        "@info(name = 'query1') from CheckStockStream "
+        "insert into CheckStockWindow ;"
+        "@info(name = 'query2') from CheckStockWindow join StockTable "
+        " on CheckStockWindow.symbol==StockTable.symbol "
+        "select CheckStockWindow.symbol as checkSymbol, "
+        "StockTable.symbol as symbol, StockTable.volume as volume  "
+        "insert into OutputStream ;")
+    q = QC()
+    rt.add_callback("query2", q)
+    rt.start()
+    rt.get_input_handler("StockStream").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("StockStream").send(["IBM", 75.6, 10])
+    rt.get_input_handler("CheckStockStream").send(["WSO2"])
+    m.shutdown()
+    assert len(q.events) == 1
+    assert q.events[0].data == ["WSO2", "WSO2", 100]
+    assert q.expired == []
+
+
+def test_join_window_with_window():
+    """testJoinWindowWithWindow (:127-185): two named windows joined on
+    roomNo — two temps above 30 match their regulators."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream TempStream(deviceID long, roomNo int, "
+        "temp double); "
+        "define stream RegulatorStream(deviceID long, roomNo int, "
+        "isOn bool); "
+        "define window TempWindow(deviceID long, roomNo int, "
+        "temp double) time(1 min); "
+        "define window RegulatorWindow(deviceID long, roomNo int, "
+        "isOn bool) length(1); "
+        "@info(name = 'query1') from TempStream[temp > 30.0] "
+        "insert into TempWindow; "
+        "@info(name = 'query2') from RegulatorStream[isOn == false] "
+        "insert into RegulatorWindow; "
+        "@info(name = 'query3') from TempWindow "
+        "join RegulatorWindow "
+        "on TempWindow.roomNo == RegulatorWindow.roomNo "
+        "select TempWindow.roomNo, RegulatorWindow.deviceID, "
+        "'start' as action insert into RegulatorActionStream;")
+    c = SC()
+    rt.add_callback("RegulatorActionStream", c)
+    rt.start()
+    t = rt.get_input_handler("TempStream")
+    r = rt.get_input_handler("RegulatorStream")
+    for room, temp in [(1, 20.0), (2, 25.0), (3, 30.0), (4, 35.0),
+                       (5, 40.0)]:
+        t.send([100, room, temp])
+    for room in range(1, 6):
+        r.send([100, room, False])
+    m.shutdown()
+    assert len(c.events) == 2
+    assert sorted(e.data[0] for e in c.events) == [4, 5]
+
+
+def test_join_window_with_stream():
+    """testJoinWindowWithStream (:187-241): a named window joined with a
+    filtered raw-stream side."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream TempStream(deviceID long, roomNo int, "
+        "temp double); "
+        "define stream RegulatorStream(deviceID long, roomNo int, "
+        "isOn bool); "
+        "define window TempWindow(deviceID long, roomNo int, "
+        "temp double) time(1 min); "
+        "@info(name = 'query1') from TempStream[temp > 30.0] "
+        "insert into TempWindow;"
+        "@info(name = 'query2') from TempWindow "
+        "join RegulatorStream[isOn == false]#window.length(1) as R "
+        "on TempWindow.roomNo == R.roomNo "
+        "select TempWindow.roomNo, R.deviceID, 'start' as action "
+        "insert into RegulatorActionStream;")
+    c = SC()
+    rt.add_callback("RegulatorActionStream", c)
+    rt.start()
+    t = rt.get_input_handler("TempStream")
+    r = rt.get_input_handler("RegulatorStream")
+    for room, temp in [(1, 20.0), (2, 25.0), (3, 30.0), (4, 35.0),
+                       (5, 40.0)]:
+        t.send([100, room, temp])
+    for room in range(1, 6):
+        r.send([100, room, False])
+    m.shutdown()
+    assert len(c.events) == 2
+    assert sorted(e.data[0] for e in c.events) == [4, 5]
+
+
+def test_multiple_streams_into_one_window():
+    """testMultipleStreamsToWindow (:243-296): six producer streams feed
+    one lengthBatch(5) window; the flush aggregates across them."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "".join(f"define stream Stream{i} (symbol string, price float, "
+                f"volume long); " for i in range(1, 7))
+        + "define window StockWindow (symbol string, price float, "
+        "volume long) lengthBatch(5); "
+        + "".join(f"from Stream{i} insert into StockWindow; "
+                  for i in range(1, 7))
+        + "@info(name = 'query1') from StockWindow "
+        "select symbol, sum(price) as totalPrice, sum(volume) as volumes "
+        "insert into OutputStream; ")
+    c = SC()
+    rt.add_callback("OutputStream", c)
+    rt.start()
+    for i in range(1, 7):
+        rt.get_input_handler(f"Stream{i}").send(["WSO2", i * 10.0, 1])
+    m.shutdown()
+    assert len(c.events) == 1
+    assert c.events[0].data == ["WSO2", 150.0, 5]
+
+
+def test_join_window_with_itself():
+    """testJoinWindowWithSameWindow (:654-700): a length(2) named window
+    self-joined on symbol; 3 current matches and 1 expired-side match."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume int); "
+        "define window cseEventWindow (symbol string, price float, "
+        "volume int) length(2); "
+        "@info(name = 'query0') from cseEventStream "
+        "insert into cseEventWindow; "
+        "@info(name = 'query1') from cseEventWindow as a "
+        "join cseEventWindow as b on a.symbol== b.symbol "
+        "select a.symbol as symbol, a.price as priceA, b.price as priceB "
+        "insert all events into outputStream ;")
+    q = QC()
+    rt.add_callback("query1", q)
+    rt.start()
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 75.6, 100])
+    h.send(["WSO2", 57.6, 100])
+    h.send(["IBM", 59.6, 100])
+    m.shutdown()
+    assert len(q.events) == 3
+    assert len(q.expired) == 1
