@@ -6,6 +6,7 @@ and ``store/OnDemandQueryWindowTestCase.java`` (on-demand reads over
 import pytest
 
 from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.query.callback import QueryCallback
 
 
 @pytest.mark.parametrize("defn", [
@@ -96,3 +97,63 @@ def test_on_demand_window_projection_and_group():
                       "select symbol, volume group by symbol ")
     assert len(events) == 2
     m.shutdown()
+
+
+class _QC(QueryCallback):
+    def __init__(self):
+        self.events = []
+        self.expired = []
+
+    def receive(self, timestamp, in_events, remove_events):
+        if in_events:
+            self.events.extend(in_events)
+        if remove_events:
+            self.expired.extend(remove_events)
+
+
+def test_named_length_window_under_capacity():
+    """testLengthWindow1 (window/LengthWindowTestCase:60-94): fewer events
+    than the window size — only CURRENT emissions, in arrival order."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume int); "
+        "define window cseWindow (symbol string, price float, volume int) "
+        "length(4) output all events; "
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol,price,volume insert into cseWindow ;"
+        "@info(name = 'query2') from cseWindow insert into outputStream ;")
+    q = _QC()
+    rt.add_callback("query2", q)
+    rt.start()
+    h = rt.get_input_handler("cseEventStream")
+    h.send(["IBM", 700.0, 0])
+    h.send(["WSO2", 60.5, 1])
+    m.shutdown()
+    assert [e.data[2] for e in q.events] == [0, 1]
+    assert q.expired == []
+
+
+def test_named_length_window_over_capacity():
+    """testLengthWindow2 (:96-145): past the window size each insert also
+    expires the oldest — 6 current + 2 expired for 6 sends into
+    length(4), expirations starting at the 5th event."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream cseEventStream (symbol string, price float, "
+        "volume int); "
+        "define window cseWindow (symbol string, price float, volume int) "
+        "length(4) output all events; "
+        "@info(name = 'query1') from cseEventStream "
+        "select symbol,price,volume insert into cseWindow ;"
+        "@info(name = 'query2') from cseWindow "
+        "insert all events into outputStream ;")
+    q = _QC()
+    rt.add_callback("query2", q)
+    rt.start()
+    h = rt.get_input_handler("cseEventStream")
+    for i in range(1, 7):
+        h.send(["IBM" if i % 2 else "WSO2", 700.0, i])
+    m.shutdown()
+    assert [e.data[2] for e in q.events] == [1, 2, 3, 4, 5, 6]
+    assert [e.data[2] for e in q.expired] == [1, 2]
