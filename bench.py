@@ -301,23 +301,38 @@ def bench_host_pipeline():
     return eps_pipeline, eps_csv
 
 
+_PARTITIONED_APP = """
+define stream StockStream (symbol string, price float, volume long);
+partition with (symbol of StockStream)
+begin
+  @info(name = 'bench')
+  from StockStream#window.length({W})
+  select symbol, avg(price) as avgPrice, sum(volume) as totalVolume
+  insert into OutStream;
+end;
+""".format(W=WINDOW)
+
+
 def bench_mesh_scaling():
-    """Strong scaling of the flagship query step over a device mesh:
-    the same 10k-key length(1000)->avg/sum step with its keyed selector
-    state sharded over n = 1/2/4/8 mesh devices (parallel/mesh.py
-    shard_query_step — NamedSharding on the key axis, XLA inserts the
-    collectives). Tunnel-independent: runs on the 8-device virtual CPU
-    mesh (force_host_devices), so the curve lands on the record even when
-    the TPU tunnel is wedged. On virtual CPU devices all shards share one
-    host's cores — the curve measures sharding/collective overhead, not
-    real speedup; on a real v5e slice the same code divides the key space
-    across chips."""
+    """Strong scaling of the partitioned flagship (per-key length(1000)
+    window -> avg/sum over 10k keys) with the key space sharded over
+    n = 1/2/4/8 mesh devices via the zero-collective ``shard_map`` path:
+    the host router (``route_batch_to_shards``) scatters each batch's rows
+    to the shard owning their key, and each device steps its own
+    ``[K/n]``-keyed state — no per-step collectives at all (audited by
+    tools/hlo_audit.py; the round-4 replicated-batch path all-gathered per
+    step and scaled INVERSELY). Tunnel-independent: runs on the 8-device
+    virtual CPU mesh, where shards share one host's cores — the curve
+    bounds sharding overhead rather than demonstrating speedup; on a real
+    slice the same code divides key state and row traffic across chips.
+    Host routing cost is charged inside the measured loop."""
     import jax
 
     from siddhi_tpu import SiddhiManager
     from siddhi_tpu.core.plan.selector_plan import GK_KEY
-    from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
-    from siddhi_tpu.parallel.mesh import make_mesh, shard_query_step
+    from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
+    from siddhi_tpu.parallel.mesh import (
+        make_mesh, route_batch_to_shards, shard_keyed_query_step)
 
     rng = np.random.default_rng(5)
     B = BATCH
@@ -335,26 +350,39 @@ def bench_mesh_scaling():
             "volume": rng.integers(1, 1000, B, dtype=np.int64),
             "volume?": np.zeros(B, bool),
             GK_KEY: sym.astype(np.int32),
+            PK_KEY: sym.astype(np.int32),
         }
+
+    def _pow2(n):
+        k = 16
+        while k < n:
+            k *= 2
+        return k
 
     batches = [make_batch(i) for i in range(4)]
     eps_by_devices = {}
     for n_dev in (1, 2, 4, 8):
         manager = SiddhiManager()
-        rt = manager.create_siddhi_app_runtime(_APP)
+        rt = manager.create_siddhi_app_runtime(_PARTITIONED_APP)
         rt.start()
         q = rt.query_runtimes["bench"]
-        q.selector_plan.num_keys = 16_384
-        step, state = shard_query_step(q, make_mesh(n_dev))
+        local_k = _pow2((NUM_KEYS + n_dev - 1) // n_dev)  # per-shard capacity
+        q.selector_plan.num_keys = local_k
+        q._win_keys = local_k
+        rows_per_shard = B if n_dev == 1 else int(B / n_dev * 1.25)
+        step, state = shard_keyed_query_step(
+            q, make_mesh(n_dev), rows_per_shard=rows_per_shard)
         now = np.int64(0)
         for i in range(3):
-            state, out = step(state, batches[i % 4], now)
+            rb = route_batch_to_shards(batches[i % 4], n_dev, rows_per_shard)
+            state, out = step(state, rb, now)
         jax.block_until_ready(state)
         t0 = time.perf_counter()
         n = 0
         i = 0
         while True:
-            state, out = step(state, batches[i % 4], now)
+            rb = route_batch_to_shards(batches[i % 4], n_dev, rows_per_shard)
+            state, out = step(state, rb, now)
             n += B
             i += 1
             if i % 10 == 0:
@@ -495,6 +523,36 @@ def _run_section_once(name: str, timeout_s: float):
     return out, False
 
 
+def _probe_tunnel(timeout_s: float = 30.0) -> dict:
+    """Cheap tunnel liveness probe: import jax + list devices in a fresh
+    subprocess — NO jit, so a wedged tunnel costs ``timeout_s``, not a
+    300 s bench section (VERDICT r04 next #1a). Returns a timestamped
+    record that main() appends to the result's ``tunnel_probes`` log."""
+    import datetime
+    import subprocess
+    import sys
+
+    t0 = time.time()
+    rec = {"t": datetime.datetime.now().isoformat(timespec="seconds"),
+           "alive": False, "platform": None, "elapsed_s": None}
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "BENCH_FORCE_CPU")}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
+        if r.returncode == 0:
+            rec["platform"] = r.stdout.strip().splitlines()[-1]
+            rec["alive"] = rec["platform"] not in ("cpu", "", None)
+    except subprocess.TimeoutExpired:
+        pass
+    rec["elapsed_s"] = round(time.time() - t0, 1)
+    print(f"[bench] tunnel probe: {rec}", file=sys.stderr, flush=True)
+    return rec
+
+
 def main():
     import sys
 
@@ -533,43 +591,64 @@ def main():
     def emit():
         print(json.dumps(result), flush=True)
 
-    wedged = False
+    result["tunnel_probes"] = []
 
-    # ---- tunnel sections, headline first; flush after each one
-    out, t_o = _run_section_once("device", min(300.0, remaining()))
-    if out is not None:
-        result["value"] = round(out["eps"], 1)
-        result["vs_baseline"] = round(out["eps"] / MEASURED_BASELINE_EPS, 3)
-        result["device_backend"] = out.get("platform", "tpu")
-    else:
-        result["sections_failed"].append("device")
-        wedged |= t_o
+    def run_tunnel_sections():
+        """device -> e2e -> nfa against the (probed-alive) tunnel; a
+        section timeout marks the tunnel wedged and skips the rest."""
+        # a revival re-run supersedes the first attempt's failure tags —
+        # drop them so the record can't carry both a result and its failure
+        stale = {"device", "e2e", "nfa", "e2e:skipped-wedged-tunnel",
+                 "nfa:skipped-wedged-tunnel", "tunnel:probe-dead"}
+        result["sections_failed"] = [
+            s for s in result["sections_failed"] if s not in stale]
+        wedged = False
+        out, t_o = _run_section_once("device", min(300.0, remaining()))
+        if out is not None:
+            result["value"] = round(out["eps"], 1)
+            result["vs_baseline"] = round(
+                out["eps"] / MEASURED_BASELINE_EPS, 3)
+            result["device_backend"] = out.get("platform", "tpu")
+        else:
+            result["sections_failed"].append("device")
+            wedged |= t_o
+        emit()
+
+        if not wedged:
+            out, t_o = _run_section_once("e2e", min(300.0, remaining()))
+            if out is not None:
+                result["e2e_events_per_sec"] = round(out["eps_str"], 1)
+                result["e2e_preencoded_events_per_sec"] = round(
+                    out["eps_pre"], 1)
+            else:
+                result["sections_failed"].append("e2e")
+                wedged |= t_o
+            emit()
+        else:
+            result["sections_failed"].append("e2e:skipped-wedged-tunnel")
+
+        if not wedged:
+            out, t_o = _run_section_once("nfa", min(300.0, remaining()))
+            if out is not None:
+                result["nfa_p99_ms_per_batch"] = round(out["p99_ms"], 3)
+                result["nfa_events_per_sec"] = round(out["eps"], 1)
+                result["nfa_backend"] = "tpu"
+            else:
+                result["sections_failed"].append("nfa")
+                wedged |= t_o
+            emit()
+        else:
+            result["sections_failed"].append("nfa:skipped-wedged-tunnel")
+
+    # ---- probe first: a wedged tunnel costs one 30 s probe, not a 300 s
+    # section timeout; probe log rides the result line (VERDICT r04 #1)
+    probe = _probe_tunnel(min(30.0, remaining()))
+    result["tunnel_probes"].append(probe)
     emit()
-
-    if not wedged:
-        out, t_o = _run_section_once("e2e", min(300.0, remaining()))
-        if out is not None:
-            result["e2e_events_per_sec"] = round(out["eps_str"], 1)
-            result["e2e_preencoded_events_per_sec"] = round(out["eps_pre"], 1)
-        else:
-            result["sections_failed"].append("e2e")
-            wedged |= t_o
-        emit()
+    if probe["alive"]:
+        run_tunnel_sections()
     else:
-        result["sections_failed"].append("e2e:skipped-wedged-tunnel")
-
-    if not wedged:
-        out, t_o = _run_section_once("nfa", min(300.0, remaining()))
-        if out is not None:
-            result["nfa_p99_ms_per_batch"] = round(out["p99_ms"], 3)
-            result["nfa_events_per_sec"] = round(out["eps"], 1)
-            result["nfa_backend"] = "tpu"
-        else:
-            result["sections_failed"].append("nfa")
-            wedged |= t_o
-        emit()
-    else:
-        result["sections_failed"].append("nfa:skipped-wedged-tunnel")
+        result["sections_failed"].append("tunnel:probe-dead")
     if result["nfa_p99_ms_per_batch"] is None:
         # labeled CPU fallback: the p99 record must not be another null
         out, _ = _run_section_once("nfa_cpu", min(240.0, remaining()))
@@ -601,6 +680,16 @@ def main():
     else:
         result["sections_failed"].append("scaling")
     emit()
+
+    # ---- the tunnel has revived mid-round before (PERF.md r04): if the
+    # start-of-run probe found it dead, spend a second probe at the END of
+    # the budget and claim any revival window (VERDICT r04 #1c)
+    if result["device_backend"] is None and remaining() > 90:
+        probe = _probe_tunnel(min(30.0, remaining()))
+        result["tunnel_probes"].append(probe)
+        emit()
+        if probe["alive"]:
+            run_tunnel_sections()
     if result["value"] is None:
         # last-resort labeled fallback so the record always carries a
         # number: the device section on the CPU backend

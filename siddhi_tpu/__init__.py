@@ -12,6 +12,20 @@ Public API surface mirrors the reference's (``SiddhiManager``
 ``SiddhiAppRuntime.java``, ``stream/input/InputHandler.java``).
 """
 
+# The window/NFA hot path swaps ring-buffer slots in place (gather old
+# value, scatter new one into the SAME donated [K*W] buffer). XLA:CPU's
+# default copy-insertion cannot prove the gather-before-scatter ordering
+# and materializes two full-buffer copies per column per step (O(K*W)
+# bytes — 33x slower at the bench shape); region analysis proves it.
+# CPU-only flag, inert on TPU. Must be set before backend init.
+import os as _os
+
+_FLAG = "--xla_cpu_copy_insertion_use_region_analysis"
+if _FLAG not in _os.environ.get("XLA_FLAGS", ""):
+    # name-only check: an explicit user setting (either value) wins
+    _os.environ["XLA_FLAGS"] = (
+        _os.environ.get("XLA_FLAGS", "") + " " + _FLAG + "=true").strip()
+
 # Millisecond epoch timestamps need int64; enable x64 before any jax use.
 import jax
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -59,47 +60,48 @@ def key_axis_sharding(mesh: Mesh, arr_ndim: int, key_axis_index: int) -> NamedSh
     return NamedSharding(mesh, P(*spec))
 
 
+def _key_axis_of(path, leaf, num_keys: int, win_keys: int) -> int:
+    """Key-axis index of a query-state leaf, or -1 if unkeyed.
+
+    Keyed state: selector/aggregator arrays (under the ``"sel"`` subtree,
+    shape ``[slots, K]``), partitioned window state (under ``"win"``:
+    per-key rows ``[Kw]`` or flat ring buffers ``[Kw*W]`` — key-contiguous
+    layout, so an even split along axis 0 is a split along keys), and NFA
+    slot tensors (``"nfa"``: key-major ``[K, S]`` / per-key ``[K]``)."""
+    if not hasattr(leaf, "shape") or leaf.ndim == 0:
+        return -1
+    top = path[0].key if path and hasattr(path[0], "key") else None
+    if top == "sel":
+        for i, s in enumerate(leaf.shape):
+            if s == num_keys:
+                return i
+    if top == "win" and win_keys > 1 and leaf.shape[0] % win_keys == 0:
+        return 0
+    if top == "nfa" and win_keys > 1 and leaf.shape[0] == win_keys:
+        return 0
+    return -1
+
+
 def state_shardings(state, mesh: Mesh, num_keys: int, win_keys: int = 1):
     """Pytree of shardings for a query-state pytree.
 
-    Only keyed state is sharded: selector/aggregator arrays (under the
-    ``"sel"`` subtree, shape ``[slots, K]``) and partitioned window state
-    (under ``"win"``: per-key rows ``[Kw]`` or flat ring buffers
-    ``[Kw*W]`` — key-contiguous layout, so an even split along axis 0 is a
-    split along keys) split across the mesh. Global (unkeyed, ``win_keys``
-    == 1) window ring buffers and scalars are replicated — sharding a
-    global ring along its ring axis would put every window write on a
-    collective."""
+    Only keyed state is sharded (see ``_key_axis_of``). Global (unkeyed,
+    ``win_keys`` == 1) window ring buffers and scalars are replicated —
+    sharding a global ring along its ring axis would put every window
+    write on a collective."""
     replicated = NamedSharding(mesh, P())
     n_dev = mesh.devices.size
 
     def one(path, leaf):
-        if not hasattr(leaf, "shape"):
+        ax = _key_axis_of(path, leaf, num_keys, win_keys)
+        if ax < 0:
             return replicated
         top = path[0].key if path and hasattr(path[0], "key") else None
-        if top == "sel":
-            for i, s in enumerate(leaf.shape):
-                if s == num_keys:
-                    return key_axis_sharding(mesh, leaf.ndim, i)
-        if (
-            top == "win"
-            and win_keys > 1
-            and leaf.ndim >= 1
-            and leaf.shape[0] % win_keys == 0
-            and leaf.shape[0] % n_dev == 0
-            and win_keys % n_dev == 0
+        if top in ("win", "nfa") and (
+            leaf.shape[0] % n_dev != 0 or win_keys % n_dev != 0
         ):
-            return key_axis_sharding(mesh, leaf.ndim, 0)
-        if (
-            top == "nfa"
-            and win_keys > 1
-            and leaf.ndim >= 1
-            and leaf.shape[0] == win_keys
-            and win_keys % n_dev == 0
-        ):
-            # NFA slot tensors are key-major [K, S]; per-key vectors [K]
-            return key_axis_sharding(mesh, leaf.ndim, 0)
-        return replicated
+            return replicated
+        return key_axis_sharding(mesh, leaf.ndim, ax)
 
     return jax.tree_util.tree_map_with_path(one, state)
 
@@ -162,6 +164,134 @@ def _out_shardings(mesh: Mesh, st_sh):
     if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
         return None
     return (st_sh, NamedSharding(mesh, P()))
+
+
+def route_batch_to_shards(cols, n_shards: int, rows_per_shard: int):
+    """Host-side all-to-all: scatter batch rows to their owning key shard.
+
+    The owner of dense key ``k`` is ``k % n_shards`` and its local id is
+    ``k // n_shards`` — round-robin keeps the keyer's dense ids
+    load-balanced across shards. Returns a routed column dict of shape
+    ``[n_shards * rows_per_shard]`` where segment ``d`` holds shard ``d``'s
+    rows (original order preserved within the shard) padded with invalid
+    rows, and the PK/GK columns rewritten to LOCAL ids. Pair with
+    ``shard_keyed_query_step``: the router replaces the device-side
+    all-to-all the reference's partition fan-out does with per-key junction
+    dispatch (``PartitionStreamReceiver.java:96-135``)."""
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import PK_KEY, VALID_KEY
+
+    key_col = PK_KEY if PK_KEY in cols else GK_KEY
+    if GK_KEY in cols and PK_KEY in cols and not np.array_equal(
+            np.asarray(cols[GK_KEY]), np.asarray(cols[PK_KEY])):
+        # a group-by key distinct from the partition key lives in its own
+        # dense-id space; rewriting it to partition-local ids would corrupt
+        # the selector's group state (runtime.py:531-534 — GK == PK only
+        # for partitioned queries without an explicit group-by)
+        raise ValueError(
+            "route_batch_to_shards requires GK == PK (partitioned query "
+            "without a distinct group-by key)")
+    valid = np.asarray(cols[VALID_KEY])
+    keep = np.nonzero(valid)[0]  # capacity padding never competes for rows
+    pk = np.asarray(cols[key_col]).astype(np.int64)[keep]
+    owner = pk % n_shards
+    local = pk // n_shards
+    order = np.argsort(owner, kind="stable")
+    counts = np.bincount(owner, minlength=n_shards)
+    if int(counts.max(initial=0)) > rows_per_shard:
+        raise ValueError(
+            f"shard overflow: {int(counts.max())} rows for one shard > "
+            f"rows_per_shard={rows_per_shard}; raise the pad or split the batch")
+    starts = np.zeros(n_shards, np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    owner_sorted = owner[order]
+    pos = np.arange(keep.shape[0], dtype=np.int64) - starts[owner_sorted]
+    dest = owner_sorted * rows_per_shard + pos
+    src = keep[order]
+
+    N = n_shards * rows_per_shard
+    routed = {}
+    for k, v in cols.items():
+        v = np.asarray(v)
+        if k in (PK_KEY, GK_KEY):
+            buf = np.zeros(N, v.dtype)
+            buf[dest] = local[order].astype(v.dtype)
+        else:
+            buf = np.zeros((N,) + v.shape[1:], v.dtype)
+            buf[dest] = v[src]
+        routed[k] = buf
+    return routed  # padding rows keep VALID=False (zero-fill)
+
+
+def shard_keyed_query_step(runtime, mesh: Mesh, rows_per_shard: int):
+    """Jit a keyed (partitioned) query step as a ``shard_map`` over ``mesh``
+    — zero-collective data parallelism over the key space.
+
+    Contract with ``route_batch_to_shards``: the runtime is sized to its
+    PER-SHARD key capacity (``selector_plan.num_keys`` / ``_win_keys`` are
+    local values), and batches arrive routed (``[n * rows_per_shard]`` rows
+    carrying local key ids). Each device then steps its own
+    ``[slots, K_local]`` / ``[K_local * W]`` state over only its own rows;
+    the compiled HLO contains NO collective ops (verified by
+    ``tools/hlo_audit.py``) — the host router IS the all-to-all, and the
+    ICI carries nothing per step. Global-window queries cannot take this
+    path (their ring semantics need every row in order); use
+    ``shard_query_step`` for those.
+
+    Returns ``(jitted_step, global_state)``. Out rows come back
+    shard-segmented (leaf axis 0 = ``n * R_local``); ``"__meta__"`` is
+    ``[n, 3]`` — one (overflow, notify, count) row per shard."""
+    from jax.experimental.shard_map import shard_map
+
+    n = mesh.devices.size
+    localK = runtime.selector_plan.num_keys
+    local_win = getattr(runtime, "_win_keys", 1)
+    if runtime._state is None:
+        runtime._state = runtime._init_state()
+    local_state = runtime._state
+    step = runtime.build_step_fn()
+
+    axes = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _key_axis_of(path, leaf, localK, local_win),
+        local_state)
+
+    def stack_global(leaf, ax):
+        arr = np.asarray(leaf)
+        if ax < 0:
+            # unkeyed leaf: leading device axis — every shard keeps its own
+            # independently-evolving copy (squeezed back inside the map)
+            return np.stack([arr] * n, axis=0)
+        return np.concatenate([arr] * n, axis=ax)
+
+    global_state = jax.tree_util.tree_map(stack_global, local_state, axes)
+    st_specs = jax.tree_util.tree_map(
+        lambda ax: P(KEY_AXIS) if ax <= 0 else P(*([None] * ax), KEY_AXIS),
+        axes)
+
+    def wrapped(state, cols, now):
+        state = jax.tree_util.tree_map(
+            lambda leaf, ax: leaf[0] if ax < 0 else leaf, state, axes)
+        st, out = step(state, cols, now)
+        st = jax.tree_util.tree_map(
+            lambda leaf, ax: jnp.asarray(leaf)[None] if ax < 0 else leaf,
+            st, axes)
+        out = {
+            k: jnp.asarray(v)[None] if (k == "__meta__" or jnp.ndim(v) == 0)
+            else v
+            for k, v in out.items()
+        }
+        return st, out
+
+    sharded = shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(st_specs, P(KEY_AXIS), P()),
+        out_specs=(st_specs, P(KEY_AXIS)),
+        check_rep=False,
+    )
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+    state = jax.device_put(global_state, jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), st_specs))
+    return jitted, state
 
 
 def sharded_jit_for(runtime, fn, n_state_args: int = 1, n_plain_args: int = 2):
