@@ -32,6 +32,8 @@ class PartitionKeySpace:
     streams). ``@purge`` retires idle ids into a free list for reuse
     (reference PartitionRuntimeImpl idle-partition purge)."""
 
+    _LUT_MAX = 1 << 22  # raw-key bound for the vectorized table (4 M ids)
+
     def __init__(self):
         import threading
 
@@ -39,9 +41,39 @@ class PartitionKeySpace:
         self._map: Dict[tuple, int] = {}
         self._reverse: List[tuple] = []
         self._free: List[int] = []
+        # single-int-key fast table: raw value (dictionary-encoded string
+        # id or int key) -> dense pk; -1 = unseen. Steady state keys a
+        # whole batch with ONE np.take instead of a per-row Python probe
+        # (the partitioned-NFA host bottleneck — PERF.md round 5)
+        self._lut = np.full(1024, -1, np.int32)
         # last-seen tracking is enabled only when the partition has @purge
         # (a per-batch touch would otherwise tax every partitioned app)
         self.last_seen: Optional[Dict[int, int]] = None
+
+    def ids_of_ints(self, raw: np.ndarray) -> Optional[np.ndarray]:
+        """Vectorized ``id_of`` over a single-int-key batch; None when the
+        values fall outside the table's domain (negative / huge)."""
+        if raw.size == 0:
+            return np.empty(0, np.int32)
+        vmin, vmax = int(raw.min()), int(raw.max())
+        if vmin < 0 or vmax >= self._LUT_MAX:
+            return None
+        with self._lock:
+            lut = self._lut
+            if vmax >= lut.shape[0]:
+                n = lut.shape[0]
+                while n <= vmax:
+                    n *= 2
+                grown = np.full(n, -1, np.int32)
+                grown[: lut.shape[0]] = lut
+                self._lut = lut = grown
+            out = lut[raw]
+            miss = out < 0
+            if miss.any():
+                for x in np.unique(raw[miss]):
+                    lut[int(x)] = self.id_of((int(x),))
+                out = lut[raw]
+        return out
 
     def enable_purge_tracking(self):
         if self.last_seen is None:
@@ -83,6 +115,8 @@ class PartitionKeySpace:
                     self._reverse[i] = None
                     del self.last_seen[i]
                     retired.append(i)
+            if retired:
+                self._lut.fill(-1)  # retired raw keys must re-probe
             return retired
 
     def release(self, ids: List[int]):
@@ -108,6 +142,7 @@ class PartitionKeySpace:
             for k, i in self._map.items():
                 self._reverse[i] = k
             self._free = list(snap.get("free", []))
+            self._lut.fill(-1)  # raw-key bindings may have changed
             if self.last_seen is not None:
                 # restored keys start their idle clocks at restore time —
                 # otherwise pre-restart keys would be invisible to purge
@@ -147,11 +182,20 @@ class ValuePartitionKeyer:
                 drop |= np.broadcast_to(np.asarray(m), (B,)) & is_cur
         keyed = np.nonzero(is_cur & ~drop)[0]
         if keyed.size:
-            # vectorized dictionary encoding (shared helper — unique the key
-            # tuples once, probe the Python keyspace only per unique)
-            from siddhi_tpu.core.event import encode_key_tuples
+            got = None
+            if len(vals) == 1 and vals[0].dtype.kind in "iu":
+                # single int key (dictionary-encoded strings included):
+                # one np.take through the keyspace table in steady state
+                got = self._keyspace.ids_of_ints(
+                    np.ascontiguousarray(vals[0][keyed]).astype(np.int64))
+            if got is not None:
+                pk[keyed] = got
+            else:
+                # vectorized dictionary encoding (shared helper — unique the
+                # key tuples once, probe the Python keyspace only per unique)
+                from siddhi_tpu.core.event import encode_key_tuples
 
-            pk[keyed] = encode_key_tuples(vals, keyed, self._keyspace.id_of)
+                pk[keyed] = encode_key_tuples(vals, keyed, self._keyspace.id_of)
             if self._keyspace.last_seen is not None:
                 import time as _time
 

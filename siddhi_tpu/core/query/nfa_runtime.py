@@ -79,9 +79,16 @@ class NFAQueryRuntime(QueryRuntime):
         self.stage = stage
         self.input_defs = input_defs
         self.stream_keyers = stream_keyers  # stream id -> partition keyer|None
-        self._steps: Dict[str, object] = {}
+        self._steps: Dict[object, object] = {}
         self._timer_step = None
         self._sel_step = None
+        # host mirror of the PER-KEY event-time high-water marks (fast
+        # two-step kernel dispatch — see _host_hard_batch; per-key because
+        # the generic engine's `_expire` only advances the clock of each
+        # row's own key); persisted with snapshots so restored state
+        # cannot be resurrected by replays
+        self._nfa_hwm_arr = None
+        self._expire_step = None
         # one stable callback object: Scheduler dedups on (id(target), ts),
         # a fresh bound method per notify_at would defeat it
         self._timer_cb = self.process_timer
@@ -176,10 +183,15 @@ class NFAQueryRuntime(QueryRuntime):
 
     # ---------------------------------------------------------- step builds
 
-    def build_stream_step_fn(self, stream_id: str):
+    def build_stream_step_fn(self, stream_id: str, force_generic: bool = False):
         """Pure (state, cols, now) -> (state', out) for one input stream —
         the NFA transition fused with the selector stage (unless a host
-        group-by keyer has to run between them)."""
+        group-by keyer has to run between them). ``force_generic`` builds
+        the serial-engine variant the host dispatches to when a batch's
+        timestamps are hostile to the fast kernel (see
+        ``process_stream_batch``); an in-graph ``lax.cond`` would instead
+        break buffer donation (XLA copies the whole [K, S] state through
+        conditionals — measured 11 big copies/step)."""
         stage = self.stage
         sel = self.selector_plan
         split = self.keyer is not None
@@ -190,7 +202,12 @@ class NFAQueryRuntime(QueryRuntime):
             ctx = {"xp": jnp, "current_time": current_time}
             cols = dict(cols)
             strrank = cols.pop(STR_RANK, None)   # selector-only side input
-            new_nfa, out_cols = stage.apply_stream(stream_id, state["nfa"], cols, ctx)
+            if force_generic:
+                new_nfa, out_cols = stage._apply_stream_generic(
+                    stream_id, state["nfa"], cols, ctx)
+            else:
+                new_nfa, out_cols = stage.apply_stream(
+                    stream_id, state["nfa"], cols, ctx)
             out_cols = dict(out_cols)
             overflow = out_cols.pop("__overflow__", None)
             notify = out_cols.pop("__notify__", None)
@@ -257,16 +274,18 @@ class NFAQueryRuntime(QueryRuntime):
                 self._ensure_capacity()
             if self._state is None:
                 self._state = self._init_state()
-            step = self._steps.get(stream_id)
+            force_generic = self._host_hard_batch(stream_id, cols)
+            step = self._steps.get((stream_id, force_generic))
             if step is None:
-                fn = self.build_stream_step_fn(stream_id)
+                fn = self.build_stream_step_fn(stream_id,
+                                               force_generic=force_generic)
                 if self._shard_mesh is not None:
                     from siddhi_tpu.parallel.mesh import sharded_jit_for
 
                     step = sharded_jit_for(self, fn, n_plain_args=2)
                 else:
                     step = jax.jit(fn, donate_argnums=0)
-                self._steps[stream_id] = step
+                self._steps[(stream_id, force_generic)] = step
             jcols = dict(cols) if isinstance(cols, LazyColumns) else cols
             if self.selector_plan.needs_str_rank:
                 from siddhi_tpu.core.plan.selector_plan import STR_RANK
@@ -277,6 +296,77 @@ class NFAQueryRuntime(QueryRuntime):
                 np.int64(self.app_context.timestamp_generator.current_time())))
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self._timer_cb)
+
+    def _host_hard_batch(self, stream_id: str, cols) -> bool:
+        """Host-side dispatch between the fast two-step kernel and the
+        serial engine, decided from timestamps alone (VERDICT r05: an
+        in-graph lax.cond breaks state donation — 11 full-state copies
+        per step). Hard conditions, each a conservative
+        over-approximation:
+        - out-of-order timestamps (below the row's key's high-water mark,
+          or decreasing in-batch): the fast kernel's lazy `within` expiry
+          is exact only for monotone feeds;
+        - head batches where one key's rows span several timestamps: a
+          `within` deadline could cross inside the batch and re-order the
+          free-slot list between same-key arming rows.
+        When a batch is hard, the PER-KEY physical expiry clears the
+        generic engine would already have made are applied first
+        (`expire_to` — per key because `_expire` only advances each row's
+        own key's clock)."""
+        stage = self.stage
+        side_kind = (stage._fast_side(stream_id)
+                     if stage.fast_enabled else None)
+        if side_kind is None or stage.plan.within is None:
+            return side_kind is None  # ineligible plans: generic always
+        raw_ts = dict.__getitem__(cols, TS_KEY) if TS_KEY in cols else None
+        if not isinstance(raw_ts, np.ndarray):
+            # device-resident (chained-query) batch: reading timestamps
+            # here would force a device->host pull per batch (~70 ms on
+            # the tunnel), and without host timestamps the high-water
+            # marks cannot be maintained soundly — retire the fast path
+            # for this runtime
+            stage.fast_enabled = False
+            self._steps.clear()
+            return True
+        ts = raw_ts
+        valid = np.asarray(cols[VALID_KEY]) & (
+            np.asarray(cols[TYPE_KEY]) == 0)
+        tsv = ts[valid]
+        if tsv.size == 0:
+            return False
+        K = self._win_keys
+        arr = self._nfa_hwm_arr
+        if arr is None or arr.shape[0] < K:
+            grown = np.full(K, -(2 ** 62), np.int64)
+            if arr is not None:
+                grown[: arr.shape[0]] = arr
+            self._nfa_hwm_arr = arr = grown
+        pk = (np.asarray(cols[PK_KEY], np.int64) if PK_KEY in cols
+              else np.zeros(ts.shape[0], np.int64))
+        pkv = np.clip(pk[valid], 0, K - 1)
+        hard = bool(np.any(tsv < arr[pkv])) or bool(
+            np.any(np.diff(tsv) < 0))
+        if not hard and side_kind == "head" and tsv.min() != tsv.max():
+            order = np.argsort(pkv, kind="stable")
+            same = pkv[order][1:] == pkv[order][:-1]
+            hard = bool(np.any(same & (np.diff(tsv[order]) != 0)))
+        if hard:
+            # apply the generic engine's per-key physical expiry clears
+            # before falling back (donation-safe: state replaced wholesale)
+            if self._expire_step is None:
+                self._expire_step = jax.jit(self.stage.expire_to,
+                                            donate_argnums=0)
+            self._state = dict(self._state)
+            self._state["nfa"] = self._expire_step(
+                self._state["nfa"], arr)
+        if tsv[0] == tsv[-1] and tsv.min() == tsv.max():
+            # single-timestamp batch (the steady-state shape): duplicate
+            # keys all write the same value, so plain fancy assignment
+            # replaces the much slower unbuffered np.maximum.at
+            arr[pkv] = np.maximum(arr[pkv], tsv[0])
+        else:
+            np.maximum.at(arr, pkv, tsv)
+        return hard
 
     def process_timer(self, ts: int):
         with self._lock:

@@ -135,16 +135,32 @@ class UpdateOrInsertTableCallback(UpdateTableCallback):
         super().__init__(table, query_name, output_attrs, on_condition, update_set,
                          dictionary)
         # unmatched events insert positionally, like `insert into`
-        if len(output_attrs) != len(table.definition.attributes):
-            raise CompileError(
-                f"update or insert into '{table.definition.id}': query outputs "
-                f"{len(output_attrs)} attributes, table has "
-                f"{len(table.definition.attributes)}"
-            )
-        self.insert_mapping = [
-            (tattr.name, oname)
-            for tattr, (oname, _t) in zip(table.definition.attributes, output_attrs)
-        ]
+        if len(output_attrs) == len(table.definition.attributes):
+            self.insert_mapping = [
+                (tattr.name, oname)
+                for tattr, (oname, _t) in zip(table.definition.attributes,
+                                              output_attrs)
+            ]
+        else:
+            # the reference also accepts a PARTIAL output set when every
+            # output attribute names a table column (UpdateOrInsert-
+            # TableTestCase.java updateOrInsertTableTest5: `comp as symbol,
+            # vol as volume` against a 3-attr table) — unmatched events
+            # insert BY NAME with the absent columns null
+            tnames = {a.name for a in table.definition.attributes}
+            missing = [o for o, _t in output_attrs if o not in tnames]
+            if missing:
+                raise CompileError(
+                    f"update or insert into '{table.definition.id}': query "
+                    f"outputs {len(output_attrs)} attributes, table has "
+                    f"{len(table.definition.attributes)}, and "
+                    f"{missing} match no table attribute"
+                )
+            onames = {o for o, _t in output_attrs}
+            self.insert_mapping = [
+                (tattr.name, tattr.name if tattr.name in onames else None)
+                for tattr in table.definition.attributes
+            ]
 
     def __call__(self, events: List[Event]):
         batch = self._batch(events)

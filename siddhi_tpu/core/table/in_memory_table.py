@@ -78,8 +78,6 @@ class InMemoryTable:
     def __init__(self, definition: TableDefinition, dictionary: StringDictionary,
                  capacity: int = 1024):
         from siddhi_tpu.ops.windows import window_col_specs
-        from siddhi_tpu.query_api.annotations import find_annotation
-
         self.definition = definition
         self.dictionary = dictionary
         self.col_specs = window_col_specs(definition)
@@ -89,10 +87,30 @@ class InMemoryTable:
         # @primaryKey: uniqueness + host hash probe (the dense-array analog
         # of reference IndexEventHolder's primary-key map,
         # table/holder/IndexEventHolder.java:60-80)
-        pk_ann = find_annotation(definition.annotations or [], "primaryKey")
+        from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+        from siddhi_tpu.query_api.annotations import find_annotations
+
+        names = {a.name for a in definition.attributes}
+        pk_anns = find_annotations(definition.annotations or [], "primaryKey")
+        if len(pk_anns) > 1:
+            # reference DuplicateAnnotationException
+            # (AnnotationHelper.validateAnnotation)
+            raise SiddhiAppValidationException(
+                f"table '{definition.id}': duplicate @PrimaryKey annotation")
+        pk_ann = pk_anns[0] if pk_anns else None
         self.primary_key: List[str] = []
         if pk_ann is not None:
             self.primary_key = [v for _k, v in pk_ann.elements if v]
+            if not self.primary_key:
+                raise SiddhiAppValidationException(
+                    f"table '{definition.id}': @PrimaryKey needs at least "
+                    "one attribute")
+            for a in self.primary_key:
+                if a not in names:
+                    # reference AttributeNotExistException (case-sensitive)
+                    raise SiddhiAppValidationException(
+                        f"table '{definition.id}': @PrimaryKey attribute "
+                        f"'{a}' is not defined in the table")
         self._pk_map: Dict[tuple, int] = {}
         self._pk_dirty = False
         # @index: secondary per-attribute probes (the dense analog of the
@@ -100,13 +118,24 @@ class InMemoryTable:
         # IndexEventHolder.java:60-80). Host side: value -> slots hash maps
         # (on-demand queries); device side: joins sort the probe column
         # once per batch and searchsorted into it (join_runtime).
-        from siddhi_tpu.query_api.annotations import find_annotations
-
         self.indexes: List[str] = []
         for ann in find_annotations(definition.annotations or [], "index"):
-            self.indexes.extend(v for _k, v in ann.elements if v)
-        for a in self.indexes:
-            definition.attribute(a)     # validate the attr exists
+            vals = [v for _k, v in ann.elements]
+            if len(vals) != 1:
+                # reference: one attribute per @Index annotation
+                # (IndexTableTestCase.java indexTableTest31)
+                raise SiddhiAppValidationException(
+                    f"table '{definition.id}': @Index supports exactly one "
+                    "attribute per annotation")
+            a = vals[0]
+            if a in self.indexes:
+                raise SiddhiAppValidationException(
+                    f"table '{definition.id}': duplicate @Index('{a}')")
+            if not a or a not in names:
+                raise SiddhiAppValidationException(
+                    f"table '{definition.id}': @Index attribute '{a}' is "
+                    "not defined in the table")
+            self.indexes.append(a)
         self._idx_maps: Dict[str, Dict[object, np.ndarray]] = {}
         self._idx_dirty = True
         # incremental-snapshot op log: inserted rows since the last
@@ -334,10 +363,14 @@ class InMemoryTable:
                 hit = win >= 0
             else:
                 # primary-key assignments follow the reference's SEQUENTIAL
-                # chunk walk: events apply in order, and an event that would
-                # move a row onto another row's CURRENT key is dropped
-                # (IndexEventHolder primary-key violation) — earlier
-                # accepted events on the same row stand
+                # chunk walk: events apply in order. For a SINGLE-key PK
+                # the reference first SIMULATES the whole event's key
+                # rewrites against a snapshot of the current key set — any
+                # collision drops the ENTIRE updating event (all its
+                # matched rows, non-PK columns included):
+                # IndexOperator.java:117-161 (`keys.remove(old);
+                # if (!keys.add(new)) fail`). Composite keys keep per-row
+                # drops (the reference skips the simulation there).
                 live = np.asarray(self.state["valid"], bool)
                 m_h = np.asarray(m, bool) & live[None, :]
                 pk_vals = {col: np.asarray(v)
@@ -350,20 +383,56 @@ class InMemoryTable:
                 cur_key = {int(c): self._pk_of_host(old_k, int(c))
                            for c in np.nonzero(live)[0]}
                 win2 = np.full(C, -1, np.int64)
+                single_pk = len(self.primary_key) == 1
+                kset = set(keys) if single_pk else None
+
+                def new_key(b, c):
+                    return tuple(
+                        pk_vals[a][b, c].item() if a in pk_vals
+                        else cur_key[c][i]
+                        for i, a in enumerate(self.primary_key))
+
                 for b in range(B):
-                    for c in np.nonzero(m_h[b])[0]:
-                        c = int(c)
-                        nk = tuple(
-                            pk_vals[a][b, c].item() if a in pk_vals
-                            else cur_key[c][i]
-                            for i, a in enumerate(self.primary_key))
-                        if nk != cur_key[c] and keys.get(nk, c) != c:
-                            continue               # violation: event dropped
-                        if nk != cur_key[c]:
-                            del keys[cur_key[c]]
+                    rows = [int(c) for c in np.nonzero(m_h[b])[0]]
+                    if not rows:
+                        continue
+                    if single_pk:
+                        # simulate against the live key set, logging this
+                        # event's moves so a collision can undo them —
+                        # O(rows) per event, not O(table)
+                        moves = []
+                        ok = True
+                        for c in rows:
+                            nk = new_key(b, c)
+                            if nk != cur_key[c]:
+                                kset.discard(cur_key[c])
+                                if nk in kset:
+                                    kset.add(cur_key[c])
+                                    ok = False
+                                    break
+                                kset.add(nk)
+                                moves.append((c, cur_key[c], nk))
+                        if not ok:
+                            for c, old, nk in reversed(moves):
+                                kset.discard(nk)
+                                kset.add(old)
+                            continue       # whole updating event dropped
+                        for c, old, nk in moves:
+                            del keys[old]
                             keys[nk] = c
                             cur_key[c] = nk
-                        win2[c] = b
+                        for c in rows:
+                            win2[c] = b
+                    else:
+                        for c in rows:
+                            nk = new_key(b, c)
+                            if nk != cur_key[c] and keys.get(nk, c) != c:
+                                continue   # violation: row dropped
+                            if nk != cur_key[c]:
+                                del keys[cur_key[c]]
+                                keys[nk] = c
+                                cur_key[c] = nk
+                            win2[c] = b
                 win = jnp.asarray(win2, jnp.int32)
                 hit = win >= 0
 
@@ -410,6 +479,13 @@ class InMemoryTable:
                         ins = {TS_KEY: row[TS_KEY], TYPE_KEY: row.get(TYPE_KEY, np.zeros(1, np.int8)),
                                VALID_KEY: row[VALID_KEY]}
                         for table_attr, ev_col in insert_mapping:
+                            if ev_col is None:
+                                # partial upsert output set: absent table
+                                # columns insert as NULL
+                                dt = self.col_specs[table_attr]
+                                ins[table_attr] = np.zeros(1, dt)
+                                ins[table_attr + "?"] = np.ones(1, bool)
+                                continue
                             ins[table_attr] = row[ev_col]
                             ins[table_attr + "?"] = row.get(ev_col + "?", np.zeros(1, bool))
                         single = HostBatch(ins)

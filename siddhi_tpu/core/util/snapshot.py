@@ -67,6 +67,9 @@ class SnapshotService:
                     "keyer_map": dict(q.keyer._map) if q.keyer is not None else None,
                     "host_window": (q.host_window.snapshot()
                                     if q.host_window is not None else None),
+                    "nfa_hwm": (np.array(q._nfa_hwm_arr)
+                                if getattr(q, "_nfa_hwm_arr", None)
+                                is not None else None),
                 }
         windows = {}
         for wid, w in rt.named_windows.items():
@@ -213,6 +216,8 @@ class SnapshotService:
                     q.keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
                 if q.host_window is not None and qsnap.get("host_window") is not None:
                     q.host_window.restore(qsnap["host_window"])
+                if qsnap.get("nfa_hwm") is not None and hasattr(q, "_nfa_hwm_arr"):
+                    q._nfa_hwm_arr = np.array(qsnap["nfa_hwm"], np.int64)
                 q._step = None
                 if hasattr(q, "_steps"):
                     q._steps.clear()

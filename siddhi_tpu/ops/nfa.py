@@ -689,6 +689,9 @@ class NFAStage:
         self.plan = plan
         self.cap_cols = _cap_state_cols(plan)
         self.scope_cols = [scope_col(g) for g in range(len(plan.scopes))]
+        # loop-free kernel for simple two-step chains (see _fast_side);
+        # differential tests flip this off to pin fast == generic
+        self.fast_enabled = True
 
     def init_state(self, num_keys: int = 1) -> dict:
         K, S = num_keys, self.plan.slots
@@ -980,7 +983,295 @@ class NFAStage:
     def apply_stream(self, stream_id: str, state: dict, cols: dict, ctx: dict):
         """Process one batch arriving on ``stream_id``; returns
         (new_state, out_cols) where out_cols is a flattened [B*(S+1)] match
-        emission (capture columns + __ts__/__type__/__valid__/__gk__)."""
+        emission (capture columns + __ts__/__type__/__valid__/__gk__).
+
+        Dispatches to the loop-free fast kernel for simple two-step chains
+        (the dominant production shape — BASELINE config #4); everything
+        else takes the generic per-round ``while_loop`` engine."""
+        side_kind = self._fast_side(stream_id) if self.fast_enabled else None
+        if side_kind is not None:
+            return self._apply_stream_fast(stream_id, state, cols, ctx,
+                                           side_kind)
+        return self._apply_stream_generic(stream_id, state, cols, ctx)
+
+    def expire_to(self, state, hwm_per_key):
+        """Physically clear every pending past its `within` deadline as
+        of its KEY's event-time high-water mark ``hwm_per_key`` ([K]).
+        The fast kernels expire LAZILY (masks, no state writes) — exact
+        for monotone feeds; before a host-forced fallback to the generic
+        engine (out-of-order batch), the runtime applies the clears the
+        generic engine would already have made, so the fallback cannot
+        resurrect an expired pending. Per key because the generic
+        `_expire` only advances each row's own key's clock."""
+        w = self.plan.within
+        if w is None:
+            return state
+        state = dict(state)
+        state["active"] = state["active"] & ~(
+            state["sts"] + jnp.int64(w)
+            < jnp.asarray(hwm_per_key)[:, None])
+        return state
+
+    def _fast_side(self, stream_id: str):
+        """'head'/'tail' when ``stream_id`` feeds a fast-eligible plan:
+        exactly two plain single-side stream steps on DIFFERENT streams,
+        simple captures, no counts/absent/logical/sticky/scopes — the
+        `e1=A -> e2=B` / `e1=A, e2=B` family (with or without `every` /
+        whole-pattern `within`). For these, same-batch serial dependence
+        reduces to closed forms (see _apply_stream_fast), so no round
+        loop is needed."""
+        plan = self.plan
+        if len(plan.steps) != 2 or plan.scopes or plan.rearm_on_empty:
+            return None
+        # head `every (...)` groups: only the trivial per-event span {0: 0}
+        # (plain `every e1`) keeps plain-every semantics
+        if any(a != b or a != 0 for a, b in plan.every_groups.items()):
+            return None
+        for st in plan.steps:
+            if st.kind != "stream" or st.sticky or len(st.sides) != 1:
+                return None
+            s = st.sides[0]
+            if s.absent or s.wait_ms is not None or s.capture is None:
+                return None
+            c = s.capture
+            if c.is_count or c.n_idx or c.last_offsets or c.last_ring:
+                return None
+        s0, s1 = plan.steps[0].sides[0], plan.steps[1].sides[0]
+        if s0.stream_id == s1.stream_id:
+            return None
+        if stream_id == s0.stream_id:
+            return "head"
+        if stream_id == s1.stream_id:
+            return "tail"
+        return None
+
+    def _fast_ev(self, CP, CD, B, fresh: bool):
+        """Eval dict for a side condition, mirroring the generic round's
+        construction for non-count captures: capture cols [B, S] (or fresh
+        NULLs [B, 1]), presence synthetics, current attrs [B, 1]."""
+        plan = self.plan
+        ev = {}
+        if fresh:
+            for n in self.cap_cols:
+                ev[n] = (jnp.ones((B, 1), CP[n].dtype) if n.endswith("?")
+                         else jnp.zeros((B, 1), CP[n].dtype))
+            for cap in plan.captures:
+                ev[cap_col(cap.cid, PRESENT)] = jnp.ones((B, 1), bool)
+                ev[cap_col(cap.cid, PRESENT) + "?"] = jnp.ones((B, 1), bool)
+        else:
+            ev.update(CP)
+            for cap in plan.captures:
+                ev[cap_col(cap.cid, PRESENT)] = jnp.ones_like(CD, bool)
+                ev[cap_col(cap.cid, PRESENT) + "?"] = (
+                    CD & (1 << cap.cid)) == 0
+        return ev
+
+    def _fast_out(self, emit, emit_caps, ts, cols, pk, B):
+        """[B, S(+1)] emission tensors -> the generic flattened format."""
+        S = self.plan.slots
+        out_valid = jnp.zeros((B, S + 1), bool).at[:, :S].set(emit)
+        out_caps = {}
+        for n, dt in self.cap_cols.items():
+            z = jnp.zeros((B, S + 1), dt)
+            if n in emit_caps:
+                z = z.at[:, :S].set(jnp.where(emit, emit_caps[n], z[:, :S]))
+            out_caps[n] = z
+        out_caps["__capdone__"] = jnp.zeros((B, S + 1), jnp.int32).at[
+            :, :S].set(jnp.where(emit, emit_caps["__capdone__"], 0))
+        out_ts = jnp.broadcast_to(ts[:, None], (B, S + 1))
+        return self._flatten_out(out_valid, out_caps, out_ts, ts, cols, pk, B)
+
+    def _apply_stream_fast(self, stream_id, state, cols, ctx, side_kind):
+        """Loop-free two-step chain kernel.
+
+        Closed forms replacing the per-round loop (each proven against the
+        generic engine by tests/test_nfa_fast_differential.py):
+        - tail (e2) batches never arm, so consumption is "first matching
+          row per slot" — a scatter-min over row indices; SEQUENCE kills
+          reduce to "the key's first row decides everything"
+          (StreamPreStateProcessor.java:382-395 semantics).
+        - head (e1) batches never consume, so arming is rank-allocation of
+          free slots in index order; the one serial case — a `within`
+          expiry boundary crossing between two same-key arming rows, which
+          re-orders the free list mid-batch — is detected exactly and
+          `lax.cond`s into the generic engine (rare: needs two same-key
+          arms straddling an expiry inside ONE batch).
+        - SEQUENCE head batches: every event kills what it cannot extend,
+          so only the LAST row per key can remain pending, always at the
+          lowest free slot (index 0 once everything is killed).
+        """
+        plan = self.plan
+        S = plan.slots
+        L = plan.last_step
+        K = state["consumed"].shape[0]
+        B = cols[VALID_KEY].shape[0]
+        ts = cols[TS_KEY]
+        ts2d = ts[:, None]
+        valid_cur = cols[VALID_KEY] & (cols[TYPE_KEY] == CURRENT)
+        pk = jnp.clip(cols.get(PK_KEY, jnp.zeros(B, jnp.int32)).astype(jnp.int32), 0, K - 1)
+        w = plan.within
+        cap_names = list(self.cap_cols)
+        side = (plan.steps[0] if side_kind == "head" else plan.steps[1]).sides[0]
+        in_def = side.definition
+
+        def cur_ev(ev):
+            for a in in_def.attributes:
+                ev[a.name] = cols[a.name][:, None]
+                ev[a.name + "?"] = cols[a.name + "?"][:, None]
+            ev[TS_KEY] = ts2d
+            return ev
+
+        head_cap = plan.steps[0].sides[0].capture
+        tail_cap = plan.steps[1].sides[0].capture
+        head_pref = f"c{head_cap.cid}__"
+
+        if side_kind == "tail":
+            cap = side.capture
+            A_pk = state["active"][pk]
+            at1 = A_pk & (state["stepi"][pk] == L)
+            # slot state for the TAIL capture is never written by the fast
+            # head (and never read): its emission value IS the current row,
+            # so ev carries broadcast current values instead of gathers
+            CP = {n: state[n][pk] for n in cap_names
+                  if n.startswith(head_pref)}
+            tdef = tail_cap.definition
+            for a in tdef.attributes:
+                CP[cap_col(tail_cap.cid, a.name)] = jnp.broadcast_to(
+                    cols[a.name][:, None], (B, S))
+                CP[cap_col(tail_cap.cid, a.name) + "?"] = jnp.broadcast_to(
+                    cols[a.name + "?"][:, None], (B, S))
+            CP[cap_col(tail_cap.cid, TS_KEY)] = jnp.broadcast_to(ts2d, (B, S))
+            CD = state["capdone"][pk]
+            ev = cur_ev(self._fast_ev(CP, CD, B, fresh=False))
+            cond = (side.cond(ev, ctx) if side.cond is not None
+                    else jnp.ones((B, 1), bool))
+            match = at1 & jnp.broadcast_to(cond, (B, S)) & valid_cur[:, None]
+            if w is not None:
+                # lazy per-row expiry — exact for monotone feeds (the
+                # out-of-order case lax.conds to the generic engine below)
+                match = match & (ts2d <= state["sts"][pk] + jnp.int64(w))
+            ridx = jnp.arange(B, dtype=jnp.int32)
+
+            def tail_fast(state, cols):
+                if plan.sequence:
+                    # first VALID row per key consumes its matches and
+                    # kills the rest; later rows find nothing
+                    _o, _i, occv, _c, _s = _per_key_layout(pk, valid_cur, K)
+                    emit = match & (valid_cur & (occv == 0))[:, None]
+                    touched = jnp.zeros((K,), bool).at[
+                        jnp.where(valid_cur, pk, K)].set(True, mode="drop")
+                    active2 = state["active"] & ~touched[:, None]
+                else:
+                    first = jnp.full((K, S), B, jnp.int32).at[pk].min(
+                        jnp.where(match, ridx[:, None], B), mode="drop")
+                    emit = match & (ridx[:, None] == first[pk])
+                    active2 = state["active"] & ~(first < B)
+                emit_caps = dict(CP)  # c0 = slot state, c1 = current row
+                emit_caps["__capdone__"] = CD | (1 << cap.cid)
+                new_state = dict(state)
+                new_state["active"] = active2
+                out = self._fast_out(emit, emit_caps, ts, cols, pk, B)
+                out["__overflow__"] = jnp.int32(0)
+                out["__notify__"] = _notify_of(self._next_deadline(new_state))
+                return new_state, out
+
+            return tail_fast(state, cols)
+
+        # ---- head side
+        cap = side.capture
+        ev = cur_ev(self._fast_ev(state, None, B, fresh=True))
+        cond1 = (side.cond(ev, ctx)[:, 0] if side.cond is not None
+                 else jnp.ones((B,), bool))
+        arm_c = valid_cur & cond1
+
+        def head_fast(state, cols):
+            consumed0 = state["consumed"]
+            if plan.every:
+                arm = arm_c
+                _o, _i, occ, _c, _s = _per_key_layout(pk, arm, K)
+            else:
+                _o, _i, occc, _c, _s = _per_key_layout(pk, arm_c, K)
+                arm = arm_c & ~consumed0[pk] & (occc == 0)
+                occ = jnp.zeros(B, jnp.int64)
+            if plan.sequence:
+                # every valid row kills all (non-waitish = all) pendings of
+                # its key, then arms at the lowest free slot — only the
+                # LAST row per key survives, at slot 0
+                _o2, _i2, occv, cnts, _s2 = _per_key_layout(pk, valid_cur, K)
+                is_last = valid_cur & (occv == cnts[pk] - 1)
+                pend = arm & is_last
+                touched = jnp.zeros((K,), bool).at[
+                    jnp.where(valid_cur, pk, K)].set(True, mode="drop")
+                slot = jnp.where(pend, jnp.int64(0), jnp.int64(S))
+                flat = jnp.where(pend, pk.astype(jnp.int64) * S, jnp.int64(K * S))
+                active2 = state["active"] & ~touched[:, None]
+                overflow2 = state["nfa_overflow"]
+            else:
+                act_pk = state["active"][pk]
+                free = ~act_pk
+                if w is not None:
+                    free = free | (ts2d > state["sts"][pk] + jnp.int64(w))
+                n_free = jnp.sum(free, axis=1)
+                fs = jnp.argsort(
+                    jnp.where(free, jnp.arange(S)[None, :],
+                              S + jnp.arange(S)[None, :]), axis=1)
+                can = arm & (occ < n_free)
+                overflow2 = state["nfa_overflow"] + jnp.sum(
+                    arm & ~can).astype(jnp.int32)
+                slot = jnp.where(
+                    can,
+                    jnp.take_along_axis(
+                        fs, jnp.clip(occ, 0, S - 1)[:, None].astype(jnp.int32),
+                        axis=1)[:, 0].astype(jnp.int64),
+                    jnp.int64(S))
+                pend = arm & can
+                flat = jnp.where(pend, pk.astype(jnp.int64) * S + slot,
+                                 jnp.int64(K * S))
+                active2 = state["active"]
+                touched = None
+
+            def put2d(arr, val):
+                return arr.reshape(K * S).at[flat].set(
+                    val, mode="drop").reshape(K, S)
+
+            new_state = dict(state)
+            new_state["active"] = put2d(active2, True)
+            new_state["stepi"] = put2d(state["stepi"], jnp.int32(L))
+            new_state["bits"] = put2d(state["bits"], jnp.int32(0))
+            new_state["vbits"] = put2d(state["vbits"], jnp.int32(0))
+            new_state["sts"] = put2d(state["sts"], ts)
+            cleared_cd = put2d(state["capdone"], jnp.int32(1 << cap.cid))
+            new_state["capdone"] = cleared_cd
+            for n in cap_names:
+                if not n.startswith(head_pref):
+                    # tail-capture slot state is never read on the fast
+                    # path (emissions take the current row) — skip the
+                    # clearing scatters; capdone says "not captured"
+                    continue
+                base = state[n]
+                if n == cap_col(cap.cid, TS_KEY):
+                    val = ts
+                else:
+                    a = n[len(head_pref):]
+                    val = cols[a]
+                new_state[n] = put2d(base, val)
+            new_state["consumed"] = state["consumed"].at[
+                jnp.where(arm, pk, K)].set(True, mode="drop")
+            new_state["nfa_overflow"] = overflow2
+            emit = jnp.zeros((B, S), bool)
+            emit_caps = {n: jnp.zeros((B, S), dt)
+                         for n, dt in self.cap_cols.items()}
+            emit_caps["__capdone__"] = jnp.zeros((B, S), jnp.int32)
+            out = self._fast_out(emit, emit_caps, ts, cols, pk, B)
+            out["__overflow__"] = (
+                overflow2 > state["nfa_overflow"]).astype(jnp.int32)
+            out["__notify__"] = _notify_of(self._next_deadline(new_state))
+            return new_state, out
+
+        return head_fast(state, cols)
+
+    def _apply_stream_generic(self, stream_id: str, state: dict, cols: dict, ctx: dict):
+        """The generic per-round engine (see class docstring)."""
         plan = self.plan
         S = plan.slots
         L = plan.last_step

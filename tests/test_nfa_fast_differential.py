@@ -1,0 +1,222 @@
+"""Fast two-step NFA kernel == generic round-loop engine, differentially.
+
+The loop-free kernel (ops/nfa.py ``_apply_stream_fast``) replaces the
+per-round ``lax.while_loop`` for ``e1=A -> e2=B`` / ``e1=A, e2=B`` chains.
+These tests drive identical randomized MULTI-ROW batches (same-key
+duplicates, within-expiry straddles, filter failures) through a fast-path
+runtime and a generic-path runtime (``stage.fast_enabled = False``) and
+require byte-identical output sequences — emission order included
+(reference semantics: StreamPreStateProcessor.java:364-403).
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend((e.timestamp, tuple(e.data)) for e in events)
+
+
+def _drive(app, feeds, fast: bool, slots: int):
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.nfa_slots": str(slots)}))
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback("M", c)
+    q = rt.query_runtimes[list(rt.query_runtimes)[0]]
+    q.stage.fast_enabled = fast
+    hs = {s: rt.get_input_handler(s) for s in ("A", "B")}
+    err = None
+    try:
+        for stream, cols, ts in feeds:
+            hs[stream].send_columns(cols, timestamps=ts)
+    except Exception as ex:  # overflow parity counts too
+        err = type(ex).__name__
+    m.shutdown()
+    return c.rows, err
+
+
+def _random_feeds(rng, n_batches, max_rows, n_keys, ts_jump_ms):
+    """Interleaved multi-row A/B batches with same-key duplicates."""
+    feeds = []
+    t = 1_000
+    for _ in range(n_batches):
+        stream = "A" if rng.random() < 0.55 else "B"
+        n = int(rng.integers(1, max_rows + 1))
+        keys = np.array([f"K{int(i)}" for i in rng.integers(0, n_keys, n)],
+                        dtype=object)
+        vals = np.round(rng.random(n) * 10.0, 1)
+        # occasional in-batch ts spread, sometimes straddling `within`
+        spread = rng.choice([0, 1, ts_jump_ms])
+        ts = t + np.sort(rng.integers(0, spread + 1, n)).astype(np.int64)
+        feeds.append((stream, {"k": keys, "v": vals}, ts))
+        t += int(rng.integers(1, ts_jump_ms))
+    return feeds
+
+
+PATTERNS = {
+    "every-pattern-within": """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        from every e1=A -> e2=B[e2.v > e1.v] within 2 sec
+        select e1.v as v1, e2.v as v2, e1.k as k insert into M;
+    """,
+    "every-pattern-nowithin": """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        from every e1=A -> e2=B[e2.v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into M;
+    """,
+    "nonevery-pattern": """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        from e1=A -> e2=B[e2.v > e1.v] within 2 sec
+        select e1.v as v1, e2.v as v2 insert into M;
+    """,
+    "every-pattern-headfilter": """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        from every e1=A[v > 3.0] -> e2=B[e2.v > e1.v] within 2 sec
+        select e1.v as v1, e2.v as v2 insert into M;
+    """,
+    "every-sequence": """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        from every e1=A, e2=B[e2.v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into M;
+    """,
+    "nonevery-sequence": """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        from e1=A, e2=B[e2.v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into M;
+    """,
+    "every-sequence-within": """
+        @app:playback
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        from every e1=A, e2=B[e2.v > e1.v] within 2 sec
+        select e1.v as v1, e2.v as v2 insert into M;
+    """,
+}
+
+
+@pytest.mark.parametrize("name", sorted(PATTERNS))
+def test_fast_matches_generic_unpartitioned(name):
+    app = PATTERNS[name]
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    feeds = _random_feeds(rng, n_batches=30, max_rows=6, n_keys=1,
+                          ts_jump_ms=900)
+    fast, ef = _drive(app, feeds, fast=True, slots=16)
+    slow, es = _drive(app, feeds, fast=False, slots=16)
+    assert ef == es
+    assert fast == slow
+
+
+@pytest.mark.parametrize("name", sorted(PATTERNS))
+def test_fast_matches_generic_partitioned(name):
+    app = PATTERNS[name].replace(
+        "from ", "partition with (k of A, k of B) begin @info(name='q') from ", 1
+    ).replace("insert into M;", "insert into M; end;")
+    rng = np.random.default_rng(zlib.crc32(name.encode()) // 2)
+    feeds = _random_feeds(rng, n_batches=40, max_rows=8, n_keys=5,
+                          ts_jump_ms=700)
+    fast, ef = _drive(app, feeds, fast=True, slots=16)
+    slow, es = _drive(app, feeds, fast=False, slots=16)
+    assert ef == es
+    assert fast == slow
+
+
+def test_fast_hard_fallback_expiry_straddle():
+    """An in-batch ts spread straddling `within` for one key forces the
+    lax.cond fallback to the generic engine; outputs must still agree."""
+    app = PATTERNS["every-pattern-within"]
+    feeds = []
+    # pre-arm two pendings whose deadlines fall INSIDE the next batch span
+    feeds.append(("A", {"k": np.array(["K0", "K0"], object),
+                        "v": np.array([1.0, 2.0])},
+                  np.array([1_000, 1_400], np.int64)))
+    # arming batch: same key, rows straddling both deadlines (3000, 3400)
+    feeds.append(("A", {"k": np.array(["K0", "K0", "K0"], object),
+                        "v": np.array([3.0, 4.0, 5.0])},
+                  np.array([2_900, 3_200, 3_600], np.int64)))
+    feeds.append(("B", {"k": np.array(["K0"], object),
+                        "v": np.array([9.9])},
+                  np.array([3_700], np.int64)))
+    fast, ef = _drive(app, feeds, fast=True, slots=16)
+    slow, es = _drive(app, feeds, fast=False, slots=16)
+    assert ef == es is None
+    assert fast == slow
+    assert len(fast) > 0
+
+
+def test_fast_overflow_parity():
+    app = PATTERNS["every-pattern-nowithin"]
+    rows = 10
+    feeds = [("A", {"k": np.array(["K0"] * rows, object),
+                    "v": np.arange(rows, dtype=float)},
+              np.arange(1_000, 1_000 + rows, dtype=np.int64))]
+    fast, ef = _drive(app, feeds, fast=True, slots=4)
+    slow, es = _drive(app, feeds, fast=False, slots=4)
+    assert ef == es == "FatalQueryError"
+
+
+def test_ineligible_plans_take_generic_path():
+    """3-step, logical, count, and same-stream chains must not dispatch to
+    the fast kernel."""
+    from siddhi_tpu.core.manager import SiddhiManager as SM
+
+    cases = [
+        "from every e1=A -> e2=B -> e3=A select e1.v as v1 insert into M;",
+        "from every e1=A -> not B for 1 sec select e1.v as v1 insert into M;",
+        "from every e1=A<1:3> -> e2=B select e2.v as v2 insert into M;",
+        "from every e1=A -> e2=A[e2.v > e1.v] select e1.v as v1 insert into M;",
+    ]
+    for q in cases:
+        m = SM()
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback define stream A (k string, v double); "
+            "define stream B (k string, v double); " + q)
+        rtq = rt.query_runtimes[list(rt.query_runtimes)[0]]
+        assert rtq.stage._fast_side("A") is None, q
+        assert rtq.stage._fast_side("B") is None, q
+        m.shutdown()
+
+
+def test_out_of_order_timestamp_cannot_resurrect_expired_pending():
+    """Minimized from a randomized divergence: the generic engine expires
+    pendings PHYSICALLY at each event's ts (`_expire` clears persist), so
+    an out-of-order earlier-ts event must not match a pending that a
+    later-ts event already expired. Playback feeds can go backwards."""
+    app = PATTERNS["every-pattern-headfilter"].replace(
+        "from ", "partition with (k of A, k of B) begin @info(name='q') from ", 1
+    ).replace("insert into M;", "insert into M; end;")
+    feeds = [
+        ("A", {"k": np.array(["K1"], object), "v": np.array([3.5])},
+         np.array([8_762], np.int64)),
+        ("B", {"k": np.array(["K1"], object), "v": np.array([2.6])},
+         np.array([11_015], np.int64)),   # expires the pending (dl 10762)
+        ("B", {"k": np.array(["K1"], object), "v": np.array([6.2])},
+         np.array([10_684], np.int64)),   # out-of-order: must NOT match
+    ]
+    fast, ef = _drive(app, feeds, fast=True, slots=16)
+    slow, es = _drive(app, feeds, fast=False, slots=16)
+    assert ef == es is None
+    assert fast == slow == []

@@ -443,3 +443,194 @@ def test_logical_three_conjuncts():
     m.shutdown()
     assert sorted(tuple(e.data) for e in q.events) == [
         ("IBM", 50), ("WSO2", 100)]
+
+
+# ---------------------------------------------------------------- round 5:
+# remaining UpdateOrInsertTableTestCase scenarios
+
+
+def build_q(app, query="query2"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    q = QCollect()
+    rt.add_callback(query, q)
+    return m, rt, q
+
+
+UOI_BASE = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define table StockTable (symbol string, price float, volume long);
+"""
+
+
+def test_upsert_then_composite_in_probe():
+    """updateOrInsertTableTest4 (:254-319): upsert keyed on symbol; the
+    (symbol, volume) `in` probe sees the post-upsert values."""
+    m, rt, q = build_q(UOI_BASE + """
+        @info(name = 'query2') from StockStream
+        update or insert into StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol==StockTable.symbol and
+                               volume==StockTable.volume) in StockTable]
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    stock.send(["IBM", 77.6, 200])     # updates IBM's row
+    check.send(["IBM", 100])           # stale volume: no match
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("WSO2", 100), ("WSO2", 100)]
+
+
+def test_upsert_with_aliases_no_output_query():
+    """updateOrInsertTableTest5 (:322-372): aliased upsert
+    (comp as symbol) compiles and runs; nothing listens on OutStream."""
+    m, rt, q = build_q(UOI_BASE + """
+        define stream UpdateStockStream (comp string, vol long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2') from UpdateStockStream
+        select comp as symbol, vol as volume
+        update or insert into StockTable on StockTable.symbol==symbol;
+    """, query="query1")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("UpdateStockStream").send(["FB", 300])
+    m.shutdown()
+    # the reference only asserts the app runs (nothing listens on
+    # OutStream); our callback sits on query1 and sees the two inserts
+    assert len(q.events) == 2
+
+
+def test_upsert_projected_then_triple_in_probe():
+    """updateOrInsertTableTest8 (:508-570): projected upsert; 3-way
+    composite probe before and after."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query2') from StockStream
+        select symbol, price, volume
+        update or insert into StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol==StockTable.symbol and
+                               volume==StockTable.volume and
+                               price==StockTable.price) in StockTable]
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 155.6, 100])
+    check.send(["IBM", 100, 155.6])
+    check.send(["WSO2", 100, 155.6])
+    stock.send(["IBM", 155.6, 200])
+    check.send(["IBM", 200, 155.6])
+    check.send(["WSO2", 100, 155.6])
+    m.shutdown()
+    assert [(e.data[0], e.data[1]) for e in q.events] == [
+        ("IBM", 100), ("IBM", 200)]
+
+
+def test_upsert_left_outer_join_existing_row():
+    """updateOrInsertTableTest9 (:573-641): left-outer enrichment upsert of
+    an EXISTING row keeps its price (join side non-null)."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define stream UpdateStockStream (comp string, vol long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2') from UpdateStockStream left outer join StockTable
+        on UpdateStockStream.comp == StockTable.symbol
+        select symbol, ifThenElse(price is null,0f,price) as price,
+               vol as volume
+        update or insert into StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol==StockTable.symbol and
+                               volume==StockTable.volume and
+                               price==StockTable.price) in StockTable]
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 155.6, 100])
+    check.send(["IBM", 100, 155.6])
+    check.send(["WSO2", 100, 155.6])
+    rt.get_input_handler("UpdateStockStream").send(["IBM", 200])
+    check.send(["IBM", 200, 155.6])
+    check.send(["WSO2", 100, 155.6])
+    m.shutdown()
+    assert [(e.data[0], e.data[1]) for e in q.events] == [
+        ("IBM", 100), ("IBM", 200)]
+
+
+def test_upsert_left_outer_join_missing_row_null_fill():
+    """updateOrInsertTableTest10 (:644-713): enrichment upsert of a row NOT
+    in the table takes the ifThenElse null fill (price 0)."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define stream UpdateStockStream (comp string, vol long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2') from UpdateStockStream left outer join StockTable
+        on UpdateStockStream.comp == StockTable.symbol
+        select comp as symbol, ifThenElse(price is null,0f,price) as price,
+               vol as volume
+        update or insert into StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol==StockTable.symbol and
+                               volume==StockTable.volume and
+                               price==StockTable.price) in StockTable]
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    upd = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    check.send(["IBM", 100, 155.6])
+    check.send(["WSO2", 100, 155.6])
+    upd.send(["IBM", 200])
+    upd.send(["WSO2", 300])
+    check.send(["IBM", 200, 0.0])
+    check.send(["WSO2", 300, 55.6])
+    m.shutdown()
+    assert [(e.data[0], e.data[1]) for e in q.events] == [
+        ("IBM", 200), ("WSO2", 300)]
+
+
+def test_upsert_chunk_sequential_visibility():
+    """updateOrInsertTableTest11 (:716-780): one 4-event chunk whose later
+    events update rows the earlier events of the SAME chunk inserted."""
+    m, rt, q = build_q("""
+        define stream UpdateStockStream (symbol string, price int, volume long);
+        define stream SearchStream (symbol string);
+        define table StockTable (symbol string, price int, volume long);
+        @info(name = 'query1') from UpdateStockStream
+        update or insert into StockTable on StockTable.symbol == symbol;
+        @info(name = 'query2') from SearchStream#window.length(1) join StockTable
+        on StockTable.symbol == SearchStream.symbol
+        select StockTable.symbol as symbol, price, volume
+        insert into OutStream;
+    """)
+    import numpy as np
+
+    upd = rt.get_input_handler("UpdateStockStream")
+    upd.send_columns(
+        {"symbol": np.array(["WSO2", "IBM", "WSO2", "IBM"], object),
+         "price": np.array([55, 55, 155, 155], np.int32),
+         "volume": np.array([100, 100, 200, 200], np.int64)})
+    rt.get_input_handler("SearchStream").send(["WSO2"])
+    rt.get_input_handler("SearchStream").send(["IBM"])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("WSO2", 155, 200), ("IBM", 155, 200)]

@@ -196,3 +196,454 @@ def test_index_relational_update_condition():
     m.shutdown()
     assert [(round(e.data[0], 4), e.data[1]) for e in q.events] == [
         (55.6, 200), (77.6, 200)]
+
+
+# ---------------------------------------------------------------- round 5:
+# the remainder of IndexTableTestCase.java (test35-analog timing races are
+# covered deterministically by tests/test_index_probes.py)
+
+IDX_VOLUME = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define stream UpdateStockStream (symbol string, price float, volume long);
+    @Index('volume')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+IDX_SYMBOL = IDX_VOLUME.replace("@Index('volume')", "@Index('symbol')")
+
+
+def _range_feed(rt):
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["GOOG", 50.6, 50])
+    stock.send(["ABC", 5.6, 70])
+
+
+def _idx_range_case(op, probe, expected):
+    m, rt, q = build_q(IDX_VOLUME + f"""
+        @info(name = 'query2') from CheckStockStream join StockTable
+        on {op}
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """)
+    _range_feed(rt)
+    rt.get_input_handler("CheckStockStream").send(list(probe))
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == sorted(expected)
+
+
+def test_index_lt_join():
+    """indexTableTest4 (:258-321)."""
+    _idx_range_case("StockTable.volume < CheckStockStream.volume",
+                    ("IBM", 200),
+                    [("IBM", "ABC", 70), ("IBM", "GOOG", 50)])
+
+
+def test_index_le_join():
+    """indexTableTest5 (:324-387)."""
+    _idx_range_case("StockTable.volume <= CheckStockStream.volume",
+                    ("IBM", 70),
+                    [("IBM", "ABC", 70), ("IBM", "GOOG", 50)])
+
+
+def test_index_gt_join():
+    """indexTableTest6 (:390-453)."""
+    _idx_range_case("StockTable.volume > CheckStockStream.volume",
+                    ("IBM", 50),
+                    [("IBM", "WSO2", 200), ("IBM", "ABC", 70)])
+
+
+def test_index_ne_update_then_ne_join():
+    """indexTableTest10 (:668-747): update on symbol != 'IBM' rewrites the
+    WSO2 row to the update event's values; != probes before and after."""
+    m, rt, q = build_q(IDX_SYMBOL + """
+        @info(name = 'query2') from UpdateStockStream
+        update StockTable on StockTable.symbol!=symbol;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        on CheckStockStream.symbol!=StockTable.symbol
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    upd = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    upd.send(["IBM", 77.6, 200])     # updates WSO2 -> (IBM, 77.6, 200)
+    check.send(["WSO2", 100])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    assert rows[:2] == [("WSO2", 100), ("IBM", 100)]
+    assert sorted(rows[2:]) == [("IBM", 100), ("IBM", 200)]
+
+
+def _idx_update_case(update_on, expected1, expected2):
+    m, rt, q = build_q(IDX_VOLUME + f"""
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on {update_on};
+        @info(name = 'query3') from CheckStockStream join StockTable
+        on CheckStockStream.volume >= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 200])
+    rt.get_input_handler("UpdateStockStream").send(["FOO", 77.6, 200])
+    rt.get_input_handler("CheckStockStream").send(["BAR", 200])
+    m.shutdown()
+    rows = [(round(float(e.data[0]), 4), e.data[1]) for e in q.events]
+    assert sorted(rows[:2]) == sorted(expected1)
+    assert sorted(rows[2:]) == sorted(expected2)
+
+
+def test_index_update_le_no_pk_allows_collision():
+    """indexTableTest11 (:750-829): with a plain @Index (no primary key)
+    the volume<=200 update rewrites BOTH rows to (77.6, 200) — duplicates
+    are legal in an indexed (non-PK) table."""
+    _idx_update_case("StockTable.volume <= volume",
+                     [(55.6, 200), (55.6, 100)],
+                     [(77.6, 200), (77.6, 200)])
+
+
+def test_index_update_lt():
+    """indexTableTest12 (:832-911): volume<200 rewrites IBM only."""
+    _idx_update_case("StockTable.volume < volume",
+                     [(55.6, 200), (55.6, 100)],
+                     [(55.6, 200), (77.6, 200)])
+
+
+def test_index_update_gt():
+    """indexTableTest14 (:989-1062): volume>150 rewrites WSO2 to
+    (77.6, 150); probe join is check.volume <= table.volume."""
+    m, rt, q = build_q(IDX_VOLUME + """
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume > volume;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 150])
+    rt.get_input_handler("UpdateStockStream").send(["FOO", 77.6, 150])
+    rt.get_input_handler("CheckStockStream").send(["BAR", 150])
+    m.shutdown()
+    rows = [(round(float(e.data[0]), 4), e.data[1]) for e in q.events]
+    assert rows == [(55.6, 200), (77.6, 150)]
+
+
+IDX_DELETE = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define stream DeleteStockStream (symbol string, price float, volume long);
+    @Index('{attr}')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def _idx_delete_case(attr, delete_on, feed, before, after):
+    m, rt, q = build_q(IDX_DELETE.format(attr=attr) + f"""
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on {delete_on};
+        @info(name = 'query3') from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    for row in feed:
+        stock.send(list(row))
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    rt.get_input_handler("DeleteStockStream").send(["IBM", 77.6, 150 if "150" in delete_on else 200])
+    rt.get_input_handler("CheckStockStream").send(["FOO", 100])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    assert sorted(rows[:len(before)]) == sorted(before)
+    assert rows[len(before):] == after
+
+
+def test_index_delete_eq():
+    """indexTableTest15 (:1065-1140)."""
+    _idx_delete_case("symbol", "StockTable.symbol==symbol",
+                     [("WSO2", 55.6, 100), ("IBM", 55.6, 100)],
+                     [("IBM", 100), ("WSO2", 100)], [("WSO2", 100)])
+
+
+def test_index_delete_ne():
+    """indexTableTest16 (:1143-1218)."""
+    _idx_delete_case("symbol", "StockTable.symbol!=symbol",
+                     [("WSO2", 55.6, 100), ("IBM", 55.6, 100)],
+                     [("IBM", 100), ("WSO2", 100)], [("IBM", 100)])
+
+
+def test_index_delete_gt():
+    """indexTableTest17 (:1221-1296): delete volume > 150."""
+    m, rt, q = build_q(IDX_DELETE.format(attr="volume") + """
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume>volume;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    rt.get_input_handler("DeleteStockStream").send(["IBM", 77.6, 150])
+    rt.get_input_handler("CheckStockStream").send(["FOO", 100])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    assert sorted(rows[:2]) == [("IBM", 100), ("WSO2", 200)]
+    assert rows[2:] == [("IBM", 100)]
+
+
+def test_index_delete_ge():
+    """indexTableTest18 (:1299-1375): delete volume >= 200."""
+    m, rt, q = build_q(IDX_DELETE.format(attr="volume") + """
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume>=volume;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    rt.get_input_handler("DeleteStockStream").send(["IBM", 77.6, 200])
+    rt.get_input_handler("CheckStockStream").send(["FOO", 100])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    assert sorted(rows[:2]) == [("IBM", 100), ("WSO2", 200)]
+    assert rows[2:] == [("IBM", 100)]
+
+
+def test_index_delete_lt():
+    """indexTableTest19 (:1378-1453): delete volume < 150."""
+    m, rt, q = build_q(IDX_DELETE.format(attr="volume") + """
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume < volume;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    rt.get_input_handler("DeleteStockStream").send(["IBM", 77.6, 150])
+    rt.get_input_handler("CheckStockStream").send(["FOO", 100])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    assert sorted(rows[:2]) == [("IBM", 100), ("WSO2", 200)]
+    assert rows[2:] == [("WSO2", 200)]
+
+
+def test_index_delete_le():
+    """indexTableTest20 (:1456-1533): delete volume <= 150 removes IBM and
+    BAR."""
+    m, rt, q = build_q(IDX_DELETE.format(attr="volume") + """
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume <= volume;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["BAR", 55.6, 150])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    rt.get_input_handler("DeleteStockStream").send(["IBM", 77.6, 150])
+    rt.get_input_handler("CheckStockStream").send(["FOO", 100])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    assert sorted(rows[:3]) == [("BAR", 150), ("IBM", 100), ("WSO2", 200)]
+    assert rows[3:] == [("WSO2", 200)]
+
+
+IDX_IN = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    @Index('{attr}')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def _idx_in_case(attr, cond, probes, expected):
+    m, rt, q = build_q(IDX_IN.format(attr=attr) + f"""
+        @info(name = 'query2')
+        from CheckStockStream[{cond}]
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["BAR", 55.6, 150])
+    stock.send(["IBM", 55.6, 100])
+    for p in probes:
+        rt.get_input_handler("CheckStockStream").send(list(p))
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == sorted(expected)
+
+
+def test_index_in_eq():
+    """indexTableTest21 (:1536-1596)."""
+    _idx_in_case("symbol", "(symbol==StockTable.symbol) in StockTable",
+                 [("FOO", 100), ("WSO2", 100)], [("WSO2", 100)])
+
+
+def test_index_in_ne():
+    """indexTableTest22 (:1599-1661)."""
+    _idx_in_case("symbol", "(symbol!=StockTable.symbol) in StockTable",
+                 [("FOO", 100), ("WSO2", 100)],
+                 [("FOO", 100), ("WSO2", 100)])
+
+
+def test_index_in_gt():
+    """indexTableTest23 (:1664-1726)."""
+    _idx_in_case("volume", "(volume > StockTable.volume) in StockTable",
+                 [("FOO", 170), ("FOO", 500)], [("FOO", 170), ("FOO", 500)])
+
+
+def test_index_in_lt():
+    """indexTableTest24 (:1729-1789)."""
+    _idx_in_case("volume", "(volume < StockTable.volume) in StockTable",
+                 [("FOO", 170), ("FOO", 500)], [("FOO", 170)])
+
+
+def test_index_in_le():
+    """indexTableTest25 (:1792-1853)."""
+    _idx_in_case("volume", "(volume <= StockTable.volume) in StockTable",
+                 [("FOO", 170), ("FOO", 200)], [("FOO", 170), ("FOO", 200)])
+
+
+def test_index_in_ge():
+    """indexTableTest26 (:1856-1917)."""
+    _idx_in_case("volume", "(volume >= StockTable.volume) in StockTable",
+                 [("FOO", 170), ("FOO", 100)], [("FOO", 170), ("FOO", 100)])
+
+
+def test_index_left_outer_upsert_then_triple_in_probe():
+    """indexTableTest27 (:1920-1996): left-outer enrichment upsert with
+    ifThenElse null fill; 3-way composite `in` probes count matches."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define stream UpdateStockStream (comp string, vol long);
+        @Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2') from UpdateStockStream left outer join StockTable
+        on UpdateStockStream.comp == StockTable.symbol
+        select comp as symbol, ifThenElse(price is null,0f,price) as price,
+               vol as volume
+        update or insert into StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol==StockTable.symbol
+                               and volume==StockTable.volume
+                               and price==StockTable.price) in StockTable]
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    upd = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    check.send(["IBM", 100, 155.6])
+    check.send(["WSO2", 100, 155.6])
+    upd.send(["IBM", 200])
+    upd.send(["WSO2", 300])
+    check.send(["IBM", 200, 0.0])
+    check.send(["WSO2", 300, 55.6])
+    m.shutdown()
+    assert [(e.data[0], e.data[1], round(float(e.data[2]), 4))
+            for e in q.events] == [("IBM", 200, 0.0), ("WSO2", 300, 55.6)]
+
+
+def test_index_with_primary_key_and_two_indexes():
+    """indexTableTest28 (:1999-2064): @PrimaryKey + two @Index annotations
+    coexist."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('symbol') @Index('price') @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2') from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["IBM", 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 100), ("WSO2", 100)]
+
+
+def test_index_two_indexes_no_pk():
+    """indexTableTest29 (:2067-2130): two distinct @Index annotations."""
+    m, rt, q = build_q("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @Index('symbol') @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable;
+        @info(name = 'query2') from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["IBM", 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 100), ("WSO2", 100)]
+
+
+def _expect_rejected(table_ann):
+    import pytest
+
+    from tests.test_table_define_corpus import CREATION_ERRORS
+    with pytest.raises(CREATION_ERRORS):
+        SiddhiManager().create_siddhi_app_runtime(f"""
+            define stream StockStream (symbol string, price float, volume long);
+            {table_ann}
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable;
+        """)
+
+
+def test_index_empty_attribute_rejected():
+    """indexTableTest30 (:2133-2156, AttributeNotExistException)."""
+    _expect_rejected("@Index('')")
+
+
+def test_index_multi_attribute_annotation_rejected():
+    """indexTableTest31 (:2159-2182, SiddhiAppValidationException): one
+    @Index annotation may name only one attribute."""
+    _expect_rejected("@Index('symbol', 'volume')")
+
+
+def test_index_duplicate_annotation_rejected():
+    """indexTableTest32 (:2185-2209, SiddhiAppValidationException)."""
+    _expect_rejected("@Index('symbol') @Index('symbol')")
+
+
+def test_index_unknown_attribute_rejected():
+    """indexTableTest33 (:2212-2235, AttributeNotExistException)."""
+    _expect_rejected("@Index('foo')")

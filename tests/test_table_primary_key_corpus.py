@@ -241,3 +241,526 @@ def test_pk_left_outer_join_upsert():
     m.shutdown()
     assert len(q.events) == 2
     assert [e.data[0] for e in q.events] == ["IBM", "WSO2"]
+
+
+# ---------------------------------------------------------------- round 5:
+# the remainder of PrimaryKeyTableTestCase.java (29 scenarios; test35's
+# indexing-speed timing race is covered deterministically by
+# tests/test_index_probes.py instead)
+
+PK_RANGE_FEED = [("WSO2", 55.6, 200), ("GOOG", 50.6, 50), ("ABC", 5.6, 70)]
+
+
+def _range_join(op):
+    return PK_VOLUME + f"""
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on {op}
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;
+    """
+
+
+def _feed_range(rt):
+    stock = rt.get_input_handler("StockStream")
+    for row in PK_RANGE_FEED:
+        stock.send(list(row))
+
+
+def test_pk_stream_gt_table_join():
+    """primaryKeyTableTest3 (:188-257): check.volume > table.volume probe,
+    two probes with per-probe expected splits."""
+    m, rt, q = build_q(_range_join("CheckStockStream.volume > StockTable.volume"))
+    _feed_range(rt)
+    check = rt.get_input_handler("CheckStockStream")
+    check.send(["IBM", 100])
+    check.send(["FOO", 60])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    assert sorted(rows[:2]) == [("IBM", "ABC", 70), ("IBM", "GOOG", 50)]
+    assert rows[2:] == [("FOO", "GOOG", 50)]
+
+
+def test_pk_table_lt_stream_join():
+    """primaryKeyTableTest4 (:260-323): table.volume < check.volume."""
+    m, rt, q = build_q(_range_join("StockTable.volume < CheckStockStream.volume"))
+    _feed_range(rt)
+    rt.get_input_handler("CheckStockStream").send(["IBM", 200])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", "ABC", 70), ("IBM", "GOOG", 50)]
+
+
+def test_pk_table_le_stream_join():
+    """primaryKeyTableTest5 (:326-389): table.volume <= check.volume."""
+    m, rt, q = build_q(_range_join("StockTable.volume <= CheckStockStream.volume"))
+    _feed_range(rt)
+    rt.get_input_handler("CheckStockStream").send(["IBM", 70])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", "ABC", 70), ("IBM", "GOOG", 50)]
+
+
+def test_pk_table_ge_stream_join():
+    """primaryKeyTableTest7 (:458-521): table.volume >= check.volume."""
+    m, rt, q = build_q(_range_join("StockTable.volume >= CheckStockStream.volume"))
+    _feed_range(rt)
+    rt.get_input_handler("CheckStockStream").send(["IBM", 70])
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == [
+        ("IBM", "ABC", 70), ("IBM", "WSO2", 200)]
+
+
+PK_UPDATE3 = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define stream UpdateStockStream (symbol string, price float, volume long);
+    @PrimaryKey('{key}')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def test_pk_update_on_key_between_probes():
+    """primaryKeyTableTest9 (:594-667): update on symbol key between two
+    probe pairs — IBM's volume changes 100 -> 200, WSO2 untouched."""
+    m, rt, q = build_q(PK_UPDATE3.format(key="symbol") + """
+        @info(name = 'query2') from UpdateStockStream
+        update StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    check = rt.get_input_handler("CheckStockStream")
+    upd = rt.get_input_handler("UpdateStockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    upd.send(["IBM", 77.6, 200])
+    check.send(["IBM", 100])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("WSO2", 100), ("IBM", 200), ("WSO2", 100)]
+
+
+def _update_range(update_on, expect_ordered):
+    """primaryKeyTableTest11-14 family: range-conditioned updates with a
+    numeric PK; probe via check.volume-vs-table.volume joins."""
+    app = PK_UPDATE3.format(key="volume") + f"""
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on {update_on};
+        @info(name = 'query3') from CheckStockStream join StockTable
+        on CheckStockStream.volume >= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;
+    """
+    m, rt, q = build_q(app, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 200])
+    rt.get_input_handler("UpdateStockStream").send(["FOO", 77.6, 200])
+    rt.get_input_handler("CheckStockStream").send(["BAR", 200])
+    m.shutdown()
+    rows = [(round(float(e.data[0]), 4), e.data[1]) for e in q.events]
+    return rows
+
+
+def test_pk_update_le_condition():
+    """primaryKeyTableTest11 (:745-823): update on table.volume <= 200
+    rewrites BOTH rows' (price, volume) to (77.6, 200) — but volume is the
+    PK, so the second write collides and is rejected, leaving one 77.6 row
+    and... the reference's expected2 is the ORIGINAL prices (update of PK
+    columns that collide is dropped per row)."""
+    rows = _update_range("StockTable.volume <= volume",
+                         None)
+    assert sorted(rows[:2]) == [(55.6, 100), (55.6, 200)]
+    assert sorted(rows[2:]) == [(55.6, 100), (55.6, 200)]
+
+
+def test_pk_update_lt_condition():
+    """primaryKeyTableTest12 (:826-904): update on table.volume < 200 would
+    move IBM(100) onto the occupied PK 200 — rejected; both rows keep
+    their original values."""
+    rows = _update_range("StockTable.volume < volume", None)
+    assert sorted(rows[:2]) == [(55.6, 100), (55.6, 200)]
+    assert sorted(rows[2:]) == [(55.6, 100), (55.6, 200)]
+
+
+def test_pk_update_ge_condition():
+    """primaryKeyTableTest13 (:907-979): update on table.volume >= 200 hits
+    WSO2 only (200 -> 77.6/200, same PK: in-place update allowed); the
+    probe join is `check.volume <= table.volume`."""
+    app = PK_UPDATE3.format(key="volume") + """
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume >= volume;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;
+    """
+    m, rt, q = build_q(app, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 200])
+    rt.get_input_handler("UpdateStockStream").send(["FOO", 77.6, 200])
+    rt.get_input_handler("CheckStockStream").send(["BAR", 200])
+    m.shutdown()
+    rows = [(round(float(e.data[0]), 4), e.data[1]) for e in q.events]
+    assert rows == [(55.6, 200), (77.6, 200)]
+
+
+def test_pk_update_gt_condition():
+    """primaryKeyTableTest14 (:982-1055): update on table.volume > 150
+    rewrites WSO2 to (77.6, 150): PK moves 200 -> 150 (unoccupied, allowed);
+    the BAR probe at 150 sees (77.6, 150)."""
+    app = PK_UPDATE3.format(key="volume") + """
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume > volume;
+        @info(name = 'query3') from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;
+    """
+    m, rt, q = build_q(app, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["IBM", 55.6, 100])
+    rt.get_input_handler("CheckStockStream").send(["WSO2", 150])
+    rt.get_input_handler("UpdateStockStream").send(["FOO", 77.6, 150])
+    rt.get_input_handler("CheckStockStream").send(["BAR", 150])
+    m.shutdown()
+    rows = [(round(float(e.data[0]), 4), e.data[1]) for e in q.events]
+    assert rows == [(55.6, 200), (77.6, 150)]
+
+
+PK_DELETE = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define stream DeleteStockStream (symbol string, price float, volume long);
+    @PrimaryKey('{key}')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def _delete_case(key, delete_on, feed, probes_expected):
+    app = PK_DELETE.format(key=key) + f"""
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on {delete_on};
+        @info(name = 'query3') from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;
+    """
+    m, rt, q = build_q(app, query="query3")
+    stock = rt.get_input_handler("StockStream")
+    for row in feed:
+        stock.send(list(row))
+    check = rt.get_input_handler("CheckStockStream")
+    dele = rt.get_input_handler("DeleteStockStream")
+    check.send(["WSO2", 100])
+    dele.send(["IBM", 77.6, probes_expected["del_vol"]])
+    check.send(["FOO", 100])
+    m.shutdown()
+    rows = [tuple(e.data) for e in q.events]
+    n1 = probes_expected["n_before"]
+    assert sorted(rows[:n1]) == sorted(probes_expected["before"])
+    assert rows[n1:] == probes_expected["after"]
+
+
+def test_pk_delete_ne_condition():
+    """primaryKeyTableTest16 (:1136-1211): delete on symbol != 'IBM'
+    removes WSO2; IBM remains."""
+    _delete_case(
+        "symbol", "StockTable.symbol!=symbol",
+        [("WSO2", 55.6, 100), ("IBM", 55.6, 100)],
+        {"del_vol": 200, "n_before": 2,
+         "before": [("IBM", 100), ("WSO2", 100)], "after": [("IBM", 100)]})
+
+
+def test_pk_delete_gt_condition():
+    """primaryKeyTableTest17 (:1214-1289): delete on table.volume > 150
+    removes WSO2(200); IBM(100) remains."""
+    _delete_case(
+        "volume", "StockTable.volume>volume",
+        [("WSO2", 55.6, 200), ("IBM", 55.6, 100)],
+        {"del_vol": 150, "n_before": 2,
+         "before": [("IBM", 100), ("WSO2", 200)], "after": [("IBM", 100)]})
+
+
+def test_pk_delete_ge_condition():
+    """primaryKeyTableTest18 (:1292-1368): delete on table.volume >= 200."""
+    _delete_case(
+        "volume", "StockTable.volume>=volume",
+        [("WSO2", 55.6, 200), ("IBM", 55.6, 100)],
+        {"del_vol": 200, "n_before": 2,
+         "before": [("IBM", 100), ("WSO2", 200)], "after": [("IBM", 100)]})
+
+
+def test_pk_delete_lt_condition():
+    """primaryKeyTableTest19 (:1371-1446): delete on table.volume < 150
+    removes IBM(100); WSO2(200) remains."""
+    _delete_case(
+        "volume", "StockTable.volume < volume",
+        [("WSO2", 55.6, 200), ("IBM", 55.6, 100)],
+        {"del_vol": 150, "n_before": 2,
+         "before": [("IBM", 100), ("WSO2", 200)], "after": [("WSO2", 200)]})
+
+
+def test_pk_delete_le_condition():
+    """primaryKeyTableTest20 (:1449-1526): delete on table.volume <= 150
+    removes IBM(100) and BAR(150); WSO2(200) remains."""
+    _delete_case(
+        "volume", "StockTable.volume <= volume",
+        [("WSO2", 55.6, 200), ("BAR", 55.6, 150), ("IBM", 55.6, 100)],
+        {"del_vol": 150, "n_before": 3,
+         "before": [("IBM", 100), ("BAR", 150), ("WSO2", 200)],
+         "after": [("WSO2", 200)]})
+
+
+PK_IN = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    @PrimaryKey('{key}')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def _in_case(key, cond, probes, expected):
+    m, rt, q = build_q(PK_IN.format(key=key) + f"""
+        @info(name = 'query2')
+        from CheckStockStream[{cond}]
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 200])
+    stock.send(["BAR", 55.6, 150])
+    stock.send(["IBM", 55.6, 100])
+    check = rt.get_input_handler("CheckStockStream")
+    for p in probes:
+        check.send(list(p))
+    m.shutdown()
+    assert sorted(tuple(e.data) for e in q.events) == sorted(expected)
+
+
+def test_pk_in_ne_condition():
+    """primaryKeyTableTest22 (:1592-1654): (symbol != table.symbol) in
+    StockTable passes when ANY row differs."""
+    _in_case("symbol", "(symbol!=StockTable.symbol) in StockTable",
+             [("FOO", 100), ("WSO2", 100)],
+             [("FOO", 100), ("WSO2", 100)])
+
+
+def test_pk_in_gt_condition():
+    """primaryKeyTableTest23 (:1657-1719)."""
+    _in_case("volume", "(volume > StockTable.volume) in StockTable",
+             [("FOO", 170), ("FOO", 500)],
+             [("FOO", 170), ("FOO", 500)])
+
+
+def test_pk_in_lt_condition():
+    """primaryKeyTableTest24 (:1722-1782): only 170 < some row (200)."""
+    _in_case("volume", "(volume < StockTable.volume) in StockTable",
+             [("FOO", 170), ("FOO", 500)],
+             [("FOO", 170)])
+
+
+def test_pk_in_le_condition():
+    """primaryKeyTableTest25 (:1785-1846)."""
+    _in_case("volume", "(volume <= StockTable.volume) in StockTable",
+             [("FOO", 170), ("FOO", 200)],
+             [("FOO", 170), ("FOO", 200)])
+
+
+def test_pk_in_ge_condition():
+    """primaryKeyTableTest26 (:1849-1910)."""
+    _in_case("volume", "(volume >= StockTable.volume) in StockTable",
+             [("FOO", 170), ("FOO", 100)],
+             [("FOO", 170), ("FOO", 100)])
+
+
+def test_pk_unknown_attribute_rejected():
+    """primaryKeyTableTest28 (:1992-2014, AttributeNotExistException):
+    @PrimaryKey names a non-existent attribute."""
+    import pytest
+
+    from tests.test_table_define_corpus import CREATION_ERRORS
+    with pytest.raises(CREATION_ERRORS):
+        m = SiddhiManager()
+        m.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey('symbol1')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable;
+        """)
+
+
+def test_pk_empty_annotation_rejected():
+    """primaryKeyTableTest29 (:2017-2040, SiddhiParserException)."""
+    import pytest
+
+    from tests.test_table_define_corpus import CREATION_ERRORS
+    with pytest.raises(CREATION_ERRORS):
+        m = SiddhiManager()
+        m.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey()
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable;
+        """)
+
+
+def test_pk_duplicate_annotation_rejected():
+    """primaryKeyTableTest31 (:2043-2066, DuplicateAnnotationException):
+    two @PrimaryKey annotations on one table."""
+    import pytest
+
+    from tests.test_table_define_corpus import CREATION_ERRORS
+    with pytest.raises(CREATION_ERRORS):
+        m = SiddhiManager()
+        m.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey('symbol') @PrimaryKey('price')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable;
+        """)
+
+
+def test_pk_malformed_annotation_rejected():
+    """primaryKeyTableTest32 (:2069-2092, SiddhiParserException):
+    @PrimaryKey'symbol' without parentheses."""
+    import pytest
+
+    from tests.test_table_define_corpus import CREATION_ERRORS
+    with pytest.raises(CREATION_ERRORS):
+        m = SiddhiManager()
+        m.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey'symbol'
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable;
+        """)
+
+
+def test_pk_case_sensitive_attribute_rejected():
+    """primaryKeyTableTest33 (:2095-2118, AttributeNotExistException):
+    'Symbol' != 'symbol'."""
+    import pytest
+
+    from tests.test_table_define_corpus import CREATION_ERRORS
+    with pytest.raises(CREATION_ERRORS):
+        m = SiddhiManager()
+        m.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey ('Symbol')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable;
+        """)
+
+
+COMPOSITE_PK = """
+    define stream StockStream (symbol string, price float, volume long);
+    define stream CheckStockStream (symbol string, volume long);
+    define stream UpdateStockStream (symbol string, price float, volume long);
+    @PrimaryKey('symbol','volume')
+    define table StockTable (symbol string, price float, volume long);
+    @info(name = 'query1') from StockStream insert into StockTable;
+"""
+
+
+def test_pk_composite_key_join():
+    """primaryKeyTableTest36 (:2264-2327): ('symbol','volume') composite
+    uniqueness — (IBM,100) and (IBM,200) coexist; probe on both keys."""
+    m, rt, q = build_q(COMPOSITE_PK + """
+        @info(name = 'query2') from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+           and CheckStockStream.volume==StockTable.volume
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    stock.send(["IBM", 56.6, 200])
+    check = rt.get_input_handler("CheckStockStream")
+    check.send(["IBM", 200])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 200), ("WSO2", 100)]
+
+
+def test_pk_composite_partial_key_join():
+    """primaryKeyTableTest37 (:2330-2394): probing only ONE half of the
+    composite key returns every row of that symbol."""
+    m, rt, q = build_q(COMPOSITE_PK + """
+        @info(name = 'query2') from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    stock.send(["WSO2", 55.6, 100])
+    stock.send(["IBM", 55.6, 100])
+    stock.send(["IBM", 56.6, 200])
+    check = rt.get_input_handler("CheckStockStream")
+    check.send(["IBM", 200])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [
+        ("IBM", 100), ("IBM", 200), ("WSO2", 100)]
+
+
+def test_pk_composite_key_and_constant_filter_join():
+    """primaryKeyTableTest38 (:2397-2463): composite-key probe AND a
+    constant price filter."""
+    m, rt, q = build_q(COMPOSITE_PK + """
+        @info(name = 'query2') from CheckStockStream join StockTable
+        on (CheckStockStream.symbol==StockTable.symbol
+            and CheckStockStream.volume==StockTable.volume)
+           and 55.6f == StockTable.price
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    for row in [["WSO2", 55.6, 100], ["IBM", 55.6, 100], ["IBM", 55.6, 101],
+                ["IBM", 55.6, 102], ["IBM", 55.6, 200]]:
+        stock.send(row)
+    check = rt.get_input_handler("CheckStockStream")
+    check.send(["IBM", 200])
+    check.send(["WSO2", 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 200), ("WSO2", 100)]
+
+
+def test_pk_composite_key_and_attr_equal_join():
+    """primaryKeyTableTest39 (:2466-2533): composite-key probe AND a
+    stream-vs-table price equality."""
+    app = COMPOSITE_PK.replace(
+        "define stream CheckStockStream (symbol string, volume long);",
+        "define stream CheckStockStream (symbol string, price float, volume long);")
+    m, rt, q = build_q(app + """
+        @info(name = 'query2') from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+           and CheckStockStream.volume==StockTable.volume
+           and CheckStockStream.price == StockTable.price
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;
+    """)
+    stock = rt.get_input_handler("StockStream")
+    for row in [["WSO2", 55.6, 100], ["IBM", 55.6, 100], ["IBM", 55.6, 101],
+                ["IBM", 55.6, 102], ["IBM", 55.6, 200]]:
+        stock.send(row)
+    check = rt.get_input_handler("CheckStockStream")
+    check.send(["IBM", 55.6, 200])
+    check.send(["WSO2", 55.6, 100])
+    m.shutdown()
+    assert [tuple(e.data) for e in q.events] == [("IBM", 200), ("WSO2", 100)]
