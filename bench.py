@@ -132,16 +132,16 @@ def bench_device():
     return n_events / dt
 
 
-def _make_e2e_runtime():
+def _make_e2e_runtime(defer_meta: int = 8):
     from siddhi_tpu import SiddhiManager, StreamCallback
     from siddhi_tpu.core.util.config import InMemoryConfigManager
 
     manager = SiddhiManager()
-    # batch 8 step metas into one device->host round trip (the tunnel
-    # charges ~70ms latency per pull — PERF.md); outputs drain every 8
+    # batch N step metas into one device->host round trip (the tunnel
+    # charges ~70ms latency per pull — PERF.md); outputs drain every N
     # batches and at shutdown
     manager.set_config_manager(InMemoryConfigManager(
-        {"siddhi_tpu.defer_meta": "8"}))
+        {"siddhi_tpu.defer_meta": str(defer_meta)}))
     rt = manager.create_siddhi_app_runtime(_APP)
 
     class Counter(StreamCallback):
@@ -208,6 +208,55 @@ def bench_e2e():
     manager.shutdown()
     assert Counter.n > 0
     return eps_str, eps_pre
+
+
+def bench_e2e_curve():
+    """Operating-point curve (VERDICT r04 next #7): e2e throughput AND
+    per-batch p99 at several (batch size, defer_meta) points — the
+    trade-off surface the junction's adaptive batcher navigates
+    (junction.py adaptive cap). Tunnel-gated: runs only when the probe
+    found a live device backend, so the record carries real-TPU points."""
+    rng = np.random.default_rng(7)
+    sym_strings = np.array([f"S{i}" for i in range(NUM_KEYS)], dtype=object)
+    points = []
+    for B, defer in ((16_384, 1), (16_384, 8), (65_536, 1), (65_536, 8)):
+        manager, rt, Counter = _make_e2e_runtime(defer_meta=defer)
+        h = rt.get_input_handler("StockStream")
+        warm_sym = sym_strings[np.arange(B, dtype=np.int64) % NUM_KEYS]
+        h.send_columns({"symbol": warm_sym,
+                        "price": np.ones(B, np.float32),
+                        "volume": np.ones(B, np.int64)},
+                       timestamps=np.zeros(B, np.int64))
+        pre = []
+        for i in range(4):
+            ids = rng.integers(0, NUM_KEYS, B, dtype=np.int64)
+            pre.append(({
+                "symbol": sym_strings[ids],
+                "price": (rng.random(B) * 100.0).astype(np.float32),
+                "volume": rng.integers(1, 1000, B, dtype=np.int64),
+            }, np.arange(i * B, (i + 1) * B, dtype=np.int64)))
+        h.send_columns(pre[0][0], timestamps=pre[0][1])
+        lat = []
+        n = 0
+        i = 0
+        t_end = time.perf_counter() + MEASURE_SECONDS / 2
+        while time.perf_counter() < t_end:
+            cols, ts = pre[i % 4]
+            t0 = time.perf_counter()
+            h.send_columns(cols, timestamps=ts)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+            n += B
+            i += 1
+        manager.shutdown()
+        assert Counter.n > 0
+        lat = np.sort(np.asarray(lat))
+        points.append({
+            "batch": B, "defer_meta": defer,
+            "eps": round(n / float(np.sum(lat) / 1000.0), 1),
+            "p99_ms": round(float(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))]), 3),
+        })
+    return points
 
 
 def bench_host_pipeline():
@@ -586,6 +635,7 @@ def main():
         "e2e_events_per_sec": None,            # genuine string ingest
         "e2e_preencoded_events_per_sec": None,  # int ids (no dict encode)
         "e2e_cpu_events_per_sec": None,         # string ingest, CPU backend
+        "e2e_curve": None,                      # [(batch, defer, eps, p99)]
         "host_pipeline_events_per_sec": None,   # device step stubbed
         "ingest_csv_events_per_sec": None,      # native CSV loader -> pump
         "mesh_scaling_eps": None,               # {n_devices: eps}, key-sharded
@@ -652,6 +702,14 @@ def main():
             emit()
         else:
             result["sections_failed"].append("nfa:skipped-wedged-tunnel")
+
+        if not wedged:
+            out, t_o = _run_section_once("e2e_curve", min(240.0, remaining()))
+            if out is not None:
+                result["e2e_curve"] = out["points"]
+            else:
+                result["sections_failed"].append("e2e_curve")
+            emit()
 
     # ---- probe first: a wedged tunnel costs one 30 s probe, not a 300 s
     # section timeout; probe log rides the result line (VERDICT r04 #1)
@@ -747,6 +805,8 @@ if __name__ == "__main__":
             print(json.dumps({"p99_ms": p99, "eps": eps}))
         elif section == "scaling":
             print(json.dumps({"eps_by_devices": bench_mesh_scaling()}))
+        elif section == "e2e_curve":
+            print(json.dumps({"points": bench_e2e_curve()}))
         else:
             raise SystemExit(f"unknown section {section}")
     else:

@@ -156,6 +156,9 @@ class SiddhiAppRuntime:
                 v = cm.get_property(f"siddhi_tpu.{knob}")
                 if v is not None:
                     setattr(self.app_context, knob, int(v))
+            v = cm.get_property("siddhi_tpu.cluster_step_timeout")
+            if v is not None:
+                self.app_context.cluster_step_timeout = float(v)
 
         # @app:statistics (reference SiddhiStatisticsManager wiring)
         stats_ann = siddhi_app.app_annotation("statistics")

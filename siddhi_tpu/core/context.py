@@ -165,6 +165,12 @@ class SiddhiAppContext:
         # the tunnel charges ~70ms latency per pull (see PERF.md). Set via
         # ConfigManager key siddhi_tpu.defer_meta.
         self.defer_meta = 1
+        # multi-process clusters: bound every device pull by this many
+        # seconds; a peer process dying mid-collective otherwise hangs
+        # the coordinator forever (ClusterPeerError surfaces through the
+        # junction's @OnError/fault-stream machinery). Set via
+        # ConfigManager key siddhi_tpu.cluster_step_timeout. None = off.
+        self.cluster_step_timeout = None
         # fold window evictions into invertible aggregator deltas where the
         # query shape allows (ops/fused_agg.py); off = always-generic path
         self.enable_fusion = True

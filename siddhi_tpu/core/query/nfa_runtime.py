@@ -411,7 +411,7 @@ class NFAQueryRuntime(QueryRuntime):
                     return None
                 return self.flush_deferred()
             dict.pop(out_host, "__meta__")
-            meta = np.asarray(meta)
+            meta = self._pull_meta(meta)
             overflow, notify, size_hint = int(meta[0]), int(meta[1]), int(meta[2])
         else:
             ovf = out_host.pop("__overflow__", None)

@@ -628,7 +628,7 @@ class QueryRuntime(Receiver):
                     return None
                 return self.flush_deferred()
             dict.pop(out_host, "__meta__")
-            meta = np.asarray(meta)
+            meta = self._pull_meta(meta)
             overflow = int(meta[0])
             notify = int(meta[1])
             size_hint = int(meta[2])
@@ -659,6 +659,19 @@ class QueryRuntime(Receiver):
             return int(notify)
         return None
 
+    def _pull_meta(self, meta):
+        """Pull the packed meta array; on a multi-process mesh with
+        ``siddhi_tpu.cluster_step_timeout`` set, bound the wait so a dead
+        peer surfaces as a labeled ClusterPeerError through the fault
+        machinery instead of hanging the coordinator (SURVEY.md §5.3)."""
+        timeout = getattr(self.app_context, "cluster_step_timeout", None)
+        if timeout is not None and self._shard_mesh is not None:
+            from siddhi_tpu.parallel.distributed import guarded_pull
+
+            return guarded_pull(meta, timeout,
+                                what=f"query '{self.name}' step")
+        return np.asarray(meta)
+
     @property
     def _defer_ok(self) -> bool:
         # scheduler-driven windows need their per-batch __notify__ promptly
@@ -674,8 +687,17 @@ class QueryRuntime(Receiver):
             if not self._deferred:
                 return None
             pending, self._deferred = self._deferred, []
-            metas = jax.device_get(
-                [dict.__getitem__(o, "__meta__") for o, _m in pending])
+            raw = [dict.__getitem__(o, "__meta__") for o, _m in pending]
+            timeout = getattr(self.app_context, "cluster_step_timeout", None)
+            if timeout is not None and self._shard_mesh is not None:
+                # the deferred drain is a device pull too: bound it the
+                # same way as _pull_meta, or a dead peer hangs it forever
+                from siddhi_tpu.parallel.distributed import guarded_pull
+
+                metas = guarded_pull(raw, timeout,
+                                     what=f"query '{self.name}' drain")
+            else:
+                metas = jax.device_get(raw)
             notify_min: Optional[int] = None
             overflow_err: Optional[str] = None
             for (out_host, overflow_msg), meta in zip(pending, metas):

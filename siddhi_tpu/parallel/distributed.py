@@ -56,3 +56,56 @@ def process_info() -> dict:
         "local_devices": len(jax.local_devices()),
         "global_devices": len(jax.devices()),
     }
+
+
+class ClusterPeerError(RuntimeError):
+    """A multi-process device pull did not complete within the configured
+    timeout — a peer process is presumed dead or unreachable.
+
+    The reference surfaces transport failures through source retry /
+    OnError hooks (``stream/input/source/Source.java:155-185``); the
+    TPU-native failure mode is different: a peer dying mid-collective
+    leaves every other host BLOCKED inside XLA, so the detection has to
+    be a bounded wait around the device pull. Raised inside the
+    junction's delivery path, this error rides the same ``@OnError`` /
+    fault-stream machinery as any other processing failure. Recovery
+    story: tear the runtime down, restart the cluster with the surviving
+    hosts (new ``jax.distributed`` incarnation), and
+    ``restore_last_revision()`` from the persistence store — snapshots
+    are host-side and replicated, so any surviving host can restore."""
+
+
+def guarded_pull(value, timeout_s: float, what: str = "cluster step"):
+    """``np.asarray(value)`` bounded by ``timeout_s``.
+
+    The wait runs in a daemon thread; on timeout the caller gets a
+    labeled ``ClusterPeerError`` immediately (the stuck native wait stays
+    parked in the abandoned thread — XLA host calls are not cancellable,
+    but the PROGRAM regains control, which is the part that matters for
+    failure detection)."""
+    import threading
+
+    import numpy as np
+
+    box = {}
+    done = threading.Event()
+
+    def wait():
+        try:
+            box["v"] = np.asarray(value)
+        except Exception as ex:  # surfaced to the caller below
+            box["e"] = ex
+        finally:
+            done.set()
+
+    t = threading.Thread(target=wait, daemon=True,
+                         name="siddhi-cluster-pull")
+    t.start()
+    if not done.wait(timeout_s):
+        raise ClusterPeerError(
+            f"{what} did not complete within {timeout_s:.1f}s — a cluster "
+            f"peer process is presumed dead; restart the cluster and "
+            f"restore from the last snapshot revision")
+    if "e" in box:
+        raise box["e"]
+    return box["v"]

@@ -210,3 +210,149 @@ def test_two_process_cluster_runs_real_queries():
         payload = json.loads(o.strip().splitlines()[-1])
         assert payload["flagship"] == expected["flagship"]
         assert payload["nfa"] == expected["nfa"]
+
+
+# ------------------------------------------------ peer-death failure bound
+
+_DEATH_WORKER = textwrap.dedent("""
+    import json
+    import os
+    import sys
+    import time
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/root/repo/.jax_cache")
+    sys.path.insert(0, "/root/repo")
+
+    coord, nproc, pid, flag = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]), sys.argv[4])
+    from siddhi_tpu.parallel.mesh import force_host_devices
+
+    force_host_devices(2)
+    from siddhi_tpu.parallel.distributed import (
+        global_mesh, initialize_cluster)
+
+    initialize_cluster(coordinator_address=coord, num_processes=nproc,
+                       process_id=pid)
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+    from siddhi_tpu.parallel.mesh import shard_query_step
+
+    # the partitioned NFA step carries 2 all-reduces per step on this
+    # mesh (checked via lowered HLO), so the survivor's next step REALLY
+    # blocks on the dead peer — the flagship group-by happens to compile
+    # collective-free at this shape and cannot exercise the bound
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.cluster_step_timeout": "4"}))
+    rt = m.create_siddhi_app_runtime('''
+        @app:playback
+        @OnError(action='stream')
+        define stream A (k string, v double);
+        define stream B (k string, v double);
+        partition with (k of A, k of B)
+        begin
+          @info(name = 'q')
+          from every e1=A -> e2=B[e2.v > e1.v] within 5 sec
+          select e1.v as v1, e2.v as v2
+          insert into Out;
+        end;
+    ''')
+    faults = []
+
+    class F(StreamCallback):
+        def receive(self, events):
+            faults.extend(str(e.data[-1]) for e in events)
+
+    rt.add_callback("!A", F())
+    shard_query_step(rt.query_runtimes["q"], global_mesh())
+    ha = rt.get_input_handler("A")
+    hb = rt.get_input_handler("B")
+    for i in range(4):
+        ha.send(1000 + i * 10, [f"P{i % 4}", float(i)])
+        hb.send(1001 + i * 10, [f"P{i % 4}", float(i) + 1.0])
+    if pid == 1:
+        open(flag, "w").write("dead")
+        os._exit(17)      # abrupt peer death, no cleanup
+    while not os.path.exists(flag):
+        time.sleep(0.05)
+    time.sleep(1.0)
+    # the survivor's next sharded step blocks on the dead peer's
+    # all-reduce: the guarded pull must surface a LABELED error within
+    # the configured bound through the @OnError fault stream
+    t0 = time.time()
+    for i in range(4, 8):
+        ha.send(1000 + i * 10, [f"P{i % 4}", float(i)])
+        if faults:
+            break
+    elapsed = time.time() - t0
+    print(json.dumps({"faults": faults[:1], "elapsed": elapsed}), flush=True)
+    os._exit(0)           # skip shutdown: the dead cluster cannot barrier
+""")
+
+
+def test_peer_death_is_bounded_and_labeled():
+    """VERDICT r04 next #6: killing one of two processes mid-stream must
+    produce a bounded, labeled failure on the survivor — surfaced through
+    the @OnError fault-stream machinery (reference failure-surface analog:
+    Source.java:155-185 retry/error hooks) — not a hang."""
+    import tempfile
+
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    flag = tempfile.mktemp(prefix="siddhi-peer-death-")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _DEATH_WORKER, coord, "2", str(pid), flag],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for pid in (0, 1)
+    ]
+    try:
+        out1, _err1 = procs[1].communicate(timeout=300)
+        assert procs[1].returncode == 17
+        try:
+            out0, err0 = procs[0].communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            raise AssertionError("survivor hung after peer death")
+        assert procs[0].returncode == 0, f"survivor failed:\n{err0[-3000:]}"
+    finally:
+        for q in procs:          # an early failure must not leak a spinner
+            if q.poll() is None:
+                q.kill()
+    payload = json.loads(out0.strip().splitlines()[-1])
+    assert payload["faults"], "no fault-stream event on the survivor"
+    # two bounded outcomes, both labeled with the peer failure: gloo's
+    # transport notices the closed connection immediately ("Connection
+    # closed by peer"), or — when the transport keeps waiting — the
+    # guarded pull times out with ClusterPeerError ("cluster peer
+    # process is presumed dead")
+    assert "peer" in payload["faults"][0], payload
+    assert payload["elapsed"] < 60, payload
+
+
+def test_guarded_pull_times_out_with_labeled_error():
+    """Unit semantics of the bounded wait (the integration test above may
+    take gloo's fast connection-closed path instead): a pull whose
+    materialization stalls longer than the bound raises ClusterPeerError
+    with the recovery hint."""
+    import time
+
+    import numpy as np
+
+    from siddhi_tpu.parallel.distributed import ClusterPeerError, guarded_pull
+
+    class Stall:
+        def __array__(self, dtype=None, copy=None):
+            time.sleep(8.0)          # a peer-blocked device pull
+            return np.zeros(3)
+
+    t0 = time.time()
+    with pytest.raises(ClusterPeerError, match="peer.*snapshot"):
+        guarded_pull(Stall(), 1.0, what="unit step")
+    assert time.time() - t0 < 5.0    # bounded, not the full stall
+    # the fast path returns the value when the wait completes in time
+    v = guarded_pull(np.arange(3), 5.0)
+    assert list(v) == [0, 1, 2]
