@@ -869,6 +869,16 @@ class Parser:
 
     # --------------------------------------------------- on-demand queries
 
+    @staticmethod
+    def _mutation_type(out, default: str) -> str:
+        if isinstance(out, DeleteStream):
+            return "delete"
+        if isinstance(out, UpdateOrInsertStream):
+            return "update_or_insert"
+        if isinstance(out, UpdateStream):
+            return "update"
+        return default
+
     def parse_on_demand_query(self) -> OnDemandQuery:
         q = OnDemandQuery()
         t = self.peek()
@@ -905,24 +915,18 @@ class Parser:
             t = self.peek()
             if t.is_kw("insert", "update", "delete", "return") :
                 q.output_stream = self.parse_output_action()
-                if isinstance(q.output_stream, DeleteStream):
-                    q.type = "delete"
-                elif isinstance(q.output_stream, UpdateOrInsertStream):
-                    q.type = "update_or_insert"
-                elif isinstance(q.output_stream, UpdateStream):
-                    q.type = "update"
-                else:
-                    q.type = "find"
+                q.type = self._mutation_type(q.output_stream, "find")
             else:
                 q.output_stream = ReturnStream()
                 q.type = "find"
             return q
         if self.accept_kw("select"):
-            # `select ... insert into Table` form
+            # `select ... {insert|update|update or insert|delete} ...` —
+            # the projection becomes the mutation's pseudo trigger event
             self.pos -= 1
             q.selector = self.parse_selector_clauses()
             q.output_stream = self.parse_output_action()
-            q.type = "insert"
+            q.type = self._mutation_type(q.output_stream, "insert")
             return q
         self.error("expected on-demand query")
 

@@ -96,61 +96,124 @@ def _run_mutation(oq: OnDemandQuery, app_runtime, dictionary) -> List[Event]:
         raise CompileError(
             f"on-demand {oq.type} target '{target}' is not a defined table")
     tdef = table.definition
-    resolver = TableConditionResolver(tdef, None, dictionary)
+    const_resolver = TableConditionResolver(tdef, None, dictionary)
+
+    # materialize the SELECT projection as a one-row pseudo event: its
+    # aliases are the mutation's "triggering event" attributes, so
+    # `select 100L as vol delete ... on StockTable.volume == vol` and
+    # `select "X" as s update ... set StockTable.symbol = s` resolve like
+    # their streaming counterparts (reference OnDemandQueryParser builds a
+    # matching StateEvent the same way)
+    from siddhi_tpu.query_api.definitions import (
+        Attribute, StreamDefinition as _SD)
+
+    ev_def = None
+    ev_batch = None
+    sel = (oq.selector.selection_list
+           if oq.selector is not None else []) or []
+    if sel:
+        ctx = {"xp": np, "current_time": 0}
+        row = {TS_KEY: np.zeros(1, np.int64),
+               TYPE_KEY: np.zeros(1, np.int8),
+               VALID_KEY: np.ones(1, bool)}
+        ev_attrs = []
+        sel_names = []
+        for i, oa in enumerate(sel):
+            fn, t = compile_expr(oa.expression, const_resolver)
+            try:
+                v, mk = fn({VALID_KEY: row[VALID_KEY]}, ctx)
+            except KeyError as e:
+                raise CompileError(
+                    "on-demand mutation projections must be constant "
+                    f"expressions (no column references): {e}") from None
+            try:
+                name = oa.name
+            except ValueError:
+                name = f"_c{i}"   # unaliased constant (positional insert)
+            row[name] = np.broadcast_to(np.asarray(v, dtype_of(t)), (1,))
+            row[name + "?"] = np.broadcast_to(
+                np.asarray(mk, bool) if mk is not None
+                else np.zeros(1, bool), (1,))
+            ev_attrs.append(Attribute(name=name, type=t))
+            sel_names.append(name)
+        ev_def = _SD(id="__on_demand__", attributes=ev_attrs)
+        ev_batch = HostBatch(row)
+    resolver = TableConditionResolver(tdef, ev_def, dictionary)
 
     if oq.type == "insert":
         # `select <values> insert into Table` — positional mapping
-        sel = oq.selector.selection_list
         if len(sel) != len(tdef.attributes):
             raise CompileError(
                 f"insert into '{target}' needs {len(tdef.attributes)} values")
         row = {TS_KEY: np.zeros(1, np.int64),
                TYPE_KEY: np.zeros(1, np.int8),
                VALID_KEY: np.ones(1, bool)}
-        ctx = {"xp": np, "current_time": 0}
-        for attr, oa in zip(tdef.attributes, sel):
-            fn, _t = compile_expr(oa.expression, resolver)
-            v, m = fn({VALID_KEY: row[VALID_KEY]}, ctx)
-            row[attr.name] = np.broadcast_to(
-                np.asarray(v, dtype_of(attr.type)), (1,))
-            row[attr.name + "?"] = np.broadcast_to(
-                np.asarray(m, bool) if m is not None else np.zeros(1, bool), (1,))
+        for attr, sname in zip(tdef.attributes, sel_names):
+            row[attr.name] = np.asarray(
+                ev_batch.cols[sname], dtype_of(attr.type))
+            row[attr.name + "?"] = np.asarray(ev_batch.cols[sname + "?"])
         table.insert(HostBatch(row))
         return []
 
     if oq.type == "delete":
         cond = compile_condition(out.on_delete, resolver) \
             if out.on_delete is not None else None
-        table.delete(cond, None)
+        table.delete(cond, ev_batch)
         return []
 
     cond = compile_condition(out.on_update, resolver) \
         if out.on_update is not None else None
     if out.update_set is None:
         raise CompileError(f"on-demand {oq.type} needs a `set` clause")
-    assignments = _compile_assignments(table, None, out.update_set, resolver)
+    assignments = _compile_assignments(table, ev_def, out.update_set, resolver)
     if oq.type == "update":
-        table.update(cond, assignments, None)
+        table.update(cond, assignments, ev_batch)
         return []
     if oq.type == "update_or_insert":
         import jax.numpy as jnp
 
-        m = table.update(cond, assignments, None)
+        m = table.update(cond, assignments, ev_batch)
         if not bool(np.asarray(jnp.any(m))):
             # no row matched: insert one built from the set clause
             ctx = {"xp": np, "current_time": 0}
+            ones = np.ones(1, bool)
+            ev = {VALID_KEY: ones}
+            if ev_batch is not None:
+                from siddhi_tpu.core.table.in_memory_table import EV_PREFIX
+
+                for k, v in ev_batch.cols.items():
+                    ev[EV_PREFIX + k] = np.asarray(v)[:, None]
             row = {TS_KEY: np.zeros(1, np.int64),
                    TYPE_KEY: np.zeros(1, np.int8),
-                   VALID_KEY: np.ones(1, bool)}
+                   VALID_KEY: ones}
+            # the reference inserts the PROJECTED pseudo event itself
+            # (UpdateOrInsertReducer converts the matching StateEvent), so
+            # name-matched projection columns seed the row; the set clause
+            # then overrides (they usually agree — test15: volume 123
+            # comes from the projection, not the set)
             set_cols = {}
+            set_masks = {}
             for col_name, fn, _t in assignments:
-                v, mk = fn({VALID_KEY: row[VALID_KEY]}, ctx)
-                set_cols[col_name] = v
+                try:
+                    v, mk = fn(ev, ctx)
+                except KeyError as e:
+                    raise CompileError(
+                        "on-demand update-or-insert: the `set` clause "
+                        "references a table column, which has no value on "
+                        f"the insert (no-match) branch: {e}") from None
+                set_cols[col_name] = np.asarray(v).reshape(-1)[:1]
+                set_masks[col_name] = (np.asarray(mk, bool).reshape(-1)[:1]
+                                       if mk is not None else np.zeros(1, bool))
             for attr in tdef.attributes:
                 if attr.name in set_cols:
                     row[attr.name] = np.broadcast_to(
                         np.asarray(set_cols[attr.name], dtype_of(attr.type)), (1,))
-                    row[attr.name + "?"] = np.zeros(1, bool)
+                    row[attr.name + "?"] = set_masks[attr.name]
+                elif ev_batch is not None and attr.name in ev_batch.cols:
+                    row[attr.name] = np.asarray(
+                        ev_batch.cols[attr.name], dtype_of(attr.type))[:1]
+                    row[attr.name + "?"] = np.asarray(
+                        ev_batch.cols[attr.name + "?"])[:1]
                 else:
                     row[attr.name] = np.zeros(1, dtype_of(attr.type))
                     row[attr.name + "?"] = np.ones(1, bool)   # null
@@ -284,6 +347,9 @@ class OnDemandFindRuntime:
             batch_mode=True,
             dictionary=dictionary,
         )
+        # reference store-query quirk: limit applies before the sort
+        # (see SelectorPlan.limit_before_order)
+        self.plan.limit_before_order = True
         self.group_fns = None
         if self.plan.group_by:
             from siddhi_tpu.ops.expressions import compile_expr

@@ -116,3 +116,56 @@ def test_doc_generator():
     assert "`hopping(windowT, hopT)`" in md
     assert "distinctCount" in md
     assert "`function:double` (MyFn) — Doubles a value." in md
+
+
+def test_rest_error_paths():
+    """SiddhiApiServiceImpl error behaviors (reference siddhi-service
+    API tests): unknown app names, malformed apps/queries, and unknown
+    streams surface as 4xx with an error body, never unhandled 500s or
+    hangs."""
+    m = SiddhiManager()
+    svc = SiddhiRestService(m).start()
+    p = svc.port
+    try:
+        # unknown app: events / statistics / persist / query / delete
+        assert _req_status(p, "POST", "/apps/NoSuchApp/events",
+                           {"stream": "S", "data": [[1]]}) == 400
+        assert _req_status(p, "POST", "/apps/NoSuchApp/persist", {}) == 400
+        assert _req_status(p, "POST", "/query",
+                           {"app": "NoSuchApp",
+                            "query": "from T select * return;"}) == 400
+        assert _req_status(p, "DELETE", "/apps/NoSuchApp", None) == 400
+
+        # malformed SiddhiQL app deploy
+        assert _req_status(p, "POST", "/apps",
+                           "define stream broken (") == 400
+
+        # deploy a real app, then hit it with bad requests
+        got = _req(p, "POST", "/apps", """
+            @app:name('ErrApp')
+            define stream S (sym string, price double);
+            define table T (sym string, price double);
+            from S insert into T;
+        """, as_json=False)
+        assert got == {"app": "ErrApp"}
+        # unknown stream in event post
+        assert _req_status(p, "POST", "/apps/ErrApp/events",
+                           {"stream": "Nope", "data": [["X", 1.0]]}) == 400
+        # malformed on-demand query
+        assert _req_status(p, "POST", "/query",
+                           {"app": "ErrApp",
+                            "query": "from T select sym,  bogus("}) == 400
+        # unknown attribute in on-demand query
+        assert _req_status(p, "POST", "/query",
+                           {"app": "ErrApp",
+                            "query": "from T select nope return;"}) == 400
+        # the app still works after the failed requests
+        _req(p, "POST", "/apps/ErrApp/events",
+             {"stream": "S", "data": [["IBM", 9.0]]})
+        rows = _req(p, "POST", "/query",
+                    {"app": "ErrApp",
+                     "query": "from T select sym, price return;"})["rows"]
+        assert rows == [["IBM", 9.0]]
+    finally:
+        svc.stop()
+        m.shutdown()
