@@ -1557,6 +1557,16 @@ class NFAStage:
                 j = st.index
                 eff_at = at_masks[oi] & (win == oi)
                 eff_adv = adv_masks[oi] & (win == oi)
+                if (st.kind == "and" and oi > 0 and ops[oi - 1][0] is st
+                        and not ops[oi - 1][1].absent):
+                    # ONE event matching BOTH `and` sides fills both
+                    # captures in the same round (each side is its own
+                    # pre-state processor in the reference and both consume
+                    # the event — LogicalPatternTestCase testQuery5); side 1
+                    # won the claim arbitration, side 2 still consumes
+                    both = (win == oi) | (win == oi - 1)
+                    eff_at = at_masks[oi] & both
+                    eff_adv = adv_masks[oi] & both
                 eff = eff_at | eff_adv
                 cap = side.capture
                 # advances out of a sticky (`every`) count source: the
@@ -1792,7 +1802,13 @@ class NFAStage:
             # the completing event itself does not seed the new iteration —
             # reference addEveryState lands after the current chunk)
             head_gend = plan.every_groups.get(0)
-            if plan.every and head_gend:
+            # a (0, 0) span gates only LOGICAL heads — `every (e1 and e2)`
+            # is ONE step whose half-filled pair parks AT step 0, and the
+            # next iteration must not arm beside it (LogicalPatternTestCase
+            # testQuery15); count heads (`every e1?`) also park at 0 but
+            # re-arm per event by design (SequenceTestCase testQuery7)
+            if plan.every and head_gend is not None and (
+                    head_gend > 0 or plan.steps[0].kind in ("and", "or")):
                 in_head_group = jnp.any(A & (ST <= head_gend), axis=1)
             else:
                 in_head_group = None

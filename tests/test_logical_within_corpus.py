@@ -162,3 +162,199 @@ def test_within_q5_grouped_every_triples_non_overlapping():
         h.send(t, ["WSO2", 58.7, v]); t += 10
     m.shutdown()
     assert _rows(c) == [(100, 150, 200), (210, 250, 260)]
+
+
+# ---------------------------------------------------------------- round 5:
+# LogicalPatternTestCase.java and/or tail+head permutations (2-16)
+
+TWO_STREAMS = """
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+"""
+
+THREE_STREAMS = TWO_STREAMS + """
+    define stream Stream3 (symbol string, price float, volume int);
+"""
+
+
+def _run(defs, query, feeds):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        defs + f"@info(name = 'query1') {query}")
+    got = []
+
+    class C(StreamCallback):
+        def receive(self, events):
+            got.extend(tuple(e.data) for e in events)
+
+    rt.add_callback("OutputStream", C())
+    hs = {s: rt.get_input_handler(s)
+          for s in ("Stream1", "Stream2", "Stream3") if s in defs}
+    for stream, data in feeds:
+        hs[stream].send(list(data))
+    m.shutdown()
+    return [tuple(round(float(x), 4) if isinstance(x, float) else x
+                  for x in row) for row in got]
+
+
+def test_logical_q2_or_tail_second_side_fires():
+    """testQuery2 (:98-146): `e2 or e3` tail — the e3 side ('IBM') fires;
+    e2's projection is null."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] "
+               "or e3=Stream2['IBM' == symbol] "
+               "select e1.symbol as symbol1, e2.symbol as symbol2 "
+               "insert into OutputStream;",
+               [("Stream1", ["WSO2", 55.6, 100]),
+                ("Stream2", ["IBM", 10.7, 100])])
+    assert got == [("WSO2", None)]
+
+
+def test_logical_q3_or_tail_first_side_fires():
+    """testQuery3 (:149-199): the e2 side (price > e1.price) fires first;
+    e3 stays null; the second qualifying event does not re-fire."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] "
+               "or e3=Stream2['IBM' == symbol] "
+               "select e1.symbol as symbol1, e2.price as price2, "
+               "e3.price as price3 insert into OutputStream;",
+               [("Stream1", ["WSO2", 55.6, 100]),
+                ("Stream2", ["IBM", 72.7, 100]),
+                ("Stream2", ["IBM", 75.7, 100])])
+    assert got == [("WSO2", 72.7, None)]
+
+
+def test_logical_q5_and_tail_one_event_matches_both_sides():
+    """testQuery5 (:255-305): ONE event matching both `and` sides fills
+    both captures (LogicalPreStateProcessor side-1-first)."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] "
+               "and e3=Stream2['IBM' == symbol] "
+               "select e1.symbol as symbol1, e2.price as price2, "
+               "e3.price as price3 insert into OutputStream;",
+               [("Stream1", ["WSO2", 55.6, 100]),
+                ("Stream2", ["IBM", 72.7, 100]),
+                ("Stream2", ["IBM", 75.7, 100])])
+    assert got == [("WSO2", 72.7, 72.7)]
+
+
+def test_logical_q6_and_tail_cross_stream_sides():
+    """testQuery6 (:308-358): `and` sides on DIFFERENT streams complete
+    from separate events."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] "
+               "and e3=Stream1['IBM' == symbol] "
+               "select e1.symbol as symbol1, e2.price as price2, "
+               "e3.price as price3 insert into OutputStream;",
+               [("Stream1", ["WSO2", 55.6, 100]),
+                ("Stream2", ["IBM", 72.7, 100]),
+                ("Stream1", ["IBM", 75.7, 100])])
+    assert got == [("WSO2", 72.7, 75.7)]
+
+
+def test_logical_q9_or_head_second_side_arms():
+    """testQuery9 (:467-514): `or` HEAD — the e2 side arms (GOOG 72.7 >
+    30); e1 stays null in the emission."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] or e2=Stream2[price >30] "
+               "-> e3=Stream2['IBM' == symbol] "
+               "select e1.symbol as symbol1, e2.price as price2, "
+               "e3.price as price3 insert into OutputStream;",
+               [("Stream2", ["GOOG", 72.7, 100]),
+                ("Stream2", ["IBM", 4.7, 100])])
+    assert got == [(None, 72.7, 4.7)]
+
+
+def test_logical_q10_or_head_first_side_arms():
+    """testQuery10 (:517-565)."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] or e2=Stream2[price >30] "
+               "-> e3=Stream2['IBM' == symbol] "
+               "select e1.symbol as symbol1, e2.price as price2, "
+               "e3.price as price3 insert into OutputStream;",
+               [("Stream1", ["WSO2", 55.6, 100]),
+                ("Stream2", ["IBM", 4.7, 100])])
+    assert got == [("WSO2", None, 4.7)]
+
+
+def test_logical_q11_every_head_and_tail_two_matches():
+    """testQuery11 (:568-633): `every e1 -> e2 and e3` — both armed
+    iterations complete when the and-pair fills."""
+    got = _run(THREE_STREAMS,
+               "from every e1=Stream1[price >20] -> e2=Stream2['IBM' == symbol] "
+               "and e3=Stream3['WSO2' == symbol]"
+               "select e1.price as price1, e2.price as price2, "
+               "e3.price as price3 insert into OutputStream;",
+               [("Stream1", ["IBM", 25.5, 100]),
+                ("Stream1", ["IBM", 59.65, 100]),
+                ("Stream2", ["IBM", 45.5, 100]),
+                ("Stream3", ["WSO2", 46.56, 100])])
+    assert sorted(got) == [(25.5, 45.5, 46.56), (59.65, 45.5, 46.56)]
+
+
+def test_logical_q12_every_head_or_tail_two_matches():
+    """testQuery12 (:636-699): or-tail completes on its first side for
+    both armed iterations."""
+    got = _run(THREE_STREAMS,
+               "from every e1=Stream1[price >20] -> e2=Stream2['IBM' == symbol] "
+               "or e3=Stream3['WSO2' == symbol]"
+               "select e1.price as price1, e2.price as price2, "
+               "e3.price as price3 insert into OutputStream;",
+               [("Stream1", ["IBM", 25.5, 100]),
+                ("Stream1", ["IBM", 59.65, 100]),
+                ("Stream2", ["IBM", 45.5, 100])])
+    assert sorted(got) == [(25.5, 45.5, None), (59.65, 45.5, None)]
+
+
+def test_logical_q13_bare_and():
+    """testQuery13 (:702-754): a bare `e1 and e2` pattern completes once
+    and never re-arms."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] and e2=Stream2[price >30] "
+               "select e1.symbol as symbol1, e2.price as price2 "
+               "insert into OutputStream;",
+               [("Stream1", ["WSO2", 25.0, 100]),
+                ("Stream2", ["IBM", 35.0, 100]),
+                ("Stream1", ["GOOGLE", 45.0, 100]),
+                ("Stream2", ["ORACLE", 55.0, 100])])
+    assert got == [("WSO2", 35.0)]
+
+
+def test_logical_q14_bare_or():
+    """testQuery14 (:757-807): a bare `e1 or e2` fires on the first
+    matching side only."""
+    got = _run(TWO_STREAMS,
+               "from e1=Stream1[price > 20] or e2=Stream2[price >30] "
+               "select e1.symbol as symbol1, e2.price as price2 "
+               "insert into OutputStream;",
+               [("Stream1", ["WSO2", 25.0, 100]),
+                ("Stream2", ["IBM", 35.0, 100]),
+                ("Stream2", ["ORACLE", 45.0, 100])])
+    assert got == [("WSO2", None)]
+
+
+def test_logical_q15_every_and_group():
+    """testQuery15 (:810-868): `every (e1 and e2)` restarts after each
+    completion — two pairs, two matches."""
+    got = _run(TWO_STREAMS,
+               "from every (e1=Stream1[price > 20] and e2=Stream2[price >30]) "
+               "select e1.symbol as symbol1, e2.price as price2 "
+               "insert into OutputStream;",
+               [("Stream1", ["WSO2", 25.0, 100]),
+                ("Stream2", ["IBM", 35.0, 100]),
+                ("Stream1", ["GOOGLE", 45.0, 100]),
+                ("Stream2", ["ORACLE", 55.0, 100])])
+    assert got == [("WSO2", 35.0), ("GOOGLE", 55.0)]
+
+
+def test_logical_q16_every_or_group():
+    """testQuery16 (:871-931): `every (e1 or e2)` fires per matching event,
+    re-arming each time."""
+    got = _run(TWO_STREAMS,
+               "from every (e1=Stream1[price > 20] or e2=Stream2[price >30]) "
+               "select e1.symbol as symbol1, e2.price as price2 "
+               "insert into OutputStream;",
+               [("Stream1", ["WSO2", 25.0, 100]),
+                ("Stream2", ["IBM", 35.0, 100]),
+                ("Stream2", ["ORACLE", 45.0, 100])])
+    assert got == [("WSO2", None), (None, 35.0), (None, 45.0)]
