@@ -44,11 +44,11 @@ import json
 import os
 import time
 
-# Persistent compilation cache: the bench sections compile several large
-# step graphs (~35s each over the axon tunnel on first run); cache them
-# across runs so the driver's bench invocation stays fast.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
+# Persistent compilation cache: DISABLED — on this sandbox the on-disk
+# cache poisons itself (reads segfault mid-compile and can return wrong
+# results; see tests/conftest.py). A wrong-answer bench is worse than a
+# slow first compile; override the empty value to re-enable elsewhere.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
@@ -214,8 +214,9 @@ def bench_e2e_curve():
     """Operating-point curve (VERDICT r04 next #7): e2e throughput AND
     per-batch p99 at several (batch size, defer_meta) points — the
     trade-off surface the junction's adaptive batcher navigates
-    (junction.py adaptive cap). Tunnel-gated: runs only when the probe
-    found a live device backend, so the record carries real-TPU points."""
+    (junction.py adaptive cap). Runs on whatever backend exists; the
+    result record labels the backend (``e2e_curve_backend``), so a
+    CPU-fallback curve is recorded rather than another null."""
     rng = np.random.default_rng(7)
     sym_strings = np.array([f"S{i}" for i in range(NUM_KEYS)], dtype=object)
     points = []
@@ -636,6 +637,7 @@ def main():
         "e2e_preencoded_events_per_sec": None,  # int ids (no dict encode)
         "e2e_cpu_events_per_sec": None,         # string ingest, CPU backend
         "e2e_curve": None,                      # [(batch, defer, eps, p99)]
+        "e2e_curve_backend": None,
         "host_pipeline_events_per_sec": None,   # device step stubbed
         "ingest_csv_events_per_sec": None,      # native CSV loader -> pump
         "mesh_scaling_eps": None,               # {n_devices: eps}, key-sharded
@@ -661,7 +663,8 @@ def main():
         section timeout marks the tunnel wedged and skips the rest."""
         # a revival re-run supersedes the first attempt's failure tags —
         # drop them so the record can't carry both a result and its failure
-        stale = {"device", "e2e", "nfa", "e2e:skipped-wedged-tunnel",
+        stale = {"device", "e2e", "nfa", "e2e_curve",
+                 "e2e:skipped-wedged-tunnel",
                  "nfa:skipped-wedged-tunnel", "tunnel:probe-dead"}
         result["sections_failed"] = [
             s for s in result["sections_failed"] if s not in stale]
@@ -707,6 +710,7 @@ def main():
             out, t_o = _run_section_once("e2e_curve", min(240.0, remaining()))
             if out is not None:
                 result["e2e_curve"] = out["points"]
+                result["e2e_curve_backend"] = "tpu"
             else:
                 result["sections_failed"].append("e2e_curve")
             emit()
@@ -743,6 +747,17 @@ def main():
     else:
         result["sections_failed"].append("e2e_cpu")
     emit()
+    if result["e2e_curve"] is None:
+        # the curve is no longer tunnel-gated: the adaptive batcher's
+        # throughput/p99 trade-off gets a recorded artifact on whatever
+        # backend exists, labeled so a live-TPU run supersedes it
+        out, _ = _run_section_once("e2e_curve_cpu", min(240.0, remaining()))
+        if out is not None:
+            result["e2e_curve"] = out["points"]
+            result["e2e_curve_backend"] = "cpu-fallback"
+        else:
+            result["sections_failed"].append("e2e_curve")
+        emit()
     out, _ = _run_section_once("scaling_cpu", min(240.0, remaining()))
     if out is not None:
         result["mesh_scaling_eps"] = {

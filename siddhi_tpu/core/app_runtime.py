@@ -881,6 +881,8 @@ class SiddhiAppRuntime:
 
     def shutdown(self):
         self.app_context.stopped = True
+        if self.app_context.supervisor is not None:
+            self.app_context.supervisor.stop()
         self.app_context.timestamp_generator.stop_heartbeat()
         for qr in self.query_runtimes.values():
             if getattr(qr, "_deferred", None):
@@ -910,6 +912,41 @@ class SiddhiAppRuntime:
         if self.app_context.scheduler is not None:
             self.app_context.scheduler.shutdown()
         self._started = False
+
+    # ----------------------------------------------------- resilience API
+
+    def enable_wal(self, max_batches: int = 4096,
+                   max_events: Optional[int] = None):
+        """Attach a bounded ingest WAL (``resilience/replay.py``): every
+        accepted batch is recorded until the next checkpoint barrier trims
+        it; ``restore_revision`` replays the retained suffix, turning
+        checkpoint recovery from at-most-once into effectively-once.
+        Idempotent; returns the WAL."""
+        from siddhi_tpu.resilience.replay import IngestWAL
+
+        if self.app_context.ingest_wal is None:
+            self.app_context.ingest_wal = IngestWAL(
+                max_batches=max_batches, max_events=max_events,
+                app_context=self.app_context)
+        return self.app_context.ingest_wal
+
+    def supervise(self, interval_s: float = 0.25,
+                  wedge_timeout_s: float = 5.0, peer_recovery=None,
+                  peer_monitor=None):
+        """Start an ``AppSupervisor`` (``resilience/supervisor.py``) that
+        heartbeats this app's @Async junction workers — restarting dead or
+        wedged ones with their queues intact — and, when ``peer_recovery``
+        is given, runs the cluster-peer recovery protocol on a peer
+        failure (a ``ClusterPeerError`` from the bounded pull, or a lost
+        ``peer_monitor`` heartbeat). Idempotent; returns the supervisor."""
+        from siddhi_tpu.resilience.supervisor import AppSupervisor
+
+        if self.app_context.supervisor is None:
+            AppSupervisor(self, interval_s=interval_s,
+                          wedge_timeout_s=wedge_timeout_s,
+                          peer_recovery=peer_recovery,
+                          peer_monitor=peer_monitor).start()
+        return self.app_context.supervisor
 
     # ---------------------------------------------------- persistence API
 

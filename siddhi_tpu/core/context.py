@@ -174,6 +174,11 @@ class SiddhiAppContext:
         # fold window evictions into invertible aggregator deltas where the
         # query shape allows (ops/fused_agg.py); off = always-generic path
         self.enable_fusion = True
+        # resilience subsystem attach points (siddhi_tpu/resilience/):
+        # bounded ingest replay log + app supervisor, set by
+        # SiddhiAppRuntime.enable_wal() / .supervise()
+        self.ingest_wal = None
+        self.supervisor = None
         # shared stores, filled by SiddhiAppRuntime during assembly
         self.tables = {}
         self.named_windows = {}

@@ -42,6 +42,7 @@ from siddhi_tpu.ops.expressions import (
     CompileError,
     Resolver,
 )
+from siddhi_tpu.ops.windows import conform_cols
 from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
 from siddhi_tpu.query_api.expressions import Variable
 
@@ -345,7 +346,9 @@ class JoinQueryRuntime(QueryRuntime):
                 valid = valid & (f(cols, ctx) | timer)
             cols[VALID_KEY] = valid
             new_state = dict(state)
-            new_win, wout = side.window_stage.apply(state.get(win_key), cols, ctx)
+            new_win, wout = side.window_stage.apply(
+                state.get(win_key),
+                conform_cols(side.window_stage, cols), ctx)
             if win_key in state:
                 new_state[win_key] = new_win
             wout = dict(wout)

@@ -24,6 +24,7 @@ from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver, StreamJunction
 from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
+from siddhi_tpu.ops.windows import conform_cols
 from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
 
 
@@ -330,7 +331,9 @@ class QueryRuntime(Receiver):
             notify = None
             overflow = None
             if win is not None:
-                new_state["win"], cols = win.apply(state["win"], cols, ctx)
+                new_state["win"], cols = win.apply(state["win"],
+                                                   conform_cols(win, cols),
+                                                   ctx)
                 cols = dict(cols)
                 notify = cols.pop("__notify__", None)
                 overflow = cols.pop("__overflow__", None)

@@ -64,17 +64,27 @@ class InputHandler:
         for ev in events:
             if ev.timestamp < 0:
                 ev.timestamp = tsg.current_time()
+        wal = getattr(self.app_context, "ingest_wal", None)
+        replaying = wal is not None and wal.in_replay()
         with self._barrier:  # snapshot quiesce gate (ThreadBarrier.java:30-36)
             # order check INSIDE the barrier (atomic with delivery order)
             # and BEFORE the clock advances — a rejected batch must not
-            # fire timers or expire windows as a side effect
-            if self.app_context.enforce_order and events:
+            # fire timers or expire windows as a side effect. A WAL replay
+            # bypasses the watermark: the suffix re-enters with its
+            # ORIGINAL (already-validated, arrival-ordered) timestamps,
+            # which an in-process restore's watermark has already passed.
+            if self.app_context.enforce_order and events and not replaying:
                 ts_seq = [e.timestamp for e in events]
                 if any(b < a for a, b in zip(ts_seq, ts_seq[1:])):
                     raise ValueError(
                         f"@app:enforceOrder: non-monotone timestamps inside "
                         f"a batch on stream '{self.stream_id}'")
                 self._check_order(ts_seq[0], ts_seq[-1])
+            # WAL boundary (resilience/replay.py): the batch is ACCEPTED
+            # once validation passed — record before delivery, inside the
+            # snapshot barrier so a checkpoint always cuts between batches
+            if wal is not None:
+                wal.record_events(self.stream_id, events)
             for ev in events:
                 tsg.set_current_timestamp(ev.timestamp)
             self.junction.send_events(events)
@@ -96,12 +106,15 @@ class InputHandler:
             data, self.junction.definition,
             self.app_context.string_dictionary,
             timestamps=timestamps, default_ts=now)
+        wal = getattr(self.app_context, "ingest_wal", None)
+        replaying = wal is not None and wal.in_replay()
         with self._barrier:
             if timestamps is not None:
                 ts_arr = np.asarray(timestamps, np.int64)
                 if ts_arr.size:
-                    # order check before the clock advances (see send())
-                    if self.app_context.enforce_order:
+                    # order check before the clock advances (see send();
+                    # a WAL replay bypasses the watermark)
+                    if self.app_context.enforce_order and not replaying:
                         if np.any(ts_arr[1:] < ts_arr[:-1]):
                             raise ValueError(
                                 f"@app:enforceOrder: non-monotone timestamps "
@@ -115,6 +128,15 @@ class InputHandler:
                     if lo != hi:
                         tsg.set_current_timestamp(lo)
                     tsg.set_current_timestamp(hi)
+            if wal is not None:
+                # raw columns, not the encoded HostBatch: replay re-encodes
+                # against the restored dictionary. Timestamps are recorded
+                # RESOLVED — a default-stamped batch must replay at its
+                # original ingest time, not the replay wall clock
+                wal.record_columns(
+                    self.stream_id, data,
+                    timestamps if timestamps is not None
+                    else np.full(int(batch.size), now, np.int64))
             self.junction.send_batch(batch)
 
 

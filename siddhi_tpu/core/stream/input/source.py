@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from typing import Dict, List, Optional
 
 from siddhi_tpu.core.util.transport import InMemoryBroker
@@ -134,13 +133,20 @@ class SourceRuntime:
 
     def __init__(self, source: Source, mapper: SourceMapper, input_handler,
                  app_context, retry_interval_ms: int = 100,
-                 max_retry_interval_ms: int = 5_000):
+                 max_retry_interval_ms: int = 5_000, retry_policy=None):
+        from siddhi_tpu.resilience.retry import RetryPolicy
+
         self.source = source
         self.mapper = mapper
         self.input_handler = input_handler
         self.app_context = app_context
         self.retry_interval_ms = retry_interval_ms
         self.max_retry_interval_ms = max_retry_interval_ms
+        # shared backoff policy (resilience/retry.py): unbounded, like the
+        # reference's connectWithRetry — the transport may come back hours
+        # later; shutdown() is the only way out
+        self.retry_policy = retry_policy or RetryPolicy(
+            initial_ms=retry_interval_ms, max_ms=max_retry_interval_ms)
         self._resume = threading.Event()
         self._resume.set()
         self._connected = False
@@ -171,16 +177,19 @@ class SourceRuntime:
 
     def connect_with_retry(self):
         """Reference Source.connectWithRetry:155-185: exponential backoff
-        until the transport accepts the connection."""
-        delay = self.retry_interval_ms
-        while not self._shutdown:
-            try:
-                self.source.connect()
-                self._connected = True
-                return
-            except ConnectionUnavailableException:
-                time.sleep(delay / 1000.0)
-                delay = min(delay * 2, self.max_retry_interval_ms)
+        until the transport accepts the connection, driven by the shared
+        retry policy (``resilience/retry.py``)."""
+        from siddhi_tpu.resilience import stat_count
+
+        def _connect():
+            self.source.connect()
+            self._connected = True
+
+        self.retry_policy.run(
+            _connect, (ConnectionUnavailableException,),
+            stop=lambda: self._shutdown,
+            on_retry=lambda *_: stat_count(
+                self.app_context, "resilience.source_retries"))
 
     def shutdown(self):
         self._shutdown = True

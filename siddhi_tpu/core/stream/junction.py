@@ -24,6 +24,15 @@ from siddhi_tpu.query_api.definitions import StreamDefinition
 
 log = logging.getLogger(__name__)
 
+# marker for "no batch in flight" (None is the queue's stop sentinel)
+_NOTHING = object()
+
+# worker heartbeat floor: the drain loop polls its queue with this bound,
+# so a healthy worker — even an idle one — bumps its beats counter at
+# least ~10x/sec and the supervisor can tell wedged from idle (its
+# wedge timeout is clamped to a multiple of this floor)
+_IDLE_POLL_S = 0.1
+
 
 class FatalQueryError(RuntimeError):
     """Framework-infrastructure failure (dense-capacity overflow knobs):
@@ -62,6 +71,16 @@ class StreamJunction:
         self._lat_ewma = 0.0
         self._running = False
         self._fatal: Optional[Exception] = None  # async worker's FatalQueryError
+        # resilience hooks (resilience/supervisor.py, resilience/faults.py):
+        # the in-flight batch survives a worker death for its replacement;
+        # the generation token retires late-waking stale workers; beats is
+        # the supervisor's liveness counter; fault_hook is the injection
+        # point the drain loop polls
+        self._inflight = _NOTHING
+        self._inflight_owner = None    # thread that parked _inflight
+        self._gen = 0
+        self._beats = 0
+        self.fault_hook = None
 
     def subscribe(self, receiver: Receiver):
         if receiver not in self.receivers:
@@ -98,9 +117,22 @@ class StreamJunction:
     def start_processing(self):
         self._running = True
         if self._async:
-            self._worker = threading.Thread(target=self._drain, daemon=True,
-                                            name=f"junction-{self.definition.id}")
-            self._worker.start()
+            self._start_worker()
+
+    def _start_worker(self):
+        self._gen += 1
+        self._worker = threading.Thread(
+            target=self._drain, args=(self._gen,), daemon=True,
+            name=f"junction-{self.definition.id}-g{self._gen}")
+        self._worker.start()
+
+    def restart_worker(self):
+        """Replace a dead or wedged worker (supervisor path): the queue and
+        any in-flight batch stay intact; the generation bump makes a stale
+        worker that later wakes exit without double-delivering."""
+        if not (self._async and self._running):
+            return
+        self._start_worker()
 
     def stop_processing(self):
         self._running = False
@@ -190,10 +222,51 @@ class StreamJunction:
         self._deliver(events)
         self._adapt((time.perf_counter() - t0) * 1000.0)
 
-    def _drain(self):
+    def _drain(self, gen: Optional[int] = None):
+        if gen is None:
+            gen = self._gen
         while True:
-            item = self._queue.get()
+            self._beats += 1
+            hook = self.fault_hook
+            if hook is not None:
+                # fault-injection point (resilience/faults.py): the hook
+                # may raise (simulated worker crash — the in-flight batch
+                # stays parked for the replacement) or block (wedge)
+                try:
+                    hook(self)
+                except Exception as e:  # noqa: BLE001 — injected death
+                    log.warning("junction '%s' worker killed: %s",
+                                self.definition.id, e)
+                    return
+            if gen != self._gen:
+                return     # superseded by a restart while wedged/blocked
+            if self._inflight is not _NOTHING:
+                owner = self._inflight_owner
+                if (owner is not None and owner.is_alive()
+                        and owner is not threading.current_thread()):
+                    # a superseded-but-ALIVE predecessor still owns the
+                    # unit (slow delivery, e.g. a first-batch jit
+                    # compile): adopting it would double-deliver when the
+                    # predecessor eventually completes. Wait for it to
+                    # finish or die, beating so the supervisor sees this
+                    # worker as healthy (and keeping queue order intact).
+                    time.sleep(_IDLE_POLL_S)
+                    continue
+                item = self._inflight    # predecessor died mid-delivery
+                self._inflight_owner = threading.current_thread()
+            else:
+                try:
+                    item = self._queue.get(timeout=_IDLE_POLL_S)
+                except queue.Empty:
+                    if not self._running and self._queue.empty():
+                        return   # stop raced our sentinel away
+                    continue
+                self._inflight = item
+                self._inflight_owner = threading.current_thread()
+                if gen != self._gen:
+                    return   # superseded mid-fetch: item handed over
             if item is None:
+                self._inflight = _NOTHING
                 return
             if not isinstance(item, list):
                 # columnar HostBatch: delivered as ONE pre-formed unit
@@ -203,10 +276,14 @@ class StreamJunction:
                 t0 = time.perf_counter()
                 self._deliver_batch(item)
                 self._adapt((time.perf_counter() - t0) * 1000.0)
+                self._inflight = _NOTHING
                 continue
             batch = list(item)
+            self._inflight = batch   # coalesced extras ride the same unit
             deadline = (time.perf_counter() + self._max_delay_s
                         if self._max_delay_s is not None else None)
+            stop_after = False
+            follow = None            # HostBatch that broke the coalesce
             # re-batch pending chunks up to the (adaptive) cap; a partial
             # batch waits at most max.delay for more
             while len(batch) < self._cur_batch:
@@ -217,20 +294,36 @@ class StreamJunction:
                         wait = deadline - time.perf_counter()
                         if wait <= 0:
                             break
-                        more = self._queue.get(timeout=wait)
+                        # bounded slices of the max.delay wait, beating
+                        # between them — a worker waiting out a LONG
+                        # coalesce deadline is healthy, and must not look
+                        # wedged to the supervisor
+                        more = self._queue.get(
+                            timeout=min(wait, _IDLE_POLL_S))
                 except queue.Empty:
-                    break
+                    if deadline is None or time.perf_counter() >= deadline:
+                        break
+                    self._beats += 1
+                    continue
                 if more is None:
-                    self._timed_deliver(batch)
-                    return
+                    stop_after = True
+                    break
                 if not isinstance(more, list):
-                    self._timed_deliver(batch)
-                    self._deliver_batch(more)
-                    batch = None
+                    follow = more
                     break
                 batch.extend(more)
-            if batch is not None:
-                self._timed_deliver(batch)
+            if gen != self._gen and follow is None and not stop_after:
+                return   # superseded while coalescing: the (possibly
+                #          grown) batch stays parked for the replacement
+            self._timed_deliver(batch)
+            if follow is not None:
+                self._inflight = follow
+                t0 = time.perf_counter()
+                self._deliver_batch(follow)
+                self._adapt((time.perf_counter() - t0) * 1000.0)
+            self._inflight = _NOTHING
+            if stop_after:
+                return
 
     def _deliver(self, events: List[Event]):
         for r in self.receivers:
@@ -241,6 +334,15 @@ class StreamJunction:
 
     def handle_error(self, events: List[Event], e: Exception):
         from siddhi_tpu.ops.expressions import CompileError
+
+        supervisor = getattr(self.app_context, "supervisor", None)
+        if supervisor is not None:
+            # cluster-peer failures trigger the recovery protocol
+            # (resilience/supervisor.py); other errors are ignored there
+            try:
+                supervisor.notify_error(self, e)
+            except Exception:  # noqa: BLE001 — supervision must not mask
+                log.exception("supervisor notification failed")
 
         if isinstance(e, (FatalQueryError, CompileError)):
             # framework-infrastructure failures (capacity overflow knobs)

@@ -15,9 +15,12 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional
 
+from siddhi_tpu.core.stream.input.source import ConnectionUnavailableException
 from siddhi_tpu.core.stream.junction import Receiver
 from siddhi_tpu.core.util.transport import InMemoryBroker
 from siddhi_tpu.query_api.definitions import StreamDefinition
+from siddhi_tpu.resilience import stat_count
+from siddhi_tpu.resilience.retry import RetryPolicy
 
 
 class SinkMapper:
@@ -161,11 +164,19 @@ class SinkRuntime(Receiver):
     """One @sink subscription on a stream junction."""
 
     def __init__(self, sinks: List[Sink], mapper: SinkMapper,
-                 strategy: Optional[DistributionStrategy], definition):
+                 strategy: Optional[DistributionStrategy], definition,
+                 app_context=None, retry_policy=None):
         self.sinks = sinks
         self.mapper = mapper
         self.strategy = strategy
         self.definition = definition
+        self.app_context = app_context
+        # shared backoff policy (resilience/retry.py): unlike a source
+        # reconnect, a publish retry holds the junction's delivery path —
+        # bounded attempts, then RetryExhausted rides the stream's
+        # @OnError routing like any other processing failure
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy(initial_ms=10, max_ms=1_000, max_attempts=8)
         self._connected = False
 
     def connect(self):
@@ -173,16 +184,29 @@ class SinkRuntime(Receiver):
             s.connect()
         self._connected = True
 
+    def _publish(self, sink: Sink, payload):
+        if self.retry_policy is None:
+            sink.publish(payload)
+            return
+        self.retry_policy.run(
+            lambda: sink.publish(payload),
+            (ConnectionUnavailableException,),
+            # app shutdown (or a supervisor abandoning the runtime) must
+            # not sit out the remaining backoff sleeps per pending event
+            stop=lambda: getattr(self.app_context, "stopped", False),
+            on_retry=lambda *_: stat_count(
+                self.app_context, "resilience.sink_retries"))
+
     def receive(self, events):
         for e in events:
             if e.is_expired:
                 continue
             payload = self.mapper.map(e)
             if self.strategy is None:
-                self.sinks[0].publish(payload)
+                self._publish(self.sinks[0], payload)
             else:
                 for d in self.strategy.destinations_for(e):
-                    self.sinks[d].publish(payload)
+                    self._publish(self.sinks[d], payload)
 
     def receive_batch(self, batch, junction=None):
         dictionary = (junction.app_context.string_dictionary
@@ -228,7 +252,8 @@ def create_sink_runtime(ann, stream_def: StreamDefinition, app_context,
     if dist_ann is None:
         sink = cls()
         sink.init(stream_def, opts, app_context)
-        return SinkRuntime([sink], mapper, None, stream_def)
+        return SinkRuntime([sink], mapper, None, stream_def,
+                           app_context=app_context)
 
     dist_opts = {k: v for k, v in dist_ann.elements if k is not None}
     strat_name = (dist_opts.pop("strategy", None) or "roundrobin").lower()
@@ -248,6 +273,7 @@ def create_sink_runtime(ann, stream_def: StreamDefinition, app_context,
         raise ValueError("@distribution needs at least one @destination")
     strategy = scls()
     strategy.init(len(sinks), stream_def, dist_opts)
-    return SinkRuntime(sinks, mapper, strategy, stream_def)
+    return SinkRuntime(sinks, mapper, strategy, stream_def,
+                       app_context=app_context)
 
 

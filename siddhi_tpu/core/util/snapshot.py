@@ -16,12 +16,15 @@ the reference's JDK serialization (impl-private there too, SURVEY.md §7).
 from __future__ import annotations
 
 import itertools
+import logging
 import pickle
 import time
 from typing import List, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 # v2: named-window entries became {'host','data'} wrappers, queries gained
 # 'host_window'
@@ -33,10 +36,34 @@ import numpy as np
 FORMAT_VERSION = 4
 
 
+# one jitted identity per replicated sharding: jax.jit caches by wrapped
+# function identity, so a fresh lambda per leaf per persist would pay a
+# full recompile of the allgather at every checkpoint
+_REPLICATE_JIT: dict = {}
+
+
 def _to_host(tree):
     import jax
 
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+    def pull(x):
+        if getattr(x, "is_fully_addressable", True) is False:
+            # multi-process mesh: this host cannot read the peer shards
+            # directly — replicate through one allgather so the snapshot
+            # is WHOLE on every host and any survivor can restore
+            # (requires every process to capture at the same point, the
+            # SPMD contract persist() already runs under). jit identity
+            # with a replicated out_sharding compiles to that allgather.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(x.sharding.mesh, PartitionSpec())
+            fn = _REPLICATE_JIT.get(rep)
+            if fn is None:
+                fn = jax.jit(lambda a: a, out_shardings=rep)
+                _REPLICATE_JIT[rep] = fn
+            x = fn(x)
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(pull, tree)
 
 
 def _to_device(tree):
@@ -311,23 +338,63 @@ class PersistenceManager:
 
     _seq = itertools.count()  # ms collisions must not overwrite snapshots
 
+    def _drain_async_junctions(self, timeout_s: float = 5.0) -> bool:
+        """Wait (holding the barrier) until every @Async junction's queue
+        and in-flight unit have been APPLIED. The WAL records at the
+        InputHandler boundary — BEFORE the async queue — so a cut taken
+        while batches are still queued would trim events whose effects are
+        not in the snapshot, and a restore would silently lose them. The
+        barrier stops new sends; the workers keep draining. Returns False
+        if a (wedged) worker did not drain in time."""
+        from siddhi_tpu.core.stream.junction import _NOTHING
+
+        rt = self.app_runtime
+        deadline = time.monotonic() + timeout_s
+        while True:
+            busy = [j for j in rt.junctions.values()
+                    if getattr(j, "_async", False) and j._running
+                    and (not j._queue.empty()
+                         or j._inflight is not _NOTHING)]
+            if not busy:
+                return True
+            if time.monotonic() > deadline:
+                log.warning(
+                    "persist: async junction(s) %s did not drain in %.1fs "
+                    "— the ingest WAL will not be trimmed for this "
+                    "checkpoint (replay may overlap the snapshot)",
+                    [j.definition.id for j in busy], timeout_s)
+                return False
+            time.sleep(0.001)
+
     def persist(self, incremental: bool = False) -> str:
         """Full checkpoint, or (``incremental=True``, after at least one
         full) an op-log delta chained to the previous revision (reference
         incremental SnapshotService + IncrementalPersistenceStore)."""
         rt = self.app_runtime
         store = self._store()
+        wal = getattr(rt.app_context, "ingest_wal", None)
         with rt._barrier:  # quiesce inputs (ThreadBarrier)
+            # accepted-but-queued async batches must be applied before the
+            # capture, or the WAL cut below would cover them unapplied
+            drained = self._drain_async_junctions() if wal is not None \
+                else True
             if incremental and self._last_revision is not None:
                 data = self.snapshot_service.incremental_snapshot(
                     self._last_revision)
             else:
                 data = self.snapshot_service.full_snapshot()
+            # the WAL cut marks what this snapshot covers; the trim waits
+            # for the durable save — a batch accepted after the barrier
+            # releases must survive in the log (resilience/replay.py)
+            wal_cut = wal.cut() if (wal is not None and drained) else None
         # sortable: ms prefix, then a process-monotonic counter
         revision = f"{int(time.time() * 1000):020d}_{next(self._seq):06d}_{rt.name}"
         store.save(rt.name, revision, data)
         # only after the save is durable: clear the op logs
         self.snapshot_service.mark_checkpoint()
+        if wal_cut is not None:
+            wal.trim(wal_cut)
+            wal.checkpoint_revision = revision
         self._last_revision = revision
         return revision
 
@@ -355,6 +422,25 @@ class PersistenceManager:
             # replayed state must not re-enter the next delta's op log
             self.snapshot_service.mark_checkpoint()
         self._last_revision = revision
+        # effectively-once: re-feed the post-checkpoint ingest suffix in
+        # arrival order (outside the barrier — replay sends re-enter it).
+        # The suffix FOLLOWS wal.checkpoint_revision; replaying it onto an
+        # OLDER restored revision would graft it onto a base it never
+        # followed (with the middle missing), so that case skips the
+        # replay and leaves the log intact. Revisions sort by their ms
+        # prefix; a NEWER revision (an SPMD peer's simultaneous
+        # checkpoint, cluster recovery) is a valid base for the suffix.
+        wal = getattr(rt.app_context, "ingest_wal", None)
+        if wal is not None and len(wal):
+            if (wal.checkpoint_revision is None
+                    or revision >= wal.checkpoint_revision):
+                wal.replay(rt)
+            else:
+                log.warning(
+                    "ingest-WAL replay skipped: restored revision %s "
+                    "precedes the WAL's checkpoint %s — the retained "
+                    "suffix does not follow this base",
+                    revision, wal.checkpoint_revision)
 
     def restore_last_revision(self) -> Optional[str]:
         rt = self.app_runtime

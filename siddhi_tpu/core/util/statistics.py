@@ -120,6 +120,10 @@ class StatisticsManager:
         # every batch — sampling at the report beats tracking per step)
         self.memory_probes: Dict[str, Callable[[], int]] = {}
         self.buffer_probes: Dict[str, Callable[[], int]] = {}
+        # named event counters (resilience: worker restarts, WAL replayed/
+        # dropped batches, source/sink retries, peer recoveries) — rare,
+        # operationally load-bearing events counted at every level > OFF
+        self.counters: Dict[str, int] = {}
         self._job = None
 
     # ------------------------------------------------------------ trackers
@@ -150,6 +154,11 @@ class StatisticsManager:
         the analog of monitorBufferedEvents on @Async junctions."""
         with self._lock:
             self.buffer_probes[name] = probe
+
+    def count(self, name: str, n: int = 1):
+        """Bump a named event counter (see ``counters``)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
 
     # ------------------------------------------------------------- control
 
@@ -188,6 +197,8 @@ class StatisticsManager:
                     for n, t in self.latency.items()
                 },
             }
+            if self.counters:
+                out["counters"] = dict(self.counters)
             if self.level >= DETAIL:
                 mem = {}
                 for n, probe in self.memory_probes.items():
@@ -218,3 +229,4 @@ class StatisticsManager:
                 t.reset()
             for t in self.latency.values():
                 t.reset()
+            self.counters.clear()

@@ -21,6 +21,7 @@ from siddhi_tpu.core.event import CURRENT, EXPIRED, RESET, TIMER, Event, HostBat
 from siddhi_tpu.core.plan.resolvers import SingleStreamResolver
 from siddhi_tpu.core.stream.junction import Receiver, StreamJunction
 from siddhi_tpu.ops.expressions import TYPE_KEY, VALID_KEY
+from siddhi_tpu.ops.windows import conform_cols
 from siddhi_tpu.query_api.definitions import WindowDefinition
 
 
@@ -53,7 +54,7 @@ class NamedWindowRuntime(Receiver):
 
         def step(state, cols, now):
             ctx = {"xp": jnp, "current_time": now}
-            return stage.apply(state, cols, ctx)
+            return stage.apply(state, conform_cols(stage, cols), ctx)
 
         # NOT donated: probe readers (joins, on-demand queries) hold
         # references to the state buffers between steps

@@ -108,6 +108,24 @@ class WindowStage:
     def init_state(self, num_keys: int = 1) -> dict:
         raise NotImplementedError
 
+    def conform(self, cols: Dict) -> Dict:
+        """Cast batch columns to this stage's declared ring dtypes.
+
+        Hand-built batches (sharded routers, benches, dry runs) commonly
+        carry int64 key/id columns where the ring buffer stores the
+        dictionary's int32 ids; scattering int64 values into an int32 ring
+        is a JAX FutureWarning today and an error in future releases. A
+        matching batch traces to a no-op."""
+        specs = getattr(self, "col_specs", None)
+        if not specs:
+            return cols
+        out = dict(cols)
+        for k, dt in specs.items():
+            v = out.get(k)
+            if v is not None and getattr(v, "dtype", dt) != dt:
+                out[k] = v.astype(dt)
+        return out
+
     def apply(self, state: dict, cols: Dict, ctx: Dict):
         raise NotImplementedError
 
@@ -118,6 +136,14 @@ class WindowStage:
         raise CompileError(
             f"{type(self).__name__} cannot be probed (used as a join side)"
         )
+
+
+def conform_cols(stage, cols: Dict) -> Dict:
+    """``stage.conform(cols)`` for any stage-like object: duck-typed
+    stages that slot into the window position without subclassing
+    WindowStage (``ops/fused_agg.FusedSlidingAggStage``) pass through."""
+    fn = getattr(stage, "conform", None)
+    return fn(cols) if fn is not None else cols
 
 
 class PassthroughWindowStage(WindowStage):

@@ -26,15 +26,37 @@ from siddhi_tpu.parallel.mesh import KEY_AXIS
 
 def initialize_cluster(coordinator_address: Optional[str] = None,
                        num_processes: Optional[int] = None,
-                       process_id: Optional[int] = None) -> None:
+                       process_id: Optional[int] = None,
+                       max_missing_heartbeats: Optional[int] = None) -> None:
     """Join this process into the cluster (``jax.distributed.initialize``);
-    with no arguments, cluster-environment auto-detection applies."""
+    with no arguments, cluster-environment auto-detection applies.
+
+    ``max_missing_heartbeats`` (default: jax's 10 x 10 s) bounds how long
+    the coordination service waits before declaring a silent peer dead —
+    at which point it propagates an error that TERMINATES every healthy
+    task. A supervised deployment (``resilience/supervisor.py``) that
+    wants to recover in place rather than be torn down should raise it;
+    the supervisor's own peer monitor and the bounded device pull provide
+    the (much faster) failure detection instead."""
     import jax
 
-    jax.distributed.initialize(
+    if max_missing_heartbeats is None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return
+    from jax._src import distributed as _dist
+
+    # the public wrapper does not expose the heartbeat knobs; the state
+    # object underneath it does
+    _dist.global_state.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        service_max_missing_heartbeats=max_missing_heartbeats,
+        client_max_missing_heartbeats=max_missing_heartbeats,
     )
 
 
@@ -75,6 +97,26 @@ class ClusterPeerError(RuntimeError):
     are host-side and replicated, so any surviving host can restore."""
 
 
+def local_survivor_mesh(axis_name: str = KEY_AXIS):
+    """1-D mesh over THIS process's devices only — the shape a survivor
+    rebuilds on after a peer death, when re-forming the full cluster is
+    not (yet) possible. State restored from the replicated snapshot store
+    re-shards onto it transparently (same NamedSharding specs, smaller
+    device set)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.local_devices()), (axis_name,))
+
+
+# Fault-injection slot (resilience/faults.py): when set, every
+# guarded_pull consults it BEFORE waiting — ``FaultInjector.drop_peer``
+# installs a hook that raises ClusterPeerError immediately, simulating a
+# dead peer without waiting out the pull timeout. Never set in production.
+_fault_hook = None
+
+
 def guarded_pull(value, timeout_s: float, what: str = "cluster step"):
     """``np.asarray(value)`` bounded by ``timeout_s``.
 
@@ -86,6 +128,9 @@ def guarded_pull(value, timeout_s: float, what: str = "cluster step"):
     import threading
 
     import numpy as np
+
+    if _fault_hook is not None:
+        _fault_hook(what)
 
     box = {}
     done = threading.Event()

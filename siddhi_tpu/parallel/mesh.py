@@ -42,7 +42,17 @@ def force_host_devices(n: int) -> None:
     from jax.extend.backend import clear_backends
 
     clear_backends()  # must precede the device-count update (guarded)
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # older jax: the host-device count is an XLA flag consumed at
+        # backend init — scrub any previous value, set the new one, and
+        # re-clear so the next backend lookup picks it up
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        clear_backends()
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = KEY_AXIS) -> Mesh:

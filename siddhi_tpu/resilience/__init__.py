@@ -1,0 +1,53 @@
+"""Resilience subsystem: supervised recovery, replayable ingest, faults.
+
+The reference engine survives worker and connection failure through
+periodic state persistence plus source retry/reconnect
+(``stream/input/source/Source.java:155-185``). This package is the
+TPU-native completion of that story, built around the fact that dense
+array state makes full snapshots O(state) (SURVEY.md §5.4) — so the only
+missing pieces for effectively-once recovery are a bounded host-side
+replay log and a supervisor that drives the protocol:
+
+- ``retry``:      shared exponential-backoff policy (sources, sinks,
+                  peer transport) — the ``connectWithRetry`` philosophy.
+- ``replay``:     per-stream bounded ingest WAL recorded at the
+                  ``InputHandler`` boundary, trimmed at every checkpoint,
+                  replayed after ``restore_revision``.
+- ``supervisor``: heartbeats ``@Async`` junction workers and cluster
+                  peers; restarts dead workers with their queues intact;
+                  executes the peer-death recovery protocol promised in
+                  ``parallel/distributed.py`` (tear down → re-form cluster
+                  with survivors → restore last revision → replay WAL →
+                  resume feeds).
+- ``faults``:     deterministic fault injection (kill a junction worker,
+                  drop a peer, fail the Nth sink publish, delay a device
+                  step) for the resilience test suite.
+"""
+
+from siddhi_tpu.resilience.faults import FaultInjector, WorkerKilled
+from siddhi_tpu.resilience.replay import IngestWAL
+from siddhi_tpu.resilience.retry import RetryPolicy
+from siddhi_tpu.resilience.supervisor import (
+    AppSupervisor,
+    PeerMonitor,
+    PeerRecovery,
+)
+
+__all__ = [
+    "AppSupervisor",
+    "FaultInjector",
+    "IngestWAL",
+    "PeerMonitor",
+    "PeerRecovery",
+    "RetryPolicy",
+    "WorkerKilled",
+]
+
+
+def stat_count(app_context, name: str, n: int = 1) -> None:
+    """Bump a recovery counter on the app's StatisticsManager (no-op when
+    statistics are not configured). Resilience events are rare and
+    operationally load-bearing, so they count at every level above OFF."""
+    sm = getattr(app_context, "statistics_manager", None)
+    if sm is not None and getattr(sm, "level", 0) > 0:
+        sm.count(name, n)

@@ -1,0 +1,142 @@
+"""Deterministic fault injection for the resilience test suite.
+
+Every injection is an explicit hook at a point the production code already
+owns — no monkeypatching of framework internals from tests:
+
+- junction workers poll an optional ``fault_hook`` at the top of each
+  drain iteration (``core/stream/junction.py``): a hook can raise
+  (simulated worker crash) or block (simulated wedge);
+- ``parallel/distributed.guarded_pull`` consults a module-level fault
+  slot before waiting (simulated peer death);
+- sink publishes go through the Sink SPI object, which the injector
+  wraps to fail the first N calls with the transport's own
+  ``ConnectionUnavailableException``.
+
+All injections are one-shot or counted, so tests are deterministic; the
+injector restores everything it touched on ``clear()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class WorkerKilled(Exception):
+    """Raised inside a junction worker by ``kill_worker`` — simulates the
+    worker thread dying mid-drain (the junction treats ANY exception out
+    of the fault hook as a worker death)."""
+
+
+class FaultInjector:
+    def __init__(self):
+        self._wedge_release = threading.Event()
+        self._wedged = threading.Event()
+        self._patched_sinks = []          # (sink, original_publish)
+        self._peer_fault_armed = False
+
+    # ------------------------------------------------- junction workers
+
+    def kill_worker(self, junction) -> None:
+        """Arm a one-shot crash: the next drain iteration raises
+        ``WorkerKilled`` and the worker thread exits. Any in-flight batch
+        stays parked on the junction for the replacement worker."""
+        def hook(j):
+            j.fault_hook = None
+            raise WorkerKilled(f"injected kill on junction "
+                               f"'{j.definition.id}'")
+
+        junction.fault_hook = hook
+
+    def wedge_worker(self, junction) -> None:
+        """Arm a one-shot wedge: the next drain iteration blocks until
+        ``release()``. The thread stays alive but stops heartbeating —
+        exactly the failure the supervisor's beat-stall detector targets.
+        A released stale worker exits on its generation check without
+        touching the queue."""
+        self._wedge_release.clear()
+        self._wedged.clear()
+
+        def hook(j):
+            j.fault_hook = None
+            self._wedged.set()
+            self._wedge_release.wait()
+
+        junction.fault_hook = hook
+
+    def wait_wedged(self, timeout: float = 10.0) -> bool:
+        """Block until a wedged worker actually entered the wedge."""
+        return self._wedged.wait(timeout)
+
+    def release(self) -> None:
+        """Wake every worker currently blocked in a wedge hook."""
+        self._wedge_release.set()
+
+    def delay_worker(self, junction, seconds: float) -> None:
+        """Arm a one-shot delivery delay (a slow device step seen from the
+        junction's side): the next drain iteration sleeps ``seconds``."""
+        import time
+
+        def hook(j):
+            j.fault_hook = None
+            time.sleep(seconds)
+
+        junction.fault_hook = hook
+
+    # ------------------------------------------------------ cluster peers
+
+    def drop_peer(self, what: str = "injected peer death") -> None:
+        """Make every subsequent ``guarded_pull`` raise ``ClusterPeerError``
+        immediately — a peer process presumed dead without waiting out the
+        pull timeout. Cleared by ``restore_peer()``/``clear()``."""
+        from siddhi_tpu.parallel import distributed
+
+        def hook(label):
+            raise distributed.ClusterPeerError(
+                f"{label}: {what} — restart the cluster and restore from "
+                f"the last snapshot revision")
+
+        distributed._fault_hook = hook
+        self._peer_fault_armed = True
+
+    def restore_peer(self) -> None:
+        from siddhi_tpu.parallel import distributed
+
+        distributed._fault_hook = None
+        self._peer_fault_armed = False
+
+    # -------------------------------------------------------------- sinks
+
+    def fail_publishes(self, sink, n: int = 1,
+                       exception: Optional[Exception] = None) -> None:
+        """Fail the next ``n`` ``publish`` calls on this Sink with
+        ``ConnectionUnavailableException`` (or the given exception), then
+        pass through — the shape of a transport blip the retry policy must
+        absorb."""
+        from siddhi_tpu.core.stream.input.source import (
+            ConnectionUnavailableException,
+        )
+
+        original = sink.publish
+        box = {"left": int(n)}
+
+        def publish(payload):
+            if box["left"] > 0:
+                box["left"] -= 1
+                raise (exception if exception is not None
+                       else ConnectionUnavailableException(
+                           "injected publish failure"))
+            return original(payload)
+
+        sink.publish = publish
+        self._patched_sinks.append((sink, original))
+
+    # ------------------------------------------------------------ cleanup
+
+    def clear(self) -> None:
+        self.release()
+        if self._peer_fault_armed:
+            self.restore_peer()
+        for sink, original in self._patched_sinks:
+            sink.publish = original
+        self._patched_sinks.clear()

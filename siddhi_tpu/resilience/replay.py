@@ -1,0 +1,203 @@
+"""Ingest WAL: a bounded, per-process replay log for effectively-once
+recovery.
+
+Full snapshots are already O(state) for dense-array runtimes (SURVEY.md
+§5.4), so the only thing a checkpoint loses is the ingest SUFFIX — every
+batch accepted after the last barrier. The WAL records that suffix at the
+``InputHandler``/``StreamJunction`` boundary (inside the snapshot quiesce
+barrier, so a checkpoint always cuts at a batch boundary), is trimmed at
+every durable checkpoint, and is replayed in arrival order after
+``restore_revision``. Region-based-state streaming (PAPERS.md) makes this
+the cheap half of recovery: state restore is one pytree copy, replay is a
+re-send of host-side columnar batches.
+
+Bounds and overflow: the log is bounded by ``max_batches`` (and optionally
+``max_events``). On overflow the OLDEST record is dropped and
+``dropped_batches`` is bumped — recovery from the previous checkpoint then
+has a hole, which the counter (and the ``resilience.wal_dropped_batches``
+statistic) makes visible. Operators should checkpoint at least as often
+as the WAL can hold; the bound trades recovery completeness for a hard
+memory ceiling, never blocking ingest.
+
+Trim protocol: appends and checkpoint cuts both happen under the app's
+ingestion barrier, but the durable save happens OUTSIDE it (persist()
+releases the barrier before writing the store). ``cut()`` under the
+barrier marks the sequence number the snapshot covers; ``trim(cut)``
+after the save removes exactly the covered prefix — a batch accepted
+between capture and save survives in the log.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional
+
+from siddhi_tpu.core.event import Event
+
+
+class _Record:
+    __slots__ = ("seq", "stream_id", "kind", "payload", "timestamps", "size")
+
+    def __init__(self, seq, stream_id, kind, payload, timestamps, size):
+        self.seq = seq
+        self.stream_id = stream_id
+        self.kind = kind              # 'events' | 'columns'
+        self.payload = payload
+        self.timestamps = timestamps
+        self.size = size
+
+
+def _copy_columns(data):
+    """Defensive copy: producers reuse/mutate their column buffers."""
+    import numpy as np
+
+    out = {}
+    for k, v in data.items():
+        if hasattr(v, "dtype"):
+            out[k] = np.array(v, copy=True)
+        else:
+            out[k] = list(v)
+    return out
+
+
+class IngestWAL:
+    """Per-process bounded ingest log (see module docstring)."""
+
+    def __init__(self, max_batches: int = 4096,
+                 max_events: Optional[int] = None,
+                 app_context=None):
+        if max_batches <= 0:
+            raise ValueError("IngestWAL needs max_batches > 0")
+        self.max_batches = int(max_batches)
+        self.max_events = max_events
+        self.app_context = app_context    # statistics hookup (optional)
+        self._log: deque = deque()
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._events = 0                  # events currently held
+        self.dropped_batches = 0          # overflow evictions (lossy!)
+        self.replayed_batches = 0
+        self.recorded_batches = 0
+        # revision whose snapshot the retained suffix FOLLOWS (set by the
+        # checkpoint trim); restore_revision consults it so a restore of
+        # an OLDER revision does not graft the suffix onto a stale base
+        self.checkpoint_revision: Optional[str] = None
+        # re-record suppression is scoped to the REPLAYING THREAD only:
+        # live ingest accepted concurrently on other threads must still
+        # be recorded, or the next failure silently loses it
+        self._replay_thread: Optional[int] = None
+
+    def in_replay(self) -> bool:
+        """True on the thread currently executing ``replay()`` — consulted
+        by the record paths (suppress re-recording) and by the
+        InputHandler's @app:enforceOrder watermark (a replayed suffix
+        re-enters with its ORIGINAL timestamps, behind the watermark)."""
+        return self._replay_thread == threading.get_ident()
+
+    # ------------------------------------------------------------- record
+
+    def record_events(self, stream_id: str, events: List[Event]) -> None:
+        if self.in_replay() or not events:
+            return
+        copies = [Event(timestamp=e.timestamp, data=list(e.data))
+                  for e in events]
+        self._append(_Record(None, stream_id, "events", copies, None,
+                             len(copies)))
+
+    def record_columns(self, stream_id: str, data, timestamps=None) -> None:
+        if self.in_replay():
+            return
+        import numpy as np
+
+        n = 0
+        for v in data.values():
+            n = len(v)
+            break
+        ts = np.array(timestamps, np.int64) if timestamps is not None else None
+        self._append(_Record(None, stream_id, "columns",
+                             _copy_columns(data), ts, n))
+
+    def _append(self, rec: _Record) -> None:
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            self._log.append(rec)
+            self._events += rec.size
+            self.recorded_batches += 1
+            while (len(self._log) > self.max_batches
+                   or (self.max_events is not None
+                       and self._events > self.max_events
+                       and len(self._log) > 1)):
+                old = self._log.popleft()
+                self._events -= old.size
+                self.dropped_batches += 1
+                self._count("resilience.wal_dropped_batches")
+
+    # ------------------------------------------------- checkpoint protocol
+
+    def cut(self) -> int:
+        """Sequence mark of everything a snapshot captured — call while
+        holding the app barrier, alongside the state capture."""
+        with self._lock:
+            return self._seq
+
+    def trim(self, upto_seq: int) -> int:
+        """Drop records covered by a durably-saved checkpoint; returns how
+        many were trimmed."""
+        n = 0
+        with self._lock:
+            while self._log and self._log[0].seq <= upto_seq:
+                rec = self._log.popleft()
+                self._events -= rec.size
+                n += 1
+        return n
+
+    def mark_checkpoint(self, revision: Optional[str] = None) -> int:
+        """Unconditional trim of the whole log (checkpoint under a held
+        barrier, or restore completing — the restored state supersedes);
+        records ``revision`` as the base the (now empty) suffix follows."""
+        n = self.trim(self.cut())
+        if revision is not None:
+            self.checkpoint_revision = revision
+        return n
+
+    # -------------------------------------------------------------- replay
+
+    def __len__(self) -> int:
+        return len(self._log)
+
+    @property
+    def pending_events(self) -> int:
+        return self._events
+
+    def replay(self, app_runtime) -> int:
+        """Re-send the retained suffix in arrival order through the given
+        runtime's input handlers. Returns the number of replayed batches.
+        The records stay in the log (they are still the post-checkpoint
+        suffix of the restored state, and must survive a second failure);
+        re-recording is suppressed only for THIS wal — a different wal on
+        the target runtime correctly records the replay as fresh ingest."""
+        with self._lock:
+            records = list(self._log)
+        self._replay_thread = threading.get_ident()
+        try:
+            for rec in records:
+                h = app_runtime.get_input_handler(rec.stream_id)
+                if rec.kind == "events":
+                    h.send([Event(timestamp=e.timestamp, data=list(e.data))
+                            for e in rec.payload])
+                else:
+                    h.send_columns(_copy_columns(rec.payload),
+                                   timestamps=rec.timestamps)
+                self.replayed_batches += 1
+                self._count("resilience.wal_replayed_batches")
+        finally:
+            self._replay_thread = None
+        return len(records)
+
+    def _count(self, name: str) -> None:
+        from siddhi_tpu.resilience import stat_count
+
+        if self.app_context is not None:
+            stat_count(self.app_context, name)

@@ -1,0 +1,338 @@
+"""App-level supervision: worker heartbeats and the peer-death protocol.
+
+The reference keeps its engine alive with per-transport retry loops
+(``Source.java:155-185``) and leaves worker threads to the Disruptor; our
+``@Async`` junctions run plain host threads, and the multi-process mesh
+adds a failure mode the reference never had — a peer dying mid-collective
+wedges every other host inside XLA (``parallel/distributed.py``). The
+supervisor owns both:
+
+- **Worker heartbeats.** Every async junction worker bumps a beats
+  counter each drain iteration and polls its queue with a bounded wait,
+  so a healthy worker beats at least ~2 Hz even when idle. A worker whose
+  thread died is restarted immediately; a worker whose beats stalled past
+  ``wedge_timeout_s`` is presumed wedged and REPLACED — the queue and any
+  in-flight batch stay on the junction, and the junction's worker
+  generation token makes a later-waking stale worker exit without
+  double-delivering (``core/stream/junction.py``).
+
+- **Peer recovery.** ``StreamJunction.handle_error`` notifies the
+  supervisor of every processing error; on ``ClusterPeerError`` the
+  supervisor runs the protocol ``distributed.py`` promises, exactly once:
+  abandon the wedged runtime (collectives are not cancellable — the stuck
+  waits stay parked in daemon threads), rebuild on the surviving hosts
+  (caller-supplied: a fresh runtime over ``local_survivor_mesh()`` or a
+  re-formed ``jax.distributed`` incarnation), ``restore_last_revision()``
+  from the replicated snapshot store, replay the ingest WAL suffix, and
+  resume feeds.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class PeerMonitor:
+    """Socket liveness heartbeats between cluster processes.
+
+    A peer dying mid-collective is detected by the bounded device pull
+    (``distributed.guarded_pull``) — but only when a collective is in
+    flight. The monitor closes that gap: every process binds a tiny TCP
+    listener, every supervisor probes its peers' listeners each tick, and
+    a peer that was reachable once and then refuses ``misses`` consecutive
+    probes is declared dead (an abruptly killed process's listener drops
+    instantly, so detection is ~``misses`` ticks — typically faster than a
+    pull timeout). The supervisor folds confirmed deaths into the same
+    ``ClusterPeerError`` recovery path as a blocked pull."""
+
+    def __init__(self, listen_port: int = 0, probe_timeout_s: float = 1.0,
+                 misses: int = 3):
+        import socket
+
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.misses = int(misses)
+        self._peers = {}          # addr -> {"seen": bool, "missed": int}
+        self._dead = set()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", listen_port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._accepting = True
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"peer-monitor-:{self.port}")
+        t.start()
+
+    def _accept_loop(self) -> None:
+        while self._accepting:
+            try:
+                conn, _addr = self._sock.accept()
+                conn.close()          # the successful connect IS the beat
+            except OSError:
+                return
+
+    def watch(self, host: str, port: int) -> None:
+        self._peers[(host, int(port))] = {"seen": False, "missed": 0}
+
+    def poll_dead(self) -> list:
+        """Probe every watched peer once; returns NEWLY dead addresses."""
+        import socket
+
+        newly = []
+        for addr, st in self._peers.items():
+            if addr in self._dead:
+                continue
+            try:
+                s = socket.create_connection(addr, self.probe_timeout_s)
+                s.close()
+                st["seen"] = True
+                st["missed"] = 0
+            except OSError:
+                if st["seen"]:        # never-reached peers are "not up yet"
+                    st["missed"] += 1
+                    if st["missed"] >= self.misses:
+                        self._dead.add(addr)
+                        newly.append(addr)
+        return newly
+
+    def close(self) -> None:
+        self._accepting = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def is_peer_failure(error: Exception) -> bool:
+    """ClusterPeerError is the guarded-pull timeout; a dead peer's
+    transport can also surface FASTER as a raw runtime error from inside
+    the collective ("Connection closed by peer" / "connection reset by
+    peer" — gloo noticing the closed socket before the bounded wait
+    expires). Both mean the same thing for supervision. The substring
+    match is scoped to jax/jaxlib exception types: an app-level socket
+    error (a flaky SINK client also says "reset by peer", errno 104) must
+    not tear down a healthy runtime."""
+    from siddhi_tpu.parallel.distributed import ClusterPeerError
+
+    if isinstance(error, ClusterPeerError):
+        return True
+    mod = getattr(type(error), "__module__", "") or ""
+    if not mod.startswith(("jax", "xla")):
+        return False
+    msg = str(error).lower()
+    return "closed by peer" in msg or "reset by peer" in msg
+
+
+def abandon_runtime(app_runtime) -> None:
+    """Best-effort, non-blocking teardown of a runtime presumed wedged on
+    a dead peer: no deferred flushes (they would block on the same dead
+    collective), no worker joins. Stops ingest, sources, timers."""
+    app_runtime.app_context.stopped = True
+    try:
+        app_runtime.app_context.timestamp_generator.stop_heartbeat()
+    except Exception:
+        pass
+    for sr in getattr(app_runtime, "source_runtimes", []):
+        try:
+            sr.shutdown()
+        except Exception:
+            pass
+    for tr in getattr(app_runtime, "trigger_runtimes", []):
+        try:
+            tr.stop()
+        except Exception:
+            pass
+    for j in app_runtime.junctions.values():
+        j._running = False
+        j._gen += 1          # any parked worker exits on its next wake
+    sched = app_runtime.app_context.scheduler
+    if sched is not None:
+        try:
+            sched.shutdown()
+        except Exception:
+            pass
+
+
+class PeerRecovery:
+    """One execution of the peer-death recovery protocol.
+
+    ``rebuild()`` must return a FRESH ``SiddhiAppRuntime`` for the same
+    app, already wired to the replicated persistence store and with its
+    callbacks re-attached — on the survivor's own devices
+    (``distributed.local_survivor_mesh()``) or a re-formed cluster. The
+    old runtime is abandoned, the last revision restored, the WAL suffix
+    replayed, and sources resumed.
+    """
+
+    def __init__(self, rebuild: Callable[[], object],
+                 wal=None,
+                 on_recovered: Optional[Callable[[object, Optional[str]],
+                                                 None]] = None):
+        self.rebuild = rebuild
+        self.wal = wal
+        self.on_recovered = on_recovered
+
+    def recover(self, old_runtime=None):
+        """Returns ``(new_runtime, restored_revision)``."""
+        from siddhi_tpu.resilience import stat_count
+
+        if old_runtime is not None:
+            abandon_runtime(old_runtime)
+        new_rt = self.rebuild()
+        if self.wal is not None and getattr(
+                new_rt.app_context, "ingest_wal", None) is None:
+            # the survivor's log must also guard the NEW incarnation
+            new_rt.app_context.ingest_wal = self.wal
+        revision = new_rt.restore_last_revision()
+        # restore_last_revision replays the wal attached to new_rt; replay
+        # explicitly only when ours is a different object (or nothing was
+        # restored — a WAL-only recovery still re-feeds the suffix)
+        if self.wal is not None and (
+                revision is None
+                or getattr(new_rt.app_context, "ingest_wal", None)
+                is not self.wal):
+            self.wal.replay(new_rt)
+        for sr in getattr(new_rt, "source_runtimes", []):
+            sr.resume()
+        stat_count(new_rt.app_context, "resilience.peer_recoveries")
+        if self.on_recovered is not None:
+            self.on_recovered(new_rt, revision)
+        return new_rt, revision
+
+
+class AppSupervisor:
+    """Heartbeats one app's async junction workers and drives peer
+    recovery. ``SiddhiAppRuntime.supervise()`` is the usual entry."""
+
+    def __init__(self, app_runtime, interval_s: float = 0.25,
+                 wedge_timeout_s: float = 5.0,
+                 peer_recovery: Optional[PeerRecovery] = None,
+                 peer_monitor: Optional[PeerMonitor] = None):
+        from siddhi_tpu.core.stream.junction import _IDLE_POLL_S
+
+        self.app_runtime = app_runtime
+        self.interval_s = float(interval_s)
+        # below 3 idle-poll periods an IDLE worker (which only beats when
+        # its bounded queue wait times out) would look wedged
+        self.wedge_timeout_s = max(float(wedge_timeout_s),
+                                   3.0 * _IDLE_POLL_S)
+        self.peer_monitor = peer_monitor
+        self.peer_recovery = peer_recovery
+        self.worker_restarts = 0
+        self.recovery_result = None       # (new_runtime, revision)
+        self._beat_seen = {}              # junction id -> (beats, t_changed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._recovering = threading.Event()
+        self._recovered = threading.Event()
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "AppSupervisor":
+        if self._thread is not None:
+            return self
+        self.app_runtime.app_context.supervisor = self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"supervisor-{self.app_runtime.name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+        if self.peer_monitor is not None:
+            self.peer_monitor.close()
+        if getattr(self.app_runtime.app_context, "supervisor", None) is self:
+            self.app_runtime.app_context.supervisor = None
+
+    # --------------------------------------------------------- heartbeats
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._tick()
+            except Exception:                     # noqa: BLE001
+                log.exception("supervisor tick failed")
+
+    def _tick(self) -> None:
+        from siddhi_tpu.resilience import stat_count
+
+        if self.peer_monitor is not None:
+            from siddhi_tpu.parallel.distributed import ClusterPeerError
+
+            for addr in self.peer_monitor.poll_dead():
+                self.notify_error(None, ClusterPeerError(
+                    f"cluster peer {addr[0]}:{addr[1]} lost its heartbeat "
+                    f"— presumed dead; restore from the last snapshot "
+                    f"revision"))
+        now = time.monotonic()
+        for sid, j in list(self.app_runtime.junctions.items()):
+            if not (getattr(j, "_async", False) and j._running):
+                continue
+            worker = j._worker
+            beats = j._beats
+            seen = self._beat_seen.get(sid)
+            if seen is None or seen[0] != beats:
+                self._beat_seen[sid] = (beats, now)
+                stalled = False
+            else:
+                stalled = (now - seen[1]) > self.wedge_timeout_s
+            dead = worker is None or not worker.is_alive()
+            if j._fatal is not None:
+                continue    # framework failure: surfaced to senders, not
+                #             a restartable worker fault
+            if dead or stalled:
+                log.warning("supervisor: restarting %s worker of "
+                            "junction '%s'",
+                            "dead" if dead else "wedged", sid)
+                j.restart_worker()
+                self.worker_restarts += 1
+                self._beat_seen[sid] = (j._beats, now)
+                stat_count(self.app_runtime.app_context,
+                           "resilience.worker_restarts")
+
+    # ------------------------------------------------------ peer recovery
+
+    def notify_error(self, junction, error: Exception) -> None:
+        """Called by ``StreamJunction.handle_error`` for every processing
+        error; reacts (once) to cluster-peer failures."""
+        from siddhi_tpu.resilience import stat_count
+
+        if not is_peer_failure(error):
+            return
+        stat_count(self.app_runtime.app_context,
+                   "resilience.peer_failures")
+        if self.peer_recovery is None:
+            return
+        with self._lock:
+            if self._recovering.is_set():
+                return
+            self._recovering.set()
+        threading.Thread(target=self._recover, daemon=True,
+                         name=f"peer-recovery-{self.app_runtime.name}"
+                         ).start()
+
+    def _recover(self) -> None:
+        try:
+            self.recovery_result = self.peer_recovery.recover(
+                old_runtime=self.app_runtime)
+        except Exception:                         # noqa: BLE001
+            log.exception("peer recovery failed")
+        finally:
+            self._recovered.set()
+
+    def wait_recovered(self, timeout: Optional[float] = None):
+        """Block until a triggered peer recovery finished; returns the
+        ``(new_runtime, revision)`` result, or None."""
+        self._recovered.wait(timeout)
+        return self.recovery_result

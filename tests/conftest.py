@@ -7,9 +7,15 @@ first import, hence here at conftest import time.
 
 import os
 
-# Persistent jit cache: the suite compiles many small step functions; cache
-# them across runs.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+# Persistent jit cache: DISABLED. On this sandbox (gVisor) the on-disk
+# cache poisons itself — reads of previously written entries segfault the
+# process mid-compile and can return WRONG computation results (repro:
+# tests/test_absent_corpus.py q16 flipped pass/fail/segfault with the
+# cache on, 5/5 clean with it off). In-process jit caching is unaffected,
+# and tier-1 is one process, so the persistent layer only ever saved
+# cross-run startup time. Override the empty value to re-enable at your
+# own risk.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
@@ -19,3 +25,35 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 from siddhi_tpu.parallel.mesh import force_host_devices  # noqa: E402
 
 force_host_devices(8)
+
+# Automatic GC during jax tracing segfaults this jaxlib build
+# (deterministic repro with the persistent cache off: faulthandler shows
+# "Garbage-collecting" inside a live trace). Collecting between tests is
+# NOT safe either — finalizers on collected jaxlib objects abort the
+# interpreter — so cycles leak for the session; the suite fits comfortably
+# in memory.
+import gc  # noqa: E402
+
+gc.disable()
+
+_exit_status = {"code": None}
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _exit_status["code"] = int(exitstatus)
+
+
+import atexit  # noqa: E402
+import sys  # noqa: E402
+
+
+@atexit.register
+def _skip_interpreter_teardown():
+    # Interpreter shutdown finalizes jaxlib objects out of dependency
+    # order and segfaults AFTER the suite already finished, turning a
+    # green run into rc=139. Once pytest has produced its verdict, skip
+    # teardown and exit with the real status.
+    if _exit_status["code"] is not None:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(_exit_status["code"])

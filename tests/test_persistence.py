@@ -281,3 +281,122 @@ def test_incremental_second_generation_restore_no_duplicates():
     rows = sorted(tuple(e.data) for e in rt3.tables["T"].all_events())
     m3.shutdown()
     assert rows == [("A", 1.0), ("B", 2.0), ("C", 3.0)]
+
+
+# --------------------------------------------- revision edge cases (ISSUE 1)
+# Reference `managment` corpus behaviors around bad/absent revisions:
+# PersistenceTestCase restores of unknown ids, clearAllRevisions mid-run,
+# and incremental chains with no base. Triage: COVERAGE.md E-M6 row.
+
+
+def test_restore_missing_revision_raises_keyerror():
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.get_input_handler("S").send(["A", 1.0])
+    import pytest
+
+    with pytest.raises(KeyError, match="no-such-revision"):
+        rt.restore_revision("no-such-revision")
+    # the failed restore must not poison live state
+    rt.get_input_handler("S").send(["A", 2.0])
+    m.shutdown()
+
+
+def test_restore_corrupt_revision_surfaces_error_and_keeps_state():
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(APP)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    rev = rt.persist()
+    # a torn write / bad disk: the stored bytes are not a snapshot
+    store.save(rt.name, rev + "_corrupt", b"\x00garbage")
+    import pytest
+
+    with pytest.raises(Exception):
+        rt.restore_revision(rev + "_corrupt")
+
+    class C(StreamCallback):
+        def __init__(self):
+            super().__init__()
+            self.events = []
+
+        def receive(self, events):
+            self.events.extend(events)
+
+    c = C()
+    rt.add_callback("OutStream", c)
+    h.send(["A", 2.0])      # window still holds 1.0 -> sum 3.0
+    assert c.events[-1].data[1] == 3.0
+    m.shutdown()
+
+
+def test_clear_all_revisions_mid_run_resets_the_chain():
+    """clearAllRevisions between persists: the last-revision pointer must
+    not dangle — restore_last_revision returns None, and the next
+    persist_incremental falls back to a FULL snapshot rather than chaining
+    to a wiped base."""
+    import pickle
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(APP)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    rt.persist()
+    h.send(["A", 2.0])
+    rt.persist_incremental()
+    rt.clear_all_revisions()
+    assert store.revisions(rt.name) == []
+    assert rt.restore_last_revision() is None
+    h.send(["A", 4.0])
+    rev = rt.persist_incremental()      # no base left: must be full
+    obj = pickle.loads(store.load(rt.name, rev))
+    assert not obj.get("incremental"), "incremental chained to a wiped base"
+    m.shutdown()
+
+    # ...and the fallback snapshot restores standalone
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(APP)
+    assert rt2.restore_last_revision() == rev
+    rows = rt2.query("from T select symbol, price")
+    assert sorted(e.data[1] for e in rows) == [1.0, 2.0, 4.0]
+    m2.shutdown()
+
+
+def test_incremental_on_empty_base_is_a_full_snapshot():
+    import pickle
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.get_input_handler("S").send(["A", 1.0])
+    rev = rt.persist_incremental()      # first persist ever: no base
+    obj = pickle.loads(store.load(rt.name, rev))
+    assert not obj.get("incremental")
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(APP)
+    assert rt2.restore_last_revision() == rev
+    rows = rt2.query("from T select symbol, price")
+    assert [e.data[1] for e in rows] == [1.0]
+    m2.shutdown()

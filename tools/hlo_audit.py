@@ -19,7 +19,11 @@ Run: ``python tools/hlo_audit.py`` (prints one JSON line).
 from __future__ import annotations
 
 import json
+import os
 import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

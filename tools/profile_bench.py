@@ -7,7 +7,11 @@ quantify the x64-emulation tax on TPU v5e. Informs PERF.md.
 
 from __future__ import annotations
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
