@@ -19,12 +19,47 @@ Public API surface mirrors the reference's (``SiddhiManager``
 # bytes — 33x slower at the bench shape); region analysis proves it.
 # CPU-only flag, inert on TPU. Must be set before backend init.
 import os as _os
+import sys as _sys
+
+
+def _jax_backend_initialized() -> bool:
+    """True when the embedding application already initialized a JAX
+    backend before importing siddhi_tpu — XLA_FLAGS set below are then
+    inert (XLA parsed them at backend init)."""
+    xb = getattr(_sys.modules.get("jax._src.xla_bridge"), "__dict__", None)
+    if xb is None:
+        return False
+    try:
+        fn = xb.get("backends_are_initialized")
+        if fn is not None:
+            return bool(fn())
+    except Exception:  # pragma: no cover — version-dependent introspection
+        pass
+    return bool(xb.get("_backends"))
+
 
 _FLAG = "--xla_cpu_copy_insertion_use_region_analysis"
 if _FLAG not in _os.environ.get("XLA_FLAGS", ""):
     # name-only check: an explicit user setting (either value) wins
     _os.environ["XLA_FLAGS"] = (
         _os.environ.get("XLA_FLAGS", "") + " " + _FLAG + "=true").strip()
+    if _jax_backend_initialized():
+        # the mutation came too late: the CPU backend already parsed its
+        # flags, so the ring-swap fix (two full-buffer copies per window
+        # column per step, 33x at the bench shape — see the comment
+        # above) is silently OFF. Warn once so the regression cannot be
+        # reintroduced unnoticed; see README "Observability" for the fix
+        # (import siddhi_tpu before any jax computation, or set the flag
+        # in the environment).
+        import warnings as _warnings
+
+        _warnings.warn(
+            "siddhi_tpu: a JAX backend was initialized before importing "
+            f"siddhi_tpu, so '{_FLAG}=true' cannot take effect — the "
+            "XLA:CPU window/NFA ring-swap path will run up to 33x slower. "
+            "Import siddhi_tpu before running any jax computation, or set "
+            f"XLA_FLAGS={_FLAG}=true in the environment.",
+            RuntimeWarning, stacklevel=2)
 
 # Millisecond epoch timestamps need int64; enable x64 before any jax use.
 import jax

@@ -501,8 +501,11 @@ class SiddhiAppRuntime:
                 f"'{query.input_stream.stream_id}' is a table — consume it via a "
                 f"join or an on-demand query (runtime.query(...))"
             )
-        runtime = plan_query(query, query_name, self.app_context, definitions,
-                             partition_ctx=partition_ctx)
+        from siddhi_tpu.observability.tracing import span
+
+        with span("plan", query=query_name):
+            runtime = plan_query(query, query_name, self.app_context,
+                                 definitions, partition_ctx=partition_ctx)
 
         from siddhi_tpu.core.query.output_callbacks import create_table_callback
         from siddhi_tpu.query_api.execution import (
@@ -922,12 +925,16 @@ class SiddhiAppRuntime:
         it; ``restore_revision`` replays the retained suffix, turning
         checkpoint recovery from at-most-once into effectively-once.
         Idempotent; returns the WAL."""
-        from siddhi_tpu.resilience.replay import IngestWAL
+        from siddhi_tpu.resilience.replay import IngestWAL, register_wal_gauges
 
         if self.app_context.ingest_wal is None:
             self.app_context.ingest_wal = IngestWAL(
                 max_batches=max_batches, max_events=max_events,
                 app_context=self.app_context)
+        # scrapeable WAL size/loss gauges (GET /metrics): a log that
+        # keeps dropping batches means checkpoints are too far apart for
+        # the configured bound
+        register_wal_gauges(self.app_context)
         return self.app_context.ingest_wal
 
     def supervise(self, interval_s: float = 0.25,

@@ -144,6 +144,14 @@ class SiddhiAppContext:
         self.snapshot_service = None
         self.scheduler = None
         self.statistics_manager = None
+        # always-on telemetry registry (observability/telemetry.py):
+        # gauges (@Async queue depth, WAL size), backpressure counters,
+        # jit-compile events — scraped via GET /metrics; kept separate
+        # from statistics_manager, which only exists under
+        # @app:statistics and gates by level
+        from siddhi_tpu.observability.telemetry import TelemetryRegistry
+
+        self.telemetry = TelemetryRegistry()
         self.playback = False
         self.enforce_order = False
         self.root_metrics_level = "OFF"

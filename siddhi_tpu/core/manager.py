@@ -52,11 +52,15 @@ class SiddhiManager:
         self.app_runtimes: Dict[str, SiddhiAppRuntime] = {}
 
     def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        from siddhi_tpu.observability.tracing import span
+
         if isinstance(app, str):
-            app = SiddhiCompiler.parse(SiddhiCompiler.update_variables(app))
+            with span("compile", chars=len(app)):
+                app = SiddhiCompiler.parse(SiddhiCompiler.update_variables(app))
         # Not auto-started: callers attach callbacks first, then start()
         # (reference flow); InputManager starts lazily on first handler use.
-        runtime = SiddhiAppRuntime(app, self.siddhi_context)
+        with span("assemble", app=app.name or ""):
+            runtime = SiddhiAppRuntime(app, self.siddhi_context)
         self.app_runtimes[runtime.name] = runtime
         return runtime
 

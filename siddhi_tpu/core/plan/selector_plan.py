@@ -184,12 +184,6 @@ class SelectorPlan:
     # drives snapshot-limiter variant selection
     # (WrappedSnapshotOutputRateLimiter.java:67-74)
     agg_positions: List[int] = field(default_factory=list)
-    # ON-DEMAND quirk: the reference's store-query runtime applies LIMIT
-    # to the un-sorted chunk and only then sorts
-    # (OnDemandQueryTableTestCase.java test9: order by price limit 2 over
-    # {55.6, 75.6, 57.6} returns {55.6, 75.6}); streaming queries sort
-    # first (OrderByLimitTestCase)
-    limit_before_order: bool = False
 
     @property
     def contains_aggregator(self) -> bool:
@@ -270,10 +264,6 @@ class SelectorPlan:
             return v & keep
 
         has_limit = self.limit is not None or self.offset is not None
-        if self.limit_before_order and has_limit:
-            valid = _apply_limit(valid)
-            out[VALID_KEY] = valid
-
         if self.order_by:
             # jnp.lexsort: last key is the primary sort key
             scalar_ov = out.pop("__overflow__", None)  # 0-d: not row-shaped
@@ -297,7 +287,9 @@ class SelectorPlan:
             if scalar_ov is not None:
                 out["__overflow__"] = scalar_ov
 
-        if not self.limit_before_order and has_limit:
+        # sort-then-limit, store queries included: QuerySelector always
+        # orders the chunk before offset/limit (QuerySelector.java:192-198)
+        if has_limit:
             out[VALID_KEY] = _apply_limit(valid)
 
         return state, out

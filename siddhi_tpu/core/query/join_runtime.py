@@ -525,7 +525,9 @@ class JoinQueryRuntime(QueryRuntime):
         return self.build_side_step_fn(key)
 
     def process_side_batch(self, side_key: str, batch: HostBatch):
-        with self._lock:
+        from siddhi_tpu.observability.tracing import span
+
+        with span("query.step", query=self.name, side=side_key), self._lock:
             side = self.sides[side_key]
             cols = batch.cols
             partitioned = self.partition_ctx is not None
@@ -578,8 +580,14 @@ class JoinQueryRuntime(QueryRuntime):
                 self._state = self._init_state()
             jitted = self._steps.get(side_key)
             if jitted is None:
-                jitted = jax.jit(self.build_side_step_fn(side_key), donate_argnums=0)
+                jitted = self.app_context.telemetry.instrument_jit(
+                    jax.jit(self.build_side_step_fn(side_key),
+                            donate_argnums=0),
+                    f"query.{self.name}.join.{side_key}")
                 self._steps[side_key] = jitted
+            else:
+                self.app_context.telemetry.record_jit(
+                    f"query.{self.name}.join.{side_key}", hit=True)
             other = self.sides["right" if side_key == "left" else "left"]
             _ovf_msg = ("join window capacity exceeded — raise "
                         "app_context.window_capacity")
@@ -654,6 +662,10 @@ class JoinQueryRuntime(QueryRuntime):
     def _finish_device_batch(self, step, cols, overflow_msg):
         if self.keyer is None:
             return super()._finish_device_batch(step, cols, overflow_msg)
+        from siddhi_tpu.core.util.statistics import latency_t0, record_elapsed_ms
+
+        sm = self.app_context.statistics_manager
+        t0 = latency_t0(sm)
         now = np.int64(self.app_context.timestamp_generator.current_time())
         if self.selector_plan.needs_str_rank:
             from siddhi_tpu.core.plan.selector_plan import STR_RANK
@@ -672,6 +684,7 @@ class JoinQueryRuntime(QueryRuntime):
             notify = int(nt) if nt is not None else -1
         if overflow > 0:
             raise FatalQueryError(f"query '{self.name}': {overflow_msg}")
+        record_elapsed_ms(sm, self.name, t0)
         out_host = self._host_keyed_select(out_host)
         self._emit(HostBatch(out_host))
         if notify >= 0:

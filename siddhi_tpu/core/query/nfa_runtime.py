@@ -257,7 +257,10 @@ class NFAQueryRuntime(QueryRuntime):
     # ----------------------------------------------------------- processing
 
     def process_stream_batch(self, stream_id: str, batch: HostBatch):
-        with self._lock:
+        from siddhi_tpu.observability.tracing import span
+
+        with span("query.step", query=self.name, stream=stream_id), \
+                self._lock:
             cols = batch.cols
             partitioned = self.partition_ctx is not None
             if partitioned:
@@ -275,6 +278,8 @@ class NFAQueryRuntime(QueryRuntime):
             if self._state is None:
                 self._state = self._init_state()
             force_generic = self._host_hard_batch(stream_id, cols)
+            jit_key = (f"query.{self.name}.nfa.{stream_id}"
+                       + (".generic" if force_generic else ""))
             step = self._steps.get((stream_id, force_generic))
             if step is None:
                 fn = self.build_stream_step_fn(stream_id,
@@ -285,7 +290,11 @@ class NFAQueryRuntime(QueryRuntime):
                     step = sharded_jit_for(self, fn, n_plain_args=2)
                 else:
                     step = jax.jit(fn, donate_argnums=0)
+                step = self.app_context.telemetry.instrument_jit(
+                    step, jit_key)
                 self._steps[(stream_id, force_generic)] = step
+            else:
+                self.app_context.telemetry.record_jit(jit_key, hit=True)
             jcols = dict(cols) if isinstance(cols, LazyColumns) else cols
             if self.selector_plan.needs_str_rank:
                 from siddhi_tpu.core.plan.selector_plan import STR_RANK
@@ -380,6 +389,8 @@ class NFAQueryRuntime(QueryRuntime):
                     self._timer_step = sharded_jit_for(self, fn, n_plain_args=1)
                 else:
                     self._timer_step = jax.jit(fn, donate_argnums=0)
+                self._timer_step = self.app_context.telemetry.instrument_jit(
+                    self._timer_step, f"query.{self.name}.nfa.timer")
             notify = self._run_nfa_step(
                 lambda: self._timer_step(self._state, np.int64(ts)))
         if notify is not None and self.scheduler is not None:
@@ -389,6 +400,10 @@ class NFAQueryRuntime(QueryRuntime):
         """Run a jitted NFA step; when a group-by keyer splits the pipeline,
         key the NFA emissions host-side and run the selector step after.
         Overflow/notify/size arrive packed in __meta__ — one pull."""
+        from siddhi_tpu.core.util.statistics import latency_t0, record_elapsed_ms
+
+        sm = self.app_context.statistics_manager
+        t0 = latency_t0(sm)
         self._state, out = run()
         out_host = LazyColumns(out)
         size_hint = None
@@ -402,7 +417,9 @@ class NFAQueryRuntime(QueryRuntime):
                     st.waitish for st in self.stage.plan.steps):
                 # batch N step metas into ONE round trip (PERF.md tunnel
                 # cost model); absent deadlines need prompt notifies, so
-                # only wait-free plans defer
+                # only wait-free plans defer (dispatch-side latency only —
+                # emission is deferred)
+                record_elapsed_ms(sm, self.name, t0)
                 self._deferred.append((
                     out_host,
                     "pattern match-slot capacity exceeded — raise "
@@ -423,6 +440,7 @@ class NFAQueryRuntime(QueryRuntime):
                 f"query '{self.name}': pattern match-slot capacity exceeded — "
                 f"raise app_context.nfa_slots before creating the runtime"
             )
+        record_elapsed_ms(sm, self.name, t0)
         if self.keyer is not None:
             out_host.pop("__overflow__", None)
             out_host.pop("__notify__", None)

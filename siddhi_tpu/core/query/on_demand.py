@@ -347,9 +347,6 @@ class OnDemandFindRuntime:
             batch_mode=True,
             dictionary=dictionary,
         )
-        # reference store-query quirk: limit applies before the sort
-        # (see SelectorPlan.limit_before_order)
-        self.plan.limit_before_order = True
         self.group_fns = None
         if self.plan.group_by:
             from siddhi_tpu.ops.expressions import compile_expr

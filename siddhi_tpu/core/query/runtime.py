@@ -297,7 +297,13 @@ class QueryRuntime(Receiver):
             self._state = state
 
     def _make_step(self):
-        return jax.jit(self.build_step_fn(), donate_argnums=0)
+        # first-call compile timing rides a telemetry proxy: jit-compile
+        # count/wall-ms per query (and a span("jit")) with one attribute
+        # check per call afterwards — re-jits on capacity growth show up
+        # as fresh compile events
+        jitted = jax.jit(self.build_step_fn(), donate_argnums=0)
+        return self.app_context.telemetry.instrument_jit(
+            jitted, f"query.{self.name}.step")
 
     def build_step_fn(self):
         """The pure (state, cols, now) -> (state', out) device function for
@@ -466,7 +472,9 @@ class QueryRuntime(Receiver):
             tap.emit(rows, timestamps)
 
     def process_batch(self, batch: HostBatch):
-        with self._lock:
+        from siddhi_tpu.observability.tracing import span
+
+        with span("query.step", query=self.name), self._lock:
             notify_host = None
             if self.log_stages:
                 self._run_log_taps(batch)
@@ -543,6 +551,14 @@ class QueryRuntime(Receiver):
                 self._state = self._init_state()
             if self._step is None:
                 self._step = self._make_step()
+            else:
+                # hit key follows the wrapper's own key: a sharded step
+                # (mesh.shard_query_step) compiles under ".sharded_step",
+                # and its hits must land on the SAME series or cache-hit
+                # dashboards read garbage for sharded apps
+                self.app_context.telemetry.record_jit(
+                    getattr(self._step, "_key", f"query.{self.name}.step"),
+                    hit=True)
             knob = (
                 "app_context.partition_window_capacity"
                 if self.partition_ctx is not None
@@ -573,7 +589,12 @@ class QueryRuntime(Receiver):
                 st2, out2 = sel.apply(sel_state, cols, {"xp": jnp, "current_time": now})
                 return st2, pack_meta(out2)
 
-            self._sel_step = jax.jit(fn, donate_argnums=0)
+            self._sel_step = self.app_context.telemetry.instrument_jit(
+                jax.jit(fn, donate_argnums=0),
+                f"query.{self.name}.selector")
+        else:
+            self.app_context.telemetry.record_jit(
+                f"query.{self.name}.selector", hit=True)
         now = np.int64(self._now())
         new_sel, sel_out = self._sel_step(self._state["sel"], dict(out_host), now)
         self._state["sel"] = new_sel
@@ -593,12 +614,10 @@ class QueryRuntime(Receiver):
         """Run the jitted step, raise on overflow, emit outputs; returns the
         wanted timer wake time (or None). Shared tail of every query
         runtime's batch processing (single-stream, NFA, join)."""
-        sm = self.app_context.statistics_manager
-        t0 = None
-        if sm is not None and sm.level >= 2:
-            import time as _time
+        from siddhi_tpu.core.util.statistics import latency_t0, record_elapsed_ms
 
-            t0 = _time.perf_counter()
+        sm = self.app_context.statistics_manager
+        t0 = latency_t0(sm)
         now = np.int64(self._now())
         if isinstance(cols, LazyColumns):
             cols = dict(cols)   # jit boundary: raw (possibly device) arrays
@@ -620,12 +639,8 @@ class QueryRuntime(Receiver):
             if defer > 1 and self._defer_ok:
                 # batch N metas into ONE round trip: queue the (device)
                 # output; emission + overflow surfacing lag <= N batches
-                if t0 is not None:
-                    import time as _time
-
-                    # dispatch-side latency only (emission is deferred)
-                    sm.latency_tracker(self.name).record(
-                        (_time.perf_counter() - t0) * 1000.0)
+                # (dispatch-side latency only — emission is deferred)
+                record_elapsed_ms(sm, self.name, t0)
                 self._deferred.append((out_host, overflow_msg))
                 if len(self._deferred) < defer:
                     return None
@@ -638,11 +653,7 @@ class QueryRuntime(Receiver):
             if overflow > 0:
                 raise FatalQueryError(
                     f"query '{self.name}': {overflow_msg} before creating the runtime")
-            if t0 is not None:
-                import time as _time
-
-                sm.latency_tracker(self.name).record(
-                    (_time.perf_counter() - t0) * 1000.0)
+            record_elapsed_ms(sm, self.name, t0)
             self._emit(HostBatch(out_host, size=size_hint))
             if notify >= 0:
                 return notify
@@ -653,10 +664,7 @@ class QueryRuntime(Receiver):
                 f"query '{self.name}': {overflow_msg} before creating the runtime"
             )
         notify = out_host.pop("__notify__", None)
-        if t0 is not None:
-            import time as _time
-
-            sm.latency_tracker(self.name).record((_time.perf_counter() - t0) * 1000.0)
+        record_elapsed_ms(sm, self.name, t0)
         self._emit(HostBatch(out_host))
         if notify is not None and int(notify) >= 0:
             return int(notify)

@@ -20,6 +20,7 @@ import traceback
 from typing import List, Optional
 
 from siddhi_tpu.core.event import Event
+from siddhi_tpu.observability.tracing import span
 from siddhi_tpu.query_api.definitions import StreamDefinition
 
 log = logging.getLogger(__name__)
@@ -113,6 +114,15 @@ class StreamJunction:
         self._latency_target_ms = latency_target_ms
         self._lat_ewma = 0.0
         self._queue = queue.Queue(maxsize=buffer_size)
+        # observability: queue depth + in-flight unit gauges, scraped via
+        # GET /metrics (telemetry is level-independent — a wedging @Async
+        # queue must be visible whether or not @app:statistics is on)
+        tel = getattr(self.app_context, "telemetry", None)
+        if tel is not None:
+            sid = self.definition.id
+            tel.gauge(f"junction.{sid}.queue_depth", self._queue.qsize)
+            tel.gauge(f"junction.{sid}.inflight_batches",
+                      lambda j=self: 0 if j._inflight is _NOTHING else 1)
 
     def start_processing(self):
         self._running = True
@@ -161,7 +171,7 @@ class StreamJunction:
             # the producer instead of blocking on a queue nobody drains
             raise self._fatal
         if self._async and self._running:
-            self._queue.put(events)
+            self._enqueue(events)
         else:
             self._deliver(events)
 
@@ -183,26 +193,51 @@ class StreamJunction:
         if self._fatal is not None:
             raise self._fatal
         if self._async and self._running:
-            self._queue.put(batch)
+            self._enqueue(batch)
         else:
             self._deliver_batch(batch)
+
+    def _enqueue(self, item):
+        """Producer-side @Async enqueue, counting backpressure stalls
+        (sends that found the queue FULL and had to block) so sizing
+        regressions are visible on /metrics before they become p99."""
+        try:
+            self._queue.put_nowait(item)
+            return
+        except queue.Full:
+            pass
+        tel = getattr(self.app_context, "telemetry", None)
+        if tel is not None:
+            tel.count(f"junction.{self.definition.id}.backpressure_stalls")
+        self._queue.put(item)
 
     def _deliver_batch(self, batch):
         from siddhi_tpu.core.event import HostBatch, LazyColumns
 
-        for r in self.receivers:
-            # receivers mutate batch.cols in place (filters, key columns) —
-            # hand each its own dict so mutations don't leak across;
-            # LazyColumns keeps device-held outputs unpulled until read
-            try:
-                r.receive_batch(
-                    HostBatch(LazyColumns(batch.cols), size=batch._size), self)
-            except Exception as e:  # noqa: BLE001 — fault-stream routing
-                self.handle_error(self.decode_events(batch), e)
+        with span("junction.dispatch", stream=self.definition.id,
+                  rows=int(batch._size) if batch._size is not None else -1):
+            for r in self.receivers:
+                # receivers mutate batch.cols in place (filters, key
+                # columns) — hand each its own dict so mutations don't leak
+                # across; LazyColumns keeps device-held outputs unpulled
+                # until read
+                try:
+                    r.receive_batch(
+                        HostBatch(LazyColumns(batch.cols),
+                                  size=batch._size), self)
+                except Exception as e:  # noqa: BLE001 — fault-stream routing
+                    self.handle_error(self.decode_events(batch), e)
 
     def _adapt(self, elapsed_ms: float):
         """Latency-target control loop: EWMA the delivery latency, shrink
-        the batch cap on overshoot, regrow on sustained headroom."""
+        the batch cap on overshoot, regrow on sustained headroom. Every
+        @Async delivery's latency also lands in the junction's histogram
+        tracker — the batcher's contribution to tail latency is exactly
+        what max.delay / latency.target tune."""
+        sm = self.app_context.statistics_manager
+        if sm is not None and sm.level >= 1:
+            sm.latency_tracker(
+                f"junction.{self.definition.id}").record(elapsed_ms)
         target = self._latency_target_ms
         if target is None:
             return
@@ -326,11 +361,13 @@ class StreamJunction:
                 return
 
     def _deliver(self, events: List[Event]):
-        for r in self.receivers:
-            try:
-                r.receive(events)
-            except Exception as e:  # noqa: BLE001 — fault-stream routing
-                self.handle_error(events, e)
+        with span("junction.dispatch", stream=self.definition.id,
+                  rows=len(events)):
+            for r in self.receivers:
+                try:
+                    r.receive(events)
+                except Exception as e:  # noqa: BLE001 — fault-stream routing
+                    self.handle_error(events, e)
 
     def handle_error(self, events: List[Event], e: Exception):
         from siddhi_tpu.ops.expressions import CompileError

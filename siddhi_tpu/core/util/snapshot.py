@@ -42,6 +42,14 @@ FORMAT_VERSION = 4
 _REPLICATE_JIT: dict = {}
 
 
+def _telemetry():
+    # process-global registry: _to_host is a module function with no app
+    # context in scope, and the replicate-jit cache is process-wide too
+    from siddhi_tpu.observability.telemetry import global_registry
+
+    return global_registry()
+
+
 def _to_host(tree):
     import jax
 
@@ -57,6 +65,8 @@ def _to_host(tree):
 
             rep = NamedSharding(x.sharding.mesh, PartitionSpec())
             fn = _REPLICATE_JIT.get(rep)
+            _telemetry().record_jit("snapshot.replicate_allgather",
+                                    hit=fn is not None)
             if fn is None:
                 fn = jax.jit(lambda a: a, out_shardings=rep)
                 _REPLICATE_JIT[rep] = fn
@@ -243,8 +253,15 @@ class SnapshotService:
                     q.keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
                 if q.host_window is not None and qsnap.get("host_window") is not None:
                     q.host_window.restore(qsnap["host_window"])
-                if qsnap.get("nfa_hwm") is not None and hasattr(q, "_nfa_hwm_arr"):
-                    q._nfa_hwm_arr = np.array(qsnap["nfa_hwm"], np.int64)
+                if hasattr(q, "_nfa_hwm_arr"):
+                    # no nfa_hwm in the snapshot -> the mirror must RESET:
+                    # keeping post-snapshot high-water marks after a
+                    # rollback would permanently classify every later
+                    # batch as hard (fast kernel never used) and feed
+                    # expire_to clocks from the abandoned timeline
+                    hwm = qsnap.get("nfa_hwm")
+                    q._nfa_hwm_arr = (np.array(hwm, np.int64)
+                                      if hwm is not None else None)
                 q._step = None
                 if hasattr(q, "_steps"):
                     q._steps.clear()
@@ -370,32 +387,45 @@ class PersistenceManager:
         """Full checkpoint, or (``incremental=True``, after at least one
         full) an op-log delta chained to the previous revision (reference
         incremental SnapshotService + IncrementalPersistenceStore)."""
+        from siddhi_tpu.observability.tracing import span
+
+        t_start = time.perf_counter()
         rt = self.app_runtime
         store = self._store()
         wal = getattr(rt.app_context, "ingest_wal", None)
-        with rt._barrier:  # quiesce inputs (ThreadBarrier)
-            # accepted-but-queued async batches must be applied before the
-            # capture, or the WAL cut below would cover them unapplied
-            drained = self._drain_async_junctions() if wal is not None \
-                else True
-            if incremental and self._last_revision is not None:
-                data = self.snapshot_service.incremental_snapshot(
-                    self._last_revision)
-            else:
-                data = self.snapshot_service.full_snapshot()
-            # the WAL cut marks what this snapshot covers; the trim waits
-            # for the durable save — a batch accepted after the barrier
-            # releases must survive in the log (resilience/replay.py)
-            wal_cut = wal.cut() if (wal is not None and drained) else None
-        # sortable: ms prefix, then a process-monotonic counter
-        revision = f"{int(time.time() * 1000):020d}_{next(self._seq):06d}_{rt.name}"
-        store.save(rt.name, revision, data)
-        # only after the save is durable: clear the op logs
-        self.snapshot_service.mark_checkpoint()
-        if wal_cut is not None:
-            wal.trim(wal_cut)
-            wal.checkpoint_revision = revision
-        self._last_revision = revision
+        with span("persist", app=rt.name, incremental=incremental):
+            with rt._barrier:  # quiesce inputs (ThreadBarrier)
+                # accepted-but-queued async batches must be applied before
+                # the capture, or the WAL cut below would cover them
+                # unapplied
+                drained = self._drain_async_junctions() if wal is not None \
+                    else True
+                if incremental and self._last_revision is not None:
+                    data = self.snapshot_service.incremental_snapshot(
+                        self._last_revision)
+                else:
+                    data = self.snapshot_service.full_snapshot()
+                # the WAL cut marks what this snapshot covers; the trim
+                # waits for the durable save — a batch accepted after the
+                # barrier releases must survive in the log
+                # (resilience/replay.py)
+                wal_cut = wal.cut() if (wal is not None and drained) else None
+            # sortable: ms prefix, then a process-monotonic counter
+            revision = (f"{int(time.time() * 1000):020d}_"
+                        f"{next(self._seq):06d}_{rt.name}")
+            store.save(rt.name, revision, data)
+            # only after the save is durable: clear the op logs
+            self.snapshot_service.mark_checkpoint()
+            if wal_cut is not None:
+                wal.trim(wal_cut)
+                wal.checkpoint_revision = revision
+            self._last_revision = revision
+        sm = rt.app_context.statistics_manager
+        if sm is not None and sm.level >= 1:
+            # checkpoint stalls ingest for its whole barrier'd capture —
+            # its tail belongs on the same percentile surface as queries
+            sm.latency_tracker("snapshot.persist").record(
+                (time.perf_counter() - t_start) * 1000.0)
         return revision
 
     def persist_incremental(self) -> str:

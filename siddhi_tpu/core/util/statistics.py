@@ -44,6 +44,25 @@ def pytree_nbytes(tree) -> int:
 
 OFF, BASIC, DETAIL = 0, 1, 2
 
+
+def latency_t0(sm: Optional["StatisticsManager"],
+               level: int = DETAIL) -> Optional[float]:
+    """Start a latency measurement: ``perf_counter()`` when ``sm`` collects
+    at ``level``, else None. Pair with ``record_elapsed_ms`` — the shared
+    timing pattern of the query/join/NFA runtimes (one helper so the
+    copies cannot drift)."""
+    if sm is not None and sm.level >= level:
+        return time.perf_counter()
+    return None
+
+
+def record_elapsed_ms(sm: Optional["StatisticsManager"], name: str,
+                      t0: Optional[float]) -> None:
+    """Record elapsed ms since ``t0`` on ``sm``'s tracker; no-op when the
+    paired ``latency_t0`` returned None."""
+    if t0 is not None:
+        sm.latency_tracker(name).record((time.perf_counter() - t0) * 1000.0)
+
 _LEVELS = {"off": OFF, "basic": BASIC, "detail": DETAIL,
            "false": OFF, "true": BASIC}
 
@@ -81,28 +100,49 @@ class ThroughputTracker:
 
 
 class LatencyTracker:
-    """Per-batch processing latency aggregates (ms)."""
+    """Per-batch processing latency aggregates (ms) with tail
+    percentiles: every record also lands in a fixed-bucket log-spaced
+    histogram (``observability/histogram.py``), so the avg-only view
+    the reference's LatencyTracker offers is extended with p50/p95/p99
+    — the numbers the PERF.md batching decisions actually hinge on."""
 
     def __init__(self, name: str):
+        from siddhi_tpu.observability.histogram import Histogram
+
         self.name = name
         self.n = 0
         self.total_ms = 0.0
         self.max_ms = 0.0
+        self.hist = Histogram()
 
     def record(self, ms: float):
         self.n += 1
         self.total_ms += ms
         if ms > self.max_ms:
             self.max_ms = ms
+        self.hist.record(ms)
 
     @property
     def avg_ms(self) -> float:
         return self.total_ms / self.n if self.n else 0.0
 
+    @property
+    def p50_ms(self) -> float:
+        return self.hist.quantile(0.50)
+
+    @property
+    def p95_ms(self) -> float:
+        return self.hist.quantile(0.95)
+
+    @property
+    def p99_ms(self) -> float:
+        return self.hist.quantile(0.99)
+
     def reset(self):
         self.n = 0
         self.total_ms = 0.0
         self.max_ms = 0.0
+        self.hist.reset()
 
 
 class StatisticsManager:
@@ -193,7 +233,11 @@ class StatisticsManager:
                 },
                 "latency": {
                     n: {"batches": t.n, "avg_ms": round(t.avg_ms, 3),
-                        "max_ms": round(t.max_ms, 3)}
+                        "max_ms": round(t.max_ms, 3),
+                        "total_ms": round(t.total_ms, 3),
+                        "p50_ms": round(t.p50_ms, 3),
+                        "p95_ms": round(t.p95_ms, 3),
+                        "p99_ms": round(t.p99_ms, 3)}
                     for n, t in self.latency.items()
                 },
             }

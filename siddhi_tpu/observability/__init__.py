@@ -1,0 +1,51 @@
+"""Observability subsystem: spans, percentile histograms, telemetry, export.
+
+The reference engine ships first-class runtime statistics (metrics-core
+behind ``@app:statistics`` — ``siddhi-core/pom.xml:79``,
+``SiddhiStatisticsManager``); our ``StatisticsManager`` covered counters
+and *average* latencies only. Every PERF.md decision so far (the
+p99-vs-batch cliff, the router eating ~75% of single-shard throughput)
+hinged on tail latency and per-stage attribution, which averages cannot
+show — and "Scaling Ordered Stream Processing on Shared-Memory
+Multicores" (PAPERS.md) makes the same point for ordered pipelines:
+diagnosis needs per-stage queue and latency instrumentation. Four parts:
+
+- ``tracing``:   lightweight structured spans (``span("compile")``,
+                 ``span("jit", key=...)``) — nested, thread-safe,
+                 ring-buffered, exported as Chrome-trace JSON
+                 (``chrome://tracing`` / Perfetto). Wired through
+                 compile → plan → jit → junction dispatch → query step →
+                 sink publish → persist.
+- ``histogram``: fixed-bucket log-spaced (HDR-style) latency histograms
+                 with p50/p95/p99, embedded in ``LatencyTracker`` so the
+                 query/join/NFA runtimes, the @Async junction batcher,
+                 and snapshot persist all gain tails for free.
+- ``telemetry``: gauges (@Async queue depth, in-flight batches, WAL
+                 size, outstanding cluster pulls), counters
+                 (backpressure stalls), and jit-compile events (count,
+                 wall-ms, cache hit/miss) — one registry per app plus a
+                 process-global one for context-free sites.
+- ``export``:    Prometheus text exposition + JSON snapshot, served at
+                 ``GET /metrics[/{app}]`` on the REST service
+                 (``service/rest.py``), with ``POST /trace/start|stop``
+                 dumping a span file.
+
+Always-on-capable: ``tools/obs_overhead.py`` holds the e2e throughput
+with full instrumentation at >= 0.9x uninstrumented (PERF.md).
+"""
+
+from siddhi_tpu.observability.histogram import Histogram
+from siddhi_tpu.observability.telemetry import (
+    TelemetryRegistry,
+    global_registry,
+)
+from siddhi_tpu.observability.tracing import TRACER, Tracer, span
+
+__all__ = [
+    "Histogram",
+    "TRACER",
+    "TelemetryRegistry",
+    "Tracer",
+    "global_registry",
+    "span",
+]

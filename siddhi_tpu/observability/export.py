@@ -1,0 +1,201 @@
+"""Metrics exposition: Prometheus text format + JSON snapshot.
+
+Renders every deployed app's ``StatisticsManager`` (throughput, latency
+with p50/p95/p99, named counters, DETAIL memory/buffer probes) and
+``TelemetryRegistry`` (gauges, counters, jit-compile events), merged
+with the process-global registry, as:
+
+- Prometheus text exposition (v0.0.4) for ``GET /metrics`` — the
+  scrapeable surface a production deployment points its collector at;
+- a JSON snapshot (``?format=json`` / ``Accept: application/json``) for
+  humans and tests.
+
+Naming: structured label sets, not dotted metric names — per-query
+latency is ``siddhi_latency_ms{app=...,name=...,quantile=...}``, @Async
+depth is ``siddhi_junction_queue_depth{app=...,stream=...}``, and named
+counters keep their dotted names as a LABEL VALUE
+(``siddhi_counter_total{name="resilience.wal_replayed_batches"}``)
+where dots are legal. The well-known ``resilience.*`` counters are
+always emitted (0 until the event happens) so dashboards and alerts can
+be written before the first failure."""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Tuple
+
+from siddhi_tpu.observability.telemetry import global_registry
+
+# operationally load-bearing counters, pre-declared at 0 per app
+RESILIENCE_COUNTERS = (
+    "resilience.worker_restarts",
+    "resilience.wal_replayed_batches",
+    "resilience.wal_dropped_batches",
+    "resilience.source_retries",
+    "resilience.sink_retries",
+    "resilience.peer_failures",
+    "resilience.peer_recoveries",
+)
+
+_JUNCTION_GAUGE = re.compile(r"^junction\.(?P<stream>.+)\.(?P<kind>"
+                             r"queue_depth|inflight_batches)$")
+_JUNCTION_STALLS = re.compile(r"^junction\.(?P<stream>.+)"
+                              r"\.backpressure_stalls$")
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Families:
+    """Accumulates samples grouped per metric family so each family's
+    ``# TYPE`` header is emitted exactly once, before its samples."""
+
+    def __init__(self):
+        self._fam: Dict[str, Tuple[str, str, List[str]]] = {}
+
+    def add(self, family: str, ftype: str, help_: str,
+            labels: Dict[str, str], value, suffix: str = ""):
+        rec = self._fam.get(family)
+        if rec is None:
+            rec = self._fam[family] = (ftype, help_, [])
+        lbl = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        lbl = "{" + lbl + "}" if lbl else ""
+        rec[2].append(f"{family}{suffix}{lbl} {_fmt(value)}")
+
+    def render(self) -> str:
+        lines = []
+        for family in sorted(self._fam):
+            ftype, help_, samples = self._fam[family]
+            lines.append(f"# HELP {family} {help_}")
+            lines.append(f"# TYPE {family} {ftype}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+def app_snapshot(rt) -> dict:
+    """JSON-ready metrics for one app runtime."""
+    sm = rt.app_context.statistics_manager
+    return {
+        "app": rt.name,
+        "statistics": rt.statistics() if sm is not None else {"level": "off"},
+        "telemetry": rt.app_context.telemetry.snapshot(),
+    }
+
+
+def json_snapshot(manager) -> dict:
+    return {
+        "apps": {name: app_snapshot(rt)
+                 for name, rt in sorted(manager.app_runtimes.items())},
+        "process": global_registry().snapshot(),
+    }
+
+
+def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
+    base = {"app": app} if app else {}
+    for name, v in sorted(tel_snapshot.get("gauges", {}).items()):
+        m = _JUNCTION_GAUGE.match(name)
+        if m:
+            fams.add(f"siddhi_junction_{m.group('kind')}", "gauge",
+                     ("@Async junction queue depth"
+                      if m.group("kind") == "queue_depth"
+                      else "@Async junction in-flight delivery units"),
+                     {**base, "stream": m.group("stream")}, v)
+        else:
+            fams.add("siddhi_gauge", "gauge", "registered telemetry gauge",
+                     {**base, "name": name}, v)
+    for name, v in sorted(tel_snapshot.get("counters", {}).items()):
+        m = _JUNCTION_STALLS.match(name)
+        if m:
+            fams.add("siddhi_junction_backpressure_stalls_total", "counter",
+                     "producer sends that blocked on a full @Async queue",
+                     {**base, "stream": m.group("stream")}, v)
+        else:
+            fams.add("siddhi_counter_total", "counter",
+                     "named event counter",
+                     {**base, "name": name}, v)
+    for key, rec in sorted(tel_snapshot.get("jit", {}).items()):
+        kl = {**base, "key": key}
+        fams.add("siddhi_jit_compiles_total", "counter",
+                 "jitted step functions compiled", kl, rec["compiles"])
+        fams.add("siddhi_jit_compile_ms_total", "counter",
+                 "wall-clock ms spent in first-call jit compiles", kl,
+                 rec["compile_ms"])
+        fams.add("siddhi_jit_cache_hits_total", "counter",
+                 "jitted step cache hits", kl, rec["hits"])
+
+
+def _add_statistics(fams: _Families, rt):
+    app = rt.name
+    sm = rt.app_context.statistics_manager
+    report = rt.statistics() if sm is not None else {"level": "off"}
+    fams.add("siddhi_statistics_level", "gauge",
+             "statistics level (0=off 1=basic 2=detail)",
+             {"app": app},
+             {"off": 0, "basic": 1, "detail": 2}.get(report.get("level"), 0))
+    for name, t in sorted(report.get("throughput", {}).items()):
+        fams.add("siddhi_stream_events_total", "counter",
+                 "events published through the stream junction",
+                 {"app": app, "stream": name}, t["events"])
+        fams.add("siddhi_stream_batches_total", "counter",
+                 "batches published through the stream junction",
+                 {"app": app, "stream": name}, t["batches"])
+    for name, lat in sorted(report.get("latency", {}).items()):
+        labels = {"app": app, "name": name}
+        for q in ("0.5", "0.95", "0.99"):
+            key = {"0.5": "p50_ms", "0.95": "p95_ms", "0.99": "p99_ms"}[q]
+            fams.add("siddhi_latency_ms", "summary",
+                     "per-stage batch processing latency (ms)",
+                     {**labels, "quantile": q}, lat.get(key, 0.0))
+        fams.add("siddhi_latency_ms", "summary",
+                 "per-stage batch processing latency (ms)",
+                 labels, lat.get("total_ms", 0.0), suffix="_sum")
+        fams.add("siddhi_latency_ms", "summary",
+                 "per-stage batch processing latency (ms)",
+                 labels, lat["batches"], suffix="_count")
+        fams.add("siddhi_latency_ms_max", "gauge",
+                 "max batch processing latency (ms)",
+                 labels, lat.get("max_ms", 0.0))
+    counters = dict(report.get("counters", {}))
+    for name in RESILIENCE_COUNTERS:
+        counters.setdefault(name, 0)
+    for name, v in sorted(counters.items()):
+        fams.add("siddhi_counter_total", "counter", "named event counter",
+                 {"app": app, "name": name}, v)
+    for name, v in sorted(report.get("memory_bytes", {}).items()):
+        fams.add("siddhi_state_memory_bytes", "gauge",
+                 "dense state footprint (bytes)",
+                 {"app": app, "name": name}, v)
+    for name, v in sorted(report.get("buffered_events", {}).items()):
+        fams.add("siddhi_buffered_events", "gauge",
+                 "pending buffered events/batches",
+                 {"app": app, "name": name}, v)
+
+
+def prometheus_text(manager, app_name=None) -> str:
+    """Prometheus text exposition for every app (or one app) plus the
+    process-global telemetry."""
+    fams = _Families()
+    runtimes = manager.app_runtimes
+    if app_name is not None:
+        rt = runtimes.get(app_name)
+        if rt is None:
+            raise KeyError(f"app '{app_name}' is not deployed")
+        runtimes = {app_name: rt}
+    for name in sorted(runtimes):
+        rt = runtimes[name]
+        _add_statistics(fams, rt)
+        _add_telemetry(fams, rt.app_context.telemetry.snapshot(), name)
+    _add_telemetry(fams, global_registry().snapshot(), "")
+    return fams.render()

@@ -1,0 +1,148 @@
+"""Telemetry registry: gauges, counters, and jit-compile events.
+
+Complements ``core/util/statistics.StatisticsManager`` (throughput +
+latency trackers behind ``@app:statistics``) with the operational
+signals a production deployment scrapes continuously:
+
+- **gauges** — sampled callables: @Async junction queue depth and
+  in-flight batches (``core/stream/junction.py``), ingest-WAL size
+  (``resilience/replay.py``), outstanding bounded cluster pulls
+  (``parallel/distributed.py``). Registered once at wiring time, read
+  at scrape time — a dead probe reports NaN instead of failing the
+  scrape.
+- **counters** — monotone event counts outside the statistics levels:
+  backpressure stalls (producer blocked on a full @Async queue).
+- **jit events** — per-key compile count, compile wall-ms, and cache
+  hits, hooked where the runtimes build/cache jitted steps
+  (``QueryRuntime._make_step``, the join/NFA ``_steps`` caches,
+  ``parallel/mesh.py`` sharded jits, ``snapshot.py``'s replicate-jit
+  cache). Compile storms and cache-miss regressions — recompiles on
+  every capacity growth — show up here before they show up as p99.
+
+One registry per app (``SiddhiAppContext.telemetry``, always present so
+call sites need no None checks) plus one process-global registry
+(``global_registry()``) for sites with no app context; ``export.py``
+merges both into every scrape.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Dict
+
+
+class InstrumentedJit:
+    """First-call compile-timing proxy around a jitted callable.
+
+    ``jax.jit`` returns instantly; tracing + XLA compilation happen at
+    the first invocation. This proxy times that first call, records it
+    as a jit-compile event (and a ``span("jit", key=...)``), then
+    degrades to a single attribute check per call."""
+
+    __slots__ = ("_fn", "_key", "_telemetry", "_compiled")
+
+    def __init__(self, fn: Callable, key: str, telemetry: "TelemetryRegistry"):
+        self._fn = fn
+        self._key = key
+        self._telemetry = telemetry
+        self._compiled = False
+
+    def __call__(self, *args):
+        if self._compiled:
+            return self._fn(*args)
+        from siddhi_tpu.observability.tracing import span
+
+        t0 = time.perf_counter()
+        with span("jit", key=self._key):
+            out = self._fn(*args)
+        self._compiled = True
+        self._telemetry.record_jit(
+            self._key, wall_ms=(time.perf_counter() - t0) * 1000.0)
+        return out
+
+    def __getattr__(self, name):
+        # transparent proxy: .lower()/.trace()/aot inspection go to the
+        # wrapped jitted callable (hlo_audit lowers the sharded step)
+        return getattr(self._fn, name)
+
+
+class TelemetryRegistry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self.counters: Dict[str, int] = {}
+        # key -> {"compiles": int, "compile_ms": float, "hits": int}
+        self.jit: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------- gauges
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        """Register (or replace) a sampled gauge."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    def remove_gauge(self, name: str) -> None:
+        with self._lock:
+            self._gauges.pop(name, None)
+
+    def read_gauges(self) -> Dict[str, float]:
+        with self._lock:
+            items = list(self._gauges.items())
+        out = {}
+        for name, fn in items:
+            try:
+                out[name] = float(fn())
+            except Exception:  # noqa: BLE001 — a dead probe must not
+                out[name] = math.nan  # fail the scrape
+        return out
+
+    # ----------------------------------------------------------- counters
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    # --------------------------------------------------------- jit events
+
+    def record_jit(self, key: str, wall_ms: float = 0.0,
+                   hit: bool = False) -> None:
+        with self._lock:
+            rec = self.jit.get(key)
+            if rec is None:
+                rec = self.jit[key] = {"compiles": 0, "compile_ms": 0.0,
+                                       "hits": 0}
+            if hit:
+                rec["hits"] += 1
+            else:
+                rec["compiles"] += 1
+                rec["compile_ms"] += float(wall_ms)
+
+    def instrument_jit(self, fn: Callable, key: str) -> InstrumentedJit:
+        """Wrap a freshly-built jitted callable so its first call is
+        recorded as a compile event."""
+        return InstrumentedJit(fn, key, self)
+
+    # ------------------------------------------------------------ reading
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+            jit = {k: dict(v) for k, v in self.jit.items()}
+        return {"gauges": self.read_gauges(), "counters": counters,
+                "jit": jit}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.jit.clear()
+
+
+_GLOBAL = TelemetryRegistry()
+
+
+def global_registry() -> TelemetryRegistry:
+    """Process-wide registry for sites with no app context (the snapshot
+    replicate-jit cache, the bounded cluster-pull gauge)."""
+    return _GLOBAL

@@ -90,9 +90,14 @@ class ClusterPeerError(RuntimeError):
     leaves every other host BLOCKED inside XLA, so the detection has to
     be a bounded wait around the device pull. Raised inside the
     junction's delivery path, this error rides the same ``@OnError`` /
-    fault-stream machinery as any other processing failure. Recovery
-    story: tear the runtime down, restart the cluster with the surviving
-    hosts (new ``jax.distributed`` incarnation), and
+    fault-stream machinery as any other processing failure.
+
+    TERMINAL for the runtime: the timed-out pull leaves a leaked thread
+    parked on the device stream, so retrying (or stepping the runtime
+    again) only stacks more leaked threads — ``guarded_pull`` counts
+    them (``cluster.outstanding_pulls`` gauge) and fails fast at its
+    cap. Recovery story: tear the runtime down, restart the cluster with
+    the surviving hosts (new ``jax.distributed`` incarnation), and
     ``restore_last_revision()`` from the persistence store — snapshots
     are host-side and replicated, so any surviving host can restore."""
 
@@ -116,6 +121,32 @@ def local_survivor_mesh(axis_name: str = KEY_AXIS):
 # dead peer without waiting out the pull timeout. Never set in production.
 _fault_hook = None
 
+# Leaked-pull accounting: every timeout abandons a daemon thread parked
+# in an un-cancellable XLA host wait. The count of still-outstanding
+# pulls is exported as a process gauge (``cluster.outstanding_pulls`` on
+# GET /metrics), and bounded by ``_MAX_OUTSTANDING_PULLS`` — reaching
+# the cap means the caller kept stepping a runtime that ClusterPeerError
+# already declared dead (see guarded_pull's docstring: the error is
+# TERMINAL), and further pulls fail fast instead of stacking threads.
+_MAX_OUTSTANDING_PULLS = 32
+_outstanding_pulls = 0
+_pull_lock = None    # created lazily (threading import stays function-local)
+
+
+def outstanding_pulls() -> int:
+    """Device pulls currently in flight or abandoned-but-parked (leaked
+    native waits from timed-out guarded_pull calls)."""
+    return _outstanding_pulls
+
+
+def _register_pull_gauge():
+    from siddhi_tpu.observability.telemetry import global_registry
+
+    global_registry().gauge("cluster.outstanding_pulls", outstanding_pulls)
+
+
+_register_pull_gauge()
+
 
 def guarded_pull(value, timeout_s: float, what: str = "cluster step"):
     """``np.asarray(value)`` bounded by ``timeout_s``.
@@ -124,23 +155,49 @@ def guarded_pull(value, timeout_s: float, what: str = "cluster step"):
     labeled ``ClusterPeerError`` immediately (the stuck native wait stays
     parked in the abandoned thread — XLA host calls are not cancellable,
     but the PROGRAM regains control, which is the part that matters for
-    failure detection)."""
+    failure detection).
+
+    ``ClusterPeerError`` is TERMINAL for the runtime that raised it: the
+    abandoned thread still owns the device stream, so retrying the pull
+    (or stepping the same runtime again) can only stack more leaked
+    threads behind a dead collective. The supported recovery is the
+    supervisor's peer protocol — abandon the runtime, rebuild on
+    ``local_survivor_mesh()``, restore the last revision, replay the WAL
+    (``resilience/supervisor.py``). Outstanding pulls are counted on the
+    ``cluster.outstanding_pulls`` gauge and capped at
+    ``_MAX_OUTSTANDING_PULLS``; at the cap, guarded_pull fails fast."""
     import threading
 
     import numpy as np
 
+    global _pull_lock, _outstanding_pulls
+    if _pull_lock is None:
+        _pull_lock = threading.Lock()
+
     if _fault_hook is not None:
         _fault_hook(what)
+
+    with _pull_lock:
+        if _outstanding_pulls >= _MAX_OUTSTANDING_PULLS:
+            raise ClusterPeerError(
+                f"{what}: {_outstanding_pulls} device pulls already "
+                f"outstanding (cap {_MAX_OUTSTANDING_PULLS}) — earlier "
+                f"ClusterPeerErrors were terminal; abandon this runtime "
+                f"and run the peer-recovery protocol instead of retrying")
+        _outstanding_pulls += 1
 
     box = {}
     done = threading.Event()
 
     def wait():
+        global _outstanding_pulls
         try:
             box["v"] = np.asarray(value)
         except Exception as ex:  # surfaced to the caller below
             box["e"] = ex
         finally:
+            with _pull_lock:
+                _outstanding_pulls -= 1
             done.set()
 
     t = threading.Thread(target=wait, daemon=True,
@@ -149,8 +206,9 @@ def guarded_pull(value, timeout_s: float, what: str = "cluster step"):
     if not done.wait(timeout_s):
         raise ClusterPeerError(
             f"{what} did not complete within {timeout_s:.1f}s — a cluster "
-            f"peer process is presumed dead; restart the cluster and "
-            f"restore from the last snapshot revision")
+            f"peer process is presumed dead; this error is terminal for "
+            f"the runtime: abandon it, restart the cluster and restore "
+            f"from the last snapshot revision")
     if "e" in box:
         raise box["e"]
     return box["v"]

@@ -148,6 +148,13 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
         out_shardings=out_sh,
         donate_argnums=(0,) if donate else (),
     )
+    # telemetry: a sharded (re-)jit is a compile event — capacity growth
+    # re-invokes this function, and those recompiles must be visible on
+    # /metrics (siddhi_jit_compiles_total) before they show up as p99
+    tel = getattr(runtime.app_context, "telemetry", None)
+    if tel is not None:
+        jitted = tel.instrument_jit(
+            jitted, f"query.{runtime.name}.sharded_step")
     # hand the runtime the sharded timeline so junction-fed batches
     # (QueryRuntime.process_batch) and direct jitted() callers share state;
     # remember the mesh so capacity growth re-establishes the sharding

@@ -61,6 +61,23 @@ def _copy_columns(data):
     return out
 
 
+def register_wal_gauges(app_context) -> None:
+    """Expose the context's attached WAL on its telemetry registry
+    (``wal.batches`` / ``wal.pending_events`` / ``wal.dropped_batches``
+    on GET /metrics). Called wherever a WAL is ATTACHED to a context —
+    ``SiddhiAppRuntime.enable_wal`` and the peer-recovery rebuild
+    (``supervisor.PeerRecovery.recover``) — so a post-recovery runtime,
+    where WAL growth matters most, is never scraped blind. Idempotent
+    (gauges are keyed by name)."""
+    wal = getattr(app_context, "ingest_wal", None)
+    tel = getattr(app_context, "telemetry", None)
+    if wal is None or tel is None:
+        return
+    tel.gauge("wal.batches", wal.__len__)
+    tel.gauge("wal.pending_events", lambda w=wal: w.pending_events)
+    tel.gauge("wal.dropped_batches", lambda w=wal: w.dropped_batches)
+
+
 class IngestWAL:
     """Per-process bounded ingest log (see module docstring)."""
 

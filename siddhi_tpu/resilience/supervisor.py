@@ -186,8 +186,13 @@ class PeerRecovery:
         new_rt = self.rebuild()
         if self.wal is not None and getattr(
                 new_rt.app_context, "ingest_wal", None) is None:
-            # the survivor's log must also guard the NEW incarnation
+            # the survivor's log must also guard the NEW incarnation —
+            # gauges included: after a recovery is exactly when WAL
+            # growth/drops must be scrapeable
             new_rt.app_context.ingest_wal = self.wal
+            from siddhi_tpu.resilience.replay import register_wal_gauges
+
+            register_wal_gauges(new_rt.app_context)
         revision = new_rt.restore_last_revision()
         # restore_last_revision replays the wal attached to new_rt; replay
         # explicitly only when ours is a different object (or nothing was

@@ -15,6 +15,21 @@ Endpoints (JSON in/out):
 - ``GET  /apps/<name>/statistics``     — metrics snapshot
 - ``POST /apps/<name>/persist``        — checkpoint; -> ``{"revision": ...}``
 - ``POST /apps/<name>/restore``        — ``{"revision": optional}`` (last when omitted)
+
+Observability (``siddhi_tpu/observability/``):
+
+- ``GET  /metrics``                    — Prometheus text exposition over every
+  deployed app (per-query latency p50/p95/p99, junction queue-depth gauges,
+  jit-compile counters, ``resilience.*`` counters) + process telemetry;
+  ``?format=json`` or ``Accept: application/json`` returns the JSON snapshot
+- ``GET  /metrics/<name>``             — same, scoped to one app
+- ``POST /trace/start``                — ``{"capacity": optional}``; enable the
+  structured span tracer (compile/plan/jit/dispatch/step/publish/persist)
+- ``POST /trace/stop``                 — ``{"file": optional relative name}``;
+  disable it, dump Chrome-trace JSON under the trace base, return it inline
+
+(The per-app ``POST /apps/<name>/trace`` endpoint remains the XLA device
+profiler; ``/trace/*`` is the host-side span timeline.)
 """
 
 from __future__ import annotations
@@ -45,6 +60,16 @@ class SiddhiRestService:
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_text(self, code: int, text: str,
+                           ctype: str = "text/plain; version=0.0.4; "
+                                        "charset=utf-8"):
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -105,12 +130,36 @@ class SiddhiRestService:
         return rt
 
     def _get(self, h):
-        parts = [p for p in h.path.split("/") if p]
+        from urllib.parse import parse_qs, urlsplit
+
+        split = urlsplit(h.path)
+        parts = [p for p in split.path.split("/") if p]
         if parts == ["apps"]:
             h._send(200, {"apps": sorted(self.manager.app_runtimes)})
             return
         if len(parts) == 3 and parts[0] == "apps" and parts[2] == "statistics":
             h._send(200, self._rt(parts[1]).statistics())
+            return
+        if parts and parts[0] == "metrics" and len(parts) <= 2:
+            from siddhi_tpu.observability import export
+
+            app = parts[1] if len(parts) == 2 else None
+            if app is not None and self.manager.get_siddhi_app_runtime(
+                    app) is None:
+                h._send(404, {"error": f"app '{app}' is not deployed"})
+                return
+            fmt = (parse_qs(split.query).get("format", [""])[0]
+                   or ("json" if "application/json"
+                       in (h.headers.get("Accept") or "") else "text"))
+            if fmt == "json":
+                snap = export.json_snapshot(self.manager)
+                if app is not None:
+                    snap = {"apps": {app: snap["apps"][app]},
+                            "process": snap["process"]}
+                h._send(200, snap)
+            else:
+                h._send_text(200, export.prometheus_text(
+                    self.manager, app_name=app))
             return
         h._send(404, {"error": f"unknown path {h.path}"})
 
@@ -128,6 +177,42 @@ class SiddhiRestService:
             rt = self._rt(body["app"])
             events = rt.query(body["query"])
             h._send(200, {"rows": [list(e.data) for e in events]})
+            return
+        if parts == ["trace", "start"]:
+            from siddhi_tpu.observability.tracing import TRACER
+
+            if TRACER.enabled:
+                h._send(409, {"error": "span tracing is already running"})
+                return
+            cap = body.get("capacity") if isinstance(body, dict) else None
+            TRACER.start(capacity=int(cap) if cap else None)
+            h._send(200, {"tracing": True, "capacity": TRACER.capacity})
+            return
+        if parts == ["trace", "stop"]:
+            from siddhi_tpu.observability.tracing import TRACER
+
+            if not TRACER.enabled:
+                h._send(409, {"error": "no span trace is running"})
+                return
+            # validate the target BEFORE stopping: a rejected request
+            # must not kill a running trace as a side effect
+            name = (body.get("file") if isinstance(body, dict) else None) \
+                or "spans.trace.json"
+            base = os.path.realpath(self.trace_base)
+            target = os.path.realpath(os.path.join(base, name))
+            # target == base is rejected too: it names the trace DIRECTORY,
+            # and open() on it would 500 after killing the running trace
+            if not target.startswith(base + os.sep):
+                h._send(400, {"error": "trace file escapes the configured "
+                                       "trace base"})
+                return
+            trace = TRACER.stop()
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            with open(target, "w", encoding="utf-8") as f:
+                json.dump(trace, f)
+            h._send(200, {"tracing": False, "file": target,
+                          "events": len(trace["traceEvents"]),
+                          "trace": trace})
             return
         if len(parts) == 3 and parts[0] == "apps":
             rt = self._rt(parts[1])

@@ -21,9 +21,14 @@ IDTBL = """
 """
 
 
-def _stock_rt():
+def _stock_rt(pk: bool = False):
+    """``pk=True`` declares ``@PrimaryKey('symbol')`` like the reference
+    fixtures that rely on duplicate-symbol rows being dropped
+    (IndexEventHolder.add putIfAbsent)."""
     m = SiddhiManager()
-    rt = m.create_siddhi_app_runtime(STOCK)
+    app = STOCK if not pk else STOCK.replace(
+        "define table", "@PrimaryKey('symbol') define table", 1)
+    rt = m.create_siddhi_app_runtime(app)
     h = rt.get_input_handler("StockStream")
     h.send(["WSO2", 55.6, 100])
     h.send(["IBM", 75.6, 100])
@@ -108,13 +113,29 @@ def test_find_on_primary_key():
 
 
 def test_order_by_limit():
-    """test9 (:319-355): order by price limit 2."""
-    m, rt = _stock_rt()
+    """test9 (:319-355): order by price limit 2 — the reference table is
+    @PrimaryKey('symbol'), so the duplicate WSO2 row (57.6) is dropped
+    on insert and sort-then-limit yields {55.6, 75.6}."""
+    m, rt = _stock_rt(pk=True)
     ev = rt.query("from StockTable on volume > 10 "
                   "select symbol, price, volume order by price limit 2")
     assert len(ev) == 2
     assert round(float(ev[0].data[1]), 4) == 55.6
     assert round(float(ev[1].data[1]), 4) == 75.6
+    m.shutdown()
+
+
+def test_order_by_limit_sorts_before_limiting():
+    """QuerySelector orders the chunk BEFORE offset/limit
+    (QuerySelector.java:192-198), store queries included: without a
+    primary key all three rows survive, and limit 2 must return the two
+    SMALLEST prices {55.6, 57.6}, not the first two by insertion order."""
+    m, rt = _stock_rt()
+    ev = rt.query("from StockTable on volume > 10 "
+                  "select symbol, price, volume order by price limit 2")
+    assert len(ev) == 2
+    assert round(float(ev[0].data[1]), 4) == 55.6
+    assert round(float(ev[1].data[1]), 4) == 57.6
     m.shutdown()
 
 

@@ -400,3 +400,38 @@ def test_incremental_on_empty_base_is_a_full_snapshot():
     rows = rt2.query("from T select symbol, price")
     assert [e.data[1] for e in rows] == [1.0]
     m2.shutdown()
+
+
+def test_restore_resets_nfa_high_water_marks():
+    """Rolling back to a revision captured BEFORE any event must clear
+    the NFA runtime's host high-water-mark mirror: stale post-snapshot
+    HWMs would permanently classify every later batch as hard (generic
+    fallback, fast kernel never used) and feed ``expire_to`` clocks from
+    the abandoned timeline (ADVICE r05 low finding)."""
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('nfaHwmApp')
+        define stream A (sym string, v double);
+        define stream B (sym string, v double);
+        @info(name='p')
+        from every e1=A -> e2=B[e2.v > e1.v] within 2 sec
+        select e1.sym as sym, e2.v as v insert into M;
+    """)
+    c = Collector()
+    rt.add_callback("M", c)
+    rev = rt.persist()          # checkpoint before any event: no nfa_hwm
+    ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+    ha.send(1_000, ["K", 1.0])
+    hb.send(1_500, ["K", 2.0])
+    q = rt.query_runtimes["p"]
+    assert q._nfa_hwm_arr is not None       # host mirror advanced
+    assert len(c.events) == 1
+    rt.restore_revision(rev)
+    assert q._nfa_hwm_arr is None           # rolled back with the state
+    # the restored timeline re-accepts the same (pre-HWM) timestamps
+    ha.send(1_000, ["K", 1.0])
+    hb.send(1_500, ["K", 2.0])
+    m.shutdown()
+    assert len(c.events) == 2
