@@ -159,6 +159,10 @@ class SiddhiAppRuntime:
             v = cm.get_property("siddhi_tpu.cluster_step_timeout")
             if v is not None:
                 self.app_context.cluster_step_timeout = float(v)
+            v = cm.get_property("siddhi_tpu.fuse_fanout")
+            if v is not None:
+                self.app_context.fuse_fanout = str(v).strip().lower() not in (
+                    "0", "false", "off", "no")
 
         # @app:statistics (reference SiddhiStatisticsManager wiring)
         stats_ann = siddhi_app.app_annotation("statistics")
@@ -291,6 +295,14 @@ class SiddhiAppRuntime:
                 sr = create_sink_runtime(ann, sdef, self.app_context, extensions)
                 self.junctions[sid].subscribe(sr)
                 self.sink_runtimes.append(sr)
+
+        # fan-out fusion: contiguous runs of sibling single-stream queries
+        # on one junction fuse into ONE jitted step + ONE __meta__ round
+        # trip per batch (core/plan/fanout_plan.py); opt out with the
+        # app_context.fuse_fanout knob / siddhi_tpu.fuse_fanout config key
+        from siddhi_tpu.core.plan.fanout_plan import plan_fanout_groups
+
+        self.fused_fanout_groups: List = plan_fanout_groups(self)
 
     # ------------------------------------------------------------ assembly
 
@@ -798,6 +810,11 @@ class SiddhiAppRuntime:
         from siddhi_tpu.core.debugger import SiddhiDebugger
 
         if getattr(self, "_debugger", None) is None:
+            # breakpoints instrument per-runtime delivery methods, which a
+            # fused group bypasses — debugging runs unfused
+            for g in list(self.fused_fanout_groups):
+                g.dissolve()
+            self.fused_fanout_groups = []
             self._debugger = SiddhiDebugger(self)
         return self._debugger
 

@@ -182,6 +182,12 @@ class SiddhiAppContext:
         # fold window evictions into invertible aggregator deltas where the
         # query shape allows (ops/fused_agg.py); off = always-generic path
         self.enable_fusion = True
+        # fan-out fusion: sibling single-stream queries on one junction
+        # compile into ONE jitted step with ONE combined __meta__ pull per
+        # batch (core/plan/fanout_plan.py + core/query/fused_fanout.py).
+        # Off = every query keeps its own dispatch. Set via ConfigManager
+        # key siddhi_tpu.fuse_fanout.
+        self.fuse_fanout = True
         # resilience subsystem attach points (siddhi_tpu/resilience/):
         # bounded ingest replay log + app supervisor, set by
         # SiddhiAppRuntime.enable_wal() / .supervise()

@@ -1,0 +1,103 @@
+"""Fan-out fusion planning: which sibling queries of one junction may fuse.
+
+Multi-query sharing over a common scan is the classic fan-out
+amortization (PAPERS.md: "On the Semantic Overlap of Operators in Stream
+Processing Engines"); here the shared scan is the junction's packed
+columnar batch and the shared computation is ONE ``jax.jit`` step
+covering every sibling query (``core/query/fused_fanout.py``) — N
+queries subscribed to one stream pay one device dispatch and one
+``__meta__`` round trip per batch instead of N of each. This module
+decides WHICH subscribers may join a fused group; everything else keeps
+its own ``QueryRuntime`` delivery unchanged.
+
+Eligibility (``fusion_ineligibility`` returns the reason for the first
+miss, or None):
+
+- a plain single-stream ``QueryRuntime`` — joins and patterns subscribe
+  proxy receivers, never the runtime itself, so they are excluded by
+  construction; the explicit type check also excludes their runtimes'
+  subclasses defensively;
+- not partitioned (per-key flows carry pk protocol the group does not);
+- device-only: no host window, no host-side transform chain, no #log
+  taps (all three run host stages per member between pack and step);
+- no scheduler-driven window (time/timeBatch/... windows need their
+  per-batch ``__notify__`` handled through their own timer re-entry);
+- not already sharded over a mesh (``parallel/mesh.py`` owns that step;
+  sharding an already-fused member releases it from its group).
+
+Groups are formed from CONTIGUOUS runs of eligible receivers, so
+delivery order relative to every other subscriber (stream callbacks,
+sinks, aggregations) is exactly the unfused subscription order, and the
+members of one group emit in their subscription order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from siddhi_tpu.query_api.expressions import Variable
+
+
+def fusion_ineligibility(q) -> Optional[str]:
+    """Why ``q`` cannot join a fused fan-out group (None = eligible)."""
+    from siddhi_tpu.core.query.runtime import QueryRuntime
+
+    if type(q) is not QueryRuntime:
+        return f"not a plain single-stream runtime ({type(q).__name__})"
+    if q.partition_ctx is not None:
+        return "partitioned"
+    if q.host_window is not None:
+        return "host-mode window"
+    if q.host_transforms:
+        return "host-side transform chain"
+    if q.log_stages:
+        return "#log() host taps"
+    if q.window_stage is not None and getattr(
+            q.window_stage, "needs_scheduler", False):
+        return "scheduler-driven window"
+    if q._shard_mesh is not None:
+        return "sharded over a mesh"
+    return None
+
+
+def keyer_signature(q) -> Optional[Tuple]:
+    """Identity of a query's group-key computation, used to deduplicate
+    ``GroupKeyer`` work inside a fused group (the common ``group by
+    symbol`` fan-out runs the keyer ONCE for the whole group). Only plain
+    attribute references are comparable; anything else returns None
+    (= never share)."""
+    if q.keyer is None:
+        return ()
+    sig = []
+    for var in q.selector_plan.group_key_exprs:
+        if type(var) is not Variable:
+            return None
+        sig.append((var.attribute_name, var.stream_id))
+    return tuple(sig)
+
+
+def plan_fanout_groups(app_runtime) -> List:
+    """Group each junction's contiguous runs of eligible sibling queries
+    into ``FusedFanoutRuntime``s (wired in place of the members in the
+    junction's receiver list). Returns the groups; respects the
+    ``app_context.fuse_fanout`` opt-out knob."""
+    from siddhi_tpu.core.query.fused_fanout import FusedFanoutRuntime
+
+    groups: List = []
+    if not getattr(app_runtime.app_context, "fuse_fanout", True):
+        return groups
+    for junction in app_runtime.junctions.values():
+        run: List = []
+
+        def close_run(j=None):
+            if len(run) >= 2:
+                groups.append(FusedFanoutRuntime(j, list(run)))
+            run.clear()
+
+        for r in list(junction.receivers):
+            if fusion_ineligibility(r) is None:
+                run.append(r)
+            else:
+                close_run(junction)
+        close_run(junction)
+    return groups

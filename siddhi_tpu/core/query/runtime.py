@@ -382,11 +382,7 @@ class QueryRuntime(Receiver):
         objects on ingest."""
         if self.carried_pk and PK_KEY not in batch.cols:
             batch.cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
-        # a re-published batch omits '?' masks for never-null outputs;
-        # window buffers key off the full col-spec set, so backfill
-        for a in self.input_definition.attributes:
-            if a.name in batch.cols and a.name + "?" not in batch.cols:
-                batch.cols[a.name + "?"] = np.zeros(batch.capacity, bool)
+        backfill_null_masks(batch, self.input_definition)
         self.process_batch(batch)
 
     _now_override = None   # timer chunks sweep at their scheduled time
@@ -559,20 +555,26 @@ class QueryRuntime(Receiver):
                 self.app_context.telemetry.record_jit(
                     getattr(self._step, "_key", f"query.{self.name}.step"),
                     hit=True)
-            knob = (
-                "app_context.partition_window_capacity"
-                if self.partition_ctx is not None
-                else "app_context.window_capacity"
-            )
-            if any(s.kind == "distinctcount"
-                   for s in self.selector_plan.specs or []):
-                knob += " (or app_context.distinct_values_capacity)"
             notify = self._finish_device_batch(
-                self._step, cols, f"window buffer capacity exceeded — raise {knob}")
+                self._step, cols, self.overflow_knob_msg())
         if notify_host is not None:
             notify = notify_host if notify is None else min(notify, notify_host)
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self.process_timer)
+
+    def overflow_knob_msg(self) -> str:
+        """Capacity-overflow message naming THIS query's knob — shared by
+        the unfused path and the fused fan-out group
+        (``core/query/fused_fanout.py``) so attribution cannot drift."""
+        knob = (
+            "app_context.partition_window_capacity"
+            if self.partition_ctx is not None
+            else "app_context.window_capacity"
+        )
+        if any(s.kind == "distinctcount"
+               for s in self.selector_plan.specs or []):
+            knob += " (or app_context.distinct_values_capacity)"
+        return f"window buffer capacity exceeded — raise {knob}"
 
     def _host_keyed_select(self, out_host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Split-pipeline tail: when the group key is computed from a device
@@ -730,12 +732,16 @@ class QueryRuntime(Receiver):
             return
         for col in self.selector_plan.uuid_cols:
             # uuid(): fresh per-row UUID strings, filled host-side (the
-            # jitted step emitted placeholders — see ops/expressions.py)
+            # jitted step emitted placeholders — see ops/expressions.py);
+            # generated up front and bulk-encoded in one dictionary pass
             import uuid as _uuid
 
             vals = np.asarray(out.cols[col]).copy()
-            for i in np.nonzero(np.asarray(out.cols[VALID_KEY]))[0]:
-                vals[i] = self.dictionary.encode(str(_uuid.uuid4()))
+            idx = np.nonzero(np.asarray(out.cols[VALID_KEY]))[0]
+            if idx.size:
+                fresh = np.array([str(_uuid.uuid4()) for _ in range(idx.size)],
+                                 dtype=object)
+                vals[idx] = self.dictionary.encode_array(fresh)
             out.cols[col] = vals
         from siddhi_tpu.core.query.ratelimit import PassThroughRateLimiter
 
@@ -796,6 +802,17 @@ class QueryRuntime(Receiver):
             in_events = [e for e in events if not e.is_expired] or None
             remove_events = [e for e in events if e.is_expired] or None
             cb.receive(events[0].timestamp, in_events, remove_events)
+
+
+def backfill_null_masks(batch: HostBatch, definition) -> None:
+    """A re-published batch omits '?' masks for never-null outputs;
+    window buffers key off the full col-spec set, so backfill. Shared by
+    the unfused and fused receive_batch paths — the capacity read skips
+    ``__getitem__`` so device-held columns stay unpulled."""
+    cap = dict.__getitem__(batch.cols, VALID_KEY).shape[0]
+    for a in definition.attributes:
+        if a.name in batch.cols and a.name + "?" not in batch.cols:
+            batch.cols[a.name + "?"] = np.zeros(cap, bool)
 
 
 def pack_meta(out: dict) -> dict:

@@ -87,6 +87,16 @@ class StreamJunction:
         if receiver not in self.receivers:
             self.receivers.append(receiver)
 
+    def replace_receivers(self, members: List[Receiver], group: Receiver):
+        """Swap a contiguous run of subscribed receivers for ONE fused
+        receiver at the run's position (fan-out fusion,
+        ``core/plan/fanout_plan.py``) — every other subscriber keeps its
+        delivery slot, so callback/sink ordering is unchanged."""
+        i = self.receivers.index(members[0])
+        for m in members:
+            self.receivers.remove(m)
+        self.receivers.insert(i, group)
+
     def enable_async(self, buffer_size: int = 1024, batch_size: int = 256,
                      max_delay_ms: Optional[float] = None,
                      latency_target_ms: Optional[float] = None):
@@ -391,12 +401,7 @@ class StreamJunction:
             self._fatal = e
             raise e
         if self.on_error_action == "STREAM" and self.fault_junction is not None:
-            # fault stream schema = original attrs + _error (reference
-            # FaultStreamEventConverter)
-            fault_events = [
-                Event(timestamp=ev.timestamp, data=list(ev.data) + [str(e)]) for ev in events
-            ]
-            self.fault_junction.send_events(fault_events)
+            self.route_fault_events(events, e)
         else:
             # default/LOG action: log and DROP — the reference's
             # StreamJunction never propagates processing errors back to
@@ -405,3 +410,14 @@ class StreamJunction:
                 "error processing events in stream '%s': %s\n%s",
                 self.definition.id, e, traceback.format_exc(),
             )
+
+    def route_fault_events(self, events: List[Event], e: Exception):
+        """Publish ``events`` + error to the '!stream' fault junction —
+        fault stream schema = original attrs + _error (reference
+        FaultStreamEventConverter). The tail of ``handle_error``'s STREAM
+        action, also used directly by receivers that do their own
+        per-member attribution (fused fan-out groups)."""
+        self.fault_junction.send_events([
+            Event(timestamp=ev.timestamp, data=list(ev.data) + [str(e)])
+            for ev in events
+        ])

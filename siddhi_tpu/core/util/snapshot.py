@@ -248,9 +248,19 @@ class SnapshotService:
                 q._win_keys = qsnap["win_keys"]
                 q._state = _to_device(qsnap["state"]) if qsnap["state"] is not None else None
                 if q.keyer is not None and qsnap["keyer_map"] is not None:
-                    q.keyer._map = dict(qsnap["keyer_map"])
-                    q.keyer._next = max(q.keyer._map.values(), default=-1) + 1
-                    q.keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
+                    # write into the member's OWN keyer: a fused fan-out
+                    # group may have aliased q.keyer to a sibling's
+                    # (identical-computation dedup), and a restored
+                    # snapshot can carry divergent per-member maps — the
+                    # group re-derives sharing below (on_restore)
+                    keyer = getattr(q, "_own_keyer", None)
+                    if keyer is None:   # explicit: an empty keyer is falsy
+                        keyer = q.keyer
+                    keyer._map = dict(qsnap["keyer_map"])
+                    keyer._next = max(keyer._map.values(), default=-1) + 1
+                    keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
+                    if keyer is not q.keyer:
+                        q.keyer = keyer
                 if q.host_window is not None and qsnap.get("host_window") is not None:
                     q.host_window.restore(qsnap["host_window"])
                 if hasattr(q, "_nfa_hwm_arr"):
@@ -265,6 +275,11 @@ class SnapshotService:
                 q._step = None
                 if hasattr(q, "_steps"):
                     q._steps.clear()
+
+        # fused fan-out groups: re-derive keyer sharing from the restored
+        # maps and drop the compiled fused step (key capacities changed)
+        for g in getattr(rt, "fused_fanout_groups", ()) or ():
+            g.on_restore()
 
         for tid, tsnap in obj.get("tables", {}).items():
             t = rt.tables.get(tid)

@@ -42,6 +42,9 @@ _JUNCTION_GAUGE = re.compile(r"^junction\.(?P<stream>.+)\.(?P<kind>"
                              r"queue_depth|inflight_batches)$")
 _JUNCTION_STALLS = re.compile(r"^junction\.(?P<stream>.+)"
                               r"\.backpressure_stalls$")
+_FANOUT_GAUGE = re.compile(r"^fanout\.(?P<stream>.+)\.group_size$")
+_FANOUT_COUNTER = re.compile(r"^fanout\.(?P<stream>.+)\.(?P<kind>"
+                             r"dispatches|meta_pulls)$")
 
 
 def _esc(v: str) -> str:
@@ -113,18 +116,34 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                       else "@Async junction in-flight delivery units"),
                      {**base, "stream": m.group("stream")}, v)
         else:
-            fams.add("siddhi_gauge", "gauge", "registered telemetry gauge",
-                     {**base, "name": name}, v)
+            m = _FANOUT_GAUGE.match(name)
+            if m:
+                fams.add("siddhi_fanout_group_size", "gauge",
+                         "queries fused into one dispatch per stream batch",
+                         {**base, "stream": m.group("stream")}, v)
+            else:
+                fams.add("siddhi_gauge", "gauge",
+                         "registered telemetry gauge",
+                         {**base, "name": name}, v)
     for name, v in sorted(tel_snapshot.get("counters", {}).items()):
         m = _JUNCTION_STALLS.match(name)
         if m:
             fams.add("siddhi_junction_backpressure_stalls_total", "counter",
                      "producer sends that blocked on a full @Async queue",
                      {**base, "stream": m.group("stream")}, v)
-        else:
-            fams.add("siddhi_counter_total", "counter",
-                     "named event counter",
-                     {**base, "name": name}, v)
+            continue
+        m = _FANOUT_COUNTER.match(name)
+        if m:
+            fams.add(f"siddhi_fanout_{m.group('kind')}_total", "counter",
+                     ("fused fan-out device dispatches (one per group "
+                      "per stream batch)"
+                      if m.group("kind") == "dispatches"
+                      else "fused fan-out combined __meta__ round trips"),
+                     {**base, "stream": m.group("stream")}, v)
+            continue
+        fams.add("siddhi_counter_total", "counter",
+                 "named event counter",
+                 {**base, "name": name}, v)
     for key, rec in sorted(tel_snapshot.get("jit", {}).items()):
         kl = {**base, "key": key}
         fams.add("siddhi_jit_compiles_total", "counter",

@@ -125,6 +125,16 @@ def batch_shardings(cols, mesh: Mesh):
     return jax.tree_util.tree_map(one, cols)
 
 
+def _release_from_fanout(runtime):
+    """A sharded step owns the runtime's dispatch: a fused fan-out group
+    (core/query/fused_fanout.py) would keep stepping the member through
+    its pre-sharding fused computation, so hand the member back its own
+    junction subscription before wiring the sharded jit."""
+    group = getattr(runtime, "_fanout_group", None)
+    if group is not None:
+        group.release(runtime)
+
+
 def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
     """Jit a QueryRuntime's step with its keyed state sharded over ``mesh``.
 
@@ -134,6 +144,7 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
     all-to-all off the hot path). For B-sharded ingestion use
     ``batch_shardings`` explicitly.
     """
+    _release_from_fanout(runtime)
     num_keys = runtime.selector_plan.num_keys
     if runtime._state is None:
         runtime._state = runtime._init_state()
@@ -260,6 +271,7 @@ def shard_keyed_query_step(runtime, mesh: Mesh, rows_per_shard: int):
     ``[n, 3]`` — one (overflow, notify, count) row per shard."""
     from jax.experimental.shard_map import shard_map
 
+    _release_from_fanout(runtime)
     n = mesh.devices.size
     localK = runtime.selector_plan.num_keys
     local_win = getattr(runtime, "_win_keys", 1)

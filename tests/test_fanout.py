@@ -1,0 +1,429 @@
+"""Fan-out fusion: multi-query-per-stream semantics (ISSUE 4).
+
+Covers the fused group's contract against the unfused reference path:
+subscription-order delivery, per-receiver column-mutation isolation (the
+``_deliver_batch`` per-receiver dict wrapper), fused == unfused outputs
+(exact precision on CPU), the one-dispatch/one-meta-pull amortization
+asserted via telemetry, per-member overflow attribution and fault-stream
+routing, and snapshot/restore round trips across a fusion-config change.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
+from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+
+class Collector(StreamCallback):
+    def __init__(self, log=None, tag=None):
+        self.events = []
+        self._log = log
+        self._tag = tag
+
+    def receive(self, events):
+        self.events.extend(events)
+        if self._log is not None:
+            self._log.extend((self._tag, tuple(e.data)) for e in events)
+
+
+def _manager(fused: bool) -> SiddhiManager:
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.fuse_fanout": "1" if fused else "0"}))
+    return m
+
+
+_FOUR_QUERY_APP = """
+define stream S (symbol string, price float, volume long);
+@info(name='q0') from S[price > 10.0] select symbol, price insert into O0;
+@info(name='q1') from S#window.length(4)
+  select symbol, sum(volume) as tv group by symbol insert into O1;
+@info(name='q2') from S select symbol, volume * 2 as v2 insert into O2;
+@info(name='q3') from S#window.lengthBatch(2)
+  select symbol, avg(price) as ap group by symbol insert into O3;
+"""
+
+
+def _drive(rt):
+    h = rt.get_input_handler("S")
+    h.send(100, ["IBM", 15.0, 10])
+    h.send(101, ["WSO2", 5.0, 20])
+    h.send_columns(
+        {"symbol": np.array(["IBM", "GOOG", "WSO2", "IBM"], dtype=object),
+         "price": np.array([30.0, 11.0, 2.0, 7.5], np.float32),
+         "volume": np.array([1, 2, 3, 4], np.int64)},
+        timestamps=np.array([102, 103, 104, 105], np.int64))
+    h.send(106, ["GOOG", 50.0, 7])
+
+
+def _collect_all(rt, streams):
+    outs = {}
+    for s in streams:
+        outs[s] = Collector()
+        rt.add_callback(s, outs[s])
+    return outs
+
+
+def test_fused_equals_unfused_outputs():
+    results = {}
+    for fused in (True, False):
+        m = _manager(fused)
+        rt = m.create_siddhi_app_runtime(_FOUR_QUERY_APP)
+        outs = _collect_all(rt, ["O0", "O1", "O2", "O3"])
+        if fused:
+            assert [(g.stream_id, len(g.members))
+                    for g in rt.fused_fanout_groups] == [("S", 4)]
+        else:
+            assert rt.fused_fanout_groups == []
+        _drive(rt)
+        results[fused] = {
+            s: [(e.timestamp, tuple(e.data)) for e in c.events]
+            for s, c in outs.items()}
+        m.shutdown()
+    assert results[True] == results[False]
+
+
+def test_single_dispatch_and_meta_pull_per_batch():
+    m = _manager(True)
+    rt = m.create_siddhi_app_runtime(_FOUR_QUERY_APP)
+    _collect_all(rt, ["O0", "O1", "O2", "O3"])
+    h = rt.get_input_handler("S")
+    h.send(100, ["IBM", 15.0, 10])      # warm: builds + compiles the step
+    tel = rt.app_context.telemetry
+    base = tel.snapshot()
+    for i in range(3):
+        h.send(101 + i, ["IBM", 15.0, 10])
+    snap = tel.snapshot()
+    # exactly ONE jitted dispatch and ONE meta pull per junction batch
+    assert snap["counters"]["fanout.S.dispatches"] \
+        - base["counters"]["fanout.S.dispatches"] == 3
+    assert snap["counters"]["fanout.S.meta_pulls"] \
+        - base["counters"]["fanout.S.meta_pulls"] == 3
+    rec = snap["jit"]["fanout.S.step"]
+    assert rec["compiles"] == 1
+    # member hit-counting: 4 query-batches amortized per dispatch
+    assert rec["hits"] - base["jit"]["fanout.S.step"]["hits"] == 3 * 4
+    # no member compiled (or dispatched) its own step
+    assert not any(k.startswith("query.") for k in snap["jit"])
+    assert snap["gauges"]["fanout.S.group_size"] == 4
+    m.shutdown()
+
+
+def test_subscription_order_delivery():
+    for fused in (True, False):
+        m = _manager(fused)
+        rt = m.create_siddhi_app_runtime("""
+            define stream S (v long);
+            @info(name='qa') from S select v insert into OA;
+            @info(name='qb') from S select v + 1 as v insert into OB;
+            @info(name='qc') from S select v + 2 as v insert into OC;
+        """)
+        log = []
+        for tag, s in (("a", "OA"), ("b", "OB"), ("c", "OC")):
+            rt.add_callback(s, Collector(log=log, tag=tag))
+        h = rt.get_input_handler("S")
+        h.send(1, [10])
+        h.send(2, [20])
+        assert [t for t, _d in log] == ["a", "b", "c", "a", "b", "c"], fused
+        m.shutdown()
+
+
+def test_receiver_column_mutation_isolation():
+    """Regression for the ``_deliver_batch`` per-receiver dict wrapper: a
+    receiver rebinding a column in its batch dict must not leak the
+    mutation into later receivers' deliveries."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("define stream S (v long);")
+
+    seen = []
+
+    class Mutator(Receiver):
+        def receive(self, events):  # pragma: no cover — batch path only
+            raise AssertionError("columnar path expected")
+
+        def receive_batch(self, batch, junction):
+            batch.cols["v"] = np.zeros_like(np.asarray(batch.cols["v"]))
+            batch.cols["__extra__"] = np.ones(1)
+
+    class Witness(Receiver):
+        def receive_batch(self, batch, junction):
+            seen.append((np.asarray(batch.cols["v"]).copy(),
+                         "__extra__" in batch.cols))
+
+    j = rt.junctions["S"]
+    j.subscribe(Mutator())
+    j.subscribe(Witness())
+    h = rt.get_input_handler("S")
+    h.send_columns({"v": np.array([7, 8, 9], np.int64)},
+                   timestamps=np.array([1, 2, 3], np.int64))
+    assert len(seen) == 1
+    vals, extra_leaked = seen[0]
+    assert vals[:3].tolist() == [7, 8, 9]
+    assert not extra_leaked
+    m.shutdown()
+
+
+def test_mixed_eligibility_groups_contiguous_run():
+    m = _manager(True)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, v long, ts long);
+        @info(name='q0') from S select symbol, v insert into O0;
+        @info(name='q1') from S#window.time(1 sec)
+          select symbol, sum(v) as sv insert into O1;
+        @info(name='q2') from S select symbol, v + 1 as v insert into O2;
+        @info(name='q3') from S[v > 0] select symbol, v insert into O3;
+    """)
+    # q1's time window is scheduler-driven -> ineligible; it splits the
+    # receiver list into [q0] (too short) and [q2, q3] (fused)
+    groups = rt.fused_fanout_groups
+    assert len(groups) == 1
+    assert [q.name for q in groups[0].members] == ["q2", "q3"]
+    outs = _collect_all(rt, ["O0", "O1", "O2", "O3"])
+    h = rt.get_input_handler("S")
+    h.send(1000, ["IBM", 5, 1000])
+    assert [tuple(e.data) for e in outs["O0"].events] == [("IBM", 5)]
+    assert [tuple(e.data) for e in outs["O2"].events] == [("IBM", 6)]
+    assert [tuple(e.data) for e in outs["O3"].events] == [("IBM", 5)]
+    m.shutdown()
+
+
+def test_fuse_fanout_opt_out_knob():
+    m = _manager(False)
+    rt = m.create_siddhi_app_runtime(_FOUR_QUERY_APP)
+    assert rt.fused_fanout_groups == []
+    m.shutdown()
+
+
+_OVERFLOW_APP = """
+@OnError(action='stream')
+define stream S (symbol string, v long, ts long);
+@info(name='q_ok') from S select symbol, v insert into OK;
+@info(name='q_over') from S#window.externalTime(ts, 10 sec)
+  select symbol, sum(v) as sv insert into OV;
+@info(name='q_ok2') from S select symbol, v + 1 as v insert into OK2;
+"""
+
+
+def _overflow_manager():
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.fuse_fanout": "1", "siddhi_tpu.window_capacity": "8"}))
+    return m
+
+
+def test_fused_overflow_names_query_and_routes_fault_stream():
+    m = _overflow_manager()
+    rt = m.create_siddhi_app_runtime(_OVERFLOW_APP)
+    assert len(rt.fused_fanout_groups[0].members) == 3
+    ok, ok2, faults = Collector(), Collector(), Collector()
+    rt.add_callback("OK", ok)
+    rt.add_callback("OK2", ok2)
+    rt.add_callback("!S", faults)
+    h = rt.get_input_handler("S")
+    n = 16   # > capacity 8, all within the 10 s horizon: q_over overflows
+    h.send_columns(
+        {"symbol": np.array(["A"] * n, dtype=object),
+         "v": np.arange(n, dtype=np.int64),
+         "ts": np.full(n, 1000, np.int64)},
+        timestamps=np.full(n, 1000, np.int64))
+    # only q_over's failure routed to the fault stream, naming its knob
+    assert len(faults.events) == n
+    err = faults.events[0].data[-1]
+    assert "q_over" in err and "window_capacity" in err
+    # the sibling members' outputs for the SAME batch are unaffected
+    assert len(ok.events) == n
+    assert len(ok2.events) == n
+    assert [e.data[1] for e in ok2.events] == list(range(1, n + 1))
+    m.shutdown()
+
+
+def test_fused_overflow_propagates_without_fault_stream():
+    m = _overflow_manager()
+    rt = m.create_siddhi_app_runtime(
+        _OVERFLOW_APP.replace("@OnError(action='stream')\n", ""))
+    ok = Collector()
+    rt.add_callback("OK", ok)
+    h = rt.get_input_handler("S")
+    n = 16
+    with pytest.raises(FatalQueryError, match=r"q_over.*window_capacity"):
+        h.send_columns(
+            {"symbol": np.array(["A"] * n, dtype=object),
+             "v": np.arange(n, dtype=np.int64),
+             "ts": np.full(n, 1000, np.int64)},
+            timestamps=np.full(n, 1000, np.int64))
+    # siblings emitted before the fatal surfaced to the sender
+    assert len(ok.events) == n
+    m.shutdown()
+
+
+_SNAP_APP = """
+@app:name('FanSnap')
+define stream S (symbol string, v long);
+@info(name='qs0') from S#window.length(4)
+  select symbol, sum(v) as sv group by symbol insert into OS0;
+@info(name='qs1') from S#window.length(2)
+  select symbol, max(v) as mv group by symbol insert into OS1;
+"""
+
+
+def _feed(h, lo, hi):
+    for i in range(lo, hi):
+        h.send(1000 + i, [f"K{i % 3}", i])
+
+
+@pytest.mark.parametrize("fused_before,fused_after",
+                         [(True, False), (False, True), (True, True)])
+def test_snapshot_restores_across_fusion_config_change(fused_before,
+                                                       fused_after):
+    # reference run: uninterrupted, unfused
+    m_ref = _manager(False)
+    rt_ref = m_ref.create_siddhi_app_runtime(_SNAP_APP)
+    ref = _collect_all(rt_ref, ["OS0", "OS1"])
+    h = rt_ref.get_input_handler("S")
+    _feed(h, 0, 6)
+    _feed(h, 6, 12)
+    expect = {s: [(e.timestamp, tuple(e.data)) for e in c.events]
+              for s, c in ref.items()}
+    m_ref.shutdown()
+
+    m1 = _manager(fused_before)
+    rt1 = m1.create_siddhi_app_runtime(_SNAP_APP)
+    outs1 = _collect_all(rt1, ["OS0", "OS1"])
+    _feed(rt1.get_input_handler("S"), 0, 6)
+    head = {s: [(e.timestamp, tuple(e.data)) for e in c.events]
+            for s, c in outs1.items()}
+    snap = rt1.snapshot()
+    m1.shutdown()
+
+    m2 = _manager(fused_after)
+    rt2 = m2.create_siddhi_app_runtime(_SNAP_APP)
+    outs2 = _collect_all(rt2, ["OS0", "OS1"])
+    rt2.restore(snap)
+    _feed(rt2.get_input_handler("S"), 6, 12)
+    tail = {s: [(e.timestamp, tuple(e.data)) for e in c.events]
+            for s, c in outs2.items()}
+    m2.shutdown()
+
+    for s in expect:
+        assert head[s] + tail[s] == expect[s], (s, fused_before, fused_after)
+
+
+def test_identical_program_dedup_cluster():
+    """Members with provably identical step programs (and states) run as
+    ONE computation in the fused module; a differing sibling keeps its
+    own — outputs stay per-member and match the unfused path."""
+    app = """
+    define stream S (symbol string, v long);
+    @info(name='t0') from S#window.length(4)
+      select symbol, sum(v) as sv group by symbol insert into T0;
+    @info(name='t1') from S#window.length(4)
+      select symbol, sum(v) as sv group by symbol insert into T1;
+    @info(name='t2') from S#window.length(4)
+      select symbol, sum(v) as sv group by symbol insert into T2;
+    @info(name='t3') from S[v > 2]
+      select symbol, v insert into T3;
+    """
+    results = {}
+    for fused in (True, False):
+        m = _manager(fused)
+        rt = m.create_siddhi_app_runtime(app)
+        outs = _collect_all(rt, ["T0", "T1", "T2", "T3"])
+        h = rt.get_input_handler("S")
+        _feed(h, 0, 8)
+        if fused:
+            (group,) = rt.fused_fanout_groups
+            # t0/t1/t2 dedup into one cluster; t3 is its own
+            assert [len(c) for c in group._clusters] == [3, 1]
+            # cluster members share the (immutable) state arrays
+            q0, q1 = rt.query_runtimes["t0"], rt.query_runtimes["t1"]
+            assert q0._state is q1._state
+            # snapshot keys stay per-query; restore round-trips
+            snap = rt.snapshot()
+            rt.restore(snap)
+            _feed(h, 8, 12)
+        else:
+            _feed(h, 8, 12)
+        results[fused] = {
+            s: [(e.timestamp, tuple(e.data)) for e in c.events]
+            for s, c in outs.items()}
+        m.shutdown()
+    assert results[True] == results[False]
+    # sanity: the three identical queries really got identical outputs
+    assert results[True]["T0"] == results[True]["T1"]
+
+
+def test_release_middle_member_preserves_subscription_order():
+    """Releasing a MIDDLE member dissolves the group: the fused slot
+    cannot keep the released member between its former siblings, and
+    subscription-order delivery outranks keeping the fusion."""
+    m = _manager(True)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v long);
+        @info(name='r0') from S select v insert into R0;
+        @info(name='r1') from S select v + 1 as v insert into R1;
+        @info(name='r2') from S select v + 2 as v insert into R2;
+    """)
+    (group,) = rt.fused_fanout_groups
+    log = []
+    for tag, s in (("r0", "R0"), ("r1", "R1"), ("r2", "R2")):
+        rt.add_callback(s, Collector(log=log, tag=tag))
+    group.release(rt.query_runtimes["r1"])
+    assert group.members == []          # dissolved, not reordered
+    j = rt.junctions["S"]
+    names = [getattr(r, "name", None) for r in j.receivers]
+    assert names[:3] == ["r0", "r1", "r2"]
+    rt.get_input_handler("S").send(1, [10])
+    assert [t for t, _d in log] == ["r0", "r1", "r2"]
+    m.shutdown()
+
+
+def test_two_groups_one_stream_gauges_aggregate():
+    """An ineligible receiver mid-run splits one stream into two fused
+    groups; the per-stream gauges aggregate over both, and dissolving
+    one group must not delete the survivor's metric surface."""
+    m = _manager(True)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v long, ts long);
+        @info(name='g0') from S select v insert into A0;
+        @info(name='g1') from S select v + 1 as v insert into A1;
+        @info(name='mid') from S#window.time(1 sec)
+          select sum(v) as sv insert into AM;
+        @info(name='g2') from S select v + 2 as v insert into A2;
+        @info(name='g3') from S select v + 3 as v insert into A3;
+    """)
+    groups = rt.fused_fanout_groups
+    assert [[q.name for q in g.members] for g in groups] == \
+        [["g0", "g1"], ["g2", "g3"]]
+    tel = rt.app_context.telemetry
+    assert tel.read_gauges()["fanout.S.group_size"] == 4
+    groups[0].dissolve()
+    gauges = tel.read_gauges()
+    assert gauges["fanout.S.group_size"] == 2      # survivor still scraped
+    groups[1].dissolve()
+    assert "fanout.S.group_size" not in tel.read_gauges()
+    m.shutdown()
+
+
+def test_group_release_and_dissolve():
+    m = _manager(True)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v long);
+        @info(name='qa') from S select v insert into OA;
+        @info(name='qb') from S select v + 1 as v insert into OB;
+    """)
+    (group,) = rt.fused_fanout_groups
+    qa = rt.query_runtimes["qa"]
+    outs = _collect_all(rt, ["OA", "OB"])
+    h = rt.get_input_handler("S")
+    h.send(1, [10])
+    group.release(qa)      # drops below two members -> dissolves entirely
+    assert group.members == []
+    assert qa._fanout_group is None
+    j = rt.junctions["S"]
+    assert group not in j.receivers
+    h.send(2, [20])        # both members back on their own subscriptions
+    assert [e.data[0] for e in outs["OA"].events] == [10, 20]
+    assert [e.data[0] for e in outs["OB"].events] == [11, 21]
+    m.shutdown()

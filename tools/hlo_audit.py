@@ -109,6 +109,33 @@ def main():
     report["gspmd_replicated_batch"] = _count_collectives(hlo1)
     m1.shutdown()
 
+    # ---- fan-out fusion: a fused 3-query group must lower to ONE module
+    _FANOUT_APP = """
+define stream StockStream (symbol string, price float, volume long);
+@info(name='f0') from StockStream[price > 10.0]
+  select symbol, price insert into Out0;
+@info(name='f1') from StockStream#window.length({W})
+  select symbol, avg(price) as avgPrice group by symbol insert into Out1;
+@info(name='f2') from StockStream
+  select symbol, volume insert into Out2;
+""".format(W=WINDOW)
+    mf = SiddhiManager()
+    rtf = mf.create_siddhi_app_runtime(_FANOUT_APP)
+    rtf.start()
+    (group,) = rtf.fused_fanout_groups
+    from siddhi_tpu.core.event import HostBatch
+
+    hlo_f = group.lower_hlo_text(HostBatch(_make_batch(rng)))
+    n_modules = hlo_f.count("ENTRY")
+    assert n_modules == 1, (
+        f"fused fan-out group lowered to {n_modules} HLO modules, want 1")
+    report["fused_fanout"] = {
+        "members": len(group.members),
+        "hlo_modules": n_modules,
+        "collectives": _count_collectives(hlo_f),
+    }
+    mf.shutdown()
+
     # ---- round-5 strategy: host-routed batch, shard_map local state
     m2 = SiddhiManager()
     rt2 = m2.create_siddhi_app_runtime(_APP)
