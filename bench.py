@@ -132,16 +132,17 @@ def bench_device():
     return n_events / dt
 
 
-def _make_e2e_runtime(defer_meta: int = 8):
+def _make_e2e_runtime(pipeline_depth: int = 8):
     from siddhi_tpu import SiddhiManager, StreamCallback
     from siddhi_tpu.core.util.config import InMemoryConfigManager
 
     manager = SiddhiManager()
-    # batch N step metas into one device->host round trip (the tunnel
-    # charges ~70ms latency per pull — PERF.md); outputs drain every N
-    # batches and at shutdown
+    # dispatch-pipeline depth (core/query/completion.py; replaces the
+    # deprecated defer_meta hold-N queue): synchronous sends flush per
+    # batch, so depth only engages through @Async producers — kept here
+    # for parity with bench_pipeline_curve's async shape
     manager.set_config_manager(InMemoryConfigManager(
-        {"siddhi_tpu.defer_meta": str(defer_meta)}))
+        {"siddhi_tpu.pipeline_depth": str(pipeline_depth)}))
     rt = manager.create_siddhi_app_runtime(_APP)
 
     class Counter(StreamCallback):
@@ -212,7 +213,7 @@ def bench_e2e():
 
 def bench_e2e_curve():
     """Operating-point curve (VERDICT r04 next #7): e2e throughput AND
-    per-batch p99 at several (batch size, defer_meta) points — the
+    per-batch p99 at several (batch size, pipeline_depth) points — the
     trade-off surface the junction's adaptive batcher navigates
     (junction.py adaptive cap). Runs on whatever backend exists; the
     result record labels the backend (``e2e_curve_backend``), so a
@@ -220,8 +221,8 @@ def bench_e2e_curve():
     rng = np.random.default_rng(7)
     sym_strings = np.array([f"S{i}" for i in range(NUM_KEYS)], dtype=object)
     points = []
-    for B, defer in ((16_384, 1), (16_384, 8), (65_536, 1), (65_536, 8)):
-        manager, rt, Counter = _make_e2e_runtime(defer_meta=defer)
+    for B, depth in ((16_384, 1), (16_384, 8), (65_536, 1), (65_536, 8)):
+        manager, rt, Counter = _make_e2e_runtime(pipeline_depth=depth)
         h = rt.get_input_handler("StockStream")
         warm_sym = sym_strings[np.arange(B, dtype=np.int64) % NUM_KEYS]
         h.send_columns({"symbol": warm_sym,
@@ -252,12 +253,115 @@ def bench_e2e_curve():
         assert Counter.n > 0
         lat = np.sort(np.asarray(lat))
         points.append({
-            "batch": B, "defer_meta": defer,
+            "batch": B, "pipeline_depth": depth,
             "eps": round(n / float(np.sum(lat) / 1000.0), 1),
             "p99_ms": round(float(
                 lat[min(len(lat) - 1, int(len(lat) * 0.99))]), 3),
         })
     return points
+
+
+def bench_pipeline_curve():
+    """Dispatch-pipeline depth curve (ISSUE 5): the bench shape behind an
+    @Async junction — the producer shape where the CompletionPump
+    actually pipelines (the worker delivers back-to-back, so up to D
+    device batches ride in flight while the next batch packs; sync sends
+    flush per batch by design). D=1 is the old synchronous
+    pull-per-batch engine. Records input events/sec send->fully-drained
+    and the pump's metas-per-pull batching ratio per depth.
+
+    On the TPU tunnel the expected win is the PERF.md cost model's
+    ``max(pack, step+pull)`` vs ``pack + step + pull``; on a single-core
+    CPU sandbox there is nothing to overlap with, so the acceptance bar
+    is no-regression (depth-2 >= 0.95x depth-1)."""
+    from siddhi_tpu.core.stream.junction import _NOTHING
+
+    B = int(os.environ.get("BENCH_PIPELINE_BATCH", 8192))
+    app = """
+@Async(buffer.size='64')
+define stream StockStream (symbol string, price float, volume long);
+@info(name = 'bench')
+from StockStream#window.length({W})
+select symbol, avg(price) as avgPrice, sum(volume) as totalVolume
+group by symbol
+insert into OutStream;
+""".format(W=WINDOW)
+    rng = np.random.default_rng(23)
+    sym_strings = np.array([f"S{i}" for i in range(NUM_KEYS)], dtype=object)
+
+    def run_one(depth: int):
+        from siddhi_tpu import SiddhiManager, StreamCallback
+        from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+        manager = SiddhiManager()
+        manager.set_config_manager(InMemoryConfigManager(
+            {"siddhi_tpu.pipeline_depth": str(depth)}))
+        rt = manager.create_siddhi_app_runtime(app)
+
+        class Counter(StreamCallback):
+            n = 0
+
+            def receive_batch(self, batch, junction):
+                Counter.n += batch.size
+
+            def receive(self, events):
+                Counter.n += len(events)
+
+        rt.add_callback("OutStream", Counter())
+        rt.query_runtimes["bench"].selector_plan.num_keys = 16_384
+        rt.start()
+        h = rt.get_input_handler("StockStream")
+        j = rt.junctions["StockStream"]
+        pump = rt.app_context.completion_pump
+
+        def drained() -> bool:
+            return (j._queue.empty() and j._inflight is _NOTHING
+                    and not pump.has_pending)
+
+        pre = []
+        for i in range(4):
+            ids = rng.integers(0, NUM_KEYS, B, dtype=np.int64)
+            pre.append(({
+                "symbol": sym_strings[ids],
+                "price": (rng.random(B) * 100.0).astype(np.float32),
+                "volume": rng.integers(1, 1000, B, dtype=np.int64),
+            }, np.arange(i * B, (i + 1) * B, dtype=np.int64)))
+        warm_sym = sym_strings[np.arange(B, dtype=np.int64) % NUM_KEYS]
+        h.send_columns({"symbol": warm_sym,
+                        "price": np.ones(B, np.float32),
+                        "volume": np.ones(B, np.int64)},
+                       timestamps=np.zeros(B, np.int64))
+        h.send_columns(pre[0][0], timestamps=pre[0][1])
+        deadline = time.perf_counter() + 30.0
+        while not drained() and time.perf_counter() < deadline:
+            time.sleep(0.002)
+
+        t0 = time.perf_counter()
+        n = 0
+        i = 0
+        t_end = t0 + MEASURE_SECONDS / 2
+        while time.perf_counter() < t_end:
+            cols, ts = pre[i % 4]
+            h.send_columns(cols, timestamps=ts)   # blocks only on full queue
+            n += B
+            i += 1
+        deadline = time.perf_counter() + 60.0
+        while not drained() and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        dt = time.perf_counter() - t0
+        tel = rt.app_context.telemetry.snapshot()
+        metas = tel["counters"].get("pipeline.metas", 0)
+        pulls = tel["counters"].get("pipeline.pulls", 0)
+        stalls = tel["counters"].get("pipeline.stalls", 0)
+        manager.shutdown()
+        assert Counter.n > 0
+        return {
+            "depth": depth, "eps": round(n / dt, 1),
+            "metas_per_pull": round(metas / pulls, 2) if pulls else None,
+            "stalls": stalls,
+        }
+
+    return [run_one(d) for d in (1, 2, 4, 8)]
 
 
 def bench_host_pipeline():
@@ -472,10 +576,10 @@ def bench_nfa_p99():
 
     # config #4 holds at most a couple of pending matches per key: 8 slots
     # (vs the 32 default) quarters the [K, S] state and the emission pull;
-    # defer_meta=2 folds the A-batch and B-batch metas into one ~70ms
-    # tunnel round trip per iteration (wait-free plan: safe to defer)
+    # pipeline_depth=2 lets the A-batch and B-batch dispatches ride the
+    # pump back-to-back (completion.py; wait-free NFA plans are eligible)
     manager.set_config_manager(InMemoryConfigManager(
-        {"siddhi_tpu.nfa_slots": "8", "siddhi_tpu.defer_meta": "2"}))
+        {"siddhi_tpu.nfa_slots": "8", "siddhi_tpu.pipeline_depth": "2"}))
     rt = manager.create_siddhi_app_runtime(app)
 
     class Counter(StreamCallback):
@@ -759,10 +863,12 @@ def main():
         "e2e_events_per_sec": None,            # genuine string ingest
         "e2e_preencoded_events_per_sec": None,  # int ids (no dict encode)
         "e2e_cpu_events_per_sec": None,         # string ingest, CPU backend
-        "e2e_curve": None,                      # [(batch, defer, eps, p99)]
+        "e2e_curve": None,                      # [(batch, depth, eps, p99)]
         "e2e_curve_backend": None,
         "fanout_curve": None,                   # fused vs unfused, N queries
         "fanout_backend": None,
+        "pipeline_curve": None,                 # [(depth, eps, metas/pull)]
+        "pipeline_backend": None,
         "host_pipeline_events_per_sec": None,   # device step stubbed
         "ingest_csv_events_per_sec": None,      # native CSV loader -> pump
         "mesh_scaling_eps": None,               # {n_devices: eps}, key-sharded
@@ -788,7 +894,7 @@ def main():
         section timeout marks the tunnel wedged and skips the rest."""
         # a revival re-run supersedes the first attempt's failure tags —
         # drop them so the record can't carry both a result and its failure
-        stale = {"device", "e2e", "nfa", "e2e_curve",
+        stale = {"device", "e2e", "nfa", "e2e_curve", "fanout", "pipeline",
                  "e2e:skipped-wedged-tunnel",
                  "nfa:skipped-wedged-tunnel", "tunnel:probe-dead"}
         result["sections_failed"] = [
@@ -849,6 +955,18 @@ def main():
                 result["sections_failed"].append("fanout")
             emit()
 
+        if not wedged:
+            # the depth curve's overlap term (max(pack, step+pull) vs
+            # pack+step+pull) only exists where the ~70 ms pull toll does
+            # — measure on the live tunnel when it's up
+            out, t_o = _run_section_once("pipeline", min(300.0, remaining()))
+            if out is not None:
+                result["pipeline_curve"] = out["points"]
+                result["pipeline_backend"] = "tpu"
+            else:
+                result["sections_failed"].append("pipeline")
+            emit()
+
     # ---- probe first: a wedged tunnel costs one 30 s probe, not a 300 s
     # section timeout; probe log rides the result line (VERDICT r04 #1)
     probe = _probe_tunnel(min(30.0, remaining()))
@@ -901,6 +1019,17 @@ def main():
             result["fanout_backend"] = "cpu-fallback"
         else:
             result["sections_failed"].append("fanout")
+        emit()
+    if result["pipeline_curve"] is None:
+        # dispatch-pipeline depth curve (ISSUE 5): recorded on whatever
+        # backend exists; on the tunnel the overlap term dominates, on a
+        # single-core CPU it is a no-regression check
+        out, _ = _run_section_once("pipeline_cpu", min(240.0, remaining()))
+        if out is not None:
+            result["pipeline_curve"] = out["points"]
+            result["pipeline_backend"] = "cpu-fallback"
+        else:
+            result["sections_failed"].append("pipeline")
         emit()
     out, _ = _run_section_once("scaling_cpu", min(240.0, remaining()))
     if out is not None:
@@ -968,6 +1097,8 @@ if __name__ == "__main__":
             print(json.dumps({"points": bench_e2e_curve()}))
         elif section == "fanout":
             print(json.dumps({"points": bench_fanout()}))
+        elif section == "pipeline":
+            print(json.dumps({"points": bench_pipeline_curve()}))
         else:
             raise SystemExit(f"unknown section {section}")
     else:

@@ -150,12 +150,16 @@ class SiddhiAppRuntime:
         # deployment config: ConfigManager system keys override the
         # capacity knobs (reference ConfigManager consulted at parse time)
         cm = siddhi_context.config_manager
+        explicit_depth = None
         if cm is not None:
             for knob in ("window_capacity", "partition_window_capacity",
-                         "nfa_slots", "initial_key_capacity", "defer_meta"):
+                         "nfa_slots", "initial_key_capacity", "defer_meta",
+                         "pipeline_depth"):
                 v = cm.get_property(f"siddhi_tpu.{knob}")
                 if v is not None:
                     setattr(self.app_context, knob, int(v))
+                    if knob == "pipeline_depth":
+                        explicit_depth = int(v)
             v = cm.get_property("siddhi_tpu.cluster_step_timeout")
             if v is not None:
                 self.app_context.cluster_step_timeout = float(v)
@@ -163,6 +167,36 @@ class SiddhiAppRuntime:
             if v is not None:
                 self.app_context.fuse_fanout = str(v).strip().lower() not in (
                     "0", "false", "off", "no")
+        if self.app_context.defer_meta > 1:
+            # deprecation shim: the hold-N-then-flush defer queue is
+            # subsumed by the dispatch pipeline (core/query/completion.py)
+            # — same pull batching, no emission lag under trickle, joins/
+            # scheduler windows no longer excluded. See MIGRATION.md.
+            import warnings
+
+            if explicit_depth is None:
+                warnings.warn(
+                    "siddhi_tpu.defer_meta is deprecated — use "
+                    "siddhi_tpu.pipeline_depth (the dispatch pipeline "
+                    "subsumes meta-defer batching); mapping defer_meta="
+                    f"{self.app_context.defer_meta} onto pipeline_depth",
+                    DeprecationWarning, stacklevel=2)
+                self.app_context.pipeline_depth = max(
+                    self.app_context.pipeline_depth,
+                    self.app_context.defer_meta)
+                self.app_context.defer_meta = 1
+            else:
+                # an explicit pipeline_depth wins; defer_meta is left
+                # as-is — the legacy hold-N path only engages when the
+                # pipeline is pinned off (depth 1), and silently zeroing
+                # it would remove the batching the user asked for
+                warnings.warn(
+                    "siddhi_tpu.defer_meta is deprecated — use "
+                    "siddhi_tpu.pipeline_depth; explicit pipeline_depth="
+                    f"{explicit_depth} set, defer_meta="
+                    f"{self.app_context.defer_meta} kept for the legacy "
+                    "path (engages only at pipeline_depth 1)",
+                    DeprecationWarning, stacklevel=2)
 
         # @app:statistics (reference SiddhiStatisticsManager wiring)
         stats_ann = siddhi_app.app_annotation("statistics")
@@ -904,6 +938,18 @@ class SiddhiAppRuntime:
         if self.app_context.supervisor is not None:
             self.app_context.supervisor.stop()
         self.app_context.timestamp_generator.stop_heartbeat()
+        pump = getattr(self.app_context, "completion_pump", None)
+        if pump is not None and pump.has_pending:
+            # batches still riding the dispatch pipeline emit before
+            # teardown (async tails are additionally flushed by each
+            # worker as its last act on the stop sentinel)
+            try:
+                pump.flush()
+            except RuntimeError:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "pipeline flush failed during shutdown")
         for qr in self.query_runtimes.values():
             if getattr(qr, "_deferred", None):
                 try:

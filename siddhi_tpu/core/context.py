@@ -171,8 +171,23 @@ class SiddhiAppContext:
         # >1: batch N step metas into ONE device->host round trip, emitting
         # outputs (and surfacing overflow errors) up to N batches late —
         # the tunnel charges ~70ms latency per pull (see PERF.md). Set via
-        # ConfigManager key siddhi_tpu.defer_meta.
+        # ConfigManager key siddhi_tpu.defer_meta. DEPRECATED: values >1
+        # are remapped onto pipeline_depth at app build (app_runtime.py).
         self.defer_meta = 1
+        # dispatch pipeline depth: up to N device batches per query ride
+        # in flight while the host packs the next batch; emission stays
+        # in per-query dispatch order and overflow errors surface on the
+        # producer's next send (core/query/completion.py). 1 = fully
+        # synchronous (today's pull-per-batch). Set via ConfigManager key
+        # siddhi_tpu.pipeline_depth; SIDDHI_TPU_PIPELINE_DEPTH overrides
+        # the process default.
+        import os as _os
+
+        self.pipeline_depth = int(
+            _os.environ.get("SIDDHI_TPU_PIPELINE_DEPTH") or "2")
+        from siddhi_tpu.core.query.completion import CompletionPump
+
+        self.completion_pump = CompletionPump(self)
         # multi-process clusters: bound every device pull by this many
         # seconds; a peer process dying mid-collective otherwise hangs
         # the coordinator forever (ClusterPeerError surfaces through the

@@ -203,6 +203,11 @@ def encode_key_tuples(arrays, rows: np.ndarray, id_of) -> np.ndarray:
     return lut[inv]
 
 
+# vectorized None-scan over object columns (HostBatch.from_events): one
+# ufunc sweep instead of a per-row `is None` list comprehension
+_NONE_MASK = np.frompyfunc(lambda v: v is None, 1, 1)
+
+
 def _pad_len(n: int, minimum: int = 8) -> int:
     """Pad batch length to a power of two to bound jit recompiles."""
     b = minimum
@@ -303,7 +308,6 @@ class HostBatch:
             if expired.any():
                 cols[TYPE_KEY][:n][expired] = EXPIRED
         rows = [ev.data for ev in events]
-        encode = dictionary.encode
         for pos, attr in enumerate(definition.attributes):
             dtype = dtype_of(attr.type)
             arr = np.zeros(b, dtype)
@@ -360,20 +364,22 @@ class HostBatch:
                     if nulls:
                         mask[nulls] = True
                 elif attr.type == AttrType.STRING:
-                    vals = [
-                        StringDictionary.NULL_ID if r[pos] is None else encode(r[pos])
-                        for r in rows
-                    ]
-                    arr[:n] = vals
-                    mask[:n] = np.asarray(vals, np.int64) == StringDictionary.NULL_ID
-                    arr[:n][mask[:n]] = 0
+                    # ONE bulk dictionary pass over the column (native
+                    # strdict fast path; Nones encode to NULL_ID there)
+                    # instead of a per-row Python encode() probe
+                    col = np.fromiter((r[pos] for r in rows), object, n)
+                    ids = dictionary.encode_array(col)
+                    mask[:n] = ids == StringDictionary.NULL_ID
+                    arr[:n] = np.where(mask[:n], 0, ids)
                 else:
                     zero = False if attr.type == AttrType.BOOL else 0
-                    vals = [zero if r[pos] is None else r[pos] for r in rows]
-                    arr[:n] = vals
-                    nulls = [i for i, r in enumerate(rows) if r[pos] is None]
-                    if nulls:
-                        mask[nulls] = True
+                    col = np.fromiter((r[pos] for r in rows), object, n)
+                    nulls = _NONE_MASK(col).astype(bool)
+                    if nulls.any():
+                        mask[:n] = nulls
+                        arr[:n] = np.where(nulls, zero, col)
+                    else:
+                        arr[:n] = col
             cols[attr.name] = arr
             cols[attr.name + "?"] = mask
         return HostBatch(cols)

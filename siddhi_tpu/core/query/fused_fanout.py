@@ -270,11 +270,15 @@ class FusedFanoutRuntime(Receiver):
         from siddhi_tpu.core.query.runtime import backfill_null_masks
 
         backfill_null_masks(batch, self.input_definition)
-        self.process_batch(batch)
+        self.process_batch(batch, junction=junction)
 
-    def process_batch(self, batch: HostBatch):
+    def process_batch(self, batch: HostBatch, junction=None):
+        from siddhi_tpu.core.stream.junction import \
+            current_delivering_junction
         from siddhi_tpu.observability.tracing import span
 
+        if junction is None:
+            junction = current_delivering_junction()
         with span("fanout.step", stream=self.stream_id,
                   members=len(self.members)):
             with self._lock, contextlib.ExitStack() as stack:
@@ -282,7 +286,7 @@ class FusedFanoutRuntime(Receiver):
                 # one at a time — no cycle)
                 for m in self.members:
                     stack.enter_context(m._lock)
-                self._process_locked(batch)
+                self._process_locked(batch, junction=junction)
 
     # ----------------------------------------------------------- internals
 
@@ -404,7 +408,7 @@ class FusedFanoutRuntime(Receiver):
         return self.app_context.telemetry.instrument_jit(
             jitted, f"fanout.{self.stream_id}.step")
 
-    def _process_locked(self, batch: HostBatch):
+    def _process_locked(self, batch: HostBatch, junction=None):
         from siddhi_tpu.core.util.statistics import (latency_t0,
                                                      record_elapsed_ms)
 
@@ -418,26 +422,68 @@ class FusedFanoutRuntime(Receiver):
         new_states, (outs, metas) = self._step(states, cols_dev,
                                                self._now64())
         tel.count(f"fanout.{self.stream_id}.dispatches")
+        for i, m in enumerate(members):
+            # cluster members share the (immutable) result arrays
+            m._state = new_states[self._cluster_of[i]]
+        pump = getattr(self.app_context, "completion_pump", None)
+        if pump is not None and pump.depth > 1:
+            # pipelined: the whole group batch rides in flight; per-member
+            # emission/attribution runs at drain (complete_entry). The
+            # member list and cluster map are snapshotted — a release or
+            # rebuild between dispatch and drain must not re-map outputs.
+            from siddhi_tpu.core.query.completion import FusedCompletion
+
+            for m in members:
+                record_elapsed_ms(sm, m.name, t0)
+            pump.submit(FusedCompletion(
+                self, outs, metas, list(members), list(self._cluster_of),
+                batch, junction=junction))
+            return
         # ONE combined [n_clusters, 3] meta pull for the whole group — the
         # single device->host round trip this layer exists to amortize
         metas_host = np.asarray(jax.device_get(metas))
         tel.count(f"fanout.{self.stream_id}.meta_pulls")
-        for i, m in enumerate(members):
-            # cluster members share the (immutable) result arrays
-            m._state = new_states[self._cluster_of[i]]
+        fatal = self._emit_members(list(members), list(self._cluster_of),
+                                   outs, metas_host, batch, t0sm=t0)
+        if fatal is not None:
+            # surfaced AFTER every member emitted: the junction's
+            # handle_error stores it so later sends re-raise, exactly as
+            # an unfused member's fatal would
+            raise fatal
+
+    def complete_entry(self, entry, metas_host) -> Optional[Exception]:
+        """Drain-side tail of a pipelined group batch (CompletionPump):
+        per-member emission and fault attribution over the snapshotted
+        member list. Returns the fatal (if any) for the pump's
+        drain-then-raise instead of raising mid-round."""
+        tel = self.app_context.telemetry
+        tel.count(f"fanout.{self.stream_id}.meta_pulls")
+        with self._lock, contextlib.ExitStack() as stack:
+            for m in entry.members:
+                stack.enter_context(m._lock)
+            return self._emit_members(entry.members, entry.cluster_of,
+                                      entry.outs, np.asarray(metas_host),
+                                      entry.batch, t0sm=None)
+
+    def _emit_members(self, members, cluster_of, outs, metas_host, batch,
+                      t0sm) -> Optional[Exception]:
+        from siddhi_tpu.core.util.statistics import record_elapsed_ms
+
+        sm = self.app_context.statistics_manager
         fatal: Optional[Exception] = None
         for i, m in enumerate(members):
-            row = metas_host[self._cluster_of[i]]
+            row = metas_host[cluster_of[i]]
             overflow, notify, size = int(row[0]), int(row[1]), int(row[2])
             try:
                 if overflow > 0:
                     raise FatalQueryError(
                         f"query '{m.name}': {m.overflow_knob_msg()} "
                         f"before creating the runtime")
-                record_elapsed_ms(sm, m.name, t0)
+                if t0sm is not None:   # pipelined path recorded at dispatch
+                    record_elapsed_ms(sm, m.name, t0sm)
                 # own LazyColumns wrapper per member over the shared
                 # arrays: materialization/mutation must not leak across
-                m._emit(HostBatch(LazyColumns(outs[self._cluster_of[i]]),
+                m._emit(HostBatch(LazyColumns(outs[cluster_of[i]]),
                                   size=size))
                 if notify >= 0 and m.scheduler is not None:
                     # defensive: eligible members carry no scheduler-driven
@@ -446,11 +492,7 @@ class FusedFanoutRuntime(Receiver):
                     m.scheduler.notify_at(notify, m.process_timer)
             except Exception as e:  # noqa: BLE001 — per-member attribution
                 fatal = self._route_member_error(m, batch, e, fatal)
-        if fatal is not None:
-            # surfaced AFTER every member emitted: the junction's
-            # handle_error stores it so later sends re-raise, exactly as
-            # an unfused member's fatal would
-            raise fatal
+        return fatal
 
     def _route_member_error(self, member, batch: HostBatch, e: Exception,
                             fatal: Optional[Exception]):

@@ -659,6 +659,18 @@ class JoinQueryRuntime(QueryRuntime):
         # notify values are per SIDE — never defer join metas
         return False
 
+    @property
+    def _pipeline_ok(self) -> bool:
+        # joins stay SYNCHRONOUS even under the CompletionPump: the two
+        # sides' state updates are order-coupled (a left batch's probe
+        # must observe the right window exactly as of dispatch), the
+        # packed __notify__ is per SIDE (the pump's drain could not
+        # attribute the wake time to the right per-side timer callback),
+        # and left/right batches interleave through ONE runtime lock —
+        # pipelining one side while the other dispatches would reorder
+        # probe-vs-insert against the reference semantics.
+        return False
+
     def _finish_device_batch(self, step, cols, overflow_msg):
         if self.keyer is None:
             return super()._finish_device_batch(step, cols, overflow_msg)

@@ -45,7 +45,8 @@ class StreamProxy(Receiver):
         self.runtime.process_stream_batch(self.stream_id, batch)
 
     def receive_batch(self, batch: HostBatch, junction=None):
-        self.runtime.process_stream_batch(self.stream_id, batch)
+        self.runtime.process_stream_batch(self.stream_id, batch,
+                                          junction=junction)
 
 
 class NFAQueryRuntime(QueryRuntime):
@@ -256,11 +257,20 @@ class NFAQueryRuntime(QueryRuntime):
 
     # ----------------------------------------------------------- processing
 
-    def process_stream_batch(self, stream_id: str, batch: HostBatch):
+    def process_stream_batch(self, stream_id: str, batch: HostBatch,
+                             junction=None):
         from siddhi_tpu.observability.tracing import span
 
         with span("query.step", query=self.name, stream=stream_id), \
                 self._lock:
+            from siddhi_tpu.core.stream.junction import \
+                current_delivering_junction
+
+            j = junction or current_delivering_junction()
+            self._cur_junction = j
+            self._cur_fault_batch = batch if (
+                j is not None and j.on_error_action == "STREAM"
+                and j.fault_junction is not None) else None
             cols = batch.cols
             partitioned = self.partition_ctx is not None
             if partitioned:
@@ -379,6 +389,11 @@ class NFAQueryRuntime(QueryRuntime):
 
     def process_timer(self, ts: int):
         with self._lock:
+            # drain in-flight pipelined batches first: the deadline sweep
+            # must observe a fully-emitted timeline (and runs sync itself)
+            pump = getattr(self.app_context, "completion_pump", None)
+            if pump is not None and pump.has_pending:
+                pump.flush_owner(self)
             if self._state is None:
                 self._state = self._init_state()
             if self._timer_step is None:
@@ -392,11 +407,12 @@ class NFAQueryRuntime(QueryRuntime):
                 self._timer_step = self.app_context.telemetry.instrument_jit(
                     self._timer_step, f"query.{self.name}.nfa.timer")
             notify = self._run_nfa_step(
-                lambda: self._timer_step(self._state, np.int64(ts)))
+                lambda: self._timer_step(self._state, np.int64(ts)),
+                allow_pipeline=False)
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self._timer_cb)
 
-    def _run_nfa_step(self, run) -> int | None:
+    def _run_nfa_step(self, run, allow_pipeline: bool = True) -> int | None:
         """Run a jitted NFA step; when a group-by keyer splits the pipeline,
         key the NFA emissions host-side and run the selector step after.
         Overflow/notify/size arrive packed in __meta__ — one pull."""
@@ -412,6 +428,24 @@ class NFAQueryRuntime(QueryRuntime):
         meta = (dict.__getitem__(out_host, "__meta__")
                 if "__meta__" in out_host else None)
         if meta is not None:
+            pump = getattr(self.app_context, "completion_pump", None)
+            if (allow_pipeline and pump is not None and pump.depth > 1
+                    and self.keyer is None):
+                # pipelined dispatch (completion.py). Unlike defer_meta,
+                # waitish (absent-deadline) plans are ELIGIBLE: the pump
+                # delivers __notify__ promptly at drain (sync sends flush
+                # before returning). The split-keyer path stays sync —
+                # it needs the NFA outputs host-side immediately.
+                from siddhi_tpu.core.query.completion import QueryCompletion
+
+                record_elapsed_ms(sm, self.name, t0)
+                pump.submit(QueryCompletion(
+                    self, out_host,
+                    "pattern match-slot capacity exceeded — raise "
+                    "app_context.nfa_slots",
+                    junction=self._cur_junction,
+                    batch=getattr(self, "_cur_fault_batch", None)))
+                return None
             defer = getattr(self.app_context, "defer_meta", 1)
             if defer > 1 and self.keyer is None and not any(
                     st.waitish for st in self.stage.plan.steps):

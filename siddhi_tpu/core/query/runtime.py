@@ -13,6 +13,7 @@ back to Events for rate limiting and callbacks.
 from __future__ import annotations
 
 import threading
+import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -169,6 +170,10 @@ class QueryRuntime(Receiver):
         self._shard_mesh = None  # set by parallel.mesh.shard_query_step
         self._lock = threading.RLock()  # per-query lock (QueryParser.java:159-215)
         self._deferred: List = []   # queued outputs when defer_meta > 1
+        self._cur_junction = None   # delivering junction of the batch in
+        #                             process (completion-latency feedback)
+        self._cur_fault_batch = None  # input batch retained for drain-time
+        #                               fault-stream routing (@OnError)
         self.on_error: Optional[Callable] = None
 
     # ---------------------------------------------------------------- state
@@ -383,7 +388,7 @@ class QueryRuntime(Receiver):
         if self.carried_pk and PK_KEY not in batch.cols:
             batch.cols[PK_KEY] = np.zeros(batch.capacity, np.int32)
         backfill_null_masks(batch, self.input_definition)
-        self.process_batch(batch)
+        self.process_batch(batch, junction=junction)
 
     _now_override = None   # timer chunks sweep at their scheduled time
 
@@ -410,6 +415,13 @@ class QueryRuntime(Receiver):
         # event batch on another thread must never observe the timer's ts
         # as its clock (the RLock nests with process_batch's own acquire)
         with self._lock:
+            # in-flight pipelined batches were dispatched BEFORE this
+            # timer fired: drain them first so the timer sweep observes a
+            # fully-emitted timeline (and the timer batch itself runs
+            # synchronously — _now_override gates the pipeline branch)
+            pump = getattr(self.app_context, "completion_pump", None)
+            if pump is not None and pump.has_pending:
+                pump.flush_owner(self)
             self._now_override = int(ts)
             try:
                 self.process_batch(batch)
@@ -467,10 +479,22 @@ class QueryRuntime(Receiver):
                 timestamps.append(int(ts_col[i]))
             tap.emit(rows, timestamps)
 
-    def process_batch(self, batch: HostBatch):
+    def process_batch(self, batch: HostBatch, junction=None):
+        from siddhi_tpu.core.stream.junction import current_delivering_junction
         from siddhi_tpu.observability.tracing import span
 
         with span("query.step", query=self.name), self._lock:
+            # Event-path deliveries (Receiver.receive) carry no junction
+            # parameter — fall back to the delivery-loop thread-local so
+            # pipelined completions keep their error attribution and
+            # latency feedback; direct receiver feeds see None
+            j = junction or current_delivering_junction()
+            self._cur_junction = j
+            # fault-stream routing of drain-time errors needs the input
+            # events; retain the batch only under @OnError(action=stream)
+            self._cur_fault_batch = batch if (
+                j is not None and j.on_error_action == "STREAM"
+                and j.fault_junction is not None) else None
             notify_host = None
             if self.log_stages:
                 self._run_log_taps(batch)
@@ -637,6 +661,21 @@ class QueryRuntime(Receiver):
         meta = (dict.__getitem__(out_host, "__meta__")
                 if "__meta__" in out_host else None)   # raw — no pull yet
         if meta is not None:
+            pump = getattr(self.app_context, "completion_pump", None)
+            if (pump is not None and pump.depth > 1 and self._pipeline_ok
+                    and self._now_override is None):
+                # pipelined dispatch: the batch rides in flight while the
+                # producer packs the next one; the pump emits in dispatch
+                # order, delivers __notify__ at drain, and surfaces
+                # overflow on the producer's next send (completion.py)
+                from siddhi_tpu.core.query.completion import QueryCompletion
+
+                record_elapsed_ms(sm, self.name, t0)
+                pump.submit(QueryCompletion(
+                    self, out_host, overflow_msg,
+                    junction=self._cur_junction,
+                    batch=getattr(self, "_cur_fault_batch", None)))
+                return None
             defer = getattr(self.app_context, "defer_meta", 1)
             if defer > 1 and self._defer_ok:
                 # batch N metas into ONE round trip: queue the (device)
@@ -692,6 +731,16 @@ class QueryRuntime(Receiver):
                 and (self.window_stage is None
                      or not getattr(self.window_stage, "needs_scheduler", False)))
 
+    @property
+    def _pipeline_ok(self) -> bool:
+        """May this runtime's batches ride the CompletionPump? Unlike
+        ``_defer_ok``, scheduler-driven and host windows are ELIGIBLE —
+        the pump delivers their ``__notify__`` wake times promptly at
+        drain (sync sends flush before returning; @Async workers flush at
+        queue-idle) instead of holding them a full defer window. Joins
+        override this to False (``join_runtime._pipeline_ok``)."""
+        return True
+
     def flush_deferred(self) -> Optional[int]:
         """Drain queued outputs: pull ALL their metas in one batched round
         trip, then emit in order (called when the defer window fills, at
@@ -712,37 +761,45 @@ class QueryRuntime(Receiver):
             else:
                 metas = jax.device_get(raw)
             notify_min: Optional[int] = None
-            overflow_err: Optional[str] = None
+            overflow_errs: List[str] = []
             for (out_host, overflow_msg), meta in zip(pending, metas):
                 dict.pop(out_host, "__meta__")
                 overflow, notify, size = int(meta[0]), int(meta[1]), int(meta[2])
-                if overflow > 0 and overflow_err is None:
-                    overflow_err = overflow_msg   # raise AFTER draining all
+                if overflow > 0 and overflow_msg not in overflow_errs:
+                    # every DISTINCT knob text of an overflowed batch is
+                    # reported (first-error-wins dropped the later
+                    # members' knobs); still drain-then-raise
+                    overflow_errs.append(overflow_msg)
                 self._emit(HostBatch(out_host, size=size))
                 if notify >= 0:
                     notify_min = notify if notify_min is None else min(notify_min, notify)
-            if overflow_err is not None:
+            if overflow_errs:
                 raise FatalQueryError(
-                    f"query '{self.name}': {overflow_err} before creating "
-                    f"the runtime")
+                    f"query '{self.name}': {'; '.join(overflow_errs)} "
+                    f"before creating the runtime")
             return notify_min
 
     def _emit(self, out: HostBatch):
         if out.size == 0:
             return
-        for col in self.selector_plan.uuid_cols:
+        uuid_cols = self.selector_plan.uuid_cols
+        if uuid_cols:
             # uuid(): fresh per-row UUID strings, filled host-side (the
-            # jitted step emitted placeholders — see ops/expressions.py);
-            # generated up front and bulk-encoded in one dictionary pass
-            import uuid as _uuid
-
-            vals = np.asarray(out.cols[col]).copy()
+            # jitted step emitted placeholders — see ops/expressions.py).
+            # The whole batch of UUIDs — every column — is generated up
+            # front and dictionary-encoded in ONE encode_array pass; the
+            # fused fan-out path shares this call site via m._emit
             idx = np.nonzero(np.asarray(out.cols[VALID_KEY]))[0]
             if idx.size:
-                fresh = np.array([str(_uuid.uuid4()) for _ in range(idx.size)],
-                                 dtype=object)
-                vals[idx] = self.dictionary.encode_array(fresh)
-            out.cols[col] = vals
+                fresh = np.array(
+                    [str(uuid.uuid4())
+                     for _ in range(idx.size * len(uuid_cols))],
+                    dtype=object)
+                ids = self.dictionary.encode_array(fresh)
+                for ci, col in enumerate(uuid_cols):
+                    vals = np.asarray(out.cols[col]).copy()
+                    vals[idx] = ids[ci * idx.size:(ci + 1) * idx.size]
+                    out.cols[col] = vals
         from siddhi_tpu.core.query.ratelimit import PassThroughRateLimiter
 
         if (

@@ -28,6 +28,16 @@ log = logging.getLogger(__name__)
 # marker for "no batch in flight" (None is the queue's stop sentinel)
 _NOTHING = object()
 
+# the junction whose delivery loop is running on THIS thread: receivers
+# reached through the Event path (Receiver.receive has no junction
+# parameter) read it so their pipelined completions still know their
+# delivering junction (error attribution + completion-latency feedback)
+_DELIVERING = threading.local()
+
+
+def current_delivering_junction() -> Optional["StreamJunction"]:
+    return getattr(_DELIVERING, "junction", None)
+
 # worker heartbeat floor: the drain loop polls its queue with this bound,
 # so a healthy worker — even an idle one — bumps its beats counter at
 # least ~10x/sec and the supervisor can tell wedged from idle (its
@@ -70,6 +80,10 @@ class StreamJunction:
         self._max_delay_s: Optional[float] = None
         self._latency_target_ms: Optional[float] = None
         self._lat_ewma = 0.0
+        # _adapt used to run only on the single worker thread; pipelined
+        # completions now also feed it from whichever thread drains the
+        # pump, so the EWMA/cap read-modify-write needs a lock
+        self._adapt_lock = threading.Lock()
         self._running = False
         self._fatal: Optional[Exception] = None  # async worker's FatalQueryError
         # resilience hooks (resilience/supervisor.py, resilience/faults.py):
@@ -184,6 +198,14 @@ class StreamJunction:
             self._enqueue(events)
         else:
             self._deliver(events)
+            # synchronous sends keep synchronous semantics: any batches
+            # the receivers pipelined (CompletionPump) drain before the
+            # send returns — the caller observes its outputs immediately,
+            # exactly as before the pump existed. Overlap comes from
+            # producers that deliver back-to-back (@Async workers).
+            # own_only: this sender's dispatches and cascades, not an
+            # unrelated busy stream's in-flight pulls.
+            self._flush_pipeline(own_only=True)
 
     def decode_events(self, batch) -> List[Event]:
         return batch.to_events(
@@ -206,6 +228,32 @@ class StreamJunction:
             self._enqueue(batch)
         else:
             self._deliver_batch(batch)
+            self._flush_pipeline(own_only=True)   # see send_events
+
+    def _flush_pipeline(self, own_only: bool = False):
+        """Drain the app's CompletionPump (no-op when empty or when this
+        is a nested flush inside an emit cascade). ``own_only`` (sync
+        senders) drains only this thread's own dispatches and cascades;
+        worker-loop flushes drain everything — including entries a dead
+        predecessor worker left riding. The pump routes each drain error
+        through the ENTRY's own delivering junction (fatals arm that
+        junction's ``_fatal``, peer failures notify the supervisor, the
+        rest log) — this junction only propagates the raise so a
+        synchronous sender (or the worker loop) still sees the failure
+        at the flush point."""
+        pump = getattr(self.app_context, "completion_pump", None)
+        if pump is None or not pump.has_pending:
+            return
+        pump.flush(own_only=own_only)
+
+    def record_completion(self, elapsed_ms: float):
+        """Completion-latency feedback from the CompletionPump: the TRUE
+        deliver->emit time of a pipelined batch (the worker's own timing
+        only saw the dispatch slice, which returns instantly once a batch
+        rides the pipeline) — without this, ``latency.target`` would see
+        near-zero latency and never shrink the batch cap on a slow
+        device step."""
+        self._adapt(elapsed_ms)
 
     def _enqueue(self, item):
         """Producer-side @Async enqueue, counting backpressure stalls
@@ -226,17 +274,22 @@ class StreamJunction:
 
         with span("junction.dispatch", stream=self.definition.id,
                   rows=int(batch._size) if batch._size is not None else -1):
-            for r in self.receivers:
-                # receivers mutate batch.cols in place (filters, key
-                # columns) — hand each its own dict so mutations don't leak
-                # across; LazyColumns keeps device-held outputs unpulled
-                # until read
-                try:
-                    r.receive_batch(
-                        HostBatch(LazyColumns(batch.cols),
-                                  size=batch._size), self)
-                except Exception as e:  # noqa: BLE001 — fault-stream routing
-                    self.handle_error(self.decode_events(batch), e)
+            prev = current_delivering_junction()
+            _DELIVERING.junction = self
+            try:
+                for r in self.receivers:
+                    # receivers mutate batch.cols in place (filters, key
+                    # columns) — hand each its own dict so mutations don't
+                    # leak across; LazyColumns keeps device-held outputs
+                    # unpulled until read
+                    try:
+                        r.receive_batch(
+                            HostBatch(LazyColumns(batch.cols),
+                                      size=batch._size), self)
+                    except Exception as e:  # noqa: BLE001 — fault routing
+                        self.handle_error(self.decode_events(batch), e)
+            finally:
+                _DELIVERING.junction = prev
 
     def _adapt(self, elapsed_ms: float):
         """Latency-target control loop: EWMA the delivery latency, shrink
@@ -251,21 +304,40 @@ class StreamJunction:
         target = self._latency_target_ms
         if target is None:
             return
-        self._lat_ewma = (0.7 * self._lat_ewma + 0.3 * elapsed_ms
-                          if self._lat_ewma else elapsed_ms)
-        if self._lat_ewma > target:
-            self._cur_batch = max(16, self._cur_batch // 2)
-            self._lat_ewma = target  # re-converge from the new cap
-        elif (self._lat_ewma < target / 2
-              and self._cur_batch < self._batch_size):
-            self._cur_batch = min(self._batch_size,
-                                  max(self._cur_batch + 1,
-                                      int(self._cur_batch * 1.25)))
+        with self._adapt_lock:
+            self._lat_ewma = (0.7 * self._lat_ewma + 0.3 * elapsed_ms
+                              if self._lat_ewma else elapsed_ms)
+            if self._lat_ewma > target:
+                self._cur_batch = max(16, self._cur_batch // 2)
+                self._lat_ewma = target  # re-converge from the new cap
+            elif (self._lat_ewma < target / 2
+                  and self._cur_batch < self._batch_size):
+                self._cur_batch = min(self._batch_size,
+                                      max(self._cur_batch + 1,
+                                          int(self._cur_batch * 1.25)))
+
+    def _pump_submits(self) -> int:
+        pump = getattr(self.app_context, "completion_pump", None)
+        return pump.submits_of(self) if pump is not None else 0
 
     def _timed_deliver(self, events: List[Event]):
         t0 = time.perf_counter()
+        n0 = self._pump_submits()
         self._deliver(events)
-        self._adapt((time.perf_counter() - t0) * 1000.0)
+        if self._pump_submits() == n0:
+            # pipelined deliveries return at dispatch; their near-zero
+            # slice must not feed the latency loop — record_completion
+            # supplies the TRUE sample at drain instead
+            self._adapt((time.perf_counter() - t0) * 1000.0)
+
+    def _timed_deliver_batch(self, batch):
+        # columnar unit variant of _timed_deliver — same pipelined-skip
+        # rule; the two must stay in lock-step
+        t0 = time.perf_counter()
+        n0 = self._pump_submits()
+        self._deliver_batch(batch)
+        if self._pump_submits() == n0:
+            self._adapt((time.perf_counter() - t0) * 1000.0)
 
     def _drain(self, gen: Optional[int] = None):
         if gen is None:
@@ -303,6 +375,11 @@ class StreamJunction:
                 try:
                     item = self._queue.get(timeout=_IDLE_POLL_S)
                 except queue.Empty:
+                    # idle: drain any batches still riding the pipeline —
+                    # bounds emission lag under trickle load to one idle
+                    # poll (this is what lets scheduler-driven windows
+                    # and absent deadlines ride the pump)
+                    self._flush_pipeline()
                     if not self._running and self._queue.empty():
                         return   # stop raced our sentinel away
                     continue
@@ -312,16 +389,19 @@ class StreamJunction:
                     return   # superseded mid-fetch: item handed over
             if item is None:
                 self._inflight = _NOTHING
+                self._flush_pipeline()   # the worker's last act: nothing
+                #                          may stay riding after shutdown
                 return
             if not isinstance(item, list):
                 # columnar HostBatch: delivered as ONE pre-formed unit
                 # (the cap never splits producer batches — max.delay /
                 # latency.target shape only the event-path coalescing),
                 # but its delivery latency still feeds the adaptive loop
-                t0 = time.perf_counter()
-                self._deliver_batch(item)
-                self._adapt((time.perf_counter() - t0) * 1000.0)
+                # (unless it pipelined — see _timed_deliver)
+                self._timed_deliver_batch(item)
                 self._inflight = _NOTHING
+                if self._queue.empty():
+                    self._flush_pipeline()
                 continue
             batch = list(item)
             self._inflight = batch   # coalesced extras ride the same unit
@@ -363,21 +443,26 @@ class StreamJunction:
             self._timed_deliver(batch)
             if follow is not None:
                 self._inflight = follow
-                t0 = time.perf_counter()
-                self._deliver_batch(follow)
-                self._adapt((time.perf_counter() - t0) * 1000.0)
+                self._timed_deliver_batch(follow)
             self._inflight = _NOTHING
+            if stop_after or self._queue.empty():
+                self._flush_pipeline()
             if stop_after:
                 return
 
     def _deliver(self, events: List[Event]):
         with span("junction.dispatch", stream=self.definition.id,
                   rows=len(events)):
-            for r in self.receivers:
-                try:
-                    r.receive(events)
-                except Exception as e:  # noqa: BLE001 — fault-stream routing
-                    self.handle_error(events, e)
+            prev = current_delivering_junction()
+            _DELIVERING.junction = self
+            try:
+                for r in self.receivers:
+                    try:
+                        r.receive(events)
+                    except Exception as e:  # noqa: BLE001 — fault routing
+                        self.handle_error(events, e)
+            finally:
+                _DELIVERING.junction = prev
 
     def handle_error(self, events: List[Event], e: Exception):
         from siddhi_tpu.ops.expressions import CompileError

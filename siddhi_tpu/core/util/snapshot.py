@@ -91,6 +91,13 @@ class SnapshotService:
     def _capture_common(self) -> dict:
         rt = self.app_runtime
         dictionary = rt.app_context.string_dictionary
+        pump = getattr(rt.app_context, "completion_pump", None)
+        if pump is not None and pump.has_pending:
+            # batches riding the dispatch pipeline drain INSIDE the
+            # barrier: their state updates are already in the pytrees the
+            # capture reads, so their outputs must emit before the cut —
+            # a restore must neither lose nor re-emit them
+            pump.flush()
         for q in rt.query_runtimes.values():
             if getattr(q, "_deferred", None):
                 q.flush_deferred()   # un-emitted outputs must not be lost
@@ -232,6 +239,12 @@ class SnapshotService:
 
         for snap, pctx in zip(obj["partitions"], rt.partition_contexts):
             pctx.keyspace.restore(snap)
+
+        pump = getattr(rt.app_context, "completion_pump", None)
+        if pump is not None:
+            # in-flight pipelined outputs belong to the rolled-back
+            # timeline — discard without emitting (like q._deferred below)
+            pump.discard_all()
 
         for name, qsnap in obj["queries"].items():
             q = rt.query_runtimes.get(name)

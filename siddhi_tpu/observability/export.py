@@ -45,6 +45,21 @@ _JUNCTION_STALLS = re.compile(r"^junction\.(?P<stream>.+)"
 _FANOUT_GAUGE = re.compile(r"^fanout\.(?P<stream>.+)\.group_size$")
 _FANOUT_COUNTER = re.compile(r"^fanout\.(?P<stream>.+)\.(?P<kind>"
                              r"dispatches|meta_pulls)$")
+_PIPELINE_GAUGE = re.compile(r"^pipeline\.(?P<query>.+)\.inflight$")
+# pipeline.metas / pipeline.pulls: metas-per-pull batching ratio;
+# pipeline.stalls: forced drains that had to wait on an unready meta
+_PIPELINE_COUNTER_FAMILY = {
+    "pipeline.stalls": ("siddhi_pipeline_stalls_total",
+                        "pipeline drains that blocked on an unready "
+                        "__meta__ (producer stalled on the device)"),
+    "pipeline.metas": ("siddhi_pipeline_metas_total",
+                       "batch metas drained through the dispatch "
+                       "pipeline (divide by pulls for the batching "
+                       "ratio)"),
+    "pipeline.pulls": ("siddhi_pipeline_meta_pulls_total",
+                       "device->host round trips made by pipeline "
+                       "drains"),
+}
 
 
 def _esc(v: str) -> str:
@@ -122,9 +137,15 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                          "queries fused into one dispatch per stream batch",
                          {**base, "stream": m.group("stream")}, v)
             else:
-                fams.add("siddhi_gauge", "gauge",
-                         "registered telemetry gauge",
-                         {**base, "name": name}, v)
+                m = _PIPELINE_GAUGE.match(name)
+                if m:
+                    fams.add("siddhi_pipeline_depth", "gauge",
+                             "device batches riding the dispatch pipeline",
+                             {**base, "query": m.group("query")}, v)
+                else:
+                    fams.add("siddhi_gauge", "gauge",
+                             "registered telemetry gauge",
+                             {**base, "name": name}, v)
     for name, v in sorted(tel_snapshot.get("counters", {}).items()):
         m = _JUNCTION_STALLS.match(name)
         if m:
@@ -140,6 +161,10 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                       if m.group("kind") == "dispatches"
                       else "fused fan-out combined __meta__ round trips"),
                      {**base, "stream": m.group("stream")}, v)
+            continue
+        fam = _PIPELINE_COUNTER_FAMILY.get(name)
+        if fam is not None:
+            fams.add(fam[0], "counter", fam[1], base, v)
             continue
         fams.add("siddhi_counter_total", "counter",
                  "named event counter",

@@ -229,6 +229,8 @@ class AppSupervisor:
         self.peer_monitor = peer_monitor
         self.peer_recovery = peer_recovery
         self.worker_restarts = 0
+        self.pump_wedges = 0              # pipeline-drain stalls detected
+        self._pump_wedge_flagged = False  # one count/log per episode
         self.recovery_result = None       # (new_runtime, revision)
         self._beat_seen = {}              # junction id -> (beats, t_changed)
         self._stop = threading.Event()
@@ -305,6 +307,40 @@ class AppSupervisor:
                 self._beat_seen[sid] = (j._beats, now)
                 stat_count(self.app_runtime.app_context,
                            "resilience.worker_restarts")
+        self._check_pump()
+
+    def _check_pump(self) -> None:
+        """Wedged-pipeline detection: a CompletionPump entry whose meta
+        never arrives means the device step (or a cluster collective
+        behind it) hung — the producers keep packing while nothing
+        emits, a failure mode the worker heartbeats cannot see (the
+        worker is healthy; it just never drains). Detection only: with
+        ``cluster_step_timeout`` set the drain itself surfaces a labeled
+        ``ClusterPeerError`` through the junction's fault machinery; the
+        in-flight pipeline survives worker replacement untouched (its
+        entries belong to the pump, not the worker thread), so the
+        replacement drains it in order without loss or double-emit."""
+        from siddhi_tpu.resilience import stat_count
+
+        pump = getattr(self.app_runtime.app_context, "completion_pump",
+                       None)
+        if pump is None:
+            return
+        age = pump.oldest_age_s()
+        if age is not None and age > self.wedge_timeout_s:
+            if not self._pump_wedge_flagged:
+                self._pump_wedge_flagged = True
+                self.pump_wedges += 1
+                log.warning(
+                    "supervisor: completion pump of app '%s' looks "
+                    "wedged — oldest in-flight batch is %.1fs old and "
+                    "its __meta__ never arrived (hung device step or "
+                    "dead collective peer)",
+                    self.app_runtime.name, age)
+                stat_count(self.app_runtime.app_context,
+                           "resilience.pump_wedges")
+        else:
+            self._pump_wedge_flagged = False
 
     # ------------------------------------------------------ peer recovery
 

@@ -19,6 +19,13 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
+# Dispatch pipeline (core/query/completion.py): pin tier-1 to depth 2 so
+# the WHOLE suite exercises the pipelined submit/drain path (sync sends
+# flush before returning, so visible semantics stay synchronous), not
+# just tests/test_pipeline.py. Matches the production default; set to 1
+# to bisect a failure against the fully-synchronous path.
+os.environ.setdefault("SIDDHI_TPU_PIPELINE_DEPTH", "2")
+
 # Plugin platforms (the axon TPU tunnel) override JAX_PLATFORMS via
 # jax.config.update at interpreter start, so env vars alone don't stick —
 # force the virtual 8-device CPU platform through the config API.

@@ -168,18 +168,26 @@ def test_event_order_preserved_in_batch_send():
 
 
 def test_deferred_meta_batching():
-    """siddhi_tpu.defer_meta=4: outputs queue device-side and flush as one
-    batched pull every 4 batches (and at shutdown)."""
+    """siddhi_tpu.defer_meta=4 is DEPRECATED: it maps onto the dispatch
+    pipeline (pipeline_depth=4, core/query/completion.py) with a
+    DeprecationWarning. Unlike the old hold-N queue, outputs no longer
+    lag a defer window — synchronous sends observe them immediately —
+    and nothing is lost at shutdown."""
+    import pytest
+
     from siddhi_tpu.core.util.config import InMemoryConfigManager
 
     manager = SiddhiManager()
     manager.set_config_manager(InMemoryConfigManager(
         {"siddhi_tpu.defer_meta": "4"}))
-    rt = manager.create_siddhi_app_runtime("""
-        define stream S (sym string, v int);
-        @info(name='q')
-        from S[v > 0] select sym, v insert into Out;
-    """)
+    with pytest.warns(DeprecationWarning, match="defer_meta"):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream S (sym string, v int);
+            @info(name='q')
+            from S[v > 0] select sym, v insert into Out;
+        """)
+    assert rt.app_context.pipeline_depth == 4
+    assert rt.app_context.defer_meta == 1
     seen = []
 
     class C(StreamCallback):
@@ -188,11 +196,10 @@ def test_deferred_meta_batching():
 
     rt.add_callback("Out", C())
     h = rt.get_input_handler("S")
-    for i in range(1, 4):
+    for i in range(1, 5):
         h.send(["a", i])
-    assert seen == []                     # still queued (window of 4)
-    h.send(["a", 4])                      # 4th batch: flush
+    # no defer lag: every synchronous send flushed the pipeline
     assert seen == [("a", 1), ("a", 2), ("a", 3), ("a", 4)]
-    h.send(["b", 5])                      # queued again
-    manager.shutdown()                    # shutdown drains the tail
+    h.send(["b", 5])
+    manager.shutdown()
     assert seen[-1] == ("b", 5)

@@ -1,0 +1,350 @@
+"""Dispatch pipeline (core/query/completion.py — CompletionPump).
+
+Covers the ISSUE-5 acceptance set: per-query dispatch-order emission with
+depth-bounded in-flight batches, overflow surfacing as FatalQueryError on
+the producer's next send with the capacity knob named, checkpoint/restore
+with a NON-empty pipeline (no lost and no doubled emission), and @Async
+worker death with in-flight pipelined batches (the supervisor's
+replacement drains them in order — the pipeline belongs to the pump, not
+the worker thread).
+
+Direct ``receive_batch`` calls are the deterministic way to park batches
+in the pipeline: junction sends flush the pump before returning (that's
+the synchronous-semantics contract), so a test that needs entries IN
+FLIGHT feeds the receiver below the junction.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.event import HostBatch
+from siddhi_tpu.core.stream.junction import FatalQueryError
+from siddhi_tpu.core.util.config import InMemoryConfigManager
+from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(tuple(e.data) for e in events)
+
+
+APP = """
+define stream S (sym string, v long);
+@info(name='pq')
+from S#window.length(8)
+  select sym, sum(v) as total group by sym
+  insert into Out;
+"""
+
+
+def _manager(depth, extra=None):
+    m = SiddhiManager()
+    cfg = {"siddhi_tpu.pipeline_depth": str(depth)}
+    cfg.update(extra or {})
+    m.set_config_manager(InMemoryConfigManager(cfg))
+    return m
+
+
+def _batch(rt, vals, ts0=0):
+    defn = rt.junctions["S"].definition
+    n = len(vals)
+    return HostBatch.from_columns(
+        {"sym": np.array(["A"] * n, dtype=object),
+         "v": np.asarray(vals, np.int64)},
+        defn, rt.app_context.string_dictionary,
+        timestamps=np.arange(ts0, ts0 + n, dtype=np.int64))
+
+
+def test_sync_sends_keep_synchronous_semantics():
+    """A junction send flushes the pump before returning: callers observe
+    their outputs immediately, at any depth."""
+    m = _manager(4)
+    rt = m.create_siddhi_app_runtime(APP)
+    out = Collector()
+    rt.add_callback("Out", out)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1])
+    assert out.rows == [("A", 1)]
+    h.send(["A", 2])
+    assert out.rows == [("A", 1), ("A", 3)]
+    pump = rt.app_context.completion_pump
+    assert not pump.has_pending
+    m.shutdown()
+
+
+def test_inflight_batches_emit_in_dispatch_order():
+    m = _manager(4)
+    rt = m.create_siddhi_app_runtime(APP)
+    out = Collector()
+    rt.add_callback("Out", out)
+    qr = rt.query_runtimes["pq"]
+    pump = rt.app_context.completion_pump
+    for i in range(3):
+        qr.receive_batch(_batch(rt, [i + 1], ts0=i))
+    # three batches ride in flight, nothing emitted yet
+    assert pump.inflight(qr) == 3
+    assert out.rows == []
+    pump.flush()
+    assert pump.inflight(qr) == 0
+    # strict per-query dispatch order: running sums 1, 3, 6
+    assert out.rows == [("A", 1), ("A", 3), ("A", 6)]
+    m.shutdown()
+
+
+def test_depth_bound_forces_batched_drain():
+    m = _manager(2)
+    rt = m.create_siddhi_app_runtime(APP)
+    out = Collector()
+    rt.add_callback("Out", out)
+    qr = rt.query_runtimes["pq"]
+    pump = rt.app_context.completion_pump
+    for i in range(5):
+        qr.receive_batch(_batch(rt, [1], ts0=i))
+        assert pump.inflight(qr) <= 2
+    # at least the older batches drained along the way, in order
+    assert out.rows == [("A", k) for k in range(1, len(out.rows) + 1)]
+    pump.flush()
+    assert out.rows == [("A", 1), ("A", 2), ("A", 3), ("A", 4), ("A", 5)]
+    tel = rt.app_context.telemetry.snapshot()
+    assert tel["counters"]["pipeline.pulls"] >= 1
+    assert tel["counters"]["pipeline.metas"] == 5
+    assert tel["gauges"]["pipeline.pq.inflight"] == 0
+    m.shutdown()
+
+
+def test_overflow_reaches_producer_as_fatal_with_knob_named():
+    """An overflow riding a pipelined meta surfaces on the producer's
+    NEXT interaction as FatalQueryError naming the capacity knob, and the
+    overflowed batch's clamped rows do not emit."""
+    m = _manager(4, {"siddhi_tpu.window_capacity": "8"})
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v long, ts long);
+        @info(name='ovq')
+        from S#window.externalTime(ts, 10 sec)
+          select sym, sum(v) as sv insert into Out;
+    """)
+    out = Collector()
+    rt.add_callback("Out", out)
+    qr = rt.query_runtimes["ovq"]
+    defn = rt.junctions["S"].definition
+    n = 16    # > capacity 8, all within the horizon -> overflow
+    big = HostBatch.from_columns(
+        {"sym": np.array(["A"] * n, dtype=object),
+         "v": np.arange(n, dtype=np.int64),
+         "ts": np.full(n, 1000, np.int64)},
+        defn, rt.app_context.string_dictionary,
+        timestamps=np.full(n, 1000, np.int64))
+    qr.receive_batch(big)          # dispatched; overflow rides the meta
+    pump = rt.app_context.completion_pump
+    assert pump.inflight(qr) == 1
+    with pytest.raises(FatalQueryError, match=r"ovq.*window_capacity"):
+        pump.flush()
+    assert out.rows == []          # the overflowed batch did not emit
+    m.shutdown()
+
+
+def test_checkpoint_drains_pipeline_and_restore_discards_it():
+    """persist() drains the pump inside the barrier (its state updates
+    are already in the captured pytrees, so its outputs must emit exactly
+    once); restore discards pre-restore in-flight outputs — nothing is
+    lost, nothing doubles across the cycle."""
+    store = InMemoryPersistenceStore()
+    m = _manager(4)
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(APP)
+    out = Collector()
+    rt.add_callback("Out", out)
+    qr = rt.query_runtimes["pq"]
+    pump = rt.app_context.completion_pump
+
+    qr.receive_batch(_batch(rt, [1], ts0=0))
+    qr.receive_batch(_batch(rt, [2], ts0=1))
+    assert pump.inflight(qr) == 2 and out.rows == []
+    rev = rt.persist()
+    # the two in-flight batches emitted exactly once, in order, and the
+    # snapshot covers their state (sum == 3)
+    assert out.rows == [("A", 1), ("A", 3)]
+    assert pump.inflight(qr) == 0
+
+    # new in-flight work AFTER the checkpoint, then roll back: the
+    # pending outputs belong to the abandoned timeline and must vanish
+    qr.receive_batch(_batch(rt, [10], ts0=2))
+    assert pump.inflight(qr) == 1
+    rt.restore_revision(rev)
+    assert pump.inflight(qr) == 0
+    assert out.rows == [("A", 1), ("A", 3)]   # no doubled emission
+    h = rt.get_input_handler("S")
+    h.send(["A", 4])
+    # restored window holds 1,2 -> 1+2+4
+    assert out.rows[-1] == ("A", 7)
+    m.shutdown()
+
+
+def test_worker_replacement_adopts_inflight_pipeline():
+    """@Async worker dies with batches riding the pipeline: the pump's
+    entries are worker-independent, so the supervisor's replacement
+    worker drains them in order — no loss, no double-emit. The worker is
+    first WEDGED (parked inside the fault hook, so its idle flush cannot
+    run) to make the in-flight window deterministic."""
+    from siddhi_tpu.resilience.faults import FaultInjector
+
+    m = _manager(8)
+    rt = m.create_siddhi_app_runtime("""
+        @Async(buffer.size='64')
+        define stream S (sym string, v long);
+        @info(name='pq')
+        from S#window.length(8) select sym, sum(v) as total group by sym
+          insert into Out;
+    """)
+    out = Collector()
+    rt.add_callback("Out", out)
+    rt.start()
+    sup = rt.supervise(interval_s=0.05, wedge_timeout_s=1.0)
+    inj = FaultInjector()
+    sj = rt.junctions["S"]
+    try:
+        qr = rt.query_runtimes["pq"]
+        pump = rt.app_context.completion_pump
+        inj.wedge_worker(sj)
+        assert inj.wait_wedged(10.0)      # worker parked, cannot flush
+        for i in range(2):
+            qr.receive_batch(_batch(rt, [i + 1], ts0=i))
+        assert pump.inflight(qr) == 2 and out.rows == []
+        h = rt.get_input_handler("S")
+        h.send(["A", 4])                  # queued past the stuck worker
+        deadline = time.time() + 10.0
+        while len(out.rows) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        # the replacement drained the adopted pipeline in dispatch order,
+        # then delivered (and flushed) the queued batch
+        assert out.rows == [("A", 1), ("A", 3), ("A", 7)]
+        assert sup.worker_restarts >= 1
+        inj.release()                     # stale worker wakes, retires
+    finally:
+        inj.clear()
+        sup.stop()
+        m.shutdown()
+
+
+def test_async_idle_flush_bounds_trickle_lag():
+    """Under trickle load the worker flushes the pipeline when its queue
+    goes idle — a single send's outputs appear without further sends."""
+    m = _manager(8)
+    rt = m.create_siddhi_app_runtime("""
+        @Async(buffer.size='64')
+        define stream S (sym string, v long);
+        @info(name='pq')
+        from S#window.length(8) select sym, sum(v) as total group by sym
+          insert into Out;
+    """)
+    out = Collector()
+    rt.add_callback("Out", out)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["A", 5])
+    deadline = time.time() + 5.0
+    while not out.rows and time.time() < deadline:
+        time.sleep(0.01)
+    assert out.rows == [("A", 5)]
+    m.shutdown()
+
+
+def test_fused_group_rides_pipeline_and_drains_in_member_order():
+    m = _manager(4, {"siddhi_tpu.fuse_fanout": "1"})
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v long);
+        @info(name='q0') from S select sym, v insert into A;
+        @info(name='q1') from S select sym, v * 2 as v insert into B;
+    """)
+    outs = {s: Collector() for s in ("A", "B")}
+    for s, c in outs.items():
+        rt.add_callback(s, c)
+    (group,) = rt.fused_fanout_groups
+    pump = rt.app_context.completion_pump
+    defn = rt.junctions["S"].definition
+    for i in range(2):
+        b = HostBatch.from_columns(
+            {"sym": np.array(["A"], dtype=object),
+             "v": np.array([i + 1], np.int64)},
+            defn, rt.app_context.string_dictionary,
+            timestamps=np.array([i], np.int64))
+        group.receive_batch(b)
+    assert pump.inflight(group) == 2
+    assert outs["A"].rows == [] and outs["B"].rows == []
+    pump.flush()
+    assert outs["A"].rows == [("A", 1), ("A", 2)]
+    assert outs["B"].rows == [("A", 2), ("A", 4)]
+    tel = rt.app_context.telemetry.snapshot()
+    assert tel["counters"]["fanout.S.dispatches"] == 2
+    assert tel["counters"]["fanout.S.meta_pulls"] == 2
+    m.shutdown()
+
+
+def test_drain_error_routes_to_fault_stream_with_events():
+    """A NON-fatal error that escapes ``_emit`` at drain time (a raising
+    QueryCallback — invoked directly, not behind a downstream junction)
+    must reach the @OnError(action='stream') fault junction WITH the
+    failing input events, exactly like the synchronous path — the entry
+    retains its input batch when the junction routes faults. (A raising
+    StreamCallback is different: the OUTPUT junction catches and logs it,
+    at any depth.)"""
+    from siddhi_tpu import QueryCallback
+
+    m = _manager(4)
+    rt = m.create_siddhi_app_runtime("""
+        @OnError(action='stream')
+        define stream S (sym string, v long);
+        @info(name='pq') from S select sym, v insert into Out;
+    """)
+
+    class Boom(QueryCallback):
+        def receive(self, timestamp, in_events, remove_events):
+            raise ValueError("callback exploded")
+
+    faults = Collector()
+    rt.add_callback("pq", Boom())
+    rt.add_callback("!S", faults)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1])      # sync send -> dispatch -> flush -> emit raises
+    assert len(faults.rows) == 1
+    sym, v, err = faults.rows[0]
+    assert (sym, v) == ("A", 1) and "callback exploded" in err
+    m.shutdown()
+
+
+def test_defer_meta_maps_onto_pipeline_depth():
+    """Deprecation shim: defer_meta>1 becomes pipeline_depth (MIGRATION
+    note); the legacy hold-N queue no longer engages."""
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.defer_meta": "4"}))
+    with pytest.warns(DeprecationWarning, match="defer_meta"):
+        rt = m.create_siddhi_app_runtime(APP)
+    assert rt.app_context.pipeline_depth == 4
+    assert rt.app_context.defer_meta == 1
+    out = Collector()
+    rt.add_callback("Out", out)
+    h = rt.get_input_handler("S")
+    h.send(["A", 1])
+    assert out.rows == [("A", 1)]   # sync semantics, no defer lag
+    m.shutdown()
+
+
+def test_depth_one_bypasses_pump():
+    m = _manager(1)
+    rt = m.create_siddhi_app_runtime(APP)
+    out = Collector()
+    rt.add_callback("Out", out)
+    qr = rt.query_runtimes["pq"]
+    pump = rt.app_context.completion_pump
+    qr.receive_batch(_batch(rt, [1]))
+    # synchronous: emitted inline, nothing ever rode the pipeline
+    assert out.rows == [("A", 1)]
+    assert not pump.has_pending
+    m.shutdown()
