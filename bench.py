@@ -364,6 +364,106 @@ insert into OutStream;
     return [run_one(d) for d in (1, 2, 4, 8)]
 
 
+def bench_serving():
+    """Serving-tier shard curve (ISSUE 6): ingest eps and on-demand store
+    query p50/p99 under MIXED load, for 1/2/4/8 aggregation shards. An
+    ingest thread pumps columnar batches into a grouped multi-granularity
+    aggregation the whole time while two query threads fire canned
+    `within ... per ...` reads (in-process `rt.query` — the REST hop is
+    measured by tools/serve_soak.py). Sharded reads scatter per-shard
+    epoch-pinned partials and ordered-merge them without the app barrier,
+    so the signal is (a) ingest eps holding steady under the query storm
+    and (b) query latency vs shard count."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+    from siddhi_tpu.observability.histogram import Histogram
+
+    app = """
+@app:name('BenchServe')
+define stream TradeStream (symbol string, price double, ts long);
+define aggregation TradeAgg
+from TradeStream
+select symbol, sum(price) as total, count() as n
+group by symbol
+aggregate by ts every sec ... day;
+"""
+    KEYS, B, TS_RANGE = 50, 512, 600_000
+    measure_s = float(os.environ.get("BENCH_SERVING_SECONDS", 8.0))
+    rng = np.random.default_rng(11)
+    syms = np.array([f"S{i}" for i in range(KEYS)], dtype=object)
+    queries = [
+        f"from TradeAgg within {lo}L, {lo + 300_000}L per '{p}' "
+        f"select AGG_TIMESTAMP, symbol, total, n"
+        for p in ("seconds", "minutes", "hours")
+        for lo in (0, 150_000, 300_000)
+    ]
+
+    def run_one(shards: int):
+        import threading
+
+        manager = SiddhiManager()
+        manager.set_config_manager(InMemoryConfigManager(
+            {"siddhi_tpu.agg_shards": str(shards)}))
+        rt = manager.create_siddhi_app_runtime(app)
+        h = rt.get_input_handler("TradeStream")
+        pre = []
+        for i in range(4):
+            ids = rng.integers(0, KEYS, B)
+            pre.append({
+                "symbol": syms[ids],
+                "price": (rng.random(B) * 100.0).astype(np.float64),
+                "ts": rng.integers(0, TS_RANGE, B, dtype=np.int64)})
+        h.send_columns(pre[0], timestamps=np.arange(B, dtype=np.int64))
+        for q in queries:    # warm the on-demand plans + jit shapes
+            rt.query(q)
+
+        stop = threading.Event()
+        sent = {"n": 0}
+
+        def ingest():
+            i = 0
+            while not stop.is_set():
+                h.send_columns(pre[i % 4],
+                               timestamps=np.arange(B, dtype=np.int64))
+                sent["n"] += B
+                i += 1
+
+        hist = Histogram()
+        qcount = {"n": 0}
+
+        def querier(ci):
+            qrng = np.random.default_rng(100 + ci)
+            while not stop.is_set():
+                q = queries[int(qrng.integers(0, len(queries)))]
+                t0 = time.perf_counter()
+                rt.query(q)
+                hist.record((time.perf_counter() - t0) * 1000.0)
+                qcount["n"] += 1
+
+        threads = [threading.Thread(target=ingest, daemon=True)] + [
+            threading.Thread(target=querier, args=(i,), daemon=True)
+            for i in range(2)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(measure_s)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        dt = time.perf_counter() - t0
+        manager.shutdown()
+        return {
+            "shards": shards,
+            "ingest_eps": round(sent["n"] / dt, 1),
+            "queries": qcount["n"],
+            "query_qps": round(qcount["n"] / dt, 1),
+            "query_p50_ms": round(hist.quantile(0.50), 2),
+            "query_p99_ms": round(hist.quantile(0.99), 2),
+        }
+
+    return [run_one(s) for s in (1, 2, 4, 8)]
+
+
 def bench_host_pipeline():
     """Host-pipeline throughput with the device step STUBBED: the full
     ingest pump — string columns -> dictionary encode (native strdict.cpp)
@@ -869,6 +969,8 @@ def main():
         "fanout_backend": None,
         "pipeline_curve": None,                 # [(depth, eps, metas/pull)]
         "pipeline_backend": None,
+        "serving_curve": None,                  # shard-count mixed-load curve
+        "serving_backend": None,
         "host_pipeline_events_per_sec": None,   # device step stubbed
         "ingest_csv_events_per_sec": None,      # native CSV loader -> pump
         "mesh_scaling_eps": None,               # {n_devices: eps}, key-sharded
@@ -1031,6 +1133,16 @@ def main():
         else:
             result["sections_failed"].append("pipeline")
         emit()
+    # serving-tier shard curve (ISSUE 6): mixed ingest + on-demand store
+    # queries over 1/2/4/8 aggregation shards; CPU-only workload today
+    # (the rollup cube lives host-side), so never tunnel-gated
+    out, _ = _run_section_once("serving_cpu", min(300.0, remaining()))
+    if out is not None:
+        result["serving_curve"] = out["points"]
+        result["serving_backend"] = "cpu-fallback"
+    else:
+        result["sections_failed"].append("serving")
+    emit()
     out, _ = _run_section_once("scaling_cpu", min(240.0, remaining()))
     if out is not None:
         result["mesh_scaling_eps"] = {
@@ -1099,6 +1211,8 @@ if __name__ == "__main__":
             print(json.dumps({"points": bench_fanout()}))
         elif section == "pipeline":
             print(json.dumps({"points": bench_pipeline_curve()}))
+        elif section == "serving":
+            print(json.dumps({"points": bench_serving()}))
         else:
             raise SystemExit(f"unknown section {section}")
     else:

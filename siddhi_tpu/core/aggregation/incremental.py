@@ -324,6 +324,24 @@ class IncrementalAggregationRuntime(Receiver):
                     f"configured 'shardId' property")
             self.shard_id = cfg
 
+        # /metrics: per-granularity rollup bucket-count gauges and a
+        # flush-latency (ingest fold) histogram, registered on the
+        # always-on telemetry registry so the unsharded path and the
+        # serving tier's sharded path are both scraped the same way
+        self._flush_hist = None
+        tel = getattr(app_context, "telemetry", None)
+        if tel is not None and hasattr(tel, "histogram"):
+            aid = definition.id
+            for d in self.durations:
+                tel.gauge(f"aggregation.{aid}.{d.value}.buckets",
+                          lambda d=d: self._bucket_count(d))
+            self._flush_hist = tel.histogram(f"aggregation.{aid}.flush_ms")
+
+    def _bucket_count(self, duration: Duration) -> int:
+        """Live bucket count for one granularity (telemetry gauge); the
+        sharded serving runtime overrides this to sum its shards."""
+        return len(self.store.get(duration, ()))
+
     def purge(self, now: Optional[int] = None) -> int:
         """Drop buckets older than each duration's retention; returns the
         number of purged buckets (reference IncrementalDataPurger run)."""
@@ -388,13 +406,33 @@ class IncrementalAggregationRuntime(Receiver):
     # ------------------------------------------------------------- ingest
 
     def receive(self, events: List[Event]):
+        import time
+
+        prep = self._prepare_batch(events)
+        if prep is None:
+            return
+        t0 = time.perf_counter()
+        with self._lock:
+            self._fold_rows(self, prep, prep["idx"])
+        hist = getattr(self, "_flush_hist", None)
+        if hist is not None:
+            hist.record((time.perf_counter() - t0) * 1000.0)
+
+    def _prepare_batch(self, events: List[Event]) -> Optional[dict]:
+        """Run the compiled rollup PROGRAM over one batch: timestamps,
+        group keys, base argument columns and per-duration bucket starts —
+        everything that is independent of WHICH store the rows fold into.
+        The sharded serving tier (``siddhi_tpu/serving/``) prepares once
+        and folds per shard, sharing this program across shards instead of
+        compiling one per shard (the semantic-overlap sharing of
+        PAPERS.md applied to rollup programs)."""
         batch = HostBatch.from_events(events, self.input_def, self.dictionary)
         cols = batch.cols
         ctx = {"xp": np}
         valid = cols[VALID_KEY] & (cols[TYPE_KEY] == 0)
         idx = np.nonzero(valid)[0]
         if idx.size == 0:
-            return
+            return None
         if self.ts_fn is not None:
             tsv, _m = self.ts_fn(cols, ctx)
             if self.ts_is_string:
@@ -424,7 +462,7 @@ class IncrementalAggregationRuntime(Receiver):
                 valid = valid & ok
                 idx = np.nonzero(valid)[0]
                 if idx.size == 0:
-                    return
+                    return None
             else:
                 tsv = np.broadcast_to(np.asarray(tsv, np.int64), valid.shape)
         else:
@@ -449,30 +487,51 @@ class IncrementalAggregationRuntime(Receiver):
                 base_null[key] = (np.broadcast_to(np.asarray(m), valid.shape)
                                   if m is not None else None)
 
+        return {
+            "idx": idx,
+            "tsv": tsv,
+            "groups": groups,
+            "group_tuples": {int(i): tuple(x[i].item() for x in groups)
+                             for i in idx},
+            "base_vals": base_vals,
+            "base_null": base_null,
+            "buckets": {d: bucket_starts(tsv, d) for d in self.durations},
+        }
+
+    def _fold_rows(self, holder, prep: dict, rows) -> None:
+        """Fold prepared rows into ``holder``'s bucket stores. ``holder``
+        supplies ``store`` / ``_dirty`` / ``_deleted`` (this runtime, or
+        one ``AggregationShard`` of the serving tier); the caller holds
+        the holder's lock."""
         base_keys = list(self.bases)
-        with self._lock:
-            for d in self.durations:
-                buckets = bucket_starts(tsv, d)
-                dstore = self.store[d]
-                for i in idx:
-                    b = int(buckets[i])
-                    g = tuple(x[i].item() for x in groups)
-                    self._dirty.add((d, b))
-                    self._deleted.discard((d, b))   # re-created after purge
-                    slot = dstore.setdefault(b, {}).get(g)
-                    if slot is None:
-                        slot = dstore[b][g] = [None] * len(base_keys)
-                    for j, k in enumerate(base_keys):
-                        nm = base_null[k]
-                        if nm is not None and nm[i]:
-                            continue  # null arg leaves the base untouched
-                        spec = self.bases[k]
-                        v = base_vals[k][i].item()
-                        if spec.kind == "distinct":
-                            v = {v}
-                        elif spec.kind == "last":
-                            v = (int(tsv[i]), v)   # event-time-tagged
-                        slot[j] = spec.fold(slot[j], v)
+        tsv = prep["tsv"]
+        base_vals, base_null = prep["base_vals"], prep["base_null"]
+        group_tuples = prep["group_tuples"]
+        for d in self.durations:
+            buckets = prep["buckets"][d]
+            # setdefault: a restore may have replaced the store with a
+            # snapshot keeping fewer granularities — ingest re-creates
+            # the declared ones rather than crashing
+            dstore = holder.store.setdefault(d, {})
+            for i in rows:
+                b = int(buckets[i])
+                g = group_tuples[int(i)]
+                holder._dirty.add((d, b))
+                holder._deleted.discard((d, b))   # re-created after purge
+                slot = dstore.setdefault(b, {}).get(g)
+                if slot is None:
+                    slot = dstore[b][g] = [None] * len(base_keys)
+                for j, k in enumerate(base_keys):
+                    nm = base_null[k]
+                    if nm is not None and nm[i]:
+                        continue  # null arg leaves the base untouched
+                    spec = self.bases[k]
+                    v = base_vals[k][i].item()
+                    if spec.kind == "distinct":
+                        v = {v}
+                    elif spec.kind == "last":
+                        v = (int(tsv[i]), v)   # event-time-tagged
+                    slot[j] = spec.fold(slot[j], v)
 
     # -------------------------------------------------------------- query
 
@@ -489,16 +548,15 @@ class IncrementalAggregationRuntime(Receiver):
                 seen.add(a.name)
         return StreamDefinition(id=self.definition.id, attributes=attrs)
 
-    def rows(self, duration: Duration,
-             within: Optional[Tuple[int, int]] = None) -> List[list]:
-        """Final (stitched) rows for one duration: [AGG_TS, outputs...,
-        group attrs...] — closed and open buckets alike (the reference's
-        table + running-store stitch)."""
+    def _resolve_within(self, duration: Duration,
+                        within: Optional[Tuple[int, int]]):
+        # checked against the STORE, not self.durations: a restore may
+        # have replaced the store with a snapshot keeping fewer (or more)
+        # granularities, and the queryable set follows the state
         if duration not in self.store:
             raise CompileError(
                 f"aggregation '{self.definition.id}' does not keep "
                 f"'{duration.value}' granularity")
-        base_keys = list(self.bases)
         if within is not None:
             # the reference truncates the within-START down to the queried
             # duration's bucket start (IncrementalTimeConverterUtil via
@@ -507,38 +565,57 @@ class IncrementalAggregationRuntime(Receiver):
             # test44: a 1-second range read `per "hours"`)
             start = int(bucket_starts(np.asarray([within[0]]), duration)[0])
             within = (start, within[1])
+        return within
+
+    def _rows_from_items(self, items) -> List[list]:
+        """Compute the final output rows from (bucket, group, base-values)
+        items — ONE code path shared by the single-store read and the
+        serving tier's cross-shard stitched read, so sharded and unsharded
+        results are computed bit-identically."""
+        base_keys = list(self.bases)
         out_rows: List[list] = []
+        onames = {o.name for o in self.outputs}
+        gnames = [a.name for a in self.group_attrs]
+        for b, g, vals in items:
+            by_key = dict(zip(base_keys, vals))
+            row = [b]
+            for o in self.outputs:
+                if o.kind == "group":
+                    row.append(g[gnames.index(o.bases[0])])
+                elif o.kind == "avg":
+                    s, c = by_key[o.bases[0]], by_key[o.bases[1]]
+                    row.append(s / c if (c and s is not None) else None)
+                elif o.kind == "count":
+                    row.append(by_key[o.bases[0]] or 0)
+                elif o.kind == "distinctcount":
+                    s = by_key[o.bases[0]]
+                    row.append(len(s) if s else 0)
+                elif o.kind == "last":
+                    v = by_key[o.bases[0]]  # (event_ts, value) pair
+                    # bare pre-pair-layout snapshot values pass through
+                    row.append(v[1] if isinstance(v, tuple) else v)
+                else:
+                    row.append(by_key[o.bases[0]])  # None -> null output
+            for gi, a in enumerate(self.group_attrs):
+                if a.name not in onames:
+                    row.append(g[gi])
+            out_rows.append(row)
+        return out_rows
+
+    def rows(self, duration: Duration,
+             within: Optional[Tuple[int, int]] = None) -> List[list]:
+        """Final (stitched) rows for one duration: [AGG_TS, outputs...,
+        group attrs...] — closed and open buckets alike (the reference's
+        table + running-store stitch)."""
+        within = self._resolve_within(duration, within)
+        items = []
         with self._lock:
             for b in sorted(self.store[duration]):
                 if within is not None and not (within[0] <= b < within[1]):
                     continue
                 for g, vals in self.store[duration][b].items():
-                    by_key = dict(zip(base_keys, vals))
-                    row = [b]
-                    for o in self.outputs:
-                        if o.kind == "group":
-                            gi = [a.name for a in self.group_attrs].index(o.bases[0])
-                            row.append(g[gi])
-                        elif o.kind == "avg":
-                            s, c = by_key[o.bases[0]], by_key[o.bases[1]]
-                            row.append(s / c if (c and s is not None) else None)
-                        elif o.kind == "count":
-                            row.append(by_key[o.bases[0]] or 0)
-                        elif o.kind == "distinctcount":
-                            s = by_key[o.bases[0]]
-                            row.append(len(s) if s else 0)
-                        elif o.kind == "last":
-                            v = by_key[o.bases[0]]  # (event_ts, value) pair
-                            # bare pre-pair-layout snapshot values pass through
-                            row.append(v[1] if isinstance(v, tuple) else v)
-                        else:
-                            row.append(by_key[o.bases[0]])  # None -> null output
-                    onames = {o.name for o in self.outputs}
-                    for gi, a in enumerate(self.group_attrs):
-                        if a.name not in onames:
-                            row.append(g[gi])
-                    out_rows.append(row)
-        return out_rows
+                    items.append((b, g, list(vals)))
+        return self._rows_from_items(items)
 
     def contents(self, duration: Duration,
                  within: Optional[Tuple[int, int]] = None):
@@ -546,13 +623,24 @@ class IncrementalAggregationRuntime(Receiver):
         duration: (output_definition, cols, valid) — shared by on-demand
         `within/per` queries and aggregation joins (reference
         ``AggregationRuntime.java:331-357`` compiled selection)."""
+        return self._columnize(self.rows(duration, within))
+
+    def _columnize(self, rows: List[list]):
+        """Rows -> (output_definition, columnar numpy arrays, valid mask) —
+        the probe surface shape shared with tables/named windows."""
         from siddhi_tpu.ops.expressions import TS_KEY
         from siddhi_tpu.ops.types import dtype_of
 
         definition = self.output_definition()
-        rows = self.rows(duration, within)
         n = len(rows)
-        cap = max(n, 1)
+        # pad to the next power of two: the on-demand selector stage jits
+        # per columnar SHAPE, and under live ingest the stitched row count
+        # moves with every fold — raw-n capacity meant a recompile per
+        # query, pow2 padding means a handful of shapes per query text
+        # (padding rows stay valid=False, exactly like table capacity)
+        cap = 1
+        while cap < n:
+            cap *= 2
         cols = {}
         for pos, attr in enumerate(definition.attributes):
             dt = dtype_of(attr.type)
@@ -678,7 +766,34 @@ class IncrementalAggregationRuntime(Receiver):
                 }
             }
 
+    def _merge_sharded_snapshot(self, snap: dict) -> dict:
+        """Fold a serving-tier sharded snapshot ({"sharded": True,
+        "shards": [...]}) into one flat store dict — the shard-stitch rule
+        (``_BaseSpec.fold`` per base) applied at restore time, so
+        pre-sharding and post-sharding revisions cross-restore in both
+        directions (the PR-3 fusion-config precedent)."""
+        snap_keys = snap.get("base_keys", list(self.bases))
+        store: dict = {}
+        for shard_snap in snap.get("shards", []):
+            for dv, dstore in shard_snap.get("store", {}).items():
+                dd = store.setdefault(dv, {})
+                for b, groups in dstore.items():
+                    bg = dd.setdefault(b, {})
+                    for g, vals in groups.items():
+                        cur = bg.get(g)
+                        if cur is None:
+                            bg[g] = list(vals)
+                        else:  # duplicate (bucket, group): fold the bases
+                            bg[g] = [
+                                self.bases[k].fold(a, v)
+                                if k in self.bases
+                                else (v if v is not None else a)
+                                for k, a, v in zip(snap_keys, cur, vals)]
+        return {"base_keys": snap_keys, "store": store}
+
     def restore(self, snap: dict):
+        if snap.get("sharded"):
+            snap = self._merge_sharded_snapshot(snap)
         # realign slot lists by base-key name so snapshots survive base
         # layout changes (e.g. avg gaining a cnt@ base)
         snap_keys = snap.get("base_keys")

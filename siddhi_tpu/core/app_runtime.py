@@ -154,7 +154,7 @@ class SiddhiAppRuntime:
         if cm is not None:
             for knob in ("window_capacity", "partition_window_capacity",
                          "nfa_slots", "initial_key_capacity", "defer_meta",
-                         "pipeline_depth"):
+                         "pipeline_depth", "agg_shards", "agg_shard_wal"):
                 v = cm.get_property(f"siddhi_tpu.{knob}")
                 if v is not None:
                     setattr(self.app_context, knob, int(v))
@@ -267,8 +267,25 @@ class SiddhiAppRuntime:
 
         self.aggregations: Dict[str, IncrementalAggregationRuntime] = {}
         for aid, adef in siddhi_app.aggregation_definitions.items():
-            agg = IncrementalAggregationRuntime(
-                adef, self.app_context, dictionary, self.stream_definitions)
+            n_shards = int(getattr(self.app_context, "agg_shards", 1) or 1)
+            # @PartitionById (annotation or system property) keeps the
+            # legacy DB shard-stitch runtime — the two sharding modes are
+            # mutually exclusive (MIGRATION.md)
+            pbi = find_annotation(adef.annotations or [], "PartitionById")
+            sys_pbi = ((cm.get_property("partitionById") or "")
+                       if cm is not None else "").lower() == "true"
+            if n_shards > 1 and pbi is None and not sys_pbi:
+                from siddhi_tpu.serving import ShardedIncrementalAggregation
+
+                agg = ShardedIncrementalAggregation(
+                    adef, self.app_context, dictionary,
+                    self.stream_definitions, n_shards=n_shards,
+                    wal_batches=getattr(self.app_context,
+                                        "agg_shard_wal", 1024) or None)
+            else:
+                agg = IncrementalAggregationRuntime(
+                    adef, self.app_context, dictionary,
+                    self.stream_definitions)
             self.junctions[agg.input_stream_id].subscribe(agg)
             self.aggregations[aid] = agg
         self.app_context.aggregations = self.aggregations
@@ -1091,8 +1108,13 @@ class SiddhiAppRuntime:
         # extensions + script functions)
         _expr_mod.set_active_extensions(self._extensions)
 
-        with self._barrier:
-            return run_on_demand_query(on_demand_query, self)
+        # barrier management lives in run_on_demand_query: mutations and
+        # table/window finds serialize on the app barrier as before, but
+        # aggregation store-queries read epoch-pinned per-shard snapshots
+        # and must NOT hold it — the serving tier's whole point is that a
+        # dashboard query storm never stalls ingest (which takes the same
+        # barrier on every send)
+        return run_on_demand_query(on_demand_query, self)
 
     @property
     def query_names(self) -> List[str]:
@@ -1135,13 +1157,13 @@ def _agg_store_bytes(agg) -> int:
     plus any array-valued running state. The reference sizes this with a
     reflective object walk (ObjectSizeCalculator.java:66); the dense cube
     makes it a direct count."""
-    from siddhi_tpu.core.util.statistics import pytree_nbytes
-
     total = 0
-    for dstore in getattr(agg, "store", {}).values():
-        for groups in dstore.values():
-            for vals in groups.values():
-                total += 8 * len(vals)
+    # sharded serving runtimes hold their cube in per-shard stores
+    for holder in (getattr(agg, "shards", None) or [agg]):
+        for dstore in getattr(holder, "store", {}).values():
+            for groups in dstore.values():
+                for vals in groups.values():
+                    total += 8 * len(vals)
     for v in vars(agg).values():
         if hasattr(v, "nbytes"):
             total += int(v.nbytes)

@@ -203,6 +203,17 @@ class SiddhiAppContext:
         # Off = every query keeps its own dispatch. Set via ConfigManager
         # key siddhi_tpu.fuse_fanout.
         self.fuse_fanout = True
+        # serving tier (siddhi_tpu/serving/): >1 key-partitions every
+        # incremental aggregation's bucket state across this many
+        # in-process shards (round-robin over mesh devices) and answers
+        # on-demand `within ... per ...` queries by scatter-gather ordered
+        # merge. Set via ConfigManager key siddhi_tpu.agg_shards.
+        # @PartitionById (DB shard-stitch) aggregations keep the legacy
+        # single-store runtime regardless — see MIGRATION.md.
+        self.agg_shards = 1
+        # per-shard bounded WAL (batches) backing the shard rebuild
+        # protocol; 0 disables shard WALs. Key siddhi_tpu.agg_shard_wal.
+        self.agg_shard_wal = 1024
         # resilience subsystem attach points (siddhi_tpu/resilience/):
         # bounded ingest replay log + app supervisor, set by
         # SiddhiAppRuntime.enable_wal() / .supervise()

@@ -255,7 +255,14 @@ def run_on_demand_query(source: str, app_runtime) -> List[Event]:
     """Parse/compile-once, execute-per-call: compiled FIND runtimes are
     cached per query text, capped at 50 with oldest-inserted eviction
     (reference ``SiddhiAppRuntimeImpl.java:344-351``). Mutations recompile
-    per call (their compile is a fraction of the store write they do)."""
+    per call (their compile is a fraction of the store write they do).
+
+    Barrier scope: mutations and table/named-window finds hold the app
+    barrier (their stores are mutated by streaming output under the same
+    barrier). Aggregation store-queries run WITHOUT it — the single-store
+    runtime snapshots under its own lock, and the serving tier's sharded
+    runtime reads epoch-pinned per-shard snapshots — so a storm of
+    dashboard `within ... per ...` reads never blocks ingest."""
     cache = getattr(app_runtime, "_on_demand_cache", None)
     if cache is None:
         from collections import OrderedDict
@@ -266,12 +273,16 @@ def run_on_demand_query(source: str, app_runtime) -> List[Event]:
         oq: OnDemandQuery = SiddhiCompiler.parse_on_demand_query(source)
         dictionary = app_runtime.app_context.string_dictionary
         if oq.type != "find" or oq.input_store is None:
-            return _run_mutation(oq, app_runtime, dictionary)
+            with app_runtime._barrier:
+                return _run_mutation(oq, app_runtime, dictionary)
         rt = OnDemandFindRuntime(oq, app_runtime, dictionary)
         cache[source] = rt
         if len(cache) > 50:
             cache.popitem(last=False)
-    return rt.execute()
+    if rt.agg is not None:
+        return rt.execute()
+    with app_runtime._barrier:
+        return rt.execute()
 
 
 class OnDemandFindRuntime:

@@ -36,6 +36,11 @@ RESILIENCE_COUNTERS = (
     "resilience.sink_retries",
     "resilience.peer_failures",
     "resilience.peer_recoveries",
+    # serving tier (siddhi_tpu/serving/)
+    "resilience.query_sheds",
+    "resilience.shard_rebuilds",
+    "resilience.shard_replay_skips",
+    "resilience.shard_replay_gaps",
 )
 
 _JUNCTION_GAUGE = re.compile(r"^junction\.(?P<stream>.+)\.(?P<kind>"
@@ -59,6 +64,32 @@ _PIPELINE_COUNTER_FAMILY = {
     "pipeline.pulls": ("siddhi_pipeline_meta_pulls_total",
                        "device->host round trips made by pipeline "
                        "drains"),
+}
+
+# serving tier (siddhi_tpu/serving/): aggregation rollup + scatter-gather
+_AGG_BUCKETS = re.compile(r"^aggregation\.(?P<agg>.+)\.(?P<dur>[a-z]+)"
+                          r"\.buckets$")
+_AGG_SHARDS = re.compile(r"^aggregation\.(?P<agg>.+)\.shards$")
+_AGG_SHARD_WAL = re.compile(r"^aggregation\.(?P<agg>.+)\.shard"
+                            r"(?P<shard>\d+)\.wal_batches$")
+_AGG_FLUSH_HIST = re.compile(r"^aggregation\.(?P<agg>.+)\.flush_ms$")
+_SERVING_QUERY_HIST = re.compile(r"^serving\.query\.(?P<dur>[a-z]+)_ms$")
+_SERVING_COUNTER_FAMILY = {
+    "serving.queries": ("siddhi_serving_queries_total",
+                        "on-demand queries admitted by the serving tier"),
+    "serving.sheds": ("siddhi_serving_shed_total",
+                      "on-demand queries shed at the per-endpoint "
+                      "admission cap (HTTP 503)"),
+    "serving.shard_rebuilds": ("siddhi_serving_shard_rebuilds_total",
+                               "aggregation shards rebuilt from "
+                               "checkpoint blob + WAL suffix"),
+}
+_SERVING_HIST_FAMILY = {
+    "serving.fanout_ms": ("siddhi_serving_fanout_ms",
+                          "scatter fan-out time across aggregation "
+                          "shards (ms)"),
+    "serving.merge_ms": ("siddhi_serving_merge_ms",
+                         "ordered cross-shard rollup merge time (ms)"),
 }
 
 
@@ -102,6 +133,20 @@ class _Families:
         return "\n".join(lines) + "\n"
 
 
+def _add_histogram(fams: _Families, family: str, help_: str,
+                   labels: Dict[str, str], snap: dict) -> None:
+    """Render one telemetry histogram snapshot as a Prometheus summary
+    (quantile samples + _sum/_count), matching the latency-tracker
+    exposition shape."""
+    for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+        fams.add(family, "summary", help_,
+                 {**labels, "quantile": q}, snap.get(key, 0.0))
+    fams.add(family, "summary", help_, labels, snap.get("sum", 0.0),
+             suffix="_sum")
+    fams.add(family, "summary", help_, labels, snap.get("count", 0),
+             suffix="_count")
+
+
 def app_snapshot(rt) -> dict:
     """JSON-ready metrics for one app runtime."""
     sm = rt.app_context.statistics_manager
@@ -142,6 +187,32 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                     fams.add("siddhi_pipeline_depth", "gauge",
                              "device batches riding the dispatch pipeline",
                              {**base, "query": m.group("query")}, v)
+                elif _AGG_SHARD_WAL.match(name):
+                    m = _AGG_SHARD_WAL.match(name)
+                    fams.add("siddhi_aggregation_shard_wal_batches", "gauge",
+                             "retained per-shard WAL batches (rebuild "
+                             "replay suffix)",
+                             {**base, "name": m.group("agg"),
+                              "shard": m.group("shard")}, v)
+                elif _AGG_SHARDS.match(name):
+                    m = _AGG_SHARDS.match(name)
+                    fams.add("siddhi_aggregation_shards", "gauge",
+                             "in-process key shards of the aggregation "
+                             "rollup state",
+                             {**base, "name": m.group("agg")}, v)
+                elif _AGG_BUCKETS.match(name):
+                    m = _AGG_BUCKETS.match(name)
+                    fams.add("siddhi_aggregation_buckets", "gauge",
+                             "live rollup buckets per granularity",
+                             {**base, "name": m.group("agg"),
+                              "duration": m.group("dur")}, v)
+                elif name in ("serving.pool.pending", "serving.pool.active"):
+                    kind = name.rsplit(".", 1)[1]
+                    fams.add(f"siddhi_serving_pool_{kind}", "gauge",
+                             ("on-demand queries admitted and not yet "
+                              "finished" if kind == "pending"
+                              else "on-demand queries currently "
+                                   "executing"), base, v)
                 else:
                     fams.add("siddhi_gauge", "gauge",
                              "registered telemetry gauge",
@@ -163,12 +234,37 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                      {**base, "stream": m.group("stream")}, v)
             continue
         fam = _PIPELINE_COUNTER_FAMILY.get(name)
+        if fam is None:
+            fam = _SERVING_COUNTER_FAMILY.get(name)
         if fam is not None:
             fams.add(fam[0], "counter", fam[1], base, v)
             continue
         fams.add("siddhi_counter_total", "counter",
                  "named event counter",
                  {**base, "name": name}, v)
+    for name, snap in sorted(tel_snapshot.get("histograms", {}).items()):
+        fam = _SERVING_HIST_FAMILY.get(name)
+        labels = dict(base)
+        if fam is not None:
+            family, help_ = fam
+        else:
+            m = _AGG_FLUSH_HIST.match(name)
+            if m:
+                family = "siddhi_aggregation_flush_ms"
+                help_ = "aggregation ingest fold latency per batch (ms)"
+                labels["name"] = m.group("agg")
+            else:
+                m = _SERVING_QUERY_HIST.match(name)
+                if m:
+                    family = "siddhi_serving_query_ms"
+                    help_ = ("on-demand store-query latency per "
+                             "granularity (ms)")
+                    labels["granularity"] = m.group("dur")
+                else:
+                    family = "siddhi_histogram_ms"
+                    help_ = "registered telemetry histogram (ms)"
+                    labels["name"] = name
+        _add_histogram(fams, family, help_, labels, snap)
     for key, rec in sorted(tel_snapshot.get("jit", {}).items()):
         kl = {**base, "key": key}
         fams.add("siddhi_jit_compiles_total", "counter",

@@ -75,6 +75,10 @@ class TelemetryRegistry:
         self.counters: Dict[str, int] = {}
         # key -> {"compiles": int, "compile_ms": float, "hits": int}
         self.jit: Dict[str, dict] = {}
+        # name -> observability.histogram.Histogram (ms by convention):
+        # always-on percentile series outside the @app:statistics levels —
+        # aggregation flush latency, serving-tier fan-out/merge/query time
+        self.histograms: Dict[str, object] = {}
 
     # ------------------------------------------------------------- gauges
 
@@ -104,6 +108,21 @@ class TelemetryRegistry:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
+    # ---------------------------------------------------------- histograms
+
+    def histogram(self, name: str):
+        """Get-or-create a named log-bucket latency histogram
+        (``observability/histogram.py``) — O(1) record, p50/p95/p99 on
+        every scrape. Idempotent: call sites keep the returned object and
+        record on it directly."""
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                from siddhi_tpu.observability.histogram import Histogram
+
+                h = self.histograms[name] = Histogram()
+            return h
+
     # --------------------------------------------------------- jit events
 
     def record_jit(self, key: str, wall_ms: float = 0.0,
@@ -130,13 +149,19 @@ class TelemetryRegistry:
         with self._lock:
             counters = dict(self.counters)
             jit = {k: dict(v) for k, v in self.jit.items()}
-        return {"gauges": self.read_gauges(), "counters": counters,
-                "jit": jit}
+            hists = {k: h.snapshot() for k, h in self.histograms.items()}
+        out = {"gauges": self.read_gauges(), "counters": counters,
+               "jit": jit}
+        if hists:
+            out["histograms"] = hists
+        return out
 
     def reset(self) -> None:
         with self._lock:
             self.counters.clear()
             self.jit.clear()
+            for h in self.histograms.values():
+                h.reset()
 
 
 _GLOBAL = TelemetryRegistry()

@@ -100,6 +100,11 @@ class IngestWAL:
         # checkpoint trim); restore_revision consults it so a restore of
         # an OLDER revision does not graft the suffix onto a stale base
         self.checkpoint_revision: Optional[str] = None
+        # highest sequence any checkpoint trim has covered: a restore of a
+        # snapshot whose cut predates this must SKIP the replay (the
+        # retained suffix follows a newer base) — consulted by the serving
+        # tier's per-shard rebuild (serving/sharded_aggregation.py)
+        self.checkpoint_seq = 0
         # re-record suppression is scoped to the REPLAYING THREAD only:
         # live ingest accepted concurrently on other threads must still
         # be recorded, or the next failure silently loses it
@@ -168,6 +173,8 @@ class IngestWAL:
                 rec = self._log.popleft()
                 self._events -= rec.size
                 n += 1
+            if upto_seq > self.checkpoint_seq:
+                self.checkpoint_seq = upto_seq
         return n
 
     def mark_checkpoint(self, revision: Optional[str] = None) -> int:
@@ -187,6 +194,14 @@ class IngestWAL:
     @property
     def pending_events(self) -> int:
         return self._events
+
+    def records_after(self, seq: int) -> List[_Record]:
+        """Retained records with sequence > ``seq`` (oldest first) — the
+        suffix a restored snapshot with cut ``seq`` is missing. Used by
+        shard-scoped rebuilds that re-fold records directly instead of
+        re-sending through input handlers."""
+        with self._lock:
+            return [r for r in self._log if r.seq > seq]
 
     def replay(self, app_runtime) -> int:
         """Re-send the retained suffix in arrival order through the given

@@ -11,7 +11,11 @@ Endpoints (JSON in/out):
 - ``POST /apps``                       — body = SiddhiQL app text (deploy + start)
 - ``DELETE /apps/<name>``              — shutdown + undeploy
 - ``POST /apps/<name>/events``         — ``{"stream": S, "data": [...] | [[...], ...], "timestamp": optional}``
-- ``POST /query``                      — ``{"app": name, "query": "<on-demand query>"}`` -> rows
+- ``POST /query``                      — ``{"app": name, "query": "<on-demand query>"}`` -> rows;
+  runs on a bounded executor with a per-endpoint queue cap
+  (``siddhi_tpu/serving/query_tier.py``) — past the cap the request is
+  SHED with ``503`` + ``Retry-After`` instead of queuing behind the app
+  barrier, so a query storm never stalls ingest
 - ``GET  /apps/<name>/statistics``     — metrics snapshot
 - ``POST /apps/<name>/persist``        — checkpoint; -> ``{"revision": ...}``
 - ``POST /apps/<name>/restore``        — ``{"revision": optional}`` (last when omitted)
@@ -44,12 +48,21 @@ from typing import Optional
 
 class SiddhiRestService:
     def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
-                 trace_base: Optional[str] = None):
+                 trace_base: Optional[str] = None,
+                 query_workers: int = 8, query_queue_cap: int = 64):
         self.manager = manager
         # profiler traces are confined under this directory; REST clients
         # supply a relative name, never an absolute filesystem path
         self.trace_base = trace_base or os.path.join(
             tempfile.gettempdir(), "siddhi_tpu_traces")
+        # on-demand queries run on a bounded executor with a per-endpoint
+        # queue cap (siddhi_tpu/serving/query_tier.py): a query storm
+        # degrades to fast 503s instead of stacking handler threads behind
+        # the app barrier and stalling ingest
+        from siddhi_tpu.serving.query_tier import AdmissionPool
+
+        self.admission = AdmissionPool(max_workers=query_workers,
+                                       default_cap=query_queue_cap)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -120,6 +133,7 @@ class SiddhiRestService:
         self._server.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self.admission.shutdown()
 
     # ------------------------------------------------------------ handlers
 
@@ -174,8 +188,25 @@ class SiddhiRestService:
             h._send(201, {"app": rt.name})
             return
         if parts == ["query"]:
+            from siddhi_tpu.resilience import stat_count
+            from siddhi_tpu.serving.query_tier import QueryShedError
+
             rt = self._rt(body["app"])
-            events = rt.query(body["query"])
+            try:
+                fut = self.admission.try_submit(
+                    "/query", rt.query, body["query"])
+            except QueryShedError as e:
+                stat_count(rt.app_context, "resilience.query_sheds")
+                h.send_response(503)
+                h.send_header("Retry-After", "1")
+                payload = json.dumps(
+                    {"error": str(e), "shed": True}).encode("utf-8")
+                h.send_header("Content-Type", "application/json")
+                h.send_header("Content-Length", str(len(payload)))
+                h.end_headers()
+                h.wfile.write(payload)
+                return
+            events = fut.result()
             h._send(200, {"rows": [list(e.data) for e in events]})
             return
         if parts == ["trace", "start"]:

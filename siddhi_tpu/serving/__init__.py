@@ -1,0 +1,20 @@
+"""Serving tier: mesh-sharded incremental aggregation + scatter-gather
+on-demand queries + admission control (README "Serving tier")."""
+
+from siddhi_tpu.serving.query_tier import (
+    AdmissionPool,
+    QueryShedError,
+    scatter_pool,
+)
+from siddhi_tpu.serving.sharded_aggregation import (
+    AggregationShard,
+    ShardedIncrementalAggregation,
+)
+
+__all__ = [
+    "AdmissionPool",
+    "AggregationShard",
+    "QueryShedError",
+    "ShardedIncrementalAggregation",
+    "scatter_pool",
+]

@@ -1,0 +1,502 @@
+"""Serving tier tests: mesh-sharded incremental aggregation, scatter-
+gather on-demand queries, per-shard WAL rebuild, admission control
+(``siddhi_tpu/serving/``)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+APP = """
+@app:name('ServeApp')
+define stream TradeStream (symbol string, price double, volume long, ts long);
+define aggregation TradeAgg
+from TradeStream
+select symbol, sum(price) as total, avg(price) as avgPrice, count() as n,
+       min(price) as lo, max(price) as hi, distinctCount(volume) as dv,
+       price * 2.0 as lastDouble
+group by symbol
+aggregate by ts every sec ... year;
+"""
+
+QUERY = ("from TradeAgg within 0L, 100000000000L per '{per}' "
+         "select AGG_TIMESTAMP, symbol, total, avgPrice, n, lo, hi, dv, "
+         "lastDouble")
+
+
+def _mk(shards: int, app: str = APP):
+    m = SiddhiManager()
+    cfg = {"siddhi_tpu.agg_shards": str(shards)}
+    m.set_config_manager(InMemoryConfigManager(cfg))
+    rt = m.create_siddhi_app_runtime(app)
+    return m, rt
+
+
+def _pump(rt, seed=0, n=300, keys=23):
+    h = rt.get_input_handler("TradeStream")
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        h.send([f"S{rng.integers(0, keys)}", float(rng.random() * 100.0),
+                int(rng.integers(1, 5)), int(rng.integers(0, 50_000))])
+
+
+def _rows(rt, per="seconds", q=QUERY):
+    return sorted(tuple(e.data) for e in rt.query(q.format(per=per)))
+
+
+def test_sharded_equals_unsharded_all_granularities():
+    m1, rt1 = _mk(1)
+    m4, rt4 = _mk(4)
+    _pump(rt1)
+    _pump(rt4)
+    try:
+        agg = rt4.aggregations["TradeAgg"]
+        from siddhi_tpu.serving import ShardedIncrementalAggregation
+
+        assert isinstance(agg, ShardedIncrementalAggregation)
+        assert agg.n_shards == 4
+        # every shard owns a non-empty slice of the key space
+        assert all(s.store[agg.durations[0]] for s in agg.shards)
+        for per in ("seconds", "minutes", "hours", "days"):
+            assert _rows(rt1, per) == _rows(rt4, per), per
+    finally:
+        m1.shutdown()
+        m4.shutdown()
+
+
+def test_within_straddles_granularity_boundaries():
+    """A `within` range that starts/ends mid-bucket must truncate its
+    start down to the queried granularity's bucket start identically on
+    both paths (the reference IncrementalTimeConverterUtil rule)."""
+    m1, rt1 = _mk(1)
+    m3, rt3 = _mk(3)
+    try:
+        for rt in (rt1, rt3):
+            h = rt.get_input_handler("TradeStream")
+            for ts in (500, 1500, 59_500, 60_500, 3_599_500, 3_600_500):
+                h.send(["A", 1.0, 1, ts])
+                h.send(["B", 2.0, 1, ts])
+        for q in (
+            "from TradeAgg within 1500L, 3500L per 'seconds' "
+            "select AGG_TIMESTAMP, symbol, total, n",
+            # straddles the minute boundary mid-minute on both ends
+            "from TradeAgg within 30000L, 90000L per 'minutes' "
+            "select AGG_TIMESTAMP, symbol, total, n",
+            # one-bucket hour range expressed inside the bucket
+            "from TradeAgg within 3599000L, 3599900L per 'hours' "
+            "select AGG_TIMESTAMP, symbol, total, n",
+        ):
+            a = sorted(tuple(e.data) for e in rt1.query(q))
+            b = sorted(tuple(e.data) for e in rt3.query(q))
+            assert a == b and a, q
+    finally:
+        m1.shutdown()
+        m3.shutdown()
+
+
+def test_out_of_order_near_bucket_flip():
+    """Out-of-order arrivals just after a bucket flip fold into their own
+    (older) bucket, and bare-selection last-value semantics keep the
+    latest EVENT-TIME value — identically sharded and unsharded."""
+    m1, rt1 = _mk(1)
+    m2, rt2 = _mk(2)
+    try:
+        seq = [("A", 10.0, 1999), ("A", 20.0, 2000), ("B", 5.0, 2001),
+               ("A", 7.0, 1998),   # late: lands in bucket 1000
+               ("B", 9.0, 1999),   # late for B too
+               ("A", 30.0, 2999), ("A", 1.0, 2500)]  # older within bucket 2000
+        for rt in (rt1, rt2):
+            h = rt.get_input_handler("TradeStream")
+            for sym, price, ts in seq:
+                h.send([sym, price, 1, ts])
+        a = _rows(rt1)
+        b = _rows(rt2)
+        assert a == b
+        by_key = {(r[0], r[1]): r for r in a}
+        # bucket 1000/A sums the on-time and the late arrival
+        assert by_key[(1000, "A")][2] == 17.0
+        # bucket 2000/A: lastDouble keeps ts=2999's value (60.0), not the
+        # later-ARRIVING ts=2500 one
+        assert by_key[(2000, "A")][8] == 60.0
+    finally:
+        m1.shutdown()
+        m2.shutdown()
+
+
+def test_shard_kill_rebuild_effectively_once():
+    m, rt = _mk(3)
+    try:
+        _pump(rt, seed=7, n=120)
+        agg = rt.aggregations["TradeAgg"]
+        blobs = agg.checkpoint_shards()
+        _pump(rt, seed=8, n=80)       # suffix lives in the shard WALs
+        ref = _rows(rt)
+        agg.kill_shard(1)
+        assert _rows(rt) != ref       # the shard's slice is gone
+        replayed = agg.rebuild_shard(1, blobs[1])
+        assert replayed >= 1
+        assert _rows(rt) == ref       # zero lost, zero duplicated
+    finally:
+        m.shutdown()
+
+
+def test_rebuild_skips_wal_suffix_predating_revision():
+    """A shard blob whose cut predates the WAL's last checkpoint trim
+    restores WITHOUT replay: the retained suffix follows a newer base and
+    grafting it would silently lose the gap (PR-1 stale-revision rule)."""
+    m, rt = _mk(2)
+    try:
+        _pump(rt, seed=1, n=60)
+        agg = rt.aggregations["TradeAgg"]
+        old = agg.checkpoint_shards()
+        _pump(rt, seed=2, n=60)
+        agg.checkpoint_shards()       # trims WALs past old's cut
+        _pump(rt, seed=3, n=40)       # fresh suffix follows the NEW base
+        agg.kill_shard(0)
+        assert agg.rebuild_shard(0, old[0]) == 0   # replay skipped
+        # the shard holds exactly the old blob's state (stale by design,
+        # visibly so — not silently wrong)
+        expect = agg._deser_store(old[0]["store"])
+        assert agg.shards[0].store == expect
+    finally:
+        m.shutdown()
+
+
+def test_rebuild_reports_wal_overflow_gap():
+    """A shard WAL bounded too small for the post-checkpoint suffix must
+    SAY so at rebuild (gap counter + error log), not silently restore a
+    hole — sequence numbers are contiguous, so the drop is detectable."""
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.agg_shards": "2", "siddhi_tpu.agg_shard_wal": "2"}))
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.set_statistics_level("basic")
+    try:
+        h = rt.get_input_handler("TradeStream")
+        agg = rt.aggregations["TradeAgg"]
+        blobs = agg.checkpoint_shards()
+        for i in range(5):     # 5 single-event batches > bound of 2
+            h.send(["A", 1.0, 1, 1000 * i])
+        victim = agg._owner_of(
+            (rt.app_context.string_dictionary.encode("A"),))
+        agg.kill_shard(victim)
+        agg.rebuild_shard(victim, blobs[victim])
+        counters = rt.app_context.statistics_manager.counters
+        assert counters.get("resilience.shard_replay_gaps") == 1
+        # the retained tail IS replayed (visible partial state, counted)
+        assert _rows(rt)
+    finally:
+        m.shutdown()
+
+
+def test_cross_restore_with_foreign_durations():
+    """Restoring a snapshot that keeps MORE granularities than the app
+    declares (sec...day snap into a sec...hour sharded app) must follow
+    the restored state, both ways — and querying a granularity neither
+    kept raises a clean CompileError, not KeyError."""
+    from siddhi_tpu.ops.expressions import CompileError
+
+    small = APP.replace("every sec ... year", "every sec ... hour")
+    m1, rt1 = _mk(1)                 # sec...year, unsharded
+    m2, rt2 = _mk(2, app=small)      # sec...hour, sharded
+    try:
+        _pump(rt1, seed=61, n=60)
+        ref = _rows(rt1)
+        rt2.restore(rt1.snapshot())  # brings sec...year buckets along
+        assert _rows(rt2) == ref
+        assert _rows(rt2, per="days") == _rows(rt1, per="days")
+        # ingest after the cross-restore folds into DECLARED durations
+        rt2.get_input_handler("TradeStream").send(["S0", 1.0, 1, 5])
+        rt1.get_input_handler("TradeStream").send(["S0", 1.0, 1, 5])
+        assert _rows(rt2) == _rows(rt1)
+    finally:
+        m1.shutdown()
+        m2.shutdown()
+
+    # shrinking direction: a sec...hour snapshot into a sec...year
+    # sharded app — the un-restored granularity reads as a clean
+    # CompileError (not KeyError), and reappears once ingest re-folds it
+    m3, rt3 = _mk(1, app=small)
+    m4, rt4 = _mk(3)
+    try:
+        _pump(rt3, seed=62, n=40)
+        rt4.restore(rt3.snapshot())
+        assert _rows(rt4) == _rows(rt3)
+        with pytest.raises(CompileError):
+            rt4.query("from TradeAgg within 0L, 1L per 'months' select n")
+        rt4.get_input_handler("TradeStream").send(["S0", 1.0, 1, 5])
+        assert rt4.query(
+            "from TradeAgg within 0L, 100000L per 'months' select n")
+    finally:
+        m3.shutdown()
+        m4.shutdown()
+
+
+def test_full_snapshot_cross_restores_sharded_and_unsharded():
+    m4, rt4 = _mk(4)
+    m1, rt1 = _mk(1)
+    m2, rt2 = _mk(2)
+    try:
+        _pump(rt4, seed=5, n=150)
+        ref = _rows(rt4)
+        blob = rt4.snapshot()
+        rt1.restore(blob)             # sharded -> unsharded
+        assert _rows(rt1) == ref
+        rt2.restore(rt1.snapshot())   # unsharded -> sharded(2)
+        assert _rows(rt2) == ref
+        # ingest keeps folding correctly after the re-route
+        rt2.get_input_handler("TradeStream").send(["S0", 1.5, 1, 123])
+        rt1.get_input_handler("TradeStream").send(["S0", 1.5, 1, 123])
+        assert _rows(rt2) == _rows(rt1)
+    finally:
+        m4.shutdown()
+        m1.shutdown()
+        m2.shutdown()
+
+
+def test_incremental_snapshot_cross_layout():
+    """persist_incremental/restore chains work across the sharded layout:
+    op-logs capture per shard and apply back per shard."""
+    from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+
+    m, rt = _mk(3)
+    m.set_persistence_store(InMemoryPersistenceStore())
+    try:
+        _pump(rt, seed=11, n=60)
+        rt.persist()
+        _pump(rt, seed=12, n=60)
+        ref = _rows(rt)
+        rev = rt.persist_incremental()
+        _pump(rt, seed=13, n=30)      # diverge past the checkpoint
+        rt.restore_revision(rev)
+        assert _rows(rt) == ref
+    finally:
+        m.shutdown()
+
+
+def test_device_views_epoch_cached_on_shard_devices():
+    import jax
+
+    m, rt = _mk(4)
+    try:
+        _pump(rt, seed=21, n=80)
+        agg = rt.aggregations["TradeAgg"]
+        d = agg.durations[0]
+        views = [agg.shard_device_contents(i, d) for i in range(4)]
+        for i, (defn, cols, valid) in enumerate(views):
+            arr = cols["total"]
+            assert isinstance(arr, jax.Array)
+            assert arr.devices() == {agg.shards[i].device}
+        # cached until the next fold bumps the epoch
+        assert agg.shard_device_contents(0, d) is views[0]
+        rt.get_input_handler("TradeStream").send(["S0", 1.0, 1, 1])
+        owner = agg._owner_of(
+            (rt.app_context.string_dictionary.encode("S0"),))
+        assert agg.shard_device_contents(owner, d) is not views[owner]
+    finally:
+        m.shutdown()
+
+
+def test_queries_do_not_hold_the_app_barrier():
+    """An aggregation store-query mid-flight must not block ingest: the
+    serving read path takes per-shard locks only."""
+    m, rt = _mk(2)
+    try:
+        _pump(rt, seed=31, n=50)
+        agg = rt.aggregations["TradeAgg"]
+        release = threading.Event()
+        in_query = threading.Event()
+        orig = agg.shards[0].partials
+
+        def slow_partials(duration):
+            in_query.set()
+            release.wait(5)
+            return orig(duration)
+
+        agg.shards[0].partials = slow_partials
+        result = {}
+
+        def query():
+            result["rows"] = _rows(rt)
+
+        t = threading.Thread(target=query)
+        t.start()
+        assert in_query.wait(5)
+        # the query is parked inside shard 0's read; ingest must proceed
+        rt.get_input_handler("TradeStream").send(["S1", 2.0, 1, 77])
+        release.set()
+        t.join(5)
+        assert not t.is_alive() and result["rows"]
+    finally:
+        release.set()
+        m.shutdown()
+
+
+def test_admission_pool_caps_and_counters():
+    from siddhi_tpu.observability.telemetry import TelemetryRegistry
+    from siddhi_tpu.serving import AdmissionPool, QueryShedError
+
+    tel = TelemetryRegistry()
+    pool = AdmissionPool(max_workers=2, default_cap=3, telemetry=tel)
+    gate = threading.Event()
+    futs = [pool.try_submit("/query", gate.wait, 10) for _ in range(3)]
+    with pytest.raises(QueryShedError):
+        pool.try_submit("/query", gate.wait, 10)
+    # a different endpoint has its own budget (admitted, queued behind
+    # the gated workers)
+    f = pool.try_submit("/stats", lambda: 42)
+    gate.set()
+    assert f.result(10) == 42
+    for fu in futs:
+        fu.result(10)
+    snap = tel.snapshot()
+    assert snap["counters"]["serving.queries"] == 4
+    assert snap["counters"]["serving.sheds"] == 1
+    assert snap["gauges"]["serving.pool.pending"] == 0
+    # capacity freed after completion
+    pool.try_submit("/query", lambda: None).result(5)
+    pool.shutdown()
+
+
+def _req(port, method, path, body=None, text=False):
+    data = None
+    headers = {}
+    if body is not None:
+        data = body.encode() if text else json.dumps(body).encode()
+        headers["Content-Type"] = "text/plain" if text else "application/json"
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data,
+                               method=method, headers=headers)
+    with urllib.request.urlopen(r) as resp:
+        return json.loads(resp.read())
+
+
+def test_rest_storm_sheds_503_and_query_during_rebuild():
+    from siddhi_tpu.service import SiddhiRestService
+
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.agg_shards": "3"}))
+    svc = SiddhiRestService(m, query_workers=2, query_queue_cap=4).start()
+    app = APP.replace("@app:name('ServeApp')",
+                      "@app:name('ServeApp')\n@app:statistics('true')")
+    try:
+        _req(svc.port, "POST", "/apps", app, text=True)
+        rt = m.get_siddhi_app_runtime("ServeApp")
+        _pump(rt, seed=41, n=100)
+        agg = rt.aggregations["TradeAgg"]
+        blobs = agg.checkpoint_shards()
+        _pump(rt, seed=42, n=50)
+        q = {"app": "ServeApp",
+             "query": QUERY.format(per="seconds") + ";"}
+        ref = _req(svc.port, "POST", "/query", q)["rows"]
+
+        # store queries keep answering (200 or a clean 503, never a 500)
+        # while a shard is killed and rebuilt
+        codes = []
+
+        def client():
+            for _ in range(10):
+                try:
+                    _req(svc.port, "POST", "/query", q)
+                    codes.append(200)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        agg.kill_shard(2)
+        agg.rebuild_shard(2, blobs[2])
+        for t in threads:
+            t.join(30)
+        assert set(codes) <= {200, 503} and 200 in codes
+        # after the rebuild the stitched result is exact again
+        assert _req(svc.port, "POST", "/query", q)["rows"] == ref
+
+        # storm past the cap: 503 with the shed marker + counters
+        gate = threading.Event()
+        orig = agg.shards[0].partials
+        agg.shards[0].partials = lambda d: (gate.wait(10), orig(d))[1]
+        storm_codes = []
+
+        def storm():
+            try:
+                _req(svc.port, "POST", "/query", q)
+                storm_codes.append(200)
+            except urllib.error.HTTPError as e:
+                storm_codes.append(e.code)
+
+        threads = [threading.Thread(target=storm) for _ in range(10)]
+        for t in threads:
+            t.start()
+        while storm_codes.count(503) == 0 and any(
+                t.is_alive() for t in threads):
+            pass
+        gate.set()
+        for t in threads:
+            t.join(30)
+        assert 503 in storm_codes
+        metrics = _req(svc.port, "GET", "/metrics?format=json")
+        proc = metrics["process"]["counters"]
+        assert proc["serving.sheds"] >= 1
+        stats = metrics["apps"]["ServeApp"]["statistics"]["counters"]
+        assert stats["resilience.query_sheds"] >= 1
+        assert stats["resilience.shard_rebuilds"] == 1
+    finally:
+        svc.stop()
+        m.shutdown()
+
+
+def test_metrics_families_for_both_aggregation_paths():
+    """The /metrics satellite fix: per-granularity bucket gauges and
+    flush-latency histograms are scraped for the legacy single-store
+    runtime AND the sharded serving runtime."""
+    from siddhi_tpu.observability import export
+
+    for shards in (1, 3):
+        m, rt = _mk(shards)
+        try:
+            _pump(rt, seed=51, n=40)
+            _rows(rt)
+            text = export.prometheus_text(m)
+            assert ('siddhi_aggregation_buckets{app="ServeApp",'
+                    'name="TradeAgg",duration="sec"}') in text
+            assert 'siddhi_aggregation_flush_ms{app="ServeApp"' in text
+            assert 'siddhi_aggregation_flush_ms_count{' in text
+            if shards > 1:
+                assert ('siddhi_aggregation_shards{app="ServeApp",'
+                        'name="TradeAgg"} 3') in text
+                assert "siddhi_serving_fanout_ms{" in text
+                assert "siddhi_serving_merge_ms{" in text
+                assert ('siddhi_serving_query_ms{app="ServeApp",'
+                        'granularity="sec",quantile="0.99"}') in text
+                assert "siddhi_aggregation_shard_wal_batches{" in text
+        finally:
+            m.shutdown()
+
+
+def test_partition_by_id_keeps_legacy_runtime():
+    """@PartitionById (DB shard-stitch) is subsumed but NOT broken: it
+    keeps the legacy runtime even when agg_shards is configured."""
+    from siddhi_tpu.core.aggregation import IncrementalAggregationRuntime
+    from siddhi_tpu.serving import ShardedIncrementalAggregation
+
+    app = APP.replace("define aggregation TradeAgg",
+                      "@PartitionById\ndefine aggregation TradeAgg")
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.agg_shards": "4", "shardId": "node-1"}))
+    try:
+        rt = m.create_siddhi_app_runtime(app)
+        agg = rt.aggregations["TradeAgg"]
+        assert isinstance(agg, IncrementalAggregationRuntime)
+        assert not isinstance(agg, ShardedIncrementalAggregation)
+        assert agg.shard_mode and agg.shard_id == "node-1"
+    finally:
+        m.shutdown()
