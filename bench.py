@@ -569,24 +569,28 @@ end;
 
 def bench_mesh_scaling():
     """Strong scaling of the partitioned flagship (per-key length(1000)
-    window -> avg/sum over 10k keys) with the key space sharded over
-    n = 1/2/4/8 mesh devices via the zero-collective ``shard_map`` path:
-    the host router (``route_batch_to_shards``) scatters each batch's rows
-    to the shard owning their key, and each device steps its own
-    ``[K/n]``-keyed state — no per-step collectives at all (audited by
-    tools/hlo_audit.py; the round-4 replicated-batch path all-gathered per
-    step and scaled INVERSELY). Tunnel-independent: runs on the 8-device
-    virtual CPU mesh, where shards share one host's cores — the curve
-    bounds sharding overhead rather than demonstrating speedup; on a real
-    slice the same code divides key state and row traffic across chips.
-    Host routing cost is charged inside the measured loop."""
+    window -> avg/sum over 10k keys) under round-6 DEVICE-side
+    repartitioning (``device_route_query_step``): the unrouted batch
+    enters the jitted step B-sharded, owners are computed on device, rows
+    exchange shard-to-shard with a dense all_to_all inside the shard_map
+    body, and emitted rows re-merge into unsharded order on the way out —
+    the round-5 host router (~75% of single-shard throughput, BENCH_r05)
+    is gone from the loop entirely. Three numbers per run: the UNROUTED
+    single-shard jit (the bar the 1-dev routed point must hold 0.9x of),
+    the legacy host-routed 1-dev point (the before), and the
+    device-routed 1/2/4/8 curve. Tunnel-independent: on the virtual CPU
+    mesh shards share one host's cores, so the curve bounds overhead
+    rather than demonstrating speedup."""
+    import warnings
+
     import jax
 
     from siddhi_tpu import SiddhiManager
     from siddhi_tpu.core.plan.selector_plan import GK_KEY
     from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
     from siddhi_tpu.parallel.mesh import (
-        make_mesh, route_batch_to_shards, shard_keyed_query_step)
+        device_route_query_step, make_mesh, route_batch_to_shards,
+        shard_keyed_query_step)
 
     rng = np.random.default_rng(5)
     B = BATCH
@@ -614,39 +618,84 @@ def bench_mesh_scaling():
         return k
 
     batches = [make_batch(i) for i in range(4)]
-    eps_by_devices = {}
-    for n_dev in (1, 2, 4, 8):
+
+    def timed_loop(fn):
+        for i in range(3):
+            st = fn(i)
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        n = i = 0
+        while True:
+            st = fn(i)
+            n += B
+            i += 1
+            if i % 10 == 0:
+                jax.block_until_ready(st)
+                if time.perf_counter() - t0 >= MEASURE_SECONDS / 2:
+                    break
+        jax.block_until_ready(st)
+        return n / (time.perf_counter() - t0)
+
+    def fresh_runtime(num_keys):
         manager = SiddhiManager()
         rt = manager.create_siddhi_app_runtime(_PARTITIONED_APP)
         rt.start()
         q = rt.query_runtimes["bench"]
-        local_k = _pow2((NUM_KEYS + n_dev - 1) // n_dev)  # per-shard capacity
-        q.selector_plan.num_keys = local_k
-        q._win_keys = local_k
+        q.selector_plan.num_keys = num_keys
+        q._win_keys = num_keys
+        return manager, q
+
+    result = {}
+
+    # --- unrouted single-shard baseline: the plain jitted step
+    manager, q = fresh_runtime(16_384)
+    step = jax.jit(q.build_step_fn(), donate_argnums=0)
+    holder = {"st": q._init_state()}
+
+    def run_plain(i):
+        holder["st"], _out = step(holder["st"], batches[i % 4], np.int64(0))
+        return holder["st"]
+
+    result["unrouted_1dev"] = timed_loop(run_plain)
+    manager.shutdown()
+
+    # --- legacy host router at 1 dev (the round-5 "before" point)
+    manager, q = fresh_runtime(16_384)
+    hstep, hstate = shard_keyed_query_step(q, make_mesh(1), rows_per_shard=B)
+    hold = {"st": hstate}
+
+    def run_host(i):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            rb = route_batch_to_shards(batches[i % 4], 1, B)
+        hold["st"], _out = hstep(hold["st"], rb, np.int64(0))
+        return hold["st"]
+
+    result["host_routed_1dev"] = timed_loop(run_host)
+    manager.shutdown()
+
+    # --- device-routed curve: routing inside the jitted step
+    device_routed = {}
+    for n_dev in (1, 2, 4, 8):
+        manager, q = fresh_runtime(16_384)
         rows_per_shard = B if n_dev == 1 else int(B / n_dev * 1.25)
-        step, state = shard_keyed_query_step(
+        step3, state = device_route_query_step(
             q, make_mesh(n_dev), rows_per_shard=rows_per_shard)
-        now = np.int64(0)
-        for i in range(3):
-            rb = route_batch_to_shards(batches[i % 4], n_dev, rows_per_shard)
-            state, out = step(state, rb, now)
-        jax.block_until_ready(state)
-        t0 = time.perf_counter()
-        n = 0
-        i = 0
-        while True:
-            rb = route_batch_to_shards(batches[i % 4], n_dev, rows_per_shard)
-            state, out = step(state, rb, now)
-            n += B
-            i += 1
-            if i % 10 == 0:
-                jax.block_until_ready(state)
-                if time.perf_counter() - t0 >= MEASURE_SECONDS / 2:
-                    break
-        jax.block_until_ready(state)
-        eps_by_devices[str(n_dev)] = n / (time.perf_counter() - t0)
+        hold = {"st": state}
+
+        def run_dev(i):
+            hold["st"], out = step3(hold["st"], batches[i % 4], np.int64(0))
+            return hold["st"]
+
+        device_routed[str(n_dev)] = timed_loop(run_dev)
+        # balanced random keys must never trip the exchange quota here
+        _st, out = step3(hold["st"], batches[0], np.int64(0))
+        assert int(np.asarray(out["__meta__"])[3]) == 0, "exchange overflow"
         manager.shutdown()
-    return eps_by_devices
+    result["device_routed"] = device_routed
+    result["routed_vs_unrouted_1dev"] = round(
+        device_routed["1"] / result["unrouted_1dev"], 3)
+    return result
 
 
 def bench_nfa_p99():
@@ -1145,9 +1194,15 @@ def main():
     emit()
     out, _ = _run_section_once("scaling_cpu", min(240.0, remaining()))
     if out is not None:
+        mesh = out["mesh"]
         result["mesh_scaling_eps"] = {
-            k: round(v, 1) for k, v in out["eps_by_devices"].items()}
-        result["mesh_scaling_backend"] = "cpu-8dev-virtual-mesh"
+            k: round(v, 1) for k, v in mesh["device_routed"].items()}
+        result["mesh_unrouted_1dev_eps"] = round(mesh["unrouted_1dev"], 1)
+        result["mesh_host_routed_1dev_eps"] = round(
+            mesh["host_routed_1dev"], 1)
+        result["mesh_routed_vs_unrouted_1dev"] = mesh[
+            "routed_vs_unrouted_1dev"]
+        result["mesh_scaling_backend"] = "cpu-8dev-virtual-mesh-device-routed"
     else:
         result["sections_failed"].append("scaling")
     emit()
@@ -1186,7 +1241,7 @@ if __name__ == "__main__":
             # scaling section needs the full 8-device virtual mesh.
             from siddhi_tpu.parallel.mesh import force_host_devices
 
-            force_host_devices(8 if section == "scaling" else 1)
+            force_host_devices(8 if section in ("scaling", "mesh") else 1)
         if section == "device":
             eps = bench_device()
             import jax
@@ -1203,8 +1258,8 @@ if __name__ == "__main__":
         elif section == "nfa":
             p99, eps = bench_nfa_p99()
             print(json.dumps({"p99_ms": p99, "eps": eps}))
-        elif section == "scaling":
-            print(json.dumps({"eps_by_devices": bench_mesh_scaling()}))
+        elif section in ("scaling", "mesh"):
+            print(json.dumps({"mesh": bench_mesh_scaling()}))
         elif section == "e2e_curve":
             print(json.dumps({"points": bench_e2e_curve()}))
         elif section == "fanout":

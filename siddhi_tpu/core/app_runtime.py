@@ -167,6 +167,17 @@ class SiddhiAppRuntime:
             if v is not None:
                 self.app_context.fuse_fanout = str(v).strip().lower() not in (
                     "0", "false", "off", "no")
+            v = cm.get_property("siddhi_tpu.shard_exchange")
+            if v is not None:
+                # device-routed sharding's exchange kernel: "all_to_all"
+                # (portable default) or "pallas_ring" (TPU direct-RDMA;
+                # inert on CPU fallback — parallel/mesh.py)
+                v = str(v).strip().lower()
+                if v not in ("all_to_all", "pallas_ring"):
+                    raise SiddhiAppValidationException(
+                        "siddhi_tpu.shard_exchange must be 'all_to_all' "
+                        "or 'pallas_ring'")
+                self.app_context.shard_exchange = v
         if self.app_context.defer_meta > 1:
             # deprecation shim: the hold-N-then-flush defer queue is
             # subsumed by the dispatch pipeline (core/query/completion.py)

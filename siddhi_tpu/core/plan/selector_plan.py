@@ -27,7 +27,9 @@ import numpy as np
 from siddhi_tpu.core.plan.resolvers import OutputColsResolver
 from siddhi_tpu.ops import aggregators as agg_ops
 from siddhi_tpu.ops.expressions import (
+    OKEY_KEY,
     PK_KEY,
+    RIDX_KEY,
     TS_KEY,
     TYPE_KEY,
     VALID_KEY,
@@ -212,6 +214,14 @@ class SelectorPlan:
             out["__overflow__"] = cols["__agg_overflow__"]
         if PK_KEY in cols:
             out[PK_KEY] = cols[PK_KEY]  # partition id rides along to the edge
+        if OKEY_KEY in cols:
+            # device-routed sharding: the window's emission-order key rides
+            # to the route wrapper's cross-shard merge
+            out[OKEY_KEY] = cols[OKEY_KEY]
+        elif RIDX_KEY in cols:
+            # no window stage: rows are input-aligned, so the original
+            # batch position IS the emission order
+            out[OKEY_KEY] = cols[RIDX_KEY]
         B = cols[TS_KEY].shape[0]
         for name, fn, _t in self.projections:
             v, m = fn(cols, ctx)

@@ -111,6 +111,15 @@ class QueryCompletion:
         dict.pop(self.out, "__meta__")
         overflow, notify, size = int(meta[0]), int(meta[1]), int(meta[2])
         try:
+            check = getattr(q, "_routed_meta_check", None)
+            if check is not None and len(meta) > 3:
+                # device-routed entries carry [.., route_overflow, rows...]
+                # behind the standard prefix — an exchange overflow is
+                # fatal for this batch exactly like a capacity overflow
+                try:
+                    check(meta)
+                except FatalQueryError as routed_err:
+                    return routed_err
             if overflow > 0:
                 # the overflowed batch's rows are clamped garbage —
                 # matching the synchronous path, it does not emit (the

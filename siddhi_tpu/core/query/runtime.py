@@ -168,6 +168,7 @@ class QueryRuntime(Receiver):
         self._step = None
         self._sel_step = None  # split pipelines (host keyer between stages)
         self._shard_mesh = None  # set by parallel.mesh.shard_query_step
+        self._route_layout = None  # parallel.mesh.device_route_query_step
         self._lock = threading.RLock()  # per-query lock (QueryParser.java:159-215)
         self._deferred: List = []   # queued outputs when defer_meta > 1
         self._cur_junction = None   # delivering junction of the batch in
@@ -214,6 +215,14 @@ class QueryRuntime(Receiver):
         """Grow dense key capacity (pow2) when a key dictionary outgrows
         it; state rows are preserved (keyed buffers are laid out so prefix
         copy keeps per-key alignment), step re-jitted on the new shapes."""
+        if self._route_layout is not None:
+            # device-routed runtimes hold PER-SHARD capacities: growth
+            # compares the GLOBAL key population against n * localK and
+            # re-lays the state out through its canonical form
+            from siddhi_tpu.parallel.mesh import ensure_routed_capacity
+
+            ensure_routed_capacity(self)
+            return
         grew = False
         needed = self._needed_sel_keys()
         k = self.selector_plan.num_keys
@@ -252,7 +261,16 @@ class QueryRuntime(Receiver):
         with self._lock:
             if self._state is None:
                 return
-            idx = jnp.asarray(np.asarray(ids, np.int32))
+            rl = self._route_layout
+            ids_np = np.asarray(ids, np.int64)
+            if rl is not None:
+                # routed state is shard-major: global pk id g lives at row
+                # (g % n) * local + g // n of each keyed buffer
+                idx = jnp.asarray(
+                    ((ids_np % rl.n) * rl.local_win
+                     + ids_np // rl.n).astype(np.int32))
+            else:
+                idx = jnp.asarray(ids_np.astype(np.int32))
             state = dict(self._state)
             if "win" in state and hasattr(self.window_stage, "reset_keys"):
                 state["win"] = self.window_stage.reset_keys(state["win"], idx)
@@ -275,6 +293,15 @@ class QueryRuntime(Receiver):
                 # parallel/mesh.py shards by).
                 K = self.selector_plan.num_keys
                 init = self.selector_plan.init_state()
+                sel_idx, init_idx = idx, idx
+                if rl is not None:
+                    # sel space is gk == pk here; init rows are identical
+                    # per key, so gather them at the LOCAL id
+                    K = K * rl.n
+                    sel_idx = jnp.asarray(
+                        ((ids_np % rl.n) * rl.localK
+                         + ids_np // rl.n).astype(np.int32))
+                    init_idx = jnp.asarray((ids_np // rl.n).astype(np.int32))
 
                 def reset_key_rows(x, x0):
                     if not hasattr(x, "shape"):
@@ -282,9 +309,11 @@ class QueryRuntime(Receiver):
                     for ax, s in enumerate(x.shape):
                         if s == K:
                             sl = [slice(None)] * x.ndim
-                            sl[ax] = idx
+                            sl[ax] = sel_idx
+                            sl0 = [slice(None)] * x.ndim
+                            sl0[ax] = init_idx
                             return x.at[tuple(sl)].set(
-                                jnp.asarray(x0)[tuple(sl)])
+                                jnp.asarray(x0)[tuple(sl0)])
                     return x
 
                 state["sel"] = jax.tree_util.tree_map(
@@ -306,6 +335,12 @@ class QueryRuntime(Receiver):
         # count/wall-ms per query (and a span("jit")) with one attribute
         # check per call afterwards — re-jits on capacity growth show up
         # as fresh compile events
+        if self._route_layout is not None:
+            # a cleared step on a device-routed runtime (restore, growth)
+            # must come back ROUTED, not as the plain unsharded jit
+            from siddhi_tpu.parallel.mesh import routed_step_for
+
+            return routed_step_for(self)
         jitted = jax.jit(self.build_step_fn(), donate_argnums=0)
         return self.app_context.telemetry.instrument_jit(
             jitted, f"query.{self.name}.step")
@@ -579,8 +614,21 @@ class QueryRuntime(Receiver):
                 self.app_context.telemetry.record_jit(
                     getattr(self._step, "_key", f"query.{self.name}.step"),
                     hit=True)
-            notify = self._finish_device_batch(
-                self._step, cols, self.overflow_knob_msg())
+            if self._route_layout is not None:
+                # device-routed dispatch: pad/precheck host-side (splitting
+                # oversized batches instead of overflowing) and run each
+                # piece through the routed step in order
+                from siddhi_tpu.parallel.mesh import prepare_routed_batches
+
+                notify = None
+                for piece in prepare_routed_batches(self, cols):
+                    nt = self._finish_device_batch(
+                        self._step, piece, self.overflow_knob_msg())
+                    if nt is not None:
+                        notify = nt if notify is None else min(notify, nt)
+            else:
+                notify = self._finish_device_batch(
+                    self._step, cols, self.overflow_knob_msg())
         if notify_host is not None:
             notify = notify_host if notify is None else min(notify, notify_host)
         if notify is not None and self.scheduler is not None:
@@ -599,6 +647,29 @@ class QueryRuntime(Receiver):
                for s in self.selector_plan.specs or []):
             knob += " (or app_context.distinct_values_capacity)"
         return f"window buffer capacity exceeded — raise {knob}"
+
+    def route_overflow_msg(self) -> str:
+        """Device-router exchange overflow naming its knob, in the same
+        convention as ``overflow_knob_msg`` (the host precheck splits
+        oversized batches, so this only fires on direct step callers that
+        bypass ``prepare_routed_batches``)."""
+        rl = self._route_layout
+        rps = rl.rows_per_shard if rl is not None else 0
+        return (f"shard exchange overflow — more rows bound for one shard "
+                f"pair than its quota; raise rows_per_shard={rps} "
+                f"(device_route_query_step) or split the batch")
+
+    def _routed_meta_check(self, meta) -> None:
+        """Device-routed extras riding behind the ``[ov, notify, count]``
+        meta prefix: raise on exchange overflow (slot 3), publish the
+        per-shard routed-row counts (slots 4..4+n) for skew debugging."""
+        rl = self._route_layout
+        if rl is None or len(meta) <= 3:
+            return
+        rl.last_shard_rows = np.asarray(meta[4:4 + rl.n], np.int64)
+        if int(meta[3]) > 0:
+            raise FatalQueryError(
+                f"query '{self.name}': {self.route_overflow_msg()}")
 
     def _host_keyed_select(self, out_host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Split-pipeline tail: when the group key is computed from a device
@@ -688,6 +759,7 @@ class QueryRuntime(Receiver):
                 return self.flush_deferred()
             dict.pop(out_host, "__meta__")
             meta = self._pull_meta(meta)
+            self._routed_meta_check(meta)
             overflow = int(meta[0])
             notify = int(meta[1])
             size_hint = int(meta[2])

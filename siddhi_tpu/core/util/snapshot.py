@@ -104,10 +104,25 @@ class SnapshotService:
         queries = {}
         for name, q in rt.query_runtimes.items():
             with q._lock:
+                rl = getattr(q, "_route_layout", None)
+                if rl is not None and q._state is not None:
+                    # device-routed runtimes snapshot CANONICAL (unsharded)
+                    # state at GLOBAL capacities, so revisions cross-restore
+                    # between any shard counts and the unsharded runtime
+                    from siddhi_tpu.parallel.mesh import canonical_route_state
+
+                    state = canonical_route_state(q)
+                    sel_keys = rl.n * rl.localK
+                    win_keys = (rl.n * rl.local_win
+                                if rl.local_win > 1 else q._win_keys)
+                else:
+                    state = q._state
+                    sel_keys = q.selector_plan.num_keys
+                    win_keys = q._win_keys
                 queries[name] = {
-                    "state": _to_host(q._state) if q._state is not None else None,
-                    "sel_keys": q.selector_plan.num_keys,
-                    "win_keys": q._win_keys,
+                    "state": _to_host(state) if state is not None else None,
+                    "sel_keys": sel_keys,
+                    "win_keys": win_keys,
                     "keyer_map": dict(q.keyer._map) if q.keyer is not None else None,
                     "host_window": (q.host_window.snapshot()
                                     if q.host_window is not None else None),
@@ -259,7 +274,14 @@ class SnapshotService:
                     q.rate_limiter.reset()
                 q.selector_plan.num_keys = qsnap["sel_keys"]
                 q._win_keys = qsnap["win_keys"]
-                q._state = _to_device(qsnap["state"]) if qsnap["state"] is not None else None
+                if getattr(q, "_route_layout", None) is not None:
+                    # device-routed runtimes relayout host-side and upload
+                    # shard-major below (adopt_canonical) — a _to_device
+                    # here would round-trip the whole canonical state
+                    # through the device for nothing
+                    q._state = qsnap["state"]
+                else:
+                    q._state = _to_device(qsnap["state"]) if qsnap["state"] is not None else None
                 if q.keyer is not None and qsnap["keyer_map"] is not None:
                     # write into the member's OWN keyer: a fused fan-out
                     # group may have aliased q.keyer to a sibling's
@@ -274,6 +296,13 @@ class SnapshotService:
                     keyer._lut = np.full(64, -1, np.int32)  # lazily rebuilt
                     if keyer is not q.keyer:
                         q.keyer = keyer
+                if getattr(q, "_route_layout", None) is not None:
+                    # snapshots store canonical layout/capacities; re-derive
+                    # THIS runtime's shard-major layout (the snapshot may
+                    # come from a different shard count, or be unsharded)
+                    from siddhi_tpu.parallel.mesh import adopt_canonical
+
+                    adopt_canonical(q, qsnap["sel_keys"], qsnap["win_keys"])
                 if q.host_window is not None and qsnap.get("host_window") is not None:
                     q.host_window.restore(qsnap["host_window"])
                 if hasattr(q, "_nfa_hwm_arr"):

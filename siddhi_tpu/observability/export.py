@@ -74,6 +74,12 @@ _AGG_SHARD_WAL = re.compile(r"^aggregation\.(?P<agg>.+)\.shard"
                             r"(?P<shard>\d+)\.wal_batches$")
 _AGG_FLUSH_HIST = re.compile(r"^aggregation\.(?P<agg>.+)\.flush_ms$")
 _SERVING_QUERY_HIST = re.compile(r"^serving\.query\.(?P<dur>[a-z]+)_ms$")
+# sharded keyed steps (parallel/mesh.py): per-shard routed-row gauges
+# (key-skew visibility) + exchange/prep latency histogram — fed by BOTH
+# the legacy host router (scope "host") and the device-routed path
+# (scope = query name)
+_SHARD_ROWS = re.compile(r"^shard\.rows\.(?P<scope>.+)\.(?P<shard>\d+)$")
+_SHARD_EXCHANGE_HIST = re.compile(r"^shard\.exchange_ms\.(?P<scope>.+)$")
 _SERVING_COUNTER_FAMILY = {
     "serving.queries": ("siddhi_serving_queries_total",
                         "on-demand queries admitted by the serving tier"),
@@ -206,6 +212,13 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                              "live rollup buckets per granularity",
                              {**base, "name": m.group("agg"),
                               "duration": m.group("dur")}, v)
+                elif _SHARD_ROWS.match(name):
+                    m = _SHARD_ROWS.match(name)
+                    fams.add("siddhi_shard_rows", "gauge",
+                             "batch rows routed to each key shard (last "
+                             "batch; skew shows as imbalance)",
+                             {**base, "query": m.group("scope"),
+                              "shard": m.group("shard")}, v)
                 elif name in ("serving.pool.pending", "serving.pool.active"):
                     kind = name.rsplit(".", 1)[1]
                     fams.add(f"siddhi_serving_pool_{kind}", "gauge",
@@ -253,6 +266,13 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                 family = "siddhi_aggregation_flush_ms"
                 help_ = "aggregation ingest fold latency per batch (ms)"
                 labels["name"] = m.group("agg")
+            elif _SHARD_EXCHANGE_HIST.match(name):
+                m = _SHARD_EXCHANGE_HIST.match(name)
+                family = "siddhi_shard_exchange_ms"
+                help_ = ("host time spent routing/prepping one batch for "
+                         "the sharded keyed step (ms; device-routed path "
+                         "pays only pad+precheck here)")
+                labels["query"] = m.group("scope")
             else:
                 m = _SERVING_QUERY_HIST.match(name)
                 if m:

@@ -51,6 +51,17 @@ TS_KEY = "__ts__"
 TYPE_KEY = "__type__"
 VALID_KEY = "__valid__"
 PK_KEY = "__pk__"  # partition-key id column (dense, host-computed)
+# Device-routed sharding (parallel/mesh.device_route_query_step) carries
+# TWO dense id spaces per row: the partition key (PK_KEY, owner = pk % n,
+# local id = pk // n) and the group-by key (GK_KEY, owned by its pk's
+# shard, local id assigned per shard in allocation order) — the split that
+# lifts the old GK == PK routing restriction. RIDX_KEY is the row's
+# position in the ORIGINAL unrouted batch, attached on device before the
+# shard exchange; window stages derive their emission order keys from it
+# so sharded output re-merges into the exact unsharded order (OKEY_KEY,
+# attached by the window/selector and consumed by the route wrapper).
+RIDX_KEY = "__ridx__"
+OKEY_KEY = "__okey__"
 
 
 @dataclass

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY, CompileError
+from siddhi_tpu.ops.expressions import (
+    OKEY_KEY, PK_KEY, RIDX_KEY, TS_KEY, TYPE_KEY, VALID_KEY, CompileError)
 from siddhi_tpu.ops.windows import (
     CURRENT,
     EXPIRED,
@@ -37,6 +38,7 @@ from siddhi_tpu.ops.windows import (
     _BIG,
     _data_keys,
     _order_emit,
+    _row_order_base,
 )
 
 
@@ -110,12 +112,16 @@ class KeyedLengthWindowStage(WindowStage):
         slot = jnp.where(write, pk * W + seq % W, jnp.int64(K * W)).astype(jnp.int64)
         new_buf = {k: state["buf"][k].at[slot].set(cols[k], mode="drop") for k in state["buf"]}
 
-        idx = jnp.arange(B, dtype=jnp.int64)
+        # order base: original batch position (global under device routing,
+        # so a shard's 2*i/2*i+1 keys interleave correctly with its peers')
+        idx = _row_order_base(cols, B)
         parts = [
             (expired, jnp.full((B,), EXPIRED, jnp.int8), evicts, 2 * idx),
             ({k: cols[k] for k in keys}, cols[TYPE_KEY], valid_cur, 2 * idx + 1),
         ]
-        out, _ = _order_emit(parts)
+        out, okey = _order_emit(parts)
+        if RIDX_KEY in cols:
+            out[OKEY_KEY] = okey   # route wrapper merges shards by this
         return {"buf": new_buf, "total": state["total"] + counts}, out
 
     def contents(self, state):

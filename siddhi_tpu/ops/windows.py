@@ -49,7 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY, CompileError
+from siddhi_tpu.ops.expressions import (
+    OKEY_KEY, RIDX_KEY, TS_KEY, TYPE_KEY, VALID_KEY, CompileError)
 from siddhi_tpu.query_api.definitions import AttrType
 from siddhi_tpu.query_api.execution import Window
 from siddhi_tpu.query_api.expressions import Constant, TimeConstant
@@ -69,7 +70,8 @@ def _data_keys(cols: Dict) -> List[str]:
     # (ops/aggregators.py arg_is_multi guard)
     return sorted(
         k for k in cols
-        if k not in (TYPE_KEY, VALID_KEY, NOTIFY_KEY, OVERFLOW_KEY, FLUSH_KEY)
+        if k not in (TYPE_KEY, VALID_KEY, NOTIFY_KEY, OVERFLOW_KEY, FLUSH_KEY,
+                     RIDX_KEY, OKEY_KEY)
         and "#set" not in k
     )
 
@@ -92,6 +94,19 @@ def _order_emit(parts) -> Tuple[Dict, jnp.ndarray]:
     out[TYPE_KEY] = types[order]
     out[VALID_KEY] = valid[order]
     return out, okey[order]
+
+
+def _row_order_base(cols: Dict, B: int):
+    """Per-row base for emission order keys: the row's position in the
+    ORIGINAL batch. Plain steps see ``arange(B)``; under device-routed
+    sharding (``parallel/mesh.device_route_query_step``) the route wrapper
+    attaches ``RIDX_KEY`` — each row's index in the pre-exchange global
+    batch — so a stage's order keys stay comparable ACROSS shards and the
+    egress merge can reproduce the exact unsharded emission order."""
+    ridx = cols.get(RIDX_KEY)
+    if ridx is not None:
+        return jnp.asarray(ridx, jnp.int64)
+    return jnp.arange(B, dtype=jnp.int64)
 
 
 def _insert_ranks(valid_cur):

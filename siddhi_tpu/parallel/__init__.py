@@ -1,5 +1,6 @@
 from siddhi_tpu.parallel.mesh import (
     batch_shardings,
+    device_route_query_step,
     force_host_devices,
     key_axis_sharding,
     make_mesh,
@@ -9,6 +10,7 @@ from siddhi_tpu.parallel.mesh import (
 
 __all__ = [
     "batch_shardings",
+    "device_route_query_step",
     "force_host_devices",
     "key_axis_sharding",
     "make_mesh",

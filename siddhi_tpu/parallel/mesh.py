@@ -197,28 +197,43 @@ def _out_shardings(mesh: Mesh, st_sh):
 def route_batch_to_shards(cols, n_shards: int, rows_per_shard: int):
     """Host-side all-to-all: scatter batch rows to their owning key shard.
 
+    DEPRECATED — a compatibility shim kept for the legacy
+    ``shard_keyed_query_step`` callers. The host router costs ~75% of
+    single-shard throughput (BENCH_r05) and requires GK == PK; new code
+    should use :func:`device_route_query_step`, which routes rows INSIDE
+    the jitted step (dense ``all_to_all`` under ``shard_map``), supports a
+    group-by key distinct from the partition key, and re-merges emitted
+    rows into the exact unsharded order.
+
     The owner of dense key ``k`` is ``k % n_shards`` and its local id is
     ``k // n_shards`` — round-robin keeps the keyer's dense ids
     load-balanced across shards. Returns a routed column dict of shape
     ``[n_shards * rows_per_shard]`` where segment ``d`` holds shard ``d``'s
     rows (original order preserved within the shard) padded with invalid
-    rows, and the PK/GK columns rewritten to LOCAL ids. Pair with
-    ``shard_keyed_query_step``: the router replaces the device-side
-    all-to-all the reference's partition fan-out does with per-key junction
-    dispatch (``PartitionStreamReceiver.java:96-135``)."""
+    rows, and the PK/GK columns rewritten to LOCAL ids."""
+    import time
+    import warnings
+
     from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.core.stream.junction import FatalQueryError
     from siddhi_tpu.ops.expressions import PK_KEY, VALID_KEY
 
+    warnings.warn(
+        "route_batch_to_shards is deprecated: use device_route_query_step "
+        "(on-device repartitioning; lifts the GK == PK restriction)",
+        DeprecationWarning, stacklevel=2)
+    t0 = time.perf_counter()
     key_col = PK_KEY if PK_KEY in cols else GK_KEY
     if GK_KEY in cols and PK_KEY in cols and not np.array_equal(
             np.asarray(cols[GK_KEY]), np.asarray(cols[PK_KEY])):
         # a group-by key distinct from the partition key lives in its own
         # dense-id space; rewriting it to partition-local ids would corrupt
-        # the selector's group state (runtime.py:531-534 — GK == PK only
-        # for partitioned queries without an explicit group-by)
-        raise ValueError(
+        # the selector's group state. The DEVICE router carries the two id
+        # spaces separately — use device_route_query_step for distinct GKs.
+        raise FatalQueryError(
             "route_batch_to_shards requires GK == PK (partitioned query "
-            "without a distinct group-by key)")
+            "without a distinct group-by key) — device_route_query_step "
+            "lifts this restriction")
     valid = np.asarray(cols[VALID_KEY])
     keep = np.nonzero(valid)[0]  # capacity padding never competes for rows
     pk = np.asarray(cols[key_col]).astype(np.int64)[keep]
@@ -227,9 +242,10 @@ def route_batch_to_shards(cols, n_shards: int, rows_per_shard: int):
     order = np.argsort(owner, kind="stable")
     counts = np.bincount(owner, minlength=n_shards)
     if int(counts.max(initial=0)) > rows_per_shard:
-        raise ValueError(
+        raise FatalQueryError(
             f"shard overflow: {int(counts.max())} rows for one shard > "
-            f"rows_per_shard={rows_per_shard}; raise the pad or split the batch")
+            f"rows_per_shard={rows_per_shard} — raise rows_per_shard or "
+            f"split the batch")
     starts = np.zeros(n_shards, np.int64)
     np.cumsum(counts[:-1], out=starts[1:])
     owner_sorted = owner[order]
@@ -248,6 +264,8 @@ def route_batch_to_shards(cols, n_shards: int, rows_per_shard: int):
             buf = np.zeros((N,) + v.shape[1:], v.dtype)
             buf[dest] = v[src]
         routed[k] = buf
+    _record_route_telemetry(None, "host", counts,
+                            (time.perf_counter() - t0) * 1000.0)
     return routed  # padding rows keep VALID=False (zero-fill)
 
 
@@ -335,3 +353,813 @@ def sharded_jit_for(runtime, fn, n_state_args: int = 1, n_plain_args: int = 2):
         out_shardings=_out_shardings(mesh, st_sh),
         donate_argnums=(0,),
     )
+
+
+# ---------------------------------------------------------------------------
+# Device-side repartitioning (round 6): the host router above moved every
+# batch row through numpy before dispatch and hard-required GK == PK. The
+# device router below does the same scatter INSIDE the jitted step — the
+# unrouted batch enters B-sharded, each shard computes owners on device,
+# rows exchange shard-to-shard with one dense all_to_all (or a Pallas TPU
+# ring kernel, config-selected), and emitted rows re-merge into the exact
+# unsharded emission order on the way out ("Scaling Ordered Stream
+# Processing on Shared-Memory Multicores": ordered re-merge over
+# out-of-order parallel execution). Two dense id spaces ride each row —
+# the partition key (owner = pk % n, local = pk // n) and the group-by key
+# (owned by its pk's shard, local ids assigned per shard in allocation
+# order via a host-maintained LUT) — which is what lifts GK == PK.
+# ---------------------------------------------------------------------------
+
+# plain numpy scalar: a module-level jnp constant would initialize the
+# jax backend AT IMPORT TIME and silently break force_host_devices
+_ROUTE_BIG = np.int64(2 ** 62)
+# registry -> {scope: np[n] last routed rows}. Weak keys: a dead app's
+# registry must not pin its arrays forever, and a NEW registry allocated
+# at a recycled address must not inherit the old one's "already
+# registered" state (id()-keyed caching would do exactly that)
+import weakref as _weakref
+
+_ROUTE_ROWS: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+
+
+def _record_route_telemetry(telemetry, scope: str, rows, exchange_ms):
+    """siddhi_shard_rows{shard} gauges + siddhi_shard_exchange_ms histogram
+    — registered on BOTH the legacy host-routed path (process-global
+    registry, scope "host") and the device-routed path (app registry,
+    scope = query name) so key skew is visible either way."""
+    if telemetry is None:
+        from siddhi_tpu.observability.telemetry import global_registry
+
+        telemetry = global_registry()
+    if exchange_ms is not None:
+        telemetry.histogram(f"shard.exchange_ms.{scope}").record(exchange_ms)
+    store = _ROUTE_ROWS.setdefault(telemetry, {})
+    prev = store.get(scope)
+    known = 0 if prev is None else prev.shape[0]
+    store[scope] = np.asarray(rows, np.int64)
+    # register gauges for any shard indices not seen before — a
+    # re-install onto a LARGER mesh must grow the gauge set, not keep
+    # reporting only the original shards' skew
+    for i in range(known, len(store[scope])):
+        telemetry.gauge(
+            f"shard.rows.{scope}.{i}",
+            lambda s=scope, j=i, st=store: (
+                float(st[s][j]) if j < st[s].shape[0] else 0.0))
+
+
+class RouteLayout:
+    """Host-side bookkeeping of one device-routed query: shard count,
+    receive capacity, and the group-key local-id LUT that carries a
+    distinct GK through the exchange. ``localK``/``local_win`` mirror the
+    runtime's (now per-shard) capacity fields; ``n * localK`` is the
+    global dense-id capacity the keyer allocates into."""
+
+    def __init__(self, mesh: Mesh, rows_per_shard: int, exchange: str,
+                 partitioned: bool, use_lut: bool):
+        self.mesh = mesh
+        self.n = int(mesh.devices.size)
+        self.rows_per_shard = int(rows_per_shard)
+        self.quota = max(1, self.rows_per_shard // self.n)
+        self.exchange = exchange
+        self.partitioned = partitioned
+        self.use_lut = use_lut
+        self.localK = 1
+        self.local_win = 1
+        # group-key space: global gk id -> (owner shard, per-shard local id)
+        self.gk_owner = np.full(0, -1, np.int32)
+        self.gk_local = np.full(0, -1, np.int32)
+        self.gk_counts = np.zeros(self.n, np.int64)
+        self.gk_known = 0
+        self._lut_dev = None      # (lut [Kg], inv [n, localK]) device pair
+        self._lut_dirty = True
+
+    # ------------------------------------------------------------- lut sync
+
+    def _resize_gk(self, cap: int):
+        if self.gk_owner.shape[0] >= cap:
+            return
+        grown_o = np.full(cap, -1, np.int32)
+        grown_l = np.full(cap, -1, np.int32)
+        grown_o[: self.gk_owner.shape[0]] = self.gk_owner
+        grown_l[: self.gk_local.shape[0]] = self.gk_local
+        self.gk_owner, self.gk_local = grown_o, grown_l
+
+    def sync_gk(self, keyer) -> bool:
+        """Assign per-shard local ids to group keys allocated since the
+        last sync (allocation order per shard — deterministic given the
+        keyer map). Returns True while every shard still fits localK;
+        False means a shard overflowed and capacity must grow."""
+        if not self.use_lut or keyer is None:
+            return True
+        total = len(keyer)
+        if total <= self.gk_known and not self._lut_dirty:
+            return int(self.gk_counts.max(initial=0)) <= self.localK
+        self._resize_gk(max(total, self.n * self.localK))
+        if total > self.gk_known:
+            fresh = sorted(
+                ((gid, key) for key, gid in keyer._map.items()
+                 if gid >= self.gk_known))
+            for gid, key in fresh:
+                owner = int(key[0]) % self.n   # composite keys lead with pk
+                self.gk_owner[gid] = owner
+                self.gk_local[gid] = self.gk_counts[owner]
+                self.gk_counts[owner] += 1
+            self.gk_known = total
+            self._lut_dirty = True
+        return int(self.gk_counts.max(initial=0)) <= self.localK
+
+    def rebuild_gk(self, keyer):
+        """Full LUT rebuild (restore / capacity growth): local ids are a
+        pure function of the keyer map, so rebuilding is always safe."""
+        self.gk_owner = np.full(0, -1, np.int32)
+        self.gk_local = np.full(0, -1, np.int32)
+        self.gk_counts = np.zeros(self.n, np.int64)
+        self.gk_known = 0
+        self._lut_dirty = True
+        return self.sync_gk(keyer)
+
+    def device_luts(self):
+        """(lut, inv) device pair, replicated over the mesh; refreshed
+        only when the host LUT changed (steady state: zero transfers)."""
+        if self._lut_dev is not None and not self._lut_dirty:
+            return self._lut_dev
+        Kg = self.n * self.localK
+        if self.use_lut:
+            self._resize_gk(Kg)
+            lut = np.where(self.gk_local[:Kg] >= 0,
+                           self.gk_local[:Kg], 0).astype(np.int32)
+            inv = np.zeros((self.n, self.localK), np.int32)
+            alloc = np.nonzero(self.gk_local[:Kg] >= 0)[0]
+            inv[self.gk_owner[alloc], self.gk_local[alloc]] = alloc
+        else:
+            lut = np.zeros(1, np.int32)
+            inv = np.zeros((self.n, 1), np.int32)
+        rep = NamedSharding(self.mesh, P())
+        self._lut_dev = (jax.device_put(lut, rep), jax.device_put(inv, rep))
+        self._lut_dirty = False
+        return self._lut_dev
+
+    # --------------------------------------------------------- permutations
+
+    def pk_positions(self, local: int) -> np.ndarray:
+        """Routed row of global pk id g in a [n * local] key space."""
+        g = np.arange(self.n * local, dtype=np.int64)
+        return (g % self.n) * local + g // self.n
+
+    def gk_positions(self) -> np.ndarray:
+        """Routed row of global gk id g (bijective over [n * localK]):
+        allocated ids sit at (owner, local); unallocated ids — and ids
+        whose per-shard local slot exceeds localK (allocated this batch,
+        about to trigger growth; they never owned a state row yet) — fill
+        the remaining all-init rows in order."""
+        Kg = self.n * self.localK
+        if not self.use_lut:
+            return self.pk_positions(self.localK)
+        self._resize_gk(Kg)
+        pos = np.full(Kg, -1, np.int64)
+        placed = np.nonzero(
+            (self.gk_local[:Kg] >= 0) & (self.gk_local[:Kg] < self.localK))[0]
+        pos[placed] = (self.gk_owner[placed].astype(np.int64) * self.localK
+                       + self.gk_local[placed])
+        free = np.setdiff1d(np.arange(Kg), pos[placed], assume_unique=False)
+        pos[pos < 0] = free
+        return pos
+
+    def gk_inverse_values(self) -> np.ndarray:
+        """[n, localK] local gk id -> global gk id (0 where unallocated;
+        ids allocated past localK — pending growth, no state row yet —
+        are simply not placed)."""
+        inv = np.zeros((self.n, self.localK), np.int64)
+        Kg = self.n * self.localK
+        self._resize_gk(Kg)
+        placed = np.nonzero(
+            (self.gk_local[:Kg] >= 0) & (self.gk_local[:Kg] < self.localK))[0]
+        inv[self.gk_owner[placed], self.gk_local[placed]] = placed
+        return inv
+
+
+def route_ineligibility(runtime) -> Optional[str]:
+    """Why this runtime cannot take the device-routed path (None = it
+    can). v1 scope: single-stream partitioned queries over device keyed
+    length windows (or no window at all), and non-partitioned grouped
+    aggregations without a window. Time-driven windows keep the legacy
+    paths until their emission-order keys are made global-aware."""
+    from siddhi_tpu.ops.keyed_windows import KeyedLengthWindowStage
+
+    if getattr(runtime, "sides", None) is not None:
+        return "join queries"
+    if hasattr(runtime, "_steps"):
+        return "pattern/sequence (NFA) queries"
+    if runtime.host_window is not None:
+        return "host-mode windows"
+    sp = runtime.selector_plan
+    if sp.order_by or sp.limit is not None or sp.offset is not None:
+        return "order by / limit (batch-global ordering)"
+    win = runtime.window_stage
+    if win is not None and not isinstance(win, KeyedLengthWindowStage):
+        return (f"window stage {type(win).__name__} (emission-order keys "
+                f"not global-aware yet)")
+    if win is not None and runtime.partition_ctx is None:
+        return "global (non-partitioned) windows"
+    if runtime.partition_ctx is None and runtime.keyer is None:
+        return "unkeyed queries (nothing to route by)"
+    if runtime.carried_pk:
+        return "inner partition '#stream' inputs"
+    return None
+
+
+def device_route_query_step(runtime, mesh: Mesh, rows_per_shard: int = 4096,
+                            exchange: Optional[str] = None):
+    """Install on-device repartitioning for a keyed query: the runtime's
+    step becomes a ``shard_map`` whose body (1) computes each row's owner
+    shard from its key on device, (2) exchanges rows shard-to-shard with a
+    dense ``jax.lax.all_to_all`` (``exchange="pallas_ring"`` selects the
+    TPU ring kernel; inert on CPU fallback), (3) rewrites the partition-
+    and group-key columns into their per-shard local id spaces (distinct
+    spaces — GK == PK is no longer required), (4) steps the shard's local
+    state, and (5) re-merges emitted rows across shards by their global
+    emission-order keys, so sharded output is bit-identical to unsharded.
+
+    ``rows_per_shard`` bounds each shard's per-batch receive capacity;
+    the host pre-checks per-pair quotas and SPLITS oversized batches
+    (``prepare_routed_batches``) instead of dying, and the device-side
+    overflow flag (rows beyond quota) surfaces as ``FatalQueryError``
+    naming ``rows_per_shard``.
+
+    Returns ``(step3, state)`` where ``step3(state, cols, now)`` is also
+    installed as ``runtime._step`` so junction-fed batches take the
+    routed path (CompletionPump-eligible: the merged meta keeps the
+    ``[overflow, notify, count]`` prefix)."""
+    from siddhi_tpu.ops.expressions import CompileError
+
+    why = route_ineligibility(runtime)
+    if why is not None:
+        raise CompileError(
+            f"query '{runtime.name}': device routing does not support "
+            f"{why} — use shard_query_step for those")
+    _release_from_fanout(runtime)
+    n = int(mesh.devices.size)
+    if exchange is None:
+        exchange = getattr(runtime.app_context, "shard_exchange",
+                           "all_to_all")
+    if exchange == "pallas_ring" and not _tpu_backend():
+        exchange = "all_to_all"   # Pallas ring is TPU-only; inert on CPU
+    partitioned = runtime.partition_ctx is not None
+    use_lut = partitioned and runtime.keyer is not None
+
+    # current (global/canonical) capacities and state
+    if runtime._route_layout is not None:
+        canonical = canonical_route_state(runtime)
+        old = runtime._route_layout
+        Kg = old.n * old.localK
+        Wg = old.n * old.local_win if old.local_win > 1 else runtime._win_keys
+    else:
+        Kg = runtime.selector_plan.num_keys
+        Wg = runtime._win_keys
+        canonical = None
+        if runtime._state is not None:
+            canonical = jax.tree_util.tree_map(
+                np.asarray, jax.device_get(runtime._state))
+
+    layout = RouteLayout(mesh, rows_per_shard, exchange, partitioned, use_lut)
+    _install_routed(runtime, layout, canonical, Kg, Wg)
+    return runtime._step, runtime._state
+
+
+def _tpu_backend() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — no backend yet
+        return False
+
+
+def _install_routed(runtime, layout: RouteLayout, canonical, Kg: int, Wg: int):
+    """Shared tail of install / capacity growth / snapshot adoption: size
+    the per-shard capacities, (re)build the GK LUT, lay the canonical
+    state out shard-major, and jit the routed step."""
+    n = layout.n
+    Kg = max(int(Kg), n)
+    # floor 16 (the engine's minimum key capacity): a tiny localK would
+    # collide with aggregator slot counts in _key_axis_of's size-match
+    # heuristic ([slots, K] with slots == K is ambiguous)
+    layout.localK = max(16, _pow2_div(Kg, n))
+    if layout.partitioned:
+        Wg = max(int(Wg), n)
+        layout.local_win = max(16, _pow2_div(Wg, n))
+    else:
+        layout.local_win = 1
+    # per-shard GK pressure can exceed localK under key skew even when the
+    # global count fits — grow until the worst shard fits
+    layout.rebuild_gk(runtime.keyer)
+    while int(layout.gk_counts.max(initial=0)) > layout.localK:
+        layout.localK *= 2
+        layout._lut_dirty = True
+    runtime.selector_plan.num_keys = layout.localK
+    runtime._win_keys = layout.local_win
+    runtime._route_layout = layout
+    runtime._shard_mesh = layout.mesh
+
+    state = _canonical_to_routed(runtime, layout, canonical)
+    if n > 1:
+        axes = _routed_axes(runtime, layout, state)
+        st_specs = jax.tree_util.tree_map(
+            lambda ax: P(KEY_AXIS) if ax <= 0 else P(*([None] * ax), KEY_AXIS),
+            axes)
+        state = jax.device_put(state, jax.tree_util.tree_map(
+            lambda spec: NamedSharding(layout.mesh, spec), st_specs))
+    else:
+        state = jax.device_put(state)
+    runtime._state = state
+    runtime._step = routed_step_for(runtime)
+
+
+def _pow2_div(total: int, n: int) -> int:
+    """total/n rounded up to the next power of two (total, n both pow2 in
+    practice; stays exact then)."""
+    k = 1
+    need = (total + n - 1) // n
+    while k < need:
+        k *= 2
+    return k
+
+
+def _routed_axes(runtime, layout: RouteLayout, state):
+    """Key-axis index per leaf of the GLOBAL routed state (shard-major
+    layout, leaf sizes n*localK / n*local_win*W); -1 = unkeyed (stacked
+    with a leading device axis)."""
+    Kg = layout.n * layout.localK
+    Wgk = layout.n * layout.local_win if layout.local_win > 1 else 1
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _key_axis_of(path, leaf, Kg, Wgk), state)
+
+
+# -------------------------------------------------------- state relayout
+
+def _leaf_space(path) -> str:
+    top = path[0].key if path and hasattr(path[0], "key") else None
+    return "gk" if top == "sel" else "pk"
+
+
+def _buffered_id_col(path) -> Optional[str]:
+    """'__gk__'/'__pk__' when this window-buffer leaf stores key ids whose
+    VALUES must translate between local and global spaces."""
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import PK_KEY
+
+    top = path[0].key if path and hasattr(path[0], "key") else None
+    tail = path[-1].key if path and hasattr(path[-1], "key") else None
+    if top == "win" and tail in (GK_KEY, PK_KEY):
+        return "gk" if tail == GK_KEY else "pk"
+    return None
+
+
+def canonical_route_state(runtime):
+    """Routed (shard-major) state -> canonical unsharded layout, host-side
+    numpy. Snapshots store THIS, so revisions cross-restore between any
+    routed layouts (2/4/8 shards) and the unsharded runtime."""
+    layout = runtime._route_layout
+    state = jax.tree_util.tree_map(np.asarray, jax.device_get(runtime._state))
+    n, Kl, Wl = layout.n, layout.localK, layout.local_win
+    pos_gk = layout.gk_positions()
+    inv_gk_vals = layout.gk_inverse_values() if layout.use_lut else None
+
+    def one(path, leaf):
+        axes = _key_axis_of(path, leaf, n * Kl, n * Wl if Wl > 1 else 1)
+        if axes < 0:
+            return leaf[0] if leaf.ndim else leaf   # stacked unkeyed copy
+        leaf = np.asarray(leaf)
+        idcol = _buffered_id_col(path)
+        if idcol is not None:
+            # translate buffered LOCAL key ids to global before the rows
+            # move: ring rows of shard s live in block s of the flat ring.
+            # Without a LUT (no distinct group-by) the gk space IS the pk
+            # space, so both translate by the round-robin formula.
+            per_shard = leaf.shape[0] // n
+            out = leaf.copy()
+            for s in range(n):
+                blk = out[s * per_shard:(s + 1) * per_shard]
+                if idcol == "pk" or inv_gk_vals is None:
+                    out[s * per_shard:(s + 1) * per_shard] = blk * n + s
+                else:
+                    safe = np.clip(blk.astype(np.int64), 0, Kl - 1)
+                    out[s * per_shard:(s + 1) * per_shard] = (
+                        inv_gk_vals[s][safe].astype(leaf.dtype))
+            leaf = out
+        if _leaf_space(path) == "gk":
+            return np.take(leaf, pos_gk, axis=axes)
+        keys = n * Wl
+        W = leaf.shape[0] // keys
+        pos = layout.pk_positions(Wl)
+        rows = (pos[:, None] * W + np.arange(W)[None, :]).reshape(-1)
+        return leaf[rows]
+
+    return jax.tree_util.tree_map_with_path(one, state)
+
+
+def _canonical_to_routed(runtime, layout: RouteLayout, canonical):
+    """Canonical state (possibly smaller-capacity) -> routed shard-major
+    layout at the layout's capacities; missing key rows come from init."""
+    n, Kl, Wl = layout.n, layout.localK, layout.local_win
+    # routed init: per-shard local inits concatenated shard-major
+    local_init = jax.tree_util.tree_map(np.asarray, runtime._init_state())
+    axes_local = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _key_axis_of(path, leaf, Kl,
+                                        Wl if Wl > 1 else 1), local_init)
+
+    def stack(leaf, ax):
+        arr = np.asarray(leaf)
+        if ax < 0:
+            return np.stack([arr] * n, axis=0)
+        return np.concatenate([arr] * n, axis=ax)
+
+    routed = jax.tree_util.tree_map(stack, local_init, axes_local)
+    if canonical is None:
+        return routed
+    pos_gk = layout.gk_positions()
+    if layout.use_lut:
+        layout._resize_gk(n * Kl)
+
+    def one(path, routed_leaf, canon_leaf):
+        ax = _key_axis_of(path, routed_leaf, n * Kl, n * Wl if Wl > 1 else 1)
+        if ax < 0:
+            base = np.asarray(canon_leaf)
+            return np.stack([base] * n, axis=0)
+        canon_leaf = np.asarray(canon_leaf)
+        out = np.asarray(routed_leaf).copy()
+        if _leaf_space(path) == "gk":
+            # source capacity comes from the canonical leaf itself (it may
+            # be a smaller snapshot/pre-growth layout)
+            g = np.arange(min(canon_leaf.shape[ax], n * Kl))
+            if layout.use_lut:
+                # only groups ALIVE in the (rebuilt-from-keyer) LUT carry
+                # their canonical rows over. Purged gids are absent from
+                # the keyer map, so the rebuild compacts local ids — and
+                # the freed slots are exactly what new groups allocate
+                # next; copying a purged group's stale aggregates there
+                # would seed new groups with dead state (verified bug).
+                # Dropped rows fall back to init, like the unsharded
+                # engine's "purged rows become unreachable" rule.
+                g = g[layout.gk_local[g] >= 0]
+            sl_dst = [slice(None)] * out.ndim
+            sl_src = [slice(None)] * out.ndim
+            sl_dst[ax] = pos_gk[g]
+            sl_src[ax] = g
+            out[tuple(sl_dst)] = canon_leaf[tuple(sl_src)]
+            return out
+        keys = n * Wl
+        W = out.shape[0] // keys
+        pos = layout.pk_positions(Wl)
+        g = np.arange(min(canon_leaf.shape[0] // max(W, 1), keys))
+        rows_dst = (pos[g][:, None] * W + np.arange(W)[None, :]).reshape(-1)
+        rows_src = (g[:, None] * W + np.arange(W)[None, :]).reshape(-1)
+        out[rows_dst] = canon_leaf[rows_src]
+        idcol = _buffered_id_col(path)
+        if idcol is not None:
+            # translate buffered GLOBAL key ids to this layout's locals
+            # (without a LUT the gk space IS the pk space — formula)
+            per_shard = out.shape[0] // n
+            for s in range(n):
+                blk = out[s * per_shard:(s + 1) * per_shard]
+                if idcol == "pk" or not layout.use_lut:
+                    out[s * per_shard:(s + 1) * per_shard] = (
+                        blk.astype(np.int64) // n).astype(out.dtype)
+                else:
+                    lut_g = np.where(
+                        layout.gk_local[: n * Kl] >= 0,
+                        layout.gk_local[: n * Kl], 0).astype(np.int64)
+                    safe = np.clip(blk.astype(np.int64), 0, len(lut_g) - 1)
+                    out[s * per_shard:(s + 1) * per_shard] = (
+                        lut_g[safe].astype(out.dtype))
+        return out
+
+    return jax.tree_util.tree_map_with_path(one, routed, canonical)
+
+
+# ----------------------------------------------------------- routed step
+
+def routed_step_for(runtime):
+    """Build (and return) the device-routed ``step3(state, cols, now)``
+    for a runtime whose ``_route_layout`` is installed. The heavy lifting
+    happens in one jitted ``shard_map``:
+
+    ingress   rows enter B-sharded; each shard computes ``owner = key % n``
+              for its slice, buckets rows per destination (per-pair quota
+              ``rows_per_shard // n``; over-quota rows are counted, not
+              silently dropped), and one dense ``all_to_all`` moves every
+              bucket to its owner. Received rows arrive source-major, i.e.
+              in original batch order.
+    local     PK/GK columns are rewritten to per-shard local ids (PK by
+              ``// n``; GK through the replicated LUT — distinct id
+              spaces, so GK != PK is fine) and the shard steps its local
+              ``[.., K/n]`` state.
+    egress    the window/selector's emission-order key (``__okey__``,
+              derived from the pre-exchange global row index) rides out;
+              shards ``all_gather`` their emitted rows and sort once by
+              okey — the ordered re-merge that makes sharded output
+              bit-identical to the unsharded run. The packed meta becomes
+              ``[overflow, notify, count, route_overflow, rows_0..n-1]``
+              (prefix-compatible with the unsharded ``[3]`` contract)."""
+    from jax.experimental.shard_map import shard_map
+
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import (
+        OKEY_KEY, PK_KEY, RIDX_KEY, VALID_KEY)
+
+    layout = runtime._route_layout
+    n, Q = layout.n, layout.quota
+    localK = layout.localK
+    partitioned, use_lut = layout.partitioned, layout.use_lut
+    step = runtime.build_step_fn()
+    key_name = PK_KEY if partitioned else GK_KEY
+
+    if n == 1:
+        def one_dev(state, cols, luts, now):
+            cols = dict(cols)
+            B = cols[VALID_KEY].shape[0]
+            cols[RIDX_KEY] = jnp.arange(B, dtype=jnp.int64)
+            rows = jnp.sum(cols[VALID_KEY], dtype=jnp.int64)
+            st, out = step(state, cols, now)
+            out = dict(out)
+            meta = out.pop("__meta__")
+            out.pop(OKEY_KEY, None)   # single shard: already in order
+            out["__meta__"] = jnp.concatenate(
+                [meta, jnp.zeros(1, jnp.int64), rows[None]])
+            return st, out
+
+        jitted = jax.jit(one_dev, donate_argnums=(0,))
+        return _finish_routed_install(runtime, layout, jitted)
+
+    axes = _routed_axes(runtime, layout, runtime._state)
+    st_specs = jax.tree_util.tree_map(
+        lambda ax: P(KEY_AXIS) if ax <= 0 else P(*([None] * ax), KEY_AXIS),
+        axes)
+    if layout.exchange == "pallas_ring":
+        exchange = lambda buf: _pallas_ring_exchange(buf, n)  # noqa: E731
+    else:
+        exchange = lambda buf: jax.lax.all_to_all(  # noqa: E731
+            buf, KEY_AXIS, split_axis=0, concat_axis=0, tiled=True)
+
+    def wrapped(state, cols, luts, now):
+        state = jax.tree_util.tree_map(
+            lambda leaf, ax: leaf[0] if ax < 0 else leaf, state, axes)
+        me = jax.lax.axis_index(KEY_AXIS)
+        valid = cols[VALID_KEY]
+        Bl = valid.shape[0]
+        ridx = me.astype(jnp.int64) * Bl + jnp.arange(Bl, dtype=jnp.int64)
+        # owner shard per local row (invalid rows route nowhere)
+        owner = jnp.where(valid, cols[key_name].astype(jnp.int64) % n,
+                          jnp.int64(n))
+        dest = jnp.arange(n, dtype=jnp.int64)[:, None]
+        maskd = owner[None, :] == dest                        # [n, Bl]
+        pos = jnp.cumsum(maskd.astype(jnp.int64), axis=1) - 1
+        # per-ROW slot: each row has exactly one destination, so every
+        # column scatters once at [Bl] cost (an [n*Bl] broadcast-scatter
+        # here would n-fold the hot loop's scatter bandwidth)
+        owner_c = jnp.clip(owner, 0, n - 1).astype(jnp.int32)
+        pos_row = jnp.take_along_axis(pos, owner_c[None, :], axis=0)[0]
+        sendable = owner < n                                  # valid rows
+        sent_row = sendable & (pos_row < Q)
+        route_ov = jnp.sum((sendable & ~sent_row).astype(jnp.int64))
+        slot_row = jnp.where(sent_row, owner * Q + pos_row, jnp.int64(n * Q))
+
+        def exch(col):
+            buf = jnp.zeros((n * Q,) + col.shape[1:], col.dtype)
+            buf = buf.at[slot_row].set(col, mode="drop")
+            return exchange(buf)
+
+        rcols = {k: exch(v) for k, v in cols.items()}
+        rcols[RIDX_KEY] = exch(ridx)
+        rows_here = jnp.sum(rcols[VALID_KEY], dtype=jnp.int64)
+        # global -> per-shard local ids (two separate dense spaces)
+        if partitioned:
+            pk = rcols[PK_KEY]
+            rcols[PK_KEY] = (pk.astype(jnp.int64) // n).astype(pk.dtype)
+        gk = rcols[GK_KEY]
+        if use_lut:
+            lut = luts[0]
+            gl = lut[jnp.clip(gk.astype(jnp.int64), 0, lut.shape[0] - 1)]
+            gl = jnp.clip(gl, 0, localK - 1)
+        else:
+            gl = gk.astype(jnp.int64) // n
+        rcols[GK_KEY] = gl.astype(gk.dtype)
+
+        st, out = step(state, rcols, now)
+        out = dict(out)
+        meta = out.pop("__meta__")
+        okey = jnp.asarray(out.pop(OKEY_KEY), jnp.int64)
+        valid_o = out[VALID_KEY]
+        okey = jnp.where(valid_o, okey, _ROUTE_BIG)
+        # local -> global ids on the emitted rows
+        if partitioned and PK_KEY in out:
+            pko = out[PK_KEY]
+            out[PK_KEY] = (pko.astype(jnp.int64) * n
+                           + me.astype(jnp.int64)).astype(pko.dtype)
+        if GK_KEY in out:
+            gko = out[GK_KEY]
+            if use_lut:
+                inv = luts[1]
+                gg = inv[me, jnp.clip(gko.astype(jnp.int64), 0, localK - 1)]
+            else:
+                gg = gko.astype(jnp.int64) * n + me.astype(jnp.int64)
+            out[GK_KEY] = gg.astype(gko.dtype)
+        # ordered re-merge: gather every shard's emitted rows and sort
+        # once by the global emission-order key (invalid rows sort last,
+        # exactly like _order_emit does within one step)
+        okg = jax.lax.all_gather(okey, KEY_AXIS, axis=0, tiled=True)
+        order = jnp.argsort(okg, stable=True)
+        merged = {
+            k: jax.lax.all_gather(v, KEY_AXIS, axis=0, tiled=True)[order]
+            for k, v in out.items()
+        }
+        ov = jax.lax.psum(meta[0], KEY_AXIS)
+        ntb = jnp.where(meta[1] < 0, _ROUTE_BIG, meta[1])
+        nt = jax.lax.pmin(ntb, KEY_AXIS)
+        nt = jnp.where(nt >= _ROUTE_BIG, jnp.int64(-1), nt)
+        cnt = jax.lax.psum(meta[2], KEY_AXIS)
+        rov = jax.lax.psum(route_ov, KEY_AXIS)
+        rows = jax.lax.all_gather(rows_here, KEY_AXIS)
+        merged["__meta__"] = jnp.concatenate(
+            [jnp.stack([ov, nt, cnt, rov]), rows.astype(jnp.int64)])
+        st = jax.tree_util.tree_map(
+            lambda leaf, ax: jnp.asarray(leaf)[None] if ax < 0 else leaf,
+            st, axes)
+        return st, merged
+
+    sharded = shard_map(
+        wrapped, mesh=layout.mesh,
+        in_specs=(st_specs, P(KEY_AXIS), P(), P()),
+        out_specs=(st_specs, P()),
+        check_rep=False,
+    )
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+    return _finish_routed_install(runtime, layout, jitted)
+
+
+def _finish_routed_install(runtime, layout: RouteLayout, jitted):
+    key = f"query.{runtime.name}.routed_step"
+    tel = getattr(runtime.app_context, "telemetry", None)
+    if tel is not None:
+        jitted = tel.instrument_jit(jitted, key)
+
+    def step3(state, cols, now):
+        return jitted(state, cols, layout.device_luts(), now)
+
+    step3._key = key
+    step3._routed_raw = jitted    # hlo_audit lowers through this
+    step3._layout = layout
+    return step3
+
+
+def prepare_routed_batches(runtime, cols):
+    """Host side of the device-routed dispatch: pad the batch to a
+    multiple of the shard count, pre-check the per-(src, dst) exchange
+    quotas, and SPLIT oversized batches in half until every piece fits —
+    feasible splitting replaces the old router's hard ``shard overflow``
+    death. Also records the shard-skew gauges and the (now tiny)
+    host-side exchange-prep histogram. Returns a list of column dicts to
+    dispatch in order."""
+    import time as _time
+
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import PK_KEY, VALID_KEY
+
+    layout = runtime._route_layout
+    t0 = _time.perf_counter()
+    n, quota = layout.n, layout.quota
+    cols = {k: np.asarray(v) for k, v in dict(cols).items()}
+    key_name = PK_KEY if layout.partitioned else GK_KEY
+
+    def pad_to_mult(c):
+        B = c[VALID_KEY].shape[0]
+        if B % n == 0:
+            return c
+        pad = n - B % n
+        return {k: np.concatenate(
+            [v, np.zeros((pad,) + v.shape[1:], v.dtype)]) for k, v in c.items()}
+
+    pieces = []
+
+    def emit(c):
+        c = pad_to_mult(c)
+        B = c[VALID_KEY].shape[0]
+        Bl = B // n
+        valid = c[VALID_KEY].astype(bool)
+        key = c[key_name].astype(np.int64)
+        src = np.arange(B) // Bl
+        pair = (src * n + key % n)[valid]
+        counts = np.bincount(pair, minlength=n * n)
+        if int(counts.max(initial=0)) <= quota or B <= n:
+            pieces.append(c)
+            return
+        half = max((B // 2 // n) * n, n)
+        emit({k: v[:half] for k, v in c.items()})
+        emit({k: v[half:] for k, v in c.items()})
+
+    emit(cols)
+    dest_rows = np.bincount(
+        (np.asarray(cols[key_name], np.int64) % n)[
+            np.asarray(cols[VALID_KEY], bool)], minlength=n)
+    _record_route_telemetry(
+        getattr(runtime.app_context, "telemetry", None), runtime.name,
+        dest_rows, (_time.perf_counter() - t0) * 1000.0)
+    return pieces
+
+
+def ensure_routed_capacity(runtime) -> None:
+    """Routed analog of ``QueryRuntime._ensure_capacity``: grow per-shard
+    capacities when the GLOBAL key population outgrows ``n * localK`` /
+    ``n * local_win`` — or when key skew overfills one shard's slice of
+    the group-key space — re-laying the live state out via its canonical
+    form."""
+    layout = runtime._route_layout
+    n = layout.n
+    needed_sel = runtime._needed_sel_keys()
+    needed_win = (runtime.partition_ctx.num_keys()
+                  if runtime.partition_ctx is not None else 1)
+    fits = layout.sync_gk(runtime.keyer)
+    grow_sel = needed_sel > n * layout.localK or not fits
+    grow_win = layout.partitioned and needed_win > n * layout.local_win
+    if not (grow_sel or grow_win):
+        return
+    canonical = (canonical_route_state(runtime)
+                 if runtime._state is not None else None)
+    Kg = n * layout.localK
+    while needed_sel > Kg:
+        Kg *= 2
+    Wg = n * layout.local_win if layout.partitioned else 1
+    while layout.partitioned and needed_win > Wg:
+        Wg *= 2
+    _install_routed(runtime, layout, canonical, Kg, Wg)
+
+
+def adopt_canonical(runtime, sel_keys_g: int, win_keys_g: int) -> None:
+    """Snapshot-restore hook: ``runtime._state`` currently holds CANONICAL
+    state at the snapshot's global capacities (snapshots of routed
+    runtimes are captured canonical — see ``canonical_route_state``);
+    re-derive this runtime's shard-major layout from it. Works for any
+    source layout: unsharded, or routed at a different shard count."""
+    layout = runtime._route_layout
+    canonical = None
+    if runtime._state is not None:
+        canonical = jax.tree_util.tree_map(
+            np.asarray, jax.device_get(runtime._state))
+    _install_routed(runtime, layout, canonical, sel_keys_g, win_keys_g)
+
+
+# ------------------------------------------------- Pallas TPU ring kernel
+
+def _pallas_ring_exchange(buf, n: int):
+    """All-to-all of ``buf`` ([n * Q, ...]: segment d goes to shard d) via
+    direct async remote copies (SNIPPETS.md [2] pattern:
+    ``pltpu.make_async_remote_copy`` under ``shard_map``). TPU-only —
+    selected by ``shard_exchange = "pallas_ring"`` and silently replaced
+    by ``lax.all_to_all`` on CPU fallback (``device_route_query_step``).
+    Each shard pushes segment d straight to shard d's receive buffer at
+    segment ``me`` (received rows stay source-major, matching the dense
+    all_to_all layout); ``wait()`` on every descriptor covers both the
+    local sends and the n-1 expected arrivals, whose semaphore slots line
+    up because transfer sizes are uniform."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_dtype = buf.dtype
+    if orig_dtype == jnp.bool_:
+        buf = buf.astype(jnp.int8)   # DMA-friendly lane type
+
+    def kernel(x_ref, out_ref, send_sems, recv_sems):
+        me = jax.lax.axis_index(KEY_AXIS)
+        Q = x_ref.shape[0] // n
+        out_ref[pl.ds(me * Q, Q)] = x_ref[pl.ds(me * Q, Q)]
+        descs = []
+        for hop in range(1, n):
+            dst = jax.lax.rem(me + hop, n)
+            d = pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[pl.ds(dst * Q, Q)],
+                dst_ref=out_ref.at[pl.ds(me * Q, Q)],
+                send_sem=send_sems.at[hop - 1],
+                recv_sem=recv_sems.at[hop - 1],
+                device_id=(dst,),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            d.start()
+            descs.append(d)
+        for d in descs:
+            d.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+                        pltpu.SemaphoreType.DMA((max(n - 1, 1),))],
+    )
+    out = pl.pallas_call(
+        functools.partial(kernel),
+        out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
+        grid_spec=grid_spec,
+    )(buf)
+    if orig_dtype == jnp.bool_:
+        out = out.astype(jnp.bool_)
+    return out

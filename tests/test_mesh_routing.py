@@ -1,0 +1,378 @@
+"""Device-side repartitioning (parallel/mesh.device_route_query_step).
+
+Round-6 contract: a keyed query's batch routing happens INSIDE the jitted
+step (dense all_to_all under shard_map), the group-by key rides a dense-id
+space SEPARATE from the partition key (the old host router's GK == PK
+restriction is lifted), and emitted rows re-merge across shards into the
+exact unsharded emission order — every test here asserts bit-identity
+against an unsharded run of the same feed, through the full engine path
+(junction -> process_batch -> CompletionPump -> callbacks).
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.stream.junction import FatalQueryError
+from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+from siddhi_tpu.parallel.mesh import device_route_query_step, make_mesh
+
+DISTINCT_GK_APP = """
+    @app:name('routeapp')
+    define stream S (symbol string, side string, price double, volume long);
+    partition with (symbol of S)
+    begin
+      @info(name = 'q')
+      from S#window.length(8)
+      select symbol, side, avg(price) as ap, sum(volume) as tv
+      group by side
+      insert into Out;
+    end;
+"""
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(tuple(e.data) for e in events)
+
+
+def _build(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback("Out", c)
+    return m, rt, c
+
+
+def _feed(rt, lo, hi, n_sym=13, n_side=5):
+    rng = np.random.default_rng(42)
+    syms = rng.integers(0, n_sym, 2000)
+    sides = rng.integers(0, n_side, 2000)
+    h = rt.get_input_handler("S")
+    for i in range(lo, hi):
+        h.send([f"SYM{syms[i]}", f"SIDE{sides[i]}",
+                float(i % 17) + 0.25, int(i)])
+
+
+def _run_unsharded(app, lo=0, hi=400):
+    m, rt, c = _build(app)
+    _feed(rt, lo, hi)
+    m.shutdown()
+    return c.rows
+
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_distinct_group_key_bit_identical(n_dev):
+    """The case the host router hard-rejected: a partitioned query whose
+    group-by key differs from the partition key runs sharded and yields
+    output bit-identical to the unsharded run."""
+    ref = _run_unsharded(DISTINCT_GK_APP)
+    m, rt, c = _build(DISTINCT_GK_APP)
+    q = rt.query_runtimes["q"]
+    device_route_query_step(q, make_mesh(n_dev), rows_per_shard=256)
+    assert q._route_layout.n == n_dev   # conftest pins an 8-device mesh
+    _feed(rt, 0, 400)
+    m.shutdown()
+    assert len(ref) == 400
+    assert c.rows == ref
+
+
+def test_out_of_order_emission_remerges():
+    """Keys are fed in an order that makes consecutive rows land on
+    DIFFERENT shards every time (round-robin over the shard owners), so
+    any merge that concatenates per-shard output instead of re-merging by
+    the global emission-order key would interleave wrongly. Window
+    evictions (EXPIRED rows) must also stay glued before the CURRENT row
+    that displaced them."""
+    app = """
+        define stream S (k string, v double);
+        partition with (k of S)
+        begin
+          @info(name = 'q')
+          from S#window.length(2) select k, v, sum(v) as s insert into Out;
+        end;
+    """
+    def feed(rt):
+        h = rt.get_input_handler("S")
+        # 16 keys; adjacent sends always hit different shards at n=4
+        for i in range(240):
+            h.send([f"P{i % 16}", float(i)])
+
+    m1, rt1, c1 = _build(app)
+    feed(rt1)
+    m1.shutdown()
+    m2, rt2, c2 = _build(app)
+    device_route_query_step(rt2.query_runtimes["q"], make_mesh(4),
+                            rows_per_shard=256)
+    feed(rt2)
+    m2.shutdown()
+    assert len(c1.rows) > 0
+    assert c2.rows == c1.rows
+
+
+def test_oversized_batches_split_not_die():
+    """Key skew past the per-pair exchange quota splits the batch
+    host-side (prepare_routed_batches) instead of overflowing — output
+    stays bit-identical."""
+    app = """
+        define stream S (k string, v long);
+        partition with (k of S)
+        begin
+          @info(name = 'q')
+          from S#window.length(4) select k, sum(v) as s insert into Out;
+        end;
+    """
+    def feed(rt):
+        h = rt.get_input_handler("S")
+        for i in range(200):           # 80% of rows on one key/shard
+            h.send([f"K{0 if i % 5 else i % 7}", i])
+
+    m1, rt1, c1 = _build(app)
+    feed(rt1)
+    m1.shutdown()
+    m2, rt2, c2 = _build(app)
+    device_route_query_step(rt2.query_runtimes["q"], make_mesh(4),
+                            rows_per_shard=8)   # quota 2 rows per pair
+    feed(rt2)
+    m2.shutdown()
+    assert c2.rows == c1.rows
+
+
+def test_exchange_overflow_attribution():
+    """A direct step call that bypasses the host precheck trips the
+    device-side overflow flag; the meta check surfaces it as
+    FatalQueryError naming rows_per_shard (the overflow_knob_msg
+    convention), and the per-shard routed-row counts ride the meta."""
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
+
+    app = """
+        define stream S (k string, v long);
+        partition with (k of S)
+        begin
+          @info(name = 'q')
+          from S#window.length(4) select k, sum(v) as s insert into Out;
+        end;
+    """
+    m, rt, _c = _build(app)
+    q = rt.query_runtimes["q"]
+    device_route_query_step(q, make_mesh(4), rows_per_shard=8)
+    h = rt.get_input_handler("S")
+    for i in range(20):
+        h.send([f"K{i % 6}", i])
+    B = 32
+    pk = np.zeros(B, np.int32)   # every row on one shard: pair count 8 > 2
+    cols = {TS_KEY: np.arange(B, dtype=np.int64),
+            TYPE_KEY: np.zeros(B, np.int8), VALID_KEY: np.ones(B, bool),
+            "k": pk.astype(np.int64), "k?": np.zeros(B, bool),
+            "v": np.arange(B, dtype=np.int64), "v?": np.zeros(B, bool),
+            GK_KEY: pk, PK_KEY: pk}
+    _st, out = q._step(q._state, cols, np.int64(99))
+    meta = np.asarray(out["__meta__"])
+    assert meta.shape[0] == 4 + 4          # prefix + per-shard rows
+    assert int(meta[3]) > 0                # route overflow flag
+    with pytest.raises(FatalQueryError, match="rows_per_shard"):
+        q._routed_meta_check(meta)
+    m.shutdown()
+
+
+def test_snapshot_cross_restore_between_layouts():
+    """A revision persisted by a 2-shard routed runtime restores into
+    4- and 8-shard routed runtimes AND into an unsharded one, and every
+    continuation matches the continuous unsharded reference exactly —
+    snapshots store canonical (unsharded) layout."""
+    ref = _run_unsharded(DISTINCT_GK_APP, 0, 500)
+
+    store = InMemoryPersistenceStore()
+    m1, rt1, c1 = _build(DISTINCT_GK_APP)
+    m1.set_persistence_store(store)
+    device_route_query_step(rt1.query_runtimes["q"], make_mesh(2),
+                            rows_per_shard=128)
+    _feed(rt1, 0, 250)
+    rt1.persist()
+    m1.shutdown()
+    head = len(c1.rows)
+
+    for n_dev in (4, 8, None):
+        m2, rt2, c2 = _build(DISTINCT_GK_APP)
+        m2.set_persistence_store(store)
+        if n_dev is not None:
+            device_route_query_step(rt2.query_runtimes["q"], make_mesh(n_dev),
+                                    rows_per_shard=128)
+        rt2.restore_last_revision()
+        _feed(rt2, 250, 500)
+        m2.shutdown()
+        assert c2.rows == ref[head:], f"restore into {n_dev or 'unsharded'}"
+
+
+def test_grouped_no_window_routes_by_group_key():
+    """Non-partitioned grouped aggregation (no window): rows route by the
+    group key itself; no partition-key column exists at all."""
+    app = """
+        define stream S (k string, v long);
+        @info(name = 'q')
+        from S select k, sum(v) as s, count() as c group by k insert into Out;
+    """
+    def feed(rt):
+        rng = np.random.default_rng(3)
+        h = rt.get_input_handler("S")
+        for i in range(300):
+            h.send([f"G{int(rng.integers(0, 40))}", i])
+
+    m1, rt1, c1 = _build(app)
+    feed(rt1)
+    m1.shutdown()
+    m2, rt2, c2 = _build(app)
+    device_route_query_step(rt2.query_runtimes["q"], make_mesh(8),
+                            rows_per_shard=256)
+    feed(rt2)
+    m2.shutdown()
+    assert len(c1.rows) == 300
+    assert c2.rows == c1.rows
+
+
+def test_ineligible_runtimes_raise_cleanly():
+    from siddhi_tpu.ops.expressions import CompileError
+
+    app = """
+        define stream S (k string, v double);
+        @info(name = 'q')
+        from S#window.length(4) select k, sum(v) as s insert into Out;
+    """
+    m, rt, _c = _build(app)
+    with pytest.raises(CompileError, match="device routing"):
+        # global (unpartitioned) window: ring semantics need every row
+        device_route_query_step(rt.query_runtimes["q"], make_mesh(2),
+                                rows_per_shard=64)
+    m.shutdown()
+
+
+def test_purged_groups_do_not_leak_into_new_ones():
+    """Regression (round-6 review): after reset_partition_keys prunes the
+    keyer map, a LUT rebuild (re-install / growth / restore) compacts
+    local gk ids — the freed slots are what NEW groups allocate next, and
+    the relayout must NOT pour the purged groups' stale aggregate rows
+    into them."""
+    app = """
+        define stream S (k string, g string, v long);
+        partition with (k of S)
+        begin
+          @info(name = 'q')
+          from S select k, g, sum(v) as s group by g insert into Out;
+        end;
+    """
+    def feed_phase1(rt):
+        h = rt.get_input_handler("S")
+        for i in range(24):
+            h.send([f"K{i % 12}", f"G{i % 12}", 7])
+
+    def feed_phase2(rt):
+        h = rt.get_input_handler("S")
+        for i in range(8):
+            h.send([f"KN{i}", f"GN{i}", 100])
+
+    def run(routed):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        c = Collector()
+        rt.add_callback("Out", c)
+        q = rt.query_runtimes["q"]
+        if routed:
+            device_route_query_step(q, make_mesh(2), rows_per_shard=64)
+        feed_phase1(rt)
+        # purge a few partition keys, then force a re-layout (the
+        # re-install path exercises rebuild_gk + _canonical_to_routed)
+        q.reset_partition_keys([0, 1])
+        if routed:
+            device_route_query_step(q, make_mesh(2), rows_per_shard=64)
+        feed_phase2(rt)
+        m.shutdown()
+        return c.rows
+
+    ref = run(False)
+    got = run(True)
+    # fresh groups must start from init (sum == 100), not inherit a
+    # purged group's leftovers
+    assert [r for r in got if r[0].startswith("KN")] == \
+        [r for r in ref if r[0].startswith("KN")]
+    assert got == ref
+
+
+def test_gk_equals_pk_reinstall_and_cross_restore():
+    """Regression (round-6 review follow-up): a partitioned query WITHOUT
+    a distinct group-by (gk == pk, no LUT) must survive the relayout
+    paths too — re-install onto a larger mesh mid-run, and snapshot
+    cross-restore — translating its window-buffered key ids by the
+    round-robin formula."""
+    app = """
+        @app:name('gkpk')
+        define stream S (k string, v double);
+        partition with (k of S)
+        begin
+          @info(name = 'q')
+          from S#window.length(4) select k, sum(v) as s insert into Out;
+        end;
+    """
+    def feed(rt, lo, hi):
+        h = rt.get_input_handler("S")
+        for i in range(lo, hi):
+            h.send([f"P{i % 24}", float(i % 9)])
+
+    m1, rt1, c1 = _build(app)
+    feed(rt1, 0, 300)
+    m1.shutdown()
+
+    store = InMemoryPersistenceStore()
+    m2, rt2, c2 = _build(app)
+    m2.set_persistence_store(store)
+    q = rt2.query_runtimes["q"]
+    device_route_query_step(q, make_mesh(2), rows_per_shard=64)
+    feed(rt2, 0, 100)
+    device_route_query_step(q, make_mesh(8), rows_per_shard=64)  # re-install
+    feed(rt2, 100, 200)
+    rt2.persist()
+    m2.shutdown()
+    assert c2.rows == c1.rows[:len(c2.rows)]
+
+    m3, rt3, c3 = _build(app)
+    m3.set_persistence_store(store)
+    device_route_query_step(rt3.query_runtimes["q"], make_mesh(4),
+                            rows_per_shard=64)
+    rt3.restore_last_revision()
+    feed(rt3, 200, 300)
+    m3.shutdown()
+    assert c3.rows == c1.rows[len(c2.rows):]
+
+
+def test_capacity_growth_relayouts_live_state():
+    """Key dictionaries outgrowing n * localK mid-run force a routed
+    relayout (canonical round trip) without output divergence."""
+    app = """
+        define stream S (k string, g string, v long);
+        partition with (k of S)
+        begin
+          @info(name = 'q')
+          from S#window.length(4)
+          select k, g, sum(v) as s group by g insert into Out;
+        end;
+    """
+    def feed(rt):
+        h = rt.get_input_handler("S")
+        for i in range(600):           # 60 pks x composite groups >> 16*n
+            h.send([f"K{i % 60}", f"G{i % 7}", i])
+
+    m1, rt1, c1 = _build(app)
+    feed(rt1)
+    m1.shutdown()
+    m2, rt2, c2 = _build(app)
+    q = rt2.query_runtimes["q"]
+    device_route_query_step(q, make_mesh(4), rows_per_shard=256)
+    k0 = q.selector_plan.num_keys
+    feed(rt2)
+    m2.shutdown()
+    assert q.selector_plan.num_keys > k0    # growth actually happened
+    assert c2.rows == c1.rows

@@ -316,13 +316,23 @@ def test_shard_map_routed_keyed_window_matches_unsharded():
 
 
 def test_route_batch_overflow_raises():
+    """Round-6: the legacy host router's overflow follows the
+    FatalQueryError + knob-naming convention (it used to die with a bare
+    ValueError), and the router itself is a deprecated shim."""
+    import warnings
+
+    import pytest
+
     from siddhi_tpu.core.plan.selector_plan import GK_KEY
+    from siddhi_tpu.core.stream.junction import FatalQueryError
     from siddhi_tpu.ops.expressions import PK_KEY, VALID_KEY
     from siddhi_tpu.parallel.mesh import route_batch_to_shards
 
     cols = {PK_KEY: np.zeros(16, np.int32), GK_KEY: np.zeros(16, np.int32),
             VALID_KEY: np.ones(16, bool)}
-    import pytest
-
-    with pytest.raises(ValueError, match="shard overflow"):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        with pytest.raises(DeprecationWarning):
+            route_batch_to_shards(cols, 4, 16)   # shim warns
+    with pytest.raises(FatalQueryError, match="rows_per_shard"):
         route_batch_to_shards(cols, 4, 2)  # 16 rows all on shard 0 > 2

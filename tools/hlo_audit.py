@@ -151,6 +151,45 @@ define stream StockStream (symbol string, price float, volume long);
     report["shard_map_routed"] = _count_collectives(hlo2)
     m2.shutdown()
 
+    # ---- round-6 strategy: DEVICE-routed batch (unrouted rows in, dense
+    # all_to_all exchange + local step + ordered re-merge inside ONE jitted
+    # module, zero host transfers)
+    from siddhi_tpu.parallel.mesh import device_route_query_step
+
+    m3 = SiddhiManager()
+    rt3 = m3.create_siddhi_app_runtime(_APP)
+    rt3.start()
+    q3 = rt3.query_runtimes["bench"]
+    q3.selector_plan.num_keys = 16_384   # global capacity; split per shard
+    q3._win_keys = 16_384
+    device_route_query_step(q3, mesh, rows_per_shard=rows)
+    lowered = q3._step._routed_raw.lower(
+        q3._state, batch, q3._route_layout.device_luts(), np.int64(0))
+    pre = lowered.as_text()   # pre-optimization: the exchange is explicit
+    assert "all_to_all" in pre, (
+        "device-routed step lost its all_to_all exchange in lowering")
+    hlo3 = lowered.compile().as_text()
+    n_modules = hlo3.count("ENTRY")
+    assert n_modules == 1, (
+        f"device-routed step compiled to {n_modules} HLO modules, want 1")
+    dev_counts = _count_collectives(hlo3)
+    assert dev_counts, "device-routed step compiled with NO collectives"
+    allowed = {"all-to-all", "all-gather", "all-reduce",
+               "collective-permute", "partition-id"}
+    unexpected = set(dev_counts) - allowed
+    assert not unexpected, (
+        f"device-routed step has unexpected collective kinds: {unexpected}")
+    for marker in ("infeed", "outfeed", " send(", " recv(",
+                   "send-start", "recv-start"):
+        assert marker not in hlo3, (
+            f"device-routed step contains a host transfer: {marker}")
+    report["device_routed"] = {
+        "hlo_modules": n_modules,
+        "collectives": dev_counts,
+        "host_transfers": 0,
+    }
+    m3.shutdown()
+
     report["devices"] = N_DEV
     report["batch"] = B
     print(json.dumps(report))
