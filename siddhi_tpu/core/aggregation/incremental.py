@@ -503,6 +503,47 @@ class IncrementalAggregationRuntime(Receiver):
         supplies ``store`` / ``_dirty`` / ``_deleted`` (this runtime, or
         one ``AggregationShard`` of the serving tier); the caller holds
         the holder's lock."""
+        ctl = getattr(self.app_context, "overload", None)
+        if ctl is not None and ctl.memory_budget_bytes is not None:
+            # device-memory budget gate (resilience/overload.py): bucket
+            # stores grow a (duration, bucket, group) slot per novel key —
+            # deny the fold BEFORE creating new slots once the app's
+            # budget is spent (purge/shorter retention frees it). The
+            # O(slots) store scan only runs when a budget is actually
+            # configured — queue-quota-only apps pay nothing here
+            from siddhi_tpu.resilience.overload import (
+                charge_memory,
+                ensure_memory_budget,
+            )
+
+            comp = self._budget_component(holder)
+            per_slot = 96 + 56 * max(len(self.bases), 1)
+            est = self._approx_store_slots(holder) * per_slot
+            ensure_memory_budget(
+                self.app_context, comp,
+                est + len(rows) * len(self.durations) * per_slot,
+                what=f"aggregation '{self.definition.id}' bucket-store "
+                     f"growth")
+            self._fold_rows_inner(holder, prep, rows)
+            charge_memory(self.app_context, comp,
+                          self._approx_store_slots(holder) * per_slot)
+            return
+        self._fold_rows_inner(holder, prep, rows)
+
+    def _budget_component(self, holder) -> str:
+        idx = getattr(holder, "index", None)
+        base = f"aggregation.{self.definition.id}"
+        return base if holder is self or idx is None else f"{base}.shard{idx}"
+
+    @staticmethod
+    def _approx_store_slots(holder) -> int:
+        """(duration, bucket, group) slot count — the unit the memory
+        budget charges bucket stores by (approximate: slot boxes dominate
+        the host-dict footprint)."""
+        return sum(len(groups) for dstore in holder.store.values()
+                   for groups in dstore.values())
+
+    def _fold_rows_inner(self, holder, prep: dict, rows) -> None:
         base_keys = list(self.bases)
         tsv = prep["tsv"]
         base_vals, base_null = prep["base_vals"], prep["base_null"]

@@ -262,6 +262,9 @@ class SiddhiAppRuntime:
         # event-aware clock the expirer sweeps with — mixing wall time in
         # would make @app:playback rows immortal
         for t in self.tables.values():
+            # overload layer (resilience/overload.py): tables gate their
+            # capacity growth on the app's device-memory budget
+            t.app_context = self.app_context
             cache = getattr(t, "cache", None)
             if cache is not None:
                 cache.now_fn = self.app_context.timestamp_generator.current_time
@@ -365,6 +368,82 @@ class SiddhiAppRuntime:
         from siddhi_tpu.core.plan.fanout_plan import plan_fanout_groups
 
         self.fused_fanout_groups: List = plan_fanout_groups(self)
+
+        # overload armor (resilience/overload.py): siddhi_tpu.quota_* /
+        # siddhi_tpu.shed_policy config keys register per-app ingest
+        # quotas, shed policies, a device-memory budget and a fair-share
+        # weight. No keys set => app_context.overload stays None and the
+        # engine is bit-identical to the pre-quota default.
+        if cm is not None:
+            self._overload_from_config(cm)
+
+    def _overload_from_config(self, cm) -> None:
+        def _get(key):
+            return cm.get_property(f"siddhi_tpu.{key}")
+
+        queue_quota = _get("quota_queue_depth")
+        policy = _get("shed_policy")
+        pipeline_quota = _get("quota_pipeline_depth")
+        memory_mb = _get("quota_memory_mb")
+        block_timeout = _get("quota_block_timeout_s")
+        fair_weight = _get("fair_weight")
+        query_cap = _get("quota_query_cap")
+        per_stream_quota = {}
+        per_stream_policy = {}
+        for sid in self.junctions:
+            v = _get(f"quota_queue_depth.{sid}")
+            if v is not None:
+                per_stream_quota[sid] = int(v)
+            v = _get(f"shed_policy.{sid}")
+            if v is not None:
+                per_stream_policy[sid] = str(v).strip().lower()
+        if not any((queue_quota, policy, pipeline_quota, memory_mb,
+                    block_timeout, fair_weight, query_cap,
+                    per_stream_quota, per_stream_policy)):
+            return
+        self.enable_overload(
+            queue_quota=int(queue_quota) if queue_quota else None,
+            shed_policy=(str(policy).strip().lower() if policy else "block"),
+            queue_quota_per_stream=per_stream_quota,
+            shed_policy_per_stream=per_stream_policy,
+            pipeline_quota=int(pipeline_quota) if pipeline_quota else None,
+            memory_budget_mb=float(memory_mb) if memory_mb else None,
+            block_timeout_s=(float(block_timeout) if block_timeout
+                             else None),
+            fair_weight=float(fair_weight) if fair_weight else 1.0,
+            query_cap=int(query_cap) if query_cap else None)
+
+    def enable_overload(self, queue_quota=None, shed_policy="block",
+                        queue_quota_per_stream=None,
+                        shed_policy_per_stream=None, pipeline_quota=None,
+                        memory_budget_mb=None, block_timeout_s=None,
+                        fair_weight=1.0, query_cap=None):
+        """Register this app with the process-global overload layer
+        (``resilience/overload.py``): @Async queue-depth quotas with
+        per-stream ``block`` / ``shed_oldest`` / ``shed_newest``
+        policies, an app-wide dispatch-pipeline quota, an approximate
+        device-memory budget gating every capacity-growth site, and a
+        weighted fair share against sibling apps. Idempotent (re-enable
+        replaces the config); returns the ``AppOverloadControl``."""
+        from siddhi_tpu.resilience.overload import (
+            DEFAULT_BLOCK_TIMEOUT_S,
+            OverloadConfig,
+            OverloadManager,
+        )
+
+        cfg = OverloadConfig(
+            queue_quota=queue_quota,
+            queue_quota_per_stream=dict(queue_quota_per_stream or {}),
+            shed_policy=shed_policy or "block",
+            shed_policy_per_stream=dict(shed_policy_per_stream or {}),
+            pipeline_quota=pipeline_quota,
+            memory_budget_bytes=(int(memory_budget_mb * 1024 * 1024)
+                                 if memory_budget_mb is not None else None),
+            block_timeout_s=(block_timeout_s if block_timeout_s is not None
+                             else DEFAULT_BLOCK_TIMEOUT_S),
+            fair_weight=fair_weight,
+            query_cap=query_cap)
+        return OverloadManager.instance().register(self, cfg)
 
     # ------------------------------------------------------------ assembly
 
@@ -965,6 +1044,15 @@ class SiddhiAppRuntime:
         self.app_context.stopped = True
         if self.app_context.supervisor is not None:
             self.app_context.supervisor.stop()
+        if getattr(self.app_context, "overload", None) is not None:
+            # drop the process-global registration (fair-scheduler slot,
+            # per-app control); identity-pinned so shutting down an OLD
+            # runtime never strips a newer same-named app's quotas
+            from siddhi_tpu.resilience.overload import OverloadManager
+
+            OverloadManager.instance().unregister(
+                self.app_context.name, ctl=self.app_context.overload)
+            self.app_context.overload = None
         self.app_context.timestamp_generator.stop_heartbeat()
         pump = getattr(self.app_context, "completion_pump", None)
         if pump is not None and pump.has_pending:

@@ -219,6 +219,12 @@ class SiddhiAppContext:
         # SiddhiAppRuntime.enable_wal() / .supervise()
         self.ingest_wal = None
         self.supervisor = None
+        # overload armor (resilience/overload.py): per-app ingest quotas,
+        # shed-policy backpressure, device-memory budget, weighted fair
+        # scheduling. None = no quotas (bit-identical default behavior);
+        # set by OverloadManager.register via the siddhi_tpu.quota_* /
+        # siddhi_tpu.shed_policy config keys or rt.enable_overload().
+        self.overload = None
         # shared stores, filled by SiddhiAppRuntime during assembly
         self.tables = {}
         self.named_windows = {}

@@ -269,8 +269,19 @@ class CompletionPump:
         Keeps at most ``depth`` batches of this owner in flight: when the
         new entry would exceed the bound, the older entries drain in one
         batched round (the newest keeps riding, so the producer can go
-        straight back to packing)."""
+        straight back to packing). With overload quotas registered
+        (``resilience/overload.py``) the app-wide ``pipeline_quota``
+        additionally collapses each submitting owner to ONE riding entry
+        while the app total exceeds it — bounding the steady-state total
+        at ``max(quota, one per active query)`` instead of
+        ``depth × N_queries`` (cross-owner drains are off-limits here:
+        lock order is owner -> pump, and we hold only OUR owner's lock) —
+        and each submit is a weighted-fair yield point so a flooded
+        tenant's dispatches don't monopolize the device."""
         owner = entry.owner
+        ctl = getattr(self.app_context, "overload", None)
+        if ctl is not None:
+            ctl.throttle(0)     # yield-only: usage is charged at delivery
         with self._lock:
             dq = self._pending.get(owner)
             if dq is None:
@@ -286,6 +297,12 @@ class CompletionPump:
             # own emit cascades keep producing new entries
             self._tls.submitted = getattr(self._tls, "submitted", 0) + 1
             over = len(dq) - self.depth
+            pq = ctl.pipeline_quota if ctl is not None else None
+            if pq is not None and over <= 0 and self._n_pending > pq:
+                # app-wide quota: drain THIS owner's older entries (other
+                # owners' locks cannot be taken here — their own submits
+                # and flushes bound them the same way)
+                over = 1
         if over > 0:
             # drain everything but the newest in ONE batched pull: the
             # oldest entries have had depth-1 pack cycles to complete, so

@@ -223,19 +223,32 @@ class QueryRuntime(Receiver):
 
             ensure_routed_capacity(self)
             return
-        grew = False
         needed = self._needed_sel_keys()
         k = self.selector_plan.num_keys
-        if needed > k:
-            self.selector_plan.num_keys = _pow2(needed, start=k)
-            grew = True
+        new_k = _pow2(needed, start=k) if needed > k else k
+        new_w = self._win_keys
         if self.partition_ctx is not None:
             needed_w = self.partition_ctx.num_keys()
             if needed_w > self._win_keys:
-                self._win_keys = _pow2(needed_w, start=self._win_keys)
-                grew = True
-        if not grew:
+                new_w = _pow2(needed_w, start=self._win_keys)
+        if new_k == k and new_w == self._win_keys:
             return
+        if (getattr(self.app_context, "overload", None) is not None
+                and self._state is not None):
+            # device-memory budget gate (resilience/overload.py): deny
+            # the growth BEFORE allocating — dense state scales with the
+            # grown key capacity, so project from the current footprint
+            from siddhi_tpu.core.util.statistics import pytree_nbytes
+            from siddhi_tpu.resilience.overload import ensure_memory_budget
+
+            ratio = max(new_k / max(k, 1), new_w / max(self._win_keys, 1))
+            ensure_memory_budget(
+                self.app_context, f"query.{self.name}",
+                int(pytree_nbytes(self._state) * ratio),
+                what=f"query '{self.name}' key-capacity growth "
+                     f"({k}->{new_k} keys)")
+        self.selector_plan.num_keys = new_k
+        self._win_keys = new_w
         self._sel_step = None
         old_state = self._state
         new_state = self._init_state()
@@ -249,6 +262,12 @@ class QueryRuntime(Receiver):
             from siddhi_tpu.parallel.mesh import shard_query_step
 
             shard_query_step(self, self._shard_mesh)
+        if getattr(self.app_context, "overload", None) is not None:
+            from siddhi_tpu.core.util.statistics import pytree_nbytes
+            from siddhi_tpu.resilience.overload import charge_memory
+
+            charge_memory(self.app_context, f"query.{self.name}",
+                          pytree_nbytes(self._state))
 
     def reset_partition_keys(self, ids):
         """Zero the dense state rows of purged partition keys so their ids

@@ -82,12 +82,16 @@ class InputHandler:
                 self._check_order(ts_seq[0], ts_seq[-1])
             # WAL boundary (resilience/replay.py): the batch is ACCEPTED
             # once validation passed — record before delivery, inside the
-            # snapshot barrier so a checkpoint always cuts between batches
+            # snapshot barrier so a checkpoint always cuts between batches.
+            # The record's seq rides to the junction: if quota admission
+            # SHEDS the batch (resilience/overload.py) the record is
+            # discarded, keeping replay exactly the non-shed suffix.
+            wal_seq = None
             if wal is not None:
-                wal.record_events(self.stream_id, events)
+                wal_seq = wal.record_events(self.stream_id, events)
             for ev in events:
                 tsg.set_current_timestamp(ev.timestamp)
-            self.junction.send_events(events)
+            self.junction.send_events(events, wal_seq=wal_seq)
 
     def send_columns(self, data, timestamps=None):
         """Columnar bulk ingestion — the TPU-native fast path: one numpy
@@ -128,16 +132,17 @@ class InputHandler:
                     if lo != hi:
                         tsg.set_current_timestamp(lo)
                     tsg.set_current_timestamp(hi)
+            wal_seq = None
             if wal is not None:
                 # raw columns, not the encoded HostBatch: replay re-encodes
                 # against the restored dictionary. Timestamps are recorded
                 # RESOLVED — a default-stamped batch must replay at its
                 # original ingest time, not the replay wall clock
-                wal.record_columns(
+                wal_seq = wal.record_columns(
                     self.stream_id, data,
                     timestamps if timestamps is not None
                     else np.full(int(batch.size), now, np.int64))
-            self.junction.send_batch(batch)
+            self.junction.send_batch(batch, wal_seq=wal_seq)
 
 
 class InputManager:

@@ -96,6 +96,11 @@ class StreamJunction:
         self._gen = 0
         self._beats = 0
         self.fault_hook = None
+        # overload armor (resilience/overload.py): queued unit id -> its
+        # ingest-WAL sequence number, so a shed unit's record can be
+        # discarded (replay must cover exactly the non-shed suffix).
+        # Empty unless the app registered quotas AND runs a WAL.
+        self._wal_seq_of: dict = {}
 
     def subscribe(self, receiver: Receiver):
         if receiver not in self.receivers:
@@ -184,7 +189,7 @@ class StreamJunction:
             self._worker.join(timeout=5)
             self._worker = None
 
-    def send_events(self, events: List[Event]):
+    def send_events(self, events: List[Event], wal_seq: Optional[int] = None):
         if not events:
             return
         sm = self.app_context.statistics_manager
@@ -195,7 +200,7 @@ class StreamJunction:
             # the producer instead of blocking on a queue nobody drains
             raise self._fatal
         if self._async and self._running:
-            self._enqueue(events)
+            self._enqueue(events, wal_seq)
         else:
             self._deliver(events)
             # synchronous sends keep synchronous semantics: any batches
@@ -215,7 +220,7 @@ class StreamJunction:
             object_multi=getattr(self.definition, "object_multi_attrs", None),
         )
 
-    def send_batch(self, batch):
+    def send_batch(self, batch, wal_seq: Optional[int] = None):
         """Columnar publish (no Event objects). @Async junctions enqueue the
         batch behind any pending event chunks (producer ordering is kept);
         it is delivered as one unit — already a batch."""
@@ -225,7 +230,7 @@ class StreamJunction:
         if self._fatal is not None:
             raise self._fatal
         if self._async and self._running:
-            self._enqueue(batch)
+            self._enqueue(batch, wal_seq)
         else:
             self._deliver_batch(batch)
             self._flush_pipeline(own_only=True)   # see send_events
@@ -255,10 +260,33 @@ class StreamJunction:
         device step."""
         self._adapt(elapsed_ms)
 
-    def _enqueue(self, item):
+    def _enqueue(self, item, wal_seq: Optional[int] = None):
         """Producer-side @Async enqueue, counting backpressure stalls
         (sends that found the queue FULL and had to block) so sizing
-        regressions are visible on /metrics before they become p99."""
+        regressions are visible on /metrics before they become p99.
+
+        Overload armor (resilience/overload.py): with quotas registered,
+        admission runs FIRST — past the queue quota the stream's shed
+        policy engages (shed_newest/shed_oldest drop a unit and discard
+        its WAL record; block waits bounded, escalating to the
+        supervisor). The blocking fallback itself is BOUNDED in all
+        configurations: it re-checks ``_fatal`` each slice (a worker
+        dying mid-wait used to leave the producer parked forever) and
+        escalates to the supervisor every ``block_timeout_s`` so a
+        wedged consumer is replaced instead of deadlocking the
+        producer with only a stall counter to show for it."""
+        from siddhi_tpu.resilience.overload import (
+            BLOCK_PUT_SLICE_S,
+            DEFAULT_BLOCK_TIMEOUT_S,
+        )
+
+        ctl = getattr(self.app_context, "overload", None)
+        if ctl is not None and not ctl.admit(self, item, wal_seq):
+            return                    # shed (counted; WAL record discarded)
+        if wal_seq is not None:
+            # mapped BEFORE the put: once queued, the worker (or a
+            # shed_oldest eviction) may pop it at any moment
+            self._wal_seq_of[id(item)] = wal_seq
         try:
             self._queue.put_nowait(item)
             return
@@ -267,7 +295,47 @@ class StreamJunction:
         tel = getattr(self.app_context, "telemetry", None)
         if tel is not None:
             tel.count(f"junction.{self.definition.id}.backpressure_stalls")
-        self._queue.put(item)
+        timeout_s = (ctl.block_timeout_s if ctl is not None
+                     else DEFAULT_BLOCK_TIMEOUT_S)
+        waited = 0.0
+        while True:
+            try:
+                self._queue.put(item, timeout=BLOCK_PUT_SLICE_S)
+                return
+            except queue.Full:
+                pass
+            if self._fatal is not None:
+                self._wal_seq_of.pop(id(item), None)
+                raise self._fatal
+            waited += BLOCK_PUT_SLICE_S
+            if waited >= timeout_s:
+                waited = 0.0
+                if ctl is not None:
+                    ctl.escalate(self)
+                else:
+                    self._escalate_default()
+
+    def _escalate_default(self) -> None:
+        """Bounded-wait escalation for apps WITHOUT overload quotas: the
+        blocked producer is still visible (counter + log) and the
+        supervisor still gets a chance to replace a wedged consumer."""
+        from siddhi_tpu.resilience import stat_count
+
+        tel = getattr(self.app_context, "telemetry", None)
+        if tel is not None:
+            tel.count(f"junction.{self.definition.id}.enqueue_timeouts")
+        stat_count(self.app_context, "resilience.enqueue_timeouts")
+        sup = getattr(self.app_context, "supervisor", None)
+        if sup is not None and hasattr(sup, "notify_backpressure"):
+            try:
+                sup.notify_backpressure(self)
+                return
+            except Exception:  # noqa: BLE001 — escalation must not mask
+                log.exception("backpressure escalation failed")
+        log.warning(
+            "producer blocked on full @Async queue of stream '%s' — the "
+            "consumer is not draining (wedged worker? attach "
+            "rt.supervise() to auto-replace it)", self.definition.id)
 
     def _deliver_batch(self, batch):
         from siddhi_tpu.core.event import HostBatch, LazyColumns
@@ -321,6 +389,13 @@ class StreamJunction:
         return pump.submits_of(self) if pump is not None else 0
 
     def _timed_deliver(self, events: List[Event]):
+        ctl = getattr(self.app_context, "overload", None)
+        if ctl is not None:
+            # weighted fair scheduling (resilience/overload.py): a worker
+            # of an app running over its fair share yields briefly while
+            # a sibling app is backlogged — one flooded tenant must not
+            # monopolize the cores its siblings' workers need
+            ctl.throttle(len(events))
         t0 = time.perf_counter()
         n0 = self._pump_submits()
         self._deliver(events)
@@ -332,7 +407,11 @@ class StreamJunction:
 
     def _timed_deliver_batch(self, batch):
         # columnar unit variant of _timed_deliver — same pipelined-skip
-        # rule; the two must stay in lock-step
+        # and fair-throttle rules; the two must stay in lock-step
+        ctl = getattr(self.app_context, "overload", None)
+        if ctl is not None:
+            n = batch._size   # known count only — never force a pull here
+            ctl.throttle(int(n) if n is not None else 1)
         t0 = time.perf_counter()
         n0 = self._pump_submits()
         self._deliver_batch(batch)
@@ -374,6 +453,10 @@ class StreamJunction:
             else:
                 try:
                     item = self._queue.get(timeout=_IDLE_POLL_S)
+                    if self._wal_seq_of:
+                        # dequeued for delivery: its WAL record is now
+                        # "will be processed" — drop the shed handle
+                        self._wal_seq_of.pop(id(item), None)
                 except queue.Empty:
                     # idle: drain any batches still riding the pipeline —
                     # bounds emission lag under trickle load to one idle
@@ -430,6 +513,8 @@ class StreamJunction:
                         break
                     self._beats += 1
                     continue
+                if self._wal_seq_of:
+                    self._wal_seq_of.pop(id(more), None)
                 if more is None:
                     stop_after = True
                     break

@@ -84,6 +84,9 @@ class InMemoryTable:
         self.capacity = capacity
         self.state = self._zero_state(capacity)
         self._lock = threading.RLock()
+        # owning app context, wired by SiddhiAppRuntime after construction
+        # (the overload layer's device-memory budget gates _ensure_room)
+        self.app_context = None
         # @primaryKey: uniqueness + host hash probe (the dense-array analog
         # of reference IndexEventHolder's primary-key map,
         # table/holder/IndexEventHolder.java:60-80)
@@ -225,6 +228,10 @@ class InMemoryTable:
     def count(self) -> int:
         return int(np.asarray(self.state["valid"]).sum())
 
+    def _row_bytes(self) -> int:
+        return sum(np.dtype(dt).itemsize
+                   for dt in self.col_specs.values()) + 1   # + valid flag
+
     def _ensure_room(self, n: int):
         needed = self.count + n
         cap = self.capacity
@@ -232,6 +239,21 @@ class InMemoryTable:
             return
         while cap < needed:
             cap *= 2
+        ctx = self.app_context
+        if ctx is not None and getattr(ctx, "overload", None) is not None:
+            # device-memory budget gate (resilience/overload.py): deny the
+            # doubled allocation BEFORE it happens
+            from siddhi_tpu.resilience.overload import (
+                charge_memory,
+                ensure_memory_budget,
+            )
+
+            comp = f"table.{self.definition.id}"
+            ensure_memory_budget(
+                ctx, comp, cap * self._row_bytes(),
+                what=f"table '{self.definition.id}' capacity growth "
+                     f"({self.capacity}->{cap} rows)")
+            charge_memory(ctx, comp, cap * self._row_bytes())
         new = self._zero_state(cap)
         new["cols"] = {
             n_: new["cols"][n_].at[: self.capacity].set(self.state["cols"][n_])

@@ -41,12 +41,23 @@ RESILIENCE_COUNTERS = (
     "resilience.shard_rebuilds",
     "resilience.shard_replay_skips",
     "resilience.shard_replay_gaps",
+    # overload armor (siddhi_tpu/resilience/overload.py)
+    "resilience.shed_events",
+    "resilience.quota_denials",
+    "resilience.enqueue_timeouts",
 )
 
 _JUNCTION_GAUGE = re.compile(r"^junction\.(?P<stream>.+)\.(?P<kind>"
                              r"queue_depth|inflight_batches)$")
 _JUNCTION_STALLS = re.compile(r"^junction\.(?P<stream>.+)"
                               r"\.backpressure_stalls$")
+# overload armor (resilience/overload.py): per-stream shed / escalation
+# counters + per-app quota-utilization gauges
+_JUNCTION_SHEDS = re.compile(r"^junction\.(?P<stream>.+)\.shed_events$")
+_JUNCTION_TIMEOUTS = re.compile(r"^junction\.(?P<stream>.+)"
+                                r"\.enqueue_timeouts$")
+_QUOTA_GAUGE = re.compile(r"^quota\.(?P<resource>queue|pipeline|memory)"
+                          r"_utilization(?:\.(?P<stream>.+))?$")
 _FANOUT_GAUGE = re.compile(r"^fanout\.(?P<stream>.+)\.group_size$")
 _FANOUT_COUNTER = re.compile(r"^fanout\.(?P<stream>.+)\.(?P<kind>"
                              r"dispatches|meta_pulls)$")
@@ -219,6 +230,15 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                              "batch; skew shows as imbalance)",
                              {**base, "query": m.group("scope"),
                               "shard": m.group("shard")}, v)
+                elif _QUOTA_GAUGE.match(name):
+                    m = _QUOTA_GAUGE.match(name)
+                    labels = {**base, "resource": m.group("resource")}
+                    if m.group("stream"):
+                        labels["stream"] = m.group("stream")
+                    fams.add("siddhi_quota_utilization", "gauge",
+                             "fraction of the app's overload quota in "
+                             "use (queue depth / pipeline entries / "
+                             "device-memory budget)", labels, v)
                 elif name in ("serving.pool.pending", "serving.pool.active"):
                     kind = name.rsplit(".", 1)[1]
                     fams.add(f"siddhi_serving_pool_{kind}", "gauge",
@@ -235,6 +255,20 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
         if m:
             fams.add("siddhi_junction_backpressure_stalls_total", "counter",
                      "producer sends that blocked on a full @Async queue",
+                     {**base, "stream": m.group("stream")}, v)
+            continue
+        m = _JUNCTION_SHEDS.match(name)
+        if m:
+            fams.add("siddhi_junction_shed_events_total", "counter",
+                     "events shed by overload admission (shed_oldest / "
+                     "shed_newest past the queue quota)",
+                     {**base, "stream": m.group("stream")}, v)
+            continue
+        m = _JUNCTION_TIMEOUTS.match(name)
+        if m:
+            fams.add("siddhi_junction_enqueue_timeouts_total", "counter",
+                     "bounded enqueue waits that timed out and escalated "
+                     "to the supervisor",
                      {**base, "stream": m.group("stream")}, v)
             continue
         m = _FANOUT_COUNTER.match(name)

@@ -1089,7 +1089,29 @@ def ensure_routed_capacity(runtime) -> None:
     Wg = n * layout.local_win if layout.partitioned else 1
     while layout.partitioned and needed_win > Wg:
         Wg *= 2
+    overloaded = getattr(runtime.app_context, "overload", None) is not None
+    if overloaded and canonical is not None:
+        # device-memory budget gate (resilience/overload.py): routed
+        # growth re-lays the whole state out at the grown global
+        # capacity — deny BEFORE allocating n shards' worth of it
+        from siddhi_tpu.core.util.statistics import pytree_nbytes
+        from siddhi_tpu.resilience.overload import ensure_memory_budget
+
+        ratio = max(Kg / max(n * layout.localK, 1),
+                    (Wg / max(n * layout.local_win, 1)
+                     if layout.partitioned else 1.0))
+        ensure_memory_budget(
+            runtime.app_context, f"query.{runtime.name}",
+            int(pytree_nbytes(canonical) * ratio),
+            what=f"query '{runtime.name}' routed key-capacity growth "
+                 f"({n * layout.localK}->{Kg} global keys)")
     _install_routed(runtime, layout, canonical, Kg, Wg)
+    if overloaded:
+        from siddhi_tpu.core.util.statistics import pytree_nbytes
+        from siddhi_tpu.resilience.overload import charge_memory
+
+        charge_memory(runtime.app_context, f"query.{runtime.name}",
+                      pytree_nbytes(runtime._state))
 
 
 def adopt_canonical(runtime, sel_keys_g: int, win_keys_g: int) -> None:

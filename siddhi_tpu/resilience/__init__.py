@@ -21,10 +21,19 @@ replay log and a supervisor that drives the protocol:
                   resume feeds).
 - ``faults``:     deterministic fault injection (kill a junction worker,
                   drop a peer, fail the Nth sink publish, delay a device
-                  step) for the resilience test suite.
+                  step, flood a stream) for the resilience test suite.
+- ``overload``:   per-app ingest quotas with shed-policy backpressure
+                  (block / shed_oldest / shed_newest), weighted fair
+                  scheduling across tenant apps, and a device-memory
+                  budget gating every capacity-growth site.
 """
 
 from siddhi_tpu.resilience.faults import FaultInjector, WorkerKilled
+from siddhi_tpu.resilience.overload import (
+    AppOverloadControl,
+    OverloadConfig,
+    OverloadManager,
+)
 from siddhi_tpu.resilience.replay import IngestWAL
 from siddhi_tpu.resilience.retry import RetryPolicy
 from siddhi_tpu.resilience.supervisor import (
@@ -34,9 +43,12 @@ from siddhi_tpu.resilience.supervisor import (
 )
 
 __all__ = [
+    "AppOverloadControl",
     "AppSupervisor",
     "FaultInjector",
     "IngestWAL",
+    "OverloadConfig",
+    "OverloadManager",
     "PeerMonitor",
     "PeerRecovery",
     "RetryPolicy",

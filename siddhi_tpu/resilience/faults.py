@@ -34,6 +34,7 @@ class FaultInjector:
         self._wedged = threading.Event()
         self._patched_sinks = []          # (sink, original_publish)
         self._peer_fault_armed = False
+        self._flood_threads = []          # non-blocking flood producers
 
     # ------------------------------------------------- junction workers
 
@@ -82,6 +83,70 @@ class FaultInjector:
             time.sleep(seconds)
 
         junction.fault_hook = hook
+
+    def flood_stream(self, junction, ratio: float = 10.0,
+                     base_events: Optional[int] = None,
+                     make_data=None, chunk: int = 256,
+                     block: bool = True):
+        """Deterministic overload injection: publish ``ratio ×`` the
+        junction's @Async buffer size (or ``ratio × base_events``) events
+        through ``junction.send_events`` — the exact path real producers
+        use, so quota admission, shed policies, and backpressure all
+        engage (``resilience/overload.py``). The soak tool
+        (``tools/overload_soak.py``) and the tests share this one
+        injection path, alongside kill/wedge/delay.
+
+        ``make_data(i)`` supplies each event's data row; the default
+        synthesizes one from the stream definition's attribute types.
+        ``block=True`` sends inline and returns the event count;
+        ``block=False`` floods from a daemon thread and returns it (the
+        caller joins) — the producer-blocking case IS the scenario some
+        tests flood for. Events are timestamped by the app clock."""
+        import time as _time
+
+        from siddhi_tpu.core.event import Event
+        from siddhi_tpu.query_api.definitions import AttrType
+
+        q = getattr(junction, "_queue", None)
+        base = (base_events if base_events is not None
+                else (q.maxsize if q is not None and q.maxsize > 0
+                      else 1024))
+        total = max(int(ratio * base), 1)
+        if make_data is None:
+            attrs = junction.definition.attributes
+
+            def make_data(i, _attrs=attrs):
+                row = []
+                for a in _attrs:
+                    if a.type == AttrType.STRING:
+                        row.append(f"f{i % 8}")
+                    elif a.type in (AttrType.FLOAT, AttrType.DOUBLE):
+                        row.append(float(i))
+                    elif a.type == AttrType.BOOL:
+                        row.append(bool(i % 2))
+                    else:
+                        row.append(i)
+                return row
+
+        def _flood():
+            tsg = junction.app_context.timestamp_generator
+            sent = 0
+            while sent < total:
+                n = min(chunk, total - sent)
+                now = tsg.current_time()
+                junction.send_events([
+                    Event(timestamp=now, data=make_data(sent + k))
+                    for k in range(n)])
+                sent += n
+            return sent
+
+        if block:
+            return _flood()
+        t = threading.Thread(target=_flood, daemon=True,
+                             name=f"flood-{junction.definition.id}")
+        t.start()
+        self._flood_threads.append(t)
+        return t
 
     # ------------------------------------------------------ cluster peers
 
@@ -140,3 +205,6 @@ class FaultInjector:
         for sink, original in self._patched_sinks:
             sink.publish = original
         self._patched_sinks.clear()
+        for t in self._flood_threads:
+            t.join(timeout=10)
+        self._flood_threads.clear()

@@ -1,0 +1,529 @@
+"""Overload armor: per-app quotas, shed-policy backpressure, memory budgets.
+
+The north star is one process hosting a fleet of tenant apps under heavy
+traffic. Before this layer, only the REST ``/query`` edge had admission
+control (``serving/query_tier.AdmissionPool``); the INGEST path blocked
+producers unboundedly when an ``@Async`` junction queue filled, and every
+capacity-growth site (dense key capacity, routed shard capacity,
+aggregation bucket stores, tables) grew without a ceiling — one hot or
+hostile tenant could wedge producers or OOM-abort the whole process. This
+module generalizes the AdmissionPool idea to the whole ingest surface,
+applying the bounded-buffer/backpressure discipline of "Scaling Ordered
+Stream Processing on Shared-Memory Multicores" (PAPERS.md) end to end:
+
+- **Per-app ingest quotas.** ``OverloadConfig`` bounds @Async junction
+  queue depth (per stream or app-wide), app-wide dispatch-pipeline depth
+  (CompletionPump entries in flight), and an approximate device-memory
+  budget charged at every capacity-growth site. Exceeding the queue quota
+  triggers a per-stream policy:
+
+  * ``block`` — the producer waits (bounded); every ``block_timeout_s``
+    of no progress it ESCALATES to the app supervisor
+    (``AppSupervisor.notify_backpressure`` restarts a dead/wedged
+    consumer) and counts ``resilience.enqueue_timeouts`` — a wedged
+    consumer becomes a repaired consumer, not a deadlocked producer.
+  * ``shed_oldest`` — the oldest queued unit is evicted to make room
+    (freshest data wins — dashboards, tickers).
+  * ``shed_newest`` — the incoming unit is dropped (in-order history
+    wins — audit feeds).
+
+  Sheds count events into ``resilience.shed_events`` (and the per-stream
+  ``junction.<sid>.shed_events`` telemetry counter) and their ingest-WAL
+  records are DISCARDED (``IngestWAL.discard``), so a checkpoint/restore
+  cycle replays exactly the non-shed suffix — shed events are never
+  resurrected.
+
+- **Weighted fair scheduling.** Registered apps share the host cores and
+  device through their @Async junction workers and CompletionPump slots.
+  The ``FairScheduler`` tracks a decayed per-app delivery rate; an app
+  whose share of recent work exceeds its weighted fair share — while a
+  sibling app is backlogged — has its worker briefly yield before each
+  delivery, so one flooded tenant cannot starve its siblings' workers of
+  the core (or the device of dispatch slots).
+
+- **Graceful budget exhaustion.** ``ensure_memory_budget`` is consulted
+  at every capacity-growth site (``QueryRuntime._ensure_capacity``,
+  ``mesh.ensure_routed_capacity``, aggregation bucket folds, table
+  ``_ensure_room``) BEFORE allocating: past the budget, growth is denied
+  with a ``FatalQueryError`` naming ``siddhi_tpu.quota_memory_mb`` (the
+  ``QueryRuntime.overflow_knob_msg`` convention) instead of letting XLA
+  abort the process. The ledger is approximate by design — it tracks the
+  dominant dense-state allocations, not every host byte.
+
+Zero-cost when off: an app with no quota config never registers, its
+``app_context.overload`` stays ``None``, and every call site is a single
+``getattr`` check — default behavior is bit-identical to the pre-quota
+engine (verified by ``tools/quick_all.py``).
+
+Config keys (ConfigManager; see README "Overload protection & quotas"):
+``siddhi_tpu.quota_queue_depth[.<stream>]``, ``siddhi_tpu.shed_policy
+[.<stream>]``, ``siddhi_tpu.quota_pipeline_depth``,
+``siddhi_tpu.quota_memory_mb``, ``siddhi_tpu.quota_block_timeout_s``,
+``siddhi_tpu.fair_weight``, ``siddhi_tpu.quota_query_cap``.
+Programmatic: ``SiddhiAppRuntime.enable_overload(...)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+SHED_POLICIES = ("block", "shed_oldest", "shed_newest")
+
+# bounded-wait slice for quota/block waits: short enough that a drained
+# queue admits promptly, long enough not to spin the core
+_WAIT_SLICE_S = 0.002
+# producer-side blocking-put slice (junction._enqueue fallback): each
+# slice re-checks _fatal so a dying worker surfaces to a blocked producer
+BLOCK_PUT_SLICE_S = 0.25
+# default escalation period for producers blocked on a full queue — also
+# used by the un-quota'd bounded-put fallback in junction._enqueue
+DEFAULT_BLOCK_TIMEOUT_S = 5.0
+
+
+def _units(item) -> int:
+    """Event count of one junction queue unit (event chunk or HostBatch)."""
+    if item is None:
+        return 0
+    if isinstance(item, list):
+        return len(item)
+    size = getattr(item, "size", None)
+    return int(size) if size is not None else 1
+
+
+@dataclass
+class OverloadConfig:
+    """Per-app overload-protection quotas. ``None`` disables a bound."""
+
+    # max queued units per @Async junction before the shed policy engages
+    # (distinct from @Async buffer.size: the quota is the ADMISSION bound,
+    # the buffer is the allocation)
+    queue_quota: Optional[int] = None
+    queue_quota_per_stream: Dict[str, int] = field(default_factory=dict)
+    # what happens past the queue quota: block | shed_oldest | shed_newest
+    shed_policy: str = "block"
+    shed_policy_per_stream: Dict[str, str] = field(default_factory=dict)
+    # app-wide cap on CompletionPump entries in flight: past it, each
+    # submitting query collapses to ONE riding entry, bounding the
+    # steady-state total at max(quota, one per active query) instead of
+    # pipeline_depth x N_queries (core/query/completion.py)
+    pipeline_quota: Optional[int] = None
+    # approximate device-memory budget (bytes) charged at capacity-growth
+    # sites; exceeded growth raises FatalQueryError naming the knob
+    memory_budget_bytes: Optional[int] = None
+    # bounded wait before a blocked producer escalates to the supervisor
+    block_timeout_s: float = DEFAULT_BLOCK_TIMEOUT_S
+    # weighted fair share across registered apps (FairScheduler)
+    fair_weight: float = 1.0
+    # per-app REST /query admission cap (AdmissionPool generalization)
+    query_cap: Optional[int] = None
+
+    def __post_init__(self):
+        policies = [self.shed_policy, *self.shed_policy_per_stream.values()]
+        for p in policies:
+            if p not in SHED_POLICIES:
+                raise ValueError(
+                    f"unknown shed policy '{p}' — expected one of "
+                    f"{SHED_POLICIES}")
+
+
+class FairScheduler:
+    """Weighted fair throttling across registered apps.
+
+    Each delivery charges its app's decayed usage (events, half-life
+    ``tau_s``); ``throttle`` sleeps a worker briefly when its app's share
+    of total recent usage exceeds its weighted fair share while a SIBLING
+    app has backlog. With fewer than two registered apps (or no sibling
+    backlog) it never sleeps — solo tenants run at full speed."""
+
+    _SLACK = 1.25            # tolerated overshoot before throttling
+    _MAX_SLEEP_S = 0.02      # per-call yield bound (p99-safe)
+
+    def __init__(self, tau_s: float = 1.0):
+        self.tau_s = float(tau_s)
+        self._lock = threading.Lock()
+        # name -> {"weight", "usage", "last", "backlog_fn"}
+        self._apps: Dict[str, dict] = {}
+
+    def register(self, name: str, weight: float, backlog_fn) -> None:
+        with self._lock:
+            self._apps[name] = {"weight": max(float(weight), 1e-6),
+                                "usage": 0.0, "last": time.monotonic(),
+                                "backlog_fn": backlog_fn}
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._apps.pop(name, None)
+
+    def _decayed(self, st: dict, now: float) -> float:
+        dt = now - st["last"]
+        return st["usage"] * math.exp(-dt / self.tau_s) if dt > 0 \
+            else st["usage"]
+
+    def throttle(self, name: str, units: int) -> float:
+        """Charge ``units`` to ``name`` and return (after sleeping) the
+        yield this call paid, in seconds. Cheap when the app runs alone
+        or under its fair share."""
+        now = time.monotonic()
+        delay = 0.0
+        with self._lock:
+            st = self._apps.get(name)
+            if st is None:
+                return 0.0
+            st["usage"] = self._decayed(st, now) + float(units)
+            st["last"] = now
+            if len(self._apps) >= 2:
+                total_u = total_w = 0.0
+                others_backlogged = False
+                for n, s in self._apps.items():
+                    total_u += self._decayed(s, now)
+                    total_w += s["weight"]
+                    if n != name and not others_backlogged:
+                        try:
+                            others_backlogged = bool(s["backlog_fn"]())
+                        except Exception:  # noqa: BLE001 — dead probe
+                            pass
+                if total_u > 0 and others_backlogged:
+                    share = st["usage"] / total_u
+                    fair = st["weight"] / total_w
+                    if share > fair * self._SLACK:
+                        delay = min(self._MAX_SLEEP_S,
+                                    0.005 * share / fair)
+        if delay:
+            time.sleep(delay)
+        return delay
+
+
+class AppOverloadControl:
+    """One registered app's overload state: quota admission for its
+    junctions, the memory-budget ledger, and shed/denial accounting.
+    Installed as ``app_context.overload`` by ``OverloadManager.register``;
+    every engine call site treats ``None`` as "no quotas"."""
+
+    def __init__(self, manager: "OverloadManager", app_runtime,
+                 config: OverloadConfig):
+        self.manager = manager
+        self.app_runtime = app_runtime
+        self.app_context = app_runtime.app_context
+        self.config = config
+        self._lock = threading.Lock()
+        # component -> charged bytes (capacity-growth ledger)
+        self._ledger: Dict[str, int] = {}
+        self.shed_events = 0          # events shed across all streams
+        self.shed_units = 0           # queue units (batches) shed
+        self.quota_denials = 0        # memory-budget growth denials
+        self.enqueue_timeouts = 0     # block-policy supervisor escalations
+
+    # ------------------------------------------------------------- lookup
+
+    @property
+    def name(self) -> str:
+        return self.app_context.name
+
+    @property
+    def pipeline_quota(self) -> Optional[int]:
+        return self.config.pipeline_quota
+
+    @property
+    def memory_budget_bytes(self) -> Optional[int]:
+        return self.config.memory_budget_bytes
+
+    @property
+    def query_cap(self) -> Optional[int]:
+        return self.config.query_cap
+
+    @property
+    def block_timeout_s(self) -> float:
+        return self.config.block_timeout_s
+
+    def queue_quota_of(self, junction) -> Optional[int]:
+        sid = junction.definition.id
+        q = self.config.queue_quota_per_stream.get(sid)
+        return q if q is not None else self.config.queue_quota
+
+    def policy_of(self, junction) -> str:
+        sid = junction.definition.id
+        return self.config.shed_policy_per_stream.get(
+            sid, self.config.shed_policy)
+
+    # ---------------------------------------------------------- admission
+
+    def admit(self, junction, item, wal_seq=None) -> bool:
+        """Quota admission for one @Async enqueue. Returns False when the
+        unit was SHED (already counted, WAL record discarded) — the
+        junction must not enqueue it. ``block`` policy returns True after
+        a bounded wait that escalates to the supervisor on timeout."""
+        quota = self.queue_quota_of(junction)
+        if quota is None:
+            return True
+        q = junction._queue
+        if q is None or q.qsize() < quota:
+            return True
+        wal = getattr(self.app_context, "ingest_wal", None)
+        if wal is not None and wal.in_replay():
+            # a WAL replay re-feeds the ACCEPTED suffix; shedding or
+            # re-blocking it would break effectively-once recovery
+            return True
+        policy = self.policy_of(junction)
+        if policy == "shed_newest":
+            self._record_shed(junction, _units(item), wal_seq, wal)
+            return False
+        if policy == "shed_oldest":
+            while q.qsize() >= quota:
+                try:
+                    old = q.get_nowait()
+                except queue.Empty:
+                    break
+                if old is None:
+                    # stop sentinel mid-shutdown: keep it, shed the
+                    # incoming unit instead (the worker is about to exit)
+                    try:
+                        q.put_nowait(None)
+                    except queue.Full:
+                        pass
+                    self._record_shed(junction, _units(item), wal_seq, wal)
+                    return False
+                seq = junction._wal_seq_of.pop(id(old), None) \
+                    if junction._wal_seq_of else None
+                self._record_shed(junction, _units(old), seq, wal)
+            return True
+        # block: bounded wait below the quota, escalating each timeout
+        waited = 0.0
+        while q.qsize() >= quota:
+            if junction._fatal is not None:
+                raise junction._fatal
+            if not junction._running:
+                return True          # shutdown: let the put path decide
+            time.sleep(_WAIT_SLICE_S)
+            waited += _WAIT_SLICE_S
+            if waited >= self.config.block_timeout_s:
+                waited = 0.0
+                self.escalate(junction)
+        return True
+
+    def _record_shed(self, junction, n_events: int, wal_seq, wal) -> None:
+        from siddhi_tpu.resilience import stat_count
+
+        if wal is not None and wal_seq is not None:
+            # never WAL-recorded: a restore must replay exactly the
+            # non-shed suffix, not resurrect what admission dropped
+            wal.discard(wal_seq)
+        with self._lock:
+            self.shed_events += n_events
+            self.shed_units += 1
+        sid = junction.definition.id
+        tel = getattr(self.app_context, "telemetry", None)
+        if tel is not None:
+            tel.count(f"junction.{sid}.shed_events", n_events)
+        stat_count(self.app_context, "resilience.shed_events", n_events)
+
+    def escalate(self, junction) -> None:
+        """A producer made no progress for ``block_timeout_s``: count it,
+        and hand the junction to the supervisor — which restarts a dead
+        or beat-stalled consumer — instead of deadlocking silently."""
+        from siddhi_tpu.resilience import stat_count
+
+        with self._lock:
+            self.enqueue_timeouts += 1
+        sid = junction.definition.id
+        tel = getattr(self.app_context, "telemetry", None)
+        if tel is not None:
+            tel.count(f"junction.{sid}.enqueue_timeouts")
+        stat_count(self.app_context, "resilience.enqueue_timeouts")
+        sup = getattr(self.app_context, "supervisor", None)
+        if sup is not None and hasattr(sup, "notify_backpressure"):
+            try:
+                sup.notify_backpressure(junction)
+            except Exception:  # noqa: BLE001 — escalation must not mask
+                log.exception("backpressure escalation failed")
+        else:
+            log.warning(
+                "producer blocked on full queue of junction '%s' for "
+                "%.1fs and no supervisor is attached — call "
+                "rt.supervise() so a wedged consumer can be replaced",
+                sid, self.config.block_timeout_s)
+
+    # ------------------------------------------------------ memory budget
+
+    def charged_bytes(self) -> int:
+        with self._lock:
+            return sum(self._ledger.values())
+
+    def charge(self, component: str, nbytes: int) -> None:
+        with self._lock:
+            self._ledger[component] = max(int(nbytes), 0)
+
+    def ensure_budget(self, component: str, projected_bytes: int,
+                      what: str) -> None:
+        """Deny growth past the budget with a ``FatalQueryError`` naming
+        the knob (``overflow_knob_msg`` convention) — BEFORE allocating,
+        so a hostile tenant's growth dies cleanly instead of OOM-aborting
+        the process."""
+        budget = self.config.memory_budget_bytes
+        if budget is None:
+            return
+        with self._lock:
+            used_others = sum(v for k, v in self._ledger.items()
+                              if k != component)
+        total = used_others + max(int(projected_bytes), 0)
+        if total <= budget:
+            return
+        from siddhi_tpu.core.stream.junction import FatalQueryError
+        from siddhi_tpu.resilience import stat_count
+
+        with self._lock:
+            self.quota_denials += 1
+        tel = getattr(self.app_context, "telemetry", None)
+        if tel is not None:
+            tel.count("overload.quota_denials")
+        stat_count(self.app_context, "resilience.quota_denials")
+        raise FatalQueryError(
+            f"app '{self.name}': {what} denied — device-memory budget "
+            f"exhausted ({component} needs {int(projected_bytes)} B, "
+            f"{used_others} B already charged elsewhere, budget "
+            f"{budget} B) — raise siddhi_tpu.quota_memory_mb "
+            f"(enable_overload(memory_budget_mb=...))")
+
+    # ----------------------------------------------------- fair scheduling
+
+    def throttle(self, units: int) -> None:
+        self.manager.fair.throttle(self.name, units)
+
+    def backlog(self) -> int:
+        """Queued @Async units across the app's junctions (the fair
+        scheduler's are-siblings-starving probe)."""
+        total = 0
+        for j in self.app_runtime.junctions.values():
+            q = getattr(j, "_queue", None)
+            if q is not None:
+                total += q.qsize()
+        return total
+
+    # ----------------------------------------------------------- gauges
+
+    def utilization(self) -> Dict[str, float]:
+        out = {}
+        pq = self.config.pipeline_quota
+        if pq:
+            pump = getattr(self.app_context, "completion_pump", None)
+            out["pipeline"] = (pump._n_pending / pq) if pump is not None \
+                else 0.0
+        budget = self.config.memory_budget_bytes
+        if budget:
+            out["memory"] = self.charged_bytes() / budget
+        return out
+
+
+class OverloadManager:
+    """Process-global registry of overload-protected apps — one per
+    process, like the serving tier's scatter pool."""
+
+    _inst: Optional["OverloadManager"] = None
+    _inst_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._apps: Dict[str, AppOverloadControl] = {}
+        self.fair = FairScheduler()
+
+    @classmethod
+    def instance(cls) -> "OverloadManager":
+        with cls._inst_lock:
+            if cls._inst is None:
+                cls._inst = OverloadManager()
+            return cls._inst
+
+    def register(self, app_runtime,
+                 config: OverloadConfig) -> AppOverloadControl:
+        """Install quota control on ``app_runtime`` (idempotent — a
+        re-register replaces the config, keeping counters)."""
+        name = app_runtime.app_context.name
+        with self._lock:
+            ctl = self._apps.get(name)
+            if ctl is not None and ctl.app_context is app_runtime.app_context:
+                ctl.config = config
+            else:
+                ctl = AppOverloadControl(self, app_runtime, config)
+                self._apps[name] = ctl
+        app_runtime.app_context.overload = ctl
+        self.fair.register(name, config.fair_weight, ctl.backlog)
+        self._register_gauges(ctl)
+        return ctl
+
+    def unregister(self, name: str, ctl=None) -> None:
+        """Drop a registration. ``ctl`` pins the expected control: a NEWER
+        app registered under the same name (blue/green redeploys) must not
+        lose ITS registration when the old app shuts down."""
+        with self._lock:
+            cur = self._apps.get(name)
+            if cur is None or (ctl is not None and cur is not ctl):
+                cur = None
+            else:
+                del self._apps[name]
+        if cur is None:
+            return
+        self.fair.unregister(name)
+        if getattr(cur.app_context, "overload", None) is cur:
+            cur.app_context.overload = None
+
+    def control_of(self, name: str) -> Optional[AppOverloadControl]:
+        with self._lock:
+            return self._apps.get(name)
+
+    def _register_gauges(self, ctl: AppOverloadControl) -> None:
+        """Per-app quota-utilization gauges on the app's telemetry
+        registry (``GET /metrics`` → ``siddhi_quota_utilization``):
+        how close each bounded resource runs to its quota."""
+        tel = getattr(ctl.app_context, "telemetry", None)
+        if tel is None:
+            return
+        cfg = ctl.config
+        for sid, j in ctl.app_runtime.junctions.items():
+            quota = (cfg.queue_quota_per_stream.get(sid)
+                     or cfg.queue_quota)
+            if quota and getattr(j, "_queue", None) is not None:
+                tel.gauge(
+                    f"quota.queue_utilization.{sid}",
+                    lambda jn=j, q=quota: (jn._queue.qsize() / q
+                                           if jn._queue is not None else 0.0))
+        if cfg.pipeline_quota:
+            pump = getattr(ctl.app_context, "completion_pump", None)
+            if pump is not None:
+                tel.gauge("quota.pipeline_utilization",
+                          lambda p=pump, q=cfg.pipeline_quota:
+                          p._n_pending / q)
+        if cfg.memory_budget_bytes:
+            tel.gauge("quota.memory_utilization",
+                      lambda c=ctl, b=cfg.memory_budget_bytes:
+                      c.charged_bytes() / b)
+
+
+# --------------------------------------------------- module-level helpers
+# Engine call sites use these so the default (unregistered) path costs one
+# getattr and returns.
+
+def ensure_memory_budget(app_context, component: str, projected_bytes: int,
+                         what: str) -> None:
+    """Budget gate for a capacity-growth site: raises ``FatalQueryError``
+    naming ``siddhi_tpu.quota_memory_mb`` when growing ``component`` to
+    ``projected_bytes`` would exceed the app's device-memory budget."""
+    ctl = getattr(app_context, "overload", None)
+    if ctl is None:
+        return
+    ctl.ensure_budget(component, projected_bytes, what)
+
+
+def charge_memory(app_context, component: str, nbytes: int) -> None:
+    """Record ``component``'s current approximate dense-state footprint
+    in the app's budget ledger (call after a growth actually happened)."""
+    ctl = getattr(app_context, "overload", None)
+    if ctl is None:
+        return
+    ctl.charge(component, nbytes)

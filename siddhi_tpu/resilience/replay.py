@@ -94,6 +94,7 @@ class IngestWAL:
         self._seq = 0
         self._events = 0                  # events currently held
         self.dropped_batches = 0          # overflow evictions (lossy!)
+        self.shed_records = 0             # admission sheds (overload.py)
         self.replayed_batches = 0
         self.recorded_batches = 0
         # revision whose snapshot the retained suffix FOLLOWS (set by the
@@ -119,17 +120,22 @@ class IngestWAL:
 
     # ------------------------------------------------------------- record
 
-    def record_events(self, stream_id: str, events: List[Event]) -> None:
+    def record_events(self, stream_id: str,
+                      events: List[Event]) -> Optional[int]:
+        """Returns the record's sequence number (None when suppressed) —
+        the handle ``discard`` takes if admission later SHEDS the batch
+        (resilience/overload.py: shed events are never replayed)."""
         if self.in_replay() or not events:
-            return
+            return None
         copies = [Event(timestamp=e.timestamp, data=list(e.data))
                   for e in events]
-        self._append(_Record(None, stream_id, "events", copies, None,
-                             len(copies)))
+        return self._append(_Record(None, stream_id, "events", copies, None,
+                                    len(copies)))
 
-    def record_columns(self, stream_id: str, data, timestamps=None) -> None:
+    def record_columns(self, stream_id: str, data,
+                       timestamps=None) -> Optional[int]:
         if self.in_replay():
-            return
+            return None
         import numpy as np
 
         n = 0
@@ -137,10 +143,10 @@ class IngestWAL:
             n = len(v)
             break
         ts = np.array(timestamps, np.int64) if timestamps is not None else None
-        self._append(_Record(None, stream_id, "columns",
-                             _copy_columns(data), ts, n))
+        return self._append(_Record(None, stream_id, "columns",
+                                    _copy_columns(data), ts, n))
 
-    def _append(self, rec: _Record) -> None:
+    def _append(self, rec: _Record) -> int:
         with self._lock:
             self._seq += 1
             rec.seq = self._seq
@@ -155,6 +161,23 @@ class IngestWAL:
                 self._events -= old.size
                 self.dropped_batches += 1
                 self._count("resilience.wal_dropped_batches")
+            return rec.seq
+
+    def discard(self, seq: int) -> bool:
+        """Remove one retained record by sequence number — the shed path
+        (``resilience/overload.py``): a batch that admission dropped was
+        never processed, so replaying it after a restore would resurrect
+        events the live run shed. No-op (False) when the record was
+        already trimmed or evicted. Replay iterates records, so the seq
+        gap this leaves is harmless."""
+        with self._lock:
+            for i, rec in enumerate(self._log):
+                if rec.seq == seq:
+                    del self._log[i]
+                    self._events -= rec.size
+                    self.shed_records += 1
+                    return True
+        return False
 
     # ------------------------------------------------- checkpoint protocol
 

@@ -342,6 +342,49 @@ class AppSupervisor:
         else:
             self._pump_wedge_flagged = False
 
+    # -------------------------------------------------- producer backpressure
+
+    def notify_backpressure(self, junction) -> bool:
+        """A producer's bounded enqueue wait timed out
+        (``StreamJunction._enqueue`` / the overload layer's ``block``
+        policy): check the junction's consumer NOW instead of waiting for
+        the next tick, and replace it when dead or beat-stalled. Returns
+        True when a restart was issued — the blocked producer's queue
+        starts draining again; a healthy-but-slow consumer is left alone
+        (the wait was genuine backpressure, not a wedge)."""
+        from siddhi_tpu.resilience import stat_count
+
+        if not (getattr(junction, "_async", False) and junction._running):
+            return False
+        if junction._fatal is not None:
+            return False      # surfaced to senders, not restartable
+        sid = junction.definition.id
+        with self._lock:
+            now = time.monotonic()
+            worker = junction._worker
+            dead = worker is None or not worker.is_alive()
+            seen = self._beat_seen.get(sid)
+            if seen is None:
+                # first sighting: record a baseline so the NEXT timeout
+                # can distinguish stalled from slow
+                self._beat_seen[sid] = (junction._beats, now)
+                stalled = False
+            else:
+                stalled = (seen[0] == junction._beats
+                           and (now - seen[1]) > self.wedge_timeout_s)
+            if not (dead or stalled):
+                return False
+            log.warning(
+                "supervisor: producer backpressure escalation — "
+                "restarting %s worker of junction '%s'",
+                "dead" if dead else "wedged", sid)
+            junction.restart_worker()
+            self.worker_restarts += 1
+            self._beat_seen[sid] = (junction._beats, now)
+        stat_count(self.app_runtime.app_context,
+                   "resilience.worker_restarts")
+        return True
+
     # ------------------------------------------------------ peer recovery
 
     def notify_error(self, junction, error: Exception) -> None:

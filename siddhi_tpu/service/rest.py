@@ -192,9 +192,17 @@ class SiddhiRestService:
             from siddhi_tpu.serving.query_tier import QueryShedError
 
             rt = self._rt(body["app"])
+            # per-app admission (resilience/overload.py): an app with a
+            # registered query_cap sheds against ITS OWN pending count
+            # (endpoint '/query:<app>'), so a storm on one tenant never
+            # consumes the shared '/query' cap of its siblings
+            ctl = getattr(rt.app_context, "overload", None)
+            endpoint, cap = "/query", None
+            if ctl is not None and ctl.query_cap is not None:
+                endpoint, cap = f"/query:{rt.name}", ctl.query_cap
             try:
                 fut = self.admission.try_submit(
-                    "/query", rt.query, body["query"])
+                    endpoint, rt.query, body["query"], cap=cap)
             except QueryShedError as e:
                 stat_count(rt.app_context, "resilience.query_sheds")
                 h.send_response(503)

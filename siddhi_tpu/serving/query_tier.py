@@ -79,10 +79,19 @@ class AdmissionPool:
     def cap_for(self, endpoint: str) -> int:
         return self.queue_caps.get(endpoint, self.default_cap)
 
-    def try_submit(self, endpoint: str, fn, *args, **kwargs) -> Future:
+    def try_submit(self, endpoint: str, fn, *args, cap=None,
+                   **kwargs) -> Future:
         """Admit or shed: raises ``QueryShedError`` when the endpoint
-        already has ``cap`` requests pending (queued + executing)."""
-        cap = self.cap_for(endpoint)
+        already has ``cap`` requests pending (queued + executing).
+        ``cap`` overrides the endpoint's configured cap for this submit —
+        the service edge passes an app's own quota here when the target
+        app registered one with the overload layer
+        (``resilience/overload.py``), making admission per-TENANT: a
+        ``/query:<app>`` endpoint tracks its own pending count, so one
+        app's query storm sheds against its own cap instead of consuming
+        the shared pool's."""
+        if cap is None:
+            cap = self.cap_for(endpoint)
         with self._lock:
             n = self._pending.get(endpoint, 0)
             if n >= cap:

@@ -1,0 +1,511 @@
+"""Overload armor (resilience/overload.py): per-app quotas, shed-policy
+backpressure, bounded blocking enqueue with supervisor escalation,
+device-memory budgets at capacity-growth sites, and shed-vs-WAL replay
+consistency. Default config (no quotas) must stay behavior-identical."""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.stream.junction import FatalQueryError
+from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+from siddhi_tpu.resilience import FaultInjector, IngestWAL, OverloadManager
+from siddhi_tpu.resilience.overload import FairScheduler
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def receive(self, events):
+        self.events.extend(events)
+
+    def rows(self):
+        return [tuple(e.data) for e in self.events]
+
+
+def _wait_for(predicate, timeout=15.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+ASYNC_APP = """
+@app:name('{name}')
+@Async(buffer.size='64')
+define stream S (sym string, v long);
+@info(name='q') from S select sym, v insert into Out;
+"""
+
+
+def _mk(m, name, **overload_kwargs):
+    rt = m.create_siddhi_app_runtime(ASYNC_APP.format(name=name))
+    c = Collector()
+    rt.add_callback("Out", c)
+    ctl = rt.enable_overload(**overload_kwargs) if overload_kwargs else None
+    return rt, c, ctl
+
+
+# ------------------------------------------------------------ defaults
+
+
+def test_no_quota_config_means_no_overload_control():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ASYNC_APP.format(name="plain"))
+    assert rt.app_context.overload is None
+    c = Collector()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    for i in range(50):
+        h.send([f"K{i % 3}", i])
+    assert _wait_for(lambda: len(c.events) == 50), len(c.events)
+    assert [e.data[1] for e in c.events] == list(range(50))
+    m.shutdown()
+
+
+def test_registration_is_idempotent_and_unregisters_on_shutdown():
+    m = SiddhiManager()
+    rt, _c, ctl = _mk(m, "reg", queue_quota=8)
+    assert rt.app_context.overload is ctl
+    ctl2 = rt.enable_overload(queue_quota=16)     # replaces config
+    assert ctl2 is ctl and ctl.config.queue_quota == 16
+    assert OverloadManager.instance().control_of("reg") is ctl
+    m.shutdown()
+    assert OverloadManager.instance().control_of("reg") is None
+    assert rt.app_context.overload is None
+
+
+# --------------------------------------------------------- shed policies
+
+
+def _wedge_and_flood(m, name, policy, n_flood=40, **kw):
+    rt, c, ctl = _mk(m, name, queue_quota=4, shed_policy=policy, **kw)
+    rt.start()
+    inj = FaultInjector()
+    j = rt.junctions["S"]
+    inj.wedge_worker(j)
+    h = rt.get_input_handler("S")
+    h.send(["a", -1])
+    assert inj.wait_wedged()
+    sent = inj.flood_stream(j, ratio=1.0, base_events=n_flood, chunk=1)
+    inj.release()
+    assert _wait_for(lambda: len(c.events) + ctl.shed_events == sent + 1), (
+        len(c.events), ctl.shed_events)
+    return rt, c, ctl, sent + 1
+
+
+def test_shed_newest_drops_incoming_with_exact_accounting():
+    m = SiddhiManager()
+    rt, c, ctl, total = _wedge_and_flood(m, "newest", "shed_newest")
+    assert ctl.shed_events > 0
+    assert len(c.events) + ctl.shed_events == total     # zero silent loss
+    # shed_newest keeps the OLDEST queued units: the wedge-parked head
+    # and the first few flood events survive
+    assert c.events[0].data[1] == -1
+    tel = rt.app_context.telemetry.snapshot()
+    assert tel["counters"]["junction.S.shed_events"] == ctl.shed_events
+    m.shutdown()
+
+
+def test_shed_oldest_keeps_freshest_data():
+    m = SiddhiManager()
+    rt, c, ctl, total = _wedge_and_flood(m, "oldest", "shed_oldest")
+    assert ctl.shed_events > 0
+    assert len(c.events) + ctl.shed_events == total
+    # the LAST flood event must have survived eviction (freshest wins);
+    # flood_stream's default long column counts 0..n-1
+    assert c.events[-1].data[1] == 39
+    m.shutdown()
+
+
+def test_flood_stream_respects_custom_data_and_base():
+    m = SiddhiManager()
+    rt, c, _ctl = _mk(m, "flood")
+    rt.start()
+    inj = FaultInjector()
+    n = inj.flood_stream(rt.junctions["S"], ratio=0.5, base_events=20,
+                         make_data=lambda i: ["X", i * 2])
+    assert n == 10
+    assert _wait_for(lambda: len(c.events) == 10)
+    assert [e.data[1] for e in c.events] == [i * 2 for i in range(10)]
+    m.shutdown()
+
+
+# ----------------------------------------- block policy + escalation
+
+
+def test_block_policy_escalates_to_supervisor_and_unblocks():
+    """The bugfix satellite: a wedged consumer used to deadlock the
+    producer forever. With policy 'block' the bounded wait escalates to
+    the supervisor, which replaces the wedged worker — the producer's
+    send COMPLETES."""
+    m = SiddhiManager()
+    rt, c, ctl = _mk(m, "blocker", queue_quota=2, shed_policy="block",
+                     block_timeout_s=0.4)
+    # huge interval: restarts can only come from the escalation path,
+    # not from the supervisor's own periodic tick
+    sup = rt.supervise(interval_s=60.0, wedge_timeout_s=0.3)
+    rt.start()
+    inj = FaultInjector()
+    j = rt.junctions["S"]
+    inj.wedge_worker(j)
+    h = rt.get_input_handler("S")
+    h.send(["a", 0])
+    assert inj.wait_wedged()
+    t0 = time.time()
+    for i in range(1, 6):         # quota 2: these block until escalation
+        h.send([f"K{i}", i])
+    elapsed = time.time() - t0
+    assert elapsed < 20.0          # finite — no deadlock
+    assert ctl.enqueue_timeouts >= 1
+    assert sup.worker_restarts >= 1
+    assert _wait_for(lambda: len(c.events) == 6), len(c.events)
+    assert [e.data[1] for e in c.events] == list(range(6))  # order kept
+    inj.clear()
+    m.shutdown()
+
+
+def test_bounded_enqueue_escalates_without_overload_config(monkeypatch):
+    """The blocking fallback is bounded in the DEFAULT configuration too:
+    a full queue with a wedged worker escalates to the supervisor instead
+    of parking the producer forever."""
+    import siddhi_tpu.resilience.overload as ov
+
+    monkeypatch.setattr(ov, "DEFAULT_BLOCK_TIMEOUT_S", 0.5)
+    monkeypatch.setattr(ov, "BLOCK_PUT_SLICE_S", 0.1)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('defbound')
+        @Async(buffer.size='2')
+        define stream S (sym string, v long);
+        @info(name='q') from S select sym, v insert into Out;
+    """)
+    assert rt.app_context.overload is None
+    c = Collector()
+    rt.add_callback("Out", c)
+    sup = rt.supervise(interval_s=60.0, wedge_timeout_s=0.3)
+    rt.start()
+    inj = FaultInjector()
+    j = rt.junctions["S"]
+    inj.wedge_worker(j)
+    h = rt.get_input_handler("S")
+    h.send(["a", 0])
+    assert inj.wait_wedged()
+    t0 = time.time()
+    for i in range(1, 5):          # buffer 2: producer must block
+        h.send([f"K{i}", i])
+    assert time.time() - t0 < 20.0
+    assert sup.worker_restarts >= 1
+    tel = rt.app_context.telemetry.snapshot()
+    assert tel["counters"].get("junction.S.enqueue_timeouts", 0) >= 1
+    assert _wait_for(lambda: len(c.events) == 5)
+    inj.clear()
+    m.shutdown()
+
+
+# -------------------------------------------- shed-vs-WAL consistency
+
+
+def test_wal_discard_removes_exactly_one_record():
+    wal = IngestWAL(max_batches=16)
+    from siddhi_tpu.core.event import Event
+
+    s1 = wal.record_events("S", [Event(timestamp=1, data=[1])])
+    s2 = wal.record_events("S", [Event(timestamp=2, data=[2])])
+    s3 = wal.record_events("S", [Event(timestamp=3, data=[3])])
+    assert (s1, s2, s3) == (1, 2, 3)
+    assert wal.discard(s2) is True
+    assert wal.discard(s2) is False           # already gone
+    assert [r.seq for r in wal.records_after(0)] == [1, 3]
+    assert wal.pending_events == 2
+    assert wal.shed_records == 1
+
+
+def test_shed_oldest_checkpoint_restore_replays_exactly_non_shed_suffix():
+    """The satellite acceptance: under shed_oldest, a checkpoint/restore
+    cycle replays exactly the non-shed suffix — shed events are never
+    resurrected, and wal_replayed_batches counts only retained records."""
+    store = InMemoryPersistenceStore()
+    m1 = SiddhiManager()
+    m1.set_persistence_store(store)
+    rt1, c1, ctl = _mk(m1, "walshed", queue_quota=4,
+                       shed_policy="shed_oldest")
+    wal = rt1.enable_wal()
+    rt1.start()
+    h = rt1.get_input_handler("S")
+    # prefix: fully delivered (waiting out each send keeps the queue
+    # under the quota — the prefix must not shed), then checkpointed
+    for i in range(6):
+        h.send(1000 + i, [f"K{i % 3}", i])
+        assert _wait_for(lambda n=i: len(c1.events) == n + 1)
+    rt1.persist()
+    assert len(wal) == 0
+    assert ctl.shed_events == 0
+
+    # suffix under overload: wedge the consumer, push past the quota
+    inj = FaultInjector()
+    j = rt1.junctions["S"]
+    inj.wedge_worker(j)
+    h.send(2000, ["w", 100])
+    assert inj.wait_wedged()
+    for i in range(1, 20):
+        h.send(2000 + i, [f"K{i % 3}", 100 + i])
+    inj.release()
+    assert _wait_for(
+        lambda: len(c1.events) + ctl.shed_events == 6 + 20), (
+        len(c1.events), ctl.shed_events)
+    assert ctl.shed_events > 0
+    suffix_emitted = c1.rows()[6:]
+    assert len(suffix_emitted) == 20 - ctl.shed_events
+    # the WAL retains exactly the non-shed suffix
+    assert len(wal) == len(suffix_emitted)
+    m1.shutdown()
+
+    # crash + restore: replay must reproduce exactly the emitted suffix
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2, c2, _ctl2 = _mk(m2, "walshed", queue_quota=4,
+                         shed_policy="shed_oldest")
+    rt2.app_context.ingest_wal = wal
+    replayed_before = wal.replayed_batches
+    assert rt2.restore_last_revision() is not None
+    assert _wait_for(lambda: len(c2.events) == len(suffix_emitted)), (
+        len(c2.events), len(suffix_emitted))
+    time.sleep(0.2)     # no stragglers: shed events must NOT resurrect
+    assert c2.rows() == suffix_emitted
+    assert wal.replayed_batches - replayed_before == len(suffix_emitted)
+    m2.shutdown()
+
+
+# ---------------------------------------------- device-memory budget
+
+
+def test_memory_budget_denies_key_growth_naming_the_knob():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('membudget')
+        define stream S (sym string, v long);
+        @info(name='gq') from S select sym, sum(v) as t group by sym
+          insert into Out;
+    """)
+    rt.enable_overload(memory_budget_mb=0.000001)   # ~1 byte: deny growth
+    c = Collector()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    # first batch fits the initial 16-key capacity — allowed (the budget
+    # gates GROWTH, initial allocation is the baseline)
+    h.send_columns({"sym": [f"g{i}" for i in range(10)],
+                    "v": list(range(10))})
+    with pytest.raises(FatalQueryError) as ei:
+        h.send_columns({"sym": [f"h{i}" for i in range(40)],
+                        "v": list(range(40))})
+    assert "quota_memory_mb" in str(ei.value)
+    assert "membudget" in str(ei.value)
+    ctl = rt.app_context.overload
+    assert ctl.quota_denials >= 1
+    m.shutdown()
+
+
+def test_memory_budget_denies_table_growth():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('tbudget')
+        define stream S (sym string, v long);
+        define table T (sym string, v long);
+        @info(name='ins') from S select sym, v insert into T;
+    """)
+    rt.enable_overload(memory_budget_mb=0.000001)
+    t = rt.tables["T"]
+    with pytest.raises(FatalQueryError) as ei:
+        t._ensure_room(5000)        # past the 1024 default capacity
+    assert "quota_memory_mb" in str(ei.value)
+    assert "table 'T'" in str(ei.value)
+    m.shutdown()
+
+
+def test_memory_budget_denies_aggregation_bucket_growth():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('abudget')
+        define stream S (sym string, v long);
+        define aggregation AggT
+          from S select sym, sum(v) as total group by sym
+          aggregate every sec ... hour;
+    """)
+    rt.enable_overload(memory_budget_mb=0.000001)
+    h = rt.get_input_handler("S")
+    with pytest.raises(FatalQueryError) as ei:
+        h.send(1000, ["a", 1])
+    assert "quota_memory_mb" in str(ei.value)
+    assert "bucket-store" in str(ei.value)
+    m.shutdown()
+
+
+def test_generous_budget_charges_without_denying():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('genbudget')
+        define stream S (sym string, v long);
+        @info(name='gq') from S select sym, sum(v) as t group by sym
+          insert into Out;
+    """)
+    rt.enable_overload(memory_budget_mb=256)
+    c = Collector()
+    rt.add_callback("Out", c)
+    h = rt.get_input_handler("S")
+    h.send_columns({"sym": [f"g{i}" for i in range(10)],
+                    "v": list(range(10))})
+    h.send_columns({"sym": [f"h{i}" for i in range(40)],
+                    "v": list(range(40))})         # grows 16 -> 64 keys
+    ctl = rt.app_context.overload
+    assert ctl.charged_bytes() > 0                 # ledger records growth
+    assert ctl.quota_denials == 0
+    assert 0.0 < ctl.utilization()["memory"] < 1.0
+    m.shutdown()
+
+
+# ----------------------------------------------- pipeline quota
+
+
+def test_pipeline_quota_outputs_identical_to_unbounded():
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    def run(quota):
+        m = SiddhiManager()
+        m.siddhi_context.config_manager = InMemoryConfigManager(
+            {"siddhi_tpu.pipeline_depth": "8"})
+        rt = m.create_siddhi_app_runtime(ASYNC_APP.format(name="pq"))
+        c = Collector()
+        rt.add_callback("Out", c)
+        if quota is not None:
+            rt.enable_overload(pipeline_quota=quota)
+        h = rt.get_input_handler("S")
+        for i in range(60):
+            h.send([f"K{i % 5}", i])
+        assert _wait_for(lambda: len(c.events) == 60), len(c.events)
+        rows = c.rows()
+        m.shutdown()
+        return rows
+
+    assert run(quota=1) == run(quota=None)
+
+
+# ----------------------------------------------- fair scheduling
+
+
+def test_fair_scheduler_throttles_only_the_over_share_app():
+    fs = FairScheduler(tau_s=10.0)
+    fs.register("hog", 1.0, lambda: 0)
+    fs.register("victim", 1.0, lambda: 5)      # victim is backlogged
+    for _ in range(5):
+        hog_delay = fs.throttle("hog", 10_000)
+    assert hog_delay > 0.0                     # over share + sibling starved
+    fs.register("victim", 1.0, lambda: 5)
+    assert fs.throttle("victim", 1) == 0.0     # under share: never sleeps
+    # solo app never throttles, whatever its usage
+    fs.unregister("victim")
+    assert fs.throttle("hog", 10_000) == 0.0
+
+
+def test_fair_scheduler_idle_siblings_do_not_throttle():
+    fs = FairScheduler(tau_s=10.0)
+    fs.register("hog", 1.0, lambda: 0)
+    fs.register("idle", 1.0, lambda: 0)        # no backlog anywhere
+    assert fs.throttle("hog", 10_000) == 0.0
+
+
+# ----------------------------------------------------- observability
+
+
+def test_quota_counters_predeclared_and_gauges_on_metrics():
+    from siddhi_tpu.observability.export import prometheus_text
+
+    m = SiddhiManager()
+    rt, _c, _ctl = _mk(m, "metrics_app", queue_quota=8,
+                       shed_policy="shed_newest", pipeline_quota=4,
+                       memory_budget_mb=64)
+    rt.start()
+    text = prometheus_text(m)
+    # the three new counters are pre-declared at 0 (dashboards first)
+    for name in ("resilience.shed_events", "resilience.quota_denials",
+                 "resilience.enqueue_timeouts"):
+        assert f'siddhi_counter_total{{app="metrics_app",name="{name}"}} 0' \
+            in text, name
+    # per-app quota-utilization gauges
+    assert ('siddhi_quota_utilization{app="metrics_app",resource="queue",'
+            'stream="S"}') in text
+    assert ('siddhi_quota_utilization{app="metrics_app",'
+            'resource="pipeline"}') in text
+    assert ('siddhi_quota_utilization{app="metrics_app",'
+            'resource="memory"}') in text
+    m.shutdown()
+
+
+def test_shed_counter_exported_per_stream():
+    from siddhi_tpu.observability.export import prometheus_text
+
+    m = SiddhiManager()
+    rt, c, ctl, total = _wedge_and_flood(m, "shedmetrics", "shed_newest")
+    text = prometheus_text(m, "shedmetrics")
+    assert "siddhi_junction_shed_events_total" in text
+    assert f'stream="S"}} {ctl.shed_events}' in text
+    m.shutdown()
+
+
+def test_config_keys_register_overload():
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    m = SiddhiManager()
+    m.siddhi_context.config_manager = InMemoryConfigManager({
+        "siddhi_tpu.quota_queue_depth": "16",
+        "siddhi_tpu.shed_policy": "shed_oldest",
+        "siddhi_tpu.shed_policy.S": "shed_newest",
+        "siddhi_tpu.quota_pipeline_depth": "8",
+        "siddhi_tpu.quota_memory_mb": "128",
+        "siddhi_tpu.fair_weight": "2.5",
+        "siddhi_tpu.quota_query_cap": "32",
+    })
+    rt = m.create_siddhi_app_runtime(ASYNC_APP.format(name="cfg"))
+    ctl = rt.app_context.overload
+    assert ctl is not None
+    assert ctl.config.queue_quota == 16
+    assert ctl.config.shed_policy == "shed_oldest"
+    assert ctl.policy_of(rt.junctions["S"]) == "shed_newest"
+    assert ctl.config.pipeline_quota == 8
+    assert ctl.config.memory_budget_bytes == 128 * 1024 * 1024
+    assert ctl.config.fair_weight == 2.5
+    assert ctl.query_cap == 32
+    m.shutdown()
+
+
+def test_old_runtime_shutdown_keeps_newer_same_named_registration():
+    """Blue/green redeploys: shutting down the OLD runtime of a name must
+    not strip the NEW runtime's quotas (unregister is identity-pinned)."""
+    m_old = SiddhiManager()
+    rt_old = m_old.create_siddhi_app_runtime(ASYNC_APP.format(name="bg"))
+    ctl_old = rt_old.enable_overload(queue_quota=8)
+    m_new = SiddhiManager()
+    rt_new = m_new.create_siddhi_app_runtime(ASYNC_APP.format(name="bg"))
+    ctl_new = rt_new.enable_overload(queue_quota=16)
+    assert ctl_new is not ctl_old
+    assert OverloadManager.instance().control_of("bg") is ctl_new
+    m_old.shutdown()
+    # the replacement keeps its registration and its control
+    assert OverloadManager.instance().control_of("bg") is ctl_new
+    assert rt_new.app_context.overload is ctl_new
+    assert rt_old.app_context.overload is None
+    m_new.shutdown()
+    assert OverloadManager.instance().control_of("bg") is None
+
+
+def test_bad_shed_policy_rejected():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ASYNC_APP.format(name="badpolicy"))
+    with pytest.raises(ValueError):
+        rt.enable_overload(queue_quota=4, shed_policy="drop_everything")
+    m.shutdown()

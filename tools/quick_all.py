@@ -1,0 +1,70 @@
+"""Run the whole pre-commit quick tier with ONE command and ONE exit code.
+
+Each check is a standalone script that asserts bit-identity (or audits
+the HLO) and exits nonzero on failure; this runner executes them as
+subprocesses (each needs its own fresh jax process — several reconfigure
+the virtual device count at import) and aggregates:
+
+    JAX_PLATFORMS=cpu python tools/quick_all.py            # all checks
+    JAX_PLATFORMS=cpu python tools/quick_all.py route agg  # a subset
+
+Exit code 0 iff every selected check passed. A check crossing its
+per-check timeout counts as FAILED.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# name -> (script, per-check timeout seconds)
+CHECKS = {
+    "route": ("quick_route_check.py", 300),
+    "fanout": ("quick_fanout_check.py", 300),
+    "pipeline": ("pipeline_check.py", 300),
+    "agg": ("quick_agg_check.py", 300),
+    "hlo": ("hlo_audit.py", 300),
+}
+
+
+def main() -> int:
+    names = sys.argv[1:] or list(CHECKS)
+    unknown = [n for n in names if n not in CHECKS]
+    if unknown:
+        print(f"unknown check(s) {unknown}; available: {list(CHECKS)}")
+        return 2
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "")
+    results = {}
+    t00 = time.time()
+    for name in names:
+        script, timeout = CHECKS[name]
+        t0 = time.time()
+        print(f"[quick_all] {name}: {script} ...", flush=True)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(HERE, script)],
+                env=env, timeout=timeout, capture_output=True, text=True)
+            ok = proc.returncode == 0
+            tail = (proc.stdout + proc.stderr).strip().splitlines()[-8:]
+        except subprocess.TimeoutExpired:
+            ok, tail = False, [f"TIMEOUT after {timeout}s"]
+        results[name] = ok
+        status = "PASS" if ok else "FAIL"
+        print(f"[quick_all] {name}: {status} in {time.time() - t0:.1f}s",
+              flush=True)
+        if not ok:
+            for line in tail:
+                print(f"    {line}", flush=True)
+    failed = [n for n, ok in results.items() if not ok]
+    print(f"[quick_all] {len(results) - len(failed)}/{len(results)} checks "
+          f"passed in {time.time() - t00:.1f}s"
+          + (f" — FAILED: {failed}" if failed else ""), flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
