@@ -914,6 +914,105 @@ def bench_fanout():
     return points
 
 
+def bench_join():
+    """Device join engine curve (ISSUE 9): a stream-stream length-window
+    join driven through the real ingest path under two mixes —
+    **probe-heavy** (the build side is pre-filled to its window capacity
+    and held; every measured batch triggers probes against it) and
+    **insert-heavy** (batches alternate sides under a selective ``on``
+    condition, so window insert + directory upkeep dominate) — across
+    join partition counts P in {1, 2, 4, 8} and pipeline depth {1, 2},
+    plus the legacy synchronous probe path at depth 1 as the acceptance
+    reference (the engine must hold >= 0.9x legacy at depth 1)."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    B = int(os.environ.get("BENCH_JOIN_BATCH", 2048))
+    W = int(os.environ.get("BENCH_JOIN_WINDOW", 2048))
+    K = 512                       # join key cardinality
+    rng = np.random.default_rng(23)
+    sym_strings = np.array([f"S{i}" for i in range(K)], dtype=object)
+    app = f"""
+define stream L (sym string, lv long);
+define stream R (sym string, rv long);
+@info(name='jq') from L#window.length({W}) join R#window.length({W})
+  on L.sym == R.sym
+  select L.sym as sym, L.lv as lv, R.rv as rv insert into JOut;
+"""
+
+    def batch(i, side):
+        ids = rng.integers(0, K, B, dtype=np.int64)
+        return ({"sym": sym_strings[ids],
+                 ("lv" if side == "L" else "rv"):
+                     rng.integers(0, 1000, B, dtype=np.int64)},
+                np.arange(i * B, (i + 1) * B, dtype=np.int64))
+
+    def run_one(mode: str, P: int, depth: int, mix: str) -> float:
+        manager = SiddhiManager()
+        manager.set_config_manager(InMemoryConfigManager({
+            "siddhi_tpu.join_engine": mode,
+            "siddhi_tpu.join_partitions": str(P),
+            "siddhi_tpu.pipeline_depth": str(depth),
+            "siddhi_tpu.window_capacity": str(W),
+        }))
+        rt = manager.create_siddhi_app_runtime(app)
+
+        class Counter(StreamCallback):
+            n_out = 0
+
+            def receive_batch(self, b, junction):
+                Counter.n_out += b.size
+
+            def receive(self, events):
+                Counter.n_out += len(events)
+
+        rt.add_callback("JOut", Counter())
+        hl, hr = rt.get_input_handler("L"), rt.get_input_handler("R")
+        if mix == "probe":
+            # fill the build side to capacity once; measured batches all
+            # probe (the PanJoin case: the partition directory cuts the
+            # [B, W] condition surface ~P-fold)
+            cols, ts = batch(0, "R")
+            for j in range(W // B):
+                hr.send_columns(cols, timestamps=ts)
+        # warm both side steps' compiles out of the measure window
+        for j in range(2):
+            hl.send_columns(*batch(1 + j, "L"))
+            hr.send_columns(*batch(3 + j, "R"))
+        pre = [(side, batch(5 + j, side)) for j, side in enumerate(
+            ["L"] * 8 if mix == "probe" else ["L", "R"] * 4)]
+        n, i = 0, 0
+        t0 = time.perf_counter()
+        t_end = t0 + MEASURE_SECONDS / 2
+        while time.perf_counter() < t_end:
+            side, (cols, ts) = pre[i % len(pre)]
+            (hl if side == "L" else hr).send_columns(cols, timestamps=ts)
+            n += B
+            i += 1
+        eps = n / (time.perf_counter() - t0)
+        manager.shutdown()
+        assert Counter.n_out > 0
+        return eps
+
+    points = []
+    for mix in ("probe", "insert"):
+        ref = run_one("legacy", 1, 1, mix)
+        rec = {"mix": mix, "batch": B, "window": W,
+               "eps_legacy_d1": round(ref, 1), "device": []}
+        for P in (1, 2, 4, 8):
+            for depth in (1, 2):
+                eps = run_one("device", P, depth, mix)
+                rec["device"].append({
+                    "P": P, "depth": depth, "eps": round(eps, 1),
+                    "vs_legacy_d1": round(eps / ref, 3)})
+                print(json.dumps({"partial": {"mix": mix, "P": P,
+                                              "depth": depth,
+                                              "eps": round(eps, 1)}}),
+                      flush=True)
+        points.append(rec)
+    return points
+
+
 # --------------------------------------------------------------- harness
 
 
@@ -1182,6 +1281,15 @@ def main():
         else:
             result["sections_failed"].append("pipeline")
         emit()
+    # device join engine curve (ISSUE 9): probe-heavy vs insert-heavy mix
+    # over P x depth, vs the legacy synchronous probe path
+    out, _ = _run_section_once("join_cpu", min(300.0, remaining()))
+    if out is not None:
+        result["join_curve"] = out["points"]
+        result["join_backend"] = "cpu-fallback"
+    else:
+        result["sections_failed"].append("join")
+    emit()
     # serving-tier shard curve (ISSUE 6): mixed ingest + on-demand store
     # queries over 1/2/4/8 aggregation shards; CPU-only workload today
     # (the rollup cube lives host-side), so never tunnel-gated
@@ -1266,6 +1374,8 @@ if __name__ == "__main__":
             print(json.dumps({"points": bench_fanout()}))
         elif section == "pipeline":
             print(json.dumps({"points": bench_pipeline_curve()}))
+        elif section == "join":
+            print(json.dumps({"points": bench_join()}))
         elif section == "serving":
             print(json.dumps({"points": bench_serving()}))
         else:

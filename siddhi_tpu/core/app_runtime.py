@@ -154,10 +154,22 @@ class SiddhiAppRuntime:
         if cm is not None:
             for knob in ("window_capacity", "partition_window_capacity",
                          "nfa_slots", "initial_key_capacity", "defer_meta",
-                         "pipeline_depth", "agg_shards", "agg_shard_wal"):
+                         "pipeline_depth", "agg_shards", "agg_shard_wal",
+                         "join_partitions", "join_partition_slack"):
                 v = cm.get_property(f"siddhi_tpu.{knob}")
                 if v is not None:
                     setattr(self.app_context, knob, int(v))
+            v = cm.get_property("siddhi_tpu.join_partition_grow")
+            if v is not None:
+                s = str(v).strip().lower()
+                if s in ("1", "true", "on", "yes"):
+                    self.app_context.join_partition_grow = True
+                elif s in ("0", "false", "off", "no"):
+                    self.app_context.join_partition_grow = False
+                else:
+                    raise SiddhiAppValidationException(
+                        "siddhi_tpu.join_partition_grow must be a boolean "
+                        "(1/0/true/false/on/off)")
                     if knob == "pipeline_depth":
                         explicit_depth = int(v)
             v = cm.get_property("siddhi_tpu.cluster_step_timeout")
@@ -178,6 +190,17 @@ class SiddhiAppRuntime:
                         "siddhi_tpu.shard_exchange must be 'all_to_all' "
                         "or 'pallas_ring'")
                 self.app_context.shard_exchange = v
+            v = cm.get_property("siddhi_tpu.join_engine")
+            if v is not None:
+                # 'device' = PanJoin-style partitioned engine on eligible
+                # stream-stream window joins (core/join/); 'legacy' keeps
+                # the synchronous reference probe path wholesale
+                v = str(v).strip().lower()
+                if v not in ("device", "legacy"):
+                    raise SiddhiAppValidationException(
+                        "siddhi_tpu.join_engine must be 'device' or "
+                        "'legacy'")
+                self.app_context.join_engine = v
         if self.app_context.defer_meta > 1:
             # deprecation shim: the hold-N-then-flush defer queue is
             # subsumed by the dispatch pipeline (core/query/completion.py)

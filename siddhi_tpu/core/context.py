@@ -214,6 +214,33 @@ class SiddhiAppContext:
         # per-shard bounded WAL (batches) backing the shard rebuild
         # protocol; 0 disables shard WALs. Key siddhi_tpu.agg_shard_wal.
         self.agg_shard_wal = 1024
+        # device join engine (core/join/): 'device' attaches the
+        # PanJoin-style partitioned probe engine to eligible stream-stream
+        # window joins (pipeline/fusion-eligible fused insert+probe step);
+        # 'legacy' keeps the reference synchronous broadcast-probe path
+        # wholesale. Key siddhi_tpu.join_engine.
+        self.join_engine = "device"
+        # build-side hash partitions per join side (pow2, clamped to 64);
+        # partition-local probes cut the [N, W] probe surface ~P-fold.
+        # 0 = auto: 8 on accelerator backends, 1 on the CPU fallback —
+        # the directory's gathers + emission-order sort lose to the
+        # vectorized broadcast compare on a scalar core (bench.py
+        # --section join, PERF.md), while P = 1 keeps the fused in-state
+        # step (pipeline/fusion/mesh eligibility) at legacy speed. An
+        # explicit value is always honored. Key siddhi_tpu.join_partitions.
+        self.join_partitions = 0
+        # per-partition sub-window slack factor: each [P, W*slack/P]
+        # sub-window tolerates key skew up to slack/P of the ring before
+        # adaptive growth (or, with growth off, a partition overflow
+        # naming this knob). Key siddhi_tpu.join_partition_slack.
+        self.join_partition_slack = 2
+        # adaptive sub-window growth (PanJoin re-partitioning): the host
+        # mirrors each side's ring occupancy and grows Wp (capped at
+        # pow2(W)) before a skewed batch could overflow a partition. Off
+        # = static provisioning; overflow becomes FatalQueryError naming
+        # siddhi_tpu.join_partition_slack. Key
+        # siddhi_tpu.join_partition_grow.
+        self.join_partition_grow = True
         # resilience subsystem attach points (siddhi_tpu/resilience/):
         # bounded ingest replay log + app supervisor, set by
         # SiddhiAppRuntime.enable_wal() / .supervise()

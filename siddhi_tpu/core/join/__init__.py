@@ -1,0 +1,65 @@
+"""Device-resident partitioned join engine (see ``engine.py``).
+
+``attach_join_engine`` is the single planner hook: it classifies a
+freshly-built ``JoinQueryRuntime`` (engine-eligible / pipeline-eligible /
+legacy), instantiates the engine for eligible shapes, and registers the
+join observability surface (``siddhi_join_partition_rows`` occupancy
+gauges + ``siddhi_join_probe_ms`` / ``siddhi_join_insert_ms``
+histograms, exported by ``observability/export.py``)."""
+
+from __future__ import annotations
+
+from siddhi_tpu.core.join.engine import (  # noqa: F401 — public surface
+    ENGINE_STATE_KEYS,
+    PIDX_KEYS,
+    SEQ_KEY,
+    DeviceJoinEngine,
+    engine_ineligibility,
+    extract_partition_keys,
+    pipeline_ineligibility,
+)
+
+
+def attach_join_engine(rt, on_expr) -> None:
+    """Classify ``rt`` and attach the device engine when eligible.
+    Called by the planner right after the runtime is built; respects the
+    ``siddhi_tpu.join_engine`` opt-out (``legacy`` keeps the synchronous
+    reference path wholesale, including pipeline ineligibility — the
+    bit-identity baseline ``tools/quick_join_check.py`` compares
+    against)."""
+    rt.engine = None
+    rt.engine_reason = engine_ineligibility(rt)
+    rt.pipeline_reason = pipeline_ineligibility(rt)
+    mode = str(getattr(rt.app_context, "join_engine", "device") or "device")
+    if mode != "device":
+        rt.engine_reason = rt.engine_reason or \
+            "disabled (siddhi_tpu.join_engine=legacy)"
+        rt.pipeline_reason = "siddhi_tpu.join_engine=legacy"
+        return
+    if rt.engine_reason is not None:
+        return
+    pspec = extract_partition_keys(
+        on_expr, rt.sides["left"], rt.sides["right"], rt.dictionary) \
+        if on_expr is not None else None
+    rt.engine = DeviceJoinEngine(rt, pspec)
+    _register_metrics(rt)
+
+
+def _register_metrics(rt) -> None:
+    tel = getattr(rt.app_context, "telemetry", None)
+    if tel is None:
+        return
+    # pre-declare the per-query probe/insert histograms so the
+    # siddhi_join_probe_ms / siddhi_join_insert_ms families exist on
+    # /metrics from app start (export.py renders them as summaries)
+    tel.histogram(f"join.probe_ms.{rt.name}")
+    tel.histogram(f"join.insert_ms.{rt.name}")
+    eng = rt.engine
+    for side_key, plan in eng.plans.items():
+        if not plan.use_pidx:
+            continue
+        for p in range(eng.P):
+            tel.gauge(
+                f"join.partition_rows.{rt.name}.{side_key}.{p}",
+                lambda e=eng, s=side_key, i=p: float(
+                    e.partition_occupancy(s)[i]))

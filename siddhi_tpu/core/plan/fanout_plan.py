@@ -40,8 +40,15 @@ from siddhi_tpu.query_api.expressions import Variable
 
 def fusion_ineligibility(q) -> Optional[str]:
     """Why ``q`` cannot join a fused fan-out group (None = eligible)."""
+    from siddhi_tpu.core.query.join_runtime import JoinSideProxy
     from siddhi_tpu.core.query.runtime import QueryRuntime
 
+    if isinstance(q, JoinSideProxy):
+        # a device-engine join side is a pure (state, cols, now) member
+        # like any other: its insert+probe folds into the junction's one
+        # fused step (the proxy implements the member protocol and owns
+        # its own eligibility rules)
+        return q.fusion_ineligibility()
     if type(q) is not QueryRuntime:
         return f"not a plain single-stream runtime ({type(q).__name__})"
     if q.partition_ctx is not None:

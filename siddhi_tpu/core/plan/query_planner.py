@@ -593,6 +593,13 @@ def plan_join_query(
         group_keyer=group_keyer,
     )
     rt.index_probe = index_probe
+    # classify + attach the device join engine (core/join/): eligible
+    # stream-stream window joins get the PanJoin-style partitioned probe
+    # engine (pipeline/fusion-eligible); everything else keeps the legacy
+    # probe path with the reason recorded on the runtime
+    from siddhi_tpu.core.join import attach_join_engine
+
+    attach_join_engine(rt, join.on_compare)
     return rt
 
 

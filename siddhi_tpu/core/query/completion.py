@@ -74,10 +74,11 @@ log = logging.getLogger(__name__)
 
 
 class QueryCompletion:
-    """One in-flight batch of a (single-stream / NFA) query runtime."""
+    """One in-flight batch of a (single-stream / NFA / join) query
+    runtime."""
 
     __slots__ = ("owner", "out", "overflow_msg", "junction", "batch",
-                 "t0", "wall", "tid")
+                 "timer_cb", "t0", "wall", "tid")
 
     def __init__(self, owner, out, overflow_msg: str, junction=None,
                  batch=None):
@@ -89,6 +90,10 @@ class QueryCompletion:
         # fault stream (@OnError action='stream') — drain-time errors
         # must publish the failing events there, like the sync path
         self.batch = batch
+        # per-SIDE notify attribution: a join batch's __notify__ must
+        # re-arm the dispatching side's own timer callback, snapshotted
+        # here at submit (the runtime's _cur_timer_cb is per-batch state)
+        self.timer_cb = getattr(owner, "_cur_timer_cb", None)
         self.t0 = time.perf_counter()
         self.wall = time.monotonic()      # wedge detection (supervisor)
         self.tid = threading.get_ident()  # submitting thread (scoped flush)
@@ -123,14 +128,19 @@ class QueryCompletion:
             if overflow > 0:
                 # the overflowed batch's rows are clamped garbage —
                 # matching the synchronous path, it does not emit (the
-                # rest of the drain round still does: drain-then-raise)
+                # rest of the drain round still does: drain-then-raise).
+                # Joins pass a CALLABLE decoding the overflow bitmask to
+                # the exact knob (overflow_knob_msg convention).
+                msg = (self.overflow_msg(overflow)
+                       if callable(self.overflow_msg) else self.overflow_msg)
                 return FatalQueryError(
-                    f"query '{q.name}': {self.overflow_msg} before "
+                    f"query '{q.name}': {msg} before "
                     f"creating the runtime")
             q._emit(HostBatch(self.out, size=size))
             if notify >= 0 and q.scheduler is not None:
                 q.scheduler.notify_at(
-                    notify, getattr(q, "_timer_cb", q.process_timer))
+                    notify, self.timer_cb
+                    or getattr(q, "_timer_cb", q.process_timer))
             return None
         finally:
             if self.junction is not None:

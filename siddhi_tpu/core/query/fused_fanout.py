@@ -319,6 +319,12 @@ class FusedFanoutRuntime(Receiver):
                 m._ensure_capacity()
             if m._state is None:
                 m._state = m._init_state()
+            prep = getattr(m, "prepare_cols", None)
+            if prep is not None and prep(cols):
+                # a join side grew its partition directory: the member's
+                # state shapes changed under the same (slots, capacities)
+                # signature — drop the fused step so it re-jits
+                self._step = None
         cols_dev = dict(cols)   # jit boundary: raw (possibly device) arrays
         for s, gk in enumerate(gk_cols):
             cols_dev[_FGK.format(s)] = gk
@@ -399,7 +405,10 @@ class FusedFanoutRuntime(Receiver):
                 mcols = dict(base)
                 mcols[GK_KEY] = cols[gk_names[cluster_slots[ci]]]
                 st, out = fn(states[ci], mcols, now)
-                metas.append(out.pop("__meta__"))
+                # [:3] strips per-member meta suffixes (a join side's
+                # cross-stream sequence number) so the [n, 3] stack stays
+                # rectangular; plain members' [3] metas pass unchanged
+                metas.append(out.pop("__meta__")[:3])
                 new_states.append(st)
                 outs.append(out)
             return tuple(new_states), (tuple(outs), jnp.stack(metas))
@@ -477,7 +486,7 @@ class FusedFanoutRuntime(Receiver):
             try:
                 if overflow > 0:
                     raise FatalQueryError(
-                        f"query '{m.name}': {m.overflow_knob_msg()} "
+                        f"query '{m.name}': {m.overflow_knob_msg(overflow)} "
                         f"before creating the runtime")
                 if t0sm is not None:   # pipelined path recorded at dispatch
                     record_elapsed_ms(sm, m.name, t0sm)

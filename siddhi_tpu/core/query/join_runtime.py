@@ -34,6 +34,7 @@ from siddhi_tpu.core.plan.selector_plan import FLUSH_KEY, GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
 from siddhi_tpu.ops.expressions import (
+    OKEY_KEY,
     PK_KEY,
     TS_KEY,
     TYPE_KEY,
@@ -211,9 +212,136 @@ class JoinResolver(Resolver):
 
 
 class JoinSideProxy(Receiver):
+    """Per-side receiver of a join runtime. Beyond plain delivery it
+    implements the fused fan-out MEMBER protocol
+    (``core/query/fused_fanout.py``): an engine-attached join side can
+    fuse with sibling single-stream queries on a shared junction — the
+    side's insert+probe folds into the junction's ONE jitted step and its
+    meta rides the group's combined pull (the engine's in-state probe
+    surfaces are what make the side step a pure ``(state, cols, now)``
+    function like any other member's)."""
+
+    _fanout_group = None
+    _own_keyer = None
+
     def __init__(self, runtime: "JoinQueryRuntime", side_key: str):
         self.runtime = runtime
         self.side_key = side_key
+
+    # ------------------------------------------------ fused member protocol
+
+    def fusion_ineligibility(self) -> Optional[str]:
+        """Why this join side cannot join a fused fan-out group (None =
+        eligible) — consulted by ``fanout_plan.fusion_ineligibility``."""
+        rt = self.runtime
+        if rt.engine is None:
+            return f"join side without device engine ({rt.engine_reason})"
+        if rt.keyer is not None:
+            return "grouped join selector (split host-keyed pipeline)"
+        if rt._shard_mesh is not None or rt._route_layout is not None:
+            return "mesh-sharded join"
+        for side in rt.sides.values():
+            st = side.window_stage
+            if st is not None and getattr(st, "needs_scheduler", False):
+                return "scheduler-driven join window"
+        if rt.sides["left"].stream_id == rt.sides["right"].stream_id:
+            # both proxies would fuse onto ONE junction sharing one state
+            # pytree — the fused step would donate it twice per dispatch
+            return "self-join (both sides share the junction batch)"
+        return None
+
+    @property
+    def name(self) -> str:
+        return f"{self.runtime.name}.{self.side_key}"
+
+    @property
+    def app_context(self):
+        return self.runtime.app_context
+
+    @property
+    def input_definition(self):
+        return self.runtime.sides[self.side_key].pack_definition
+
+    @property
+    def dictionary(self):
+        return self.runtime.dictionary
+
+    @property
+    def selector_plan(self):
+        return self.runtime.selector_plan
+
+    @property
+    def keyer(self):
+        return self.runtime.keyer
+
+    @keyer.setter
+    def keyer(self, value):
+        self.runtime.keyer = value
+
+    @property
+    def _win_keys(self):
+        return self.runtime._win_keys
+
+    @property
+    def _lock(self):
+        return self.runtime._lock
+
+    @property
+    def _state(self):
+        return self.runtime._state
+
+    @_state.setter
+    def _state(self, value):
+        self.runtime._state = value
+
+    @property
+    def scheduler(self):
+        return self.runtime.scheduler
+
+    def process_timer(self, ts: int):
+        # per-side notify attribution: a fused side's wake time re-enters
+        # through ITS OWN timer callback (defensive — eligible sides carry
+        # no scheduler-driven window)
+        self.runtime._timer(self.side_key, ts)
+
+    def _ensure_capacity(self):
+        self.runtime._ensure_capacity()
+
+    def _init_state(self):
+        return self.runtime._init_state()
+
+    def prepare_cols(self, cols) -> bool:
+        """Fused-group pre-dispatch hook: adaptive sub-window growth for
+        this side's batch (mirrors ``process_side_batch``'s call). True =
+        state shapes changed, the group must re-jit its fused step."""
+        eng = self.runtime.engine
+        if eng is None:
+            return False
+        if self.runtime._state is None:
+            self.runtime._state = self.runtime._init_state()
+        return eng.prepare_batch(self.side_key, cols)
+
+    def overflow_knob_msg(self, code: Optional[int] = None):
+        # forward the overflow bitmask: the fused drain must name the
+        # partition/selector knob, not default to window capacity
+        return self.runtime.overflow_knob_msg(code)
+
+    def _emit(self, out: HostBatch):
+        self.runtime._emit(out)
+
+    def build_step_fn(self):
+        """The side's fused-member step: the engine's probe surfaces live
+        inside the state, so the probe placeholders of the side-step
+        signature are inert."""
+        step = self.runtime.build_side_step_fn(self.side_key)
+        placeholder = jnp.zeros((1,), bool)
+
+        def fn(state, cols, now):
+            return step(state, {}, placeholder, cols, now)
+
+        return fn
+
+    # ---------------------------------------------------------- delivery
 
     def receive(self, events: List[Event]):
         side = self.runtime.sides[self.side_key]
@@ -277,6 +405,14 @@ class JoinQueryRuntime(QueryRuntime):
         # compare): {"store_side", "attr", "val_fn", "residual_fn"}
         self.index_probe = None
         self._steps: Dict[str, object] = {}
+        # device join engine (core/join/): attached by the planner for
+        # eligible stream-stream shapes; None keeps the legacy probe path
+        self.engine = None
+        self.engine_reason: Optional[str] = "engine not attached"
+        self.pipeline_reason: Optional[str] = "engine not attached"
+        self._in_timer = False       # timer sweeps run synchronously
+        self._drain_seq = None       # last cross-stream seq seen at drain
+        self._cur_timer_cb = None    # per-side notify attribution (pump)
         # stable per-side timer callbacks so the scheduler's
         # (id(target), ts) dedup holds across batches
         self._timer_cbs = {
@@ -285,12 +421,15 @@ class JoinQueryRuntime(QueryRuntime):
 
     def make_proxies(self) -> Dict[str, JoinSideProxy]:
         # store sides produce no events — no proxy; named-window sides get
-        # one (subscribed to the window's emission junction)
-        return {
+        # one (subscribed to the window's emission junction). The proxies
+        # are retained: fan-out fusion subscribes THEM as group members
+        # (fanout_plan), and the seq check consults their group state.
+        self._proxies = {
             k: JoinSideProxy(self, k)
             for k in ("left", "right")
             if self.sides[k].window_stage is not None
         }
+        return self._proxies
 
     def _init_state(self) -> dict:
         state = {"sel": self.selector_plan.init_state()}
@@ -300,7 +439,62 @@ class JoinQueryRuntime(QueryRuntime):
             if side.window_stage is not None and side.host_window is None:
                 state[wk] = (side.window_stage.init_state(self._win_keys)
                              if partitioned else side.window_stage.init_state())
+        if self.engine is not None:
+            state.update(self.engine.init_pidx_state())
         return state
+
+    def strip_engine_state(self, state):
+        """Snapshot canonicalization: the partition directories and the
+        cross-stream sequence are derived state — captures store only the
+        legacy ``[W]`` ring layout, so revisions cross-restore between
+        the device engine and the legacy path bit-identically (and across
+        ``siddhi_tpu.join_partitions`` values)."""
+        if state is None or self.engine is None:
+            return state
+        from siddhi_tpu.core.join import ENGINE_STATE_KEYS
+
+        return {k: v for k, v in state.items()
+                if k not in ENGINE_STATE_KEYS}
+
+    def adopt_restored_state(self):
+        """Snapshot-restore hook: the restored state is canonical (no
+        partition directories) — rebuild them from the rings and reset
+        the drain-sequence expectation."""
+        self._drain_seq = None
+        if self.engine is None or self._state is None:
+            return
+        from siddhi_tpu.core.join import SEQ_KEY
+
+        state = dict(self._state)
+        if SEQ_KEY not in state:
+            import jax.numpy as _jnp
+
+            state[SEQ_KEY] = _jnp.int64(0)
+        self._state = state
+        self.engine.rebuild_probe_state()
+
+    def _seq_check(self, seq: int) -> None:
+        """Drain-side verification of the engine's explicit cross-stream
+        sequence: the pump's per-owner FIFO must hand batches back in
+        dispatch order — a gap means an ordering bug, which must be loud
+        (the outputs would silently interleave wrong). Skipped when a
+        side rides a fused fan-out group: its seqs drain through the
+        GROUP's entries, so this runtime's own FIFO legitimately sees
+        gaps (cross-owner order was never promised)."""
+        if any(getattr(p, "_fanout_group", None) is not None
+               for p in getattr(self, "_proxies", {}).values()):
+            self._drain_seq = None
+            return
+        exp = self._drain_seq
+        self._drain_seq = seq
+        if exp is not None and seq != exp + 1:
+            _LOG.error(
+                "query '%s': join drain sequence break (expected %d, "
+                "got %d) — cross-stream emission order violated",
+                self.name, exp + 1, seq)
+            tel = getattr(self.app_context, "telemetry", None)
+            if tel is not None:
+                tel.count("join.seq_breaks")
 
     def _ensure_capacity(self):
         before = (self.selector_plan.num_keys, self._win_keys)
@@ -308,7 +502,51 @@ class JoinQueryRuntime(QueryRuntime):
         if (self.selector_plan.num_keys, self._win_keys) != before:
             self._steps.clear()
 
+    def overflow_knob_msg(self, code: Optional[int] = None) -> str:
+        """Join overflow naming the exact knob per the
+        ``QueryRuntime.overflow_knob_msg`` convention. ``code`` is the
+        step's overflow bitmask: 1 = window ring capacity, 2 = indexed
+        probe candidate window, 4 = partition sub-window, 8 = selector
+        value table (distinctCount)."""
+        if code is None:
+            code = 1
+        code = int(code)
+        parts = []
+        if code & 1:
+            knob = ("app_context.partition_window_capacity"
+                    if self.partition_ctx is not None
+                    else "app_context.window_capacity")
+            parts.append(f"join window capacity exceeded — raise {knob}")
+        if code & 2:
+            parts.append("indexed join probe candidate window saturated — "
+                         "raise app_context.index_probe_width")
+        if code & 4:
+            parts.append("join partition sub-window overflow — raise "
+                         "siddhi_tpu.join_partition_slack (or lower "
+                         "siddhi_tpu.join_partitions)")
+        if code & 8:
+            parts.append("join selector aggregation overflow — raise "
+                         "app_context.distinct_values_capacity")
+        if not parts:
+            parts.append("join window capacity exceeded — raise "
+                         "app_context.window_capacity")
+        return "; ".join(parts)
+
+    def _routed_meta_check(self, meta) -> None:
+        """Meta-suffix hook shared by the sync tail and the pump drain:
+        engine steps append the cross-stream sequence number behind the
+        standard ``[ov, notify, count]`` prefix (verified here); routed
+        (mesh-sharded) joins carry the route-overflow/rows suffix instead
+        and defer to the base check."""
+        if self.engine is not None:
+            if len(meta) > 3:
+                self._seq_check(int(meta[3]))
+            return
+        super()._routed_meta_check(meta)
+
     def build_side_step_fn(self, side_key: str):
+        if self.engine is not None:
+            return self.engine.build_side_step(side_key)
         side = self.sides[side_key]
         other = self.sides["right" if side_key == "left" else "left"]
         win_key = "lwin" if side_key == "left" else "rwin"
@@ -355,6 +593,11 @@ class JoinQueryRuntime(QueryRuntime):
             notify = wout.pop("__notify__", None)
             overflow = wout.pop("__overflow__", None)
             wout.pop("__flush__", None)
+            # device-routed dispatch: the keyed window emits a global
+            # emission-order key per trigger row (RIDX-derived); the join
+            # carries it to the joined rows below for the cross-shard
+            # ordered re-merge
+            okey_w = wout.pop(OKEY_KEY, None)
             # post-window filters mask emitted rows (probe/trigger side
             # only — the window's retained contents are unaffected)
             pvalid = wout[VALID_KEY]
@@ -492,12 +735,27 @@ class JoinQueryRuntime(QueryRuntime):
             # the selector's batch collapse keys on (trigger row, group)
             joined[FLUSH_KEY] = jnp.repeat(
                 jnp.arange(N, dtype=jnp.int32), W + 1)
+            if okey_w is not None:
+                # joined emission-order key: trigger okey stridden by the
+                # probe width reproduces the legacy [N, W+1] row-major
+                # order ACROSS shards (one-sided rows at column W); the
+                # invalid-row _BIG sentinel is zeroed before the multiply
+                # (the route wrapper re-masks invalid rows itself)
+                okw = jnp.asarray(okey_w, jnp.int64)
+                okw = jnp.where(okw >= jnp.int64(2 ** 61), jnp.int64(0), okw)
+                joined[OKEY_KEY] = (
+                    okw[:, None] * jnp.int64(W + 1)
+                    + jnp.arange(W + 1, dtype=jnp.int64)[None, :]
+                ).reshape(NW)
 
             if idx_overflow is not None:
                 # candidate window saturated: surfacing it beats silently
-                # dropping matches (raise app_context.index_probe_width)
-                overflow = idx_overflow if overflow is None else jnp.maximum(
-                    jnp.asarray(overflow).astype(jnp.int32), idx_overflow)
+                # dropping matches. Bit 2 of the overflow mask — the host
+                # decodes it to app_context.index_probe_width, distinct
+                # from the window-capacity knob (overflow_knob_msg)
+                base = (jnp.int32(0) if overflow is None else jnp.where(
+                    jnp.asarray(overflow).astype(jnp.int32) > 0, 1, 0))
+                overflow = base | (idx_overflow * 2)
 
             if strrank is not None:   # string order-by: rank table -> selector
                 joined[STR_RANK] = strrank
@@ -525,9 +783,23 @@ class JoinQueryRuntime(QueryRuntime):
         return self.build_side_step_fn(key)
 
     def process_side_batch(self, side_key: str, batch: HostBatch):
+        import time as _time
+
+        from siddhi_tpu.core.stream.junction import \
+            current_delivering_junction
         from siddhi_tpu.observability.tracing import span
 
+        t_host0 = _time.perf_counter()
         with span("query.step", query=self.name, side=side_key), self._lock:
+            # pipelined completions need the delivering junction (error
+            # attribution + latency feedback) and the SIDE's own timer
+            # callback (per-side notify attribution at drain)
+            j = current_delivering_junction()
+            self._cur_junction = j
+            self._cur_fault_batch = batch if (
+                j is not None and j.on_error_action == "STREAM"
+                and j.fault_junction is not None) else None
+            self._cur_timer_cb = self._timer_cbs[side_key]
             side = self.sides[side_key]
             cols = batch.cols
             partitioned = self.partition_ctx is not None
@@ -578,19 +850,39 @@ class JoinQueryRuntime(QueryRuntime):
             cols[GK_KEY] = np.zeros(batch.capacity, np.int32)
             if self._state is None:
                 self._state = self._init_state()
+            if self.engine is not None:
+                # adaptive sub-window capacity: mirror this batch's ring
+                # occupancy and grow the partition directory BEFORE the
+                # step could overflow it (clears _steps when it grows)
+                self.engine.prepare_batch(side_key, cols)
+            routed = self._route_layout is not None
             jitted = self._steps.get(side_key)
             if jitted is None:
-                jitted = self.app_context.telemetry.instrument_jit(
-                    jax.jit(self.build_side_step_fn(side_key),
-                            donate_argnums=0),
-                    f"query.{self.name}.join.{side_key}")
+                if routed:
+                    # mesh-sharded partitioned join: the side step runs
+                    # inside the device-router's shard_map (exchange by
+                    # pk, partition-local probe, okey re-merge)
+                    from siddhi_tpu.parallel.mesh import routed_step_for
+
+                    jitted = routed_step_for(self, side_key=side_key)
+                else:
+                    jitted = self.app_context.telemetry.instrument_jit(
+                        jax.jit(self.build_side_step_fn(side_key),
+                                donate_argnums=0),
+                        f"query.{self.name}.join.{side_key}")
                 self._steps[side_key] = jitted
             else:
                 self.app_context.telemetry.record_jit(
-                    f"query.{self.name}.join.{side_key}", hit=True)
+                    getattr(jitted, "_key",
+                            f"query.{self.name}.join.{side_key}"), hit=True)
             other = self.sides["right" if side_key == "left" else "left"]
-            _ovf_msg = ("join window capacity exceeded — raise "
-                        "app_context.window_capacity")
+            # callable: the step's overflow bitmask decodes to the exact
+            # knob (window / index-probe / partition sub-window / selector)
+            _ovf_msg = self.overflow_knob_msg
+            tel = self.app_context.telemetry
+            tel.histogram(f"join.insert_ms.{self.name}").record(
+                (_time.perf_counter() - t_host0) * 1000.0)
+            t_probe0 = _time.perf_counter()
             if (other.store is not None
                     and getattr(other.store, "dynamic", None) is not None):
                 # per-event within/per: group trigger rows by their resolved
@@ -644,10 +936,28 @@ class JoinQueryRuntime(QueryRuntime):
 
                 notify = None
                 if probe_ok:
-                    def call(st, cols, now):
-                        return jitted(st, probe_cols, probe_valid, cols, now)
+                    if routed:
+                        # pad/precheck host-side, splitting oversized
+                        # batches, then run each piece through the routed
+                        # side step in order (mirrors process_batch)
+                        from siddhi_tpu.parallel.mesh import \
+                            prepare_routed_batches
 
-                    notify = self._finish_device_batch(call, cols, _ovf_msg)
+                        for piece in prepare_routed_batches(self, cols):
+                            nt = self._finish_device_batch(
+                                jitted, piece, _ovf_msg)
+                            if nt is not None:
+                                notify = (nt if notify is None
+                                          else min(notify, nt))
+                    else:
+                        def call(st, cols, now):
+                            return jitted(st, probe_cols, probe_valid,
+                                          cols, now)
+
+                        notify = self._finish_device_batch(
+                            call, cols, _ovf_msg)
+            tel.histogram(f"join.probe_ms.{self.name}").record(
+                (_time.perf_counter() - t_probe0) * 1000.0)
         if notify_host is not None:
             notify = notify_host if notify is None else min(notify, notify_host)
         if notify is not None and self.scheduler is not None:
@@ -661,15 +971,17 @@ class JoinQueryRuntime(QueryRuntime):
 
     @property
     def _pipeline_ok(self) -> bool:
-        # joins stay SYNCHRONOUS even under the CompletionPump: the two
-        # sides' state updates are order-coupled (a left batch's probe
-        # must observe the right window exactly as of dispatch), the
-        # packed __notify__ is per SIDE (the pump's drain could not
-        # attribute the wake time to the right per-side timer callback),
-        # and left/right batches interleave through ONE runtime lock —
-        # pipelining one side while the other dispatches would reorder
-        # probe-vs-insert against the reference semantics.
-        return False
+        # Eligible joins ride the CompletionPump (core/join/ decides —
+        # ``pipeline_reason`` is None when both probe surfaces live
+        # inside the jitted state): probe-vs-insert coupling is resolved
+        # at DISPATCH (state updates happen synchronously under the
+        # runtime lock; only the meta pull + emission ride), both sides
+        # share one owner FIFO so cross-stream emission order equals
+        # dispatch order (the engine's explicit sequence number verifies
+        # it at drain), and the per-side __notify__ is attributed to the
+        # side's own timer callback captured on the entry. Timer sweeps
+        # stay synchronous (flush-then-run, like process_timer).
+        return self.pipeline_reason is None and not self._in_timer
 
     def _finish_device_batch(self, step, cols, overflow_msg):
         if self.keyer is None:
@@ -689,13 +1001,17 @@ class JoinQueryRuntime(QueryRuntime):
         if meta is not None:
             meta = np.asarray(meta)
             overflow, notify = int(meta[0]), int(meta[1])
+            if self.engine is not None and len(meta) > 3:
+                self._seq_check(int(meta[3]))
         else:
             ovf = out_host.pop("__overflow__", None)
             overflow = int(ovf) if ovf is not None else 0
             nt = out_host.pop("__notify__", None)
             notify = int(nt) if nt is not None else -1
         if overflow > 0:
-            raise FatalQueryError(f"query '{self.name}': {overflow_msg}")
+            msg = (overflow_msg(overflow) if callable(overflow_msg)
+                   else overflow_msg)
+            raise FatalQueryError(f"query '{self.name}': {msg}")
         record_elapsed_ms(sm, self.name, t0)
         out_host = self._host_keyed_select(out_host)
         self._emit(HostBatch(out_host))
@@ -715,7 +1031,19 @@ class JoinQueryRuntime(QueryRuntime):
             self.dictionary,
         )
         batch.cols[TYPE_KEY][...] = TIMER_TYPE
-        self.process_side_batch(side_key, batch)
+        # timer sweeps run synchronously over a drained timeline, exactly
+        # like process_timer: in-flight pipelined batches were dispatched
+        # BEFORE this timer fired, and the sweep's own notify must re-arm
+        # promptly (no producer will drain it later)
+        with self._lock:
+            pump = getattr(self.app_context, "completion_pump", None)
+            if pump is not None and pump.has_pending:
+                pump.flush_owner(self)
+            self._in_timer = True
+            try:
+                self.process_side_batch(side_key, batch)
+            finally:
+                self._in_timer = False
 
     def receive(self, events: List[Event]):  # pragma: no cover — proxies only
         raise RuntimeError("join queries receive through per-side proxies")

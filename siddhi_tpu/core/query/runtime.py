@@ -653,10 +653,13 @@ class QueryRuntime(Receiver):
         if notify is not None and self.scheduler is not None:
             self.scheduler.notify_at(notify, self.process_timer)
 
-    def overflow_knob_msg(self) -> str:
+    def overflow_knob_msg(self, code: Optional[int] = None) -> str:
         """Capacity-overflow message naming THIS query's knob — shared by
         the unfused path and the fused fan-out group
-        (``core/query/fused_fanout.py``) so attribution cannot drift."""
+        (``core/query/fused_fanout.py``) so attribution cannot drift.
+        ``code`` is the step's overflow value; join runtimes decode it
+        as a bitmask into the exact knob (single-stream steps carry a
+        single overflow cause, so it is ignored here)."""
         knob = (
             "app_context.partition_window_capacity"
             if self.partition_ctx is not None
@@ -783,8 +786,12 @@ class QueryRuntime(Receiver):
             notify = int(meta[1])
             size_hint = int(meta[2])
             if overflow > 0:
+                # joins pass a CALLABLE that decodes the step's overflow
+                # bitmask into the exact knob (overflow_knob_msg)
+                msg = (overflow_msg(overflow) if callable(overflow_msg)
+                       else overflow_msg)
                 raise FatalQueryError(
-                    f"query '{self.name}': {overflow_msg} before creating the runtime")
+                    f"query '{self.name}': {msg} before creating the runtime")
             record_elapsed_ms(sm, self.name, t0)
             self._emit(HostBatch(out_host, size=size_hint))
             if notify >= 0:
@@ -792,8 +799,10 @@ class QueryRuntime(Receiver):
             return None
         overflow = out_host.pop("__overflow__", None)
         if overflow is not None and int(overflow) > 0:
+            msg = (overflow_msg(int(overflow)) if callable(overflow_msg)
+                   else overflow_msg)
             raise FatalQueryError(
-                f"query '{self.name}': {overflow_msg} before creating the runtime"
+                f"query '{self.name}': {msg} before creating the runtime"
             )
         notify = out_host.pop("__notify__", None)
         record_elapsed_ms(sm, self.name, t0)

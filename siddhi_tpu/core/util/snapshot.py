@@ -119,6 +119,14 @@ class SnapshotService:
                     state = q._state
                     sel_keys = q.selector_plan.num_keys
                     win_keys = q._win_keys
+                strip = getattr(q, "strip_engine_state", None)
+                if strip is not None and state is not None:
+                    # join engine (core/join/): the partition directories
+                    # and cross-stream sequence are derived state — the
+                    # snapshot stores the canonical [W] ring layout only,
+                    # so revisions cross-restore engine<->legacy and
+                    # across join_partitions values
+                    state = strip(state)
                 queries[name] = {
                     "state": _to_host(state) if state is not None else None,
                     "sel_keys": sel_keys,
@@ -317,6 +325,12 @@ class SnapshotService:
                 q._step = None
                 if hasattr(q, "_steps"):
                     q._steps.clear()
+                adopt = getattr(q, "adopt_restored_state", None)
+                if adopt is not None:
+                    # join engine: rebuild the partition directories from
+                    # the restored canonical rings (and reset the drain-
+                    # sequence expectation)
+                    adopt()
 
         # fused fan-out groups: re-derive keyer sharing from the restored
         # maps and drop the compiled fused step (key capacities changed)

@@ -91,6 +91,11 @@ _SERVING_QUERY_HIST = re.compile(r"^serving\.query\.(?P<dur>[a-z]+)_ms$")
 # (scope = query name)
 _SHARD_ROWS = re.compile(r"^shard\.rows\.(?P<scope>.+)\.(?P<shard>\d+)$")
 _SHARD_EXCHANGE_HIST = re.compile(r"^shard\.exchange_ms\.(?P<scope>.+)$")
+# device join engine (core/join/): per-partition build-side occupancy
+# gauges + probe/insert host-latency histograms per join query
+_JOIN_PART_ROWS = re.compile(r"^join\.partition_rows\.(?P<query>.+)"
+                             r"\.(?P<side>left|right)\.(?P<part>\d+)$")
+_JOIN_HIST = re.compile(r"^join\.(?P<kind>probe|insert)_ms\.(?P<query>.+)$")
 _SERVING_COUNTER_FAMILY = {
     "serving.queries": ("siddhi_serving_queries_total",
                         "on-demand queries admitted by the serving tier"),
@@ -230,6 +235,14 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                              "batch; skew shows as imbalance)",
                              {**base, "query": m.group("scope"),
                               "shard": m.group("shard")}, v)
+                elif _JOIN_PART_ROWS.match(name):
+                    m = _JOIN_PART_ROWS.match(name)
+                    fams.add("siddhi_join_partition_rows", "gauge",
+                             "live build-side rows per join hash "
+                             "partition (skew shows as imbalance)",
+                             {**base, "query": m.group("query"),
+                              "side": m.group("side"),
+                              "partition": m.group("part")}, v)
                 elif _QUOTA_GAUGE.match(name):
                     m = _QUOTA_GAUGE.match(name)
                     labels = {**base, "resource": m.group("resource")}
@@ -307,6 +320,15 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                          "the sharded keyed step (ms; device-routed path "
                          "pays only pad+precheck here)")
                 labels["query"] = m.group("scope")
+            elif _JOIN_HIST.match(name):
+                m = _JOIN_HIST.match(name)
+                family = f"siddhi_join_{m.group('kind')}_ms"
+                help_ = (
+                    "host prep+pack time per join side batch (ms)"
+                    if m.group("kind") == "insert"
+                    else "probe dispatch+finish time per join side "
+                         "batch (ms)")
+                labels["query"] = m.group("query")
             else:
                 m = _SERVING_QUERY_HIST.match(name)
                 if m:

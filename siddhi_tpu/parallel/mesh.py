@@ -85,7 +85,10 @@ def _key_axis_of(path, leaf, num_keys: int, win_keys: int) -> int:
         for i, s in enumerate(leaf.shape):
             if s == num_keys:
                 return i
-    if top == "win" and win_keys > 1 and leaf.shape[0] % win_keys == 0:
+    if (top in ("win", "lwin", "rwin") and win_keys > 1
+            and leaf.shape[0] % win_keys == 0):
+        # "lwin"/"rwin": a partitioned join's per-side keyed rings share
+        # the single-stream keyed-window layout (key-contiguous flat)
         return 0
     if top == "nfa" and win_keys > 1 and leaf.shape[0] == win_keys:
         return 0
@@ -547,7 +550,7 @@ def route_ineligibility(runtime) -> Optional[str]:
     from siddhi_tpu.ops.keyed_windows import KeyedLengthWindowStage
 
     if getattr(runtime, "sides", None) is not None:
-        return "join queries"
+        return _join_route_ineligibility(runtime)
     if hasattr(runtime, "_steps"):
         return "pattern/sequence (NFA) queries"
     if runtime.host_window is not None:
@@ -565,6 +568,37 @@ def route_ineligibility(runtime) -> Optional[str]:
         return "unkeyed queries (nothing to route by)"
     if runtime.carried_pk:
         return "inner partition '#stream' inputs"
+    return None
+
+
+def _join_route_ineligibility(runtime) -> Optional[str]:
+    """Why a JOIN runtime cannot take the device-routed path (None = it
+    can). v1 scope: partitioned keyed-length-window stream-stream joins —
+    both sides' keyed rings route by the partition key through the same
+    exchange, probes stay partition-local by construction (a key's whole
+    ring lives on its owner shard), and the join step's emission-order
+    keys (trigger okey stridden by the probe width) re-merge exactly."""
+    from siddhi_tpu.ops.keyed_windows import KeyedLengthWindowStage
+
+    if runtime.partition_ctx is None:
+        return "non-partitioned joins (nothing to route by)"
+    if runtime.keyer is not None:
+        return "grouped join selectors (host keyed select between stages)"
+    sp = runtime.selector_plan
+    if sp.order_by or sp.limit is not None or sp.offset is not None:
+        return "join order by / limit (batch-global ordering)"
+    if runtime.index_probe is not None:
+        return "indexed join probes"
+    for side in runtime.sides.values():
+        if side.store is not None or side.host_window is not None:
+            return (f"shared-store/host-window join side "
+                    f"'{side.stream_id}'")
+        if side.global_side:
+            return "global (non-partitioned) join sides"
+        if not isinstance(side.window_stage, KeyedLengthWindowStage):
+            return (f"join window stage "
+                    f"{type(side.window_stage).__name__} (emission-order "
+                    f"keys not global-aware yet)")
     return None
 
 
@@ -670,7 +704,14 @@ def _install_routed(runtime, layout: RouteLayout, canonical, Kg: int, Wg: int):
     else:
         state = jax.device_put(state)
     runtime._state = state
-    runtime._step = routed_step_for(runtime)
+    if getattr(runtime, "sides", None) is not None:
+        # joins jit one routed step PER SIDE, lazily — the side steps are
+        # rebuilt on demand by process_side_batch (routed_step_for with
+        # side_key); a stale _steps cache would run the old capacities
+        runtime._step = None
+        runtime._steps.clear()
+    else:
+        runtime._step = routed_step_for(runtime)
 
 
 def _pow2_div(total: int, n: int) -> int:
@@ -708,7 +749,7 @@ def _buffered_id_col(path) -> Optional[str]:
 
     top = path[0].key if path and hasattr(path[0], "key") else None
     tail = path[-1].key if path and hasattr(path[-1], "key") else None
-    if top == "win" and tail in (GK_KEY, PK_KEY):
+    if top in ("win", "lwin", "rwin") and tail in (GK_KEY, PK_KEY):
         return "gk" if tail == GK_KEY else "pk"
     return None
 
@@ -837,10 +878,14 @@ def _canonical_to_routed(runtime, layout: RouteLayout, canonical):
 
 # ----------------------------------------------------------- routed step
 
-def routed_step_for(runtime):
+def routed_step_for(runtime, side_key: Optional[str] = None):
     """Build (and return) the device-routed ``step3(state, cols, now)``
-    for a runtime whose ``_route_layout`` is installed. The heavy lifting
-    happens in one jitted ``shard_map``:
+    for a runtime whose ``_route_layout`` is installed. ``side_key``
+    selects one side of a JOIN runtime (the side's fused insert+probe
+    step routes like any keyed step: both sides' rings are sharded by the
+    partition key, so a routed row's probe surface — the other side's
+    ring rows of ITS OWN key — is already local to its owner shard).
+    The heavy lifting happens in one jitted ``shard_map``:
 
     ingress   rows enter B-sharded; each shard computes ``owner = key % n``
               for its slice, buckets rows per destination (per-pair quota
@@ -869,7 +914,16 @@ def routed_step_for(runtime):
     n, Q = layout.n, layout.quota
     localK = layout.localK
     partitioned, use_lut = layout.partitioned, layout.use_lut
-    step = runtime.build_step_fn()
+    if side_key is not None:
+        side_step = runtime.build_side_step_fn(side_key)
+        _ph = jnp.zeros((1,), bool)
+
+        def step(state, cols, now):
+            # probe placeholders are inert: both probe surfaces live
+            # inside the sharded state (keyed rings)
+            return side_step(state, {}, _ph, cols, now)
+    else:
+        step = runtime.build_step_fn()
     key_name = PK_KEY if partitioned else GK_KEY
 
     if n == 1:
@@ -887,7 +941,7 @@ def routed_step_for(runtime):
             return st, out
 
         jitted = jax.jit(one_dev, donate_argnums=(0,))
-        return _finish_routed_install(runtime, layout, jitted)
+        return _finish_routed_install(runtime, layout, jitted, side_key)
 
     axes = _routed_axes(runtime, layout, runtime._state)
     st_specs = jax.tree_util.tree_map(
@@ -992,11 +1046,13 @@ def routed_step_for(runtime):
         check_rep=False,
     )
     jitted = jax.jit(sharded, donate_argnums=(0,))
-    return _finish_routed_install(runtime, layout, jitted)
+    return _finish_routed_install(runtime, layout, jitted, side_key)
 
 
-def _finish_routed_install(runtime, layout: RouteLayout, jitted):
-    key = f"query.{runtime.name}.routed_step"
+def _finish_routed_install(runtime, layout: RouteLayout, jitted,
+                           side_key: Optional[str] = None):
+    key = f"query.{runtime.name}.routed_step" + (
+        f".{side_key}" if side_key else "")
     tel = getattr(runtime.app_context, "telemetry", None)
     if tel is not None:
         jitted = tel.instrument_jit(jitted, key)

@@ -136,6 +136,70 @@ define stream StockStream (symbol string, price float, volume long);
     }
     mf.shutdown()
 
+    # ---- device join engine (core/join/): an eligible stream-stream
+    # window join's fused insert+probe side step must lower to ONE HLO
+    # module with ZERO host transfers (both probe surfaces live inside
+    # the jitted state — that in-state layout is what makes joins
+    # pipeline/fusion-eligible)
+    _JOIN_APP = """
+define stream L (sym string, lv long);
+define stream R (sym string, rv long);
+@info(name='jq') from L#window.length(256) join R#window.length(256)
+  on L.sym == R.sym
+  select L.sym as sym, L.lv as lv, R.rv as rv insert into JOut;
+"""
+    import jax.numpy as jnp
+
+    from siddhi_tpu.core.plan.selector_plan import GK_KEY as _GK
+    from siddhi_tpu.ops.expressions import (
+        TS_KEY as _TS, TYPE_KEY as _TY, VALID_KEY as _VA)
+
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    mj = SiddhiManager()
+    # explicit P: the CPU-fallback auto default is P=1 (full-surface
+    # probe) — audit the PARTITIONED insert+gather step's lowering
+    mj.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.join_partitions": "8"}))
+    rtj = mj.create_siddhi_app_runtime(_JOIN_APP)
+    rtj.start()
+    qj = rtj.query_runtimes["jq"]
+    assert qj.engine is not None, (
+        f"join engine did not attach: {qj.engine_reason}")
+    assert qj._pipeline_ok, (
+        f"eligible join not pipeline-ok: {qj.pipeline_reason}")
+    qj._state = qj._init_state()
+    Bj = 512
+    jsym = rng.integers(0, 64, Bj, dtype=np.int64)
+    jcols = {
+        _TS: np.arange(Bj, dtype=np.int64),
+        _TY: np.zeros(Bj, np.int8),
+        _VA: np.ones(Bj, bool),
+        "sym": jsym.astype(np.int32), "sym?": np.zeros(Bj, bool),
+        "lv": rng.integers(0, 1000, Bj, dtype=np.int64),
+        "lv?": np.zeros(Bj, bool),
+        _GK: np.zeros(Bj, np.int32),
+    }
+    jstep = jax.jit(qj.build_side_step_fn("left"))
+    jlow = jstep.lower(qj._state, {}, jnp.zeros((1,), bool), jcols,
+                       np.int64(0))
+    hlo_j = jlow.compile().as_text()
+    n_modules = hlo_j.count("ENTRY")
+    assert n_modules == 1, (
+        f"device join side step compiled to {n_modules} HLO modules, "
+        f"want 1")
+    for marker in ("infeed", "outfeed", " send(", " recv(",
+                   "send-start", "recv-start"):
+        assert marker not in hlo_j, (
+            f"device join step contains a host transfer: {marker}")
+    report["device_join"] = {
+        "partitions": qj.engine.P,
+        "hlo_modules": n_modules,
+        "collectives": _count_collectives(hlo_j),
+        "host_transfers": 0,
+    }
+    mj.shutdown()
+
     # ---- round-5 strategy: host-routed batch, shard_map local state
     m2 = SiddhiManager()
     rt2 = m2.create_siddhi_app_runtime(_APP)

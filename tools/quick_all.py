@@ -24,6 +24,7 @@ CHECKS = {
     "route": ("quick_route_check.py", 300),
     "fanout": ("quick_fanout_check.py", 300),
     "pipeline": ("pipeline_check.py", 300),
+    "join": ("quick_join_check.py", 300),
     "agg": ("quick_agg_check.py", 300),
     "hlo": ("hlo_audit.py", 300),
 }
