@@ -66,6 +66,15 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+# SIDDHI_TPU_SANITIZE=1 arms the runtime sanitizers (transfer-guard
+# host-pull detection, post-warmup recompile watchdog, lock-order
+# assertions — siddhi_tpu/analysis/sanitize.py). Config-only: the
+# backend is NOT initialized here (that being the R1 bug class).
+from siddhi_tpu.analysis import sanitize as _sanitize
+
+if _sanitize.enabled():
+    _sanitize.enable()
+
 __version__ = "0.1.0"
 
 __all__ = [

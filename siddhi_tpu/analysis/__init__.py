@@ -1,0 +1,27 @@
+"""Static analysis + runtime sanitizers for the engine's own bug classes.
+
+- ``engine``/``rules_*`` — graftlint: an AST lint suite distilled from
+  the repo's regression history (R1 import-time backend init, R2 ad-hoc
+  config-knob reads, R3 metric-registration parity, R4 lock order, R5
+  host pulls in step code). Driver: ``tools/graftlint.py``.
+- ``lockorder`` — the declared lock partial order (shared by R4 and the
+  runtime shim).
+- ``locks`` — ``make_lock(rank)`` factory; plain RLock normally,
+  order-asserting ``CheckedRLock`` under ``SIDDHI_TPU_SANITIZE=1``.
+- ``sanitize`` — the ``SIDDHI_TPU_SANITIZE=1`` runtime detectors
+  (transfer guard + portable pull guard, post-warmup recompile
+  watchdog, lock-order assertions).
+- ``step_registry`` — declarative list of every jitted step builder;
+  ``tools/hlo_audit.py`` asserts audit coverage against it.
+"""
+
+from siddhi_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintContext,
+    ModuleInfo,
+    Rule,
+    default_rules,
+    load_modules,
+    run_lint,
+)
+from siddhi_tpu.analysis.locks import make_lock  # noqa: F401

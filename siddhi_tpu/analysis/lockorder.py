@@ -1,0 +1,87 @@
+"""Declared lock partial order — the single source of truth for R4.
+
+The hot-path locking discipline used to live only in prose (PR-5's
+"owner -> pump" contract in ``core/query/completion.py``, PR-6's
+"fold under the shard lock, WAL record inside it", the app ingestion
+barrier that everything else nests under). This module turns those
+sentences into data consumed by BOTH enforcement layers:
+
+- the static rule ``analysis/rules_locks.py`` (graftlint R4) flags a
+  ``with`` acquisition that can invert the order, at review time;
+- the runtime shim ``analysis/locks.py`` (``SIDDHI_TPU_SANITIZE=1``)
+  asserts the order on every acquisition, at test time.
+
+``EDGES`` are "must be acquired before" pairs: ``("owner", "pump")``
+means a thread holding a *pump*-ranked lock may never acquire an
+*owner*-ranked lock. Same-rank nesting is always allowed (chained
+queries take owner locks down the emit cascade; re-entrant RLocks are
+re-entrant by design).
+
+Ranked locks are created through ``analysis.locks.make_lock(rank)``;
+locks created bare (telemetry registries, scheduler, tables, ...) have
+no rank and are transparent to both checkers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Tuple
+
+# rank -> owning subsystem, for error messages and docs
+RANKS: Dict[str, str] = {
+    "barrier": "app ingestion barrier (SiddhiAppRuntime._barrier)",
+    "owner": "per-query / fused-group lock (QueryRuntime._lock, "
+             "FusedFanoutRuntime._lock)",
+    "pump": "CompletionPump._lock (core/query/completion.py)",
+    "shard": "AggregationShard._lock (serving/sharded_aggregation.py)",
+    "wal": "IngestWAL._lock (resilience/replay.py)",
+}
+
+# (first, second): `first` must be acquired before `second`; acquiring
+# `first` while holding `second` is an inversion.
+EDGES: Tuple[Tuple[str, str], ...] = (
+    ("barrier", "owner"),   # send/persist hold the barrier around dispatch
+    ("owner", "pump"),      # PR-5 contract: pump lock never wraps an owner
+    ("barrier", "shard"),   # checkpoint_shards runs under the app barrier
+    ("shard", "wal"),       # PR-6: fold + WAL record are atomic vs rebuild
+    ("barrier", "wal"),     # ingest records the WAL under the barrier
+)
+
+# Static-rule recognizers: `NAME._lock` / `NAME` in a `with` resolves to
+# a rank when the variable name is one of these (the runtime shim needs
+# no heuristics — the lock object carries its rank).
+VARIABLE_RANKS: Dict[str, str] = {
+    "owner": "owner",
+    "pump": "pump",
+    "barrier": "barrier",
+    "shard": "shard",
+    "wal": "wal",
+}
+
+# Attribute names that denote the app barrier regardless of receiver.
+BARRIER_ATTRS = ("_barrier",)
+
+
+def must_precede() -> FrozenSet[Tuple[str, str]]:
+    """Transitive closure of ``EDGES`` as a frozen set of
+    (first, second) pairs."""
+    closure = set(EDGES)
+    changed = True
+    while changed:
+        changed = False
+        for a, b in list(closure):
+            for c, d in list(closure):
+                if b == c and (a, d) not in closure:
+                    closure.add((a, d))
+                    changed = True
+    return frozenset(closure)
+
+
+_CLOSURE = must_precede()
+
+
+def inversion(held_rank: str, acquiring_rank: str) -> bool:
+    """True when acquiring ``acquiring_rank`` while holding
+    ``held_rank`` inverts the declared order."""
+    if held_rank == acquiring_rank:
+        return False
+    return (acquiring_rank, held_rank) in _CLOSURE

@@ -1,0 +1,94 @@
+"""Ranked lock factory — the runtime half of the R4 lock-order rule.
+
+Production code creates its ordered locks through ``make_lock(rank)``
+instead of ``threading.RLock()``. With sanitizers off (the default)
+this returns a plain ``threading.RLock`` — zero overhead, zero behavior
+change. Under ``SIDDHI_TPU_SANITIZE=1`` it returns a ``CheckedRLock``
+that tracks per-thread held ranks and raises ``LockOrderError`` the
+moment an acquisition inverts the partial order declared in
+``analysis/lockorder.py`` — turning a would-be deadlock that needs two
+racing threads to reproduce into a deterministic single-thread failure.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from siddhi_tpu.analysis import lockorder
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition inverted the declared partial order."""
+
+
+_TLS = threading.local()
+
+
+def _held():
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+class CheckedRLock:
+    """Re-entrant lock that asserts the declared acquisition order.
+
+    Same-rank nesting is allowed (owner locks chain down emit cascades);
+    re-entry on the SAME lock object is always allowed (RLock
+    semantics). Only cross-rank inversions raise."""
+
+    __slots__ = ("_lock", "rank")
+
+    def __init__(self, rank: str):
+        if rank not in lockorder.RANKS:
+            raise ValueError(f"undeclared lock rank '{rank}' — add it to "
+                             "analysis/lockorder.py RANKS")
+        self._lock = threading.RLock()
+        self.rank = rank
+
+    def _check(self) -> None:
+        stack = _held()
+        for held_rank, held_id in stack:
+            if held_id == id(self):
+                return      # re-entrant on the same lock: always fine
+            if lockorder.inversion(held_rank, self.rank):
+                raise LockOrderError(
+                    f"lock-order inversion: acquiring '{self.rank}' "
+                    f"({lockorder.RANKS[self.rank]}) while holding "
+                    f"'{held_rank}' ({lockorder.RANKS[held_rank]}) — "
+                    f"declared order requires '{self.rank}' before "
+                    f"'{held_rank}' (analysis/lockorder.py)")
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._check()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _held().append((self.rank, id(self)))
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == id(self):
+                del stack[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def make_lock(rank: str):
+    """A ranked re-entrant lock: plain ``threading.RLock`` normally, a
+    ``CheckedRLock`` under ``SIDDHI_TPU_SANITIZE=1``."""
+    from siddhi_tpu.analysis import sanitize
+
+    if sanitize.enabled():
+        return CheckedRLock(rank)
+    return threading.RLock()

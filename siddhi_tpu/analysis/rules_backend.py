@@ -1,0 +1,141 @@
+"""R1 — no-backend-init-at-import.
+
+The PR-7 breaker class: a module-level ``jnp.int64(...)`` constant in
+``parallel/mesh.py`` materialized a device array at import, silently
+initializing the jax backend before ``force_host_devices`` could
+configure the virtual mesh — every multi-device script collapsed to one
+device with no error. The rule flags ANY evaluation of the module's
+``jax.numpy`` alias outside a function body — module level, class
+bodies, default argument values and decorators all execute at import —
+plus module-level calls into jax's eager/backend APIs.
+
+Fix pattern: numpy for constants (``np.int64(2**62)`` promotes
+identically inside jitted arithmetic), lazy init for anything that
+really needs a device.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from siddhi_tpu.analysis.engine import Finding, LintContext, Rule
+
+# jax.<name>(...) calls that initialize or query the backend
+_EAGER_JAX_CALLS = {
+    "devices", "local_devices", "device_count", "local_device_count",
+    "default_backend", "device_put", "device_get", "make_mesh",
+}
+
+
+def _jnp_aliases(tree: ast.AST) -> set:
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                # bare `import jax.numpy` binds the NAME `jax` (the
+                # package) — jax.config.update at module level is fine;
+                # the dotted `jax.numpy` access is caught separately in
+                # _scan_expr, so only an explicit asname is an alias
+                if a.name == "jax.numpy" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and node.level == 0:
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+    return aliases
+
+
+class BackendInitRule(Rule):
+    id = "R1"
+    title = "no backend init at import"
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in ctx.modules:
+            aliases = _jnp_aliases(mod.tree)
+            self._scan_body(mod, mod.tree.body, aliases, findings)
+        return findings
+
+    # ------------------------------------------------------------------
+
+    def _scan_body(self, mod, body, aliases, findings) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # the BODY runs lazily, but defaults and decorators
+                # evaluate at import time
+                for n in (st.args.defaults
+                          + [d for d in st.args.kw_defaults if d is not None]
+                          + st.decorator_list):
+                    self._scan_expr(mod, n, aliases, findings)
+                continue
+            if isinstance(st, ast.ClassDef):
+                for n in st.decorator_list + st.bases:
+                    self._scan_expr(mod, n, aliases, findings)
+                self._scan_body(mod, st.body, aliases, findings)
+                continue
+            if isinstance(st, ast.If) and self._is_main_guard(st.test):
+                # `if __name__ == "__main__":` runs as a script entry
+                # point, never at import
+                continue
+            self._scan_expr(mod, st, aliases, findings)
+
+    @staticmethod
+    def _is_main_guard(test: ast.AST) -> bool:
+        return (isinstance(test, ast.Compare)
+                and isinstance(test.left, ast.Name)
+                and test.left.id == "__name__")
+
+    @staticmethod
+    def _walk_eager(node):
+        """ast.walk that does not descend into lazily-evaluated bodies
+        (functions and lambdas defined at module level run later) —
+        but a nested def's defaults and decorators DO evaluate at
+        import, even inside a module-level if/try block."""
+        todo = [node]
+        while todo:
+            n = todo.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                todo.extend(n.args.defaults)
+                todo.extend(d for d in n.args.kw_defaults if d is not None)
+                todo.extend(n.decorator_list)
+                continue
+            if isinstance(n, ast.Lambda):
+                todo.extend(n.args.defaults)
+                todo.extend(d for d in n.args.kw_defaults if d is not None)
+                continue
+            todo.extend(ast.iter_child_nodes(n))
+
+    def _scan_expr(self, mod, node, aliases, findings) -> None:
+        for sub in self._walk_eager(node):
+            if (isinstance(sub, ast.Name) and sub.id in aliases
+                    and isinstance(sub.ctx, ast.Load)):
+                findings.append(Finding(
+                    self.id, mod.path, sub.lineno,
+                    f"module-level evaluation of jax.numpy alias "
+                    f"'{sub.id}' runs at import and can initialize the "
+                    f"jax backend (the force_host_devices breaker class)"
+                    f" — use numpy or compute lazily inside a function"))
+            elif (isinstance(sub, ast.Attribute) and sub.attr == "numpy"
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "jax"):
+                # dotted access: `jax.numpy.int64(...)` via plain
+                # `import jax` — same breaker class, no alias involved
+                findings.append(Finding(
+                    self.id, mod.path, sub.lineno,
+                    "module-level jax.numpy evaluation runs at import "
+                    "and can initialize the jax backend (the "
+                    "force_host_devices breaker class) — use numpy or "
+                    "compute lazily inside a function"))
+            elif isinstance(sub, ast.Call):
+                fn = sub.func
+                if (isinstance(fn, ast.Attribute)
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id == "jax"
+                        and fn.attr in _EAGER_JAX_CALLS):
+                    findings.append(Finding(
+                        self.id, mod.path, sub.lineno,
+                        f"module-level jax.{fn.attr}() initializes the "
+                        f"backend at import — defer it into a function"))

@@ -1,0 +1,48 @@
+"""Declarative registry of every jitted step BUILDER in the engine.
+
+``tools/hlo_audit.py`` used to audit a hand-kept list of step kinds;
+a new builder (the device join engine, the sharded-agg selector) only
+got audited when somebody remembered. This registry is the contract:
+every entry here names a production code path that compiles a step
+with ``jax.jit``, and hlo_audit asserts its decorated audit set covers
+ALL of them — adding a builder without an audit fails the quick tier
+by construction.
+
+Entries are (dotted module path, attribute) so the registry is
+importable without jax and verifiable by a plain resolve.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, Tuple
+
+# audit name -> (module, attr) of the builder that jits the step
+JIT_STEP_BUILDERS: Dict[str, Tuple[str, str]] = {
+    # per-query single-stream step (QueryRuntime._make_step -> jax.jit)
+    "query_step": ("siddhi_tpu.core.query.runtime", "QueryRuntime"),
+    # fused sibling queries: one jitted step per junction group
+    "fused_fanout": ("siddhi_tpu.core.query.fused_fanout",
+                     "FusedFanoutRuntime"),
+    # GSPMD keyed sharding (round-4) + host-routed shard_map (round-5)
+    "gspmd_replicated_batch": ("siddhi_tpu.parallel.mesh",
+                               "shard_query_step"),
+    "shard_map_routed": ("siddhi_tpu.parallel.mesh",
+                         "shard_keyed_query_step"),
+    # device-side repartitioning (round-6): routing inside the step
+    "device_routed": ("siddhi_tpu.parallel.mesh",
+                      "device_route_query_step"),
+    # device join engine: fused insert+probe side step
+    "device_join": ("siddhi_tpu.core.join.engine", "DeviceJoinEngine"),
+    # serving tier: sharded incremental aggregation's on-demand
+    # selector steps over per-shard device views
+    "sharded_agg": ("siddhi_tpu.serving.sharded_aggregation",
+                    "ShardedIncrementalAggregation"),
+}
+
+
+def resolve(name: str):
+    """Import and return the registered builder (audit-time sanity:
+    a renamed/moved builder fails loudly, not silently unaudited)."""
+    module, attr = JIT_STEP_BUILDERS[name]
+    return getattr(importlib.import_module(module), attr)

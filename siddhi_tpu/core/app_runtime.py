@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.compiler.errors import SiddhiAppValidationException
 from siddhi_tpu.core.context import SiddhiAppContext, SiddhiContext
 from siddhi_tpu.core.event import Event
@@ -99,7 +100,7 @@ class SiddhiAppRuntime:
         self.siddhi_app = siddhi_app
         self.name = siddhi_app.name or _default_app_name(siddhi_app)
         self.app_context = SiddhiAppContext(siddhi_context, self.name)
-        self._barrier = threading.RLock()
+        self._barrier = make_lock("barrier")
         self.app_context.timestamp_generator.set_heartbeat_barrier(self._barrier)
         self.stream_definitions: Dict[str, StreamDefinition] = dict(siddhi_app.stream_definitions)
         self.junctions: Dict[str, StreamJunction] = {}
@@ -148,59 +149,16 @@ class SiddhiAppRuntime:
         self.app_context.scheduler = Scheduler(self.app_context)
 
         # deployment config: ConfigManager system keys override the
-        # capacity knobs (reference ConfigManager consulted at parse time)
+        # capacity knobs (reference ConfigManager consulted at parse
+        # time). Every siddhi_tpu.* key resolves through the typed
+        # parser registry (core/util/knobs.py): junk spellings raise
+        # SiddhiAppValidationException naming the key and the accepted
+        # values, and graftlint R2 keeps ad-hoc reads out of the tree.
+        from siddhi_tpu.core.util.knobs import apply_app_knobs
+
         cm = siddhi_context.config_manager
-        explicit_depth = None
-        if cm is not None:
-            for knob in ("window_capacity", "partition_window_capacity",
-                         "nfa_slots", "initial_key_capacity", "defer_meta",
-                         "pipeline_depth", "agg_shards", "agg_shard_wal",
-                         "join_partitions", "join_partition_slack"):
-                v = cm.get_property(f"siddhi_tpu.{knob}")
-                if v is not None:
-                    setattr(self.app_context, knob, int(v))
-            v = cm.get_property("siddhi_tpu.join_partition_grow")
-            if v is not None:
-                s = str(v).strip().lower()
-                if s in ("1", "true", "on", "yes"):
-                    self.app_context.join_partition_grow = True
-                elif s in ("0", "false", "off", "no"):
-                    self.app_context.join_partition_grow = False
-                else:
-                    raise SiddhiAppValidationException(
-                        "siddhi_tpu.join_partition_grow must be a boolean "
-                        "(1/0/true/false/on/off)")
-                    if knob == "pipeline_depth":
-                        explicit_depth = int(v)
-            v = cm.get_property("siddhi_tpu.cluster_step_timeout")
-            if v is not None:
-                self.app_context.cluster_step_timeout = float(v)
-            v = cm.get_property("siddhi_tpu.fuse_fanout")
-            if v is not None:
-                self.app_context.fuse_fanout = str(v).strip().lower() not in (
-                    "0", "false", "off", "no")
-            v = cm.get_property("siddhi_tpu.shard_exchange")
-            if v is not None:
-                # device-routed sharding's exchange kernel: "all_to_all"
-                # (portable default) or "pallas_ring" (TPU direct-RDMA;
-                # inert on CPU fallback — parallel/mesh.py)
-                v = str(v).strip().lower()
-                if v not in ("all_to_all", "pallas_ring"):
-                    raise SiddhiAppValidationException(
-                        "siddhi_tpu.shard_exchange must be 'all_to_all' "
-                        "or 'pallas_ring'")
-                self.app_context.shard_exchange = v
-            v = cm.get_property("siddhi_tpu.join_engine")
-            if v is not None:
-                # 'device' = PanJoin-style partitioned engine on eligible
-                # stream-stream window joins (core/join/); 'legacy' keeps
-                # the synchronous reference probe path wholesale
-                v = str(v).strip().lower()
-                if v not in ("device", "legacy"):
-                    raise SiddhiAppValidationException(
-                        "siddhi_tpu.join_engine must be 'device' or "
-                        "'legacy'")
-                self.app_context.join_engine = v
+        explicit_knobs = apply_app_knobs(cm, self.app_context)
+        explicit_depth = explicit_knobs.get("pipeline_depth")
         if self.app_context.defer_meta > 1:
             # deprecation shim: the hold-N-then-flush defer queue is
             # subsumed by the dispatch pipeline (core/query/completion.py)
@@ -401,40 +359,42 @@ class SiddhiAppRuntime:
             self._overload_from_config(cm)
 
     def _overload_from_config(self, cm) -> None:
-        def _get(key):
-            return cm.get_property(f"siddhi_tpu.{key}")
+        from siddhi_tpu.core.util.knobs import read_knob
 
-        queue_quota = _get("quota_queue_depth")
-        policy = _get("shed_policy")
-        pipeline_quota = _get("quota_pipeline_depth")
-        memory_mb = _get("quota_memory_mb")
-        block_timeout = _get("quota_block_timeout_s")
-        fair_weight = _get("fair_weight")
-        query_cap = _get("quota_query_cap")
+        queue_quota = read_knob(cm, "quota_queue_depth")
+        policy = read_knob(cm, "shed_policy")
+        pipeline_quota = read_knob(cm, "quota_pipeline_depth")
+        memory_mb = read_knob(cm, "quota_memory_mb")
+        block_timeout = read_knob(cm, "quota_block_timeout_s")
+        fair_weight = read_knob(cm, "fair_weight")
+        query_cap = read_knob(cm, "quota_query_cap")
         per_stream_quota = {}
         per_stream_policy = {}
         for sid in self.junctions:
-            v = _get(f"quota_queue_depth.{sid}")
+            v = read_knob(cm, "quota_queue_depth", stream=sid)
             if v is not None:
-                per_stream_quota[sid] = int(v)
-            v = _get(f"shed_policy.{sid}")
+                per_stream_quota[sid] = v
+            v = read_knob(cm, "shed_policy", stream=sid)
             if v is not None:
-                per_stream_policy[sid] = str(v).strip().lower()
-        if not any((queue_quota, policy, pipeline_quota, memory_mb,
-                    block_timeout, fair_weight, query_cap,
-                    per_stream_quota, per_stream_policy)):
+                per_stream_policy[sid] = v
+        # presence, not truthiness: the values are TYPED now, and an
+        # explicit `quota_queue_depth: 0` / `fair_weight: 0` must still
+        # register overload enforcement
+        if all(v is None for v in (queue_quota, policy, pipeline_quota,
+                                   memory_mb, block_timeout, fair_weight,
+                                   query_cap)) \
+                and not per_stream_quota and not per_stream_policy:
             return
         self.enable_overload(
-            queue_quota=int(queue_quota) if queue_quota else None,
-            shed_policy=(str(policy).strip().lower() if policy else "block"),
+            queue_quota=queue_quota,
+            shed_policy=policy if policy else "block",
             queue_quota_per_stream=per_stream_quota,
             shed_policy_per_stream=per_stream_policy,
-            pipeline_quota=int(pipeline_quota) if pipeline_quota else None,
-            memory_budget_mb=float(memory_mb) if memory_mb else None,
-            block_timeout_s=(float(block_timeout) if block_timeout
-                             else None),
-            fair_weight=float(fair_weight) if fair_weight else 1.0,
-            query_cap=int(query_cap) if query_cap else None)
+            pipeline_quota=pipeline_quota,
+            memory_budget_mb=memory_mb,
+            block_timeout_s=block_timeout,
+            fair_weight=fair_weight if fair_weight is not None else 1.0,
+            query_cap=query_cap)
 
     def enable_overload(self, queue_quota=None, shed_policy="block",
                         queue_quota_per_stream=None,

@@ -180,11 +180,12 @@ class SiddhiAppContext:
         # producer's next send (core/query/completion.py). 1 = fully
         # synchronous (today's pull-per-batch). Set via ConfigManager key
         # siddhi_tpu.pipeline_depth; SIDDHI_TPU_PIPELINE_DEPTH overrides
-        # the process default.
-        import os as _os
+        # the process default (typed read — junk spellings raise naming
+        # the variable, core/util/knobs.py).
+        from siddhi_tpu.core.util.knobs import env_knob
 
-        self.pipeline_depth = int(
-            _os.environ.get("SIDDHI_TPU_PIPELINE_DEPTH") or "2")
+        self.pipeline_depth = env_knob("SIDDHI_TPU_PIPELINE_DEPTH",
+                                       "int", 2)
         from siddhi_tpu.core.query.completion import CompletionPump
 
         self.completion_pump = CompletionPump(self)

@@ -250,12 +250,16 @@ class LazyColumns(dict):
 
     def pop(self, k, *default):
         # pops materialize ONLY the popped value (control scalars like
-        # __meta__ must not drag every data column across the link)
+        # __meta__ must not drag every data column across the link);
+        # explicit device_get — this IS a sanctioned pull point, and the
+        # SIDDHI_TPU_SANITIZE transfer guard rejects implicit transfers
         if k in self:
             v = super().__getitem__(k)
             dict.pop(self, k)
             if not isinstance(v, np.ndarray):
-                v = np.asarray(v)
+                import jax
+
+                v = np.asarray(jax.device_get(v))
             return v
         if default:
             return default[0]

@@ -68,6 +68,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.stream.junction import FatalQueryError
 
 log = logging.getLogger(__name__)
@@ -215,7 +216,7 @@ class CompletionPump:
     def __init__(self, app_context):
         self.app_context = app_context
         self._pending: Dict[object, deque] = {}
-        self._lock = threading.RLock()
+        self._lock = make_lock("pump")
         self._tls = threading.local()
         self._n_pending = 0       # cheap has-work probe for sync senders
         # monotonic submit counts PER DELIVERING JUNCTION: lets a worker

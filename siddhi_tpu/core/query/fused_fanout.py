@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, STR_RANK
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
@@ -142,7 +143,7 @@ class FusedFanoutRuntime(Receiver):
         self._sig = None          # (slots, per-member key capacities)
         self._clusters: List[List[int]] = []   # member idxs per computation
         self._cluster_of: List[int] = []       # member idx -> cluster idx
-        self._lock = threading.RLock()
+        self._lock = make_lock("owner")
         for m in self.members:
             m._fanout_group = self
         junction.replace_receivers(self.members, self)

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, LazyColumns, StringDictionary
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
@@ -169,7 +170,7 @@ class QueryRuntime(Receiver):
         self._sel_step = None  # split pipelines (host keyer between stages)
         self._shard_mesh = None  # set by parallel.mesh.shard_query_step
         self._route_layout = None  # parallel.mesh.device_route_query_step
-        self._lock = threading.RLock()  # per-query lock (QueryParser.java:159-215)
+        self._lock = make_lock("owner")  # per-query lock (QueryParser.java:159-215)
         self._deferred: List = []   # queued outputs when defer_meta > 1
         self._cur_junction = None   # delivering junction of the batch in
         #                             process (completion-latency feedback)
@@ -822,7 +823,9 @@ class QueryRuntime(Receiver):
 
             return guarded_pull(meta, timeout,
                                 what=f"query '{self.name}' step")
-        return np.asarray(meta)
+        # explicit pull: this is THE sanctioned per-batch round trip —
+        # the sanitizer's transfer guard rejects implicit d2h transfers
+        return np.asarray(jax.device_get(meta))
 
     @property
     def _defer_ok(self) -> bool:

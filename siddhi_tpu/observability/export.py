@@ -27,6 +27,46 @@ from typing import Dict, List, Tuple
 
 from siddhi_tpu.observability.telemetry import global_registry
 
+# --- graftlint R3 declarations (metric-registration parity) ----------
+# Every dotted telemetry name registered anywhere in the tree
+# (.gauge/.count/.histogram/stat_count) must start with one of these
+# prefixes; each prefix maps to a dedicated family below or renders as
+# the labeled generic siddhi_counter_total/siddhi_gauge ON PURPOSE.
+# A registration with an undeclared prefix, and a declared prefix with
+# no remaining registration site, are both lint findings — the PR-6
+# "gauges registered on one code path but not its twin" class.
+TELEMETRY_PREFIXES = (
+    "junction",      # @Async queue depth / stalls / sheds / timeouts
+    "fanout",        # fused fan-out group size + dispatch counters
+    "pipeline",      # CompletionPump depth + metas/pulls/stalls
+    "aggregation",   # rollup buckets, shards, shard WALs, flush_ms
+    "shard",         # routed-row skew gauges + exchange_ms
+    "join",          # device-join partition occupancy, probe/insert_ms
+    "serving",       # admission pool, scatter-gather latency
+    "quota",         # overload quota-utilization gauges
+    "overload",      # always-on overload counters (generic family)
+    "wal",           # ingest-WAL size gauges
+    "cluster",       # bounded-pull probe (process registry)
+    "resilience",    # StatisticsManager recovery counters (stat_count)
+)
+# Gauge templates that live exactly as long as their registry does —
+# per-app gauges die with the app's TelemetryRegistry at shutdown, the
+# process-registry entries below are deliberate process-lifetime
+# probes. Everything else must have a remove_gauge site or it pins a
+# dead probe on /metrics (the lint names this list on violation).
+PROCESS_LIFETIME_GAUGES = (
+    "junction.*",           # app registry — junctions live with the app
+    "pipeline.*.inflight",  # app registry; label-keyed, survives rebuilds
+    "wal.*",                # app registry — registered at WAL attach
+    "aggregation.*",        # app registry — both rollup paths register
+    "quota.*",              # app registry — overload registration
+    "join.partition_rows.*",  # app registry — device-join attach
+    "shard.rows.*",         # app + process registry (legacy host-router
+                            # scope "host" is a deprecated shim)
+    "cluster.outstanding_pulls",  # process registry, process-lifetime
+)
+# ---------------------------------------------------------------------
+
 # operationally load-bearing counters, pre-declared at 0 per app
 RESILIENCE_COUNTERS = (
     "resilience.worker_restarts",

@@ -39,28 +39,71 @@ class InstrumentedJit:
     ``jax.jit`` returns instantly; tracing + XLA compilation happen at
     the first invocation. This proxy times that first call, records it
     as a jit-compile event (and a ``span("jit", key=...)``), then
-    degrades to a single attribute check per call."""
+    degrades to a single attribute check per call.
 
-    __slots__ = ("_fn", "_key", "_telemetry", "_compiled")
+    Under ``SIDDHI_TPU_SANITIZE=1`` it additionally watches the wrapped
+    callable's compile cache on EVERY call: a cache miss past the
+    per-key budget — or any miss after
+    ``analysis.sanitize.freeze_compiles()`` — raises ``RecompileError``
+    naming the jit key, so a recompile-per-batch shape instability
+    fails a test instead of melting p99 in production."""
+
+    __slots__ = ("_fn", "_key", "_telemetry", "_compiled", "_sanitize",
+                 "_cache_size", "_compiles")
 
     def __init__(self, fn: Callable, key: str, telemetry: "TelemetryRegistry"):
+        from siddhi_tpu.analysis import sanitize
+
         self._fn = fn
         self._key = key
         self._telemetry = telemetry
         self._compiled = False
+        self._sanitize = sanitize.enabled()
+        self._cache_size = 0
+        self._compiles = 0
 
     def __call__(self, *args):
-        if self._compiled:
+        if self._compiled and not self._sanitize:
             return self._fn(*args)
         from siddhi_tpu.observability.tracing import span
 
         t0 = time.perf_counter()
         with span("jit", key=self._key):
             out = self._fn(*args)
+        first = not self._compiled
         self._compiled = True
-        self._telemetry.record_jit(
-            self._key, wall_ms=(time.perf_counter() - t0) * 1000.0)
+        if first:
+            self._telemetry.record_jit(
+                self._key, wall_ms=(time.perf_counter() - t0) * 1000.0)
+        if self._sanitize:
+            self._watch_recompiles(first,
+                                   (time.perf_counter() - t0) * 1000.0)
         return out
+
+    def _watch_recompiles(self, first_call: bool, wall_ms: float) -> None:
+        from siddhi_tpu.analysis import sanitize
+
+        cache_size_fn = getattr(self._fn, "_cache_size", None)
+        if cache_size_fn is None:
+            return      # not a jax.jit callable — nothing to watch
+        try:
+            size = int(cache_size_fn())
+        except Exception:   # noqa: BLE001 — jaxlib introspection only
+            return
+        if size > self._cache_size:
+            self._compiles += size - self._cache_size
+            self._cache_size = size
+            if not first_call:
+                # a LATE compile: record it (the off-mode proxy only
+                # times the first call) and let the watchdog judge it.
+                # wall_ms is the whole call (compile + execute), same
+                # approximation as the first-call timing.
+                self._telemetry.record_jit(self._key, wall_ms=wall_ms)
+            if not first_call or sanitize.compiles_frozen():
+                # freeze_compiles() means ANY cache miss raises — even a
+                # cold proxy's very first compile (a late-created
+                # runtime compiling mid-soak IS the storm being hunted)
+                sanitize.check_recompile(self._key, self._compiles)
 
     def __getattr__(self, name):
         # transparent proxy: .lower()/.trace()/aot inspection go to the

@@ -39,8 +39,6 @@ from siddhi_tpu.query_api.definitions import AttrType
 
 CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
 
-_NEG_INF = {jnp.int32: np.iinfo(np.int32).min, jnp.int64: np.iinfo(np.int64).min}
-
 
 @dataclass
 class AggSpec:

@@ -103,10 +103,12 @@ from siddhi_tpu.query_api.expressions import Expression, Variable
 
 CURRENT, EXPIRED, TIMER, RESET = 0, 1, 2, 3
 ANY_MAX = 2 ** 30
-FAR_FUTURE = jnp.int64(2 ** 62)
+# numpy on purpose: jnp scalars at module level initialize the backend
+# at import (graftlint R1 — the force_host_devices breaker class)
+FAR_FUTURE = np.int64(2 ** 62)
 # T0 sentinel for capture-less armed heads: within counts from the first
 # capture; 2**60 keeps T0 + within far below int64 overflow
-_T0_FAR = jnp.int64(2 ** 60)
+_T0_FAR = np.int64(2 ** 60)
 
 
 # --------------------------------------------------------------------- plan

@@ -60,7 +60,11 @@ NOTIFY_KEY = "__notify__"
 OVERFLOW_KEY = "__overflow__"
 FLUSH_KEY = "__flush__"
 
-_BIG = jnp.int64(2**62)
+# numpy on purpose: a jnp scalar here would materialize a device array
+# at import and initialize the backend before force_host_devices can
+# configure the virtual mesh (graftlint R1); np.int64 promotes
+# identically inside the jitted arithmetic below
+_BIG = np.int64(2**62)
 
 
 def _data_keys(cols: Dict) -> List[str]:

@@ -75,7 +75,9 @@ from typing import Dict, Optional
 
 log = logging.getLogger(__name__)
 
-SHED_POLICIES = ("block", "shed_oldest", "shed_newest")
+# declared next to the config parser so the accepted spellings cannot
+# drift from what the typed knob registry rejects (graftlint R2 class)
+from siddhi_tpu.core.util.knobs import SHED_POLICIES  # noqa: E402,F401
 
 # bounded-wait slice for quota/block waits: short enough that a drained
 # queue admits promptly, long enough not to spin the core
@@ -409,15 +411,19 @@ class AppOverloadControl:
     # ----------------------------------------------------------- gauges
 
     def utilization(self) -> Dict[str, float]:
+        # presence, not truthiness: an explicit quota of 0 is enforced
+        # (every submit drains / every growth denies) and reads as
+        # saturated the moment anything is in use
         out = {}
         pq = self.config.pipeline_quota
-        if pq:
+        if pq is not None:
             pump = getattr(self.app_context, "completion_pump", None)
-            out["pipeline"] = (pump._n_pending / pq) if pump is not None \
-                else 0.0
+            n = pump._n_pending if pump is not None else 0
+            out["pipeline"] = n / pq if pq > 0 else float(n > 0)
         budget = self.config.memory_budget_bytes
-        if budget:
-            out["memory"] = self.charged_bytes() / budget
+        if budget is not None:
+            c = self.charged_bytes()
+            out["memory"] = c / budget if budget > 0 else float(c > 0)
         return out
 
 
@@ -486,23 +492,31 @@ class OverloadManager:
             return
         cfg = ctl.config
         for sid, j in ctl.app_runtime.junctions.items():
-            quota = (cfg.queue_quota_per_stream.get(sid)
-                     or cfg.queue_quota)
-            if quota and getattr(j, "_queue", None) is not None:
+            # presence, not truthiness: an explicit per-stream quota of
+            # 0 is enforced by admit() and must gauge as saturated, not
+            # fall through to the app-wide quota (typed-knob contract)
+            quota = cfg.queue_quota_per_stream.get(sid)
+            if quota is None:
+                quota = cfg.queue_quota
+            if quota is not None and getattr(j, "_queue", None) is not None:
                 tel.gauge(
                     f"quota.queue_utilization.{sid}",
-                    lambda jn=j, q=quota: (jn._queue.qsize() / q
-                                           if jn._queue is not None else 0.0))
-        if cfg.pipeline_quota:
+                    lambda jn=j, q=quota: (
+                        (jn._queue.qsize() / q if q > 0
+                         else float(jn._queue.qsize() > 0))
+                        if jn._queue is not None else 0.0))
+        if cfg.pipeline_quota is not None:
             pump = getattr(ctl.app_context, "completion_pump", None)
             if pump is not None:
                 tel.gauge("quota.pipeline_utilization",
                           lambda p=pump, q=cfg.pipeline_quota:
-                          p._n_pending / q)
-        if cfg.memory_budget_bytes:
+                          (p._n_pending / q if q > 0
+                           else float(p._n_pending > 0)))
+        if cfg.memory_budget_bytes is not None:
             tel.gauge("quota.memory_utilization",
                       lambda c=ctl, b=cfg.memory_budget_bytes:
-                      c.charged_bytes() / b)
+                      (c.charged_bytes() / b if b > 0
+                       else float(c.charged_bytes() > 0)))
 
 
 # --------------------------------------------------- module-level helpers

@@ -33,6 +33,7 @@ import threading
 from collections import deque
 from typing import List, Optional
 
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import Event
 
 
@@ -90,7 +91,7 @@ class IngestWAL:
         self.max_events = max_events
         self.app_context = app_context    # statistics hookup (optional)
         self._log: deque = deque()
-        self._lock = threading.RLock()
+        self._lock = make_lock("wal")
         self._seq = 0
         self._events = 0                  # events currently held
         self.dropped_batches = 0          # overflow evictions (lossy!)

@@ -54,6 +54,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.aggregation.incremental import (
     IncrementalAggregationRuntime,
     parse_duration_name,
@@ -87,7 +88,7 @@ class AggregationShard:
             d: {} for d in durations}
         self._dirty: set = set()
         self._deleted: set = set()
-        self._lock = threading.RLock()
+        self._lock = make_lock("shard")
         self.epoch = 0
         self.wal = wal
         # duration -> (epoch, sorted [(bucket, group, [bases copy])])

@@ -1,0 +1,17 @@
+"""Known-bad R1 fixture: module-level jax.numpy evaluation (the PR-7
+force_host_devices breaker) plus an eager backend call at import."""
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.int64(2 ** 62)            # materializes a device array at import
+N_DEV = len(jax.devices())          # initializes the backend at import
+
+
+def ok_inside_function():
+    # lazy: evaluating jnp here is fine
+    return jnp.zeros(3)
+
+
+def bad_default(x=jnp.ones(2)):     # default args evaluate at import
+    return x

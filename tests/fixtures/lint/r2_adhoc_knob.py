@@ -1,0 +1,17 @@
+"""Known-bad R2 fixture: ad-hoc siddhi_tpu.* knob reads around the typed
+parser registry (the PR-9 'false'-crashes-the-int()-loop class)."""
+
+import os
+
+
+def read_knobs(cm, app_context):
+    # generic untyped loop: int() crashes on 'false', names no key
+    for knob in ("window_capacity", "pipeline_depth"):
+        v = cm.get_property(f"siddhi_tpu.{knob}")
+        if v is not None:
+            setattr(app_context, knob, int(v))
+    # one-off read with its own inline parser
+    grow = cm.get_property("siddhi_tpu.join_partition_grow")
+    # env spelling dodging the registry too
+    depth = os.environ.get("SIDDHI_TPU_PIPELINE_DEPTH")
+    return grow, depth

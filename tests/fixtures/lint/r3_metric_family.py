@@ -1,0 +1,14 @@
+"""Known-bad R3 fixture: a telemetry family nobody declared in
+export.py (renders as a generic catch-all), an out-of-band siddhi_*
+family literal, and a gauge with no unregister path (the PR-6
+registered-on-one-path-only class)."""
+
+
+def register(tel, sid):
+    # undeclared prefix: falls through to siddhi_gauge{name=...}
+    tel.gauge(f"mystery.{sid}.depth", lambda: 0)
+    # family literal outside export.py
+    family = "siddhi_mystery_total"
+    # counter under an undeclared prefix
+    tel.count("mystery.events")
+    return family

@@ -1,0 +1,21 @@
+"""Known-bad R4 fixture: acquiring an owner lock while holding the pump
+lock — the inversion of the declared owner -> pump order that the
+CompletionPump contract (PR-5) forbids."""
+
+from siddhi_tpu.analysis.locks import make_lock
+
+
+class BadPump:
+    def __init__(self):
+        self._lock = make_lock("pump")
+
+    def drain_all(self, owners):
+        with self._lock:                 # pump held...
+            for owner in owners:
+                with owner._lock:        # ...owner acquired: inversion
+                    owner.flush()
+
+    def barrier_under_owner(self, owner, app):
+        with owner._lock:
+            with app._barrier:           # barrier must wrap owner
+                app.persist()

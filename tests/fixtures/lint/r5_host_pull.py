@@ -1,0 +1,17 @@
+"""Known-bad R5 fixture: host pulls inside a jitted step body — each
+one a synchronous device->host round trip per batch."""
+
+import jax
+import numpy as np
+
+
+def build_step_fn(plan):
+    def step(state, cols, now):
+        total = float(state["sum"])          # scalar pull
+        count = state["count"].item()        # .item() pull
+        host = np.asarray(cols["price"])     # whole-column pull
+        if bool(state["overflow"]):          # control-flow pull
+            total = 0.0
+        return state, {"t": total, "c": count, "h": host}
+
+    return jax.jit(step)

@@ -1,0 +1,209 @@
+"""Runtime sanitizer tests (SIDDHI_TPU_SANITIZE=1).
+
+The detectors are armed per-call against the env var, so these tests
+monkeypatch it on, plant each violation class, and assert the sanitizer
+names the culprit — then the teardown restores the env and every patch
+goes inert for the rest of the suite."""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from siddhi_tpu.analysis import sanitize
+from siddhi_tpu.analysis.locks import CheckedRLock, LockOrderError, make_lock
+
+
+@pytest.fixture
+def sanitized(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_SANITIZE", "1")
+    sanitize.enable()
+    yield
+    sanitize.disable()
+
+
+# ------------------------------------------------------------ pull guard
+
+def test_planted_host_pull_is_caught(sanitized):
+    arr = jax.jit(lambda x: x + 1)(jnp.arange(4.0))
+    with pytest.raises(sanitize.HostPullError, match="host pull"):
+        float(arr[0])
+    with pytest.raises(sanitize.HostPullError):
+        arr[0].item()
+    with pytest.raises(sanitize.HostPullError):
+        bool(arr[0] > 0)
+    with pytest.raises(sanitize.HostPullError):
+        int(arr[1])
+
+
+def test_sanctioned_pulls_stay_allowed(sanitized):
+    arr = jax.jit(lambda x: x * 2)(jnp.arange(4.0))
+    # the engine's batched pull point is explicit and allowed
+    host = jax.device_get(arr)
+    assert host[1] == 2.0
+    # cold-path reads declare themselves
+    with sanitize.allowed_pull():
+        assert float(arr[0]) == 0.0
+
+
+def test_pull_guard_inert_without_env():
+    arr = jnp.arange(3.0)
+    assert float(arr[2]) == 2.0     # no env var -> patched dunder passes
+
+
+def test_lazycolumns_pop_is_explicit(sanitized):
+    """The LazyColumns.pop meta pull (every drain's first touch) must be
+    transfer-guard-clean."""
+    from siddhi_tpu.core.event import LazyColumns
+
+    out = LazyColumns({"__meta__": jax.jit(
+        lambda: jnp.array([0, -1, 3], jnp.int64))()})
+    meta = out.pop("__meta__")
+    assert isinstance(meta, np.ndarray) and meta[2] == 3
+
+
+# ------------------------------------------------------- recompile guard
+
+def _registry():
+    from siddhi_tpu.observability.telemetry import TelemetryRegistry
+
+    return TelemetryRegistry()
+
+
+def test_planted_post_warmup_recompile_is_caught(sanitized):
+    tel = _registry()
+    step = tel.instrument_jit(jax.jit(lambda x: x * 2), "test.step")
+    step(jnp.ones(4))               # warmup compile
+    sanitize.freeze_compiles()
+    with pytest.raises(sanitize.RecompileError, match="test.step"):
+        step(jnp.ones(8))           # new shape -> cache miss -> raise
+    sanitize.thaw_compiles()
+
+
+def test_recompile_budget(sanitized, monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_SANITIZE_MAX_COMPILES", "2")
+    tel = _registry()
+    step = tel.instrument_jit(jax.jit(lambda x: x + 1), "test.budget")
+    step(jnp.ones(2))
+    step(jnp.ones(4))               # compile 2: at budget, fine
+    with pytest.raises(sanitize.RecompileError, match="test.budget"):
+        step(jnp.ones(8))           # compile 3: past budget
+    # telemetry recorded every compile, not just the first
+    assert tel.jit["test.budget"]["compiles"] >= 3
+
+
+def test_stable_shapes_never_trip(sanitized):
+    sanitize.freeze_compiles()
+    try:
+        tel = _registry()
+        step = tel.instrument_jit(jax.jit(lambda x: x - 1), "test.stable")
+        sanitize.thaw_compiles()
+        step(jnp.ones(16))
+        sanitize.freeze_compiles()
+        for _ in range(5):
+            step(jnp.ones(16))      # cache hits: silent
+    finally:
+        sanitize.thaw_compiles()
+
+
+# ------------------------------------------------------- lock-order shim
+
+def test_lock_order_inversion_raises():
+    pump, owner = CheckedRLock("pump"), CheckedRLock("owner")
+    with pump:
+        with pytest.raises(LockOrderError, match="owner.*pump"):
+            with owner:
+                pass
+
+
+def test_lock_order_declared_direction_ok():
+    barrier, owner, pump = (CheckedRLock("barrier"), CheckedRLock("owner"),
+                            CheckedRLock("pump"))
+    with barrier:
+        with owner:
+            with pump:
+                pass
+    # shard -> wal likewise
+    with CheckedRLock("shard"):
+        with CheckedRLock("wal"):
+            pass
+
+
+def test_lock_order_same_rank_and_reentry_ok():
+    a, b = CheckedRLock("owner"), CheckedRLock("owner")
+    with a:
+        with a:         # re-entrant
+            with b:     # same-rank chain (emit cascades)
+                pass
+
+
+def test_lock_order_is_per_thread():
+    pump, owner = CheckedRLock("pump"), CheckedRLock("owner")
+    errs = []
+
+    def other():
+        try:
+            with owner:
+                pass
+        except Exception as e:      # pragma: no cover — would be a bug
+            errs.append(e)
+
+    with pump:
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert not errs
+
+
+def test_make_lock_plain_without_env(monkeypatch):
+    monkeypatch.delenv("SIDDHI_TPU_SANITIZE", raising=False)
+    lk = make_lock("pump")
+    assert isinstance(lk, type(threading.RLock()))
+
+
+def test_make_lock_checked_with_env(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_SANITIZE", "1")
+    lk = make_lock("pump")
+    assert isinstance(lk, CheckedRLock)
+    with pytest.raises(ValueError, match="undeclared"):
+        make_lock("nonsense")
+
+
+def test_engine_runs_clean_under_sanitize(monkeypatch):
+    """End-to-end: a real app (pipelined, ranked locks active) runs a
+    batch with every sanitizer armed and trips nothing."""
+    monkeypatch.setenv("SIDDHI_TPU_SANITIZE", "1")
+    sanitize.enable()
+    try:
+        from siddhi_tpu import SiddhiManager
+
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime("""
+define stream S (sym string, v long);
+@info(name='q') from S#window.length(4)
+  select sym, sum(v) as total group by sym insert into Out;
+""")
+        got = []
+        rt.add_callback("Out", _collect(got))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(8):
+            h.send([f"k{i % 2}", i])
+        assert len(got) == 8
+        m.shutdown()
+    finally:
+        sanitize.disable()
+
+
+def _collect(sink):
+    from siddhi_tpu import StreamCallback
+
+    class _C(StreamCallback):
+        def receive(self, events):
+            sink.extend(events)
+
+    return _C()

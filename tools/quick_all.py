@@ -19,35 +19,63 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-# name -> (script, per-check timeout seconds)
+# name -> (script, per-check timeout seconds, extra argv, extra env)
 CHECKS = {
-    "route": ("quick_route_check.py", 300),
-    "fanout": ("quick_fanout_check.py", 300),
-    "pipeline": ("pipeline_check.py", 300),
-    "join": ("quick_join_check.py", 300),
-    "agg": ("quick_agg_check.py", 300),
-    "hlo": ("hlo_audit.py", 300),
+    "lint": ("graftlint.py", 120, (), {}),
+    "route": ("quick_route_check.py", 300, (), {}),
+    "fanout": ("quick_fanout_check.py", 300, (), {}),
+    "pipeline": ("pipeline_check.py", 300, (), {}),
+    "join": ("quick_join_check.py", 300, (), {}),
+    "agg": ("quick_agg_check.py", 300, (), {}),
+    "hlo": ("hlo_audit.py", 300, (), {}),
+    # the sanitized pass: the fast bit-identity subset re-run with every
+    # runtime sanitizer armed (transfer guard, recompile watchdog,
+    # lock-order assertions — siddhi_tpu/analysis/sanitize.py). For the
+    # FULL tier under sanitizers run:
+    #   SIDDHI_TPU_SANITIZE=1 python tools/quick_all.py route fanout \
+    #       pipeline join agg hlo
+    # budget = the four sub-checks' own budgets plus headroom for the
+    # nested runner's per-check interpreter/jax startup: sanitize mode
+    # is strictly slower per call, so the nested run must not get LESS
+    # time than its parts would alone
+    "sanitize": ("quick_all.py", 1350,
+                 ("route", "fanout", "pipeline", "agg"),
+                 {"SIDDHI_TPU_SANITIZE": "1"}),
 }
 
 
 def main() -> int:
-    names = sys.argv[1:] or list(CHECKS)
+    explicit = sys.argv[1:]
+    names = explicit or list(CHECKS)
     unknown = [n for n in names if n not in CHECKS]
     if unknown:
         print(f"unknown check(s) {unknown}; available: {list(CHECKS)}")
         return 2
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "")
+    base_env = dict(os.environ)
+    base_env.setdefault("JAX_PLATFORMS", "cpu")
+    base_env.setdefault("JAX_COMPILATION_CACHE_DIR", "")
+    if not explicit and base_env.get(
+            "SIDDHI_TPU_SANITIZE", "").strip().lower() in (
+            "1", "true", "on", "yes"):     # same spellings sanitize.enabled()
+        # a DEFAULT run inside an already-sanitized environment skips
+        # the nested "sanitize" entry — everything is sanitized anyway.
+        # An EXPLICIT `quick_all.py sanitize` still runs it (its
+        # subprocess names the subset, so there is no recursion), and
+        # an explicit =0 is NOT sanitized: the pass still runs.
+        names = [n for n in names if n != "sanitize"]
+    if not names:
+        print("quick_all: nothing to run")
+        return 2
     results = {}
     t00 = time.time()
     for name in names:
-        script, timeout = CHECKS[name]
+        script, timeout, extra_argv, extra_env = CHECKS[name]
         t0 = time.time()
+        env = {**base_env, **extra_env}
         print(f"[quick_all] {name}: {script} ...", flush=True)
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.join(HERE, script)],
+                [sys.executable, os.path.join(HERE, script), *extra_argv],
                 env=env, timeout=timeout, capture_output=True, text=True)
             ok = proc.returncode == 0
             tail = (proc.stdout + proc.stderr).strip().splitlines()[-8:]
