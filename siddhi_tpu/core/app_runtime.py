@@ -107,6 +107,7 @@ class SiddhiAppRuntime:
         self.query_runtimes: Dict[str, QueryRuntime] = {}
         self._stream_callback_adapters: List = []
         self._started = False
+        self._profiling_on = False  # holds one journey/costmodel enable
 
         # @app:playback (reference SiddhiAppParser.java:171-212): optional
         # idle.time + increment enable the idle heartbeat — when no event
@@ -890,6 +891,17 @@ class SiddhiAppRuntime:
             if self._started:
                 return
             self._started = True
+            # critical-path profiler knobs: refcounted process-wide
+            # enables, paired one-for-one with the disables in shutdown()
+            if not self._profiling_on and (self.app_context.profile_journeys
+                                           or self.app_context.profile_costs):
+                from siddhi_tpu.observability import costmodel, journey
+
+                if self.app_context.profile_journeys:
+                    journey.enable()
+                if self.app_context.profile_costs:
+                    costmodel.enable()
+                self._profiling_on = True
             for j in self.junctions.values():
                 j.start_processing()
             scheduler = self.app_context.scheduler
@@ -1076,6 +1088,20 @@ class SiddhiAppRuntime:
             sr.shutdown()
         if self.app_context.scheduler is not None:
             self.app_context.scheduler.shutdown()
+        from siddhi_tpu.observability import journey
+
+        # this app's wall-tracking must die with it (a redeployed
+        # same-named app starts a fresh observation window)
+        journey.forget_app(self.app_context.name)
+        if self._profiling_on:
+            # release this runtime's refcount on the process collectors
+            from siddhi_tpu.observability import costmodel
+
+            if self.app_context.profile_journeys:
+                journey.disable()
+            if self.app_context.profile_costs:
+                costmodel.disable()
+            self._profiling_on = False
         self._started = False
 
     # ----------------------------------------------------- resilience API

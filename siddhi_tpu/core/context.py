@@ -204,6 +204,15 @@ class SiddhiAppContext:
         # Off = every query keeps its own dispatch. Set via ConfigManager
         # key siddhi_tpu.fuse_fanout.
         self.fuse_fanout = True
+        # critical-path profiler (siddhi_tpu/observability/journey.py +
+        # costmodel.py): batch-journey stage tracing and first-compile
+        # program-cost capture. Both enable a PROCESS-wide collector for
+        # this runtime's lifetime (refcounted across apps). Keys
+        # siddhi_tpu.profile_journeys / siddhi_tpu.profile_costs;
+        # SIDDHI_TPU_PROFILE_COSTS=1 and POST /profile/* flip them
+        # process-wide without a config.
+        self.profile_journeys = False
+        self.profile_costs = False
         # serving tier (siddhi_tpu/serving/): >1 key-partitions every
         # incremental aggregation's bucket state across this many
         # in-process shards (round-robin over mesh devices) and answers

@@ -12,11 +12,13 @@ event types (``ComplexEvent.Type``) become an i8 column.
 from __future__ import annotations
 
 import ctypes
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from siddhi_tpu.observability import journey
 from siddhi_tpu.ops.expressions import TS_KEY, TYPE_KEY, VALID_KEY
 from siddhi_tpu.ops.types import dtype_of
 from siddhi_tpu.query_api.definitions import AbstractDefinition, AttrType
@@ -208,6 +210,18 @@ def encode_key_tuples(arrays, rows: np.ndarray, id_of) -> np.ndarray:
 _NONE_MASK = np.frompyfunc(lambda v: v is None, 1, 1)
 
 
+def _journey_t0() -> Optional[float]:
+    """Pack-stage stamp: perf_counter at pack start when batch-journey
+    tracing is on, else None — one module-flag check per BATCH pack
+    (observability/journey.py; maybe_delay is the tests' planted-pack-
+    bottleneck injection point, a no-op unless armed)."""
+    if not journey.enabled():
+        return None
+    t0 = time.perf_counter()
+    journey.maybe_delay("pack")   # inside the timed window by design
+    return t0
+
+
 def _pad_len(n: int, minimum: int = 8) -> int:
     """Pad batch length to a power of two to bound jit recompiles."""
     b = minimum
@@ -289,6 +303,10 @@ class HostBatch:
     def capacity(self) -> int:
         return self.cols[VALID_KEY].shape[0]
 
+    # per-batch journey trace context (observability/journey.py): stamped
+    # at pack when journey tracing is on, forked per receiving query
+    journey = None
+
     @staticmethod
     def from_events(
         events: Sequence[Event],
@@ -297,6 +315,7 @@ class HostBatch:
         pad_to: Optional[int] = None,
         event_type: int = CURRENT,
     ) -> "HostBatch":
+        t0 = _journey_t0()
         n = len(events)
         b = pad_to if pad_to is not None else _pad_len(n)
         cols: Dict[str, np.ndarray] = {
@@ -386,7 +405,10 @@ class HostBatch:
                         arr[:n] = col
             cols[attr.name] = arr
             cols[attr.name + "?"] = mask
-        return HostBatch(cols)
+        batch = HostBatch(cols)
+        if t0 is not None:
+            journey.stamp_pack(batch, t0)
+        return batch
 
     @staticmethod
     def from_columns(
@@ -401,6 +423,7 @@ class HostBatch:
         skips per-event objects entirely. ``data`` maps attribute names to
         arrays (strings may be numpy object/str arrays, encoded here, or
         pre-encoded int ids). ``<name>?`` null-mask arrays are optional."""
+        t0 = _journey_t0()
         first = next(iter(data.values()))
         n = len(first)
         b = pad_to if pad_to is not None else _pad_len(n)
@@ -437,7 +460,10 @@ class HostBatch:
                 mask[:n] |= np.asarray(user_mask, bool)[:n]
             cols[attr.name] = arr
             cols[attr.name + "?"] = mask
-        return HostBatch(cols)
+        batch = HostBatch(cols)
+        if t0 is not None:
+            journey.stamp_pack(batch, t0)
+        return batch
 
     def to_events(
         self,

@@ -70,6 +70,7 @@ import numpy as np
 
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.stream.junction import FatalQueryError
+from siddhi_tpu.observability import journey as journey_mod
 
 log = logging.getLogger(__name__)
 
@@ -79,14 +80,15 @@ class QueryCompletion:
     runtime."""
 
     __slots__ = ("owner", "out", "overflow_msg", "junction", "batch",
-                 "timer_cb", "t0", "wall", "tid")
+                 "timer_cb", "t0", "wall", "tid", "journey")
 
     def __init__(self, owner, out, overflow_msg: str, junction=None,
-                 batch=None):
+                 batch=None, journey=None):
         self.owner = owner
         self.out = out                    # LazyColumns, __meta__ still inside
         self.overflow_msg = overflow_msg
         self.junction = junction          # delivering junction (or None)
+        self.journey = journey            # batch-journey context (or None)
         # input batch, retained ONLY when the junction routes errors to a
         # fault stream (@OnError action='stream') — drain-time errors
         # must publish the failing events there, like the sync path
@@ -137,7 +139,12 @@ class QueryCompletion:
                 return FatalQueryError(
                     f"query '{q.name}': {msg} before "
                     f"creating the runtime")
+            jr = self.journey
+            t_e = time.perf_counter() if jr is not None else None
             q._emit(HostBatch(self.out, size=size))
+            if jr is not None:
+                jr.emit_ms = (time.perf_counter() - t_e) * 1000.0
+                jr.finish(q.app_context, (q.name,))
             if notify >= 0 and q.scheduler is not None:
                 q.scheduler.notify_at(
                     notify, self.timer_cb
@@ -158,10 +165,10 @@ class FusedCompletion:
     emission/attribution runs in ``FusedFanoutRuntime.complete_entry``."""
 
     __slots__ = ("owner", "outs", "metas_ref", "members", "cluster_of",
-                 "batch", "junction", "t0", "wall", "tid")
+                 "batch", "junction", "t0", "wall", "tid", "journey")
 
     def __init__(self, owner, outs, metas_ref, members, cluster_of, batch,
-                 junction=None):
+                 junction=None, journey=None):
         self.owner = owner
         self.outs = outs
         self.metas_ref = metas_ref
@@ -169,6 +176,7 @@ class FusedCompletion:
         self.cluster_of = cluster_of
         self.batch = batch                # input batch, for fault routing
         self.junction = junction
+        self.journey = journey            # one journey for the group batch
         self.t0 = time.perf_counter()
         self.wall = time.monotonic()
         self.tid = threading.get_ident()  # submitting thread (scoped flush)
@@ -193,14 +201,9 @@ class FusedCompletion:
                     (time.perf_counter() - self.t0) * 1000.0)
 
 
-def _is_ready(ref) -> bool:
-    is_ready = getattr(ref, "is_ready", None)
-    if is_ready is None:
-        return True     # numpy/unknown: treat as ready (never stalls)
-    try:
-        return bool(is_ready())
-    except Exception:   # noqa: BLE001 — deleted/donated buffers etc.
-        return True
+# numpy/unknown/deleted refs read as ready (never stalls) — shared with
+# the journey's device-attribution pivot so the two probes cannot drift
+_is_ready = journey_mod.ready_of
 
 
 class CompletionPump:
@@ -373,6 +376,16 @@ class CompletionPump:
         draining.add(id(owner))
         try:
             refs = [r for e in take for r in e.meta_refs()]
+            jt = journey_mod.enabled()
+            if jt:
+                # device-stage pivot: is_ready BEFORE the blocking pull
+                # tells whether the device was still busy for the ride
+                # (service) or the output sat parked (slack) — journey.py
+                for e in take:
+                    jr = getattr(e, "journey", None)
+                    if jr is not None:
+                        jr.pre_drain(e.ready())
+                t_pull0 = time.perf_counter()
             try:
                 metas = self._pull(owner, refs)
             except Exception as pull_err:  # noqa: BLE001 — dead peer etc.
@@ -396,6 +409,14 @@ class CompletionPump:
                 if not routed:
                     raise
                 return
+            if jt:
+                pull_ms = (time.perf_counter() - t_pull0) * 1000.0
+                for e in take:
+                    jr = getattr(e, "journey", None)
+                    if jr is not None:
+                        # one batched round trip serves the whole round;
+                        # each entry is attributed the round's pull
+                        jr.drained(pull_ms)
             errors: List[Exception] = []
             i = 0
             for e in take:

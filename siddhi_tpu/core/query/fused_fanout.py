@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import List, Optional, Tuple
 
 import jax
@@ -66,6 +67,7 @@ import numpy as np
 
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
+from siddhi_tpu.observability import journey
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, STR_RANK
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
 from siddhi_tpu.ops.expressions import VALID_KEY
@@ -428,9 +430,14 @@ class FusedFanoutRuntime(Receiver):
         sm = self.app_context.statistics_manager
         tel = self.app_context.telemetry
         t0 = latency_t0(sm)
+        # one journey per group batch: the shared dispatch/device stages
+        # are recorded under EVERY member's name at finish
+        jr = journey.begin(batch) if journey.enabled() else None
         states, cols_dev = self._prepare(batch)
         new_states, (outs, metas) = self._step(states, cols_dev,
                                                self._now64())
+        if jr is not None:
+            jr.end_dispatch()
         tel.count(f"fanout.{self.stream_id}.dispatches")
         for i, m in enumerate(members):
             # cluster members share the (immutable) result arrays
@@ -447,14 +454,24 @@ class FusedFanoutRuntime(Receiver):
                 record_elapsed_ms(sm, m.name, t0)
             pump.submit(FusedCompletion(
                 self, outs, metas, list(members), list(self._cluster_of),
-                batch, junction=junction))
+                batch, junction=junction, journey=jr))
             return
         # ONE combined [n_clusters, 3] meta pull for the whole group — the
         # single device->host round trip this layer exists to amortize
-        metas_host = np.asarray(jax.device_get(metas))
+        if jr is not None:
+            jr.pre_drain(journey.ready_of(metas))
+            _tp = time.perf_counter()
+            metas_host = np.asarray(jax.device_get(metas))
+            jr.drained((time.perf_counter() - _tp) * 1000.0)
+        else:
+            metas_host = np.asarray(jax.device_get(metas))
         tel.count(f"fanout.{self.stream_id}.meta_pulls")
+        t_e = time.perf_counter() if jr is not None else None
         fatal = self._emit_members(list(members), list(self._cluster_of),
                                    outs, metas_host, batch, t0sm=t0)
+        if jr is not None:
+            jr.emit_ms = (time.perf_counter() - t_e) * 1000.0
+            jr.finish(self.app_context, tuple(m.name for m in members))
         if fatal is not None:
             # surfaced AFTER every member emitted: the junction's
             # handle_error stores it so later sends re-raise, exactly as
@@ -471,9 +488,16 @@ class FusedFanoutRuntime(Receiver):
         with self._lock, contextlib.ExitStack() as stack:
             for m in entry.members:
                 stack.enter_context(m._lock)
-            return self._emit_members(entry.members, entry.cluster_of,
-                                      entry.outs, np.asarray(metas_host),
-                                      entry.batch, t0sm=None)
+            jr = entry.journey
+            t_e = time.perf_counter() if jr is not None else None
+            fatal = self._emit_members(entry.members, entry.cluster_of,
+                                       entry.outs, np.asarray(metas_host),
+                                       entry.batch, t0sm=None)
+            if jr is not None:
+                jr.emit_ms = (time.perf_counter() - t_e) * 1000.0
+                jr.finish(self.app_context,
+                          tuple(m.name for m in entry.members))
+            return fatal
 
     def _emit_members(self, members, cluster_of, outs, metas_host, batch,
                       t0sm) -> Optional[Exception]:

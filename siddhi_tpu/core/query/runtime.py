@@ -13,6 +13,7 @@ back to Events for rate limiting and callbacks.
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -22,6 +23,7 @@ import numpy as np
 
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, LazyColumns, StringDictionary
+from siddhi_tpu.observability import journey
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver, StreamJunction
@@ -176,6 +178,8 @@ class QueryRuntime(Receiver):
         #                             process (completion-latency feedback)
         self._cur_fault_batch = None  # input batch retained for drain-time
         #                               fault-stream routing (@OnError)
+        self._cur_journey = None    # batch-journey context of the batch in
+        #                             process (observability/journey.py)
         self.on_error: Optional[Callable] = None
 
     # ---------------------------------------------------------------- state
@@ -550,6 +554,12 @@ class QueryRuntime(Receiver):
             self._cur_fault_batch = batch if (
                 j is not None and j.on_error_action == "STREAM"
                 and j.fault_junction is not None) else None
+            # batch-journey: fork the pack stamp, open the dispatch
+            # stage (host prep + step dispatch); _finish_device_batch
+            # consumes it (one journey per delivered batch — routed
+            # splits ride the first piece)
+            self._cur_journey = journey.begin(batch) \
+                if journey.enabled() else None
             notify_host = None
             if self.log_stages:
                 self._run_log_taps(batch)
@@ -738,6 +748,8 @@ class QueryRuntime(Receiver):
 
         sm = self.app_context.statistics_manager
         t0 = latency_t0(sm)
+        jr = self._cur_journey
+        self._cur_journey = None
         now = np.int64(self._now())
         if isinstance(cols, LazyColumns):
             cols = dict(cols)   # jit boundary: raw (possibly device) arrays
@@ -765,10 +777,13 @@ class QueryRuntime(Receiver):
                 from siddhi_tpu.core.query.completion import QueryCompletion
 
                 record_elapsed_ms(sm, self.name, t0)
+                if jr is not None:
+                    jr.end_dispatch()   # device/emit stages close at drain
                 pump.submit(QueryCompletion(
                     self, out_host, overflow_msg,
                     junction=self._cur_junction,
-                    batch=getattr(self, "_cur_fault_batch", None)))
+                    batch=getattr(self, "_cur_fault_batch", None),
+                    journey=jr))
                 return None
             defer = getattr(self.app_context, "defer_meta", 1)
             if defer > 1 and self._defer_ok:
@@ -776,12 +791,27 @@ class QueryRuntime(Receiver):
                 # output; emission + overflow surfacing lag <= N batches
                 # (dispatch-side latency only — emission is deferred)
                 record_elapsed_ms(sm, self.name, t0)
+                if jr is not None:
+                    # legacy hold-N path: the deferred drain is not
+                    # instrumented — finish with the stages observed so
+                    # far (pack/queue/dispatch) rather than vanishing
+                    jr.end_dispatch()
+                    jr.finish(self.app_context, (self.name,))
                 self._deferred.append((out_host, overflow_msg))
                 if len(self._deferred) < defer:
                     return None
                 return self.flush_deferred()
             dict.pop(out_host, "__meta__")
-            meta = self._pull_meta(meta)
+            if jr is not None:
+                # synchronous device stage: the ride is ~0 (we pull
+                # immediately), so device service is the blocking pull
+                jr.end_dispatch()
+                jr.pre_drain(journey.ready_of(meta))
+                _tp = time.perf_counter()
+                meta = self._pull_meta(meta)
+                jr.drained((time.perf_counter() - _tp) * 1000.0)
+            else:
+                meta = self._pull_meta(meta)
             self._routed_meta_check(meta)
             overflow = int(meta[0])
             notify = int(meta[1])
@@ -794,7 +824,7 @@ class QueryRuntime(Receiver):
                 raise FatalQueryError(
                     f"query '{self.name}': {msg} before creating the runtime")
             record_elapsed_ms(sm, self.name, t0)
-            self._emit(HostBatch(out_host, size=size_hint))
+            self._timed_emit(HostBatch(out_host, size=size_hint), jr)
             if notify >= 0:
                 return notify
             return None
@@ -807,10 +837,26 @@ class QueryRuntime(Receiver):
             )
         notify = out_host.pop("__notify__", None)
         record_elapsed_ms(sm, self.name, t0)
-        self._emit(HostBatch(out_host))
+        if jr is not None:
+            jr.end_dispatch()   # host-window path: no device meta stage
+        self._timed_emit(HostBatch(out_host), jr)
         if notify is not None and int(notify) >= 0:
             return int(notify)
         return None
+
+    def _timed_emit(self, out: HostBatch, jr) -> None:
+        """``_emit`` with the journey's emit stage timed and the journey
+        finished (histograms + ring) — the synchronous tail; pipelined
+        batches run the same accounting at drain (completion.py)."""
+        if jr is None:
+            self._emit(out)
+            return
+        t_e = time.perf_counter()
+        try:
+            self._emit(out)
+        finally:
+            jr.emit_ms = (time.perf_counter() - t_e) * 1000.0
+            jr.finish(self.app_context, (self.name,))
 
     def _pull_meta(self, meta):
         """Pull the packed meta array; on a multi-process mesh with

@@ -20,6 +20,7 @@ import traceback
 from typing import List, Optional
 
 from siddhi_tpu.core.event import Event
+from siddhi_tpu.observability import journey
 from siddhi_tpu.observability.tracing import span
 from siddhi_tpu.query_api.definitions import StreamDefinition
 
@@ -101,6 +102,10 @@ class StreamJunction:
         # discarded (replay must cover exactly the non-shed suffix).
         # Empty unless the app registered quotas AND runs a WAL.
         self._wal_seq_of: dict = {}
+        # batch-journey tracing (observability/journey.py): queued unit
+        # id -> enqueue perf_counter, so the worker can attribute the
+        # @Async queue residence. Empty unless journeys are enabled.
+        self._jt_enq: dict = {}
 
     def subscribe(self, receiver: Receiver):
         if receiver not in self.receivers:
@@ -287,6 +292,24 @@ class StreamJunction:
             # mapped BEFORE the put: once queued, the worker (or a
             # shed_oldest eviction) may pop it at any moment
             self._wal_seq_of[id(item)] = wal_seq
+        if journey.enabled():
+            # queue-residence stamp (same before-the-put discipline).
+            # Units evicted by shed_oldest leave stale stamps behind; at
+            # most qsize stamps can be LIVE, so past that bound the
+            # OLDEST surplus is stale (insertion-ordered dict) — evict
+            # exactly it, never the live backlog's stamps (wiping those
+            # would blind queue attribution during the very overload
+            # episode being diagnosed)
+            live_cap = (self._queue.maxsize or 8192) + 256
+            while len(self._jt_enq) > live_cap:
+                try:
+                    # concurrent producers race this unlocked dict: pop
+                    # tolerates losing the key, and a torn iterator just
+                    # retries on the next enqueue
+                    self._jt_enq.pop(next(iter(self._jt_enq)), None)
+                except (StopIteration, RuntimeError):
+                    break
+            self._jt_enq[id(item)] = time.perf_counter()
         try:
             self._queue.put_nowait(item)
             return
@@ -306,6 +329,7 @@ class StreamJunction:
                 pass
             if self._fatal is not None:
                 self._wal_seq_of.pop(id(item), None)
+                self._jt_enq.pop(id(item), None)
                 raise self._fatal
             waited += BLOCK_PUT_SLICE_S
             if waited >= timeout_s:
@@ -337,13 +361,17 @@ class StreamJunction:
             "consumer is not draining (wedged worker? attach "
             "rt.supervise() to auto-replace it)", self.definition.id)
 
-    def _deliver_batch(self, batch):
+    def _deliver_batch(self, batch, enq_t=None):
         from siddhi_tpu.core.event import HostBatch, LazyColumns
 
         with span("junction.dispatch", stream=self.definition.id,
                   rows=int(batch._size) if batch._size is not None else -1):
             prev = current_delivering_junction()
             _DELIVERING.junction = self
+            jt = journey.enabled()
+            # queue-residence scope: receivers of THIS delivery read it;
+            # nested sync deliveries (emit cascades) mask it (journey.py)
+            prev_q = journey.push_delivery_queue_wait(enq_t) if jt else None
             try:
                 for r in self.receivers:
                     # receivers mutate batch.cols in place (filters, key
@@ -351,13 +379,18 @@ class StreamJunction:
                     # leak across; LazyColumns keeps device-held outputs
                     # unpulled until read
                     try:
-                        r.receive_batch(
-                            HostBatch(LazyColumns(batch.cols),
-                                      size=batch._size), self)
+                        sub = HostBatch(LazyColumns(batch.cols),
+                                        size=batch._size)
+                        # pack stamp rides the re-wrap; each receiver
+                        # forks its own journey (journey.begin)
+                        sub.journey = batch.journey
+                        r.receive_batch(sub, self)
                     except Exception as e:  # noqa: BLE001 — fault routing
                         self.handle_error(self.decode_events(batch), e)
             finally:
                 _DELIVERING.junction = prev
+                if jt:
+                    journey.pop_delivery_queue_wait(prev_q)
 
     def _adapt(self, elapsed_ms: float):
         """Latency-target control loop: EWMA the delivery latency, shrink
@@ -388,7 +421,7 @@ class StreamJunction:
         pump = getattr(self.app_context, "completion_pump", None)
         return pump.submits_of(self) if pump is not None else 0
 
-    def _timed_deliver(self, events: List[Event]):
+    def _timed_deliver(self, events: List[Event], enq_t=None):
         ctl = getattr(self.app_context, "overload", None)
         if ctl is not None:
             # weighted fair scheduling (resilience/overload.py): a worker
@@ -398,14 +431,14 @@ class StreamJunction:
             ctl.throttle(len(events))
         t0 = time.perf_counter()
         n0 = self._pump_submits()
-        self._deliver(events)
+        self._deliver(events, enq_t)
         if self._pump_submits() == n0:
             # pipelined deliveries return at dispatch; their near-zero
             # slice must not feed the latency loop — record_completion
             # supplies the TRUE sample at drain instead
             self._adapt((time.perf_counter() - t0) * 1000.0)
 
-    def _timed_deliver_batch(self, batch):
+    def _timed_deliver_batch(self, batch, enq_t=None):
         # columnar unit variant of _timed_deliver — same pipelined-skip
         # and fair-throttle rules; the two must stay in lock-step
         ctl = getattr(self.app_context, "overload", None)
@@ -414,7 +447,7 @@ class StreamJunction:
             ctl.throttle(int(n) if n is not None else 1)
         t0 = time.perf_counter()
         n0 = self._pump_submits()
-        self._deliver_batch(batch)
+        self._deliver_batch(batch, enq_t)
         if self._pump_submits() == n0:
             self._adapt((time.perf_counter() - t0) * 1000.0)
 
@@ -450,6 +483,7 @@ class StreamJunction:
                     continue
                 item = self._inflight    # predecessor died mid-delivery
                 self._inflight_owner = threading.current_thread()
+                enq_t = None             # stamp went with the predecessor
             else:
                 try:
                     item = self._queue.get(timeout=_IDLE_POLL_S)
@@ -457,6 +491,8 @@ class StreamJunction:
                         # dequeued for delivery: its WAL record is now
                         # "will be processed" — drop the shed handle
                         self._wal_seq_of.pop(id(item), None)
+                    enq_t = (self._jt_enq.pop(id(item), None)
+                             if self._jt_enq else None)
                 except queue.Empty:
                     # idle: drain any batches still riding the pipeline —
                     # bounds emission lag under trickle load to one idle
@@ -481,7 +517,7 @@ class StreamJunction:
                 # latency.target shape only the event-path coalescing),
                 # but its delivery latency still feeds the adaptive loop
                 # (unless it pipelined — see _timed_deliver)
-                self._timed_deliver_batch(item)
+                self._timed_deliver_batch(item, enq_t)
                 self._inflight = _NOTHING
                 if self._queue.empty():
                     self._flush_pipeline()
@@ -492,6 +528,7 @@ class StreamJunction:
                         if self._max_delay_s is not None else None)
             stop_after = False
             follow = None            # HostBatch that broke the coalesce
+            follow_enq = None
             # re-batch pending chunks up to the (adaptive) cap; a partial
             # batch waits at most max.delay for more
             while len(batch) < self._cur_batch:
@@ -515,31 +552,38 @@ class StreamJunction:
                     continue
                 if self._wal_seq_of:
                     self._wal_seq_of.pop(id(more), None)
+                more_enq = (self._jt_enq.pop(id(more), None)
+                            if self._jt_enq else None)
                 if more is None:
                     stop_after = True
                     break
                 if not isinstance(more, list):
                     follow = more
+                    follow_enq = more_enq
                     break
                 batch.extend(more)
             if gen != self._gen and follow is None and not stop_after:
                 return   # superseded while coalescing: the (possibly
                 #          grown) batch stays parked for the replacement
-            self._timed_deliver(batch)
+            # coalesced extras keep the FIRST chunk's enqueue stamp — the
+            # longest (and attribution-relevant) residence of the unit
+            self._timed_deliver(batch, enq_t)
             if follow is not None:
                 self._inflight = follow
-                self._timed_deliver_batch(follow)
+                self._timed_deliver_batch(follow, follow_enq)
             self._inflight = _NOTHING
             if stop_after or self._queue.empty():
                 self._flush_pipeline()
             if stop_after:
                 return
 
-    def _deliver(self, events: List[Event]):
+    def _deliver(self, events: List[Event], enq_t=None):
         with span("junction.dispatch", stream=self.definition.id,
                   rows=len(events)):
             prev = current_delivering_junction()
             _DELIVERING.junction = self
+            jt = journey.enabled()
+            prev_q = journey.push_delivery_queue_wait(enq_t) if jt else None
             try:
                 for r in self.receivers:
                     try:
@@ -548,6 +592,8 @@ class StreamJunction:
                         self.handle_error(events, e)
             finally:
                 _DELIVERING.junction = prev
+                if jt:
+                    journey.pop_delivery_queue_wait(prev_q)
 
     def handle_error(self, events: List[Event], e: Exception):
         from siddhi_tpu.ops.expressions import CompileError

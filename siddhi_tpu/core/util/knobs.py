@@ -104,6 +104,13 @@ KNOBS: Dict[str, Knob] = _declare(
     # booleans (each previously had its own — or no — spelling parser)
     Knob("join_partition_grow", "bool", attr="join_partition_grow"),
     Knob("fuse_fanout", "bool", attr="fuse_fanout"),
+    # critical-path profiler (observability/journey.py, costmodel.py):
+    # both flip PROCESS-wide collectors (refcounted per app runtime) —
+    # journeys trace every batch's stage times, costs capture each
+    # program's XLA cost/memory analysis at first compile (one extra
+    # AOT compile per program). Defaults off; see MIGRATION.md.
+    Knob("profile_journeys", "bool", attr="profile_journeys"),
+    Knob("profile_costs", "bool", attr="profile_costs"),
     # floats
     Knob("cluster_step_timeout", "float", attr="cluster_step_timeout"),
     # enums

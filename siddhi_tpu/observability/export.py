@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import math
 import re
+import time
 from typing import Dict, List, Tuple
 
 from siddhi_tpu.observability.telemetry import global_registry
@@ -48,6 +49,11 @@ TELEMETRY_PREFIXES = (
     "wal",           # ingest-WAL size gauges
     "cluster",       # bounded-pull probe (process registry)
     "resilience",    # StatisticsManager recovery counters (stat_count)
+    "stage",         # batch-journey per-stage service/queue histograms
+                     # (observability/journey.py -> siddhi_stage_*)
+    "jitcost",       # compiled-program cost gauges
+                     # (observability/costmodel.py -> siddhi_jit_cost_*)
+    "scrape",        # /metrics self-timing (siddhi_scrape_ms)
 )
 # Gauge templates that live exactly as long as their registry does —
 # per-app gauges die with the app's TelemetryRegistry at shutdown, the
@@ -64,6 +70,8 @@ PROCESS_LIFETIME_GAUGES = (
     "shard.rows.*",         # app + process registry (legacy host-router
                             # scope "host" is a deprecated shim)
     "cluster.outstanding_pulls",  # process registry, process-lifetime
+    "jitcost.*",            # process registry — a compiled program's
+                            # cost record outlives any single app
 )
 # ---------------------------------------------------------------------
 
@@ -136,6 +144,33 @@ _SHARD_EXCHANGE_HIST = re.compile(r"^shard\.exchange_ms\.(?P<scope>.+)$")
 _JOIN_PART_ROWS = re.compile(r"^join\.partition_rows\.(?P<query>.+)"
                              r"\.(?P<side>left|right)\.(?P<part>\d+)$")
 _JOIN_HIST = re.compile(r"^join\.(?P<kind>probe|insert)_ms\.(?P<query>.+)$")
+# critical-path profiler (observability/journey.py): per-query per-stage
+# service-time and queueing-time histograms of the batch journey
+_STAGE_HIST = re.compile(r"^stage\.(?P<query>.+)\.(?P<stage>[a-z_]+)"
+                         r"\.(?P<kind>service|queue)_ms$")
+# compiled-program cost registry (observability/costmodel.py): one gauge
+# per (jit key, metric) on the process registry
+_JITCOST_GAUGE = re.compile(
+    r"^jitcost\.(?P<key>.+)\.(?P<metric>flops|bytes_accessed|arg_bytes|"
+    r"out_bytes|temp_bytes|code_bytes|compile_ms)$")
+_JITCOST_HELP = {
+    "flops": ("siddhi_jit_cost_flops",
+              "XLA cost analysis: floating-point ops per execution of "
+              "the compiled program"),
+    "bytes_accessed": ("siddhi_jit_cost_bytes_accessed",
+                       "XLA cost analysis: bytes read+written per "
+                       "execution"),
+    "arg_bytes": ("siddhi_jit_cost_arg_bytes",
+                  "compiled-program argument buffer bytes"),
+    "out_bytes": ("siddhi_jit_cost_out_bytes",
+                  "compiled-program output buffer bytes"),
+    "temp_bytes": ("siddhi_jit_cost_temp_bytes",
+                   "compiled-program temp (scratch) buffer bytes"),
+    "code_bytes": ("siddhi_jit_cost_code_bytes",
+                   "generated code size in bytes"),
+    "compile_ms": ("siddhi_jit_cost_compile_ms",
+                   "ahead-of-time capture compile wall ms"),
+}
 _SERVING_COUNTER_FAMILY = {
     "serving.queries": ("siddhi_serving_queries_total",
                         "on-demand queries admitted by the serving tier"),
@@ -156,8 +191,18 @@ _SERVING_HIST_FAMILY = {
 
 
 def _esc(v: str) -> str:
+    """Label-VALUE escaping per the text-format spec: backslash, double
+    quote, and line feed (stream/app/query names are user-controlled
+    SiddhiQL identifiers — a hostile name must not break the sample
+    grammar or inject bogus series)."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
         "\n", "\\n")
+
+
+def _esc_help(v: str) -> str:
+    """HELP-text escaping per the spec: backslash and line feed only
+    (quotes are legal in HELP)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(v) -> str:
@@ -189,7 +234,7 @@ class _Families:
         lines = []
         for family in sorted(self._fam):
             ftype, help_, samples = self._fam[family]
-            lines.append(f"# HELP {family} {help_}")
+            lines.append(f"# HELP {family} {_esc_help(help_)}")
             lines.append(f"# TYPE {family} {ftype}")
             lines.extend(samples)
         return "\n".join(lines) + "\n"
@@ -220,11 +265,24 @@ def app_snapshot(rt) -> dict:
 
 
 def json_snapshot(manager) -> dict:
-    return {
-        "apps": {name: app_snapshot(rt)
-                 for name, rt in sorted(manager.app_runtimes.items())},
-        "process": global_registry().snapshot(),
-    }
+    t0 = time.perf_counter()
+    try:
+        return {
+            "apps": {name: app_snapshot(rt)
+                     for name, rt in sorted(manager.app_runtimes.items())},
+            "process": global_registry().snapshot(),
+        }
+    finally:
+        _record_scrape_ms(t0)
+
+
+def _record_scrape_ms(t0: float) -> None:
+    """Scrape self-timing (``siddhi_scrape_ms``): the duration lands in
+    the process registry AFTER the snapshot is taken, so each scrape
+    reports its predecessors — a scrape crossing its SLO is visible on
+    the dashboard scraping it."""
+    global_registry().histogram("scrape.ms").record(
+        (time.perf_counter() - t0) * 1000.0)
 
 
 def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
@@ -292,6 +350,11 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                              "fraction of the app's overload quota in "
                              "use (queue depth / pipeline entries / "
                              "device-memory budget)", labels, v)
+                elif _JITCOST_GAUGE.match(name):
+                    m = _JITCOST_GAUGE.match(name)
+                    family, help_ = _JITCOST_HELP[m.group("metric")]
+                    fams.add(family, "gauge", help_,
+                             {**base, "key": m.group("key")}, v)
                 elif name in ("serving.pool.pending", "serving.pool.active"):
                     kind = name.rsplit(".", 1)[1]
                     fams.add(f"siddhi_serving_pool_{kind}", "gauge",
@@ -369,6 +432,22 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                     else "probe dispatch+finish time per join side "
                          "batch (ms)")
                 labels["query"] = m.group("query")
+            elif _STAGE_HIST.match(name):
+                m = _STAGE_HIST.match(name)
+                if m.group("kind") == "service":
+                    family = "siddhi_stage_ms"
+                    help_ = ("batch-journey per-stage service time (ms) "
+                             "— see observability/journey.py stage "
+                             "glossary")
+                else:
+                    family = "siddhi_stage_queue_ms"
+                    help_ = ("batch-journey per-stage queueing/slack "
+                             "time (ms)")
+                labels["query"] = m.group("query")
+                labels["stage"] = m.group("stage")
+            elif name == "scrape.ms":
+                family = "siddhi_scrape_ms"
+                help_ = "/metrics scrape self-timing (ms)"
             else:
                 m = _SERVING_QUERY_HIST.match(name)
                 if m:
@@ -441,17 +520,25 @@ def _add_statistics(fams: _Families, rt):
 
 def prometheus_text(manager, app_name=None) -> str:
     """Prometheus text exposition for every app (or one app) plus the
-    process-global telemetry."""
-    fams = _Families()
-    runtimes = manager.app_runtimes
-    if app_name is not None:
-        rt = runtimes.get(app_name)
-        if rt is None:
-            raise KeyError(f"app '{app_name}' is not deployed")
-        runtimes = {app_name: rt}
-    for name in sorted(runtimes):
-        rt = runtimes[name]
-        _add_statistics(fams, rt)
-        _add_telemetry(fams, rt.app_context.telemetry.snapshot(), name)
-    _add_telemetry(fams, global_registry().snapshot(), "")
-    return fams.render()
+    process-global telemetry. Scrape hygiene: this function takes NO app
+    barrier and makes no device pulls beyond registered gauges (which
+    are themselves cached or host-side — a wedged worker or a busy app
+    must never stall a scrape), and times itself into
+    ``siddhi_scrape_ms``."""
+    t0 = time.perf_counter()
+    try:
+        fams = _Families()
+        runtimes = manager.app_runtimes
+        if app_name is not None:
+            rt = runtimes.get(app_name)
+            if rt is None:
+                raise KeyError(f"app '{app_name}' is not deployed")
+            runtimes = {app_name: rt}
+        for name in sorted(runtimes):
+            rt = runtimes[name]
+            _add_statistics(fams, rt)
+            _add_telemetry(fams, rt.app_context.telemetry.snapshot(), name)
+        _add_telemetry(fams, global_registry().snapshot(), "")
+        return fams.render()
+    finally:
+        _record_scrape_ms(t0)

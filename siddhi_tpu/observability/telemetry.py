@@ -67,6 +67,15 @@ class InstrumentedJit:
             return self._fn(*args)
         from siddhi_tpu.observability.tracing import span
 
+        if not self._compiled:
+            from siddhi_tpu.observability import costmodel
+
+            if costmodel.enabled():
+                # cost-registry capture (fingerprint + cost/memory
+                # analysis) runs BEFORE the first call: the step jits
+                # donate their state argument, and tracing after the
+                # call would read deleted buffers
+                costmodel.registry().capture(self._key, self._fn, args)
         t0 = time.perf_counter()
         with span("jit", key=self._key):
             out = self._fn(*args)

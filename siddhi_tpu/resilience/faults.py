@@ -35,6 +35,7 @@ class FaultInjector:
         self._patched_sinks = []          # (sink, original_publish)
         self._peer_fault_armed = False
         self._flood_threads = []          # non-blocking flood producers
+        self._delayed_junctions = []      # persistent delay_worker targets
 
     # ------------------------------------------------- junction workers
 
@@ -73,16 +74,38 @@ class FaultInjector:
         """Wake every worker currently blocked in a wedge hook."""
         self._wedge_release.set()
 
-    def delay_worker(self, junction, seconds: float) -> None:
-        """Arm a one-shot delivery delay (a slow device step seen from the
-        junction's side): the next drain iteration sleeps ``seconds``."""
+    def delay_worker(self, junction, seconds: float,
+                     persistent: bool = False) -> None:
+        """Arm a delivery delay (a slow device step seen from the
+        junction's side): the next drain iteration sleeps ``seconds``.
+        ``persistent=True`` keeps the delay armed on EVERY iteration —
+        the deterministic way to make the @Async queue the bottleneck
+        (the critical-path profiler's queue-attribution tests plant
+        exactly this); disarmed by :meth:`clear`."""
         import time
 
-        def hook(j):
-            j.fault_hook = None
-            time.sleep(seconds)
+        if persistent:
+            def hook(j):
+                time.sleep(seconds)
+
+            self._delayed_junctions.append(junction)
+        else:
+            def hook(j):
+                j.fault_hook = None
+                time.sleep(seconds)
 
         junction.fault_hook = hook
+
+    def delay_stage(self, stage: str, seconds: float) -> None:
+        """Plant a persistent service delay inside an instrumented
+        batch-journey stage (``observability/journey.py`` — ``'pack'``
+        today): every ``HostBatch`` pack sleeps ``seconds`` while
+        journey tracing is enabled, making that stage the known
+        bottleneck the critical-path report must name. Disarmed by
+        :meth:`clear`."""
+        from siddhi_tpu.observability import journey
+
+        journey.inject_delay(stage, seconds)
 
     def flood_stream(self, junction, ratio: float = 10.0,
                      base_events: Optional[int] = None,
@@ -208,3 +231,9 @@ class FaultInjector:
         for t in self._flood_threads:
             t.join(timeout=10)
         self._flood_threads.clear()
+        for j in self._delayed_junctions:
+            j.fault_hook = None
+        self._delayed_junctions.clear()
+        from siddhi_tpu.observability import journey
+
+        journey.clear_delays()

@@ -34,6 +34,18 @@ Observability (``siddhi_tpu/observability/``):
 
 (The per-app ``POST /apps/<name>/trace`` endpoint remains the XLA device
 profiler; ``/trace/*`` is the host-side span timeline.)
+
+Critical-path profiler (``observability/journey.py`` + ``costmodel.py``):
+
+- ``GET  /profile/critical_path[/{app}]`` — per-query per-stage
+  service/queueing report naming the bottleneck stage (rendered by
+  ``tools/critical_path.py``)
+- ``GET  /programs``                   — compiled-program cost registry
+  (cost/memory analysis + jaxpr-fingerprint duplicate clusters)
+- ``POST /profile/journeys/start|stop``— batch-journey tracing on/off
+- ``POST /profile/costs/start|stop``   — program cost capture on/off
+- ``POST /profile/device/start|stop``  — process-level XLA profiler
+  trace, confined under the trace base like ``/trace``
 """
 
 from __future__ import annotations
@@ -115,6 +127,7 @@ class SiddhiRestService:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+        self._device_tracing: Optional[str] = None  # active profile dir
 
     # ----------------------------------------------------------- lifecycle
 
@@ -153,6 +166,25 @@ class SiddhiRestService:
             return
         if len(parts) == 3 and parts[0] == "apps" and parts[2] == "statistics":
             h._send(200, self._rt(parts[1]).statistics())
+            return
+        if parts == ["programs"]:
+            # compiled-program cost registry (observability/costmodel.py):
+            # every captured program with fingerprint-duplicate clusters —
+            # the before-picture for a process-wide compiled-program cache
+            from siddhi_tpu.observability import costmodel
+
+            h._send(200, costmodel.registry().snapshot())
+            return
+        if (len(parts) in (2, 3) and parts[0] == "profile"
+                and parts[1] == "critical_path"):
+            from siddhi_tpu.observability import journey
+
+            app = parts[2] if len(parts) == 3 else None
+            if app is not None and self.manager.get_siddhi_app_runtime(
+                    app) is None:
+                h._send(404, {"error": f"app '{app}' is not deployed"})
+                return
+            h._send(200, journey.critical_path_report(self.manager, app))
             return
         if parts and parts[0] == "metrics" and len(parts) <= 2:
             from siddhi_tpu.observability import export
@@ -216,6 +248,9 @@ class SiddhiRestService:
                 return
             events = fut.result()
             h._send(200, {"rows": [list(e.data) for e in events]})
+            return
+        if len(parts) == 3 and parts[0] == "profile":
+            self._post_profile(h, parts[1], parts[2], body)
             return
         if parts == ["trace", "start"]:
             from siddhi_tpu.observability.tracing import TRACER
@@ -308,6 +343,64 @@ class SiddhiRestService:
                     rev = rt.restore_last_revision()
                 h._send(200, {"revision": rev})
                 return
+        h._send(404, {"error": f"unknown path {h.path}"})
+
+    def _post_profile(self, h, what: str, action: str, body):
+        """``POST /profile/{journeys|costs|device}/{start|stop}`` — the
+        critical-path profiler's runtime switches. ``device`` wraps the
+        process-level XLA profiler (``jax.profiler.start_trace``); its
+        output directory is confined under ``trace_base`` exactly like
+        the ``/trace`` endpoints."""
+        if action not in ("start", "stop"):
+            h._send(404, {"error": f"unknown path {h.path}"})
+            return
+        if what == "journeys":
+            from siddhi_tpu.observability import journey
+
+            if action == "start":
+                cap = body.get("capacity") if isinstance(body, dict) else None
+                journey.enable(ring_capacity=int(cap) if cap else None)
+            else:
+                journey.disable()
+            h._send(200, {"journeys": journey.enabled()})
+            return
+        if what == "costs":
+            from siddhi_tpu.observability import costmodel
+
+            if action == "start":
+                costmodel.enable()
+            else:
+                costmodel.disable()
+            h._send(200, {"costs": costmodel.enabled(),
+                          "programs": len(costmodel.registry().programs())})
+            return
+        if what == "device":
+            import jax
+
+            if action == "start":
+                if self._device_tracing:
+                    h._send(409, {"error": "a device profile is already "
+                                           "running"})
+                    return
+                name = (body.get("dir") if isinstance(body, dict)
+                        else None) or "device_profile"
+                base = os.path.realpath(self.trace_base)
+                target = os.path.realpath(os.path.join(base, name))
+                if target != base and not target.startswith(base + os.sep):
+                    h._send(400, {"error": "profile dir escapes the "
+                                           "configured trace base"})
+                    return
+                jax.profiler.start_trace(target)
+                self._device_tracing = target
+                h._send(200, {"device_profile": target})
+            else:
+                if not self._device_tracing:
+                    h._send(409, {"error": "no device profile is running"})
+                    return
+                jax.profiler.stop_trace()
+                target, self._device_tracing = self._device_tracing, None
+                h._send(200, {"device_profile": None, "dir": target})
+            return
         h._send(404, {"error": f"unknown path {h.path}"})
 
     def _delete(self, h):

@@ -1,0 +1,13 @@
+"""Known-bad R3 fixture for the critical-path profiler families: a
+``siddhi_stage_ms`` family literal outside export.py, and a ``stage.*``
+GAUGE with no unregister path (journey.py itself registers only
+histograms, which are exempt from the remove pairing — a gauge under
+the prefix must pair or be declared process-lifetime)."""
+
+
+def register(tel, query):
+    # gauge under the declared 'stage' prefix, never removed and not in
+    # PROCESS_LIFETIME_GAUGES
+    tel.gauge(f"stage.{query}.dispatch.last_ms", lambda: 0.0)
+    # family literal outside export.py
+    return "siddhi_stage_ms"

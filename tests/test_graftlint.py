@@ -24,6 +24,7 @@ FIXTURE_PATHS = {
     "r1_backend_init.py": "siddhi_tpu/parallel/bad_backend.py",
     "r2_adhoc_knob.py": "siddhi_tpu/core/bad_knobs.py",
     "r3_metric_family.py": "siddhi_tpu/observability/bad_metrics.py",
+    "r3_stage_family.py": "siddhi_tpu/observability/bad_stage_metrics.py",
     "r4_lock_order.py": "siddhi_tpu/core/query/bad_locks.py",
     "r5_host_pull.py": "siddhi_tpu/core/query/bad_steps.py",
 }
@@ -50,6 +51,9 @@ def _lint_fixture(name: str):
     ("r1_backend_init.py", "R1", 3),   # module const, jax.devices, default
     ("r2_adhoc_knob.py", "R2", 3),     # f-string key, literal key, env var
     ("r3_metric_family.py", "R3", 3),  # prefix x2 + family literal
+    # critical-path profiler families (stage.* / siddhi_stage_ms):
+    # unremoved gauge under the new prefix + family literal
+    ("r3_stage_family.py", "R3", 2),
     ("r4_lock_order.py", "R4", 2),     # pump->owner and owner->barrier
     ("r5_host_pull.py", "R5", 4),      # float, .item, np.asarray, bool
 ])

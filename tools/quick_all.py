@@ -28,6 +28,9 @@ CHECKS = {
     "join": ("quick_join_check.py", 300, (), {}),
     "agg": ("quick_agg_check.py", 300, (), {}),
     "hlo": ("hlo_audit.py", 300, (), {}),
+    # critical-path profiler: bit-identity with FULL profiling on
+    # (journeys + cost capture + tracer + detail stats) + report sanity
+    "obs": ("quick_obs_check.py", 300, (), {}),
     # the sanitized pass: the fast bit-identity subset re-run with every
     # runtime sanitizer armed (transfer guard, recompile watchdog,
     # lock-order assertions — siddhi_tpu/analysis/sanitize.py). For the

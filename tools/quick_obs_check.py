@@ -1,0 +1,136 @@
+"""Quick check: FULL critical-path profiling on == profiling off,
+bit-identically, plus report/registry sanity. ~5 s.
+
+Runs the same deterministic input sequence through two fresh runtimes
+of a 2-query app (the fused fan-out path — the default engine shape):
+
+- run A: profiling OFF (the tier-1 default);
+- run B: journey tracing + program-cost capture + span tracer + DETAIL
+  statistics all enabled.
+
+Asserts the two output sequences are IDENTICAL (values and order — the
+profiler never touches jitted step code, so there is nothing it may
+change), that the critical-path report names a bottleneck with every
+expected stage populated, and that the cost registry captured every
+step program with consistent fingerprint-cluster arithmetic.
+
+Registered in ``tools/quick_all.py`` (name: ``obs``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+APP = """
+define stream S (sym string, v long);
+@info(name='q_sum')
+from S#window.length(32) select sym, sum(v) as total group by sym insert into OutA;
+@info(name='q_avg')
+from S#window.length(32) select sym, avg(v) as mean group by sym insert into OutB;
+"""
+
+BATCHES = 12
+ROWS = 64
+
+
+def _run(profiled: bool):
+    import numpy as np
+
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.observability import costmodel, journey
+    from siddhi_tpu.observability.tracing import TRACER
+
+    rows = {"OutA": [], "OutB": []}
+
+    class C(StreamCallback):
+        def __init__(self, key):
+            super().__init__()
+            self.key = key
+
+        def receive(self, events):
+            rows[self.key].extend(tuple(e.data) for e in events)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.add_callback("OutA", C("OutA"))
+    rt.add_callback("OutB", C("OutB"))
+    if profiled:
+        journey.enable()
+        costmodel.registry().reset()
+        costmodel.enable()
+        rt.set_statistics_level("detail")
+        TRACER.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(7)
+    sym = np.array([f"K{i}" for i in range(16)], dtype=object)
+    for b in range(BATCHES):
+        ids = rng.integers(0, 16, ROWS)
+        h.send_columns(
+            {"sym": sym[ids],
+             "v": rng.integers(1, 100, ROWS).astype(np.int64)},
+            timestamps=np.full(ROWS, b, np.int64))
+    report = journey.critical_path_report(m) if profiled else None
+    progs = costmodel.registry().snapshot() if profiled else None
+    spans = len(TRACER) if profiled else 0
+    if profiled:
+        TRACER.stop()
+        journey.disable()
+        costmodel.disable()
+    m.shutdown()
+    return rows, report, progs, spans, rt.name
+
+
+def main() -> int:
+    import gc
+
+    gc.disable()          # GC during jax tracing segfaults this build
+
+    base_rows, _, _, _, _ = _run(profiled=False)
+    prof_rows, report, progs, spans, app = _run(profiled=True)
+
+    assert prof_rows == base_rows, (
+        "profiling changed the outputs: "
+        f"A {len(base_rows['OutA'])}/{len(prof_rows['OutA'])} rows, "
+        f"B {len(base_rows['OutB'])}/{len(prof_rows['OutB'])} rows")
+    assert base_rows["OutA"] and base_rows["OutB"], "no outputs produced"
+
+    # report sanity: both queries profiled, every core stage populated,
+    # a bottleneck named from the glossary
+    queries = report["apps"][app]["queries"]
+    for q in ("q_sum", "q_avg"):
+        assert q in queries, f"query {q} missing from the report"
+        stages = queries[q]["stages"]
+        for stage in ("pack", "dispatch", "device", "emit"):
+            assert stages.get(stage, {}).get("batches", 0) > 0, \
+                f"{q}: stage '{stage}' recorded no batches"
+        b = queries[q]["bottleneck"]
+        assert b and b["stage"] in report["stage_glossary"], b
+    assert spans > 0, "span tracer recorded nothing"
+
+    # cost-registry sanity: the (fused) step program captured, analysis
+    # fields populated, cluster arithmetic consistent
+    assert progs["programs"], "cost registry captured no programs"
+    assert sum(c["size"] for c in progs["clusters"]) == len(
+        progs["programs"])
+    assert progs["unique_fingerprints"] == len(progs["clusters"])
+    step = [p for p in progs["programs"] if p["key"].endswith(".step")]
+    assert step, f"no step program captured: {progs['programs']}"
+    for p in step:
+        assert p["error"] is None, p
+        assert p["flops"] > 0 and p["bytes_accessed"] > 0, p
+        assert len(p["fingerprint"]) == 16, p
+
+    n = len(base_rows["OutA"]) + len(base_rows["OutB"])
+    print(f"quick_obs_check PASS: {BATCHES} batches x {ROWS} rows, "
+          f"{n} output rows bit-identical with full profiling on; "
+          f"{len(progs['programs'])} programs captured, "
+          f"{progs['duplicate_clusters']} duplicate cluster(s), "
+          f"{spans} spans")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
