@@ -87,10 +87,12 @@ class Rule:
 @dataclass
 class LintContext:
     modules: List[ModuleInfo]
-    # R3 declarations parsed out of observability/export.py (overridable
-    # by fixture tests)
+    # R3/R6 declarations parsed out of observability/export.py
+    # (overridable by fixture tests)
     telemetry_prefixes: Sequence[str] = ()
     unremoved_gauge_allow: Sequence[str] = ()
+    device_slots: Sequence[str] = ()
+    device_check_slots: Sequence[str] = ()
     export_path: str = "siddhi_tpu/observability/export.py"
 
     def module(self, suffix: str) -> Optional[ModuleInfo]:
@@ -135,26 +137,32 @@ def _parse_export_declarations(ctx: LintContext) -> None:
         tgt = node.targets[0]
         if not isinstance(tgt, ast.Name):
             continue
-        if tgt.id in ("TELEMETRY_PREFIXES", "PROCESS_LIFETIME_GAUGES"):
+        if tgt.id in ("TELEMETRY_PREFIXES", "PROCESS_LIFETIME_GAUGES",
+                      "DEVICE_SLOTS", "DEVICE_CHECK_SLOTS"):
             try:
                 val = tuple(ast.literal_eval(node.value))
             except (ValueError, SyntaxError):
                 continue
             if tgt.id == "TELEMETRY_PREFIXES":
                 ctx.telemetry_prefixes = val
-            else:
+            elif tgt.id == "PROCESS_LIFETIME_GAUGES":
                 ctx.unremoved_gauge_allow = val
+            elif tgt.id == "DEVICE_SLOTS":
+                ctx.device_slots = val
+            else:
+                ctx.device_check_slots = val
 
 
 def default_rules() -> List[Rule]:
     from siddhi_tpu.analysis.rules_backend import BackendInitRule
     from siddhi_tpu.analysis.rules_config import ConfigKnobRule
     from siddhi_tpu.analysis.rules_hotpath import HostPullRule
+    from siddhi_tpu.analysis.rules_instruments import InstrumentParityRule
     from siddhi_tpu.analysis.rules_locks import LockOrderRule
     from siddhi_tpu.analysis.rules_metrics import MetricParityRule
 
     return [BackendInitRule(), ConfigKnobRule(), MetricParityRule(),
-            LockOrderRule(), HostPullRule()]
+            LockOrderRule(), HostPullRule(), InstrumentParityRule()]
 
 
 def run_lint(modules: List[ModuleInfo],

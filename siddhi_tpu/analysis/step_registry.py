@@ -41,6 +41,19 @@ JIT_STEP_BUILDERS: Dict[str, Tuple[str, str]] = {
 }
 
 
+# Builders whose steps carry a device-instrument meta suffix
+# (observability/instruments.py): their hlo_audit functions must ALSO
+# assert the packed meta matches the runtime's declared
+# instrument_slots() spec — one module, zero extra transfers, lanes
+# accounted for. A builder gaining a suffix without joining this tuple
+# (or vice versa) fails the audit's coverage check.
+INSTRUMENTED_STEP_BUILDERS = (
+    "query_step",      # win_fill / groups lanes
+    "device_routed",   # route slots + aggregated inner lanes
+    "device_join",     # seq + per-partition fill lanes
+)
+
+
 def resolve(name: str):
     """Import and return the registered builder (audit-time sanity:
     a renamed/moved builder fails loudly, not silently unaudited)."""

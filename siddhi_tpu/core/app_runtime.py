@@ -108,6 +108,7 @@ class SiddhiAppRuntime:
         self._stream_callback_adapters: List = []
         self._started = False
         self._profiling_on = False  # holds one journey/costmodel enable
+        self._instruments_on = False  # holds one device-instruments enable
 
         # @app:playback (reference SiddhiAppParser.java:171-212): optional
         # idle.time + increment enable the idle heartbeat — when no event
@@ -902,6 +903,15 @@ class SiddhiAppRuntime:
                 if self.app_context.profile_costs:
                     costmodel.enable()
                 self._profiling_on = True
+            # device telemetry plane: default-on per-app knob holds one
+            # refcount on the process collector for the app's lifetime
+            # (same discipline as profile_journeys)
+            if (not self._instruments_on
+                    and self.app_context.profile_device_instruments):
+                from siddhi_tpu.observability import instruments
+
+                instruments.enable()
+                self._instruments_on = True
             for j in self.junctions.values():
                 j.start_processing()
             scheduler = self.app_context.scheduler
@@ -1102,6 +1112,11 @@ class SiddhiAppRuntime:
             if self.app_context.profile_costs:
                 costmodel.disable()
             self._profiling_on = False
+        if self._instruments_on:
+            from siddhi_tpu.observability import instruments
+
+            instruments.disable()
+            self._instruments_on = False
         self._started = False
 
     # ----------------------------------------------------- resilience API

@@ -213,6 +213,14 @@ class SiddhiAppContext:
         # process-wide without a config.
         self.profile_journeys = False
         self.profile_costs = False
+        # device telemetry plane (observability/instruments.py): jitted
+        # steps append declared instrument slots (window ring fill, join
+        # partition fill, NFA active runs, routed-row skew, distinct
+        # groups) behind the standard [overflow, notify, count] meta
+        # prefix — device truth per batch at ZERO extra host transfers.
+        # Default ON; 'false' keeps the pre-round-9 meta layouts
+        # bit-for-bit. Key siddhi_tpu.profile_device_instruments.
+        self.profile_device_instruments = True
         # serving tier (siddhi_tpu/serving/): >1 key-partitions every
         # incremental aggregation's bucket state across this many
         # in-process shards (round-robin over mesh devices) and answers

@@ -42,6 +42,7 @@ def attach_join_engine(rt, on_expr) -> None:
         on_expr, rt.sides["left"], rt.sides["right"], rt.dictionary) \
         if on_expr is not None else None
     rt.engine = DeviceJoinEngine(rt, pspec)
+    rt._instr_spec = None   # engine suffix (seq + fills) joins the spec
     _register_metrics(rt)
 
 
@@ -59,6 +60,10 @@ def _register_metrics(rt) -> None:
         if not plan.use_pidx:
             continue
         for p in range(eng.P):
+            # zero-pull gauge backend: partition_occupancy reads the
+            # last DRAINED fill.<side> instrument lanes (host ring
+            # mirror when instruments are off) — a scrape never touches
+            # device state (observability/instruments.py)
             tel.gauge(
                 f"join.partition_rows.{rt.name}.{side_key}.{p}",
                 lambda e=eng, s=side_key, i=p: float(

@@ -320,7 +320,11 @@ class DeviceJoinEngine:
         # members are a subset of its ring slots, which bounds the
         # directory pressure (see prepare_batch)
         self._mirror: Dict[str, dict] = {}
-        self._occ_cache: Dict[str, tuple] = {}   # per-side (t, [P] occ)
+        # per-side (total, [P] occ) memo of the mirror bincount: the P
+        # registered partition gauges each read one lane, and a scrape
+        # must not pay P ring passes (content-keyed, not time-keyed —
+        # exactness is preserved)
+        self._occ_memo: Dict[str, tuple] = {}
         self.plans: Dict[str, _SidePlan] = {
             k: _SidePlan(k, runtime.sides[k], pspec, self.P, self.slack)
             for k in ("left", "right")
@@ -346,31 +350,32 @@ class DeviceJoinEngine:
 
     def partition_occupancy(self, side_key: str) -> np.ndarray:
         """Live members per partition of one side ([P] int64) — the
-        ``siddhi_join_partition_rows`` gauge backend. Best-effort: a
-        donated/absent state reads as zeros. The vector is cached for a
-        beat so one metrics scrape costs ONE directory pull per side,
-        not one per registered partition gauge."""
+        ``siddhi_join_partition_rows`` gauge backend. ZERO device pulls
+        by construction (a /metrics scrape must never touch the device,
+        transfer-guard-verified): the primary source is the last drained
+        ``fill.<side>`` instrument lanes, which the step computes from
+        the directory it already holds and ships on the meta pull that
+        happens anyway (``observability/instruments.py``); with
+        instruments off (``profile_device_instruments: false``) the
+        host ring-occupancy mirror answers instead — exact for length
+        rings, an upper bound for time rings whose expired rows linger
+        in their slots until overwritten."""
         plan = self.plans[side_key]
         if not plan.use_pidx:
             return np.zeros(self.P, np.int64)
-        import time as _time
-
-        cached = self._occ_cache.get(side_key)
-        now = _time.monotonic()
-        if cached is not None and now - cached[0] < 0.25:
-            return cached[1]
-        try:
-            state = self.rt._state
-            pidx = state[plan.pidx_key]
-            win = state[plan.win_key]
-            gseq = np.asarray(pidx["gseq"])
-            floor = plan.live_floor_np(
-                {k: np.asarray(v) for k, v in win.items()
-                 if k in ("total", "expired_upto")})
-            occ = ((gseq >= floor) & (gseq >= 0)).sum(axis=1)
-        except Exception:  # noqa: BLE001 — scrape must never raise
-            occ = np.zeros(self.P, np.int64)
-        self._occ_cache[side_key] = (now, occ)
+        last = getattr(self.rt, "_instr_last", {}).get(f"fill.{side_key}")
+        if last is not None and np.asarray(last).shape[0] == self.P:
+            return np.asarray(last, np.int64)
+        mir = self._mirror.get(side_key)
+        if mir is None:
+            return np.zeros(self.P, np.int64)
+        memo = self._occ_memo.get(side_key)
+        if memo is not None and memo[0] == mir["total"]:
+            return memo[1]
+        ring = mir["ring"]
+        occ = np.bincount(ring[ring >= 0],
+                          minlength=self.P).astype(np.int64)[: self.P]
+        self._occ_memo[side_key] = (mir["total"], occ)
         return occ
 
     # ------------------------------------------------------ restore path
@@ -534,6 +539,25 @@ class DeviceJoinEngine:
         on_cond = rt.on_cond
         split = rt.keyer is not None
         P, slack = self.P, self.slack
+        # device instruments: with the knob on, the step also ships each
+        # partitioned side's per-partition directory fill behind the
+        # sequence lane — the layout JoinQueryRuntime._step_instrument_
+        # slots declares and the drain decodes (captured at build; the
+        # step cache is cleared whenever capacities change)
+        ins_on = rt._instruments_on()
+
+        def _meta_suffix(new_state, seq):
+            suffix = [seq.reshape(1)]
+            if ins_on:
+                for plan in (self.plans["left"], self.plans["right"]):
+                    if not plan.use_pidx:
+                        continue
+                    gseq = new_state[plan.pidx_key]["gseq"]
+                    floor = plan.live_floor(new_state[plan.win_key])
+                    suffix.append(jnp.sum(
+                        (gseq >= floor) & (gseq >= 0),
+                        axis=1, dtype=jnp.int64))
+            return suffix
 
         def _pidx_insert(pidx, cols, win_before, win_after):
             """Scatter this batch's inserted rows into the side's own
@@ -749,7 +773,7 @@ class DeviceJoinEngine:
                 joined["__overflow__"] = ovbits
                 out = pack_meta(joined)
                 out["__meta__"] = jnp.concatenate(
-                    [out["__meta__"], seq.reshape(1)])
+                    [out["__meta__"]] + _meta_suffix(new_state, seq))
                 return new_state, out
 
             new_state["sel"], out = sel.apply(state["sel"], joined, ctx)
@@ -762,7 +786,7 @@ class DeviceJoinEngine:
                 out["__notify__"] = notify
             out = pack_meta(out)
             out["__meta__"] = jnp.concatenate(
-                [out["__meta__"], seq.reshape(1)])
+                [out["__meta__"]] + _meta_suffix(new_state, seq))
             return new_state, out
 
         return step

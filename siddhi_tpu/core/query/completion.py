@@ -119,11 +119,14 @@ class QueryCompletion:
         dict.pop(self.out, "__meta__")
         overflow, notify, size = int(meta[0]), int(meta[1]), int(meta[2])
         try:
-            check = getattr(q, "_routed_meta_check", None)
+            check = getattr(q, "decode_meta_suffix", None)
             if check is not None and len(meta) > 3:
-                # device-routed entries carry [.., route_overflow, rows...]
-                # behind the standard prefix — an exchange overflow is
-                # fatal for this batch exactly like a capacity overflow
+                # instrument/structural suffix behind the standard
+                # prefix (observability/instruments.py): data slots feed
+                # device.<q>.<slot> telemetry; check slots (route
+                # overflow, join seq) run their structural consumers —
+                # an exchange overflow is fatal for this batch exactly
+                # like a capacity overflow
                 try:
                     check(meta)
                 except FatalQueryError as routed_err:

@@ -297,6 +297,11 @@ class FusedFanoutRuntime(Receiver):
         return np.int64(
             int(self.app_context.timestamp_generator.current_time()))
 
+    def _instruments_on(self) -> bool:
+        from siddhi_tpu.observability import instruments
+
+        return instruments.app_instruments_on(self.app_context)
+
     def _prepare(self, batch: HostBatch):
         """Shared per-batch prep: group-key columns (deduplicated by
         keyer identity), per-member capacity/state, the fused input dict,
@@ -400,6 +405,7 @@ class FusedFanoutRuntime(Receiver):
             seen_ids.add(id(lead._state))
         cluster_fns = [member_fns[c[0]] for c in clusters]
         cluster_slots = [slots[c[0]] for c in clusters]
+        ins_on = self._instruments_on()
 
         def fused(states, cols, now):
             base = {k: v for k, v in cols.items() if k not in gk_set}
@@ -408,12 +414,20 @@ class FusedFanoutRuntime(Receiver):
                 mcols = dict(base)
                 mcols[GK_KEY] = cols[gk_names[cluster_slots[ci]]]
                 st, out = fn(states[ci], mcols, now)
-                # [:3] strips per-member meta suffixes (a join side's
-                # cross-stream sequence number) so the [n, 3] stack stays
-                # rectangular; plain members' [3] metas pass unchanged
-                metas.append(out.pop("__meta__")[:3])
+                # instruments ON: per-member meta SUFFIXES (a join
+                # side's sequence + partition fills, a window member's
+                # ring fill) ride the stack, zero-padded to the widest
+                # member so it stays rectangular — the drain decodes
+                # each member's row by its own instrument spec. OFF:
+                # [:3] strips them, today's [n, 3] layout bit-for-bit.
+                meta = out.pop("__meta__")
+                metas.append(meta if ins_on else meta[:3])
                 new_states.append(st)
                 outs.append(out)
+            width = max(m.shape[0] for m in metas)
+            metas = [m if m.shape[0] == width else jnp.concatenate(
+                [m, jnp.zeros(width - m.shape[0], m.dtype)])
+                for m in metas]
             return tuple(new_states), (tuple(outs), jnp.stack(metas))
 
         jitted = jax.jit(fused, donate_argnums=0)
@@ -509,6 +523,14 @@ class FusedFanoutRuntime(Receiver):
             row = metas_host[cluster_of[i]]
             overflow, notify, size = int(row[0]), int(row[1]), int(row[2])
             try:
+                if row.shape[0] > 3:
+                    # per-member instrument suffix (zero-padded to the
+                    # stack width): each member decodes its own spec —
+                    # device.<q>.<slot> telemetry, join seq (self-
+                    # skipping inside a fused group)
+                    decode = getattr(m, "decode_meta_suffix", None)
+                    if decode is not None:
+                        decode(row)
                 if overflow > 0:
                     raise FatalQueryError(
                         f"query '{m.name}': {m.overflow_knob_msg(overflow)} "

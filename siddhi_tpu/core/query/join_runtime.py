@@ -326,6 +326,12 @@ class JoinSideProxy(Receiver):
         # partition/selector knob, not default to window capacity
         return self.runtime.overflow_knob_msg(code)
 
+    def decode_meta_suffix(self, meta):
+        """Fused-member drain hook: this side's padded meta row decodes
+        by the RUNTIME's spec (seq + partition fills — the unrouted
+        runtime's instrument_slots), into the runtime's telemetry."""
+        self.runtime.decode_meta_suffix(meta)
+
     def _emit(self, out: HostBatch):
         self.runtime._emit(out)
 
@@ -532,17 +538,40 @@ class JoinQueryRuntime(QueryRuntime):
                          "app_context.window_capacity")
         return "; ".join(parts)
 
-    def _routed_meta_check(self, meta) -> None:
-        """Meta-suffix hook shared by the sync tail and the pump drain:
-        engine steps append the cross-stream sequence number behind the
-        standard ``[ov, notify, count]`` prefix (verified here); routed
-        (mesh-sharded) joins carry the route-overflow/rows suffix instead
-        and defer to the base check."""
-        if self.engine is not None:
-            if len(meta) > 3:
-                self._seq_check(int(meta[3]))
+    def _step_instrument_slots(self):
+        """Spec of the engine side step's meta suffix (must mirror
+        ``DeviceJoinEngine.build_side_step`` exactly): the structural
+        cross-stream sequence, then — instruments on — each
+        partitioned side's per-partition directory fill. Routed
+        (mesh-sharded) joins run the LEGACY side step (engine None),
+        whose meta carries no inner suffix; their route slots come from
+        the base ``instrument_slots``."""
+        from siddhi_tpu.observability.instruments import Slot
+
+        if self.engine is None:
+            return []
+        slots = [Slot("seq", kind="check")]
+        if self._instruments_on():
+            for side_key in ("left", "right"):
+                plan = self.engine.plans[side_key]
+                if plan.use_pidx:
+                    slots.append(Slot(f"fill.{side_key}",
+                                      width=self.engine.P, reduce="max"))
+        return slots
+
+    def _consume_check_slot(self, name, vals) -> None:
+        if name == "seq":
+            self._seq_check(int(vals[0]))
             return
-        super()._routed_meta_check(meta)
+        super()._consume_check_slot(name, vals)
+
+    def _instrument_capacity(self, name):
+        if name.startswith("fill.") and self.engine is not None:
+            plan = self.engine.plans.get(name[len("fill."):])
+            if plan is not None:
+                # live: adaptive growth moves Wp, the gauge must follow
+                return float(plan.Wp)
+        return super()._instrument_capacity(name)
 
     def build_side_step_fn(self, side_key: str):
         if self.engine is not None:
@@ -791,6 +820,8 @@ class JoinQueryRuntime(QueryRuntime):
 
         t_host0 = _time.perf_counter()
         with span("query.step", query=self.name, side=side_key), self._lock:
+            from siddhi_tpu.observability import journey
+
             # pipelined completions need the delivering junction (error
             # attribution + latency feedback) and the SIDE's own timer
             # callback (per-side notify attribution at drain)
@@ -800,6 +831,13 @@ class JoinQueryRuntime(QueryRuntime):
                 j is not None and j.on_error_action == "STREAM"
                 and j.fault_junction is not None) else None
             self._cur_timer_cb = self._timer_cbs[side_key]
+            # batch-journey (PR-11 coverage gap): join side batches get
+            # the same stage attribution as single-stream ones — the
+            # shared _finish_device_batch tail consumes the context.
+            # The split (host-keyed) tail is synchronous and does not
+            # thread the journey, so grouped joins skip the allocation.
+            self._cur_journey = journey.begin(batch) \
+                if journey.enabled() and self.keyer is None else None
             side = self.sides[side_key]
             cols = batch.cols
             partitioned = self.partition_ctx is not None
@@ -986,6 +1024,9 @@ class JoinQueryRuntime(QueryRuntime):
     def _finish_device_batch(self, step, cols, overflow_msg):
         if self.keyer is None:
             return super()._finish_device_batch(step, cols, overflow_msg)
+        # split (host-keyed) path: synchronous by construction; the
+        # journey context is not threaded through the two-stage tail
+        self._cur_journey = None
         from siddhi_tpu.core.util.statistics import latency_t0, record_elapsed_ms
 
         sm = self.app_context.statistics_manager
@@ -1001,8 +1042,7 @@ class JoinQueryRuntime(QueryRuntime):
         if meta is not None:
             meta = np.asarray(meta)
             overflow, notify = int(meta[0]), int(meta[1])
-            if self.engine is not None and len(meta) > 3:
-                self._seq_check(int(meta[3]))
+            self.decode_meta_suffix(meta)
         else:
             ovf = out_host.pop("__overflow__", None)
             overflow = int(ovf) if ovf is not None else 0

@@ -30,6 +30,18 @@ from siddhi_tpu.ops.nfa import NFAStage
 from siddhi_tpu.query_api.definitions import StreamDefinition
 
 
+def _nfa_meta(out: dict, new_nfa: dict, ins_on: bool) -> dict:
+    """Append the ``nfa_runs`` instrument lane (live partial-match
+    slots) behind the packed meta prefix — computed from state the step
+    already holds (``observability/instruments.py``); inert when the
+    ``profile_device_instruments`` knob is off."""
+    if ins_on:
+        out["__meta__"] = jnp.concatenate(
+            [out["__meta__"],
+             jnp.sum(new_nfa["active"], dtype=jnp.int64).reshape(1)])
+    return out
+
+
 class StreamProxy(Receiver):
     """Per-input-stream junction subscriber for one NFA query (the role of
     PatternSingle/SequenceSingleProcessStreamReceiver)."""
@@ -117,6 +129,20 @@ class NFAQueryRuntime(QueryRuntime):
             self._steps.clear()
             self._timer_step = None
 
+    def _step_instrument_slots(self):
+        """Every NFA step (per-stream and timer sweep) appends the live
+        active-run count — see ``_nfa_meta``."""
+        from siddhi_tpu.observability.instruments import Slot
+
+        if not self._instruments_on():
+            return []
+        return [Slot("nfa_runs")]
+
+    def _instrument_capacity(self, name):
+        if name == "nfa_runs":
+            return float(self._win_keys * self.stage.plan.slots)
+        return super()._instrument_capacity(name)
+
     def arm_initial(self):
         """Arm key 0's head wait at app start (reference: absent pre-state
         processors schedule their first deadline when the runtime starts —
@@ -196,6 +222,7 @@ class NFAQueryRuntime(QueryRuntime):
         stage = self.stage
         sel = self.selector_plan
         split = self.keyer is not None
+        ins_on = self._instruments_on()
 
         def step(state, cols, current_time):
             from siddhi_tpu.core.plan.selector_plan import STR_RANK
@@ -217,13 +244,15 @@ class NFAQueryRuntime(QueryRuntime):
             if split:
                 out_cols["__overflow__"] = overflow
                 out_cols["__notify__"] = notify
-                return {"nfa": new_nfa, "sel": state["sel"]}, pack_meta(out_cols)
+                return ({"nfa": new_nfa, "sel": state["sel"]},
+                        _nfa_meta(pack_meta(out_cols), new_nfa, ins_on))
             new_sel, out = sel.apply(state["sel"], out_cols, ctx)
             if overflow is not None:
                 out["__overflow__"] = overflow
             if notify is not None:
                 out["__notify__"] = notify
-            return {"nfa": new_nfa, "sel": new_sel}, pack_meta(out)
+            return ({"nfa": new_nfa, "sel": new_sel},
+                    _nfa_meta(pack_meta(out), new_nfa, ins_on))
 
         return step
 
@@ -231,6 +260,7 @@ class NFAQueryRuntime(QueryRuntime):
         stage = self.stage
         sel = self.selector_plan
         split = self.keyer is not None
+        ins_on = self._instruments_on()
 
         def step(state, now):
             ctx = {"xp": jnp, "current_time": now}
@@ -241,13 +271,15 @@ class NFAQueryRuntime(QueryRuntime):
             if split:
                 out_cols["__overflow__"] = overflow
                 out_cols["__notify__"] = notify
-                return {"nfa": new_nfa, "sel": state["sel"]}, pack_meta(out_cols)
+                return ({"nfa": new_nfa, "sel": state["sel"]},
+                        _nfa_meta(pack_meta(out_cols), new_nfa, ins_on))
             new_sel, out = sel.apply(state["sel"], out_cols, ctx)
             if overflow is not None:
                 out["__overflow__"] = overflow
             if notify is not None:
                 out["__notify__"] = notify
-            return {"nfa": new_nfa, "sel": new_sel}, pack_meta(out)
+            return ({"nfa": new_nfa, "sel": new_sel},
+                    _nfa_meta(pack_meta(out), new_nfa, ins_on))
 
         return step
 
@@ -463,6 +495,7 @@ class NFAQueryRuntime(QueryRuntime):
                 return self.flush_deferred()
             dict.pop(out_host, "__meta__")
             meta = self._pull_meta(meta)
+            self.decode_meta_suffix(meta)
             overflow, notify, size_hint = int(meta[0]), int(meta[1]), int(meta[2])
         else:
             ovf = out_host.pop("__overflow__", None)

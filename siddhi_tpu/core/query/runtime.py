@@ -12,6 +12,7 @@ back to Events for rate limiting and callbacks.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
@@ -23,13 +24,16 @@ import numpy as np
 
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, LazyColumns, StringDictionary
-from siddhi_tpu.observability import journey
+from siddhi_tpu.observability import instruments, journey
+from siddhi_tpu.observability.instruments import Slot
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
 from siddhi_tpu.core.query.ratelimit import OutputRateLimiter
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver, StreamJunction
 from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
 from siddhi_tpu.ops.windows import conform_cols
 from siddhi_tpu.query_api.definitions import AttrType, StreamDefinition
+
+_LOG = logging.getLogger("siddhi_tpu.query.runtime")
 
 
 class GroupKeyer:
@@ -180,6 +184,14 @@ class QueryRuntime(Receiver):
         #                               fault-stream routing (@OnError)
         self._cur_journey = None    # batch-journey context of the batch in
         #                             process (observability/journey.py)
+        # device-instrument plumbing (observability/instruments.py):
+        # last drained raw lanes per slot (zero-pull scrape surface),
+        # host-known capacity denominators, and the lazily-registered
+        # device.<q>.<slot> gauge set
+        self._instr_last: Dict[str, np.ndarray] = {}
+        self._instr_caps: Dict[str, float] = {}
+        self._instr_gauged: set = set()
+        self._instr_spec = None     # cached instrument_slots() result
         self.on_error: Optional[Callable] = None
 
     # ---------------------------------------------------------------- state
@@ -369,6 +381,144 @@ class QueryRuntime(Receiver):
         return self.app_context.telemetry.instrument_jit(
             jitted, f"query.{self.name}.step")
 
+    # ------------------------------------------------- device instruments
+
+    def _instruments_on(self) -> bool:
+        """Gate of the telemetry instrument slots — the per-app typed
+        knob ``siddhi_tpu.profile_device_instruments`` (default on; off
+        keeps today's meta layouts bit-for-bit). Consulted at step BUILD
+        and at drain, so layout and decoder always agree."""
+        return instruments.app_instruments_on(self.app_context)
+
+    def instrument_slots(self) -> List[Slot]:
+        """Ordered spec of everything this runtime's meta carries BEHIND
+        the standard ``[overflow, notify, count]`` prefix — the single
+        declaration the step builder, the CompletionPump drain and
+        graftlint R6 all read. Route-structural slots first (their lanes
+        predate the registry and are knob-independent), then the inner
+        step's slots (``_step_instrument_slots``). Cached per runtime —
+        the drain runs per batch; the spec only changes when the layout
+        does (route install / engine attach invalidate ``_instr_spec``)."""
+        if self._instr_spec is not None:
+            return self._instr_spec
+        spec: List[Slot] = []
+        rl = self._route_layout
+        if rl is not None:
+            spec.append(Slot("route_overflow", kind="check"))
+            spec.append(Slot("shard_rows", width=rl.n))
+            if self._instruments_on():
+                spec.append(Slot("route_residual"))
+        spec.extend(self._step_instrument_slots())
+        self._instr_spec = spec
+        return spec
+
+    def _step_instrument_slots(self) -> List[Slot]:
+        """Slots the INNER (per-shard) step appends — overridden by the
+        join/NFA runtimes to match their own step builders exactly."""
+        if not self._instruments_on():
+            return []
+        slots: List[Slot] = []
+        if (self.window_stage is not None
+                and hasattr(self.window_stage, "live_fill")):
+            slots.append(Slot("win_fill", reduce="max"))
+        if self.keyer is not None or self.partition_ctx is not None:
+            slots.append(Slot("groups"))
+        return slots
+
+    def _instrument_values(self, slots: List[Slot], new_state, cols) -> List:
+        """Device-side slot computation (runs INSIDE the jitted step,
+        from state/columns the step already holds — zero extra work
+        beyond a couple of reductions)."""
+        vals = []
+        for slot in slots:
+            if slot.name == "win_fill":
+                vals.append(jnp.asarray(
+                    self.window_stage.live_fill(new_state["win"]),
+                    jnp.int64).reshape(1))
+            elif slot.name == "groups":
+                K = self.selector_plan.num_keys
+                valid = cols[VALID_KEY]
+                gk = jnp.clip(cols[GK_KEY].astype(jnp.int64), 0, K - 1)
+                idx = jnp.where(valid, gk, jnp.int64(K))
+                seen = jnp.zeros(K + 1, bool).at[idx].set(True, mode="drop")
+                vals.append(jnp.sum(seen[:K], dtype=jnp.int64).reshape(1))
+        return vals
+
+    def _instrument_capacity(self, name: str) -> Optional[float]:
+        """Host-known denominator of one data slot (the report quotes
+        saturation against it); None = not a saturation-style signal."""
+        if name == "win_fill":
+            return getattr(self.window_stage, "ring_capacity", None)
+        if name == "groups":
+            k = self.selector_plan.num_keys
+            rl = self._route_layout
+            return float(k * rl.n) if rl is not None else float(k)
+        if name in ("shard_rows", "route_residual"):
+            rl = self._route_layout
+            return float(rl.n * rl.quota) if rl is not None else None
+        return None
+
+    def decode_meta_suffix(self, meta) -> None:
+        """Drain-side decoder of the meta suffix, shared by the
+        synchronous tail, the CompletionPump drain, the deferred flush
+        and the fused fan-out per-member path: walk the spec, record
+        data slots into ``device.<query>.<slot>`` telemetry (and the
+        zero-pull ``_instr_last`` cache), then run the structural check
+        slots (route-overflow raise, join seq verification). Data lands
+        BEFORE checks so a fatal overflow still leaves the skew gauges
+        pointing at the culprit."""
+        spec = self.instrument_slots()
+        meta = np.asarray(meta)
+        if not spec or meta.shape[0] <= 3:
+            return
+        ins_on = self._instruments_on()
+        checks = []
+        i = 3
+        for slot in spec:
+            if i + slot.width > meta.shape[0]:
+                # a meta SHORTER than the spec means a builder/spec
+                # layout drift — the bug class this registry exists to
+                # prevent. It must be loud, not a silent skip of the
+                # pending check slots (join seq, route overflow).
+                if "decode_short" not in self._instr_gauged:
+                    self._instr_gauged.add("decode_short")
+                    _LOG.error(
+                        "query '%s': meta suffix (%d lanes) shorter than "
+                        "the declared instrument spec %s — step builder "
+                        "and instrument_slots() drifted apart; remaining "
+                        "slots (incl. checks) not decoded",
+                        self.name, meta.shape[0] - 3,
+                        [s.name for s in spec])
+                tel = getattr(self.app_context, "telemetry", None)
+                if tel is not None:
+                    tel.count("device.decode_short")
+                break
+            vals = np.asarray(meta[i:i + slot.width], np.int64)
+            i += slot.width
+            if slot.kind == "check":
+                checks.append((slot, vals))
+            else:
+                self._record_instrument(slot, vals, telemetry=ins_on)
+        for slot, vals in checks:
+            self._consume_check_slot(slot.name, vals)
+
+    def _record_instrument(self, slot: Slot, vals, telemetry: bool) -> None:
+        self._instr_last[slot.name] = vals
+        if slot.name == "shard_rows" and self._route_layout is not None:
+            # back-compat mirror (skew debugging reads it directly)
+            self._route_layout.last_shard_rows = vals
+        if telemetry:
+            instruments.record(self, slot, vals,
+                               capacity=self._instrument_capacity(slot.name))
+
+    def _consume_check_slot(self, name: str, vals) -> None:
+        """Structural (kind='check') slot consumers; the join runtime
+        adds 'seq'. graftlint R6 pairs every check slot with a literal
+        handled here or in an override."""
+        if name == "route_overflow" and int(vals[0]) > 0:
+            raise FatalQueryError(
+                f"query '{self.name}': {self.route_overflow_msg()}")
+
     def build_step_fn(self):
         """The pure (state, cols, now) -> (state', out) device function for
         this query — jit-compiled by `_make_step`, also exported raw for
@@ -383,6 +533,7 @@ class QueryRuntime(Receiver):
         post_pipeline = [] if host_pre else list(self.post_pipeline)
         sel = self.selector_plan
         win = self.window_stage
+        islots = self._step_instrument_slots()
 
         def step(state, cols, current_time):
             from siddhi_tpu.core.plan.selector_plan import STR_RANK
@@ -426,7 +577,14 @@ class QueryRuntime(Receiver):
                 out["__overflow__"] = overflow if sel_ov is None else jnp.maximum(
                     jnp.asarray(overflow).astype(jnp.int32),
                     jnp.asarray(sel_ov).astype(jnp.int32))
-            return new_state, pack_meta(out)
+            out = pack_meta(out)
+            if islots:
+                # device instruments ride behind the [ov, notify, count]
+                # prefix — decoded by spec at drain (decode_meta_suffix)
+                out["__meta__"] = jnp.concatenate(
+                    [out["__meta__"]]
+                    + self._instrument_values(islots, new_state, cols))
+            return new_state, out
 
         return step
 
@@ -693,16 +851,10 @@ class QueryRuntime(Receiver):
                 f"(device_route_query_step) or split the batch")
 
     def _routed_meta_check(self, meta) -> None:
-        """Device-routed extras riding behind the ``[ov, notify, count]``
-        meta prefix: raise on exchange overflow (slot 3), publish the
-        per-shard routed-row counts (slots 4..4+n) for skew debugging."""
-        rl = self._route_layout
-        if rl is None or len(meta) <= 3:
-            return
-        rl.last_shard_rows = np.asarray(meta[4:4 + rl.n], np.int64)
-        if int(meta[3]) > 0:
-            raise FatalQueryError(
-                f"query '{self.name}': {self.route_overflow_msg()}")
+        """Back-compat alias: the route-overflow/rows suffix is now one
+        case of the declarative instrument spec — see
+        ``decode_meta_suffix`` / ``instrument_slots``."""
+        self.decode_meta_suffix(meta)
 
     def _host_keyed_select(self, out_host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Split-pipeline tail: when the group key is computed from a device
@@ -812,7 +964,7 @@ class QueryRuntime(Receiver):
                 jr.drained((time.perf_counter() - _tp) * 1000.0)
             else:
                 meta = self._pull_meta(meta)
-            self._routed_meta_check(meta)
+            self.decode_meta_suffix(meta)
             overflow = int(meta[0])
             notify = int(meta[1])
             size_hint = int(meta[2])
@@ -913,6 +1065,14 @@ class QueryRuntime(Receiver):
             overflow_errs: List[str] = []
             for (out_host, overflow_msg), meta in zip(pending, metas):
                 dict.pop(out_host, "__meta__")
+                try:
+                    # instrument/structural suffix (drain-then-raise:
+                    # a route overflow joins the collected errors)
+                    self.decode_meta_suffix(meta)
+                except FatalQueryError as suffix_err:
+                    msg = str(suffix_err)
+                    if msg not in overflow_errs:
+                        overflow_errs.append(msg)
                 overflow, notify, size = int(meta[0]), int(meta[1]), int(meta[2])
                 if overflow > 0 and overflow_msg not in overflow_errs:
                     # every DISTINCT knob text of an overflowed batch is

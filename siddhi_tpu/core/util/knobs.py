@@ -111,6 +111,13 @@ KNOBS: Dict[str, Knob] = _declare(
     # AOT compile per program). Defaults off; see MIGRATION.md.
     Knob("profile_journeys", "bool", attr="profile_journeys"),
     Knob("profile_costs", "bool", attr="profile_costs"),
+    # device telemetry plane (observability/instruments.py): instrument
+    # slots ride the meta vector behind [overflow, notify, count] —
+    # per-batch device truth (ring fill, join partition fill, NFA runs,
+    # routed-row skew) at zero extra host transfers. Default ON; off =
+    # pre-round-9 meta layouts bit-for-bit. See MIGRATION.md.
+    Knob("profile_device_instruments", "bool",
+         attr="profile_device_instruments"),
     # floats
     Knob("cluster_step_timeout", "float", attr="cluster_step_timeout"),
     # enums

@@ -54,6 +54,32 @@ TELEMETRY_PREFIXES = (
     "jitcost",       # compiled-program cost gauges
                      # (observability/costmodel.py -> siddhi_jit_cost_*)
     "scrape",        # /metrics self-timing (siddhi_scrape_ms)
+    "device",        # device-instrument slots riding the meta vector
+                     # (observability/instruments.py -> siddhi_device_*)
+)
+
+# --- graftlint R6 declarations (device-instrument parity) ------------
+# Every DATA slot name a step builder may declare in its
+# instrument_slots() spec (observability/instruments.Slot). The
+# exposition regexes below are BUILT from this tuple, and R6 checks the
+# declared set against the Slot(...) construction sites and the
+# _consume_check_slot consumers bidirectionally — a slot computed on
+# device but never decoded (or declared but never computed) is a lint
+# finding, not a silent telemetry hole.
+DEVICE_SLOTS = (
+    "win_fill",        # window ring live rows (keyed: hottest key)
+    "groups",          # distinct group keys touched by the batch
+    "nfa_runs",        # live NFA partial-match slots
+    "shard_rows",      # per-shard routed rows (device-routed exchange)
+    "route_residual",  # receive capacity left on the fullest shard
+    "fill.left",       # join build directory fill per partition
+    "fill.right",
+)
+# Structural (kind='check') slots: consumed by a runtime's
+# _consume_check_slot hook at drain, never rendered as telemetry.
+DEVICE_CHECK_SLOTS = (
+    "route_overflow",  # exchange overflow -> FatalQueryError
+    "seq",             # join cross-stream sequence verification
 )
 # Gauge templates that live exactly as long as their registry does —
 # per-app gauges die with the app's TelemetryRegistry at shutdown, the
@@ -72,6 +98,8 @@ PROCESS_LIFETIME_GAUGES = (
     "cluster.outstanding_pulls",  # process registry, process-lifetime
     "jitcost.*",            # process registry — a compiled program's
                             # cost record outlives any single app
+    "device.*",             # app registry — device-instrument last-value
+                            # and capacity gauges die with the app
 )
 # ---------------------------------------------------------------------
 
@@ -148,6 +176,17 @@ _JOIN_HIST = re.compile(r"^join\.(?P<kind>probe|insert)_ms\.(?P<query>.+)$")
 # service-time and queueing-time histograms of the batch journey
 _STAGE_HIST = re.compile(r"^stage\.(?P<query>.+)\.(?P<stage>[a-z_]+)"
                          r"\.(?P<kind>service|queue)_ms$")
+# device-instrument slots (observability/instruments.py): per-query
+# last-drained value + capacity gauges and per-batch value histograms,
+# slot names anchored to the DEVICE_SLOTS declaration above (query
+# names may contain dots — the slot tail is the fixed part)
+_DEVICE_SLOT_RX = "|".join(
+    re.escape(s) for s in sorted(DEVICE_SLOTS, key=len, reverse=True))
+_DEVICE_GAUGE = re.compile(
+    r"^device\.(?P<query>.+)\.(?P<slot>" + _DEVICE_SLOT_RX +
+    r")(?P<cap>\.capacity)?$")
+_DEVICE_HIST = re.compile(
+    r"^device\.(?P<query>.+)\.(?P<slot>" + _DEVICE_SLOT_RX + r")$")
 # compiled-program cost registry (observability/costmodel.py): one gauge
 # per (jit key, metric) on the process registry
 _JITCOST_GAUGE = re.compile(
@@ -350,6 +389,23 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                              "fraction of the app's overload quota in "
                              "use (queue depth / pipeline entries / "
                              "device-memory budget)", labels, v)
+                elif _DEVICE_GAUGE.match(name):
+                    m = _DEVICE_GAUGE.match(name)
+                    if m.group("cap"):
+                        fams.add("siddhi_device_instrument_capacity",
+                                 "gauge",
+                                 "capacity denominator of a device "
+                                 "instrument slot (ring size, Wp, "
+                                 "rows_per_shard, ...)",
+                                 {**base, "query": m.group("query"),
+                                  "slot": m.group("slot")}, v)
+                    else:
+                        fams.add("siddhi_device_instrument", "gauge",
+                                 "last drained device-instrument value "
+                                 "(rides the per-batch meta pull — "
+                                 "zero extra device transfers)",
+                                 {**base, "query": m.group("query"),
+                                  "slot": m.group("slot")}, v)
                 elif _JITCOST_GAUGE.match(name):
                     m = _JITCOST_GAUGE.match(name)
                     family, help_ = _JITCOST_HELP[m.group("metric")]
@@ -445,6 +501,13 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                              "time (ms)")
                 labels["query"] = m.group("query")
                 labels["stage"] = m.group("stage")
+            elif _DEVICE_HIST.match(name):
+                m = _DEVICE_HIST.match(name)
+                family = "siddhi_device_instrument_value"
+                help_ = ("per-batch device-instrument slot value "
+                         "(observability/instruments.py slot glossary)")
+                labels["query"] = m.group("query")
+                labels["slot"] = m.group("slot")
             elif name == "scrape.ms":
                 family = "siddhi_scrape_ms"
                 help_ = "/metrics scrape self-timing (ms)"

@@ -340,7 +340,77 @@ def _parse_stage_hists(hist_snapshot: dict) -> Dict[str, dict]:
     return out
 
 
-def _query_report(app: str, query: str, stages: Dict[str, dict]) -> dict:
+def _parse_device_signals(hist_snapshot: dict,
+                          gauge_snapshot: dict) -> Dict[str, dict]:
+    """``device.<query>.<slot>`` instrument histograms paired with their
+    ``.capacity`` gauges, grouped per query (slot names come from the
+    DEVICE_SLOTS declaration in export.py — the graftlint-R6-checked
+    tuple, so a newly declared slot is visible here by construction;
+    query names may contain dots, so parse from the right against the
+    known slot set)."""
+    from siddhi_tpu.observability.export import DEVICE_SLOTS
+
+    slots = sorted(DEVICE_SLOTS, key=len, reverse=True)
+    out: Dict[str, dict] = {}
+    for name, snap in hist_snapshot.items():
+        if not name.startswith("device."):
+            continue
+        rest = name[len("device."):]
+        for slot in slots:
+            if rest.endswith("." + slot):
+                query = rest[: -len(slot) - 1]
+                cap = gauge_snapshot.get(f"device.{query}.{slot}.capacity")
+                out.setdefault(query, {})[slot] = {
+                    "snap": snap, "capacity": cap}
+                break
+    return out
+
+
+def _device_structure(device_slots: Optional[dict]) -> Optional[dict]:
+    """The most saturated device structure of one query, from its
+    drained instrument histograms: max p99/capacity ratio across slots
+    with a known capacity — the thing to name when the device stage is
+    the bottleneck ('join right side partition fill p99 = 0.97 of
+    Wp')."""
+    from siddhi_tpu.observability.instruments import (
+        RESIDUAL_SLOTS, SLOT_CAP_NAMES, SLOT_LABELS)
+
+    best = None
+    for slot, rec in (device_slots or {}).items():
+        cap = rec.get("capacity")
+        if not cap or cap != cap:      # missing or NaN denominator
+            continue
+        label = SLOT_LABELS.get(slot, slot)
+        cap_name = SLOT_CAP_NAMES.get(slot, "capacity")
+        if slot in RESIDUAL_SLOTS:
+            # a residual saturates toward ZERO: the worst case over the
+            # window is the MINIMUM residual seen, not a high quantile
+            # (p99 would be the healthiest batch)
+            quoted = float(rec["snap"].get("min", 0.0))
+            ratio = max(0.0, 1.0 - quoted / float(cap))
+            text = (f"{label} min = {quoted:.0f} of {cap_name} "
+                    f"({ratio:.2f} saturated)")
+        else:
+            quoted = float(rec["snap"].get("p99", 0.0))
+            ratio = quoted / float(cap)
+            text = f"{label} p99 = {ratio:.2f} of {cap_name}"
+        if best is None or ratio > best["ratio"]:
+            best = {
+                "slot": slot,
+                "label": label,
+                # the quoted statistic: p99 for fill-style slots, MIN
+                # for residuals (the field name must not lie about it)
+                "stat": "min" if slot in RESIDUAL_SLOTS else "p99",
+                "value": round(quoted, 3),
+                "capacity": float(cap),
+                "ratio": round(ratio, 4),
+                "text": text,
+            }
+    return best
+
+
+def _query_report(app: str, query: str, stages: Dict[str, dict],
+                  device_slots: Optional[dict] = None) -> dict:
     per_stage = {}
     for stage in STAGES:
         kinds = stages.get(stage)
@@ -376,6 +446,7 @@ def _query_report(app: str, query: str, stages: Dict[str, dict]) -> dict:
         q_mean = queue_rec["mean_queue_ms"]
         if q_mean > 0 and q_mean >= _QUEUE_DOMINANCE * max(best_mean, 0.0):
             best_stage, best_mean = "queue", q_mean
+    structure = _device_structure(device_slots)
     bottleneck = None
     if best_stage is not None:
         rec = per_stage[best_stage]
@@ -388,8 +459,15 @@ def _query_report(app: str, query: str, stages: Dict[str, dict]) -> dict:
             "utilization": round(min(1.0, busy / wall_ms), 4)
             if wall_ms > 0 else None,
         }
-    return {"stages": per_stage, "wall_ms": round(wall_ms, 3),
-            "bottleneck": bottleneck}
+        if best_stage == "device" and structure is not None:
+            # the device is the bottleneck AND its instruments say which
+            # structure is saturated — name it right in the verdict
+            bottleneck["structure"] = structure["text"]
+    report = {"stages": per_stage, "wall_ms": round(wall_ms, 3),
+              "bottleneck": bottleneck}
+    if structure is not None:
+        report["device_structure"] = structure
+    return report
 
 
 def critical_path_report(manager, app_name: Optional[str] = None) -> dict:
@@ -409,9 +487,14 @@ def critical_path_report(manager, app_name: Optional[str] = None) -> dict:
     for name in sorted(runtimes):
         rt = runtimes[name]
         tel = rt.app_context.telemetry
-        hists = tel.snapshot().get("histograms", {})
+        snap = tel.snapshot()
+        hists = snap.get("histograms", {})
+        # device instruments (on by default, independent of journey
+        # tracing): when the device stage is the bottleneck, the report
+        # names the saturated structure behind it
+        device = _parse_device_signals(hists, snap.get("gauges", {}))
         queries = {
-            q: _query_report(name, q, stages)
+            q: _query_report(name, q, stages, device_slots=device.get(q))
             for q, stages in sorted(_parse_stage_hists(hists).items())
         }
         apps[name] = {"queries": queries}

@@ -79,6 +79,16 @@ class KeyedLengthWindowStage(WindowStage):
         buf = {k: jnp.zeros((num_keys * W,), dt) for k, dt in self.col_specs.items()}
         return {"buf": buf, "total": jnp.zeros((num_keys,), jnp.int64)}
 
+    @property
+    def ring_capacity(self) -> int:
+        return self.length
+
+    def live_fill(self, state):
+        """Hottest key's live row count — ``win_fill`` instrument slot
+        (max, not sum: the saturation signal is the fullest per-key
+        ring, which is what capacity overflow is a function of)."""
+        return jnp.max(jnp.minimum(state["total"], jnp.int64(self.length)))
+
     def apply(self, state, cols, ctx):
         W = self.length
         K = state["total"].shape[0]
@@ -180,6 +190,16 @@ class KeyedTimeWindowStage(WindowStage):
             "total": jnp.zeros((num_keys,), jnp.int64),
             "expired_upto": jnp.zeros((num_keys,), jnp.int64),
         }
+
+    @property
+    def ring_capacity(self) -> int:
+        return self.capacity
+
+    def live_fill(self, state):
+        """Hottest key's live (unexpired) row count — ``win_fill``
+        instrument slot (see KeyedLengthWindowStage.live_fill)."""
+        return jnp.max(jnp.maximum(
+            state["total"] - state["expired_upto"], jnp.int64(0)))
 
     def apply(self, state, cols, ctx):
         Wc = self.capacity

@@ -278,6 +278,17 @@ class LengthWindowStage(WindowStage):
         buf = {k: jnp.zeros((W,), dt) for k, dt in self.col_specs.items()}
         return {"buf": buf, "total": jnp.int64(0)}
 
+    @property
+    def ring_capacity(self) -> int:
+        return self.length
+
+    def live_fill(self, state):
+        """Live rows in the ring (device scalar) — the ``win_fill``
+        instrument slot (``observability/instruments.py``): computed
+        inside the jitted step from state it already holds, so ring
+        occupancy reaches /metrics with zero extra host transfers."""
+        return jnp.minimum(state["total"], jnp.int64(self.length))
+
     def apply(self, state, cols, ctx):
         W = self.length
         keys = _data_keys(cols)
@@ -346,6 +357,17 @@ class TimeWindowStage(WindowStage):
         Wc = self.capacity
         buf = {k: jnp.zeros((Wc,), dt) for k, dt in self.col_specs.items()}
         return {"buf": buf, "total": jnp.int64(0), "expired_upto": jnp.int64(0)}
+
+    @property
+    def ring_capacity(self) -> int:
+        return self.capacity
+
+    def live_fill(self, state):
+        """Live (unexpired) rows in the ring — ``win_fill`` instrument
+        slot; near ``capacity`` means the ring is one skewed batch away
+        from overflow."""
+        return jnp.maximum(state["total"] - state["expired_upto"],
+                           jnp.int64(0))
 
     def apply(self, state, cols, ctx):
         Wc = self.capacity

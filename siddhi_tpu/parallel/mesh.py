@@ -692,6 +692,8 @@ def _install_routed(runtime, layout: RouteLayout, canonical, Kg: int, Wg: int):
     runtime._win_keys = layout.local_win
     runtime._route_layout = layout
     runtime._shard_mesh = layout.mesh
+    # meta layout changed: drop the cached drain-side instrument spec
+    runtime._instr_spec = None
 
     state = _canonical_to_routed(runtime, layout, canonical)
     if n > 1:
@@ -914,6 +916,13 @@ def routed_step_for(runtime, side_key: Optional[str] = None):
     n, Q = layout.n, layout.quota
     localK = layout.localK
     partitioned, use_lut = layout.partitioned, layout.use_lut
+    # device instruments (observability/instruments.py): the inner step
+    # appends its own slot lanes; the route wrapper adds the exchange
+    # residual and aggregates the inner lanes across shards per each
+    # slot's declared reduction. Captured at BUILD so the compiled meta
+    # layout matches runtime.instrument_slots() exactly.
+    ins_on = runtime._instruments_on()
+    inner_slots = runtime._step_instrument_slots()
     if side_key is not None:
         side_step = runtime.build_side_step_fn(side_key)
         _ph = jnp.zeros((1,), bool)
@@ -936,8 +945,11 @@ def routed_step_for(runtime, side_key: Optional[str] = None):
             out = dict(out)
             meta = out.pop("__meta__")
             out.pop(OKEY_KEY, None)   # single shard: already in order
-            out["__meta__"] = jnp.concatenate(
-                [meta, jnp.zeros(1, jnp.int64), rows[None]])
+            parts = [meta[:3], jnp.zeros(1, jnp.int64), rows[None]]
+            if ins_on:
+                parts.append(jnp.full((1,), n * Q, jnp.int64) - rows[None])
+            parts.append(meta[3:])    # inner step's instrument lanes
+            out["__meta__"] = jnp.concatenate(parts)
             return st, out
 
         jitted = jax.jit(one_dev, donate_argnums=(0,))
@@ -1032,8 +1044,21 @@ def routed_step_for(runtime, side_key: Optional[str] = None):
         cnt = jax.lax.psum(meta[2], KEY_AXIS)
         rov = jax.lax.psum(route_ov, KEY_AXIS)
         rows = jax.lax.all_gather(rows_here, KEY_AXIS)
-        merged["__meta__"] = jnp.concatenate(
-            [jnp.stack([ov, nt, cnt, rov]), rows.astype(jnp.int64)])
+        parts = [jnp.stack([ov, nt, cnt, rov]), rows.astype(jnp.int64)]
+        if ins_on:
+            # exchange residual: receive capacity left on the FULLEST
+            # shard this batch (0 = one more skewed batch overflows)
+            parts.append(jnp.full((1,), n * Q, jnp.int64)
+                         - jax.lax.pmax(rows_here, KEY_AXIS)[None])
+        # inner step's instrument lanes, aggregated per declared reduce
+        # (sum for shard-owned counts, max for fill levels)
+        lane = 3
+        for slot in inner_slots:
+            v = meta[lane:lane + slot.width]
+            lane += slot.width
+            parts.append(jax.lax.pmax(v, KEY_AXIS) if slot.reduce == "max"
+                         else jax.lax.psum(v, KEY_AXIS))
+        merged["__meta__"] = jnp.concatenate(parts)
         st = jax.tree_util.tree_map(
             lambda leaf, ax: jnp.asarray(leaf)[None] if ax < 0 else leaf,
             st, axes)

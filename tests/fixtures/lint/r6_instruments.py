@@ -1,0 +1,18 @@
+"""Known-bad fixture for graftlint R6 (device-instrument parity).
+
+Declares instrument slots that (a) match no DEVICE_SLOTS declaration in
+observability/export.py and (b) carry kind='check' with no
+_consume_check_slot consumer anywhere — both must be findings."""
+
+from siddhi_tpu.observability.instruments import Slot
+
+
+class BadRuntime:
+    def _step_instrument_slots(self):
+        return [
+            # undeclared data slot: its device.* telemetry would render
+            # as an undeclared catch-all family
+            Slot("ghost_fill"),
+            # check slot nobody consumes at drain (also undeclared)
+            Slot("phantom_check", kind="check"),
+        ]

@@ -27,6 +27,7 @@ FIXTURE_PATHS = {
     "r3_stage_family.py": "siddhi_tpu/observability/bad_stage_metrics.py",
     "r4_lock_order.py": "siddhi_tpu/core/query/bad_locks.py",
     "r5_host_pull.py": "siddhi_tpu/core/query/bad_steps.py",
+    "r6_instruments.py": "siddhi_tpu/core/query/bad_instruments.py",
 }
 
 
@@ -56,6 +57,8 @@ def _lint_fixture(name: str):
     ("r3_stage_family.py", "R3", 2),
     ("r4_lock_order.py", "R4", 2),     # pump->owner and owner->barrier
     ("r5_host_pull.py", "R5", 4),      # float, .item, np.asarray, bool
+    # undeclared data slot + consumer-less check slot
+    ("r6_instruments.py", "R6", 2),
 ])
 def test_rule_flags_its_fixture(name, rule, min_hits):
     findings = _lint_fixture(name)
@@ -109,9 +112,42 @@ def test_suppression_comments():
         os.unlink(tmp)
 
 
-def test_rule_registry_lists_five_rules():
+def test_rule_registry_lists_six_rules():
     rules = default_rules()
-    assert [r.id for r in rules] == ["R1", "R2", "R3", "R4", "R5"]
+    assert [r.id for r in rules] == ["R1", "R2", "R3", "R4", "R5", "R6"]
+
+
+def test_instrument_parity_bidirectional():
+    """A DEVICE_SLOTS entry no Slot(...) produces — and a check slot no
+    _consume_check_slot handles — are findings too (fixture export.py,
+    the real one stays untouched)."""
+    import ast
+
+    exp_src = ('TELEMETRY_PREFIXES = ("device",)\n'
+               'PROCESS_LIFETIME_GAUGES = ("device.*",)\n'
+               'DEVICE_SLOTS = ("win_fill", "never_computed")\n'
+               'DEVICE_CHECK_SLOTS = ("seq",)\n')
+    reg_src = ('from siddhi_tpu.observability.instruments import Slot\n'
+               'def wire(tel, q):\n'
+               '    tel.gauge(f"device.{q}.win_fill", lambda: 0)\n'
+               'def spec():\n'
+               '    return [Slot("win_fill"), Slot("seq", kind="check")]\n'
+               'class R:\n'
+               '    def _consume_check_slot(self, name, vals):\n'
+               '        if name == "seq":\n'
+               '            pass\n')
+    mods = [
+        ModuleInfo(path="siddhi_tpu/observability/export.py", src=exp_src,
+                   tree=ast.parse(exp_src)),
+        ModuleInfo(path="siddhi_tpu/core/wire.py", src=reg_src,
+                   tree=ast.parse(reg_src)),
+    ]
+    findings = [f for f in run_lint(mods) if f.rule == "R6"]
+    dead = [f for f in findings if "never_computed" in f.message]
+    assert dead, [f.format() for f in findings]
+    # the matched pair raises nothing else
+    assert all("never_computed" in f.message for f in findings), \
+        [f.format() for f in findings]
 
 
 def test_metric_prefix_parity_bidirectional():

@@ -173,10 +173,15 @@ def test_exchange_overflow_attribution():
             GK_KEY: pk, PK_KEY: pk}
     _st, out = q._step(q._state, cols, np.int64(99))
     meta = np.asarray(out["__meta__"])
-    assert meta.shape[0] == 4 + 4          # prefix + per-shard rows
+    # layout = [ov, notify, count] + the runtime's declared instrument
+    # spec (route_overflow, rows_0..3, residual, win_fill, groups —
+    # observability/instruments.py); route overflow stays at lane 3
+    spec = q.instrument_slots()
+    assert [s.name for s in spec][:2] == ["route_overflow", "shard_rows"]
+    assert meta.shape[0] == 3 + sum(s.width for s in spec)
     assert int(meta[3]) > 0                # route overflow flag
     with pytest.raises(FatalQueryError, match="rows_per_shard"):
-        q._routed_meta_check(meta)
+        q.decode_meta_suffix(meta)
     m.shutdown()
 
 

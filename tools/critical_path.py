@@ -66,9 +66,15 @@ def render(report: dict) -> str:
             if b is not None:
                 util = (f", utilization {b['utilization']:.0%}"
                         if b.get("utilization") is not None else "")
+                tail = (f" — {b['structure']}"
+                        if b.get("structure") else "")
                 lines.append(
                     f"    bottleneck: {b['stage']} ({b['kind']}, "
-                    f"mean {b['mean_ms']:.2f} ms/batch{util})")
+                    f"mean {b['mean_ms']:.2f} ms/batch{util}){tail}")
+            st = q.get("device_structure")
+            if st is not None:
+                lines.append(f"    device structure: {st['text']} "
+                             f"(capacity {st['capacity']:.0f})")
     return "\n".join(lines)
 
 

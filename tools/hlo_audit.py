@@ -88,6 +88,20 @@ def _assert_one_module(hlo: str, what: str) -> int:
     return n
 
 
+def _assert_instrumented_meta(q, out, what: str) -> list:
+    """The step's packed meta must carry EXACTLY the runtime's declared
+    instrument spec behind the [overflow, notify, count] prefix — the
+    device telemetry plane rides the existing meta pull, with no extra
+    module, no extra transfer (observability/instruments.py)."""
+    spec = q.instrument_slots()
+    meta = np.asarray(out["__meta__"])
+    want = 3 + sum(s.width for s in spec)
+    assert meta.shape[0] == want, (
+        f"{what}: meta carries {meta.shape[0]} lanes, spec declares "
+        f"{want} ({[s.name for s in spec]})")
+    return [s.name for s in spec]
+
+
 def _make_batch(rng):
     from siddhi_tpu.core.plan.selector_plan import GK_KEY
     from siddhi_tpu.ops.expressions import PK_KEY, TS_KEY, TYPE_KEY, VALID_KEY
@@ -149,8 +163,14 @@ define stream StockStream (symbol string, price float, volume long);
     _assert_no_host_transfers(hlo, "single-query step")
     cols = _count_collectives(hlo)
     assert not cols, f"unsharded query step has collectives: {cols}"
+    # instrumented meta: the device telemetry plane adds lanes to the
+    # SAME module's meta output, never a second computation or transfer
+    _st2, out = step(q._init_state(), ctx.batch, np.int64(0))
+    slots = _assert_instrumented_meta(q, out, "single-query step")
+    assert slots, "default-on instruments declared no slots"
     m.shutdown()
-    return {"hlo_modules": n, "collectives": cols, "host_transfers": 0}
+    return {"hlo_modules": n, "collectives": cols, "host_transfers": 0,
+            "instrument_slots": slots}
 
 
 @audit("gspmd_replicated_batch")
@@ -256,11 +276,19 @@ define stream R (sym string, rv long);
                       np.int64(0)).compile().as_text()
     n = _assert_one_module(hlo, "device join side step")
     _assert_no_host_transfers(hlo, "device join side step")
+    # instrumented meta: seq + both sides' per-partition fills ride the
+    # same module's meta output
+    _st2, out = jstep(q._init_state(), {}, jnp.zeros((1,), bool), jcols,
+                      np.int64(0))
+    slots = _assert_instrumented_meta(q, out, "device join side step")
+    assert "seq" in slots and any(s.startswith("fill.") for s in slots), \
+        f"join instrument spec incomplete: {slots}"
     report = {
         "partitions": q.engine.P,
         "hlo_modules": n,
         "collectives": _count_collectives(hlo),
         "host_transfers": 0,
+        "instrument_slots": slots,
     }
     m.shutdown()
     return report
@@ -331,9 +359,12 @@ def _audit_device_routed(ctx):
     assert not unexpected, (
         f"device-routed step has unexpected collective kinds: {unexpected}")
     _assert_no_host_transfers(hlo, "device-routed step")
+    # the routed meta layout = route slots + inner instrument slots
+    slots = [s.name for s in q.instrument_slots()]
+    assert slots[:2] == ["route_overflow", "shard_rows"], slots
     m.shutdown()
     return {"hlo_modules": n, "collectives": dev_counts,
-            "host_transfers": 0}
+            "host_transfers": 0, "instrument_slots": slots}
 
 
 @audit("sharded_agg")
@@ -399,12 +430,57 @@ define aggregation TradeAgg
 
 # ----------------------------------------------------------------- main
 
+def _scrape_zero_pulls() -> dict:
+    """A full /metrics scrape must perform ZERO device pulls — verified
+    under jax's transfer guard with live device-instrument state (the
+    join partition gauges used to pull the directory per scrape; they
+    now read the last drained fill instrument / host mirror)."""
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+    from siddhi_tpu.observability import export
+
+    _JOIN_APP = """
+define stream L (sym string, lv long);
+define stream R (sym string, rv long);
+@info(name='jq') from L#window.length(64) join R#window.length(64)
+  on L.sym == R.sym
+  select L.sym as sym, L.lv as lv, R.rv as rv insert into JOut;
+"""
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.join_partitions": "8"}))
+    rt = m.create_siddhi_app_runtime(_JOIN_APP)
+    rt.start()
+    hl, hr = rt.get_input_handler("L"), rt.get_input_handler("R")
+    for i in range(16):
+        hl.send([f"S{i % 5}", i])
+        hr.send([f"S{i % 5}", 100 + i])
+    with jax.transfer_guard("disallow"):
+        text = export.prometheus_text(m)
+    # family literals below assert on exposition OUTPUT, they declare
+    # nothing (R3's central-declaration rule targets registrations)
+    want = ("siddhi_join_partition_rows",   # graftlint: disable=R3
+            "siddhi_device_instrument")     # graftlint: disable=R3
+    for fam in want:
+        assert fam in text, f"family {fam} missing from scrape"
+    # a guarded pull inside a gauge closure surfaces as NaN — the join
+    # occupancy and device-instrument families must be real numbers
+    for line in text.splitlines():
+        if line.startswith(want):
+            assert not line.endswith("NaN"), f"guarded gauge pulled: {line}"
+    m.shutdown()
+    return {"device_pulls": 0, "transfer_guard": "disallow"}
+
+
 def main():
     from siddhi_tpu.parallel.mesh import force_host_devices
 
     force_host_devices(N_DEV)
 
-    from siddhi_tpu.analysis.step_registry import JIT_STEP_BUILDERS, resolve
+    from siddhi_tpu.analysis.step_registry import (
+        INSTRUMENTED_STEP_BUILDERS, JIT_STEP_BUILDERS, resolve)
 
     missing = sorted(set(JIT_STEP_BUILDERS) - set(AUDITS))
     assert not missing, (
@@ -414,6 +490,8 @@ def main():
     assert not extra, (
         f"audits not backed by a step_registry entry: {extra} — declare "
         f"the builder in siddhi_tpu/analysis/step_registry.py")
+    bad = sorted(set(INSTRUMENTED_STEP_BUILDERS) - set(JIT_STEP_BUILDERS))
+    assert not bad, f"INSTRUMENTED_STEP_BUILDERS not in registry: {bad}"
     for name in JIT_STEP_BUILDERS:
         resolve(name)   # moved/renamed builders fail loudly here
 
@@ -424,6 +502,11 @@ def main():
     report = {}
     for name in sorted(AUDITS):
         report[name] = AUDITS[name](ctx)
+    for name in INSTRUMENTED_STEP_BUILDERS:
+        assert report[name].get("instrument_slots"), (
+            f"builder '{name}' is declared instrumented but its audit "
+            f"verified no instrument lanes")
+    report["metrics_scrape"] = _scrape_zero_pulls()
     report["devices"] = N_DEV
     report["batch"] = B
     print(json.dumps(report))

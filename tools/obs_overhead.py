@@ -4,12 +4,18 @@ instrumentation-off; ISSUE 11 extends the same bar to journey tracing).
 
 Reuses bench.py's 10k-key length(1000) -> avg/sum e2e runtime and its
 genuine string-ingest pump (same harness as tools/wal_overhead.py).
-Three measured windows:
+Four measured windows:
 
-- ``off``     — no instrumentation (baseline);
-- ``on``      — full classic instrumentation: ``@app:statistics`` DETAIL
-  (per-batch latency histograms, memory/buffer probes), the structured
-  span tracer (junction dispatch + query step spans per batch,
+- ``off``     — no instrumentation at all (baseline; device instruments
+  forced off via ``profile_device_instruments: false``);
+- ``instruments`` — ONLY the device telemetry plane (ISSUE 12 bar):
+  instrument slots computed inside the jitted step and appended to the
+  meta the host pulls anyway, plus the per-drain decode (a couple of
+  dict writes + O(1) histogram records);
+- ``on``      — device instruments (production default) plus full
+  classic instrumentation: ``@app:statistics`` DETAIL (per-batch
+  latency histograms, memory/buffer probes), the structured span
+  tracer (junction dispatch + query step spans per batch,
   ring-buffered), always-on telemetry (jit cache-hit counting);
 - ``journey`` — everything above PLUS batch-journey critical-path
   tracing (``observability/journey.py``: a Journey object per batch,
@@ -17,9 +23,10 @@ Three measured windows:
   capture (one extra AOT compile per program at warmup, zero
   steady-state work).
 
-Per batch the journey adds a handful of perf_counter reads and O(1)
-histogram records against a multi-ms device step, so both ratios
-should sit near 1.0; the acceptance bar is >= 0.9x for each.
+Per batch the additions are a handful of device reductions,
+perf_counter reads and O(1) histogram records against a multi-ms
+device step, so every ratio should sit near 1.0; the acceptance bar is
+>= 0.9x for each.
 
 Run: ``python tools/obs_overhead.py`` (prints one JSON line). Knobs:
 ``BENCH_SECONDS`` (window per side), ``BENCH_BATCH``.
@@ -42,8 +49,12 @@ def _measure(mode: str, seconds: float) -> float:
     from siddhi_tpu.observability import costmodel, journey
     from siddhi_tpu.observability.tracing import TRACER
 
-    instrumented = mode != "off"
+    instrumented = mode in ("on", "journey")
     manager, rt, _counter = bench._make_e2e_runtime()
+    if mode == "off":
+        # true baseline: the device telemetry plane defaults ON — flip
+        # the per-app knob before the first send (steps build lazily)
+        rt.app_context.profile_device_instruments = False
     if instrumented:
         rt.set_statistics_level("detail")
         TRACER.start()          # default ring capacity; oldest spans drop
@@ -77,6 +88,13 @@ def _measure(mode: str, seconds: float) -> float:
         i += 1
     eps = n / (time.perf_counter() - t0)
     spans = len(TRACER)
+    if mode == "instruments":
+        # the instruments window must actually have drained slot values
+        q = rt.query_runtimes["bench"]
+        assert q._instr_last, "instruments window decoded no slots"
+        hists = rt.app_context.telemetry.snapshot().get("histograms", {})
+        assert any(k.startswith("device.") for k in hists), \
+            "instruments window fed no device.* histograms"
     if instrumented:
         TRACER.stop()
         # sanity: the instrumented window must actually have collected
@@ -105,23 +123,27 @@ def main() -> int:
     import jax
 
     seconds = float(os.environ.get("BENCH_SECONDS", 4.0))
-    # interleave off/on/journey twice to cancel slow drift on shared hosts
-    runs = {"off": [], "on": [], "journey": []}
+    # interleave the modes twice to cancel slow drift on shared hosts
+    runs = {"off": [], "instruments": [], "on": [], "journey": []}
     for _ in range(2):
         for mode in runs:
             runs[mode].append(_measure(mode, seconds))
     eps_off = max(runs["off"])
+    eps_instr = max(runs["instruments"])
     eps_on = max(runs["on"])
     eps_journey = max(runs["journey"])
     out = {
         "backend": jax.devices()[0].platform,
         "batch": int(os.environ.get("BENCH_BATCH", 65_536)),
         "eps_obs_off": round(eps_off, 1),
+        "eps_instruments_on": round(eps_instr, 1),
         "eps_obs_on": round(eps_on, 1),
         "eps_journey_on": round(eps_journey, 1),
+        "ratio_instruments": round(eps_instr / eps_off, 3),
         "ratio": round(eps_on / eps_off, 3),
         "ratio_journey": round(eps_journey / eps_off, 3),
-        "pass_0p9": (eps_on >= 0.9 * eps_off
+        "pass_0p9": (eps_instr >= 0.9 * eps_off
+                     and eps_on >= 0.9 * eps_off
                      and eps_journey >= 0.9 * eps_off),
     }
     print(json.dumps(out))
