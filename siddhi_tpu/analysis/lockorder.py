@@ -34,6 +34,7 @@ RANKS: Dict[str, str] = {
     "pump": "CompletionPump._lock (core/query/completion.py)",
     "shard": "AggregationShard._lock (serving/sharded_aggregation.py)",
     "wal": "IngestWAL._lock (resilience/replay.py)",
+    "ingest": "IngestPackPool._lock (core/stream/input/pack_pool.py)",
 }
 
 # (first, second): `first` must be acquired before `second`; acquiring
@@ -44,6 +45,11 @@ EDGES: Tuple[Tuple[str, str], ...] = (
     ("barrier", "shard"),   # checkpoint_shards runs under the app barrier
     ("shard", "wal"),       # PR-6: fold + WAL record are atomic vs rebuild
     ("barrier", "wal"),     # ingest records the WAL under the barrier
+    # parallel pack runs inside delivery (barrier and owner may be held);
+    # the pool's bookkeeping lock is a leaf — pool workers take NO ranked
+    # locks, so nothing is ever acquired under "ingest"
+    ("barrier", "ingest"),
+    ("owner", "ingest"),
 )
 
 # Static-rule recognizers: `NAME._lock` / `NAME` in a `with` resolves to
@@ -55,6 +61,7 @@ VARIABLE_RANKS: Dict[str, str] = {
     "barrier": "barrier",
     "shard": "shard",
     "wal": "wal",
+    "pool": "ingest",
 }
 
 # Attribute names that denote the app barrier regardless of receiver.

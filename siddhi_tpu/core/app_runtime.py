@@ -912,6 +912,20 @@ class SiddhiAppRuntime:
 
                 instruments.enable()
                 self._instruments_on = True
+            # multicore ingest (core/stream/input/pack_pool.py): with
+            # siddhi_tpu.ingest_pool > 0, pack/encode work shards across
+            # that many supervised worker threads; every pack call site
+            # reads the pool through core.event.pack_pool_of
+            if (self.app_context.ingest_pool > 0
+                    and self.app_context.ingest_pack_pool is None):
+                from siddhi_tpu.core.stream.input.pack_pool import (
+                    IngestPackPool,
+                )
+
+                self.app_context.ingest_pack_pool = IngestPackPool(
+                    self.app_context,
+                    workers=self.app_context.ingest_pool,
+                    split_rows=self.app_context.ingest_split)
             for j in self.junctions.values():
                 j.start_processing()
             scheduler = self.app_context.scheduler
@@ -1096,6 +1110,10 @@ class SiddhiAppRuntime:
             j.stop_processing()
         for sr in self.sink_runtimes:
             sr.shutdown()
+        if self.app_context.ingest_pack_pool is not None:
+            # after junction workers stopped: no pack can be in flight
+            self.app_context.ingest_pack_pool.shutdown()
+            self.app_context.ingest_pack_pool = None
         if self.app_context.scheduler is not None:
             self.app_context.scheduler.shutdown()
         from siddhi_tpu.observability import journey

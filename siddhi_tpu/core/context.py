@@ -259,6 +259,18 @@ class SiddhiAppContext:
         # siddhi_tpu.join_partition_slack. Key
         # siddhi_tpu.join_partition_grow.
         self.join_partition_grow = True
+        # multicore ingest front door (core/stream/input/pack_pool.py):
+        # ingest_pool > 0 shards HostBatch pack/encode work across that
+        # many worker threads as sequence-numbered sub-batches with an
+        # ordered merge — outputs and dictionary id assignment stay
+        # bit-identical to the inline path. 0 (default) = inline.
+        # Keys siddhi_tpu.ingest_pool / siddhi_tpu.ingest_split.
+        self.ingest_pool = 0
+        self.ingest_split = 8192
+        # the live IngestPackPool instance (created by SiddhiAppRuntime
+        # at start when ingest_pool > 0; every pack call site reads it
+        # through core.event.pack_pool_of)
+        self.ingest_pack_pool = None
         # resilience subsystem attach points (siddhi_tpu/resilience/):
         # bounded ingest replay log + app supervisor, set by
         # SiddhiAppRuntime.enable_wal() / .supervise()

@@ -12,6 +12,7 @@ event types (``ComplexEvent.Type``) become an i8 column.
 from __future__ import annotations
 
 import ctypes
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -65,6 +66,13 @@ class StringDictionary:
     def __init__(self):
         self._to_id: Dict[str, int] = {}
         self._to_str: List[str] = []
+        # insert guard: id assignment is check-then-append, and the wire
+        # front door (ThreadingHTTPServer threads in decode_frame) plus
+        # multiple @Async producers can insert concurrently — without
+        # this, the same NEW string can win two different ids and split
+        # one group key in two. Reads stay lock-free (GIL-atomic dict
+        # probe); only the rare miss pays the lock.
+        self._insert_lock = threading.Lock()
         # native accelerator (strdict.cpp): a C++ mirror of _to_id probed
         # once per string by encode_array. Python stays authoritative for
         # the id space — the mirror only ever holds (string, id) pairs
@@ -78,11 +86,16 @@ class StringDictionary:
             return self.NULL_ID
         i = self._to_id.get(s)
         if i is None:
-            i = len(self._to_str)
-            self._to_id[s] = i
-            self._to_str.append(s)
-            if self._native is not None:
-                self._mirror_insert(s, i)
+            with self._insert_lock:
+                i = self._to_id.get(s)     # double-check under the lock
+                if i is None:
+                    i = len(self._to_str)
+                    self._to_str.append(s)
+                    if self._native is not None:
+                        self._mirror_insert(s, i)
+                    # publish the id LAST: a lock-free reader that sees
+                    # the dict entry must find _to_str[i] present
+                    self._to_id[s] = i
         return i
 
     def _mirror_insert(self, s: str, i: int):
@@ -99,12 +112,13 @@ class StringDictionary:
         """Replace the id space wholesale (snapshot restore) — rebuilds the
         native mirror, which would otherwise serve ids from the discarded
         space."""
-        self._to_str = list(strings)
-        self._to_id = {s: i for i, s in enumerate(strings)}
-        if self._native is not None:
-            self._native_lib.strdict_clear(self._native)
-            for i, s in enumerate(strings):
-                self._mirror_insert(s, i)
+        with self._insert_lock:
+            self._to_str = list(strings)
+            self._to_id = {s: i for i, s in enumerate(strings)}
+            if self._native is not None:
+                self._native_lib.strdict_clear(self._native)
+                for i, s in enumerate(strings):
+                    self._mirror_insert(s, i)
 
     def __del__(self):
         try:
@@ -142,20 +156,14 @@ class StringDictionary:
 
     _MISS = -2
 
-    def encode_array(self, values: np.ndarray) -> np.ndarray:
-        """Bulk dictionary encoding — the batched answer to per-event
-        string keys (``GroupByKeyGenerator.java:37``). Fast path: ONE call
-        into the native open-addressing map (strdict.cpp; ~10x the Python
-        dict loop at 65k-row batches); only misses (NEW strings, Nones,
-        non-str values) take the per-element Python path, which also
-        inserts new pairs into the native mirror via ``encode``. Falls
-        back to a per-string Python dict probe when the native lib can't
-        build. Nones encode to NULL_ID."""
-        arr = np.asarray(values, object)
-        if not arr.flags.c_contiguous:
-            arr = np.ascontiguousarray(arr)
-        out = np.empty(len(arr), np.int64)
-        if self._native is None and self._native_lib is None:
+    def _ensure_native(self):
+        """Lazy native-mirror build, guarded so concurrent first probes
+        (ingest pack-pool workers) build it exactly once."""
+        if self._native is not None or self._native_lib is not None:
+            return
+        with _NATIVE_INIT_LOCK:
+            if self._native is not None or self._native_lib is not None:
+                return
             from siddhi_tpu.native import strdict_lib
 
             lib = strdict_lib()
@@ -164,25 +172,67 @@ class StringDictionary:
             else:
                 self._native_lib = lib
                 self._native = ctypes.c_void_p(lib.strdict_new())
-                for s, i in self._to_id.items():
+                # backfill from a SNAPSHOT (a concurrent encode() insert
+                # would otherwise mutate the dict mid-iteration); a pair
+                # inserted twice — here and by that racing encode — is
+                # idempotent, and a pair the snapshot missed at worst
+                # probes as an extra _MISS, resolved correctly by the
+                # serial phase; never a wrong id
+                with self._insert_lock:
+                    items = list(self._to_id.items())
+                for s, i in items:
                     self._mirror_insert(s, i)
+
+    def probe_array(self, values: np.ndarray) -> np.ndarray:
+        """Read-only bulk probe: ids for known strings, ``_MISS`` markers
+        for everything else (new strings, Nones, non-str values) —
+        NOTHING is inserted, so concurrent probes from ingest pack-pool
+        workers are safe. Callers resolve the markers serially (in row
+        order) via :meth:`resolve_missing` so the id ASSIGNMENT order —
+        which snapshots and rank tables observe — stays identical to the
+        single-threaded encode."""
+        arr = np.asarray(values, object)
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+        out = np.empty(len(arr), np.int64)
+        self._ensure_native()
         if self._native is not None:
-            misses = self._native_lib.strdict_encode(
+            self._native_lib.strdict_encode(
                 self._native, arr.ctypes.data_as(ctypes.c_void_p), len(arr),
                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 self.NULL_ID, self._MISS)
-            if misses == 0:
-                return out
         else:
             get = self._to_id.get
             out = np.fromiter((get(v, self._MISS) for v in arr),
                               np.int64, len(arr))
-        miss_idx = np.nonzero(out == self._MISS)[0]
-        if miss_idx.size:
-            for i in miss_idx:
-                v = arr[i]
-                out[i] = (self.NULL_ID if v is None
-                          else self.encode(v if type(v) is str else str(v)))
+        return out
+
+    def resolve_missing(self, ids: np.ndarray, value_of) -> None:
+        """Serial second phase of a bulk encode: replace every ``_MISS``
+        marker in ``ids`` (in index order) by encoding ``value_of(i)`` —
+        the ONLY place a bulk path inserts new strings, so parallel
+        probes stay deterministic."""
+        miss_idx = np.nonzero(ids == self._MISS)[0]
+        for i in miss_idx:
+            v = value_of(int(i))
+            ids[i] = (self.NULL_ID if v is None
+                      else self.encode(v if type(v) is str else str(v)))
+
+    def encode_array(self, values: np.ndarray) -> np.ndarray:
+        """Bulk dictionary encoding — the batched answer to per-event
+        string keys (``GroupByKeyGenerator.java:37``). Fast path: ONE call
+        into the native open-addressing map (strdict.cpp; ~10x the Python
+        dict loop at 65k-row batches); only misses (NEW strings, Nones,
+        non-str values) take the per-element Python path
+        (``resolve_missing``), which also inserts new pairs into the
+        native mirror via ``encode``. Falls back to a per-string Python
+        dict probe when the native lib can't build. Nones encode to
+        NULL_ID."""
+        arr = np.asarray(values, object)
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+        out = self.probe_array(arr)
+        self.resolve_missing(out, lambda i: arr[i])
         return out
 
     def __len__(self):
@@ -208,6 +258,20 @@ def encode_key_tuples(arrays, rows: np.ndarray, id_of) -> np.ndarray:
 # vectorized None-scan over object columns (HostBatch.from_events): one
 # ufunc sweep instead of a per-row `is None` list comprehension
 _NONE_MASK = np.frompyfunc(lambda v: v is None, 1, 1)
+
+# one-shot native strdict bootstrap guard (StringDictionary._ensure_native):
+# plain Lock, not make_lock — held only around the ctypes constructor, no
+# ranked lock is ever taken under it
+_NATIVE_INIT_LOCK = threading.Lock()
+
+
+def pack_pool_of(app_context):
+    """The app's ingest pack pool, or None (pool size 0 / no context) —
+    the one accessor every pack call site uses, so the inline path stays
+    a single getattr (``core/stream/input/pack_pool.py``)."""
+    if app_context is None:
+        return None
+    return getattr(app_context, "ingest_pack_pool", None)
 
 
 def _journey_t0() -> Optional[float]:
@@ -314,7 +378,21 @@ class HostBatch:
         dictionary: StringDictionary,
         pad_to: Optional[int] = None,
         event_type: int = CURRENT,
+        pool=None,
     ) -> "HostBatch":
+        if pool is not None:
+            chunks = pool.plan_events(len(events), definition)
+            if chunks is not None:
+                # multicore ingest (core/stream/input/pack_pool.py): the
+                # encode work runs as sequence-numbered sub-batch tasks
+                # on the pool; the ordered merge keeps outputs AND
+                # dictionary id assignment bit-identical to this inline
+                # path. The plan is computed ONCE and threaded through —
+                # a pool state flip between two plan calls must not
+                # strand the batch between paths.
+                return _parallel_from_events(pool, chunks, events,
+                                             definition, dictionary,
+                                             pad_to, event_type)
         t0 = _journey_t0()
         n = len(events)
         b = pad_to if pad_to is not None else _pad_len(n)
@@ -418,11 +496,19 @@ class HostBatch:
         timestamps: Optional[np.ndarray] = None,
         default_ts: int = 0,
         pad_to: Optional[int] = None,
+        pool=None,
     ) -> "HostBatch":
         """Zero-copy-ish columnar ingestion — the TPU-native fast path that
         skips per-event objects entirely. ``data`` maps attribute names to
         arrays (strings may be numpy object/str arrays, encoded here, or
         pre-encoded int ids). ``<name>?`` null-mask arrays are optional."""
+        if pool is not None:
+            chunks = pool.plan_columns(data, definition)
+            if chunks is not None:
+                return _parallel_from_columns(pool, chunks, data,
+                                              definition, dictionary,
+                                              timestamps, default_ts,
+                                              pad_to)
         t0 = _journey_t0()
         first = next(iter(data.values()))
         n = len(first)
@@ -561,3 +647,171 @@ class HostBatch:
             for ev, g in zip(out, gks):
                 ev.gk = int(g)
         return out
+
+
+# ------------------------------------------------------ parallel ordered pack
+#
+# The multicore half of HostBatch.from_events / from_columns ("Scaling
+# Ordered Stream Processing on Shared-Memory Multicores", PAPERS.md): the
+# encode work of ONE batch is split into sequence-numbered row-range
+# sub-batches that run on the app's IngestPackPool workers, each writing a
+# disjoint slice of the pre-allocated output columns. The ordered merge —
+# waiting the sub-batches out in sequence order, then resolving every NEW
+# string serially in attribute-major row order — keeps the produced arrays
+# AND the dictionary's id-assignment order bit-identical to the inline
+# path, so emission order, WAL records, snapshots and rank tables cannot
+# tell the paths apart. Journey pack attribution follows the PR-11
+# max-not-sum rule: concurrent sub-batch service counts once (the slowest
+# packer), plus the serial merge.
+
+def _parallel_from_events(pool, chunks, events, definition, dictionary,
+                          pad_to, event_type) -> "HostBatch":
+    jt = journey.enabled()
+    n = len(events)
+    b = pad_to if pad_to is not None else _pad_len(n)
+    cols: Dict[str, np.ndarray] = {
+        TS_KEY: np.zeros(b, np.int64),
+        TYPE_KEY: np.full(b, event_type, np.int8),
+        VALID_KEY: np.zeros(b, bool),
+    }
+    cols[VALID_KEY][:n] = True
+    attrs = definition.attributes
+    arrs: Dict[str, np.ndarray] = {}
+    masks: Dict[str, np.ndarray] = {}
+    scratch: Dict[str, np.ndarray] = {}   # string probe ids (_MISS marked)
+    positions = {}
+    for pos, attr in enumerate(attrs):
+        arrs[attr.name] = np.zeros(b, dtype_of(attr.type))
+        masks[attr.name] = np.zeros(b, bool)
+        positions[attr.name] = pos
+        if attr.type == AttrType.STRING:
+            scratch[attr.name] = np.empty(n, np.int64)
+
+    def pack_chunk(lo: int, hi: int) -> None:
+        if jt:
+            journey.maybe_delay("pack")   # planted-bottleneck injection
+        m = hi - lo
+        sub = events[lo:hi]
+        cols[TS_KEY][lo:hi] = np.fromiter(
+            (ev.timestamp for ev in sub), np.int64, m)
+        expired = np.fromiter((ev.is_expired for ev in sub), bool, m)
+        if expired.any():
+            cols[TYPE_KEY][lo:hi][expired] = EXPIRED
+        rows = [ev.data for ev in sub]
+        for pos, attr in enumerate(attrs):
+            if attr.type == AttrType.STRING:
+                col = np.fromiter((r[pos] for r in rows), object, m)
+                # probe only — new strings stay _MISS markers for the
+                # serial merge (deterministic id assignment)
+                scratch[attr.name][lo:hi] = dictionary.probe_array(col)
+            else:
+                zero = False if attr.type == AttrType.BOOL else 0
+                col = np.fromiter((r[pos] for r in rows), object, m)
+                nulls = _NONE_MASK(col).astype(bool)
+                if nulls.any():
+                    masks[attr.name][lo:hi] = nulls
+                    arrs[attr.name][lo:hi] = np.where(nulls, zero, col)
+                else:
+                    arrs[attr.name][lo:hi] = col
+
+    chunk_ms = pool.run_ordered(chunks, pack_chunk)
+    t_merge = time.perf_counter()
+    for attr in attrs:
+        if attr.type == AttrType.STRING:
+            ids = scratch[attr.name]
+            pos = positions[attr.name]
+            # serial miss resolution in row order, attributes in
+            # declaration order — the exact insertion order the inline
+            # per-attribute encode_array produces
+            dictionary.resolve_missing(
+                ids, lambda i, _p=pos: events[i].data[_p])
+            mask = ids == StringDictionary.NULL_ID
+            masks[attr.name][:n] = mask
+            arrs[attr.name][:n] = np.where(mask, 0, ids)
+        cols[attr.name] = arrs[attr.name]
+        cols[attr.name + "?"] = masks[attr.name]
+    batch = HostBatch(cols)
+    merge_ms = (time.perf_counter() - t_merge) * 1000.0
+    pool.record_merge(merge_ms)
+    if jt:
+        # max-not-sum: sub-batches packed concurrently — the pack stage's
+        # service is the slowest packer plus the serial merge
+        journey.stamp_pack_ms(batch, max(chunk_ms, default=0.0) + merge_ms)
+    return batch
+
+
+def _parallel_from_columns(pool, chunks, data, definition, dictionary,
+                           timestamps, default_ts, pad_to) -> "HostBatch":
+    jt = journey.enabled()
+    first = next(iter(data.values()))
+    n = len(first)
+    b = pad_to if pad_to is not None else _pad_len(n)
+    cols: Dict[str, np.ndarray] = {
+        TYPE_KEY: np.full(b, CURRENT, np.int8),
+        VALID_KEY: np.zeros(b, bool),
+    }
+    cols[VALID_KEY][:n] = True
+    ts = np.zeros(b, np.int64)
+    if timestamps is not None:
+        ts_src = np.asarray(timestamps, np.int64)
+    else:
+        ts_src = None
+        ts[:n] = default_ts
+    cols[TS_KEY] = ts
+    attrs = definition.attributes
+    for attr in attrs:
+        if attr.name not in data:
+            raise KeyError(f"column '{attr.name}' missing from batch")
+    arrs: Dict[str, np.ndarray] = {}
+    masks: Dict[str, np.ndarray] = {}
+    scratch: Dict[str, np.ndarray] = {}
+    srcs = {attr.name: np.asarray(data[attr.name]) for attr in attrs}
+    str_obj = {attr.name: (attr.type == AttrType.STRING
+                           and not np.issubdtype(srcs[attr.name].dtype,
+                                                 np.integer))
+               for attr in attrs}
+    for attr in attrs:
+        arrs[attr.name] = np.zeros(b, dtype_of(attr.type))
+        masks[attr.name] = np.zeros(b, bool)
+        if str_obj[attr.name]:
+            scratch[attr.name] = np.empty(n, np.int64)
+
+    def pack_chunk(lo: int, hi: int) -> None:
+        if jt:
+            journey.maybe_delay("pack")
+        if ts_src is not None:
+            ts[lo:hi] = ts_src[lo:hi]
+        for attr in attrs:
+            src = srcs[attr.name]
+            if str_obj[attr.name]:
+                scratch[attr.name][lo:hi] = dictionary.probe_array(
+                    src[lo:hi])
+            elif attr.type == AttrType.STRING:
+                ids = np.asarray(src[lo:hi], np.int64)
+                m = ids < 0           # pre-encoded: negative = null
+                masks[attr.name][lo:hi] = m
+                arrs[attr.name][lo:hi] = np.where(m, 0, ids)
+            else:
+                arrs[attr.name][lo:hi] = src[lo:hi]
+
+    chunk_ms = pool.run_ordered(chunks, pack_chunk)
+    t_merge = time.perf_counter()
+    for attr in attrs:
+        if str_obj[attr.name]:
+            ids = scratch[attr.name]
+            src = srcs[attr.name]
+            dictionary.resolve_missing(ids, lambda i, _s=src: _s[i])
+            mask = ids == StringDictionary.NULL_ID
+            masks[attr.name][:n] = mask
+            arrs[attr.name][:n] = np.where(mask, 0, ids)
+        user_mask = data.get(attr.name + "?")
+        if user_mask is not None:
+            masks[attr.name][:n] |= np.asarray(user_mask, bool)[:n]
+        cols[attr.name] = arrs[attr.name]
+        cols[attr.name + "?"] = masks[attr.name]
+    batch = HostBatch(cols)
+    merge_ms = (time.perf_counter() - t_merge) * 1000.0
+    pool.record_merge(merge_ms)
+    if jt:
+        journey.stamp_pack_ms(batch, max(chunk_ms, default=0.0) + merge_ms)
+    return batch

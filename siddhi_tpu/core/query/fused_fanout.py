@@ -66,7 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from siddhi_tpu.analysis.locks import make_lock
-from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
+from siddhi_tpu.core.event import Event, HostBatch, LazyColumns, pack_pool_of
 from siddhi_tpu.observability import journey
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, STR_RANK
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
@@ -266,7 +266,8 @@ class FusedFanoutRuntime(Receiver):
 
     def receive(self, events: List[Event]):
         batch = HostBatch.from_events(
-            events, self.input_definition, self.dictionary)
+            events, self.input_definition, self.dictionary,
+            pool=pack_pool_of(self.app_context))
         self.process_batch(batch)
 
     def receive_batch(self, batch: HostBatch, junction=None):

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
+from siddhi_tpu.core.event import Event, HostBatch, LazyColumns, pack_pool_of
 from siddhi_tpu.core.plan.selector_plan import FLUSH_KEY, GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
@@ -382,7 +382,9 @@ class JoinSideProxy(Receiver):
                     batch.cols[PK_KEY] = pk
                     self.runtime.process_side_batch(self.side_key, batch)
             return
-        batch = HostBatch.from_events(events, side.pack_definition, self.runtime.dictionary)
+        batch = HostBatch.from_events(
+            events, side.pack_definition, self.runtime.dictionary,
+            pool=pack_pool_of(self.runtime.app_context))
         self.runtime.process_side_batch(self.side_key, batch)
 
 

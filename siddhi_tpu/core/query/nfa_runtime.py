@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from siddhi_tpu.core.event import Event, HostBatch, LazyColumns
+from siddhi_tpu.core.event import Event, HostBatch, LazyColumns, pack_pool_of
 from siddhi_tpu.core.plan.selector_plan import GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
 from siddhi_tpu.core.stream.junction import FatalQueryError, Receiver
@@ -53,7 +53,9 @@ class StreamProxy(Receiver):
         self.definition = definition
 
     def receive(self, events: List[Event]):
-        batch = HostBatch.from_events(events, self.definition, self.runtime.dictionary)
+        batch = HostBatch.from_events(
+            events, self.definition, self.runtime.dictionary,
+            pool=pack_pool_of(self.runtime.app_context))
         self.runtime.process_stream_batch(self.stream_id, batch)
 
     def receive_batch(self, batch: HostBatch, junction=None):

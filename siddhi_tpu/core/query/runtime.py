@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from siddhi_tpu.analysis.locks import make_lock
-from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, LazyColumns, StringDictionary
+from siddhi_tpu.core.event import CURRENT, EXPIRED, TIMER as TIMER_TYPE, Event, HostBatch, LazyColumns, StringDictionary, pack_pool_of
 from siddhi_tpu.observability import instruments, journey
 from siddhi_tpu.observability.instruments import Slot
 from siddhi_tpu.core.plan.selector_plan import GK_KEY, SelectorPlan
@@ -591,7 +591,9 @@ class QueryRuntime(Receiver):
     # ----------------------------------------------------------- processing
 
     def receive(self, events: List[Event]):
-        batch = HostBatch.from_events(events, self.input_definition, self.dictionary)
+        batch = HostBatch.from_events(events, self.input_definition,
+                                      self.dictionary,
+                                      pool=pack_pool_of(self.app_context))
         if self.carried_pk:
             pk = np.zeros(batch.capacity, np.int32)
             for i, e in enumerate(events):

@@ -100,7 +100,7 @@ class InputHandler:
         that understand batches consume them directly."""
         import numpy as np
 
-        from siddhi_tpu.core.event import HostBatch
+        from siddhi_tpu.core.event import HostBatch, pack_pool_of
 
         if self._ensure_started is not None:
             self._ensure_started()
@@ -109,7 +109,8 @@ class InputHandler:
         batch = HostBatch.from_columns(
             data, self.junction.definition,
             self.app_context.string_dictionary,
-            timestamps=timestamps, default_ts=now)
+            timestamps=timestamps, default_ts=now,
+            pool=pack_pool_of(self.app_context))
         wal = getattr(self.app_context, "ingest_wal", None)
         replaying = wal is not None and wal.in_replay()
         with self._barrier:

@@ -101,6 +101,13 @@ KNOBS: Dict[str, Knob] = _declare(
     Knob("join_partitions", "int", attr="join_partitions"),
     Knob("join_partition_slack", "int", attr="join_partition_slack"),
     Knob("index_probe_width", "int", attr="index_probe_width"),
+    # multicore ingest front door (core/stream/input/pack_pool.py):
+    # ingest_pool = pack-pool worker count (0 = today's inline
+    # single-thread pack, bit-identical); ingest_split = rows per
+    # sequence-numbered sub-batch task — batches smaller than two
+    # sub-batches stay inline. See MIGRATION.md round-10 notes.
+    Knob("ingest_pool", "int", attr="ingest_pool"),
+    Knob("ingest_split", "int", attr="ingest_split"),
     # booleans (each previously had its own — or no — spelling parser)
     Knob("join_partition_grow", "bool", attr="join_partition_grow"),
     Knob("fuse_fanout", "bool", attr="fuse_fanout"),

@@ -56,6 +56,10 @@ TELEMETRY_PREFIXES = (
     "scrape",        # /metrics self-timing (siddhi_scrape_ms)
     "device",        # device-instrument slots riding the meta vector
                      # (observability/instruments.py -> siddhi_device_*)
+    "ingest",        # multicore ingest front door: pack-pool gauges,
+                     # pack/merge histograms, wire-frame counters
+                     # (core/stream/input/pack_pool.py + wire.py ->
+                     # siddhi_ingest_*)
 )
 
 # --- graftlint R6 declarations (device-instrument parity) ------------
@@ -138,6 +142,37 @@ _FANOUT_GAUGE = re.compile(r"^fanout\.(?P<stream>.+)\.group_size$")
 _FANOUT_COUNTER = re.compile(r"^fanout\.(?P<stream>.+)\.(?P<kind>"
                              r"dispatches|meta_pulls)$")
 _PIPELINE_GAUGE = re.compile(r"^pipeline\.(?P<query>.+)\.inflight$")
+# multicore ingest front door (core/stream/input/): pack-pool health
+# gauges, per-sub-batch pack + per-batch ordered-merge histograms, and
+# wire-frame ingest counters
+_INGEST_POOL_GAUGE = re.compile(r"^ingest\.pool\.(?P<kind>"
+                                r"queue_depth|workers|utilization)$")
+_INGEST_HIST_FAMILY = {
+    "ingest.pack_ms": ("siddhi_ingest_pack_ms",
+                       "ingest pack-pool sub-batch encode service time "
+                       "(ms; one sample per sequence-numbered sub-batch)"),
+    "ingest.merge_ms": ("siddhi_ingest_merge_ms",
+                        "ordered-merge time per parallel-packed batch "
+                        "(ms; serial dictionary miss resolution + column "
+                        "finalize)"),
+}
+_INGEST_COUNTER_FAMILY = {
+    "ingest.wire.frames": ("siddhi_ingest_wire_frames_total",
+                           "binary wire frames accepted on "
+                           "POST /ingest/{stream}"),
+    "ingest.wire.bytes": ("siddhi_ingest_wire_bytes_total",
+                          "wire-frame bytes accepted on "
+                          "POST /ingest/{stream}"),
+    "ingest.wire.events": ("siddhi_ingest_wire_events_total",
+                           "events ingested through the wire-format "
+                           "front door"),
+    "ingest.pool.repacks": ("siddhi_ingest_repacks_total",
+                            "sub-batches re-packed inline after a dead "
+                            "ingest pack worker (re-packed, never lost)"),
+    "ingest.pool.worker_deaths": ("siddhi_ingest_worker_deaths_total",
+                                  "ingest pack-pool worker threads that "
+                                  "died (respawned by pool/supervisor)"),
+}
 # pipeline.metas / pipeline.pulls: metas-per-pull batching ratio;
 # pipeline.stalls: forced drains that had to wait on an unready meta
 _PIPELINE_COUNTER_FAMILY = {
@@ -411,6 +446,17 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                     family, help_ = _JITCOST_HELP[m.group("metric")]
                     fams.add(family, "gauge", help_,
                              {**base, "key": m.group("key")}, v)
+                elif _INGEST_POOL_GAUGE.match(name):
+                    m = _INGEST_POOL_GAUGE.match(name)
+                    kind = m.group("kind")
+                    fams.add(f"siddhi_ingest_pool_{kind}", "gauge",
+                             {"queue_depth": "sub-batch tasks queued on "
+                                             "the ingest pack pool",
+                              "workers": "live ingest pack-pool worker "
+                                         "threads",
+                              "utilization": "fraction of ingest pack "
+                                             "workers busy"}[kind],
+                             base, v)
                 elif name in ("serving.pool.pending", "serving.pool.active"):
                     kind = name.rsplit(".", 1)[1]
                     fams.add(f"siddhi_serving_pool_{kind}", "gauge",
@@ -455,6 +501,8 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
         fam = _PIPELINE_COUNTER_FAMILY.get(name)
         if fam is None:
             fam = _SERVING_COUNTER_FAMILY.get(name)
+        if fam is None:
+            fam = _INGEST_COUNTER_FAMILY.get(name)
         if fam is not None:
             fams.add(fam[0], "counter", fam[1], base, v)
             continue
@@ -462,7 +510,7 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                  "named event counter",
                  {**base, "name": name}, v)
     for name, snap in sorted(tel_snapshot.get("histograms", {}).items()):
-        fam = _SERVING_HIST_FAMILY.get(name)
+        fam = _SERVING_HIST_FAMILY.get(name) or _INGEST_HIST_FAMILY.get(name)
         labels = dict(base)
         if fam is not None:
             family, help_ = fam

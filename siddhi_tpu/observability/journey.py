@@ -297,6 +297,14 @@ def stamp_pack(batch, t0: float) -> None:
     batch.journey = Journey(pack_ms=(time.perf_counter() - t0) * 1000.0)
 
 
+def stamp_pack_ms(batch, pack_ms: float) -> None:
+    """Pack stamp with a caller-computed service time — the parallel
+    ingest pack path (``core/event._parallel_from_events``) attributes
+    max-over-sub-batches plus the serial merge, per the max-not-sum rule
+    (concurrent packer time must not count once per worker)."""
+    batch.journey = Journey(pack_ms=float(pack_ms))
+
+
 def begin(batch) -> Journey:
     """Per-receiver journey for a delivered batch: forks the batch's
     pack stamp (N receivers must not share mutable stage state) and

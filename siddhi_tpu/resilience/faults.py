@@ -36,6 +36,7 @@ class FaultInjector:
         self._peer_fault_armed = False
         self._flood_threads = []          # non-blocking flood producers
         self._delayed_junctions = []      # persistent delay_worker targets
+        self._delayed_pools = []          # armed ingest pack pools
 
     # ------------------------------------------------- junction workers
 
@@ -95,6 +96,35 @@ class FaultInjector:
                 time.sleep(seconds)
 
         junction.fault_hook = hook
+
+    # ---------------------------------------------- ingest pack-pool workers
+
+    def kill_packer(self, pool) -> None:
+        """Arm a one-shot crash on the ingest pack pool
+        (``core/stream/input/pack_pool.py``): the next sub-batch task's
+        worker dies mid-claim — the merging thread re-packs that
+        sub-batch inline (never lost) and the pool/supervisor respawn
+        the thread."""
+        def hook(p):
+            p.fault_hook = None
+            raise WorkerKilled("injected kill on ingest pack worker")
+
+        pool.fault_hook = hook
+        self._delayed_pools.append(pool)
+
+    def delay_packer(self, pool, seconds: float) -> None:
+        """Arm a one-shot delivery delay on ONE ingest pack worker: the
+        next sub-batch completes ``seconds`` late, forcing out-of-order
+        sub-batch completion — the scenario the pool's ordered merge
+        must absorb bit-identically."""
+        import time as _time
+
+        def hook(p):
+            p.fault_hook = None
+            _time.sleep(seconds)
+
+        pool.fault_hook = hook
+        self._delayed_pools.append(pool)
 
     def delay_stage(self, stage: str, seconds: float) -> None:
         """Plant a persistent service delay inside an instrumented
@@ -234,6 +264,9 @@ class FaultInjector:
         for j in self._delayed_junctions:
             j.fault_hook = None
         self._delayed_junctions.clear()
+        for p in self._delayed_pools:
+            p.fault_hook = None
+        self._delayed_pools.clear()
         from siddhi_tpu.observability import journey
 
         journey.clear_delays()

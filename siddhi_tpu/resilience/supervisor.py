@@ -307,6 +307,20 @@ class AppSupervisor:
                 self._beat_seen[sid] = (j._beats, now)
                 stat_count(self.app_runtime.app_context,
                            "resilience.worker_restarts")
+        # ingest pack-pool workers are supervised like junction workers:
+        # a dead packer already had its sub-batch re-packed by the merge
+        # thread (never lost); the tick respawns the thread so capacity
+        # recovers without waiting for the next submit
+        pool = getattr(self.app_runtime.app_context, "ingest_pack_pool",
+                       None)
+        if pool is not None:
+            healed = pool.heal()
+            if healed:
+                log.warning("supervisor: respawned %d dead ingest pack "
+                            "worker(s)", healed)
+                self.worker_restarts += healed
+                stat_count(self.app_runtime.app_context,
+                           "resilience.worker_restarts", healed)
         self._check_pump()
 
     def _check_pump(self) -> None:

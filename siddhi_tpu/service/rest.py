@@ -19,6 +19,13 @@ Endpoints (JSON in/out):
 - ``GET  /apps/<name>/statistics``     — metrics snapshot
 - ``POST /apps/<name>/persist``        — checkpoint; -> ``{"revision": ...}``
 - ``POST /apps/<name>/restore``        — ``{"revision": optional}`` (last when omitted)
+- ``POST /ingest/<stream>[?app=name]`` — body = ONE binary zero-copy
+  columnar wire frame (``core/stream/input/wire.py``; encoder in
+  ``tools/wire_bench.py``): the production telemetry front door.
+  AdmissionPool-fronted (503 + Retry-After past the per-endpoint cap);
+  malformed frames answer 400 naming the defect; landed through
+  ``InputHandler.send_columns`` so quotas/WAL/enforceOrder/journeys
+  all apply
 
 Observability (``siddhi_tpu/observability/``):
 
@@ -99,6 +106,18 @@ class SiddhiRestService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_shed(self, e):
+                """503 + Retry-After for admission sheds (/query and
+                /ingest share the policy — one place to change it)."""
+                self.send_response(503)
+                self.send_header("Retry-After", "1")
+                payload = json.dumps(
+                    {"error": str(e), "shed": True}).encode("utf-8")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
             def _body(self):
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n) if n else b""
@@ -128,6 +147,11 @@ class SiddhiRestService:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
         self._device_tracing: Optional[str] = None  # active profile dir
+        # zero-copy ingest front door (core/stream/input/wire.py):
+        # per-encoder dictionary-delta LUTs for POST /ingest/{stream}
+        from siddhi_tpu.core.stream.input.wire import DecoderRegistry
+
+        self._wire_decoders = DecoderRegistry()
 
     # ----------------------------------------------------------- lifecycle
 
@@ -210,7 +234,17 @@ class SiddhiRestService:
         h._send(404, {"error": f"unknown path {h.path}"})
 
     def _post(self, h):
-        parts = [p for p in h.path.split("/") if p]
+        from urllib.parse import parse_qs, urlsplit
+
+        split = urlsplit(h.path)
+        parts = [p for p in split.path.split("/") if p]
+        if len(parts) == 2 and parts[0] == "ingest":
+            # binary wire frame — raw bytes, never the utf-8 _body path
+            n = int(h.headers.get("Content-Length", 0))
+            raw = h.rfile.read(n) if n else b""
+            app = parse_qs(split.query).get("app", [None])[0]
+            self._post_ingest(h, parts[1], raw, app)
+            return
         body = h._body()
         if parts == ["apps"]:
             if not isinstance(body, str) or not body.strip():
@@ -237,14 +271,7 @@ class SiddhiRestService:
                     endpoint, rt.query, body["query"], cap=cap)
             except QueryShedError as e:
                 stat_count(rt.app_context, "resilience.query_sheds")
-                h.send_response(503)
-                h.send_header("Retry-After", "1")
-                payload = json.dumps(
-                    {"error": str(e), "shed": True}).encode("utf-8")
-                h.send_header("Content-Type", "application/json")
-                h.send_header("Content-Length", str(len(payload)))
-                h.end_headers()
-                h.wfile.write(payload)
+                h._send_shed(e)
                 return
             events = fut.result()
             h._send(200, {"rows": [list(e.data) for e in events]})
@@ -344,6 +371,78 @@ class SiddhiRestService:
                 h._send(200, {"revision": rev})
                 return
         h._send(404, {"error": f"unknown path {h.path}"})
+
+    def _post_ingest(self, h, stream: str, raw: bytes,
+                     app: Optional[str]) -> None:
+        """``POST /ingest/{stream}[?app=name]`` — the zero-copy columnar
+        front door: body = one binary wire frame
+        (``core/stream/input/wire.py``), landed through the stream's
+        ``InputHandler.send_columns`` so quota admission, the ingest
+        WAL, @app:enforceOrder, and batch-journey tracing all ride
+        exactly like any other producer. AdmissionPool-fronted: past the
+        per-endpoint cap the frame is SHED with 503 + Retry-After
+        instead of stacking handler threads behind the app barrier."""
+        from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+        from siddhi_tpu.core.stream.input.wire import decode_frame
+        from siddhi_tpu.serving.query_tier import QueryShedError
+
+        if app is not None:
+            rt = self.manager.get_siddhi_app_runtime(app)
+            if rt is None:
+                # routing errors are 404s, matching the no-?app branch —
+                # 400 is reserved for malformed frames
+                h._send(404, {"error": f"app '{app}' is not deployed"})
+                return
+            if stream not in rt.junctions:
+                h._send(404, {"error": f"stream '{stream}' is not "
+                                       f"defined in app '{app}'"})
+                return
+        else:
+            owners = [r for r in self.manager.app_runtimes.values()
+                      if stream in r.junctions]
+            if not owners:
+                h._send(404, {"error": f"no deployed app defines stream "
+                                       f"'{stream}'"})
+                return
+            if len(owners) > 1:
+                h._send(409, {"error": f"stream '{stream}' is defined by "
+                                       f"multiple apps "
+                                       f"{sorted(r.name for r in owners)} "
+                                       f"— disambiguate with ?app=<name>"})
+                return
+            rt = owners[0]
+
+        def ingest():
+            # scope=app name: the shared registry's LUTs hold THIS app's
+            # dictionary ids — an encoder posting to two apps gets two
+            # independent delta states
+            data, ts = decode_frame(
+                raw, rt.junctions[stream].definition,
+                rt.app_context.string_dictionary, self._wire_decoders,
+                scope=rt.name)
+            n = len(next(iter(data.values()))) if data else 0
+            handler = rt.get_input_handler(stream)
+            handler.send_columns(data, timestamps=ts)
+            tel = rt.app_context.telemetry
+            tel.count("ingest.wire.frames")
+            tel.count("ingest.wire.bytes", len(raw))
+            tel.count("ingest.wire.events", n)
+            return n
+
+        try:
+            fut = self.admission.try_submit(f"/ingest:{rt.name}", ingest)
+        except QueryShedError as e:
+            h._send_shed(e)
+            return
+        try:
+            accepted = fut.result()
+        except SiddhiAppValidationException as e:
+            # malformed frame / dictionary gap: the client's fault — 400
+            # with the exact reason, never a 500, never a partial batch
+            h._send(400, {"error": str(e)})
+            return
+        h._send(200, {"accepted": accepted, "stream": stream,
+                      "app": rt.name})
 
     def _post_profile(self, h, what: str, action: str, body):
         """``POST /profile/{journeys|costs|device}/{start|stop}`` — the

@@ -1,0 +1,369 @@
+"""Multicore ingest pack pool (core/stream/input/pack_pool.py).
+
+Ordered-merge exactness under concurrency, out-of-order sub-batch
+completion, packer death (re-packed, never lost), WAL replay and shed
+accounting bit-identical to the inline path, and journey pack-stage
+attribution (max-not-sum) at pool sizes 0 and 2."""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.util.config import InMemoryConfigManager
+from siddhi_tpu.core.util.persistence import InMemoryPersistenceStore
+from siddhi_tpu.observability import journey
+from siddhi_tpu.resilience.faults import FaultInjector
+
+APP = """
+@app:enforceOrder
+define stream S (sym string, v double, n long);
+@info(name='q') from S#window.length(64)
+  select sym, sum(v) as sv, count() as c group by sym
+  insert into Out;
+"""
+
+ASYNC_APP = """
+@Async(buffer.size='8')
+define stream S (sym string, v double, n long);
+@info(name='q') from S
+  select sym, v, n insert into Out;
+"""
+
+
+class Collector(StreamCallback):
+    def __init__(self):
+        super().__init__()
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend((e.timestamp, tuple(e.data)) for e in events)
+
+
+def _manager(pool, split=128, extra=None):
+    m = SiddhiManager()
+    cfg = {"siddhi_tpu.ingest_pool": str(pool),
+           "siddhi_tpu.ingest_split": str(split)}
+    cfg.update(extra or {})
+    m.set_config_manager(InMemoryConfigManager(cfg))
+    return m
+
+
+def _batches(n_batches=5, rows=700, seed=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    ts = 0
+    for b in range(n_batches):
+        keys = rng.integers(0, 15 + 25 * b, rows)   # new strings per batch
+        evs = []
+        for i in range(rows):
+            sym = None if i % 97 == 5 else f"K{keys[i]}"
+            evs.append(Event(timestamp=ts, data=[
+                sym, float(np.round(rng.random() * 10, 6)), int(i)]))
+            ts += 1
+        out.append(evs)
+    return out
+
+
+def _run(pool, app=APP, arm=None, split=128):
+    m = _manager(pool, split=split)
+    rt = m.create_siddhi_app_runtime(app)
+    c = Collector()
+    rt.add_callback("Out", c)
+    rt.start()
+    pl = rt.app_context.ingest_pack_pool
+    if arm is not None:
+        arm(pl)
+    h = rt.get_input_handler("S")
+    for evs in _batches():
+        h.send(evs)
+    strings = list(rt.app_context.string_dictionary._to_str)
+    tel = rt.app_context.telemetry.snapshot()
+    stats = {"repacks": getattr(pl, "repacked_subbatches", 0),
+             "deaths": getattr(pl, "worker_deaths", 0),
+             "alive": pl.alive_workers() if pl is not None else 0}
+    m.shutdown()
+    return c.rows, strings, tel, stats
+
+
+REF = None
+
+
+def _reference():
+    global REF
+    if REF is None:
+        REF = _run(pool=0)
+    return REF
+
+
+# ---------------------------------------------------------------- identity
+
+
+def test_pool_bit_identity_and_dictionary_order():
+    ref_rows, ref_strings, _, _ = _reference()
+    rows, strings, tel, _ = _run(pool=2)
+    assert rows == ref_rows and len(rows) > 0
+    assert strings == ref_strings          # id ASSIGNMENT order identical
+    hists = tel.get("histograms", {})
+    assert hists.get("ingest.pack_ms", {}).get("count", 0) > 0
+    assert hists.get("ingest.merge_ms", {}).get("count", 0) > 0
+
+
+def test_columns_path_bit_identity():
+    def run(pool):
+        m = _manager(pool)
+        rt = m.create_siddhi_app_runtime(APP)
+        c = Collector()
+        rt.add_callback("Out", c)
+        h = rt.get_input_handler("S")
+        rng = np.random.default_rng(11)
+        ts = 0
+        for b in range(4):
+            n = 900
+            keys = rng.integers(0, 30 + 30 * b, n)
+            syms = np.array([f"C{k}" for k in keys], dtype=object)
+            syms[7] = None
+            h.send_columns(
+                {"sym": syms, "v": np.round(rng.random(n), 6),
+                 "n": np.arange(n, dtype=np.int64)},
+                timestamps=np.arange(ts, ts + n, dtype=np.int64))
+            ts += n
+        strings = list(rt.app_context.string_dictionary._to_str)
+        m.shutdown()
+        return c.rows, strings
+
+    r0, s0 = run(0)
+    r2, s2 = run(2)
+    assert r0 == r2 and len(r0) > 0
+    assert s0 == s2
+
+
+def test_out_of_order_subbatch_completion_stays_ordered():
+    """FaultInjector.delay_packer: one sub-batch completes LATE, so the
+    pool observes out-of-order completion — the ordered merge (and
+    everything downstream: emission order, @app:enforceOrder) must be
+    bit-identical anyway."""
+    inj = FaultInjector()
+    try:
+        rows, strings, _, _ = _run(
+            pool=2, arm=lambda p: inj.delay_packer(p, 0.1))
+    finally:
+        inj.clear()
+    ref_rows, ref_strings, _, _ = _reference()
+    assert rows == ref_rows
+    assert strings == ref_strings
+
+
+def test_kill_packer_subbatch_repacked_not_lost():
+    inj = FaultInjector()
+    try:
+        rows, strings, tel, stats = _run(
+            pool=2, arm=lambda p: inj.kill_packer(p))
+    finally:
+        inj.clear()
+    ref_rows, ref_strings, _, _ = _reference()
+    assert rows == ref_rows                # nothing lost, order exact
+    assert strings == ref_strings
+    assert stats["repacks"] >= 1
+    assert stats["deaths"] == 1
+    assert stats["alive"] == 2             # respawned on a later submit
+    assert tel["counters"].get("ingest.pool.repacks", 0) >= 1
+
+
+def test_supervisor_heals_dead_packers():
+    m = _manager(2)
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.add_callback("Out", Collector())
+    rt.start()
+    sup = rt.supervise(interval_s=0.05)
+    pool = rt.app_context.ingest_pack_pool
+    inj = FaultInjector()
+    inj.kill_packer(pool)
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=i, data=[f"K{i % 9}", 1.0, i])
+            for i in range(1000)])
+    import time
+
+    deadline = time.time() + 5.0
+    while pool.alive_workers() < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    alive = pool.alive_workers()
+    inj.clear()
+    sup.stop()
+    m.shutdown()
+    assert alive == 2
+
+
+# ------------------------------------------------------------ WAL / shed
+
+
+def test_wal_replay_after_restore_bit_identical_with_pool():
+    """persist -> crash -> restore + WAL suffix replay with pool=2
+    reproduces EXACTLY the uninterrupted pool-0 output stream."""
+    batches = _batches()
+
+    def uninterrupted():
+        m = _manager(0)
+        rt = m.create_siddhi_app_runtime(APP)
+        c = Collector()
+        rt.add_callback("Out", c)
+        h = rt.get_input_handler("S")
+        for evs in batches:
+            h.send(evs)
+        m.shutdown()
+        return c.rows
+
+    store = InMemoryPersistenceStore()
+    m1 = _manager(2)
+    m1.set_persistence_store(store)
+    rt1 = m1.create_siddhi_app_runtime(APP)
+    c1 = Collector()
+    rt1.add_callback("Out", c1)
+    wal = rt1.enable_wal()
+    h = rt1.get_input_handler("S")
+    for evs in batches[:2]:
+        h.send(evs)
+    rt1.persist()
+    for evs in batches[2:4]:
+        h.send(evs)
+    assert len(wal) == 2
+    rows_before = list(c1.rows)
+    m1.shutdown()
+
+    m2 = _manager(2)
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(APP)
+    c2 = Collector()
+    rt2.add_callback("Out", c2)
+    rt2.app_context.ingest_wal = wal
+    assert rt2.restore_last_revision() is not None
+    h2 = rt2.get_input_handler("S")
+    for evs in batches[4:]:
+        h2.send(evs)
+    m2.shutdown()
+
+    expected = uninterrupted()
+    assert rows_before == expected[:len(rows_before)]
+    # checkpoint covered batches 0-1 (one output row per input event);
+    # the new runtime replays the WAL suffix (batches 2-3, exactly once)
+    # and continues live — together the uninterrupted stream, bit-exact
+    n_checkpoint = 2 * 700
+    assert rows_before[:n_checkpoint] + c2.rows == expected
+
+
+def test_shed_accounting_identical_inline_vs_pool():
+    """shed_newest past the queue quota with a wedged consumer: shed
+    counts, emitted rows and WAL retention are identical at pool 0 and
+    2 (admission runs BEFORE pack — the pool must not perturb it)."""
+    def run(pool):
+        m = _manager(pool, extra={
+            "siddhi_tpu.quota_queue_depth.S": "3",
+            "siddhi_tpu.shed_policy.S": "shed_newest"})
+        rt = m.create_siddhi_app_runtime(ASYNC_APP)
+        c = Collector()
+        rt.add_callback("Out", c)
+        rt.start()
+        wal = rt.enable_wal()
+        inj = FaultInjector()
+        j = rt.junctions["S"]
+        inj.wedge_worker(j)
+        h = rt.get_input_handler("S")
+        h.send([Event(timestamp=0, data=["w", 0.0, 0])])   # enter the wedge
+        assert inj.wait_wedged()
+        for b in range(8):                  # quota 3: the tail is shed
+            h.send([Event(timestamp=1 + b, data=[f"K{b}", float(b), b])])
+        shed = rt.app_context.telemetry.snapshot()["counters"].get(
+            "junction.S.shed_events", 0)
+        retained = [r.seq for r in wal.records_after(0)]
+        inj.release()
+        import time
+
+        deadline = time.time() + 5.0
+        while time.time() < deadline and j._queue.qsize() > 0:
+            time.sleep(0.02)
+        inj.clear()
+        rows = list(c.rows)
+        m.shutdown()
+        return shed, retained, rows
+
+    shed0, ret0, rows0 = run(0)
+    shed2, ret2, rows2 = run(2)
+    assert shed0 > 0
+    assert (shed0, ret0) == (shed2, ret2)
+    assert rows0 == rows2
+
+
+# ------------------------------------------------------- journey / knobs
+
+
+@pytest.mark.parametrize("pool", [0, 2])
+def test_pack_bottleneck_named_at_both_pool_sizes(pool):
+    """FaultInjector.delay_stage('pack') plants the bottleneck inside
+    the pack stage; the critical-path report must name pack whether the
+    stage runs inline or as parallel sub-batches (max-not-sum: two
+    concurrent delayed packers must not double the attributed time)."""
+    m = _manager(pool, split=128)
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.add_callback("Out", Collector())
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=i, data=[f"K{i % 9}", 1.0, i])
+            for i in range(300)])          # warm compiles pre-journey
+    journey.enable()
+    inj = FaultInjector()
+    inj.delay_stage("pack", 0.02)
+    try:
+        base = 300
+        for b in range(6):
+            h.send([Event(timestamp=base, data=[f"K{b}", 1.0, b]),
+                    *[Event(timestamp=base + i, data=[f"K{i % 9}", 1.0, i])
+                      for i in range(1, 300)]])
+            base += 300
+    finally:
+        inj.clear()
+        journey.disable(force=True)
+    rep = journey.critical_path_report(m)
+    q = rep["apps"][rt.name]["queries"]["q"]
+    assert q["bottleneck"] is not None
+    assert q["bottleneck"]["stage"] == "pack", q["bottleneck"]
+    mean = q["stages"]["pack"]["mean_service_ms"]
+    assert mean >= 15.0
+    # max-not-sum: 2 concurrent delayed sub-batches attribute ~one delay
+    # (+ merge), never the 40ms+ a sum-over-workers would report
+    assert mean < 38.0, mean
+    m.shutdown()
+
+
+def test_small_batches_stay_inline():
+    m = _manager(4, split=8192)
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.add_callback("Out", Collector())
+    h = rt.get_input_handler("S")
+    h.send([Event(timestamp=i, data=["a", 1.0, i]) for i in range(64)])
+    snap = rt.app_context.telemetry.snapshot()
+    assert snap.get("histograms", {}).get(
+        "ingest.pack_ms", {}).get("count", 0) == 0
+    m.shutdown()
+
+
+def test_pool_gauges_registered_and_removed():
+    m = _manager(2)
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.add_callback("Out", Collector())
+    rt.start()
+    gauges = rt.app_context.telemetry.snapshot()["gauges"]
+    assert gauges.get("ingest.pool.workers") == 2.0
+    assert "ingest.pool.queue_depth" in gauges
+    assert "ingest.pool.utilization" in gauges
+    tel = rt.app_context.telemetry
+    m.shutdown()
+    assert "ingest.pool.workers" not in tel.snapshot()["gauges"]
+
+
+def test_ingest_knob_junk_raises():
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.ingest_pool": "many"}))
+    with pytest.raises(SiddhiAppValidationException,
+                       match="ingest_pool"):
+        m.create_siddhi_app_runtime(APP)

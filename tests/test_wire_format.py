@@ -1,0 +1,323 @@
+"""Wire-format round-trip edge cases (core/stream/input/wire.py).
+
+Deterministic coverage of the frame protocol — empty batch, all-null
+columns, dictionary delta growth, non-ASCII strings, truncation and
+corruption (clean ``SiddhiAppValidationException``, never a crash or a
+silent partial batch) — plus a hypothesis property sweep over random
+schemas (skipped where hypothesis is absent, per the
+test_property_chunking convention)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from siddhi_tpu.compiler.errors import SiddhiAppValidationException
+from siddhi_tpu.core.event import HostBatch, StringDictionary
+from siddhi_tpu.core.stream.input.wire import (
+    MAGIC, DecoderRegistry, WireEncoder, decode_frame)
+from siddhi_tpu.query_api.definitions import (
+    Attribute, AttrType, StreamDefinition)
+
+
+def _definition(attrs):
+    return StreamDefinition("S", attributes=[
+        Attribute(name, t) for name, t in attrs])
+
+
+DEF3 = _definition([("sym", AttrType.STRING), ("v", AttrType.DOUBLE),
+                    ("n", AttrType.LONG)])
+
+
+def _decode(frame, definition=DEF3, dictionary=None, registry=None):
+    # explicit None checks: an EMPTY StringDictionary is falsy (__len__)
+    if dictionary is None:
+        dictionary = StringDictionary()
+    if registry is None:
+        registry = DecoderRegistry()
+    return decode_frame(frame, definition, dictionary, registry)
+
+
+def _strings_of(data, dictionary, name="sym"):
+    return [dictionary.decode(int(i)) if i >= 0 else None
+            for i in data[name]]
+
+
+# ------------------------------------------------------------ round trips
+
+
+def test_round_trip_basic():
+    enc = WireEncoder()
+    syms = np.array(["a", "b", None, "a", "Grüße-☃"], dtype=object)
+    v = np.array([1.5, -2.0, 0.0, 3.25, 1e9])
+    n = np.arange(5, dtype=np.int64)
+    ts = np.array([10, 20, 30, 40, 50], dtype=np.int64)
+    d = StringDictionary()
+    data, wts = _decode(enc.encode({"sym": syms, "v": v, "n": n},
+                                   timestamps=ts), dictionary=d)
+    assert _strings_of(data, d) == ["a", "b", None, "a", "Grüße-☃"]
+    assert np.array_equal(np.asarray(data["v"]), v)
+    assert np.array_equal(np.asarray(data["n"]), n)
+    assert np.array_equal(np.asarray(wts), ts)
+
+
+def test_round_trip_feeds_from_columns_bit_identically():
+    """The wire path must land EXACTLY what direct send_columns lands:
+    same HostBatch columns, pre-encoded ids included."""
+    enc = WireEncoder()
+    syms = np.array(["x", "y", None, "x"], dtype=object)
+    v = np.array([1.0, 2.0, 3.0, 4.0])
+    n = np.array([1, 2, 3, 4], dtype=np.int64)
+    ts = np.arange(4, dtype=np.int64)
+    d1, d2 = StringDictionary(), StringDictionary()
+    direct = HostBatch.from_columns(
+        {"sym": syms, "v": v, "n": n}, DEF3, d1, timestamps=ts)
+    data, wts = _decode(enc.encode({"sym": syms, "v": v, "n": n},
+                                   timestamps=ts), dictionary=d2)
+    wired = HostBatch.from_columns(data, DEF3, d2, timestamps=wts)
+    assert d1._to_str == d2._to_str
+    for k in direct.cols:
+        assert np.array_equal(direct.cols[k], wired.cols[k]), k
+
+
+def test_empty_batch():
+    enc = WireEncoder()
+    frame = enc.encode({"sym": np.array([], dtype=object),
+                        "v": np.array([], np.float64),
+                        "n": np.array([], np.int64)},
+                       timestamps=np.array([], np.int64))
+    data, wts = _decode(frame)
+    assert len(data["sym"]) == 0 and len(wts) == 0
+
+
+def test_all_null_string_column():
+    enc = WireEncoder()
+    d = StringDictionary()
+    data, _ = _decode(enc.encode(
+        {"sym": np.array([None, None, None], dtype=object),
+         "v": np.zeros(3), "n": np.zeros(3, np.int64)}), dictionary=d)
+    assert _strings_of(data, d) == [None, None, None]
+    assert len(d) == 0      # nothing inserted for an all-null column
+
+
+def test_explicit_null_masks_ride():
+    enc = WireEncoder()
+    frame = enc.encode({"sym": np.array(["a", "b"], dtype=object),
+                        "v": np.array([1.0, 2.0]),
+                        "v?": np.array([False, True]),
+                        "n": np.array([7, 8], np.int64)})
+    data, _ = _decode(frame)
+    assert np.array_equal(np.asarray(data["v?"]), [False, True])
+
+
+def test_dictionary_delta_growth():
+    """Frames carry only NEW strings; the server LUT grows per frame and
+    ids stay stable across frames."""
+    enc = WireEncoder()
+    d = StringDictionary()
+    reg = DecoderRegistry()
+
+    def send(names):
+        frame = enc.encode({"sym": np.array(names, dtype=object),
+                            "v": np.zeros(len(names)),
+                            "n": np.zeros(len(names), np.int64)})
+        data, _ = decode_frame(frame, DEF3, d, reg)
+        return data
+
+    d1 = send(["a", "b"])
+    d2 = send(["b", "c"])          # delta carries only "c"
+    d3 = send(["a", "c", "d"])     # delta carries only "d"
+    assert _strings_of(d1, d) == ["a", "b"]
+    assert _strings_of(d2, d) == ["b", "c"]
+    assert _strings_of(d3, d) == ["a", "c", "d"]
+    # same client string -> same server id across frames
+    assert d1["sym"][0] == d3["sym"][0]
+    assert d2["sym"][1] == d3["sym"][1]
+    assert len(d) == 4
+
+
+def test_delta_gap_rejected_and_reset_recovers():
+    """A decoder that lost the LUT (restart/eviction) rejects the next
+    delta frame with a clean error; WireEncoder.reset() resends from a
+    full dictionary and recovery is exact."""
+    enc = WireEncoder()
+    d = StringDictionary()
+    reg = DecoderRegistry()
+    f1 = enc.encode({"sym": np.array(["a", "b"], dtype=object),
+                     "v": np.zeros(2), "n": np.zeros(2, np.int64)})
+    decode_frame(f1, DEF3, d, reg)
+    f2 = enc.encode({"sym": np.array(["c"], dtype=object),
+                     "v": np.zeros(1), "n": np.zeros(1, np.int64)})
+    fresh = DecoderRegistry()      # the server lost its state
+    with pytest.raises(SiddhiAppValidationException,
+                       match="dictionary delta gap"):
+        decode_frame(f2, DEF3, d, fresh)
+    enc.reset()
+    f3 = enc.encode({"sym": np.array(["c", "a"], dtype=object),
+                     "v": np.zeros(2), "n": np.zeros(2, np.int64)})
+    data, _ = decode_frame(f3, DEF3, d, fresh)
+    assert _strings_of(data, d) == ["c", "a"]
+
+
+def test_registry_scope_partitions_encoder_state():
+    """One encoder posting to TWO apps (scopes): each scope keeps its
+    own LUT against its own dictionary — app B must never gather app
+    A's server ids."""
+    enc = WireEncoder()
+    reg = DecoderRegistry()
+    dA, dB = StringDictionary(), StringDictionary()
+    dA.encode("shift-A")            # skew A's id space vs B's
+    f1 = enc.encode({"sym": np.array(["x"], dtype=object),
+                     "v": np.zeros(1), "n": np.zeros(1, np.int64)})
+    a1, _ = decode_frame(f1, DEF3, dA, reg, scope="A")
+    # same frame bytes into scope B: fresh LUT (dict_base 0), B's ids
+    b1, _ = decode_frame(f1, DEF3, dB, reg, scope="B")
+    assert _strings_of(a1, dA) == ["x"] and _strings_of(b1, dB) == ["x"]
+    assert int(a1["sym"][0]) != int(b1["sym"][0])   # distinct id spaces
+    # delta continuity advances independently per scope
+    f2 = enc.encode({"sym": np.array(["y"], dtype=object),
+                     "v": np.zeros(1), "n": np.zeros(1, np.int64)})
+    a2, _ = decode_frame(f2, DEF3, dA, reg, scope="A")
+    b2, _ = decode_frame(f2, DEF3, dB, reg, scope="B")
+    assert _strings_of(a2, dA) == ["y"] and _strings_of(b2, dB) == ["y"]
+
+
+def test_pre_encoded_int_string_column():
+    """Numeric columns under a STRING attribute are rejected — silent
+    misinterpretation of raw ints as dictionary ids is the bug class
+    the type codes exist to stop."""
+    enc = WireEncoder()
+    frame = enc.encode({"sym": np.array([0, 1], np.int64),
+                        "v": np.zeros(2), "n": np.zeros(2, np.int64)})
+    with pytest.raises(SiddhiAppValidationException,
+                       match="string attribute"):
+        _decode(frame)
+
+
+# ------------------------------------------------- corruption / truncation
+
+
+def _frame():
+    enc = WireEncoder()
+    return enc.encode({"sym": np.array(["a", "b", "c"], dtype=object),
+                       "v": np.arange(3, dtype=np.float64),
+                       "n": np.arange(3, dtype=np.int64)},
+                      timestamps=np.arange(3, dtype=np.int64))
+
+
+@pytest.mark.parametrize("cut", [0, 3, 12, 47, 60, -8, -1])
+def test_truncated_frames_rejected(cut):
+    frame = _frame()
+    with pytest.raises(SiddhiAppValidationException, match="wire frame"):
+        _decode(frame[:cut] if cut >= 0 else frame[:len(frame) + cut])
+
+
+def test_bad_magic_and_version():
+    frame = bytearray(_frame())
+    frame[:4] = b"NOPE"
+    with pytest.raises(SiddhiAppValidationException, match="magic"):
+        _decode(bytes(frame))
+    frame = bytearray(_frame())
+    frame[4] = 99
+    with pytest.raises(SiddhiAppValidationException, match="version"):
+        _decode(bytes(frame))
+
+
+def test_missing_column_rejected():
+    enc = WireEncoder()
+    frame = enc.encode({"sym": np.array(["a"], dtype=object),
+                        "v": np.zeros(1)})    # 'n' absent
+    with pytest.raises(SiddhiAppValidationException,
+                       match="column 'n' missing"):
+        _decode(frame)
+
+
+def test_client_id_out_of_dictionary_range():
+    """A hand-crafted frame whose string column references an id the
+    dictionary delta never defined is rejected, not gathered out of
+    bounds."""
+    header = struct.Struct("<4sHHQIIIHHIIQ")
+    name = b"sym"
+    dir_entry = (struct.pack("<H", len(name)) + name
+                 + struct.pack("<BBQQ", 6, 0, 0, 8))
+    payload = np.array([7, -1], np.int32).tobytes()
+    frame = header.pack(MAGIC, 1, 0, 42, 0, 0, 2, 1, 0,
+                        len(dir_entry), 0, len(payload)) \
+        + dir_entry + payload
+    with pytest.raises(SiddhiAppValidationException,
+                       match="outside the 0-entry dictionary"):
+        decode_frame(frame, _definition([("sym", AttrType.STRING)]),
+                     StringDictionary(), DecoderRegistry())
+
+
+def test_offset_escape_rejected():
+    header = struct.Struct("<4sHHQIIIHHIIQ")
+    name = b"v"
+    dir_entry = (struct.pack("<H", len(name)) + name
+                 + struct.pack("<BBQQ", 1, 0, 1 << 20, 8))
+    payload = b"\0" * 16
+    frame = header.pack(MAGIC, 1, 0, 1, 0, 0, 2, 1, 0,
+                        len(dir_entry), 0, len(payload)) \
+        + dir_entry + payload
+    with pytest.raises(SiddhiAppValidationException, match="escapes"):
+        decode_frame(frame, _definition([("v", AttrType.DOUBLE)]),
+                     StringDictionary(), DecoderRegistry())
+
+
+# ------------------------------------------------------ property sweep
+
+
+pytestmark_hyp = pytest.importorskip  # see test_property_chunking
+
+
+def test_property_random_schemas():
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    attr_types = st.sampled_from(
+        [AttrType.STRING, AttrType.LONG, AttrType.DOUBLE, AttrType.BOOL])
+    schemas = st.lists(attr_types, min_size=1, max_size=5)
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        schema=schemas,
+        n_rows=st.integers(min_value=0, max_value=64),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def check(schema, n_rows, seed):
+        rng = np.random.default_rng(seed)
+        definition = _definition(
+            [(f"a{i}", t) for i, t in enumerate(schema)])
+        data = {}
+        expect = {}
+        for i, t in enumerate(schema):
+            name = f"a{i}"
+            if t == AttrType.STRING:
+                col = np.array(
+                    [None if rng.random() < 0.2
+                     else f"s{rng.integers(0, 10)}-é"
+                     for _ in range(n_rows)], dtype=object)
+            elif t == AttrType.LONG:
+                col = rng.integers(-1000, 1000, n_rows, dtype=np.int64)
+            elif t == AttrType.DOUBLE:
+                col = rng.random(n_rows)
+            else:
+                col = rng.integers(0, 2, n_rows).astype(bool)
+            data[name] = col
+            expect[name] = col
+        ts = rng.integers(0, 1000, n_rows).astype(np.int64)
+        enc = WireEncoder()
+        d = StringDictionary()
+        decoded, wts = decode_frame(
+            enc.encode(data, timestamps=ts), definition, d,
+            DecoderRegistry())
+        assert np.array_equal(np.asarray(wts), ts)
+        for i, t in enumerate(schema):
+            name = f"a{i}"
+            if t == AttrType.STRING:
+                assert _strings_of(decoded, d, name) == list(expect[name])
+            else:
+                assert np.array_equal(np.asarray(decoded[name]),
+                                      expect[name]), name
+
+    check()

@@ -27,6 +27,9 @@ CHECKS = {
     "pipeline": ("pipeline_check.py", 300, (), {}),
     "join": ("quick_join_check.py", 300, (), {}),
     "agg": ("quick_agg_check.py", 300, (), {}),
+    # ingest front door: event vs wire-format vs parallel-pack(pool=2)
+    # paths bit-identical and identically ordered through enforceOrder
+    "ingest": ("quick_ingest_check.py", 300, (), {}),
     "hlo": ("hlo_audit.py", 300, (), {}),
     # critical-path profiler: bit-identity with FULL profiling on
     # (journeys + cost capture + tracer + detail stats) + report sanity
