@@ -352,6 +352,16 @@ class SiddhiAppRuntime:
 
         self.fused_fanout_groups: List = plan_fanout_groups(self)
 
+        # eligibility census (core/eligibility.py): classify every query
+        # on every strategy surface (route / fusion / join engine / join
+        # pipeline) with stable reason codes — stashed on
+        # self.eligibility_census for tooling (the semantic fuzzer) and
+        # counted as the siddhi_eligibility_total{surface,code,query}
+        # family on /metrics
+        from siddhi_tpu.core.eligibility import register_census
+
+        register_census(self)
+
         # overload armor (resilience/overload.py): siddhi_tpu.quota_* /
         # siddhi_tpu.shed_policy config keys register per-app ingest
         # quotas, shed policies, a device-memory budget and a fair-share

@@ -27,14 +27,18 @@ def attach_join_engine(rt, on_expr) -> None:
     reference path wholesale, including pipeline ineligibility — the
     bit-identity baseline ``tools/quick_join_check.py`` compares
     against)."""
+    from siddhi_tpu.core.eligibility import ReasonCode as RC
+    from siddhi_tpu.core.eligibility import reason
+
     rt.engine = None
     rt.engine_reason = engine_ineligibility(rt)
     rt.pipeline_reason = pipeline_ineligibility(rt)
     mode = str(getattr(rt.app_context, "join_engine", "device") or "device")
     if mode != "device":
         rt.engine_reason = rt.engine_reason or \
-            "disabled (siddhi_tpu.join_engine=legacy)"
-        rt.pipeline_reason = "siddhi_tpu.join_engine=legacy"
+            reason(RC.DISABLED, "disabled (siddhi_tpu.join_engine=legacy)")
+        rt.pipeline_reason = reason(RC.DISABLED,
+                                    "siddhi_tpu.join_engine=legacy")
         return
     if rt.engine_reason is not None:
         return

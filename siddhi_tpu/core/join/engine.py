@@ -77,21 +77,31 @@ def engine_ineligibility(rt) -> Optional[str]:
     Shared-store sides (tables, named windows, aggregations), host-mode
     windows and `partition with` joins keep the legacy probe path (the
     keyed ``[K, W]`` ring of a partitioned join is already
-    partition-local by construction)."""
+    partition-local by construction). Reasons are
+    ``core.eligibility.Reason`` strings (stable ``.code`` + free-text
+    detail)."""
+    from siddhi_tpu.core.eligibility import ReasonCode as RC
+    from siddhi_tpu.core.eligibility import reason
+
     if rt.partition_ctx is not None:
-        return "partitioned join (keyed rings are already partition-local)"
+        return reason(RC.PARTITIONED,
+                      "partitioned join (keyed rings are already "
+                      "partition-local)")
     if rt.index_probe is not None:
-        return "indexed table probe"
+        return reason(RC.INDEXED_PROBE, "indexed table probe")
     for side in rt.sides.values():
         if side.store is not None:
-            return f"shared-store side '{side.stream_id}'"
+            return reason(RC.STORE_SIDE,
+                          f"shared-store side '{side.stream_id}'")
         if side.host_window is not None:
-            return f"host-mode window side '{side.stream_id}'"
+            return reason(RC.HOST_WINDOW,
+                          f"host-mode window side '{side.stream_id}'")
         stage = side.window_stage
         if not isinstance(stage, (LengthWindowStage, TimeWindowStage,
                                   PassthroughWindowStage)):
-            return (f"window stage {type(stage).__name__} on side "
-                    f"'{side.stream_id}' (no partition adapter yet)")
+            return reason(RC.WINDOW_KIND,
+                          f"window stage {type(stage).__name__} on side "
+                          f"'{side.stream_id}' (no partition adapter yet)")
     return None
 
 
@@ -103,18 +113,25 @@ def pipeline_ineligibility(rt) -> Optional[str]:
     callback at drain, and the pump's per-owner FIFO preserves the
     cross-stream dispatch order (which the engine additionally stamps
     into the meta as an explicit sequence number)."""
+    from siddhi_tpu.core.eligibility import ReasonCode as RC
+    from siddhi_tpu.core.eligibility import reason
+
     for side in rt.sides.values():
         if side.store is not None:
-            return (f"shared-store probe side '{side.stream_id}' "
-                    f"(host-interleaved contents)")
+            return reason(RC.STORE_SIDE,
+                          f"shared-store probe side '{side.stream_id}' "
+                          f"(host-interleaved contents)")
         if side.host_window is not None:
-            return f"host-mode window side '{side.stream_id}'"
+            return reason(RC.HOST_WINDOW,
+                          f"host-mode window side '{side.stream_id}'")
         if side.window_stage is None:
-            return f"side '{side.stream_id}' has no window stage"
+            return reason(RC.NO_WINDOW,
+                          f"side '{side.stream_id}' has no window stage")
     if rt.keyer is not None:
-        return "grouped selector (host keyed select between stages)"
+        return reason(RC.GROUPED_SELECT,
+                      "grouped selector (host keyed select between stages)")
     if rt.index_probe is not None:
-        return "indexed table probe"
+        return reason(RC.INDEXED_PROBE, "indexed table probe")
     return None
 
 

@@ -39,7 +39,10 @@ from siddhi_tpu.query_api.expressions import Variable
 
 
 def fusion_ineligibility(q) -> Optional[str]:
-    """Why ``q`` cannot join a fused fan-out group (None = eligible)."""
+    """Why ``q`` cannot join a fused fan-out group (None = eligible,
+    else a ``core.eligibility.Reason`` — text + stable ``.code``)."""
+    from siddhi_tpu.core.eligibility import ReasonCode as RC
+    from siddhi_tpu.core.eligibility import reason
     from siddhi_tpu.core.query.join_runtime import JoinSideProxy
     from siddhi_tpu.core.query.runtime import QueryRuntime
 
@@ -50,20 +53,22 @@ def fusion_ineligibility(q) -> Optional[str]:
         # its own eligibility rules)
         return q.fusion_ineligibility()
     if type(q) is not QueryRuntime:
-        return f"not a plain single-stream runtime ({type(q).__name__})"
+        return reason(RC.NOT_PLAIN_RUNTIME,
+                      f"not a plain single-stream runtime "
+                      f"({type(q).__name__})")
     if q.partition_ctx is not None:
-        return "partitioned"
+        return reason(RC.PARTITIONED, "partitioned")
     if q.host_window is not None:
-        return "host-mode window"
+        return reason(RC.HOST_WINDOW, "host-mode window")
     if q.host_transforms:
-        return "host-side transform chain"
+        return reason(RC.HOST_TRANSFORM, "host-side transform chain")
     if q.log_stages:
-        return "#log() host taps"
+        return reason(RC.LOG_TAPS, "#log() host taps")
     if q.window_stage is not None and getattr(
             q.window_stage, "needs_scheduler", False):
-        return "scheduler-driven window"
+        return reason(RC.SCHEDULER_WINDOW, "scheduler-driven window")
     if q._shard_mesh is not None:
-        return "sharded over a mesh"
+        return reason(RC.SHARDED, "sharded over a mesh")
     return None
 
 

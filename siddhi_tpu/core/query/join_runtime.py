@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from siddhi_tpu.core.eligibility import ReasonCode as _RC
+from siddhi_tpu.core.eligibility import reason as _reason
 from siddhi_tpu.core.event import Event, HostBatch, LazyColumns, pack_pool_of
 from siddhi_tpu.core.plan.selector_plan import FLUSH_KEY, GK_KEY
 from siddhi_tpu.core.query.runtime import QueryRuntime, pack_meta
@@ -235,19 +237,25 @@ class JoinSideProxy(Receiver):
         eligible) — consulted by ``fanout_plan.fusion_ineligibility``."""
         rt = self.runtime
         if rt.engine is None:
-            return f"join side without device engine ({rt.engine_reason})"
+            return _reason(
+                _RC.NO_DEVICE_ENGINE,
+                f"join side without device engine ({rt.engine_reason})")
         if rt.keyer is not None:
-            return "grouped join selector (split host-keyed pipeline)"
+            return _reason(_RC.GROUPED_SELECT,
+                           "grouped join selector (split host-keyed "
+                           "pipeline)")
         if rt._shard_mesh is not None or rt._route_layout is not None:
-            return "mesh-sharded join"
+            return _reason(_RC.SHARDED, "mesh-sharded join")
         for side in rt.sides.values():
             st = side.window_stage
             if st is not None and getattr(st, "needs_scheduler", False):
-                return "scheduler-driven join window"
+                return _reason(_RC.SCHEDULER_WINDOW,
+                               "scheduler-driven join window")
         if rt.sides["left"].stream_id == rt.sides["right"].stream_id:
             # both proxies would fuse onto ONE junction sharing one state
             # pytree — the fused step would donate it twice per dispatch
-            return "self-join (both sides share the junction batch)"
+            return _reason(_RC.SELF_JOIN,
+                           "self-join (both sides share the junction batch)")
         return None
 
     @property
@@ -416,8 +424,10 @@ class JoinQueryRuntime(QueryRuntime):
         # device join engine (core/join/): attached by the planner for
         # eligible stream-stream shapes; None keeps the legacy probe path
         self.engine = None
-        self.engine_reason: Optional[str] = "engine not attached"
-        self.pipeline_reason: Optional[str] = "engine not attached"
+        self.engine_reason: Optional[str] = _reason(
+            _RC.NOT_ATTACHED, "engine not attached")
+        self.pipeline_reason: Optional[str] = _reason(
+            _RC.NOT_ATTACHED, "engine not attached")
         self._in_timer = False       # timer sweeps run synchronously
         self._drain_seq = None       # last cross-stream seq seen at drain
         self._cur_timer_cb = None    # per-side notify attribution (pump)

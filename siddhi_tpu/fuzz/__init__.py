@@ -1,0 +1,45 @@
+"""Semantic fuzzing: a typed SiddhiQL generator + cross-strategy
+equivalence hunter with shrinking.
+
+The engine runs one query under up to five execution strategies (legacy
+/ fused fan-out / pipelined / device-routed / device joins) across shard
+counts, pipeline depths, join partition counts and ingest pool sizes —
+all of which must be **observationally interchangeable**: same app, same
+input, bit-identical output in the identical order. The hand-written
+quick checks cover ~6 shapes; this package generates thousands.
+
+Modules:
+
+- :mod:`siddhi_tpu.fuzz.determinism` — the deterministic-time window
+  discipline every differential check must follow (the
+  ``quick_join_check`` lesson, extracted);
+- :mod:`siddhi_tpu.fuzz.schema` — typed stream/query/case specs that
+  render to SiddhiQL and round-trip through JSON (the shrinker and the
+  fixture format operate on these, never on raw query text);
+- :mod:`siddhi_tpu.fuzz.generator` — the seeded typed generator:
+  random schemas + a grammar of composable type-checked fragments that
+  emits random-but-valid apps by construction, with eligibility
+  expectations attached;
+- :mod:`siddhi_tpu.fuzz.runner` — the strategy-matrix differential
+  runner: enumerates every live strategy combination, runs the same
+  deterministic feed through each, diffs emissions exactly (values AND
+  order) against the all-legacy baseline, and audits the eligibility
+  census for unexplained fallbacks;
+- :mod:`siddhi_tpu.fuzz.shrink` — divergence reduction to a minimal
+  repro (drop queries/clauses, shrink input, lower knobs) written as a
+  self-contained fixture under ``tests/fixtures/fuzz/``.
+
+Entry point: ``tools/fuzz_equivalence.py`` (seeded, budgeted, JSON
+report); a fast seeded subset rides ``tools/quick_all.py`` as the
+``fuzz`` check.
+"""
+
+from siddhi_tpu.fuzz.generator import CaseGenerator  # noqa: F401
+from siddhi_tpu.fuzz.runner import (  # noqa: F401
+    DiffReport,
+    StrategyCombo,
+    diff_outputs,
+    run_case,
+)
+from siddhi_tpu.fuzz.schema import CaseSpec, QuerySpec, StreamSpec  # noqa: F401
+from siddhi_tpu.fuzz.shrink import shrink_case  # noqa: F401

@@ -60,6 +60,9 @@ TELEMETRY_PREFIXES = (
                      # pack/merge histograms, wire-frame counters
                      # (core/stream/input/pack_pool.py + wire.py ->
                      # siddhi_ingest_*)
+    "eligibility",   # build-time strategy-eligibility census counters
+                     # (core/eligibility.py register_census ->
+                     # siddhi_eligibility_total{surface,code,query})
 )
 
 # --- graftlint R6 declarations (device-instrument parity) ------------
@@ -142,6 +145,11 @@ _FANOUT_GAUGE = re.compile(r"^fanout\.(?P<stream>.+)\.group_size$")
 _FANOUT_COUNTER = re.compile(r"^fanout\.(?P<stream>.+)\.(?P<kind>"
                              r"dispatches|meta_pulls)$")
 _PIPELINE_GAUGE = re.compile(r"^pipeline\.(?P<query>.+)\.inflight$")
+# eligibility.<surface>.<CODE>.<query> — surface spellings are the
+# core/eligibility.py SURFACES tuple, codes its ReasonCode values
+_ELIGIBILITY_COUNTER = re.compile(
+    r"^eligibility\.(?P<surface>[a-z_]+)\.(?P<code>[A-Z0-9_]+)"
+    r"\.(?P<query>.+)$")
 # multicore ingest front door (core/stream/input/): pack-pool health
 # gauges, per-sub-batch pack + per-batch ordered-merge histograms, and
 # wire-frame ingest counters
@@ -488,6 +496,18 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                      "bounded enqueue waits that timed out and escalated "
                      "to the supervisor",
                      {**base, "stream": m.group("stream")}, v)
+            continue
+        m = _ELIGIBILITY_COUNTER.match(name)
+        if m:
+            fams.add("siddhi_eligibility_total", "counter",
+                     "build-time strategy-eligibility census: queries "
+                     "classified per surface (route / fusion / "
+                     "join_engine / join_pipeline) with stable reason "
+                     "codes (core/eligibility.py; ELIGIBLE = the "
+                     "strategy applies)",
+                     {**base, "surface": m.group("surface"),
+                      "code": m.group("code"),
+                      "query": m.group("query")}, v)
             continue
         m = _FANOUT_COUNTER.match(name)
         if m:

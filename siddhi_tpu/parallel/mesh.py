@@ -543,31 +543,38 @@ class RouteLayout:
 
 def route_ineligibility(runtime) -> Optional[str]:
     """Why this runtime cannot take the device-routed path (None = it
-    can). v1 scope: single-stream partitioned queries over device keyed
-    length windows (or no window at all), and non-partitioned grouped
-    aggregations without a window. Time-driven windows keep the legacy
-    paths until their emission-order keys are made global-aware."""
+    can, else a ``core.eligibility.Reason`` — free text with a stable
+    machine-readable ``.code``). v1 scope: single-stream partitioned
+    queries over device keyed length windows (or no window at all), and
+    non-partitioned grouped aggregations without a window. Time-driven
+    windows keep the legacy paths until their emission-order keys are
+    made global-aware."""
+    from siddhi_tpu.core.eligibility import ReasonCode as RC
+    from siddhi_tpu.core.eligibility import reason
     from siddhi_tpu.ops.keyed_windows import KeyedLengthWindowStage
 
     if getattr(runtime, "sides", None) is not None:
         return _join_route_ineligibility(runtime)
     if hasattr(runtime, "_steps"):
-        return "pattern/sequence (NFA) queries"
+        return reason(RC.NFA_QUERY, "pattern/sequence (NFA) queries")
     if runtime.host_window is not None:
-        return "host-mode windows"
+        return reason(RC.HOST_WINDOW, "host-mode windows")
     sp = runtime.selector_plan
     if sp.order_by or sp.limit is not None or sp.offset is not None:
-        return "order by / limit (batch-global ordering)"
+        return reason(RC.ORDER_LIMIT,
+                      "order by / limit (batch-global ordering)")
     win = runtime.window_stage
     if win is not None and not isinstance(win, KeyedLengthWindowStage):
-        return (f"window stage {type(win).__name__} (emission-order keys "
-                f"not global-aware yet)")
+        return reason(RC.WINDOW_NOT_GLOBAL_AWARE,
+                      f"window stage {type(win).__name__} (emission-order "
+                      f"keys not global-aware yet)")
     if win is not None and runtime.partition_ctx is None:
-        return "global (non-partitioned) windows"
+        return reason(RC.GLOBAL_WINDOW, "global (non-partitioned) windows")
     if runtime.partition_ctx is None and runtime.keyer is None:
-        return "unkeyed queries (nothing to route by)"
+        return reason(RC.UNKEYED, "unkeyed queries (nothing to route by)")
     if runtime.carried_pk:
-        return "inner partition '#stream' inputs"
+        return reason(RC.INNER_PARTITION_STREAM,
+                      "inner partition '#stream' inputs")
     return None
 
 
@@ -578,27 +585,36 @@ def _join_route_ineligibility(runtime) -> Optional[str]:
     exchange, probes stay partition-local by construction (a key's whole
     ring lives on its owner shard), and the join step's emission-order
     keys (trigger okey stridden by the probe width) re-merge exactly."""
+    from siddhi_tpu.core.eligibility import ReasonCode as RC
+    from siddhi_tpu.core.eligibility import reason
     from siddhi_tpu.ops.keyed_windows import KeyedLengthWindowStage
 
     if runtime.partition_ctx is None:
-        return "non-partitioned joins (nothing to route by)"
+        return reason(RC.JOIN_UNPARTITIONED,
+                      "non-partitioned joins (nothing to route by)")
     if runtime.keyer is not None:
-        return "grouped join selectors (host keyed select between stages)"
+        return reason(RC.GROUPED_SELECT,
+                      "grouped join selectors (host keyed select between "
+                      "stages)")
     sp = runtime.selector_plan
     if sp.order_by or sp.limit is not None or sp.offset is not None:
-        return "join order by / limit (batch-global ordering)"
+        return reason(RC.ORDER_LIMIT,
+                      "join order by / limit (batch-global ordering)")
     if runtime.index_probe is not None:
-        return "indexed join probes"
+        return reason(RC.INDEXED_PROBE, "indexed join probes")
     for side in runtime.sides.values():
         if side.store is not None or side.host_window is not None:
-            return (f"shared-store/host-window join side "
-                    f"'{side.stream_id}'")
+            return reason(RC.STORE_SIDE,
+                          f"shared-store/host-window join side "
+                          f"'{side.stream_id}'")
         if side.global_side:
-            return "global (non-partitioned) join sides"
+            return reason(RC.GLOBAL_SIDE,
+                          "global (non-partitioned) join sides")
         if not isinstance(side.window_stage, KeyedLengthWindowStage):
-            return (f"join window stage "
-                    f"{type(side.window_stage).__name__} (emission-order "
-                    f"keys not global-aware yet)")
+            return reason(RC.WINDOW_NOT_GLOBAL_AWARE,
+                          f"join window stage "
+                          f"{type(side.window_stage).__name__} "
+                          f"(emission-order keys not global-aware yet)")
     return None
 
 

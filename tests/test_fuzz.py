@@ -1,0 +1,303 @@
+"""Semantic fuzzing subsystem tests (siddhi_tpu/fuzz/).
+
+Covers: generator well-formedness (100 seeded queries all compile),
+seed reproducibility, differ exactness (order-sensitive), shrinker
+minimality via the planted-divergence self-test, the committed fixture
+corpus, eligibility reason codes, and the census for a known-ineligible
+shape (keyed time-batch window)."""
+
+import glob
+import json
+import os
+import pickle
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.eligibility import (
+    SURFACE_FUSION,
+    SURFACE_ROUTE,
+    Reason,
+    ReasonCode,
+    code_of,
+)
+from siddhi_tpu.fuzz.generator import CaseGenerator
+from siddhi_tpu.fuzz.runner import (
+    BASELINE,
+    StrategyCombo,
+    diff_outputs,
+    enumerate_matrix,
+    run_case,
+)
+from siddhi_tpu.fuzz.schema import CaseSpec
+from siddhi_tpu.fuzz.shrink import shrink_case, write_fixture
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "fuzz")
+
+
+# ------------------------------------------------------------- generator
+
+def test_generator_wellformedness_100_queries():
+    """100 generated queries across the corpus all compile — the typed
+    grammar's by-construction validity claim."""
+    gen = CaseGenerator(seed=11, events_per_case=10)
+    total = 0
+    i = 0
+    while total < 100:
+        case = gen.case(i)
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(case.app_text())
+            assert rt.eligibility_census   # census registered at build
+        finally:
+            m.shutdown()
+        total += len(case.queries)
+        i += 1
+    assert total >= 100
+
+
+def test_generator_seed_reproducibility():
+    a = CaseGenerator(seed=7).corpus(5)
+    b = CaseGenerator(seed=7).corpus(5)
+    assert [c.to_json() for c in a] == [c.to_json() for c in b]
+    c = CaseGenerator(seed=8).corpus(5)
+    assert [x.to_json() for x in a] != [x.to_json() for x in c]
+
+
+def test_case_spec_json_roundtrip():
+    case = CaseGenerator(seed=3).case(0)
+    back = CaseSpec.from_json(case.to_json())
+    assert back.app_text() == case.app_text()
+    assert back.events == case.events
+    assert [q.expect for q in back.queries] == \
+        [q.expect for q in case.queries]
+
+
+def test_generator_windows_are_deterministic():
+    from siddhi_tpu.fuzz.determinism import is_deterministic
+
+    for i in range(25):
+        case = CaseGenerator(seed=5).case(i)
+        for q in case.queries:
+            if q.window:
+                assert is_deterministic(q.window[0]), q.window
+            if q.join:
+                for w in (q.join.left_window, q.join.right_window):
+                    assert w is None or is_deterministic(w[0]), w
+
+
+# ---------------------------------------------------------------- differ
+
+def _rows(*pairs):
+    return {"Out": [(ts, tuple(vals)) for ts, vals in pairs]}
+
+
+def test_differ_exact_match_is_clean():
+    a = _rows((1, ["x", 2]), (2, ["y", 3]))
+    assert diff_outputs(a, _rows((1, ["x", 2]), (2, ["y", 3]))) is None
+
+
+def test_differ_catches_value_change():
+    d = diff_outputs(_rows((1, ["x", 2])), _rows((1, ["x", 3])))
+    assert d is not None and d.stream == "Out" and d.index == 0
+
+
+def test_differ_is_order_sensitive():
+    a = _rows((1, ["x", 2]), (2, ["y", 3]))
+    b = _rows((2, ["y", 3]), (1, ["x", 2]))
+    d = diff_outputs(a, b)
+    assert d is not None and d.index == 0
+
+
+def test_differ_catches_length_mismatch():
+    a = _rows((1, ["x", 2]))
+    b = _rows((1, ["x", 2]), (2, ["y", 3]))
+    d = diff_outputs(a, b)
+    assert d is not None and d.index == 1
+    assert d.baseline_len == 1 and d.variant_len == 2
+
+
+def test_differ_float_bits_not_approx():
+    d = diff_outputs(_rows((1, [1.0])), _rows((1, [1.0 + 1e-12])))
+    assert d is not None, "approximate equality would mask divergence"
+
+
+# ---------------------------------------------------------------- matrix
+
+def test_matrix_liveness_collapses_dead_axes():
+    case = CaseGenerator(seed=0).case(0)   # join-free, route-ineligible
+    assert not any(q.kind == "join" for q in case.queries)
+    plan = enumerate_matrix(case)
+    assert plan.combos[0] == BASELINE
+    assert all(c.join_engine == "legacy" for c in plan.combos)
+    assert any("join" in a for a in plan.collapsed_axes)
+    # depth and pool axes always live
+    assert any(c.depth == 4 for c in plan.combos)
+    assert any(c.pool == 2 for c in plan.combos)
+
+
+def test_matrix_cap_reports_dropped():
+    case = CaseGenerator(seed=1).case(1)
+    full = enumerate_matrix(case)
+    capped = enumerate_matrix(case, max_combos=3)
+    if len(full.combos) > 4:
+        assert capped.dropped > 0
+    assert len(capped.combos) <= 1 + max(
+        3, len({v for c in full.combos for v in
+                [("depth", c.depth)]}))  # baseline + cap (coverage may pad)
+
+
+# --------------------------------------------------------- reason codes
+
+def test_reason_is_str_compatible_and_coded():
+    r = Reason(ReasonCode.STORE_SIDE, "shared-store side 'T'")
+    assert "shared-store" in r             # substring asserts keep working
+    assert isinstance(r, str)
+    assert r.code is ReasonCode.STORE_SIDE
+    assert code_of(r) is ReasonCode.STORE_SIDE
+    assert code_of(None) is ReasonCode.ELIGIBLE
+    assert code_of("bare legacy text") is ReasonCode.UNKNOWN
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r2 == r and r2.code is ReasonCode.STORE_SIDE
+
+
+def test_engine_reasons_carry_codes():
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("""
+define stream L (ts long, sym string, lv long);
+define stream R (sym string, rv long);
+@info(name='j') from L#window.length(4) join R#window.length(4)
+  on L.sym == R.sym
+  select L.sym as sym, sum(R.rv) as total group by L.sym
+  insert into Out;
+""")
+        q = rt.query_runtimes["j"]
+        assert q.engine is not None
+        assert code_of(q.engine_reason) is ReasonCode.ELIGIBLE
+        assert code_of(q.pipeline_reason) is ReasonCode.GROUPED_SELECT
+        assert "grouped selector" in q.pipeline_reason
+    finally:
+        m.shutdown()
+
+
+# ----------------------------------------------------------- the census
+
+def test_census_known_ineligible_timebatch_keyed():
+    """The ISSUE's named shape: a keyed (partitioned) time-batch window
+    must census route=WINDOW_NOT_GLOBAL_AWARE (and never UNKNOWN)."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime("""
+define stream S (sym string, v long);
+partition with (sym of S)
+begin
+  @info(name='ktb') from S#window.timeBatch(1 sec)
+  select sym, sum(v) as total insert into Out;
+end;
+""")
+        rows = rt.eligibility_census["ktb"]
+        by_surface = {s: c for s, c, _d in rows}
+        assert by_surface[SURFACE_ROUTE] is ReasonCode.WINDOW_NOT_GLOBAL_AWARE
+        assert by_surface[SURFACE_FUSION] is ReasonCode.PARTITIONED
+        assert all(c is not ReasonCode.UNKNOWN for c in by_surface.values())
+        # counted on the telemetry registry for the /metrics family
+        snap = rt.app_context.telemetry.snapshot()
+        names = [n for n in snap.get("counters", {})
+                 if n.startswith("eligibility.route.")]
+        assert any("WINDOW_NOT_GLOBAL_AWARE.ktb" in n for n in names), names
+    finally:
+        m.shutdown()
+
+
+def test_census_only_windows_build():
+    """CENSUS_ONLY_WINDOWS render to SiddhiQL the engine can BUILD (the
+    classify-never-diff contract) — hopping needs its two-arg form."""
+    from siddhi_tpu.fuzz.determinism import (
+        CENSUS_ONLY_WINDOWS, is_deterministic, window_clause)
+
+    for kind in CENSUS_ONLY_WINDOWS:
+        assert not is_deterministic(kind)
+        clause = window_clause(kind, 1)
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                f"define stream S (sym string, v long);\n"
+                f"@info(name='q') from S{clause} "
+                f"select sym, v insert into Out;\n")
+            assert rt.eligibility_census["q"]
+        finally:
+            m.shutdown()
+
+
+def test_census_renders_metrics_family():
+    from siddhi_tpu.observability import export
+
+    m = SiddhiManager()
+    try:
+        m.create_siddhi_app_runtime(
+            "define stream S (sym string, v long);\n"
+            "@info(name='q') from S select sym, v insert into Out;\n")
+        text = export.prometheus_text(m)
+        fam = "siddhi_" + "eligibility_total"   # family literal lives in
+        lines = [l for l in text.splitlines()   # export.py (graftlint R3)
+                 if l.startswith(fam + "{")]
+        assert any('surface="route"' in l and 'code="UNKEYED"' in l
+                   and 'query="q"' in l for l in lines), lines
+    finally:
+        m.shutdown()
+
+
+# ---------------------------------------- planted divergence + shrinking
+
+def test_planted_divergence_caught_and_shrunk(tmp_path):
+    """Satellite self-test: the runner's planted skew (duplicate last
+    row of every depth>1 variant) is caught by the differ and the
+    shrinker converges to a <= 3-clause fixture — proving the whole
+    find->shrink->fixture loop without a real engine bug."""
+    gen = CaseGenerator(seed=3, events_per_case=24)
+    case = gen.case(0)
+    res = run_case(case, max_combos=3, plant=True,
+                   stop_on_divergence=True)
+    assert res.divergences, "planted skew not caught by the differ"
+    combo, diff = res.divergences[0]
+    assert combo.depth > 1                      # the skewed strategy
+    shrunk = shrink_case(case, combo, diff, plant=True, max_runs=36)
+    assert shrunk.case.clause_count() <= 3, shrunk.steps
+    assert shrunk.diff.kind == "rows"
+    path = write_fixture(shrunk.case, shrunk.combo, shrunk.diff,
+                         str(tmp_path))
+    data = json.loads(open(path).read())
+    assert data["format"] == "siddhi-tpu-fuzz-divergence-v1"
+    replay = CaseSpec.from_dict(data["case"])
+    assert replay.app_text() == data["app"]
+
+
+def test_unplanted_small_matrix_is_clean():
+    """Sanity inverse of the planted test: the same case with no skew
+    runs the same mini-matrix with zero divergences and a clean census."""
+    case = CaseGenerator(seed=3, events_per_case=24).case(0)
+    res = run_case(case, max_combos=3, plant=False)
+    assert not res.divergences, [
+        (c.label(), d.summary()) for c, d in res.divergences]
+    assert not res.census_findings, res.census_findings
+
+
+# ------------------------------------------------------ fixture corpus
+
+def test_committed_fixtures_are_selfconsistent():
+    """Every committed divergence fixture (the known-bad set) must load,
+    re-render to its stored app text, and carry a genuinely diverging
+    first-row record — the promotion contract in fixtures/fuzz/README."""
+    paths = sorted(glob.glob(os.path.join(FIXTURE_DIR, "divergence_*.json")))
+    if not paths:
+        pytest.skip("no committed divergence fixtures")
+    for p in paths:
+        data = json.loads(open(p).read())
+        assert data["format"] == "siddhi-tpu-fuzz-divergence-v1"
+        case = CaseSpec.from_dict(data["case"])
+        assert case.app_text() == data["app"], p
+        assert data["clause_count"] == case.clause_count(), p
+        d = data["diff"]
+        if d["kind"] == "rows":
+            assert d["baseline_row"] != d["variant_row"], p

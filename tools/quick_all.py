@@ -34,6 +34,12 @@ CHECKS = {
     # critical-path profiler: bit-identity with FULL profiling on
     # (journeys + cost capture + tracer + detail stats) + report sanity
     "obs": ("quick_obs_check.py", 300, (), {}),
+    # semantic fuzzing (siddhi_tpu/fuzz/): a fast seeded corpus subset
+    # through the full live strategy matrix — generated apps, exact
+    # output diffs vs the all-legacy baseline, eligibility-census audit.
+    # The soak-class run is tools/fuzz_equivalence.py --seed 0 --cases 200
+    "fuzz": ("fuzz_equivalence.py", 300,
+             ("--seed", "0", "--quick"), {}),
     # the sanitized pass: the fast bit-identity subset re-run with every
     # runtime sanitizer armed (transfer guard, recompile watchdog,
     # lock-order assertions — siddhi_tpu/analysis/sanitize.py). For the

@@ -30,7 +30,11 @@ from siddhi_tpu.core.util.config import InMemoryConfigManager  # noqa: E402
 # plain window.time expires off the wall clock (scheduler timers), so
 # two separate runs are only approximately comparable — externalTime is
 # the same TimeWindowStage with data-driven expiry, which makes the
-# bit-identity assertion deterministic
+# bit-identity assertion deterministic. That lesson is now codified in
+# siddhi_tpu/fuzz/determinism.py (DETERMINISTIC_WINDOWS) — new
+# differential checks should draw their window kinds from there
+# instead of rediscovering it; the assertion below keeps THIS app
+# honest against the shared list.
 APP = """
 define stream L (ts long, sym string, lv long);
 define stream R (sym string, rv long);
@@ -48,6 +52,15 @@ define stream R (sym string, rv long);
 
 OUT_STREAMS = ("InnerOut", "OuterOut", "GroupedOut")
 N_EVENTS = 120
+
+# every window this differential app uses must be in the shared
+# deterministic set (fuzz/determinism.py) — the wall-clock lesson above
+from siddhi_tpu.fuzz.determinism import is_deterministic  # noqa: E402
+
+for _kind in ("length", "externalTime"):
+    assert is_deterministic(_kind), \
+        f"quick_join_check uses window.{_kind} but the shared " \
+        f"deterministic-window list disagrees — see fuzz/determinism.py"
 
 
 class Collector(StreamCallback):
