@@ -1117,6 +1117,119 @@ def bench_ingest():
     }
 
 
+def bench_autopilot():
+    """Closed-loop controller soak (ISSUE 16): one bursty "diurnal"
+    feed — alternating quiet phases (idle gap before each batch) and
+    burst phases (back-to-back) over an IDENTICAL chunk sequence —
+    through the headline grouped-agg app under three configurations:
+    the worst static operating point (depth 1, no ingest pool), the
+    best static point (depth 4, pool 2), and autopilot ON starting
+    from the worst point at an aggressive cadence. Records per-config
+    events/sec + per-batch p99 + the controller's tick/freeze/decision
+    counts, and asserts the autopilot run's output rows are
+    bit-identical to both static runs — live actuation must never
+    change semantics."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.autopilot import AutopilotController
+    from siddhi_tpu.core.util.config import InMemoryConfigManager
+
+    B = 8_192
+    N_KEYS = 1_024
+    N_BATCH = 24
+    rng = np.random.default_rng(23)
+    sym_strings = np.array([f"S{i}" for i in range(N_KEYS)], dtype=object)
+    chunks = []
+    for i in range(N_BATCH):
+        ids = rng.integers(0, N_KEYS, B, dtype=np.int64)
+        chunks.append((
+            {"symbol": sym_strings[ids],
+             "price": (rng.random(B) * 100.0).astype(np.float32),
+             "volume": rng.integers(1, 1000, B, dtype=np.int64)},
+            np.arange(i * B, (i + 1) * B, dtype=np.int64)))
+    # diurnal schedule: quiet-phase batches idle 20 ms before sending
+    # (trough), burst-phase batches go back-to-back (peak); the SAME
+    # batches in the SAME order for every configuration
+    quiet = {i for i in range(N_BATCH) if (i // 4) % 2 == 0}
+
+    def run(knobs, autopilot=False):
+        manager = SiddhiManager()
+        cfg = {"siddhi_tpu.ingest_split": "8"}
+        cfg.update(knobs)
+        if autopilot:
+            cfg.update({"siddhi_tpu.autopilot": "on",
+                        "siddhi_tpu.autopilot_interval_s": "0.05",
+                        "siddhi_tpu.autopilot_cooldown_s": "0.1"})
+        manager.set_config_manager(InMemoryConfigManager(cfg))
+        rt = manager.create_siddhi_app_runtime(_APP)
+        rows = []
+
+        class Sink(StreamCallback):
+            def receive(self, events):
+                rows.extend(tuple(e.data) for e in events)
+
+        rt.add_callback("OutStream", Sink())
+        rt.start()
+        rt.query_runtimes["bench"].selector_plan.num_keys = 2_048
+        h = rt.get_input_handler("StockStream")
+        # warm OUTSIDE the timed window: a full-key batch at the
+        # measured shape settles the compiles every config would hit
+        warm_ids = np.arange(B, dtype=np.int64) % N_KEYS
+        h.send_columns({"symbol": sym_strings[warm_ids],
+                        "price": np.ones(B, np.float32),
+                        "volume": np.ones(B, np.int64)},
+                       timestamps=np.zeros(B, np.int64))
+        warm_rows = len(rows)
+        ctl = AutopilotController.instance()
+        lat = []
+        t0 = time.perf_counter()
+        for i, (cols, ts) in enumerate(chunks):
+            if i in quiet:
+                time.sleep(0.02)
+            tb = time.perf_counter()
+            h.send_columns(cols, timestamps=ts)
+            lat.append(time.perf_counter() - tb)
+            if autopilot and i % 4 == 3:
+                # deterministic cadence on top of the interval thread —
+                # the same manual-tick drive the soak and tests use
+                ctl.tick(rt.name)
+        elapsed = time.perf_counter() - t0
+        ticks = freezes = applied = logged = 0
+        if autopilot:
+            rep = ctl.report(rt.name)["apps"][rt.name]
+            ticks, freezes = rep["ticks"], rep["freezes"]
+            logged = len(rep["decisions"])
+            applied = sum(1 for d in rep["decisions"] if d.get("applied"))
+        out_rows = rows[warm_rows:]
+        manager.shutdown()
+        return {
+            "eps": round(N_BATCH * B / elapsed, 1),
+            "p99_ms": round(float(np.percentile(
+                np.array(lat) * 1e3, 99)), 3),
+            "ticks": ticks,
+            "freezes": freezes,
+            "decisions_logged": logged,
+            "decisions_applied": applied,
+        }, out_rows
+
+    worst, ref = run({"siddhi_tpu.pipeline_depth": "1"})
+    best, ref_best = run({"siddhi_tpu.pipeline_depth": "4",
+                          "siddhi_tpu.ingest_pool": "2"})
+    ap, ap_rows = run({"siddhi_tpu.pipeline_depth": "1"}, autopilot=True)
+    assert ref_best == ref, "static configs diverged"
+    assert ap_rows == ref, "autopilot run diverged from static baseline"
+    return {
+        "batch": B,
+        "batches": N_BATCH,
+        "keys": N_KEYS,
+        "static_worst": worst,
+        "static_best": best,
+        "autopilot": ap,
+        "autopilot_vs_worst": round(ap["eps"] / worst["eps"], 3),
+        "autopilot_vs_best": round(ap["eps"] / best["eps"], 3),
+        "identical": True,
+    }
+
+
 # --------------------------------------------------------------- harness
 
 
@@ -1227,6 +1340,7 @@ def main():
         "ingest_csv_events_per_sec": None,      # native CSV loader -> pump
         "host_cores": os.cpu_count(),           # single-core caveat, explicit
         "ingest_curve": None,                   # wire + parallel-pack paths
+        "autopilot_soak": None,                 # controller vs static configs
         "mesh_scaling_eps": None,               # {n_devices: eps}, key-sharded
         "mesh_scaling_backend": None,
         "nfa_p99_ms_per_batch": None,
@@ -1248,7 +1362,7 @@ def main():
         # after EVERY section so a later wedge can never void it
         try:
             path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_r07.json")
+                                "BENCH_r08.json")
             with open(path, "w", encoding="utf-8") as f:
                 json.dump(result, f, indent=1)
                 f.write("\n")
@@ -1374,6 +1488,15 @@ def main():
         result["ingest_curve"] = out["ingest"]
     else:
         result["sections_failed"].append("ingest")
+    emit()
+    # closed-loop autopilot soak (ISSUE 16): bursty feed, controller vs
+    # best/worst static configs, bit-identity asserted inside the
+    # section — pure host orchestration, never tunnel-gated
+    out, _ = _run_section_once("autopilot_cpu", min(240.0, remaining()))
+    if out is not None:
+        result["autopilot_soak"] = out["autopilot"]
+    else:
+        result["sections_failed"].append("autopilot")
     emit()
     if result["e2e_curve"] is None:
         # the curve is no longer tunnel-gated: the adaptive batcher's
@@ -1506,6 +1629,8 @@ if __name__ == "__main__":
             print(json.dumps({"ingest": bench_ingest()}))
         elif section == "serving":
             print(json.dumps({"points": bench_serving()}))
+        elif section == "autopilot":
+            print(json.dumps({"autopilot": bench_autopilot()}))
         else:
             raise SystemExit(f"unknown section {section}")
     else:

@@ -154,6 +154,7 @@ def _parse_export_declarations(ctx: LintContext) -> None:
 
 
 def default_rules() -> List[Rule]:
+    from siddhi_tpu.analysis.rules_actuators import ActuatorParityRule
     from siddhi_tpu.analysis.rules_backend import BackendInitRule
     from siddhi_tpu.analysis.rules_config import ConfigKnobRule
     from siddhi_tpu.analysis.rules_hotpath import HostPullRule
@@ -162,7 +163,8 @@ def default_rules() -> List[Rule]:
     from siddhi_tpu.analysis.rules_metrics import MetricParityRule
 
     return [BackendInitRule(), ConfigKnobRule(), MetricParityRule(),
-            LockOrderRule(), HostPullRule(), InstrumentParityRule()]
+            LockOrderRule(), HostPullRule(), InstrumentParityRule(),
+            ActuatorParityRule()]
 
 
 def run_lint(modules: List[ModuleInfo],

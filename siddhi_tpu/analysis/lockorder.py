@@ -35,6 +35,8 @@ RANKS: Dict[str, str] = {
     "shard": "AggregationShard._lock (serving/sharded_aggregation.py)",
     "wal": "IngestWAL._lock (resilience/replay.py)",
     "ingest": "IngestPackPool._lock (core/stream/input/pack_pool.py)",
+    "autopilot": "AutopilotController locks (siddhi_tpu/autopilot/"
+                 "controller.py)",
 }
 
 # (first, second): `first` must be acquired before `second`; acquiring
@@ -50,6 +52,14 @@ EDGES: Tuple[Tuple[str, str], ...] = (
     # locks, so nothing is ever acquired under "ingest"
     ("barrier", "ingest"),
     ("owner", "ingest"),
+    # the autopilot tick is outermost: actuators take owner locks (join
+    # Wp rebuild, reshard), drain the pump (flush_owner) and resize the
+    # ingest pool while a controller tick is in progress — nothing in
+    # the engine ever calls back INTO the controller under its locks
+    ("autopilot", "barrier"),
+    ("autopilot", "owner"),
+    ("autopilot", "pump"),
+    ("autopilot", "ingest"),
 )
 
 # Static-rule recognizers: `NAME._lock` / `NAME` in a `with` resolves to

@@ -936,6 +936,16 @@ class SiddhiAppRuntime:
                     self.app_context,
                     workers=self.app_context.ingest_pool,
                     split_rows=self.app_context.ingest_split)
+            # closed-loop controller (siddhi_tpu/autopilot/): register
+            # with the per-process controller when the knob is armed —
+            # 'off' (the default) keeps the engine free of any
+            # controller thread, observation or actuation
+            if getattr(self.app_context, "autopilot", "off") != "off":
+                from siddhi_tpu.autopilot.controller import (
+                    AutopilotController,
+                )
+
+                AutopilotController.instance().register(self)
             for j in self.junctions.values():
                 j.start_processing()
             scheduler = self.app_context.scheduler
@@ -1071,6 +1081,14 @@ class SiddhiAppRuntime:
 
     def shutdown(self):
         self.app_context.stopped = True
+        if getattr(self.app_context, "autopilot", "off") != "off":
+            # detach FIRST: no actuation may land on a tearing-down app
+            # (identity-pinned — an old runtime never strips a newer
+            # same-named app's controller registration)
+            from siddhi_tpu.autopilot.controller import AutopilotController
+
+            AutopilotController.instance().unregister(
+                self.app_context.name, app_runtime=self)
         if self.app_context.supervisor is not None:
             self.app_context.supervisor.stop()
         if getattr(self.app_context, "overload", None) is not None:
@@ -1148,6 +1166,32 @@ class SiddhiAppRuntime:
         self._started = False
 
     # ----------------------------------------------------- resilience API
+
+    def enable_autopilot(self, mode: str = "on",
+                         interval_s: Optional[float] = None,
+                         cooldown_s: Optional[float] = None):
+        """Arm the closed-loop controller (``siddhi_tpu/autopilot/``)
+        programmatically — the API spelling of the
+        ``siddhi_tpu.autopilot`` config knob. ``mode`` is ``'on'`` or
+        ``'dry_run'`` (decide + log, never actuate). Idempotent;
+        registration with the per-process controller happens here when
+        the app already started, else at ``start()``. Returns the
+        controller."""
+        from siddhi_tpu.autopilot.controller import AutopilotController
+        from siddhi_tpu.core.util.knobs import KNOBS
+
+        self.app_context.autopilot = KNOBS["autopilot"].parse(mode)
+        if self.app_context.autopilot == "off":
+            raise ValueError("enable_autopilot with mode 'off' — use the "
+                             "config knob to keep the controller out")
+        if interval_s is not None:
+            self.app_context.autopilot_interval_s = float(interval_s)
+        if cooldown_s is not None:
+            self.app_context.autopilot_cooldown_s = float(cooldown_s)
+        ctl = AutopilotController.instance()
+        if self._started:
+            ctl.register(self)
+        return ctl
 
     def enable_wal(self, max_batches: int = 4096,
                    max_events: Optional[int] = None):

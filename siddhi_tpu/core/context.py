@@ -282,6 +282,20 @@ class SiddhiAppContext:
         # set by OverloadManager.register via the siddhi_tpu.quota_* /
         # siddhi_tpu.shed_policy config keys or rt.enable_overload().
         self.overload = None
+        # closed-loop controller (siddhi_tpu/autopilot/): 'off'
+        # (default) = no controller thread, bit-identical engine;
+        # 'dry_run' = observe + decide + log, never actuate; 'on' =
+        # actuate live knobs within per-knob bounds. Keys
+        # siddhi_tpu.autopilot / .autopilot_interval_s /
+        # .autopilot_cooldown_s; rt.enable_autopilot() flips it
+        # programmatically.
+        self.autopilot = "off"
+        self.autopilot_interval_s = 0.25
+        self.autopilot_cooldown_s = 5.0
+        # reshard-actuator shard-count ceiling (0 = all addressable
+        # devices); also records the autopilot's current target so a
+        # report can show where the controller has driven the layout
+        self.route_shards = 0
         # shared stores, filled by SiddhiAppRuntime during assembly
         self.tables = {}
         self.named_windows = {}

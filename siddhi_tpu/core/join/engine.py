@@ -536,6 +536,64 @@ class DeviceJoinEngine:
         self.rt._steps.clear()
         return True
 
+    def _shrink_target(self, side_key: str) -> Optional[tuple]:
+        """(current Wp, shrink target) for one side, or None when the
+        side is already right-sized. The target keeps the same 2x
+        headroom the growth path provisions (``_pow2(2 * need)``) and
+        never drops below the configured-slack initial sizing — the
+        autopilot may only release what adaptive growth added. Host
+        mirror / drained instrument lanes only (zero device pulls)."""
+        plan = self.plans[side_key]
+        if not plan.use_pidx:
+            return None
+        occ = self.partition_occupancy(side_key)
+        need = int(occ.max(initial=0))
+        floor = _pow2((plan.W * self.slack + self.P - 1) // self.P)
+        target = max(_pow2(2 * need), floor)
+        if target >= plan.Wp:
+            return None
+        return plan.Wp, target
+
+    def shrink_candidates(self) -> Dict[str, tuple]:
+        """Read-only autopilot signal: sides whose Wp could shrink back
+        after a skew burst passed — {side: (wp, target)}."""
+        out = {}
+        for side_key in self.plans:
+            t = self._shrink_target(side_key)
+            if t is not None:
+                out[side_key] = t
+        return out
+
+    def shrink_partitions(self) -> Dict[str, tuple]:
+        """Release over-provisioned sub-window capacity — the reverse of
+        ``prepare_batch``'s adaptive growth, through the SAME directory
+        rebuild path (so probe membership and gseq order are identical
+        by construction, only the capacity changes). Caller holds the
+        runtime's owner lock; pipelined state futures are safe — the
+        rebuild materializes the logical current state exactly as the
+        growth path does. Returns {side: (old_wp, new_wp)}."""
+        shrunk: Dict[str, tuple] = {}
+        if self.rt._state is None:
+            return shrunk
+        for side_key in self.plans:
+            t = self._shrink_target(side_key)
+            if t is None:
+                continue
+            old_wp, target = t
+            plan = self.plans[side_key]
+            plan.Wp = target
+            # _rebuild_side auto-grows if the ring is hotter than the
+            # occupancy signal suggested — shrink can never overflow
+            self._rebuild_side(side_key)
+            shrunk[side_key] = (old_wp, plan.Wp)
+            _LOG.info(
+                "query '%s': join partition sub-windows of side %s "
+                "shrunk %d -> %d (ring occupancy fell) — autopilot "
+                "re-partition", self.rt.name, side_key, old_wp, plan.Wp)
+        if shrunk:
+            self.rt._steps.clear()
+        return shrunk
+
     # -------------------------------------------------------- step build
 
     def build_side_step(self, side_key: str):

@@ -88,28 +88,39 @@ def keyer_signature(q) -> Optional[Tuple]:
     return tuple(sig)
 
 
-def plan_fanout_groups(app_runtime) -> List:
-    """Group each junction's contiguous runs of eligible sibling queries
+def plan_junction_groups(junction) -> List:
+    """Group ONE junction's contiguous runs of eligible sibling queries
     into ``FusedFanoutRuntime``s (wired in place of the members in the
-    junction's receiver list). Returns the groups; respects the
-    ``app_context.fuse_fanout`` opt-out knob."""
+    junction's receiver list). Factored out of
+    :func:`plan_fanout_groups` so the autopilot's fusion actuator can
+    re-form groups per junction, on the delivering thread, at a batch
+    boundary."""
     from siddhi_tpu.core.query.fused_fanout import FusedFanoutRuntime
 
+    groups: List = []
+    run: List = []
+
+    def close_run():
+        if len(run) >= 2:
+            groups.append(FusedFanoutRuntime(junction, list(run)))
+        run.clear()
+
+    for r in list(junction.receivers):
+        if fusion_ineligibility(r) is None:
+            run.append(r)
+        else:
+            close_run()
+    close_run()
+    return groups
+
+
+def plan_fanout_groups(app_runtime) -> List:
+    """Group each junction's contiguous runs of eligible sibling queries
+    into ``FusedFanoutRuntime``s. Returns the groups; respects the
+    ``app_context.fuse_fanout`` opt-out knob."""
     groups: List = []
     if not getattr(app_runtime.app_context, "fuse_fanout", True):
         return groups
     for junction in app_runtime.junctions.values():
-        run: List = []
-
-        def close_run(j=None):
-            if len(run) >= 2:
-                groups.append(FusedFanoutRuntime(j, list(run)))
-            run.clear()
-
-        for r in list(junction.receivers):
-            if fusion_ineligibility(r) is None:
-                run.append(r)
-            else:
-                close_run(junction)
-        close_run(junction)
+        groups.extend(plan_junction_groups(junction))
     return groups

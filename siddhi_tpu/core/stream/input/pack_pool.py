@@ -147,6 +147,32 @@ class IngestPackPool:
         with self._lock:
             return self._spawn_missing()
 
+    def resize(self, workers: int) -> int:
+        """Live worker-count change (the autopilot's ingest actuator).
+        Growth spawns the missing threads; shrink retires the excess by
+        queueing one stop sentinel per surplus worker — they finish
+        their current sub-batch first, so an in-flight ``run_ordered``
+        is never abandoned and the ordered merge is untouched (resize
+        changes how many cores pack, never what a pack produces).
+        Returns the new worker count."""
+        workers = int(workers)
+        if workers <= 0:
+            raise ValueError("resize needs workers > 0 — use shutdown() "
+                             "to dissolve the pool")
+        with self._lock:
+            if self._stopped:
+                return self.workers
+            surplus = len([t for t in self._threads if t.is_alive()]) \
+                - workers
+            self.workers = workers
+            self._spawn_missing()
+        # sentinels queue BEHIND any pending tasks: surplus workers
+        # drain real work first, then exit; _spawn_missing prunes the
+        # dead threads on the next submit/heal
+        for _ in range(max(0, surplus)):
+            self._tasks.put(None)
+        return workers
+
     def shutdown(self) -> None:
         with self._lock:
             # under the lock: serializes against a concurrent

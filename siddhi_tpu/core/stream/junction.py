@@ -106,6 +106,29 @@ class StreamJunction:
         # id -> enqueue perf_counter, so the worker can attribute the
         # @Async queue residence. Empty unless journeys are enabled.
         self._jt_enq: dict = {}
+        # deferred receiver-set mutations (autopilot fusion actuator):
+        # drained by the DELIVERING thread before it fans a batch out,
+        # so the receiver list is never rewired mid-iteration. Empty
+        # unless a controller scheduled a dissolve/re-form.
+        self._pending_mutations: List = []
+
+    def defer_mutation(self, fn) -> None:
+        """Schedule ``fn()`` to run on the next delivering thread BEFORE
+        it iterates receivers — the only point where the receiver set
+        may be rewired live (fused-group dissolve/re-form). A junction
+        that never delivers again simply never applies it."""
+        self._pending_mutations.append(fn)
+
+    def _drain_mutations(self) -> None:
+        while self._pending_mutations:
+            fn = self._pending_mutations.pop(0)
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a failed rewire must not
+                # poison the delivery that happened to drain it
+                logging.getLogger(__name__).exception(
+                    "deferred receiver mutation failed on stream '%s'",
+                    self.definition.id)
 
     def subscribe(self, receiver: Receiver):
         if receiver not in self.receivers:
@@ -364,6 +387,8 @@ class StreamJunction:
     def _deliver_batch(self, batch, enq_t=None):
         from siddhi_tpu.core.event import HostBatch, LazyColumns
 
+        if self._pending_mutations:
+            self._drain_mutations()
         with span("junction.dispatch", stream=self.definition.id,
                   rows=int(batch._size) if batch._size is not None else -1):
             prev = current_delivering_junction()
@@ -578,6 +603,8 @@ class StreamJunction:
                 return
 
     def _deliver(self, events: List[Event], enq_t=None):
+        if self._pending_mutations:
+            self._drain_mutations()
         with span("junction.dispatch", stream=self.definition.id,
                   rows=len(events)):
             prev = current_delivering_junction()

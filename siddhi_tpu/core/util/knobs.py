@@ -125,6 +125,20 @@ KNOBS: Dict[str, Knob] = _declare(
     # pre-round-9 meta layouts bit-for-bit. See MIGRATION.md.
     Knob("profile_device_instruments", "bool",
          attr="profile_device_instruments"),
+    # closed-loop controller (siddhi_tpu/autopilot/): observes the
+    # critical-path report + telemetry gauges and actuates the live
+    # knobs (pipeline depth, ingest pool size, join Wp, routed shard
+    # count, admission caps, fan-out fusion). 'off' (default) keeps the
+    # engine bit-identical; 'dry_run' decides and logs but never
+    # actuates; 'on' actuates within per-knob bounds. See MIGRATION.md
+    # round-12 notes.
+    Knob("autopilot", "enum", choices=("off", "on", "dry_run"),
+         attr="autopilot"),
+    Knob("autopilot_interval_s", "float", attr="autopilot_interval_s"),
+    Knob("autopilot_cooldown_s", "float", attr="autopilot_cooldown_s"),
+    # autopilot reshard target bound: routed queries may be re-installed
+    # up to this many shards (0 = all addressable devices)
+    Knob("route_shards", "int", attr="route_shards"),
     # floats
     Knob("cluster_step_timeout", "float", attr="cluster_step_timeout"),
     # enums

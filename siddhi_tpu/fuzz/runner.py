@@ -71,14 +71,16 @@ class StrategyCombo:
     join_engine: str = "legacy"
     join_partitions: int = 1
     pool: int = 0
+    autopilot: bool = False
 
     def label(self) -> str:
-        return (f"fuse={int(self.fuse)},depth={self.depth},"
-                f"shards={self.shards},join={self.join_engine}"
-                f"/{self.join_partitions},pool={self.pool}")
+        lbl = (f"fuse={int(self.fuse)},depth={self.depth},"
+               f"shards={self.shards},join={self.join_engine}"
+               f"/{self.join_partitions},pool={self.pool}")
+        return lbl + ",ap" if self.autopilot else lbl
 
     def config(self) -> Dict[str, str]:
-        return {
+        cfg = {
             "siddhi_tpu.fuse_fanout": "true" if self.fuse else "false",
             "siddhi_tpu.pipeline_depth": str(self.depth),
             "siddhi_tpu.join_engine": self.join_engine,
@@ -88,6 +90,16 @@ class StrategyCombo:
             # split across pool workers (>= 2 sub-batch eligibility)
             "siddhi_tpu.ingest_split": "8",
         }
+        if self.autopilot:
+            # deliberately aggressive cadence: many live actuations per
+            # case, every one of which must keep bit-identity with the
+            # all-legacy baseline
+            cfg.update({
+                "siddhi_tpu.autopilot": "on",
+                "siddhi_tpu.autopilot_interval_s": "0.05",
+                "siddhi_tpu.autopilot_cooldown_s": "0.2",
+            })
+        return cfg
 
 
 BASELINE = StrategyCombo()
@@ -143,8 +155,15 @@ class CaseResult:
 # ------------------------------------------------------------- matrix
 
 def enumerate_matrix(case: CaseSpec, max_combos: Optional[int] = None,
-                     max_shards: int = 4) -> MatrixPlan:
-    """Every live strategy combination for this case (baseline first)."""
+                     max_shards: int = 4,
+                     autopilot: bool = False) -> MatrixPlan:
+    """Every live strategy combination for this case (baseline first).
+
+    With ``autopilot=True`` the matrix becomes the autopilot axis: the
+    all-legacy baseline plus an autopilot-ON twin of every enumerated
+    combo (including the baseline itself) — the closed-loop controller
+    actuating live knobs mid-feed must stay bit-identical to the
+    untouched baseline run."""
     has_join = any(q.kind == "join" for q in case.queries)
     route_live = any(q.expect.get(SURFACE_ROUTE) == ReasonCode.ELIGIBLE.value
                      for q in case.queries)
@@ -204,6 +223,11 @@ def enumerate_matrix(case: CaseSpec, max_combos: Optional[int] = None,
                 covered |= covers(c)
         dropped = len(combos) - len(keep)
         combos = keep
+    if autopilot:
+        from dataclasses import replace
+
+        combos = [replace(c, autopilot=True)
+                  for c in [BASELINE] + combos]
     return MatrixPlan(combos=[BASELINE] + combos, collapsed_axes=collapsed,
                       dropped=dropped)
 
@@ -389,7 +413,8 @@ def audit_census(case: CaseSpec, census: Dict, combo: StrategyCombo,
 def run_case(case: CaseSpec, max_combos: Optional[int] = None,
              max_shards: int = 4, plant: Optional[bool] = None,
              stop_on_divergence: bool = False,
-             deadline: Optional[float] = None) -> CaseResult:
+             deadline: Optional[float] = None,
+             autopilot: bool = False) -> CaseResult:
     """Run the whole matrix for one case and diff every variant against
     the baseline. ``deadline`` (``time.monotonic()`` value) aborts the
     REMAINING combos cleanly once passed — truncation is visible as a
@@ -400,7 +425,7 @@ def run_case(case: CaseSpec, max_combos: Optional[int] = None,
     if plant is None:
         plant = plant_enabled()
     plan = enumerate_matrix(case, max_combos=max_combos,
-                            max_shards=max_shards)
+                            max_shards=max_shards, autopilot=autopilot)
     result = CaseResult(plan=plan)
     base_out, base_census, base_errs = run_combo(
         case, plan.combos[0], plant=plant)

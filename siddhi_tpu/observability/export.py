@@ -63,6 +63,10 @@ TELEMETRY_PREFIXES = (
     "eligibility",   # build-time strategy-eligibility census counters
                      # (core/eligibility.py register_census ->
                      # siddhi_eligibility_total{surface,code,query})
+    "autopilot",     # closed-loop controller: mode gauge, tick/freeze
+                     # counters, per-(knob,direction,reason) decision
+                     # counters (siddhi_tpu/autopilot/ ->
+                     # siddhi_autopilot_*)
 )
 
 # --- graftlint R6 declarations (device-instrument parity) ------------
@@ -252,6 +256,20 @@ _JITCOST_HELP = {
                    "generated code size in bytes"),
     "compile_ms": ("siddhi_jit_cost_compile_ms",
                    "ahead-of-time capture compile wall ms"),
+}
+# autopilot (siddhi_tpu/autopilot/): decision counters are dotted
+# autopilot.decisions.<knob>.<direction>.<rule> — knob / direction /
+# rule segments are code-controlled [a-z0-9_] identifiers (never
+# user-named), so the dotted split is unambiguous
+_AUTOPILOT_DECISION = re.compile(
+    r"^autopilot\.decisions\.(?P<knob>[a-z0-9_]+)"
+    r"\.(?P<direction>up|down)\.(?P<reason>[a-z0-9_]+)$")
+_AUTOPILOT_COUNTER_FAMILY = {
+    "autopilot.ticks": ("siddhi_autopilot_ticks_total",
+                        "autopilot observe/decide cycles run"),
+    "autopilot.freezes": ("siddhi_autopilot_freezes_total",
+                          "autopilot ticks skipped by compile-storm "
+                          "backoff (jit compiles still climbing)"),
 }
 _SERVING_COUNTER_FAMILY = {
     "serving.queries": ("siddhi_serving_queries_total",
@@ -465,6 +483,10 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                               "utilization": "fraction of ingest pack "
                                              "workers busy"}[kind],
                              base, v)
+                elif name == "autopilot.mode":
+                    fams.add("siddhi_autopilot_mode", "gauge",
+                             "closed-loop controller mode per app "
+                             "(0=off, 1=dry_run, 2=on)", base, v)
                 elif name in ("serving.pool.pending", "serving.pool.active"):
                     kind = name.rsplit(".", 1)[1]
                     fams.add(f"siddhi_serving_pool_{kind}", "gauge",
@@ -518,11 +540,23 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                       else "fused fan-out combined __meta__ round trips"),
                      {**base, "stream": m.group("stream")}, v)
             continue
+        m = _AUTOPILOT_DECISION.match(name)
+        if m:
+            fams.add("siddhi_autopilot_decisions_total", "counter",
+                     "autopilot policy decisions (includes dry_run and "
+                     "cooldown/damped-blocked decisions; every entry in "
+                     "the GET /autopilot decision log counts here once)",
+                     {**base, "knob": m.group("knob"),
+                      "direction": m.group("direction"),
+                      "reason": m.group("reason")}, v)
+            continue
         fam = _PIPELINE_COUNTER_FAMILY.get(name)
         if fam is None:
             fam = _SERVING_COUNTER_FAMILY.get(name)
         if fam is None:
             fam = _INGEST_COUNTER_FAMILY.get(name)
+        if fam is None:
+            fam = _AUTOPILOT_COUNTER_FAMILY.get(name)
         if fam is not None:
             fams.add(fam[0], "counter", fam[1], base, v)
             continue

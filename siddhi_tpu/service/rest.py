@@ -49,6 +49,9 @@ Critical-path profiler (``observability/journey.py`` + ``costmodel.py``):
   ``tools/critical_path.py``)
 - ``GET  /programs``                   — compiled-program cost registry
   (cost/memory analysis + jaxpr-fingerprint duplicate clusters)
+- ``GET  /autopilot[/{app}]``          — closed-loop controller report:
+  actuator table, per-app mode/freeze state, bounded decision log
+  (``siddhi_tpu/autopilot/``; 404 for apps not under autopilot control)
 - ``POST /profile/journeys/start|stop``— batch-journey tracing on/off
 - ``POST /profile/costs/start|stop``   — program cost capture on/off
 - ``POST /profile/device/start|stop``  — process-level XLA profiler
@@ -209,6 +212,21 @@ class SiddhiRestService:
                 h._send(404, {"error": f"app '{app}' is not deployed"})
                 return
             h._send(200, journey.critical_path_report(self.manager, app))
+            return
+        if parts and parts[0] == "autopilot" and len(parts) <= 2:
+            from siddhi_tpu.autopilot import AutopilotController
+
+            app = parts[1] if len(parts) == 2 else None
+            if app is not None and self.manager.get_siddhi_app_runtime(
+                    app) is None:
+                h._send(404, {"error": f"app '{app}' is not deployed"})
+                return
+            try:
+                h._send(200, AutopilotController.instance().report(app))
+            except KeyError:
+                # deployed but never registered (autopilot knob off)
+                h._send(404, {"error": f"app '{app}' is not under "
+                                       f"autopilot control"})
             return
         if parts and parts[0] == "metrics" and len(parts) <= 2:
             from siddhi_tpu.observability import export

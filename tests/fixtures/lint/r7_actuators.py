@@ -1,0 +1,22 @@
+"""Known-bad fixture for graftlint R7 (actuator parity).
+
+Declares (a) an actuator driving a knob that no Knob(...) declaration
+in core/util/knobs.py produces, (b) that same actuator referenced by no
+PolicyRule (dead control surface), and (c) a policy rule naming an
+actuator nobody declares (an actuation path that silently never fires)
+— all three must be findings."""
+
+from siddhi_tpu.autopilot.actuators import Actuator
+from siddhi_tpu.autopilot.policy import PolicyRule
+
+
+def _noop(rt, direction):
+    return (0, 0)
+
+
+GHOST = Actuator(name="ghost", knob="not_a_real_knob", lo=0, hi=1,
+                 doc="drives an untyped knob and no rule references it",
+                 apply=_noop)
+
+PHANTOM_RULE = PolicyRule(name="phantom_pressure", actuator="phantom",
+                          when=lambda sig: None)

@@ -28,6 +28,7 @@ FIXTURE_PATHS = {
     "r4_lock_order.py": "siddhi_tpu/core/query/bad_locks.py",
     "r5_host_pull.py": "siddhi_tpu/core/query/bad_steps.py",
     "r6_instruments.py": "siddhi_tpu/core/query/bad_instruments.py",
+    "r7_actuators.py": "siddhi_tpu/autopilot/bad_actuators.py",
 }
 
 
@@ -59,6 +60,8 @@ def _lint_fixture(name: str):
     ("r5_host_pull.py", "R5", 4),      # float, .item, np.asarray, bool
     # undeclared data slot + consumer-less check slot
     ("r6_instruments.py", "R6", 2),
+    # untyped knob + dead actuator + undeclared actuation path
+    ("r7_actuators.py", "R7", 3),
 ])
 def test_rule_flags_its_fixture(name, rule, min_hits):
     findings = _lint_fixture(name)
@@ -112,9 +115,10 @@ def test_suppression_comments():
         os.unlink(tmp)
 
 
-def test_rule_registry_lists_six_rules():
+def test_rule_registry_lists_seven_rules():
     rules = default_rules()
-    assert [r.id for r in rules] == ["R1", "R2", "R3", "R4", "R5", "R6"]
+    assert [r.id for r in rules] == ["R1", "R2", "R3", "R4", "R5", "R6",
+                                     "R7"]
 
 
 def test_instrument_parity_bidirectional():

@@ -71,6 +71,11 @@ def main() -> int:
                          "on a warm multicore host; jit-compile-bound)")
     ap.add_argument("--plant", action="store_true",
                     help="planted-divergence self-test mode")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="autopilot axis: run every combo with the "
+                         "closed-loop controller ON at an aggressive "
+                         "cadence — live actuations mid-feed must stay "
+                         "bit-identical to the all-legacy baseline")
     args = ap.parse_args()
 
     if args.quick:
@@ -103,6 +108,7 @@ def main() -> int:
         "strategy_pairs_diffed": 0,
         "combos_dropped_by_cap": 0,
         "planted_mode": plant,
+        "autopilot_axis": args.autopilot,
         "budget_exhausted": False,
         "divergences": [],
         "census_findings": [],
@@ -136,7 +142,8 @@ def main() -> int:
         try:
             res = run_case(case, max_combos=args.max_combos,
                            max_shards=N_DEV, plant=plant,
-                           stop_on_divergence=plant, deadline=deadline)
+                           stop_on_divergence=plant, deadline=deadline,
+                           autopilot=args.autopilot)
         except Exception as e:   # baseline run died: a finding, not an abort
             msg = (f"case {i}: baseline run failed: "
                    f"{type(e).__name__}: {e}")

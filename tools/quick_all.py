@@ -40,6 +40,12 @@ CHECKS = {
     # The soak-class run is tools/fuzz_equivalence.py --seed 0 --cases 200
     "fuzz": ("fuzz_equivalence.py", 300,
              ("--seed", "0", "--quick"), {}),
+    # autopilot axis (siddhi_tpu/autopilot/): the same seeded quick
+    # subset with the closed-loop controller ON at an aggressive
+    # cadence — live knob actuations mid-feed must stay bit-identical
+    # to the all-legacy baseline
+    "autopilot": ("fuzz_equivalence.py", 300,
+                  ("--seed", "0", "--quick", "--autopilot"), {}),
     # the sanitized pass: the fast bit-identity subset re-run with every
     # runtime sanitizer armed (transfer guard, recompile watchdog,
     # lock-order assertions — siddhi_tpu/analysis/sanitize.py). For the
