@@ -1230,6 +1230,42 @@ def bench_autopilot():
     }
 
 
+def bench_cluster():
+    """Cluster-fabric scaling curve (ISSUE 17): the soak driver's
+    partitioned lengthBatch app over 1/2/4 REAL worker processes,
+    no kill, exactness asserted against the single-process run. The
+    soak tool owns the workload (tools/cluster_soak.py) so the bench
+    number and the resilience soak measure the identical feed; this
+    wrapper just reruns it in pure-scaling mode and reshapes the
+    result. NOTE this host's core count bounds the curve — on a
+    single-core container the honest ceiling is "no slowdown", not a
+    speedup, so the record carries host_cpus alongside the points."""
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "cluster_soak.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, tool, "--workers", "1,2,4",
+         "--batches", "48", "--rows", "256", "--no-kill"],
+        capture_output=True, text=True, timeout=280, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(f"cluster_soak failed rc={r.returncode}: "
+                           f"{r.stderr[-1000:]}")
+    soak = json.loads(r.stdout.strip().splitlines()[-1])
+    assert soak["exact"], "cluster egress diverged from single-process"
+    return {
+        "host_cpus": soak["host_cpus"],
+        "events": soak["events"],
+        "single_process_eps": soak["single_process_events_per_s"],
+        "points": {str(p["workers"]): p["events_per_s"]
+                   for p in soak["curve"]},
+        "exact": True,
+    }
+
+
 # --------------------------------------------------------------- harness
 
 
@@ -1341,6 +1377,7 @@ def main():
         "host_cores": os.cpu_count(),           # single-core caveat, explicit
         "ingest_curve": None,                   # wire + parallel-pack paths
         "autopilot_soak": None,                 # controller vs static configs
+        "cluster_scaling": None,                # 1/2/4 worker processes (r09)
         "mesh_scaling_eps": None,               # {n_devices: eps}, key-sharded
         "mesh_scaling_backend": None,
         "nfa_p99_ms_per_batch": None,
@@ -1362,7 +1399,7 @@ def main():
         # after EVERY section so a later wedge can never void it
         try:
             path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_r08.json")
+                                "BENCH_r09.json")
             with open(path, "w", encoding="utf-8") as f:
                 json.dump(result, f, indent=1)
                 f.write("\n")
@@ -1498,6 +1535,16 @@ def main():
     else:
         result["sections_failed"].append("autopilot")
     emit()
+    # cluster-fabric scaling (ISSUE 17): 1/2/4 REAL worker processes
+    # through the router, exactness asserted in-section vs the
+    # single-process run — plain sockets + CPU engines, never
+    # tunnel-gated
+    out, _ = _run_section_once("cluster_cpu", min(300.0, remaining()))
+    if out is not None:
+        result["cluster_scaling"] = out["cluster"]
+    else:
+        result["sections_failed"].append("cluster")
+    emit()
     if result["e2e_curve"] is None:
         # the curve is no longer tunnel-gated: the adaptive batcher's
         # throughput/p99 trade-off gets a recorded artifact on whatever
@@ -1631,6 +1678,8 @@ if __name__ == "__main__":
             print(json.dumps({"points": bench_serving()}))
         elif section == "autopilot":
             print(json.dumps({"autopilot": bench_autopilot()}))
+        elif section == "cluster":
+            print(json.dumps({"cluster": bench_cluster()}))
         else:
             raise SystemExit(f"unknown section {section}")
     else:

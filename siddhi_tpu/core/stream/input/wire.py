@@ -54,7 +54,7 @@ import struct
 import threading
 import uuid
 from collections import OrderedDict
-from typing import Dict, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -64,6 +64,27 @@ from siddhi_tpu.query_api.definitions import AttrType
 MAGIC = b"SWF1"
 VERSION = 1
 FLAG_TS = 1
+# bit 15: the frame is a CONTROL frame (hello / heartbeat / seq-ack /
+# checkpoint-cut — the cluster fabric's link-management vocabulary).
+# Control frames reuse the same 48-byte header so every endpoint needs
+# exactly one frame parser; decode_frame rejects them cleanly and
+# decode_control rejects data frames symmetrically.
+FLAG_CONTROL = 0x8000
+
+# Capability bits, carried on the hello path (dict_base slot of the
+# hello header). Version gates the FRAME LAYOUT; capabilities gate
+# optional behaviors within a version, so a decoder can refuse a
+# feature without refusing the whole link.
+CAP_TS = 1 << 0             # per-row __ts__ timestamp columns
+CAP_DICT_DELTA = 1 << 1     # dictionary-delta string protocol
+CAP_CONTROL = 1 << 2        # control frames (cluster fabric links)
+CAPABILITIES = CAP_TS | CAP_DICT_DELTA | CAP_CONTROL
+
+# control-frame kinds (u16 reserved slot, FLAG_CONTROL set)
+CTRL_HELLO = 1              # version + capability negotiation
+CTRL_HEARTBEAT = 2          # liveness tick (b = sender's monotone tick)
+CTRL_SEQ_ACK = 3            # b = highest contiguous ingest seq applied
+CTRL_CHECKPOINT_CUT = 4     # b = barrier id; body = JSON revision info
 
 _HEADER = struct.Struct("<4sHHQIIIHHIIQ")     # 48 bytes
 _DIR_FIXED = struct.Struct("<BBQQ")           # after the name
@@ -95,6 +116,103 @@ def _bad(msg: str) -> SiddhiAppValidationException:
 
 def _align8(n: int) -> int:
     return (n + 7) & ~7
+
+
+# ----------------------------------------------------------- control frames
+
+
+class ControlFrame(NamedTuple):
+    """A decoded control frame. ``a`` and ``b`` are the two u64 slots
+    (sender id and a kind-specific scalar: heartbeat tick, acked seq,
+    checkpoint barrier id); ``body`` is an optional opaque blob (JSON by
+    convention) for structured payloads like checkpoint revisions."""
+
+    kind: int
+    version: int
+    capabilities: int
+    a: int
+    b: int
+    body: bytes
+
+
+def encode_control(kind: int, *, a: int = 0, b: int = 0,
+                   body: bytes = b"", version: int = VERSION,
+                   capabilities: int = CAPABILITIES) -> bytes:
+    """Encode one control frame on the shared 48-byte header: the
+    ``encoder_id`` slot carries ``a``, ``dict_base`` the capability
+    bits, ``reserved`` the control kind, ``payload_nbytes`` carries
+    ``b``, and ``dir_nbytes`` the body length."""
+    if not 0 <= kind <= 0xFFFF:
+        raise _bad(f"control kind {kind} out of range")
+    return _HEADER.pack(MAGIC, version, FLAG_CONTROL, a,
+                        capabilities & 0xFFFFFFFF, 0, 0, 0, kind,
+                        len(body), 0, b) + bytes(body)
+
+
+def is_control(buf: bytes) -> bool:
+    """True iff ``buf`` starts with a control-frame header (cheap peek
+    so a socket reader can route without a full decode)."""
+    if len(buf) < 8 or bytes(buf[:4]) != MAGIC:
+        return False
+    (flags,) = struct.unpack_from("<H", buf, 6)
+    return bool(flags & FLAG_CONTROL)
+
+
+def decode_control(buf: bytes) -> ControlFrame:
+    """Decode one control frame. Deliberately does NOT reject a version
+    mismatch: the HELLO frame must be readable across versions so the
+    negotiation error can name both sides (see :func:`negotiate_hello`)
+    instead of dying as a frame-parse error."""
+    if len(buf) < _HEADER.size:
+        raise _bad(f"truncated control frame: {len(buf)} bytes < "
+                   f"{_HEADER.size}-byte header")
+    (magic, version, flags, a, caps, _delta_n, _n_rows, _n_cols, kind,
+     body_n, _dict_n, b) = _HEADER.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise _bad(f"bad magic {magic!r} (expected {MAGIC!r})")
+    if not flags & FLAG_CONTROL:
+        raise _bad("data frame on the control path — route data frames "
+                   "through decode_frame")
+    if len(buf) < _HEADER.size + body_n:
+        raise _bad(f"truncated control frame body: header promises "
+                   f"{body_n} bytes, got {len(buf) - _HEADER.size}")
+    body = bytes(buf[_HEADER.size:_HEADER.size + body_n])
+    return ControlFrame(kind, version, caps, a, b, body)
+
+
+def encode_hello(sender_id: int = 0, *, version: int = VERSION,
+                 capabilities: int = CAPABILITIES) -> bytes:
+    """The link-open frame every wire conversation starts with:
+    protocol version + capability bits, so incompatible endpoints fail
+    at negotiation time with an error naming both versions instead of
+    mid-stream with a frame-parse error."""
+    return encode_control(CTRL_HELLO, a=sender_id, version=version,
+                          capabilities=capabilities)
+
+
+def negotiate_hello(buf: bytes, required: int = 0) -> ControlFrame:
+    """Decode a peer's hello and negotiate: a version mismatch (or a
+    required capability the peer lacks) raises a clean
+    ``SiddhiAppValidationException`` naming BOTH sides. Returns the
+    hello with capabilities narrowed to the mutually-supported set."""
+    hello = decode_control(buf)
+    if hello.kind != CTRL_HELLO:
+        raise _bad(f"expected a hello control frame, got control kind "
+                   f"{hello.kind}")
+    if hello.version != VERSION:
+        raise _bad(
+            f"protocol version mismatch: peer speaks wire version "
+            f"{hello.version}, this endpoint speaks version {VERSION} "
+            f"— upgrade the older side; the frame layout is not "
+            f"cross-version compatible")
+    agreed = hello.capabilities & CAPABILITIES
+    missing = required & ~agreed
+    if missing:
+        raise _bad(
+            f"capability mismatch: this endpoint requires bits "
+            f"{required:#x} but the peer offers "
+            f"{hello.capabilities:#x} (missing {missing:#x})")
+    return hello._replace(capabilities=agreed)
 
 
 # ------------------------------------------------------------------ encoder
@@ -140,7 +258,12 @@ class WireEncoder:
         return out
 
     def encode(self, data: Dict[str, np.ndarray],
-               timestamps=None) -> bytes:
+               timestamps=None, string_ids=frozenset()) -> bytes:
+        """``string_ids`` names columns that are ALREADY this encoder's
+        client ids (int32, -1 = null) — the cluster router's relay path,
+        which translates router ids via a LUT instead of re-interning
+        strings per row (cluster/protocol.RelayEncoder). The caller
+        guarantees the ids reference this encoder's dictionary."""
         cols: Dict[str, Tuple[int, np.ndarray]] = {}
         n_rows = None
         for name, values in data.items():
@@ -152,6 +275,9 @@ class WireEncoder:
                            f"expected {n_rows}")
             if name.endswith("?"):
                 cols[name] = (T_BOOL, np.ascontiguousarray(arr, np.bool_))
+            elif name in string_ids:
+                cols[name] = (T_STRING_IDS,
+                              np.ascontiguousarray(arr, "<i4"))
             elif arr.dtype == object or arr.dtype.kind in ("U", "S"):
                 cols[name] = (T_STRING_IDS,
                               self._encode_strings(arr.astype(object)))
@@ -213,6 +339,15 @@ class WireEncoder:
 # ------------------------------------------------------------------ decoder
 
 
+def _count_eviction() -> None:
+    # process registry, not an app registry: the shared REST/cluster
+    # DecoderRegistry outlives any single app (rendered as
+    # siddhi_wire_decoder_evictions_total, observability/export.py)
+    from siddhi_tpu.observability.telemetry import global_registry
+
+    global_registry().count("ingest.wire.decoder_evictions")
+
+
 class _EncoderState:
     __slots__ = ("lut", "lock")
 
@@ -238,6 +373,13 @@ class DecoderRegistry:
     def __init__(self, max_encoders: int = 256):
         self.max_encoders = int(max_encoders)
         self._states: "OrderedDict[tuple, _EncoderState]" = OrderedDict()
+        # keys the LRU evicted, so the evicted client's NEXT frame gets
+        # the documented reset() error naming the real cause instead of
+        # either a confusing generic gap error or — for an encoder whose
+        # LUT happened to be empty — a silent dictionary corruption.
+        # Bounded itself (a key leaves when its client resets).
+        self._evicted: "OrderedDict[tuple, None]" = OrderedDict()
+        self.evictions = 0
         self._lock = threading.Lock()
 
     def _state_for(self, encoder_id: int, dict_base: int,
@@ -245,14 +387,27 @@ class DecoderRegistry:
         key = (scope, encoder_id)
         with self._lock:
             st = self._states.get(key)
+            if st is None and dict_base != 0 and key in self._evicted:
+                raise _bad(
+                    f"encoder {encoder_id:#x} dictionary state was "
+                    f"evicted by the bounded decoder LRU (max_encoders="
+                    f"{self.max_encoders}) — reset the encoder "
+                    f"(WireEncoder.reset) and resend from a full "
+                    f"dictionary")
             if st is None or dict_base == 0:
                 # dict_base 0 re-bootstraps: a reset() client resends
                 # the full dictionary and the stale LUT must not shadow it
                 st = _EncoderState()
                 self._states[key] = st
+                self._evicted.pop(key, None)
             self._states.move_to_end(key)
             while len(self._states) > self.max_encoders:
-                self._states.popitem(last=False)
+                old, _ = self._states.popitem(last=False)
+                self._evicted[old] = None
+                while len(self._evicted) > 8 * self.max_encoders:
+                    self._evicted.popitem(last=False)
+                self.evictions += 1
+                _count_eviction()
             return st
 
 
@@ -290,8 +445,15 @@ def decode_frame(buf: bytes, definition, dictionary,
         _HEADER.unpack_from(buf, 0)
     if magic != MAGIC:
         raise _bad(f"bad magic {magic!r} (expected {MAGIC!r})")
+    if flags & FLAG_CONTROL:
+        raise _bad("control frame on the data path — route control "
+                   "frames through decode_control")
     if version != VERSION:
-        raise _bad(f"unsupported version {version}")
+        raise _bad(
+            f"protocol version mismatch: frame encoded for wire "
+            f"version {version}, this decoder speaks version {VERSION} "
+            f"— negotiate on the hello path (encode_hello/"
+            f"negotiate_hello) before streaming")
     need = _HEADER.size + dir_nbytes + dict_nbytes + payload_nbytes
     if len(buf) < need:
         raise _bad(f"truncated: header promises {need} bytes, got "

@@ -155,6 +155,12 @@ KNOBS: Dict[str, Knob] = _declare(
     Knob("quota_block_timeout_s", "float"),
     Knob("fair_weight", "float"),
     Knob("quota_query_cap", "int"),
+    # cluster fabric (cluster/router.py): worker count, router-side WAL
+    # bound per worker, link heartbeat period, auto-checkpoint period
+    Knob("cluster_workers", "int"),
+    Knob("cluster_wal_batches", "int"),
+    Knob("cluster_heartbeat_s", "float"),
+    Knob("cluster_checkpoint_s", "float"),
 )
 
 

@@ -323,6 +323,34 @@ def run_combo(case: CaseSpec, combo: StrategyCombo,
     return outputs, census, install_errors
 
 
+def run_cluster_case(case: CaseSpec, cluster, name: str
+                     ) -> Dict[str, List[Tuple]]:
+    """Run the case's feed through a live 2-worker ``ClusterRuntime``
+    (cluster/router.py) and return outputs shaped like ``run_combo``'s.
+
+    Placement is PINNED (no partition keys): the whole app lands on
+    ``crc32(name) % n`` — exact for ANY generated app, because the one
+    owning worker receives the IDENTICAL ``send_columns`` sequence the
+    in-process baseline makes (same ``_chunked_feed`` chunks), so even
+    batch-association-sensitive float accumulations must match bit for
+    bit after the wire round-trip and the ordered egress re-merge."""
+    cluster.deploy(case.app_text(), name=name,
+                   sinks=case.out_streams())
+    for stream, rows in _chunked_feed(case):
+        spec = case.stream(stream)
+        ts = np.array([r[0] for r in rows], dtype=np.int64)
+        data = {}
+        for j, (attr, atype) in enumerate(spec.attrs):
+            data[attr] = np.array([r[1][j] for r in rows],
+                                  dtype=np_dtype(atype))
+        cluster.send_columns(name, stream, data, timestamps=ts)
+    if not cluster.quiesce(120):
+        raise RuntimeError(f"cluster egress never quiesced for {name}")
+    return {s: [(ts_, tuple(vals)) for ts_, vals in
+                cluster.egress.stream_rows(name, s)]
+            for s in case.out_streams()}
+
+
 def diff_outputs(base: Dict[str, List[Tuple]],
                  variant: Dict[str, List[Tuple]]) -> Optional[DiffReport]:
     """Exact, order-sensitive diff. Returns the FIRST divergence."""

@@ -47,7 +47,12 @@ TELEMETRY_PREFIXES = (
     "quota",         # overload quota-utilization gauges
     "overload",      # always-on overload counters (generic family)
     "wal",           # ingest-WAL size gauges
-    "cluster",       # bounded-pull probe (process registry)
+    "cluster",       # bounded-pull probe (process registry) + the
+                     # multi-process cluster fabric: workers-live /
+                     # per-worker acked-seq + WAL gauges, ingest / run /
+                     # egress / checkpoint counters, per-worker respawn
+                     # and replay counters (siddhi_tpu/cluster/ ->
+                     # siddhi_cluster_*)
     "resilience",    # StatisticsManager recovery counters (stat_count)
     "stage",         # batch-journey per-stage service/queue histograms
                      # (observability/journey.py -> siddhi_stage_*)
@@ -184,6 +189,10 @@ _INGEST_COUNTER_FAMILY = {
     "ingest.pool.worker_deaths": ("siddhi_ingest_worker_deaths_total",
                                   "ingest pack-pool worker threads that "
                                   "died (respawned by pool/supervisor)"),
+    "ingest.wire.decoder_evictions": (
+        "siddhi_wire_decoder_evictions_total",
+        "wire decoder delta-state entries evicted at the registry LRU "
+        "cap (a sender whose state was evicted must WireEncoder.reset())"),
 }
 # pipeline.metas / pipeline.pulls: metas-per-pull batching ratio;
 # pipeline.stalls: forced drains that had to wait on an unready meta
@@ -280,6 +289,58 @@ _SERVING_COUNTER_FAMILY = {
     "serving.shard_rebuilds": ("siddhi_serving_shard_rebuilds_total",
                                "aggregation shards rebuilt from "
                                "checkpoint blob + WAL suffix"),
+}
+# cluster fabric (siddhi_tpu/cluster/): router-side gauges live exactly
+# as long as the ClusterRuntime (remove_gauge in shutdown); per-worker
+# names carry the worker index as a LABEL, not a metric name
+_CLUSTER_WORKER_GAUGE = re.compile(
+    r"^cluster\.worker\.(?P<kind>acked_seq|wal_batches)\.(?P<worker>\d+)$")
+_CLUSTER_WORKER_COUNTER = re.compile(
+    r"^cluster\.worker\.(?P<kind>respawns|replayed_batches|replay_gaps|"
+    r"link_drops)\.(?P<worker>\d+)$")
+_CLUSTER_WORKER_GAUGE_HELP = {
+    "acked_seq": ("siddhi_cluster_worker_acked_seq",
+                  "highest global ingest sequence the worker has acked"),
+    "wal_batches": ("siddhi_cluster_worker_wal_batches",
+                    "retained router-side ingest-WAL batches for the "
+                    "worker (replay suffix; trimmed at checkpoint cuts)"),
+}
+_CLUSTER_WORKER_COUNTER_HELP = {
+    "respawns": ("siddhi_cluster_worker_respawns_total",
+                 "worker processes respawned after peer-death detection"),
+    "replayed_batches": ("siddhi_cluster_worker_replayed_batches_total",
+                         "WAL batches replayed into a recovered worker"),
+    "replay_gaps": ("siddhi_cluster_worker_replay_gaps_total",
+                    "runs unrecoverable at replay (WAL overflow) — "
+                    "released as counted gaps, never silent hangs"),
+    "link_drops": ("siddhi_cluster_worker_link_drops_total",
+                   "worker link sessions dropped (EOF/error on the "
+                   "router-worker socket)"),
+}
+_CLUSTER_COUNTER_FAMILY = {
+    "cluster.ingest_batches": ("siddhi_cluster_ingest_batches_total",
+                               "batches sequenced by the router ingest "
+                               "front door"),
+    "cluster.ingest_rows": ("siddhi_cluster_ingest_rows_total",
+                            "rows sequenced by the router ingest front "
+                            "door"),
+    "cluster.runs_sent": ("siddhi_cluster_runs_sent_total",
+                          "contiguous same-owner runs relayed to workers"),
+    "cluster.runs_acked": ("siddhi_cluster_runs_acked_total",
+                           "runs completed (seq-acked) by workers and "
+                           "merged in global order"),
+    "cluster.egress_rows": ("siddhi_cluster_egress_rows_total",
+                            "output rows re-merged into exact global "
+                            "order by the egress stitch"),
+    "cluster.duplicate_emits_dropped": (
+        "siddhi_cluster_duplicate_emits_dropped_total",
+        "replayed emissions for already-merged runs dropped at the "
+        "egress (the effectively-once dedup)"),
+    "cluster.checkpoints": ("siddhi_cluster_checkpoints_total",
+                            "cluster-wide checkpoint barriers completed"),
+    "cluster.queries": ("siddhi_cluster_queries_total",
+                        "scatter-gather on-demand queries served by the "
+                        "cluster router"),
 }
 _SERVING_HIST_FAMILY = {
     "serving.fanout_ms": ("siddhi_serving_fanout_ms",
@@ -487,6 +548,16 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                     fams.add("siddhi_autopilot_mode", "gauge",
                              "closed-loop controller mode per app "
                              "(0=off, 1=dry_run, 2=on)", base, v)
+                elif name == "cluster.workers.live":
+                    fams.add("siddhi_cluster_workers_live", "gauge",
+                             "worker processes with a live attached link "
+                             "(out of cluster_workers)", base, v)
+                elif _CLUSTER_WORKER_GAUGE.match(name):
+                    m = _CLUSTER_WORKER_GAUGE.match(name)
+                    family, help_ = _CLUSTER_WORKER_GAUGE_HELP[
+                        m.group("kind")]
+                    fams.add(family, "gauge", help_,
+                             {**base, "worker": m.group("worker")}, v)
                 elif name in ("serving.pool.pending", "serving.pool.active"):
                     kind = name.rsplit(".", 1)[1]
                     fams.add(f"siddhi_serving_pool_{kind}", "gauge",
@@ -550,9 +621,17 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                       "direction": m.group("direction"),
                       "reason": m.group("reason")}, v)
             continue
+        m = _CLUSTER_WORKER_COUNTER.match(name)
+        if m:
+            family, help_ = _CLUSTER_WORKER_COUNTER_HELP[m.group("kind")]
+            fams.add(family, "counter", help_,
+                     {**base, "worker": m.group("worker")}, v)
+            continue
         fam = _PIPELINE_COUNTER_FAMILY.get(name)
         if fam is None:
             fam = _SERVING_COUNTER_FAMILY.get(name)
+        if fam is None:
+            fam = _CLUSTER_COUNTER_FAMILY.get(name)
         if fam is None:
             fam = _INGEST_COUNTER_FAMILY.get(name)
         if fam is None:

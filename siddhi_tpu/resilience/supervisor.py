@@ -79,12 +79,29 @@ class PeerMonitor:
     def watch(self, host: str, port: int) -> None:
         self._peers[(host, int(port))] = {"seen": False, "missed": 0}
 
+    def unwatch(self, host: str, port: int) -> None:
+        """Stop probing an address (a respawned peer binds a NEW port;
+        the old listener must not linger as a perpetual corpse)."""
+        addr = (host, int(port))
+        self._peers.pop(addr, None)
+        self._dead.discard(addr)
+
+    def rearm(self, host: str, port: int) -> None:
+        """Forget a peer's death and watch its address from scratch —
+        the cluster supervisor's respawn path (``cluster/supervisor.py``):
+        the replacement worker is 'not up yet' until its listener is
+        first reached, never instantly re-declared dead."""
+        addr = (host, int(port))
+        self._dead.discard(addr)
+        self._peers[addr] = {"seen": False, "missed": 0}
+
     def poll_dead(self) -> list:
         """Probe every watched peer once; returns NEWLY dead addresses."""
         import socket
 
         newly = []
-        for addr, st in self._peers.items():
+        # snapshot: watch/unwatch/rearm may run on other threads
+        for addr, st in list(self._peers.items()):
             if addr in self._dead:
                 continue
             try:

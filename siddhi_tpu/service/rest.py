@@ -71,8 +71,16 @@ from typing import Optional
 class SiddhiRestService:
     def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
                  trace_base: Optional[str] = None,
-                 query_workers: int = 8, query_queue_cap: int = 64):
+                 query_workers: int = 8, query_queue_cap: int = 64,
+                 cluster=None):
         self.manager = manager
+        # optional cluster fabric (siddhi_tpu/cluster/ClusterRuntime):
+        # when attached, /query scatter-gathers cluster-deployed apps
+        # across the worker fleet, GET /cluster reports fabric status,
+        # and the /metrics JSON snapshot carries a "cluster" block (the
+        # Prometheus exposition needs no routing — the router's
+        # cluster.* gauges/counters live on the process registry)
+        self.cluster = cluster
         # profiler traces are confined under this directory; REST clients
         # supply a relative name, never an absolute filesystem path
         self.trace_base = trace_base or os.path.join(
@@ -228,6 +236,12 @@ class SiddhiRestService:
                 h._send(404, {"error": f"app '{app}' is not under "
                                        f"autopilot control"})
             return
+        if parts == ["cluster"]:
+            if self.cluster is None:
+                h._send(404, {"error": "no cluster fabric is attached"})
+                return
+            h._send(200, self.cluster.status())
+            return
         if parts and parts[0] == "metrics" and len(parts) <= 2:
             from siddhi_tpu.observability import export
 
@@ -244,6 +258,8 @@ class SiddhiRestService:
                 if app is not None:
                     snap = {"apps": {app: snap["apps"][app]},
                             "process": snap["process"]}
+                if self.cluster is not None:
+                    snap["cluster"] = self.cluster.status()
                 h._send(200, snap)
             else:
                 h._send_text(200, export.prometheus_text(
@@ -275,6 +291,22 @@ class SiddhiRestService:
             from siddhi_tpu.resilience import stat_count
             from siddhi_tpu.serving.query_tier import QueryShedError
 
+            if (self.cluster is not None
+                    and body["app"] in self.cluster.apps):
+                # cluster-deployed app: scatter-gather across the worker
+                # fleet (router re-merges with the PR-6 stitch); same
+                # bounded admission as in-process queries — a storm
+                # sheds 503s here instead of stacking socket fan-outs
+                try:
+                    fut = self.admission.try_submit(
+                        "/query", self.cluster.query,
+                        body["app"], body["query"])
+                except QueryShedError as e:
+                    h._send_shed(e)
+                    return
+                rows = fut.result()
+                h._send(200, {"rows": [list(vals) for _ts, vals in rows]})
+                return
             rt = self._rt(body["app"])
             # per-app admission (resilience/overload.py): an app with a
             # registered query_cap sheds against ITS OWN pending count

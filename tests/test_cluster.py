@@ -1,0 +1,284 @@
+"""Cluster fabric: multi-process tier with ordered re-merge (ISSUE 17).
+
+One real 2-worker fabric carries three apps at once — a SPLIT
+partitioned window app, a PINNED filter app fed over the ingest
+SOCKET (wire frames), and a SPLIT table app for scatter-gather — and
+must survive a mid-feed worker kill with an egress stream that exactly
+matches uninterrupted single-process runs. The ordered-egress merger
+itself is pure Python, so its order/dedup/forget discipline is unit-
+tested without processes.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.cluster import ClusterRuntime, OrderedEgress
+from siddhi_tpu.cluster import protocol as P
+from siddhi_tpu.cluster.protocol import py_value
+from siddhi_tpu.core.stream.input.wire import (
+    VERSION, WireEncoder, decode_control, encode_control, encode_hello)
+
+APP_SPLIT = """
+@app:name('cSplit')
+@app:playback
+define stream S (k string, tag string, v double, n long);
+partition with (k of S)
+begin
+  @info(name='q')
+  from S#window.length(8)
+  select k, sum(n) as sn, count() as c, max(v) as mv
+  insert into Out;
+end;
+"""
+
+APP_PINNED = """
+@app:name('cPinned')
+@app:playback
+define stream Ping (k string, v double);
+@info(name='q')
+from Ping[v > 30.0]
+select k, v
+insert into Out;
+"""
+
+APP_TABLE = """
+@app:name('cTable')
+define stream T (k string, n long);
+define table Tab (k string, n long);
+@info(name='q')
+from T[n > 400]
+select k, n
+insert into Tab;
+"""
+
+N_BATCHES, B = 6, 48
+_rng = np.random.default_rng(23)
+BATCHES = []
+_ts = 5_000
+for _b in range(N_BATCHES):
+    BATCHES.append((
+        np.array([f"K{i}" for i in _rng.integers(0, 9 + _b, B)],
+                 dtype=object),
+        np.array([None if i % 6 == 2 else f"t{i % 4}" for i in range(B)],
+                 dtype=object),
+        np.round(_rng.random(B) * 100.0, 6),
+        _rng.integers(0, 1_000, B).astype(np.int64),
+        np.arange(_ts + _b * B, _ts + (_b + 1) * B, dtype=np.int64)))
+
+
+class _Rows(StreamCallback):
+    def __init__(self):
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(
+            (int(e.timestamp), tuple(py_value(v) for v in e.data))
+            for e in events)
+
+
+def _baseline(app, feeds, query=None):
+    """feeds: [(stream, data_dict, timestamps)] against ONE runtime."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    c = _Rows()
+    if "Out" in rt.junctions:
+        rt.add_callback("Out", c)
+    rt.start()
+    for stream, data, tss in feeds:
+        rt.get_input_handler(stream).send_columns(dict(data),
+                                                  timestamps=tss)
+    qrows = None
+    if query is not None:
+        qrows = sorted([py_value(v) for v in e.data]
+                       for e in rt.query(query))
+    m.shutdown()
+    return c.rows, qrows
+
+
+def _ingest_link(port):
+    s = P.MessageSocket(socket.create_connection(("127.0.0.1", port),
+                                                 timeout=10))
+    s.send(P.MSG_HELLO, encode_hello())
+    mtype, body = s.recv()
+    assert mtype == P.MSG_HELLO
+    return s
+
+
+def test_cluster_fabric_end_to_end_with_mid_feed_kill():
+    split_feeds = [("S", {"k": k, "tag": t, "v": v, "n": n}, ts)
+                   for k, t, v, n, ts in BATCHES]
+    pinned_feeds = [("Ping", {"k": k, "v": v}, ts)
+                    for k, t, v, n, ts in BATCHES]
+    table_feeds = [("T", {"k": k, "n": n}, ts)
+                   for k, t, v, n, ts in BATCHES]
+    base_split, _ = _baseline(APP_SPLIT, split_feeds)
+    base_pinned, _ = _baseline(APP_PINNED, pinned_feeds)
+    _, base_q = _baseline(APP_TABLE, table_feeds,
+                          query="from Tab select k, n")
+
+    cluster = ClusterRuntime(n_workers=2, heartbeat_s=0.2)
+    try:
+        cluster.wait_ready(60)
+        cluster.deploy(APP_SPLIT, partition_keys={"S": "k"},
+                       sinks=["Out"])
+        cluster.deploy(APP_PINNED, sinks=["Out"])
+        cluster.deploy(APP_TABLE, partition_keys={"T": "k"}, sinks=[])
+
+        # the PINNED app is fed over the ingest SOCKET: client frames,
+        # dictionary delta growing every batch, per-frame seq acks
+        enc = WireEncoder()
+        ing = _ingest_link(cluster.ingest_port)
+        last_seq = 0
+        for i, (k, t, v, n, ts) in enumerate(BATCHES):
+            cluster.send_columns("cSplit", "S",
+                                 {"k": k, "tag": t, "v": v, "n": n},
+                                 timestamps=ts)
+            frame = enc.encode({"k": k, "v": v}, timestamps=ts)
+            ing.send(P.MSG_INGEST,
+                     P.pack_data(0, 0, "cPinned", "Ping", frame))
+            mtype, body = ing.recv()
+            assert mtype == P.MSG_INGEST_ACK
+            seq = decode_control(body).b
+            assert seq > last_seq     # router stamped a fresh global seq
+            last_seq = seq
+            cluster.send_columns("cTable", "T", {"k": k, "n": n},
+                                 timestamps=ts)
+            if i == 1:
+                cluster.checkpoint()
+            if i == 3:
+                # kill 1 of 2 workers mid-feed; links were ready (the
+                # deploy handshake) so the death is a detected
+                # transition, and the supervisor must respawn + the
+                # router must restore-and-replay its WAL suffix
+                cluster.supervisor.kill(1)
+        ing.close()
+
+        assert cluster.quiesce(120), "egress never quiesced"
+        got_split = [(ts_, tuple(vals)) for ts_, vals in
+                     cluster.egress.stream_rows("cSplit", "Out")]
+        got_pinned = [(ts_, tuple(vals)) for ts_, vals in
+                      cluster.egress.stream_rows("cPinned", "Out")]
+        got_q = sorted(vals for ts_, vals in
+                       (tuple(r) for r in
+                        cluster.query("cTable", "from Tab select k, n")))
+
+        assert got_split == base_split
+        assert got_pinned == base_pinned
+        assert got_q == base_q
+
+        # REST tier riding the fabric: POST /query routes
+        # cluster-deployed apps through the scatter-gather, GET /cluster
+        # reports fabric status
+        import json as _json
+        from urllib.request import Request, urlopen
+
+        from siddhi_tpu import SiddhiManager
+        from siddhi_tpu.service.rest import SiddhiRestService
+
+        m = SiddhiManager()
+        svc = SiddhiRestService(m, cluster=cluster).start()
+        try:
+            req = Request(
+                f"http://127.0.0.1:{svc.port}/query",
+                data=_json.dumps({"app": "cTable",
+                                  "query": "from Tab select k, n"}
+                                 ).encode(),
+                headers={"Content-Type": "application/json"})
+            rest_rows = _json.load(urlopen(req, timeout=30))["rows"]
+            assert sorted(rest_rows) == base_q
+            st = _json.load(urlopen(
+                f"http://127.0.0.1:{svc.port}/cluster", timeout=30))
+            assert st["live"] == 2
+            assert st["apps"]["cTable"]["mode"] == "split"
+            assert st["apps"]["cPinned"]["mode"] == "pinned"
+        finally:
+            svc.stop()
+            m.shutdown()
+
+        assert sum(cluster.supervisor.respawns) >= 1
+        # replay over-delivery was absorbed, never merged twice
+        assert cluster.egress.duplicate_emits >= 1
+
+        from siddhi_tpu.observability.telemetry import global_registry
+        counters = global_registry().counters
+        assert counters.get("cluster.ingest_batches", 0) >= 3 * N_BATCHES
+        assert counters.get("cluster.checkpoints", 0) >= 1
+        assert counters.get("cluster.worker.respawns.1", 0) >= 1
+    finally:
+        cluster.shutdown()
+
+
+def test_ingest_hello_version_mismatch_names_both_versions():
+    cluster = ClusterRuntime(n_workers=1, spawn=False)
+    try:
+        s = P.MessageSocket(socket.create_connection(
+            ("127.0.0.1", cluster.ingest_port), timeout=10))
+        s.send(P.MSG_HELLO, encode_hello(version=VERSION + 1))
+        mtype, body = s.recv()
+        assert mtype == P.MSG_ERROR
+        msg = P.jload(body)["error"]
+        assert f"version {VERSION + 1}" in msg
+        assert f"version {VERSION}" in msg
+        s.close()
+    finally:
+        cluster.shutdown()
+
+
+# ------------------------------------------------- ordered egress (pure)
+
+
+def test_egress_releases_in_global_order_despite_completion_order():
+    e = OrderedEgress()
+    tags = [(1, 0), (1, 1), (2, 0)]
+    for t in tags:
+        e.expect(t)
+    e.emit((2, 0), "a", "Out", [(30, [3])])
+    e.complete((2, 0))
+    assert e.stream_rows("a", "Out") == []      # head still outstanding
+    e.emit((1, 1), "a", "Out", [(20, [2])])
+    e.complete((1, 1))
+    e.emit((1, 0), "a", "Out", [(10, [1])])
+    e.complete((1, 0))                          # releases all three
+    assert e.stream_rows("a", "Out") == [(10, (1,)), (20, (2,)),
+                                         (30, (3,))]
+    assert e.outstanding() == 0
+    assert e.wait_quiesced(0.1)
+
+
+def test_egress_drops_replayed_duplicates_and_drop_pending():
+    e = OrderedEgress()
+    e.expect((1, 0))
+    e.expect((1, 1))
+    e.emit((1, 0), "a", "Out", [(10, [1])])
+    e.complete((1, 0))
+    # replayed emission + ack of the merged tag: dropped, not doubled
+    assert e.emit((1, 0), "a", "Out", [(10, [1])]) is False
+    assert e.complete((1, 0)) is False
+    assert e.duplicate_emits == 1
+    # incomplete tag emitted pre-death: replay drops the stale copy
+    e.emit((1, 1), "a", "Out", [(20, [2])])
+    e.drop_pending((1, 1))
+    e.emit((1, 1), "a", "Out", [(20, [2])])
+    e.complete((1, 1))
+    assert e.stream_rows("a", "Out") == [(10, (1,)), (20, (2,))]
+
+
+def test_egress_forget_releases_a_lost_head():
+    e = OrderedEgress()
+    e.expect((1, 0))
+    e.expect((1, 1))
+    e.emit((1, 1), "a", "Out", [(20, [2])])
+    e.complete((1, 1))
+    e.forget((1, 0))        # WAL-overflow gap: head can never complete
+    assert e.stream_rows("a", "Out") == [(20, (2,))]
+    assert e.outstanding() == 0
+
+
+def test_egress_rejects_out_of_order_expectations():
+    e = OrderedEgress()
+    e.expect((2, 0))
+    with pytest.raises(ValueError):
+        e.expect((1, 0))

@@ -256,3 +256,80 @@ def test_kill_one_of_two_peers_supervised_recovery_exact_outputs():
     # doubled in the recovered stream)
     assert payload["post"] == expected_post
     assert payload["replayed"] == 2 * len(SEG_B)
+
+
+# --------------------------------------------------- router-side fabric
+
+
+def _column_feed(send):
+    """The same A/B interleave as the segments, one row per batch (the
+    pattern is order-sensitive across both streams)."""
+    import numpy as np
+
+    for seg in (SEG_A, SEG_B, SEG_C):
+        for t, k, v in seg:
+            send("A", {"k": np.array([k], object),
+                       "v": np.array([v])},
+                 np.array([t], np.int64))
+            send("B", {"k": np.array([k], object),
+                       "v": np.array([v + 1.0])},
+                 np.array([t + 1], np.int64))
+        yield
+
+
+def test_router_kill_one_of_two_workers_exact_egress():
+    """The cluster-fabric half of the recovery story (ISSUE 17): the
+    ROUTER owns the WAL and the supervisor owns the processes. One of
+    two REAL worker processes is SIGKILLed between segments — after the
+    deploy handshake proved it up (the ready-flag discipline) and after
+    a checkpoint barrier cut its WAL — and the merged egress stream
+    must exactly match an uninterrupted single-process run: zero lost
+    rows, zero doubled rows, original order."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.cluster import ClusterRuntime
+    from siddhi_tpu.cluster.protocol import py_value
+
+    class C(StreamCallback):
+        def __init__(self):
+            self.rows = []
+
+        def receive(self, events):
+            self.rows.extend(
+                (int(e.timestamp), tuple(py_value(v) for v in e.data))
+                for e in events)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    c = C()
+    rt.add_callback("Out", c)
+    rt.start()
+
+    def base_send(stream, data, tss):
+        rt.get_input_handler(stream).send_columns(data, timestamps=tss)
+
+    for _ in _column_feed(base_send):
+        pass
+    m.shutdown()
+
+    cluster = ClusterRuntime(n_workers=2, heartbeat_s=0.2)
+    try:
+        cluster.wait_ready(60)
+        cluster.deploy(APP, partition_keys={"A": "k", "B": "k"},
+                       sinks=["Out"])
+
+        def cl_send(stream, data, tss):
+            cluster.send_columns("recoApp", stream, data, timestamps=tss)
+
+        feed = _column_feed(cl_send)
+        next(feed)                       # SEG_A delivered
+        cluster.checkpoint()             # cut + trim both worker WALs
+        cluster.supervisor.kill(1)       # SIGKILL mid-stream
+        for _ in feed:                   # SEG_B + SEG_C keep flowing
+            pass
+        assert cluster.quiesce(180), "egress never quiesced after kill"
+        got = [(ts, tuple(vals)) for ts, vals in
+               cluster.egress.stream_rows("recoApp", "Out")]
+        assert got == c.rows
+        assert sum(cluster.supervisor.respawns) >= 1
+    finally:
+        cluster.shutdown()

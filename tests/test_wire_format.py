@@ -15,7 +15,10 @@ import pytest
 from siddhi_tpu.compiler.errors import SiddhiAppValidationException
 from siddhi_tpu.core.event import HostBatch, StringDictionary
 from siddhi_tpu.core.stream.input.wire import (
-    MAGIC, DecoderRegistry, WireEncoder, decode_frame)
+    CAP_CONTROL, CAP_DICT_DELTA, CAP_TS, CAPABILITIES, CTRL_CHECKPOINT_CUT,
+    CTRL_HEARTBEAT, CTRL_HELLO, CTRL_SEQ_ACK, MAGIC, VERSION,
+    DecoderRegistry, WireEncoder, decode_control, decode_frame,
+    encode_control, encode_hello, is_control, negotiate_hello)
 from siddhi_tpu.query_api.definitions import (
     Attribute, AttrType, StreamDefinition)
 
@@ -262,6 +265,134 @@ def test_offset_escape_rejected():
     with pytest.raises(SiddhiAppValidationException, match="escapes"):
         decode_frame(frame, _definition([("v", AttrType.DOUBLE)]),
                      StringDictionary(), DecoderRegistry())
+
+
+# ----------------------------------------- hello negotiation / control
+
+
+def test_hello_round_trip():
+    hello = negotiate_hello(encode_hello(sender_id=42))
+    assert hello.kind == CTRL_HELLO
+    assert hello.version == VERSION and hello.a == 42
+    assert hello.capabilities == CAPABILITIES
+    assert hello.capabilities & CAP_TS
+    assert hello.capabilities & CAP_DICT_DELTA
+    assert hello.capabilities & CAP_CONTROL
+
+
+def test_hello_version_mismatch_names_both_versions():
+    """A v2 encoder against this v1 decoder (and vice versa) fails at
+    negotiation with an error naming BOTH versions — never a
+    frame-parse error."""
+    with pytest.raises(SiddhiAppValidationException) as ei:
+        negotiate_hello(encode_hello(version=VERSION + 1))
+    msg = str(ei.value)
+    assert f"version {VERSION + 1}" in msg
+    assert f"version {VERSION}" in msg
+
+
+def test_data_frame_version_mismatch_names_both_versions():
+    frame = bytearray(_frame())
+    frame[4] = VERSION + 1
+    with pytest.raises(SiddhiAppValidationException) as ei:
+        _decode(bytes(frame))
+    msg = str(ei.value)
+    assert f"version {VERSION + 1}" in msg
+    assert f"version {VERSION}" in msg
+    assert "hello" in msg          # points at the negotiation path
+
+
+def test_hello_capability_narrowing_and_requirements():
+    # a peer offering extra future bits: narrowed to the mutual set
+    h = negotiate_hello(encode_hello(capabilities=CAPABILITIES | (1 << 30)))
+    assert h.capabilities == CAPABILITIES
+    # a required capability the peer lacks is a clean negotiation error
+    with pytest.raises(SiddhiAppValidationException, match="capability"):
+        negotiate_hello(encode_hello(capabilities=CAP_TS),
+                        required=CAP_CONTROL)
+
+
+def test_control_frames_round_trip_and_stay_off_the_data_path():
+    for kind, a, b, body in [
+            (CTRL_HEARTBEAT, 7, 123, b""),
+            (CTRL_SEQ_ACK, 1, 99, b""),
+            (CTRL_CHECKPOINT_CUT, 2, 5, b'{"rev": "r1"}')]:
+        buf = encode_control(kind, a=a, b=b, body=body)
+        assert is_control(buf)
+        cf = decode_control(buf)
+        assert (cf.kind, cf.a, cf.b, cf.body) == (kind, a, b, body)
+    # control frames bounce off decode_frame with a clean error...
+    with pytest.raises(SiddhiAppValidationException, match="control"):
+        _decode(encode_control(CTRL_HEARTBEAT))
+    # ...and data frames bounce off decode_control symmetrically
+    assert not is_control(_frame())
+    with pytest.raises(SiddhiAppValidationException, match="data frame"):
+        decode_control(_frame())
+    with pytest.raises(SiddhiAppValidationException, match="truncated"):
+        decode_control(encode_control(CTRL_CHECKPOINT_CUT,
+                                      body=b"x" * 10)[:-4])
+
+
+# ----------------------------------------------------- LRU eviction fix
+
+
+def test_lru_eviction_raises_reset_error_and_counts():
+    """A live connection's encoder state evicted by a tiny LRU must
+    fail the NEXT frame with the documented WireEncoder.reset() error
+    naming the eviction — not a generic gap error, and never (for an
+    encoder with an empty LUT) silent acceptance."""
+    from siddhi_tpu.observability.telemetry import global_registry
+
+    reg = DecoderRegistry(max_encoders=2)
+    d = StringDictionary()
+    encs = [WireEncoder() for _ in range(3)]
+
+    def frame_of(enc, names):
+        return enc.encode({"sym": np.array(names, dtype=object),
+                           "v": np.zeros(len(names)),
+                           "n": np.zeros(len(names), np.int64)})
+
+    before = global_registry().counters.get(
+        "ingest.wire.decoder_evictions", 0)
+    # three encoders through a 2-slot LRU: encoder 0 is evicted
+    for enc in encs:
+        decode_frame(frame_of(enc, ["a", "b"]), DEF3, d, reg)
+    assert reg.evictions == 1
+    assert global_registry().counters[
+        "ingest.wire.decoder_evictions"] == before + 1
+    # encoder 0's next DELTA frame: the eviction-specific error
+    with pytest.raises(SiddhiAppValidationException) as ei:
+        decode_frame(frame_of(encs[0], ["a", "c"]), DEF3, d, reg)
+    msg = str(ei.value)
+    assert "evicted" in msg and "WireEncoder.reset" in msg
+    # reset() recovers exactly (dict_base 0 re-bootstraps)
+    encs[0].reset()
+    data, _ = decode_frame(frame_of(encs[0], ["a", "c"]), DEF3, d, reg)
+    assert _strings_of(data, d) == ["a", "c"]
+
+
+def test_lru_eviction_error_even_with_empty_lut():
+    """The silent-corruption corner: an evicted encoder whose LUT had
+    no strings yet would previously pass the generic gap check
+    (0 == 0). The eviction tracker must still refuse the frame."""
+    reg = DecoderRegistry(max_encoders=1)
+    d = StringDictionary()
+    e1, e2 = WireEncoder(), WireEncoder()
+
+    def no_string_frame(enc, base):
+        # hand-roll dict_base continuity without strings: first frame
+        # establishes the state, second claims a nonzero base
+        f = enc.encode({"sym": np.array(["s"] * base, dtype=object),
+                        "v": np.zeros(base), "n": np.zeros(base, np.int64)})
+        return f
+
+    decode_frame(no_string_frame(e1, 1), DEF3, d, reg)     # e1 live
+    decode_frame(no_string_frame(e2, 1), DEF3, d, reg)     # evicts e1
+    with pytest.raises(SiddhiAppValidationException,
+                       match="evicted"):
+        decode_frame(e1.encode(
+            {"sym": np.array(["t"], dtype=object),
+             "v": np.zeros(1), "n": np.zeros(1, np.int64)}), DEF3, d, reg)
 
 
 # ------------------------------------------------------ property sweep

@@ -76,7 +76,15 @@ def main() -> int:
                          "closed-loop controller ON at an aggressive "
                          "cadence — live actuations mid-feed must stay "
                          "bit-identical to the all-legacy baseline")
+    ap.add_argument("--cluster", action="store_true",
+                    help="cluster axis: run each case's app PINNED on a "
+                         "live 2-worker cluster fabric and diff the "
+                         "ordered egress against the in-process "
+                         "all-legacy baseline (exact, order-sensitive)")
     args = ap.parse_args()
+
+    if args.cluster:
+        return _cluster_main(args)
 
     if args.quick:
         args.cases = min(args.cases, 3)
@@ -240,6 +248,70 @@ def main() -> int:
         and not report.get("case_errors")
     print(f"[fuzz] {'PASS' if clean else 'FAIL'} cross-strategy "
           f"equivalence", flush=True)
+    return 0 if clean else 1
+
+
+def _cluster_main(args) -> int:
+    """The --cluster axis: every corpus case deployed PINNED on one
+    shared 2-worker fabric, its chunked feed driven through the router
+    (global sequencing + wire relay + ordered egress), outputs diffed
+    exactly against the in-process all-legacy run of the same chunks.
+    Workers are real processes, so one fabric is reused across the
+    whole subset to amortize the spawn."""
+    from siddhi_tpu.cluster import ClusterRuntime
+    from siddhi_tpu.fuzz.generator import CaseGenerator
+    from siddhi_tpu.fuzz.runner import (
+        BASELINE, diff_outputs, run_cluster_case, run_combo)
+
+    cases = min(args.cases, 10) if args.quick else min(args.cases, 40)
+    events = min(args.events, 40) if args.quick else args.events
+    gen = CaseGenerator(seed=args.seed, events_per_case=events,
+                        max_queries=args.max_queries)
+    t0 = time.time()
+    report = {
+        "seed": args.seed, "cluster_axis": True, "cases_run": 0,
+        "divergences": [], "case_errors": [],
+    }
+    cluster = ClusterRuntime(n_workers=2, heartbeat_s=0.2)
+    try:
+        cluster.wait_ready(60)
+        for i in range(args.start_case, cases):
+            if args.time_budget is not None \
+                    and time.time() - t0 > args.time_budget:
+                report["budget_exhausted"] = True
+                print(f"[fuzz] time budget hit after case {i - 1}",
+                      flush=True)
+                break
+            case = gen.case(i)
+            try:
+                base, _census, _errs = run_combo(case, BASELINE)
+                got = run_cluster_case(case, cluster, f"case{i}")
+            except Exception as e:   # a crash is a finding, not an abort
+                msg = (f"case {i}: cluster run failed: "
+                       f"{type(e).__name__}: {e}")
+                print(f"[fuzz] {msg}", flush=True)
+                report["case_errors"].append(msg)
+                report["cases_run"] += 1
+                continue
+            report["cases_run"] += 1
+            diff = diff_outputs(base, got)
+            if diff is not None:
+                print(f"[fuzz] case {i} DIVERGED on the cluster: "
+                      f"{diff.summary()}", flush=True)
+                report["divergences"].append(
+                    {"case": i, "diff": diff.summary()})
+    finally:
+        cluster.shutdown()
+    report["elapsed_s"] = round(time.time() - t0, 1)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    clean = not report["divergences"] and not report["case_errors"]
+    print(f"[fuzz] cluster axis: {report['cases_run']} cases, "
+          f"{len(report['divergences'])} divergences, "
+          f"{len(report['case_errors'])} errors in "
+          f"{report['elapsed_s']}s — {'PASS' if clean else 'FAIL'}",
+          flush=True)
     return 0 if clean else 1
 
 

@@ -30,6 +30,10 @@ CHECKS = {
     # ingest front door: event vs wire-format vs parallel-pack(pool=2)
     # paths bit-identical and identically ordered through enforceOrder
     "ingest": ("quick_ingest_check.py", 300, (), {}),
+    # cluster fabric (siddhi_tpu/cluster/): 2 real worker processes,
+    # split + pinned apps, a mid-feed checkpoint barrier — merged egress
+    # must exactly equal the single-process run (ISSUE 17)
+    "cluster": ("quick_cluster_check.py", 300, (), {}),
     "hlo": ("hlo_audit.py", 300, (), {}),
     # critical-path profiler: bit-identity with FULL profiling on
     # (journeys + cost capture + tracer + detail stats) + report sanity
