@@ -3,14 +3,18 @@
 - ``engine``/``rules_*`` — graftlint: an AST lint suite distilled from
   the repo's regression history (R1 import-time backend init, R2 ad-hoc
   config-knob reads, R3 metric-registration parity, R4 lock order, R5
-  host pulls in step code). Driver: ``tools/graftlint.py``.
+  host pulls in step code, R6 instrument parity, R7 actuator parity,
+  R8 guarded-by lock coverage). Driver: ``tools/graftlint.py``.
 - ``lockorder`` — the declared lock partial order (shared by R4 and the
   runtime shim).
 - ``locks`` — ``make_lock(rank)`` factory; plain RLock normally,
   order-asserting ``CheckedRLock`` under ``SIDDHI_TPU_SANITIZE=1``.
+- ``guards`` — ``GUARDED_BY`` lock-coverage contracts (the runtime
+  half of R8): descriptor-asserted field access under sanitize, plain
+  attributes off.
 - ``sanitize`` — the ``SIDDHI_TPU_SANITIZE=1`` runtime detectors
   (transfer guard + portable pull guard, post-warmup recompile
-  watchdog, lock-order assertions).
+  watchdog, lock-order + lock-coverage assertions).
 - ``step_registry`` — declarative list of every jitted step builder;
   ``tools/hlo_audit.py`` asserts audit coverage against it.
 """
@@ -24,4 +28,5 @@ from siddhi_tpu.analysis.engine import (  # noqa: F401
     load_modules,
     run_lint,
 )
+from siddhi_tpu.analysis.guards import guarded  # noqa: F401
 from siddhi_tpu.analysis.locks import make_lock  # noqa: F401

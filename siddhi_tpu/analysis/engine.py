@@ -157,6 +157,7 @@ def default_rules() -> List[Rule]:
     from siddhi_tpu.analysis.rules_actuators import ActuatorParityRule
     from siddhi_tpu.analysis.rules_backend import BackendInitRule
     from siddhi_tpu.analysis.rules_config import ConfigKnobRule
+    from siddhi_tpu.analysis.rules_guards import GuardedByRule
     from siddhi_tpu.analysis.rules_hotpath import HostPullRule
     from siddhi_tpu.analysis.rules_instruments import InstrumentParityRule
     from siddhi_tpu.analysis.rules_locks import LockOrderRule
@@ -164,7 +165,7 @@ def default_rules() -> List[Rule]:
 
     return [BackendInitRule(), ConfigKnobRule(), MetricParityRule(),
             LockOrderRule(), HostPullRule(), InstrumentParityRule(),
-            ActuatorParityRule()]
+            ActuatorParityRule(), GuardedByRule()]
 
 
 def run_lint(modules: List[ModuleInfo],

@@ -37,6 +37,20 @@ RANKS: Dict[str, str] = {
     "ingest": "IngestPackPool._lock (core/stream/input/pack_pool.py)",
     "autopilot": "AutopilotController locks (siddhi_tpu/autopilot/"
                  "controller.py)",
+    "adapt": "StreamJunction._adapt_lock (core/stream/junction.py)",
+    "overload": "OverloadManager / FairScheduler / AppOverloadControl "
+                "locks (resilience/overload.py)",
+    "app_supervisor": "AppSupervisor._lock (resilience/supervisor.py)",
+    # cluster fabric (siddhi_tpu/cluster/) — PR-17's bare locks, ranked
+    "cluster_ingest": "ClusterRuntime._ingest_lock — global sequencing "
+                      "+ checkpoint barrier (cluster/router.py)",
+    "link": "_WorkerLink._lock — send vs recovery session "
+            "(cluster/router.py)",
+    "router": "ClusterRuntime._lock — link attach/invalidate, ids "
+              "(cluster/router.py)",
+    "egress": "OrderedEgress._lock/_cv (cluster/egress.py)",
+    "cluster_supervisor": "WorkerSupervisor._lock "
+                          "(cluster/supervisor.py)",
 }
 
 # (first, second): `first` must be acquired before `second`; acquiring
@@ -60,6 +74,22 @@ EDGES: Tuple[Tuple[str, str], ...] = (
     ("autopilot", "owner"),
     ("autopilot", "pump"),
     ("autopilot", "ingest"),
+    # cluster fabric (cluster/router.py): the global-sequencing lock is
+    # outermost — _ingest_frame splits + sends runs (link session) and
+    # registers egress expectations under it; the checkpoint barrier
+    # cuts/trims worker WALs under it
+    ("cluster_ingest", "link"),
+    ("cluster_ingest", "egress"),
+    ("cluster_ingest", "wal"),
+    # a send/recovery failure invalidates the session and notifies the
+    # supervisor while holding the link session lock
+    ("link", "router"),
+    ("link", "egress"),      # recovery replays forget/drop under session
+    ("link", "wal"),         # recovery reads the WAL suffix under session
+    # the reader thread notifies the supervisor under the router lock;
+    # the supervisor lock is a leaf (it never calls back into the router
+    # under its own lock)
+    ("router", "cluster_supervisor"),
 )
 
 # Static-rule recognizers: `NAME._lock` / `NAME` in a `with` resolves to
@@ -72,6 +102,8 @@ VARIABLE_RANKS: Dict[str, str] = {
     "shard": "shard",
     "wal": "wal",
     "pool": "ingest",
+    "link": "link",          # _WorkerLink._lock (cluster/router.py)
+    "egress": "egress",      # OrderedEgress._lock (cluster/egress.py)
 }
 
 # Attribute names that denote the app barrier regardless of receiver.

@@ -31,6 +31,13 @@ def _held():
     return stack
 
 
+def held_ranks() -> frozenset:
+    """Ranks of every ``CheckedRLock`` the CALLING thread currently
+    holds — the guarded-by sanitizer's (``analysis/guards.py``) oracle.
+    Empty when sanitize is off (plain RLocks leave no trace)."""
+    return frozenset(rank for rank, _ in _held())
+
+
 class CheckedRLock:
     """Re-entrant lock that asserts the declared acquisition order.
 
@@ -82,6 +89,22 @@ class CheckedRLock:
     def __exit__(self, *exc):
         self.release()
         return False
+
+    # --- threading.Condition protocol (OrderedEgress wraps its ranked
+    # lock in a Condition). wait() fully releases the inner RLock via
+    # _release_save and reacquires via _acquire_restore; the rank stays
+    # on the held stack across the wait on purpose — the waiting thread
+    # acquires nothing while blocked, and the predicate runs with the
+    # lock (logically and physically) held.
+
+    def _is_owned(self):
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        return self._lock._release_save()
+
+    def _acquire_restore(self, state):
+        self._lock._acquire_restore(state)
 
 
 def make_lock(rank: str):

@@ -16,7 +16,13 @@ The rule flags:
   still an ad-hoc read);
 - any ``os.environ`` read of a ``SIDDHI_TPU_*`` variable outside the
   knob registry and the sanitizer module (env spellings deserve the
-  same typed parsing as config keys).
+  same typed parsing as config keys);
+- (the bidirectional half) any knob DECLARED in the registry that no
+  production code ever reads — ``attr=None`` knobs need a
+  ``read_knob(…, "key")`` literal somewhere, ``attr="x"`` knobs need
+  the attribute consumed (``ctx.x`` or ``getattr(ctx, "x", …)``).
+  A tunable nobody consumes is dead weight that silently does nothing
+  when users set it.
 """
 
 from __future__ import annotations
@@ -89,7 +95,84 @@ class ConfigKnobRule(Rule):
                             f"ad-hoc read of env var '{text}' — give "
                             f"it a typed accessor in "
                             f"core/util/knobs.py"))
+        findings.extend(self._dead_knobs(ctx))
         return findings
+
+    # ------------------------------------------------- dead-knob parity
+
+    def _dead_knobs(self, ctx: LintContext) -> List[Finding]:
+        """The reverse direction: every ``Knob(...)`` declared in the
+        registry must have a production consumer. Silent when the
+        linted tree has no registry at all (targeted roots, fixtures).
+        """
+        knobs_mod = None
+        for mod in ctx.modules:
+            if mod.path.endswith("core/util/knobs.py"):
+                knobs_mod = mod
+                break
+        if knobs_mod is None:
+            return []
+        declared = self._declared_knobs(knobs_mod.tree)
+        if not declared:
+            return []
+        read_keys: set = set()
+        attr_reads: set = set()
+        for mod in ctx.modules:
+            if mod is knobs_mod or mod.path.startswith("tests/"):
+                continue
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)):
+                    attr_reads.add(node.attr)
+                elif isinstance(node, ast.Call):
+                    fn = node.func
+                    name = (fn.attr if isinstance(fn, ast.Attribute)
+                            else fn.id if isinstance(fn, ast.Name)
+                            else None)
+                    if name == "read_knob":
+                        for arg in node.args[1:]:
+                            text = _literal_text(arg)
+                            if text:
+                                read_keys.add(text)
+                    elif name == "getattr" and len(node.args) >= 2:
+                        text = _literal_text(node.args[1])
+                        if text:
+                            attr_reads.add(text)
+        findings: List[Finding] = []
+        for key in sorted(declared):
+            attr, lineno = declared[key]
+            alive = (key in read_keys) if attr is None \
+                else (attr in attr_reads)
+            if not alive:
+                how = (f"read_knob(…, '{key}')" if attr is None
+                       else f"a read of ctx.{attr}")
+                findings.append(Finding(
+                    self.id, knobs_mod.path, lineno,
+                    f"knob '{key}' is declared but never read by "
+                    f"production code ({how} not found) — wire up a "
+                    f"consumer or drop the declaration"))
+        return findings
+
+    @staticmethod
+    def _declared_knobs(tree) -> dict:
+        """``{key: (attr_or_None, lineno)}`` from the registry's
+        ``Knob("key", ..., attr=...)`` declarations."""
+        out: dict = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "Knob"
+                    and node.args):
+                continue
+            key = _literal_text(node.args[0])
+            if key is None:
+                continue
+            attr = None
+            for kw in node.keywords:
+                if kw.arg == "attr":
+                    attr = _literal_text(kw.value)
+            out[key] = (attr, node.lineno)
+        return out
 
     def _check_env(self, mod, node: ast.Call, findings) -> None:
         """os.environ.get("SIDDHI_TPU_…") / os.getenv(…) outside the

@@ -1,6 +1,6 @@
 """Runtime sanitizers, gated by ``SIDDHI_TPU_SANITIZE=1``.
 
-Three detectors for the bug classes graftlint checks statically, armed
+Four detectors for the bug classes graftlint checks statically, armed
 at runtime so CI and quick checks catch what escapes the AST:
 
 1. **Host-transfer detection.** ``jax.transfer_guard`` is set to
@@ -24,6 +24,13 @@ at runtime so CI and quick checks catch what escapes the AST:
 3. **Lock-order assertions.** ``analysis.locks.make_lock`` returns
    ``CheckedRLock``s that enforce the partial order declared in
    ``analysis/lockorder.py`` per thread, per acquisition.
+
+4. **Lock-coverage (guarded-by) assertions.** ``analysis.guards``
+   installs a data descriptor per field a class declares in its
+   ``GUARDED_BY`` map (the static half is graftlint R8): every
+   read/write asserts via the ``CheckedRLock`` per-thread holdings that
+   a lock of the guarding rank is held, raising ``GuardViolation``
+   otherwise. Plain attributes when off — zero cost.
 
 Enable with ``SIDDHI_TPU_SANITIZE=1`` in the environment BEFORE
 importing siddhi_tpu (the lock factory and jit proxies read it at

@@ -26,6 +26,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from siddhi_tpu.analysis.guards import guarded
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.autopilot import signals
 from siddhi_tpu.autopilot.actuators import ACTUATORS
@@ -60,11 +61,14 @@ class _AppState:
                              "autopilot_interval_s", 0.25) or 0.25)
 
 
+@guarded
 class AutopilotController:
     """Process-wide controller registry + tick thread."""
 
     _instance: Optional["AutopilotController"] = None
     _instance_lock = threading.Lock()
+
+    GUARDED_BY = {"_apps": "autopilot"}
 
     def __init__(self):
         self._lock = make_lock("autopilot")

@@ -26,14 +26,26 @@ import threading
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from siddhi_tpu.analysis.guards import guarded
+from siddhi_tpu.analysis.locks import make_lock
+
 Tag = Tuple[int, int]
 
 
+@guarded
 class OrderedEgress:
     """Router-side merge point for worker emissions."""
 
+    GUARDED_BY = {
+        "_expected": "egress", "_expected_set": "egress",
+        "_ready": "egress", "_pending_rows": "egress",
+        "_done": "egress", "rows": "egress",
+        "merged_rows": "egress", "merged_runs": "egress",
+        "duplicate_emits": "egress",
+    }
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("egress")
         self._cv = threading.Condition(self._lock)
         self._expected: deque = deque()     # tags in global send order
         self._expected_set = set()
@@ -136,6 +148,14 @@ class OrderedEgress:
         with self._cv:
             return self._cv.wait_for(lambda: not self._expected,
                                      timeout=timeout)
+
+    def counters(self) -> Dict[str, int]:
+        """Merge counters under the lock — status endpoints and tools
+        must read through here, never the raw attributes."""
+        with self._lock:
+            return {"merged_rows": self.merged_rows,
+                    "merged_runs": self.merged_runs,
+                    "duplicate_emits": self.duplicate_emits}
 
     def snapshot_rows(self) -> Dict[Tuple[str, str], List[Tuple]]:
         with self._lock:

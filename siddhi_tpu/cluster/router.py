@@ -48,6 +48,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from siddhi_tpu.analysis.guards import guarded
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.cluster import protocol as P
 from siddhi_tpu.cluster.egress import OrderedEgress
 from siddhi_tpu.cluster.protocol import RelayEncoder, encode_for_link
@@ -74,15 +76,20 @@ def owner_of_key(value, n_workers: int) -> int:
     return zlib.crc32(str(value).encode("utf-8")) % n_workers
 
 
+@guarded
 class _WorkerLink:
     """Router-side state of one worker process' link."""
+
+    # `up`/`acked_seq`/`last_heartbeat` stay undeclared: they are
+    # lock-free liveness probes read by gauges and status snapshots
+    GUARDED_BY = {"encoders": "link", "tags": "link"}
 
     def __init__(self, idx: int, wal_batches: int):
         self.idx = idx
         self.sock: Optional[P.MessageSocket] = None
         self.up = False
         self.ready = threading.Event()       # cleared while down/recovering
-        self.session_lock = threading.Lock()  # serializes send vs recovery
+        self._lock = make_lock("link")       # serializes send vs recovery
         self.wal = IngestWAL(max_batches=wal_batches)
         self.tags: Dict[int, Tuple[Tuple[int, int], str, str]] = {}
         self.encoders: Dict[Tuple[str, str], RelayEncoder] = {}
@@ -95,7 +102,13 @@ class _WorkerLink:
         self.pid: Optional[int] = None
         self.hb_port: Optional[int] = None
 
-    def invalidate_session(self) -> None:
+    def trim_tags(self, cut: int) -> None:
+        """Drop WAL-tag entries a checkpoint cut has covered."""
+        with self._lock:
+            self.tags = {s: t for s, t in self.tags.items() if s > cut}
+
+    def invalidate_session_locked(self) -> None:
+        """Caller holds this link's lock (rank ``link``)."""
         self.up = False
         self.ready.clear()
         self.encoders = {}
@@ -157,8 +170,15 @@ class _AppSpec:
             self.part_attr[sid] = (key, kinds[key] == AttrType.STRING)
 
 
+@guarded
 class ClusterRuntime:
     """The router process' in-process handle on the whole fabric."""
+
+    GUARDED_BY = {
+        "_seq": "cluster_ingest", "_barrier_id": "cluster_ingest",
+        "_conn_seq": "router", "_qid": "router",
+        "_query_waits": "router", "apps": "router",
+    }
 
     def __init__(self, n_workers: Optional[int] = None,
                  config: Optional[dict] = None,
@@ -190,13 +210,13 @@ class ClusterRuntime:
         self.apps: Dict[str, _AppSpec] = {}
         self.links = [_WorkerLink(i, self._wal_batches)
                       for i in range(self.n_workers)]
-        self._ingest_lock = threading.Lock()   # global sequencing
+        self._ingest_lock = make_lock("cluster_ingest")  # global sequencing
         self._seq = 0
         self._barrier_id = 0
         self._qid = 0
         self._query_waits: Dict[int, tuple] = {}
         self._closing = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("router")
 
         # worker-link listener
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -355,7 +375,8 @@ class ClusterRuntime:
                     ev.set()
             elif mtype == P.MSG_QUERY_RESULT:
                 r = P.jload(body)
-                waiter = self._query_waits.get(r.get("qid"))
+                with self._lock:
+                    waiter = self._query_waits.get(r.get("qid"))
                 if waiter is not None:
                     ev, box, pending = waiter
                     box[link.idx] = r
@@ -367,12 +388,13 @@ class ClusterRuntime:
             elif mtype == P.MSG_ERROR:
                 print(f"[cluster-router] worker {link.idx} error: "
                       f"{P.jload(body)}", flush=True)
-        with self._lock:
-            if link.sock is msock and not self._closing:
-                link.invalidate_session()
-                _count(f"cluster.worker.link_drops.{link.idx}")
-                if self.supervisor is not None:
-                    self.supervisor.worker_lost(link.idx)
+        with link._lock:
+            with self._lock:
+                if link.sock is msock and not self._closing:
+                    link.invalidate_session_locked()
+                    _count(f"cluster.worker.link_drops.{link.idx}")
+                    if self.supervisor is not None:
+                        self.supervisor.worker_lost(link.idx)
 
     # ---------------------------------------------------------- deployment
 
@@ -392,8 +414,9 @@ class ClusterRuntime:
                 raise ValueError("deploy needs name= (or an @app:name "
                                  "annotation in the app text)")
             name = m.group(1)
-        if name in self.apps:
-            raise ValueError(f"app '{name}' is already deployed")
+        with self._lock:
+            if name in self.apps:
+                raise ValueError(f"app '{name}' is already deployed")
         app = _AppSpec(name, text, sinks or [], partition_keys, config,
                        self.n_workers)
         for idx in app.workers:
@@ -406,7 +429,8 @@ class ClusterRuntime:
             if first_box is None:
                 first_box = box
         app.learn_definitions(first_box.get("streams", {}))
-        self.apps[name] = app
+        with self._lock:
+            self.apps[name] = app
         return app
 
     def _deploy_on(self, link: _WorkerLink, app: _AppSpec,
@@ -437,7 +461,8 @@ class ClusterRuntime:
         """In-process ingest: frames through the app's loopback encoder
         so BOTH ingest paths (socket and in-process) share one decode +
         split + relay pipeline. Returns the assigned global sequence."""
-        app = self.apps[app_name]
+        with self._lock:
+            app = self.apps[app_name]
         frame = app.encoder.encode(
             dict(data), timestamps=timestamps)
         return self._ingest_frame(app, stream, frame,
@@ -517,11 +542,14 @@ class ClusterRuntime:
 
     def _send_run(self, link: _WorkerLink, tag, app: _AppSpec,
                   stream: str, data, ts, record: bool = True) -> None:
-        if record:
-            wal_seq = link.wal.record_columns(stream, data,
-                                              timestamps=ts)
-            link.tags[wal_seq] = (tag, app.name, stream)
-        with link.session_lock:
+        # the WAL record and its tag must land under the link lock:
+        # recovery iterates `link.tags` under the same lock, and an
+        # ingest racing a replay must not mutate the dict mid-iteration
+        with link._lock:
+            if record:
+                wal_seq = link.wal.record_columns(stream, data,
+                                                  timestamps=ts)
+                link.tags[wal_seq] = (tag, app.name, stream)
             if not link.up:
                 return          # down: the WAL replay will deliver it
             try:
@@ -529,7 +557,7 @@ class ClusterRuntime:
             except OSError:
                 with self._lock:
                     if not self._closing:
-                        link.invalidate_session()
+                        link.invalidate_session_locked()
                         if self.supervisor is not None:
                             self.supervisor.worker_lost(link.idx)
 
@@ -578,7 +606,8 @@ class ClusterRuntime:
                     raise P.ProtocolError(
                         f"unexpected message {mtype} on ingest link")
                 _s, _r, app_name, stream, frame = P.unpack_data(body)
-                app = self.apps.get(app_name)
+                with self._lock:
+                    app = self.apps.get(app_name)
                 if app is None:
                     raise P.ProtocolError(f"unknown app '{app_name}'")
                 seq = self._ingest_frame(app, stream, frame,
@@ -639,8 +668,7 @@ class ClusterRuntime:
                 revs = waiters[link.idx][1].get("revisions", {})
                 link.wal.checkpoint_revision = \
                     next(iter(revs.values()), None)
-                link.tags = {s: t for s, t in link.tags.items()
-                             if s > cut}
+                link.trim_tags(cut)
                 out[link.idx] = revs
             _count("cluster.checkpoints")
             return out
@@ -662,10 +690,12 @@ class ClusterRuntime:
         """The PR-1 protocol, router-driven: re-deploy with restore,
         replay the WAL suffix with ORIGINAL tags, resume the key range."""
         _count(f"cluster.worker.respawns.{link.idx}")
-        with link.session_lock:
+        with link._lock:
+            with self._lock:
+                apps = dict(self.apps)
             try:
                 for app_name in sorted(link.apps):
-                    self._deploy_on(link, self.apps[app_name],
+                    self._deploy_on(link, apps[app_name],
                                     restore=True, timeout=120.0)
                 records = link.wal.records_after(0)
                 retained = {rec.seq for rec in records}
@@ -683,7 +713,7 @@ class ClusterRuntime:
                     self.egress.drop_pending(link.tags[rec.seq][0])
                 for rec in records:
                     tag, app_name, stream = link.tags[rec.seq]
-                    self._relay(link, tag, self.apps[app_name],
+                    self._relay(link, tag, apps[app_name],
                                 rec.stream_id, rec.payload,
                                 rec.timestamps)
                     _count(f"cluster.worker.replayed_batches.{link.idx}")
@@ -693,7 +723,7 @@ class ClusterRuntime:
                 print(f"[cluster-router] recovery of worker {link.idx} "
                       f"failed: {e}", flush=True)
                 with self._lock:
-                    link.invalidate_session()
+                    link.invalidate_session_locked()
                     if self.supervisor is not None:
                         self.supervisor.worker_lost(link.idx)
 
@@ -707,14 +737,14 @@ class ClusterRuntime:
         (serving/cluster_gather.py)."""
         from siddhi_tpu.serving.cluster_gather import gather_query_rows
 
-        app = self.apps[app_name]
         with self._lock:
+            app = self.apps[app_name]
             self._qid += 1
             qid = self._qid
-        targets = [self.links[i] for i in app.workers]
-        ev, box, pending = (threading.Event(), {},
-                            {li.idx for li in targets})
-        self._query_waits[qid] = (ev, box, pending)
+            targets = [self.links[i] for i in app.workers]
+            ev, box, pending = (threading.Event(), {},
+                                {li.idx for li in targets})
+            self._query_waits[qid] = (ev, box, pending)
         try:
             for link in targets:
                 if not link.ready.wait(timeout):
@@ -726,7 +756,8 @@ class ClusterRuntime:
                     f"query fan-out: workers "
                     f"{sorted(pending)} never answered")
         finally:
-            self._query_waits.pop(qid, None)
+            with self._lock:
+                self._query_waits.pop(qid, None)
         parts = []
         for idx in sorted(box):
             r = box[idx]
@@ -739,6 +770,10 @@ class ClusterRuntime:
 
     def status(self) -> dict:
         """JSON-ready fabric status (the REST tier's GET /cluster)."""
+        with self._lock:
+            app_items = sorted(self.apps.items())
+        eg = self.egress.counters()
+        eg["outstanding"] = self.egress.outstanding()
         return {
             "workers": self.n_workers,
             "live": sum(1 for li in self.links if li.up),
@@ -746,18 +781,15 @@ class ClusterRuntime:
             "apps": {name: {"mode": spec.mode,
                             "workers": sorted(spec.workers),
                             "sinks": list(spec.sinks)}
-                     for name, spec in sorted(self.apps.items())},
+                     for name, spec in app_items},
             "per_worker": {
                 li.idx: {"up": li.up,
                          "acked_seq": li.acked_seq,
                          "wal_batches": len(li.wal),
-                         "respawns": (self.supervisor.respawns[li.idx]
+                         "respawns": (self.supervisor.respawn_count(li.idx)
                                       if self.supervisor else 0)}
                 for li in self.links},
-            "egress": {"merged_rows": self.egress.merged_rows,
-                       "merged_runs": self.egress.merged_runs,
-                       "duplicate_emits": self.egress.duplicate_emits,
-                       "outstanding": self.egress.outstanding()},
+            "egress": eg,
         }
 
     # ------------------------------------------------------------ teardown

@@ -26,6 +26,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from siddhi_tpu.analysis.guards import guarded
+from siddhi_tpu.analysis.locks import make_lock
+
 
 def _child_env() -> dict:
     """Workers are plain-CPU engines: strip inherited accelerator state
@@ -43,8 +46,14 @@ def _child_env() -> dict:
     return env
 
 
+@guarded
 class WorkerSupervisor:
     """Owns the worker processes of one ``ClusterRuntime``."""
+
+    GUARDED_BY = {
+        "procs": "cluster_supervisor", "respawns": "cluster_supervisor",
+        "_addrs": "cluster_supervisor", "_held_down": "cluster_supervisor",
+    }
 
     def __init__(self, runtime, persist_root: Optional[str] = None,
                  heartbeat_s: float = 0.5, misses: int = 3,
@@ -63,7 +72,7 @@ class WorkerSupervisor:
         self.respawns = [0] * n
         self._addrs: Dict[int, Tuple[str, int]] = {}
         self._held_down = set()      # killed on purpose, do not respawn
-        self._lock = threading.Lock()
+        self._lock = make_lock("cluster_supervisor")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -129,9 +138,11 @@ class WorkerSupervisor:
         addr = ("127.0.0.1", int(hb_port))
         with self._lock:
             old = self._addrs.get(idx)
-            if old is not None and old != addr:
-                self.monitor.unwatch(*old)
             self._addrs[idx] = addr
+        # monitor calls stay outside the lock: the PeerMonitor has its
+        # own (app_supervisor-ranked) lock and this one must stay a leaf
+        if old is not None and old != addr:
+            self.monitor.unwatch(*old)
         self.monitor.rearm(*addr)
 
     def worker_lost(self, idx: int) -> None:
@@ -159,6 +170,10 @@ class WorkerSupervisor:
         """Allow a held-down worker to respawn on the next tick."""
         with self._lock:
             self._held_down.discard(idx)
+
+    def respawn_count(self, idx: int) -> int:
+        with self._lock:
+            return self.respawns[idx]
 
     # ---------------------------------------------------------- poll loop
 
@@ -188,5 +203,6 @@ class WorkerSupervisor:
                 continue
             if self._stop.is_set():
                 return
-            self.respawns[idx] += 1
+            with self._lock:
+                self.respawns[idx] += 1
             self._spawn(idx)

@@ -95,7 +95,10 @@ def _default_app_name(siddhi_app: SiddhiApp) -> str:
     return "siddhi-app-" + hashlib.md5(repr(siddhi_app).encode()).hexdigest()[:12]
 
 
-class SiddhiAppRuntime:
+class SiddhiAppRuntime:  # graftlint: disable=R8 — the junction/query/
+    # adapter registries are populated during single-threaded wiring
+    # (parse + add_callback before start()); runtime threads only read
+    # them, and lifecycle transitions serialize on the app barrier
     def __init__(self, siddhi_app: SiddhiApp, siddhi_context: SiddhiContext):
         self.siddhi_app = siddhi_app
         self.name = siddhi_app.name or _default_app_name(siddhi_app)

@@ -26,7 +26,10 @@ class SiddhiContext:
         self.attributes: Dict[str, object] = {}
 
 
-class TimestampGenerator:
+class TimestampGenerator:  # graftlint: disable=R8 — listener list is
+    # mutated at single-threaded wiring time only; the heartbeat thread
+    # iterates a snapshot under the app barrier, and one-shot listeners
+    # remove themselves inside that same barrier'd iteration
     """Event/wall clock (reference ``util/timestamp/TimestampGeneratorImpl.java:31``):
     live mode returns wall time; playback mode returns the last event
     timestamp (+ configurable idle increment handled by the scheduler)."""

@@ -68,6 +68,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from siddhi_tpu.analysis.guards import guarded
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.stream.junction import FatalQueryError
 from siddhi_tpu.observability import journey as journey_mod
@@ -209,6 +210,7 @@ class FusedCompletion:
 _is_ready = journey_mod.ready_of
 
 
+@guarded
 class CompletionPump:
     """Per-app registry of in-flight device batches (one FIFO per owner).
 
@@ -218,6 +220,10 @@ class CompletionPump:
     ``owner._lock`` -> ``pump._lock`` — the pump lock is never held
     across a device pull or an emit.
     """
+
+    # `_n_pending` and `_submits_by_j` stay undeclared: both are
+    # lock-free has-work/progress probes read from hot sync paths
+    GUARDED_BY = {"_pending": "pump"}
 
     def __init__(self, app_context):
         self.app_context = app_context

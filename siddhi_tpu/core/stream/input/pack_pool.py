@@ -51,6 +51,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from siddhi_tpu.analysis.guards import guarded
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.query_api.definitions import AttrType
 
@@ -70,6 +71,7 @@ class _Task:
         self.elapsed_ms = 0.0
 
 
+@guarded
 class IngestPackPool:
     """Per-app ordered pack pool (see module docstring).
 
@@ -78,6 +80,12 @@ class IngestPackPool:
     the shared queue, each caller waits only its own). Workers take NO
     ranked locks; the pool's own bookkeeping lock ranks ``ingest``
     (a leaf under barrier/owner, ``analysis/lockorder.py``)."""
+
+    # `_stopped` stays undeclared: it is a double-checked shutdown gate
+    # whose UNLOCKED fast-path reads are deliberate (re-verified under
+    # the lock in _spawn_missing_locked); `_busy`/`_beats` are lock-free
+    # utilization/liveness probes
+    GUARDED_BY = {"_threads": "ingest", "_gen": "ingest"}
 
     def __init__(self, app_context, workers: int, split_rows: int = 8192):
         if workers <= 0:
@@ -112,14 +120,15 @@ class IngestPackPool:
         else:
             self._pack_hist = self._merge_hist = None
         with self._lock:
-            self._spawn_missing()
+            self._spawn_missing_locked()
 
     # ----------------------------------------------------------- lifecycle
 
     def alive_workers(self) -> int:
-        return sum(1 for t in self._threads if t.is_alive())
+        with self._lock:
+            return sum(1 for t in self._threads if t.is_alive())
 
-    def _spawn_missing(self) -> int:
+    def _spawn_missing_locked(self) -> int:
         """Replace dead worker threads (pool lock held). Returns how many
         were spawned."""
         if self._stopped:
@@ -145,7 +154,7 @@ class IngestPackPool:
         if self._stopped:
             return 0
         with self._lock:
-            return self._spawn_missing()
+            return self._spawn_missing_locked()
 
     def resize(self, workers: int) -> int:
         """Live worker-count change (the autopilot's ingest actuator).
@@ -165,7 +174,7 @@ class IngestPackPool:
             surplus = len([t for t in self._threads if t.is_alive()]) \
                 - workers
             self.workers = workers
-            self._spawn_missing()
+            self._spawn_missing_locked()
         # sentinels queue BEHIND any pending tasks: surplus workers
         # drain real work first, then exit; _spawn_missing prunes the
         # dead threads on the next submit/heal
@@ -241,7 +250,7 @@ class IngestPackPool:
         lost, at worst slower. Returns per-sub-batch service times in
         sequence order (journey max-not-sum attribution)."""
         with self._lock:
-            self._spawn_missing()
+            self._spawn_missing_locked()
         tasks = [_Task(seq, lo, hi, fn)
                  for seq, (lo, hi) in enumerate(chunks)]
         for t in tasks:
@@ -282,7 +291,7 @@ class IngestPackPool:
                     self._tel.count("ingest.pool.repacks")
                     self._pack_hist.record(t.elapsed_ms)
                 with self._lock:
-                    self._spawn_missing()
+                    self._spawn_missing_locked()
             out.append(t.elapsed_ms)
         return out
 

@@ -19,6 +19,8 @@ import time
 import traceback
 from typing import List, Optional
 
+from siddhi_tpu.analysis.guards import guarded
+from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import Event
 from siddhi_tpu.observability import journey
 from siddhi_tpu.observability.tracing import span
@@ -66,7 +68,15 @@ class Receiver:
         self.receive(junction.decode_events(batch))
 
 
+@guarded
 class StreamJunction:
+    # only the adaptive-batch control loop's read-modify-write state is
+    # lock-guarded; the resilience counters (`_beats`, `_inflight`) and
+    # the delivery-thread-confined registries (`receivers`,
+    # `_pending_mutations`, `_wal_seq_of`, `_jt_enq`) are deliberately
+    # lock-free — gauges and the supervisor read them racily on purpose
+    GUARDED_BY = {"_lat_ewma": "adapt", "_cur_batch": "adapt"}
+
     def __init__(self, definition: StreamDefinition, app_context, fault_junction: Optional["StreamJunction"] = None):
         self.definition = definition
         self.app_context = app_context
@@ -84,7 +94,7 @@ class StreamJunction:
         # _adapt used to run only on the single worker thread; pipelined
         # completions now also feed it from whichever thread drains the
         # pump, so the EWMA/cap read-modify-write needs a lock
-        self._adapt_lock = threading.Lock()
+        self._adapt_lock = make_lock("adapt")
         self._running = False
         self._fatal: Optional[Exception] = None  # async worker's FatalQueryError
         # resilience hooks (resilience/supervisor.py, resilience/faults.py):
@@ -165,11 +175,14 @@ class StreamJunction:
           slower (capacity regrow, device contention)."""
         self._async = True
         self._batch_size = batch_size
-        self._cur_batch = batch_size          # adaptive cap (<= batch_size)
         self._max_delay_s = (max_delay_ms / 1000.0
                              if max_delay_ms is not None else None)
         self._latency_target_ms = latency_target_ms
-        self._lat_ewma = 0.0
+        with self._adapt_lock:
+            # a live re-enable (autopilot re-tune) races the control
+            # loop's read-modify-write in _adapt — same lock
+            self._cur_batch = batch_size      # adaptive cap (<= batch_size)
+            self._lat_ewma = 0.0
         self._queue = queue.Queue(maxsize=buffer_size)
         # observability: queue depth + in-flight unit gauges, scraped via
         # GET /metrics (telemetry is level-independent — a wedging @Async
@@ -555,8 +568,12 @@ class StreamJunction:
             follow = None            # HostBatch that broke the coalesce
             follow_enq = None
             # re-batch pending chunks up to the (adaptive) cap; a partial
-            # batch waits at most max.delay for more
-            while len(batch) < self._cur_batch:
+            # batch waits at most max.delay for more. The cap is read
+            # ONCE per drain, under the adapt lock — the control loop
+            # may rewrite it concurrently from a pump-draining thread
+            with self._adapt_lock:
+                cap = self._cur_batch
+            while len(batch) < cap:
                 try:
                     if deadline is None:
                         more = self._queue.get_nowait()

@@ -75,6 +75,9 @@ from typing import Dict, Optional
 
 log = logging.getLogger(__name__)
 
+from siddhi_tpu.analysis.guards import guarded  # noqa: E402
+from siddhi_tpu.analysis.locks import make_lock  # noqa: E402
+
 # declared next to the config parser so the accepted spellings cannot
 # drift from what the typed knob registry rejects (graftlint R2 class)
 from siddhi_tpu.core.util.knobs import SHED_POLICIES  # noqa: E402,F401
@@ -136,6 +139,7 @@ class OverloadConfig:
                     f"{SHED_POLICIES}")
 
 
+@guarded
 class FairScheduler:
     """Weighted fair throttling across registered apps.
 
@@ -148,9 +152,11 @@ class FairScheduler:
     _SLACK = 1.25            # tolerated overshoot before throttling
     _MAX_SLEEP_S = 0.02      # per-call yield bound (p99-safe)
 
+    GUARDED_BY = {"_apps": "overload"}
+
     def __init__(self, tau_s: float = 1.0):
         self.tau_s = float(tau_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("overload")
         # name -> {"weight", "usage", "last", "backlog_fn"}
         self._apps: Dict[str, dict] = {}
 
@@ -203,11 +209,16 @@ class FairScheduler:
         return delay
 
 
+@guarded
 class AppOverloadControl:
     """One registered app's overload state: quota admission for its
     junctions, the memory-budget ledger, and shed/denial accounting.
     Installed as ``app_context.overload`` by ``OverloadManager.register``;
     every engine call site treats ``None`` as "no quotas"."""
+
+    # the shed/denial counters stay undeclared: written under the lock,
+    # read lock-free by reports and tests
+    GUARDED_BY = {"_ledger": "overload"}
 
     def __init__(self, manager: "OverloadManager", app_runtime,
                  config: OverloadConfig):
@@ -215,7 +226,7 @@ class AppOverloadControl:
         self.app_runtime = app_runtime
         self.app_context = app_runtime.app_context
         self.config = config
-        self._lock = threading.Lock()
+        self._lock = make_lock("overload")
         # component -> charged bytes (capacity-growth ledger)
         self._ledger: Dict[str, int] = {}
         self.shed_events = 0          # events shed across all streams
@@ -427,6 +438,7 @@ class AppOverloadControl:
         return out
 
 
+@guarded
 class OverloadManager:
     """Process-global registry of overload-protected apps — one per
     process, like the serving tier's scatter pool."""
@@ -434,8 +446,10 @@ class OverloadManager:
     _inst: Optional["OverloadManager"] = None
     _inst_lock = threading.Lock()
 
+    GUARDED_BY = {"_apps": "overload"}
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("overload")
         self._apps: Dict[str, AppOverloadControl] = {}
         self.fair = FairScheduler()
 

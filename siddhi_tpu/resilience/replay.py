@@ -33,6 +33,7 @@ import threading
 from collections import deque
 from typing import List, Optional
 
+from siddhi_tpu.analysis.guards import guarded
 from siddhi_tpu.analysis.locks import make_lock
 from siddhi_tpu.core.event import Event
 
@@ -79,8 +80,13 @@ def register_wal_gauges(app_context) -> None:
     tel.gauge("wal.dropped_batches", lambda w=wal: w.dropped_batches)
 
 
+@guarded
 class IngestWAL:
     """Per-process bounded ingest log (see module docstring)."""
+
+    # the overflow/shed/replay counters stay undeclared: monotonic,
+    # single-writer, read lock-free by gauges and reports
+    GUARDED_BY = {"_log": "wal", "_seq": "wal", "_events": "wal"}
 
     def __init__(self, max_batches: int = 4096,
                  max_events: Optional[int] = None,
@@ -213,11 +219,13 @@ class IngestWAL:
     # -------------------------------------------------------------- replay
 
     def __len__(self) -> int:
-        return len(self._log)
+        with self._lock:
+            return len(self._log)
 
     @property
     def pending_events(self) -> int:
-        return self._events
+        with self._lock:
+            return self._events
 
     def records_after(self, seq: int) -> List[_Record]:
         """Retained records with sequence > ``seq`` (oldest first) — the

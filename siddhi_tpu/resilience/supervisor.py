@@ -34,9 +34,13 @@ import threading
 import time
 from typing import Callable, Optional
 
+from siddhi_tpu.analysis.guards import guarded
+from siddhi_tpu.analysis.locks import make_lock
+
 log = logging.getLogger(__name__)
 
 
+@guarded
 class PeerMonitor:
     """Socket liveness heartbeats between cluster processes.
 
@@ -50,12 +54,18 @@ class PeerMonitor:
     pull timeout). The supervisor folds confirmed deaths into the same
     ``ClusterPeerError`` recovery path as a blocked pull."""
 
+    # watch/unwatch/rearm run on supervisor threads while poll_dead's
+    # bookkeeping runs on the tick thread; probes happen OUTSIDE the
+    # lock (a slow connect must not block an unwatch)
+    GUARDED_BY = {"_peers": "app_supervisor", "_dead": "app_supervisor"}
+
     def __init__(self, listen_port: int = 0, probe_timeout_s: float = 1.0,
                  misses: int = 3):
         import socket
 
         self.probe_timeout_s = float(probe_timeout_s)
         self.misses = int(misses)
+        self._lock = make_lock("app_supervisor")
         self._peers = {}          # addr -> {"seen": bool, "missed": int}
         self._dead = set()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -77,14 +87,16 @@ class PeerMonitor:
                 return
 
     def watch(self, host: str, port: int) -> None:
-        self._peers[(host, int(port))] = {"seen": False, "missed": 0}
+        with self._lock:
+            self._peers[(host, int(port))] = {"seen": False, "missed": 0}
 
     def unwatch(self, host: str, port: int) -> None:
         """Stop probing an address (a respawned peer binds a NEW port;
         the old listener must not linger as a perpetual corpse)."""
         addr = (host, int(port))
-        self._peers.pop(addr, None)
-        self._dead.discard(addr)
+        with self._lock:
+            self._peers.pop(addr, None)
+            self._dead.discard(addr)
 
     def rearm(self, host: str, port: int) -> None:
         """Forget a peer's death and watch its address from scratch —
@@ -92,25 +104,32 @@ class PeerMonitor:
         the replacement worker is 'not up yet' until its listener is
         first reached, never instantly re-declared dead."""
         addr = (host, int(port))
-        self._dead.discard(addr)
-        self._peers[addr] = {"seen": False, "missed": 0}
+        with self._lock:
+            self._dead.discard(addr)
+            self._peers[addr] = {"seen": False, "missed": 0}
 
     def poll_dead(self) -> list:
         """Probe every watched peer once; returns NEWLY dead addresses."""
         import socket
 
+        with self._lock:
+            targets = [(addr, st) for addr, st in self._peers.items()
+                       if addr not in self._dead]
         newly = []
-        # snapshot: watch/unwatch/rearm may run on other threads
-        for addr, st in list(self._peers.items()):
-            if addr in self._dead:
-                continue
+        for addr, st in targets:
             try:
                 s = socket.create_connection(addr, self.probe_timeout_s)
                 s.close()
-                st["seen"] = True
-                st["missed"] = 0
+                ok = True
             except OSError:
-                if st["seen"]:        # never-reached peers are "not up yet"
+                ok = False
+            with self._lock:
+                if self._peers.get(addr) is not st:
+                    continue    # unwatched/rearmed mid-probe: stale result
+                if ok:
+                    st["seen"] = True
+                    st["missed"] = 0
+                elif st["seen"]:  # never-reached peers are "not up yet"
                     st["missed"] += 1
                     if st["missed"] >= self.misses:
                         self._dead.add(addr)
@@ -227,9 +246,15 @@ class PeerRecovery:
         return new_rt, revision
 
 
+@guarded
 class AppSupervisor:
     """Heartbeats one app's async junction workers and drives peer
     recovery. ``SiddhiAppRuntime.supervise()`` is the usual entry."""
+
+    # the tick thread and producer-backpressure escalations
+    # (notify_backpressure, any sender thread) both read-modify-write
+    # the beat table — the pre-R8 tick wrote it with no lock at all
+    GUARDED_BY = {"_beat_seen": "app_supervisor"}
 
     def __init__(self, app_runtime, interval_s: float = 0.25,
                  wedge_timeout_s: float = 5.0,
@@ -254,7 +279,7 @@ class AppSupervisor:
         self._thread: Optional[threading.Thread] = None
         self._recovering = threading.Event()
         self._recovered = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("app_supervisor")
 
     # ---------------------------------------------------------- lifecycle
 
@@ -303,27 +328,32 @@ class AppSupervisor:
         for sid, j in list(self.app_runtime.junctions.items()):
             if not (getattr(j, "_async", False) and j._running):
                 continue
-            worker = j._worker
-            beats = j._beats
-            seen = self._beat_seen.get(sid)
-            if seen is None or seen[0] != beats:
-                self._beat_seen[sid] = (beats, now)
-                stalled = False
-            else:
-                stalled = (now - seen[1]) > self.wedge_timeout_s
-            dead = worker is None or not worker.is_alive()
-            if j._fatal is not None:
-                continue    # framework failure: surfaced to senders, not
-                #             a restartable worker fault
-            if dead or stalled:
+            # the beat table is shared with notify_backpressure (sender
+            # threads): the whole read-judge-restart sequence must be
+            # one atom or a concurrent escalation double-restarts
+            with self._lock:
+                worker = j._worker
+                beats = j._beats
+                seen = self._beat_seen.get(sid)
+                if seen is None or seen[0] != beats:
+                    self._beat_seen[sid] = (beats, now)
+                    stalled = False
+                else:
+                    stalled = (now - seen[1]) > self.wedge_timeout_s
+                dead = worker is None or not worker.is_alive()
+                if j._fatal is not None:
+                    continue    # framework failure: surfaced to
+                    #             senders, not a restartable fault
+                if not (dead or stalled):
+                    continue
                 log.warning("supervisor: restarting %s worker of "
                             "junction '%s'",
                             "dead" if dead else "wedged", sid)
                 j.restart_worker()
                 self.worker_restarts += 1
                 self._beat_seen[sid] = (j._beats, now)
-                stat_count(self.app_runtime.app_context,
-                           "resilience.worker_restarts")
+            stat_count(self.app_runtime.app_context,
+                       "resilience.worker_restarts")
         # ingest pack-pool workers are supervised like junction workers:
         # a dead packer already had its sub-batch re-packed by the merge
         # thread (never lost); the tick respawns the thread so capacity

@@ -1,0 +1,44 @@
+"""Known-bad R8 fixture: every guarded-by failure mode in one file —
+an unlocked read AND write of a declared field, a stale declaration
+guarding nothing, and a thread-spawning class sharing a mutable dict
+with no GUARDED_BY at all."""
+
+import threading
+
+from siddhi_tpu.analysis.locks import make_lock
+
+
+class BadPendingTable:
+    # '_pending' is declared pump-guarded but read and written outside
+    # the lock; '_ghost' is declared but never used under any lock
+    GUARDED_BY = {"_pending": "pump", "_ghost": "pump"}
+
+    def __init__(self):
+        self._lock = make_lock("pump")
+        self._pending = {}
+        self._ghost = 0
+
+    def submit(self, key, value):
+        self._pending[key] = value       # unlocked write: finding
+
+    def oldest(self):
+        if not self._pending:            # unlocked read: finding
+            return None
+        with self._lock:
+            return min(self._pending)    # locked: fine
+
+
+class BadWorkerPool:
+    # spawns threads, mutates a shared dict from them, declares nothing
+    def __init__(self):
+        self._results = {}
+        self._threads = []
+
+    def start(self, n):
+        for i in range(n):
+            t = threading.Thread(target=self._work, args=(i,))
+            self._threads.append(t)
+            t.start()
+
+    def _work(self, i):
+        self._results[i] = i * i

@@ -29,6 +29,7 @@ FIXTURE_PATHS = {
     "r5_host_pull.py": "siddhi_tpu/core/query/bad_steps.py",
     "r6_instruments.py": "siddhi_tpu/core/query/bad_instruments.py",
     "r7_actuators.py": "siddhi_tpu/autopilot/bad_actuators.py",
+    "r8_guards.py": "siddhi_tpu/core/query/bad_guards.py",
 }
 
 
@@ -62,6 +63,9 @@ def _lint_fixture(name: str):
     ("r6_instruments.py", "R6", 2),
     # untyped knob + dead actuator + undeclared actuation path
     ("r7_actuators.py", "R7", 3),
+    # stale declaration, unlocked write, unlocked read, undeclared
+    # thread-spawning class
+    ("r8_guards.py", "R8", 4),
 ])
 def test_rule_flags_its_fixture(name, rule, min_hits):
     findings = _lint_fixture(name)
@@ -115,10 +119,10 @@ def test_suppression_comments():
         os.unlink(tmp)
 
 
-def test_rule_registry_lists_seven_rules():
+def test_rule_registry_lists_eight_rules():
     rules = default_rules()
     assert [r.id for r in rules] == ["R1", "R2", "R3", "R4", "R5", "R6",
-                                     "R7"]
+                                     "R7", "R8"]
 
 
 def test_instrument_parity_bidirectional():
@@ -172,6 +176,39 @@ def test_metric_prefix_parity_bidirectional():
     findings = run_lint(mods)
     ghosts = [f for f in findings if "ghost" in f.message]
     assert ghosts, [f.format() for f in findings]
+
+
+def test_knob_parity_bidirectional():
+    """A knob declared in the registry that no production code reads is
+    a finding — in both consumption styles (attr=None needs a
+    read_knob literal, attr='x' needs the attribute consumed). Uses a
+    fixture knobs.py so the real registry stays untouched."""
+    import ast
+
+    reg_src = ('KNOBS = _declare(\n'
+               '    Knob("window_capacity", "int",'
+               ' attr="window_capacity"),\n'
+               '    Knob("ghost_attr", "int", attr="ghost_attr"),\n'
+               '    Knob("quota_queue_depth", "int"),\n'
+               '    Knob("ghost_key", "float"),\n'
+               ')\n')
+    use_src = ('def wire(ctx, cm):\n'
+               '    cap = getattr(ctx, "window_capacity", 4096)\n'
+               '    depth = read_knob(cm, "quota_queue_depth")\n'
+               '    return cap, depth\n')
+    mods = [
+        ModuleInfo(path="siddhi_tpu/core/util/knobs.py", src=reg_src,
+                   tree=ast.parse(reg_src)),
+        ModuleInfo(path="siddhi_tpu/core/wire.py", src=use_src,
+                   tree=ast.parse(use_src)),
+    ]
+    findings = [f for f in run_lint(mods) if f.rule == "R2"]
+    msgs = [f.message for f in findings]
+    assert any("ghost_attr" in m for m in msgs), msgs
+    assert any("ghost_key" in m for m in msgs), msgs
+    # the two consumed knobs raise nothing
+    assert not any("window_capacity" in m or "quota_queue_depth" in m
+                   for m in msgs), msgs
 
 
 def test_step_registry_resolves():

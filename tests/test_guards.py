@@ -1,0 +1,169 @@
+"""Guarded-by runtime contracts (analysis/guards.py — R8's runtime
+half): planted unlocked accesses raise under SIDDHI_TPU_SANITIZE=1,
+everything is plain attributes with it off, and the descriptors are
+transparent to the values they hold (pytrees round-trip untouched).
+
+``guarded()`` reads the env at class-definition time (the same
+construction-time gate as ``make_lock``), so each test defines its
+plant class locally under monkeypatched env."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from siddhi_tpu.analysis.guards import GuardViolation, _GuardedField, guarded
+from siddhi_tpu.analysis.locks import make_lock
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _plant():
+    """A threaded-class stand-in with one guarded field, defined under
+    whatever env the caller monkeypatched."""
+
+    @guarded
+    class Table:
+        GUARDED_BY = {"_pending": "pump"}
+
+        def __init__(self):
+            self._lock = make_lock("pump")
+            self._pending = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._pending[k] = v
+
+        def get(self, k):
+            with self._lock:
+                return self._pending.get(k)
+
+    return Table
+
+
+# ----------------------------------------------------------- armed (on)
+
+@pytest.fixture
+def sanitized(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_SANITIZE", "1")
+
+
+def test_unlocked_read_raises(sanitized):
+    t = _plant()()
+    t.put("a", 1)
+    with pytest.raises(GuardViolation, match="unlocked read.*_pending"):
+        _ = t._pending
+
+
+def test_unlocked_write_raises(sanitized):
+    t = _plant()()
+    with pytest.raises(GuardViolation, match="unlocked write.*_pending"):
+        t._pending = {}
+
+
+def test_locked_access_passes(sanitized):
+    t = _plant()()
+    t.put("a", 1)
+    assert t.get("a") == 1
+    with t._lock:
+        t._pending["b"] = 2     # direct access under the lock is fine
+        assert t._pending == {"a": 1, "b": 2}
+
+
+def test_constructor_is_exempt(sanitized):
+    # __init__ populated _pending without the lock and did not raise
+    t = _plant()()
+    assert t.get("missing") is None
+
+
+def test_violation_is_per_thread(sanitized):
+    """Holding the rank on THIS thread does not license another."""
+    t = _plant()()
+    errs = []
+
+    def other():
+        try:
+            _ = t._pending
+        except GuardViolation as e:
+            errs.append(e)
+
+    with t._lock:
+        th = threading.Thread(target=other)
+        th.start()
+        th.join()
+    assert len(errs) == 1
+
+
+def test_undeclared_rank_rejected(sanitized):
+    with pytest.raises(ValueError, match="undeclared lock rank"):
+        @guarded
+        class Bad:
+            GUARDED_BY = {"_x": "nonsense"}
+
+
+def test_guarded_requires_own_declaration(sanitized):
+    with pytest.raises(ValueError, match="no GUARDED_BY"):
+        @guarded
+        class Bare:
+            pass
+
+
+def test_values_round_trip_untouched(sanitized):
+    """The descriptor stores by reference — pytree-ish values (nested
+    containers, arrays) come back identical, so snapshot/restore code
+    that walks guarded state under the lock sees the real objects."""
+    import numpy as np
+
+    t = _plant()()
+    leaf = np.arange(4)
+    tree = {"rows": [leaf, (1, 2)], "meta": {"seq": 7}}
+    t.put("snap", tree)
+    with t._lock:
+        got = t._pending["snap"]
+    assert got is tree
+    assert got["rows"][0] is leaf
+
+
+# ------------------------------------------------------------ off (cold)
+
+def test_plain_attributes_without_env(monkeypatch):
+    monkeypatch.delenv("SIDDHI_TPU_SANITIZE", raising=False)
+    cls = _plant()
+    # no descriptors installed: the class dict has no _GuardedField
+    assert not any(isinstance(v, _GuardedField)
+                   for v in vars(cls).values())
+    t = cls()
+    t._pending = {"x": 1}       # unlocked access is just an attribute
+    assert t._pending == {"x": 1}
+    assert "_pending" in t.__dict__     # no mangled slot indirection
+
+
+def test_rank_names_validated_even_when_off(monkeypatch):
+    monkeypatch.delenv("SIDDHI_TPU_SANITIZE", raising=False)
+    with pytest.raises(ValueError, match="undeclared lock rank"):
+        @guarded
+        class Bad:
+            GUARDED_BY = {"_x": "nonsense"}
+
+
+# ------------------------------------------------- sanitized cluster run
+
+def test_quick_cluster_check_sanitized():
+    """The multi-process tier under every sanitizer: _child_env()
+    propagates SIDDHI_TPU_SANITIZE to the workers, so the router,
+    egress, supervisor and worker-side contracts are all enforced
+    end-to-end. A missing lock anywhere fails this loudly."""
+    env = dict(os.environ)
+    env["SIDDHI_TPU_SANITIZE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "quick_cluster_check.py")],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "quick_cluster_check OK" in proc.stdout

@@ -118,14 +118,16 @@ def run_fabric(warm, main, n_workers: int, kill: bool):
         elapsed = time.time() - t0
         rows = [(ts, tuple(vals)) for ts, vals in
                 cluster.egress.stream_rows("soakApp", "Out")]
+        eg = cluster.egress.counters()
         stats = {
             "workers": n_workers,
             "elapsed_s": round(elapsed, 3),
             "events_per_s": round(
                 sum(len(b[3]) for b in main) / elapsed),
-            "merged_runs": cluster.egress.merged_runs,
-            "duplicate_emits_dropped": cluster.egress.duplicate_emits,
-            "respawns": sum(cluster.supervisor.respawns),
+            "merged_runs": eg["merged_runs"],
+            "duplicate_emits_dropped": eg["duplicate_emits"],
+            "respawns": sum(cluster.supervisor.respawn_count(i)
+                            for i in range(n_workers)),
             "killed": bool(kill and n_workers > 1),
         }
         return rows, stats

@@ -5,14 +5,19 @@ tree (``siddhi_tpu/`` + ``tools/`` + the repo-root entry points) and
 exits nonzero on any finding:
 
   R1  no backend init at import (module-level jnp / eager jax calls)
-  R2  typed config-knob discipline (siddhi_tpu.* reads outside knobs.py)
+  R2  typed config-knob discipline (siddhi_tpu.* reads outside knobs.py,
+      knobs declared but never read)
   R3  metric-registration parity (undeclared families, unpaired gauges)
   R4  lock-order discipline (acquisitions inverting lockorder.py)
   R5  no host pulls in jitted step code
+  R6  device-instrument parity
+  R7  actuator parity
+  R8  guarded-by lock coverage (GUARDED_BY field contracts)
 
 Usage:
     python tools/graftlint.py            # lint the tree, exit 0/1
     python tools/graftlint.py --list     # print the rule set
+    python tools/graftlint.py --json     # findings as JSON records
     python tools/graftlint.py PATH...    # lint specific roots
 
 Suppress a deliberate exception with ``# graftlint: disable=R1`` on the
@@ -51,6 +56,7 @@ def main(argv=None) -> int:
         for r in rules:
             print(f"{r.id}  {r.title}")
         return 0
+    as_json = "--json" in argv
     roots = [a for a in argv if not a.startswith("-")] or list(DEFAULT_ROOTS)
     missing = [r for r in roots if not os.path.exists(os.path.join(REPO, r))]
     if missing:
@@ -67,6 +73,19 @@ def main(argv=None) -> int:
         print(f"graftlint: no Python files under {roots}")
         return 2
     findings = run_lint(modules, rules=rules)
+    if as_json:
+        # machine-readable gate output (CI annotations, editor plugins):
+        # one record per finding + a trailing summary object. Exit codes
+        # are identical to the text mode.
+        import json
+
+        print(json.dumps({
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message} for f in findings],
+            "files": len(modules),
+            "rules": [r.id for r in rules],
+        }, indent=2))
+        return 1 if findings else 0
     for f in findings:
         print(f.format())
     n = len(findings)

@@ -119,7 +119,7 @@ def main() -> int:
     finally:
         cluster.shutdown()
 
-    n_runs = cluster.egress.merged_runs
+    n_runs = cluster.egress.counters()["merged_runs"]
     assert got_split == base_split, (
         f"SPLIT mismatch: {len(got_split)} vs {len(base_split)} rows; "
         f"first diff at "
