@@ -1266,6 +1266,63 @@ def bench_cluster():
     }
 
 
+def bench_programs():
+    """Compiled-program cache acceptance curve (ISSUE 20): the
+    fleet-soak driver's 32-app fleet of IDENTICAL fuzz apps, cache on
+    vs off. The soak tool owns the workload (tools/fleet_soak.py — live
+    wire ingest, mid-soak blue/green replace, snapshot/restore, all
+    bit-identity asserted in-process) so the bench number and the soak
+    measure the identical feed; this wrapper reruns it in bench shape,
+    enforces the acceptance floors, and records the install-time curve
+    into BENCH_r10.json (`--section programs` is the writer — the main
+    harness keeps owning BENCH_r09.json)."""
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "fleet_soak.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, tool, "--identical", "32", "--churn", "1",
+         "--events", "8", "--compare-off"],
+        capture_output=True, text=True, timeout=560, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(f"fleet_soak failed rc={r.returncode}: "
+                           f"{r.stderr[-1000:]}")
+    soak = json.loads(r.stdout.strip().splitlines()[-1])
+    # acceptance floors: each distinct program compiled exactly ONCE
+    # across the 32-tenant fleet, and warm installs >= 3x faster than
+    # program_cache: off
+    assert soak["total_compiles"] == soak["distinct_programs"], soak
+    assert soak["install_speedup_rest"] >= 3.0, (
+        f"warm-install speedup {soak['install_speedup_rest']} < 3x")
+    assert soak["snapshot_restore_exact"], soak
+    record = {
+        "fleet_apps": soak["tenants_per_case"],
+        "distinct_programs": soak["distinct_programs"],
+        "total_compiles": soak["total_compiles"],
+        "cache_hits": soak["cache_hits"],
+        "install_ms_curve_on": soak["install_ms_curve"],
+        "install_ms_curve_off": soak["off_install_ms_curve"],
+        "install_ms_rest_mean_on": soak["install_ms_rest_mean"],
+        "install_ms_rest_mean_off": soak["off_install_ms_rest_mean"],
+        "install_speedup_rest": soak["install_speedup_rest"],
+        "blue_green_replacements": soak["churn_replacements"],
+        "snapshot_restore_exact": True,
+        "backend": "cpu",
+    }
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r10.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"programs": record}, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass
+    return record
+
+
 # --------------------------------------------------------------- harness
 
 
@@ -1680,6 +1737,8 @@ if __name__ == "__main__":
             print(json.dumps({"autopilot": bench_autopilot()}))
         elif section == "cluster":
             print(json.dumps({"cluster": bench_cluster()}))
+        elif section == "programs":
+            print(json.dumps({"programs": bench_programs()}))
         else:
             raise SystemExit(f"unknown section {section}")
     else:

@@ -54,6 +54,49 @@ INSTRUMENTED_STEP_BUILDERS = (
 )
 
 
+# Program-cache participation (core/util/program_cache.py, round 15):
+# audit name -> the ``family=`` tag(s) its builder passes to
+# ``instrument_jit``. The tag is part of the cache key — wrapper
+# shardings (``in_shardings=...``) are invisible in the traced jaxpr,
+# so two builders jitting the same function under different shardings
+# must never alias; tests/test_program_cache.py asserts each declared
+# tag still appears at a call site in the named module (a builder
+# gaining/renaming a tag without updating this inventory fails there).
+# ``sharded_agg`` is absent by design: its on-demand selectors fold
+# host-side — there is no production jit to cache (hlo_audit builds
+# its probe program ad hoc).
+PROGRAM_CACHE_FAMILIES: Dict[str, Tuple[str, ...]] = {
+    "query_step": ("query_step", "selector"),
+    "fused_fanout": ("fused_fanout",),
+    "gspmd_replicated_batch": ("gspmd_replicated_batch",),
+    "shard_map_routed": ("shard_map_routed",),
+    "device_routed": ("device_routed",),
+    # NFA steps ride QueryRuntime's module (pattern/sequence queries)
+    "nfa_step": ("nfa_step", "nfa_timer"),
+    # join sides tag per side at the call site: device_join.left/right
+    "device_join": ("device_join",),
+}
+
+# family tags above that are PREFIXES of the call-site tag (the call
+# site appends a dynamic suffix, e.g. ``device_join.left``)
+PROGRAM_CACHE_PREFIX_FAMILIES = ("device_join", "device_routed")
+
+# module that carries each family's instrument_jit call site (may
+# differ from the builder's own module — NFA steps live in
+# core/query/nfa_runtime, join sides in core/query/join_runtime)
+PROGRAM_CACHE_FAMILY_SITES: Dict[str, str] = {
+    "query_step": "siddhi_tpu.core.query.runtime",
+    "selector": "siddhi_tpu.core.query.runtime",
+    "fused_fanout": "siddhi_tpu.core.query.fused_fanout",
+    "gspmd_replicated_batch": "siddhi_tpu.parallel.mesh",
+    "shard_map_routed": "siddhi_tpu.parallel.mesh",
+    "device_routed": "siddhi_tpu.parallel.mesh",
+    "nfa_step": "siddhi_tpu.core.query.nfa_runtime",
+    "nfa_timer": "siddhi_tpu.core.query.nfa_runtime",
+    "device_join": "siddhi_tpu.core.query.join_runtime",
+}
+
+
 def resolve(name: str):
     """Import and return the registered builder (audit-time sanity:
     a renamed/moved builder fails loudly, not silently unaudited)."""

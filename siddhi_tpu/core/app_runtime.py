@@ -1147,6 +1147,15 @@ class SiddhiAppRuntime:  # graftlint: disable=R8 — the junction/query/
             self.app_context.ingest_pack_pool = None
         if self.app_context.scheduler is not None:
             self.app_context.scheduler.shutdown()
+        from siddhi_tpu.core.util import program_cache
+
+        # release this app's refs on the process-global compiled-program
+        # cache; entries reaching refcount zero evict (free) here. The
+        # owner token is this runtime's telemetry-registry INSTANCE
+        # (identity-pinned, the blue/green convention): an OLD runtime's
+        # shutdown can never strip the programs a newer same-named app
+        # acquired through ITS registry.
+        program_cache.cache().release_owner(self.app_context.telemetry)
         from siddhi_tpu.observability import journey
 
         # this app's wall-tracking must die with it (a redeployed

@@ -155,6 +155,14 @@ class SiddhiAppContext:
         from siddhi_tpu.observability.telemetry import TelemetryRegistry
 
         self.telemetry = TelemetryRegistry()
+        # bind the registry to this context: InstrumentedJit reads the
+        # program-cache knobs through it, and the registry INSTANCE is
+        # the app's identity-pinned owner token in the process-global
+        # compiled-program cache (core/util/program_cache.py) — unique
+        # per runtime, so a blue/green replace's old-runtime shutdown
+        # can never release the new runtime's refs
+        self.telemetry.app_context = self
+        self.telemetry.owner_name = name
         self.playback = False
         self.enforce_order = False
         self.root_metrics_level = "OFF"
@@ -216,6 +224,17 @@ class SiddhiAppContext:
         # process-wide without a config.
         self.profile_journeys = False
         self.profile_costs = False
+        # process-global compiled-program cache (core/util/program_cache.py):
+        # identical step programs compile ONCE and share the immutable
+        # executable across tenant apps (per-app state pytrees stay
+        # private). Default on; 'false' restores per-app compiles.
+        # program_cache_max caps live entries. Keys
+        # siddhi_tpu.program_cache / siddhi_tpu.program_cache_max;
+        # SIDDHI_TPU_PROGRAM_CACHE / _MAX set the process defaults.
+        self.program_cache = env_knob("SIDDHI_TPU_PROGRAM_CACHE",
+                                      "bool", True)
+        self.program_cache_max = env_knob("SIDDHI_TPU_PROGRAM_CACHE_MAX",
+                                          "int", 256)
         # device telemetry plane (observability/instruments.py): jitted
         # steps append declared instrument slots (window ring fill, join
         # partition fill, NFA active runs, routed-row skew, distinct

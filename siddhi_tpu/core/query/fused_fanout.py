@@ -433,7 +433,7 @@ class FusedFanoutRuntime(Receiver):
 
         jitted = jax.jit(fused, donate_argnums=0)
         return self.app_context.telemetry.instrument_jit(
-            jitted, f"fanout.{self.stream_id}.step")
+            jitted, f"fanout.{self.stream_id}.step", family="fused_fanout")
 
     def _process_locked(self, batch: HostBatch, junction=None):
         from siddhi_tpu.core.util.statistics import (latency_t0,

@@ -919,7 +919,8 @@ class JoinQueryRuntime(QueryRuntime):
                     jitted = self.app_context.telemetry.instrument_jit(
                         jax.jit(self.build_side_step_fn(side_key),
                                 donate_argnums=0),
-                        f"query.{self.name}.join.{side_key}")
+                        f"query.{self.name}.join.{side_key}",
+                        family=f"device_join.{side_key}")
                 self._steps[side_key] = jitted
             else:
                 self.app_context.telemetry.record_jit(

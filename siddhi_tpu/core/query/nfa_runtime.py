@@ -334,8 +334,12 @@ class NFAQueryRuntime(QueryRuntime):
                     step = sharded_jit_for(self, fn, n_plain_args=2)
                 else:
                     step = jax.jit(fn, donate_argnums=0)
+                # cache_extra: wrapper shardings are invisible in the
+                # traced program — a mesh-sharded NFA step must never
+                # alias an unsharded one with an equal jaxpr
                 step = self.app_context.telemetry.instrument_jit(
-                    step, jit_key)
+                    step, jit_key, family="nfa_step",
+                    cache_extra=str(self._shard_mesh or ""))
                 self._steps[(stream_id, force_generic)] = step
             else:
                 self.app_context.telemetry.record_jit(jit_key, hit=True)
@@ -439,7 +443,9 @@ class NFAQueryRuntime(QueryRuntime):
                 else:
                     self._timer_step = jax.jit(fn, donate_argnums=0)
                 self._timer_step = self.app_context.telemetry.instrument_jit(
-                    self._timer_step, f"query.{self.name}.nfa.timer")
+                    self._timer_step, f"query.{self.name}.nfa.timer",
+                    family="nfa_timer",
+                    cache_extra=str(self._shard_mesh or ""))
             notify = self._run_nfa_step(
                 lambda: self._timer_step(self._state, np.int64(ts)),
                 allow_pipeline=False)

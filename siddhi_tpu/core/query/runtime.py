@@ -379,7 +379,7 @@ class QueryRuntime(Receiver):
             return routed_step_for(self)
         jitted = jax.jit(self.build_step_fn(), donate_argnums=0)
         return self.app_context.telemetry.instrument_jit(
-            jitted, f"query.{self.name}.step")
+            jitted, f"query.{self.name}.step", family="query_step")
 
     # ------------------------------------------------- device instruments
 
@@ -875,7 +875,7 @@ class QueryRuntime(Receiver):
 
             self._sel_step = self.app_context.telemetry.instrument_jit(
                 jax.jit(fn, donate_argnums=0),
-                f"query.{self.name}.selector")
+                f"query.{self.name}.selector", family="selector")
         else:
             self.app_context.telemetry.record_jit(
                 f"query.{self.name}.selector", hit=True)

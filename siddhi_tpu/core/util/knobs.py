@@ -118,6 +118,18 @@ KNOBS: Dict[str, Knob] = _declare(
     # AOT compile per program). Defaults off; see MIGRATION.md.
     Knob("profile_journeys", "bool", attr="profile_journeys"),
     Knob("profile_costs", "bool", attr="profile_costs"),
+    # process-global compiled-program cache (core/util/program_cache.py):
+    # identical step programs (jaxpr text + embedded consts + output
+    # tree + backend/sharding witness) compile once and share the
+    # executable across tenant apps; per-app state pytrees stay private.
+    # program_cache gates participation per app (default on; off =
+    # every wrapper compiles privately, pre-round-15 behavior);
+    # program_cache_max caps live cache entries (zero-ref entries evict
+    # LRU-first at the cap; a cache full of live programs compiles
+    # privately without caching). Env process defaults:
+    # SIDDHI_TPU_PROGRAM_CACHE / SIDDHI_TPU_PROGRAM_CACHE_MAX.
+    Knob("program_cache", "bool", attr="program_cache"),
+    Knob("program_cache_max", "int", attr="program_cache_max"),
     # device telemetry plane (observability/instruments.py): instrument
     # slots ride the meta vector behind [overflow, notify, count] —
     # per-batch device truth (ring fill, join partition fill, NFA runs,

@@ -1,0 +1,350 @@
+"""Process-global compiled-program cache: N tenant apps, one compile.
+
+ROADMAP item 2's finish line. PR 3 proved the dedup rule inside one
+junction's fused fan-out group (equal jaxpr text + pairwise bit-equal
+embedded constants + equal output tree => provably the same program);
+PR 11's cost registry then MEASURED the cross-app duplicate clusters
+that rule leaves on the table (``GET /programs``). This module promotes
+the rule to a refcounted process-wide registry consulted by EVERY
+jitted step family at first call, through the one choke point they all
+share — ``observability.telemetry.InstrumentedJit`` (the
+``analysis/step_registry.py`` inventory routes each builder's jit
+through ``instrument_jit``).
+
+Key anatomy — an entry is shared only when ALL of these match:
+
+- **family** — the step-builder tag passed by the instrument_jit call
+  site (``query_step``, ``fused_fanout``, ``device_join.left``, ...).
+  Shardings on the jit wrapper (``in_shardings=...``) are INVISIBLE in
+  the traced jaxpr, so construction families that differ only by
+  wrapper sharding must never alias; the family tag is that witness.
+- **extra** — a call-site sharding/mesh witness (e.g. ``str(mesh)`` for
+  the GSPMD and routed builders) for variation WITHIN a family.
+- **platform** — jax backend platform (a cpu executable is not a tpu
+  executable).
+- **donate signature** — the traced ``donate_argnums``.
+- **jaxpr text** — the full closed-jaxpr string (deterministic variable
+  naming, scalar literals inline; shapes/dtypes are part of the text,
+  so a capacity re-jit is a different program by construction).
+- **embedded constants** — pairwise bit-equal (closure-captured arrays
+  are NOT in the text; ``equal_nan`` floats).
+- **output tree** — structure + (shape, dtype, sharding) of every leaf
+  (catches output-name-only differences).
+
+Sharing guarantees: the shared object is the immutable ``jax.jit``
+callable (and thus its compiled executables). State pytrees stay
+per-app — every caller passes (and donates) its OWN state argument, so
+two tenants sharing an executable can never observe each other, and
+snapshots/restores stay canonical per app. A fingerprint (sha1 over the
+jaxpr text, the PR-11 convention) buckets candidates; the full witness
+above decides.
+
+Refcounting is OWNER-scoped and identity-pinned (the PR-8 blue/green
+convention): the owner token is the app's ``TelemetryRegistry``
+INSTANCE, unique per runtime, so shutting down an OLD runtime during a
+blue/green replace can never evict the program a newer same-named app
+is sharing. ``SiddhiAppRuntime.shutdown`` releases its owner; entries
+evict at refcount zero. Within an app's lifetime a replaced step's ref
+lingers until that app's shutdown (refs are per owner, not per
+wrapper) — the ``program_cache_max`` cap bounds the resulting slack by
+evicting zero-ref entries LRU-first and, at a full cache, compiling
+privately instead of caching.
+
+Knobs (typed registry, ``core/util/knobs.py``):
+``siddhi_tpu.program_cache`` (bool, default on) gates participation per
+app; ``siddhi_tpu.program_cache_max`` (int, default 256) caps live
+entries. Process-default env spellings: ``SIDDHI_TPU_PROGRAM_CACHE`` /
+``SIDDHI_TPU_PROGRAM_CACHE_MAX``.
+
+Telemetry: ``program_cache.{hits,misses,evictions}`` counters and the
+``program_cache.size`` gauge on the process registry (rendered as the
+``siddhi_program_cache_*`` families; the gauge is removed at
+``drain()``, graftlint R3 pairing). ``GET /programs`` serves
+``cache().snapshot()`` next to the cost registry's clusters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def values_equal(x, y) -> bool:
+    """Bit-equality of two array-likes (shape, dtype, every element;
+    ``equal_nan`` floats). Unequal on any doubt — the PR-3 rule."""
+    try:
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape != y.shape or x.dtype != y.dtype:
+            return False
+        if np.issubdtype(x.dtype, np.floating):
+            return bool(np.array_equal(x, y, equal_nan=True))
+        return bool(np.array_equal(x, y))
+    except Exception:  # noqa: BLE001 — unequal on any doubt
+        return False
+
+
+def _normalize_out(out_info) -> Tuple:
+    """Hashable witness of a traced output tree: structure + per-leaf
+    (shape, dtype, sharding) — OutInfo objects don't define equality."""
+    import jax
+
+    leaves, tree = jax.tree_util.tree_flatten(out_info)
+    return (str(tree),
+            tuple((tuple(leaf.shape), str(leaf.dtype),
+                   str(getattr(leaf, "sharding", None)))
+                  for leaf in leaves))
+
+
+class CacheEntry:
+    """One shared compiled program. ``jitted`` is the immutable
+    ``jax.jit`` callable every sharer dispatches through; ``refs`` maps
+    owner tokens (app ``TelemetryRegistry`` instances — identity-pinned)
+    to their acquire counts."""
+
+    __slots__ = ("fingerprint", "family", "extra", "platform", "donated",
+                 "jaxpr_str", "consts", "out_norm", "jitted", "refs",
+                 "hits", "keys", "seq")
+
+    def __init__(self, fingerprint: str, family: str, extra: str,
+                 platform: str, donated: Tuple, jaxpr_str: str, consts,
+                 out_norm: Tuple, jitted):
+        self.fingerprint = fingerprint
+        self.family = family
+        self.extra = extra
+        self.platform = platform
+        self.donated = donated
+        self.jaxpr_str = jaxpr_str
+        self.consts = list(consts)
+        self.out_norm = out_norm
+        self.jitted = jitted
+        self.refs: Dict[object, int] = {}
+        self.hits = 0
+        self.keys: set = set()
+        self.seq = 0
+
+    def refcount(self) -> int:
+        return sum(self.refs.values())
+
+    def shared_by(self) -> List[str]:
+        """App names holding refs (owner display; an owner token without
+        a bound name reports as ``<process>``)."""
+        return sorted({getattr(tok, "owner_name", "") or "<process>"
+                       for tok in self.refs})
+
+    def matches(self, family: str, extra: str, platform: str,
+                donated: Tuple, jaxpr_str: str, consts,
+                out_norm: Tuple) -> bool:
+        if (self.family != family or self.extra != extra
+                or self.platform != platform or self.donated != donated):
+            return False
+        if self.jaxpr_str != jaxpr_str or self.out_norm != out_norm:
+            return False
+        if len(self.consts) != len(consts):
+            return False
+        return all(values_equal(a, b)
+                   for a, b in zip(self.consts, consts))
+
+
+class ProgramCache:
+    """The process-global registry (module singleton via ``cache()``)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._by_fp: Dict[str, List[CacheEntry]] = {}
+        self._seq = 0
+        self._gauge_on = False
+
+    # ------------------------------------------------------------- attach
+
+    def attach(self, key: str, family: str, jitted, args,
+               owner, extra: str = "",
+               max_entries: Optional[int] = None):
+        """First-call hook: trace ``jitted`` with the real call args,
+        look the program up, and either share an existing executable or
+        register this one. Returns ``(fn, traced, hit)`` — ``fn`` is
+        what the caller must dispatch through from now on; ``traced``
+        is the jax AOT trace (reused by the cost registry so profiling
+        never traces twice); ``hit`` is True when ``fn`` is a shared
+        executable that did NOT need a compile. Never raises: any
+        trace/introspection failure degrades to the uncached path."""
+        try:
+            trace = getattr(jitted, "trace", None)
+            if trace is None:
+                return jitted, None, False      # not a jax.jit callable
+            traced = trace(*args)
+            jaxpr_str = str(traced.jaxpr)
+            consts = list(traced.jaxpr.consts)
+            out_norm = _normalize_out(traced.out_info)
+            donated = tuple(getattr(traced, "donate_argnums", ()) or ())
+            fp = hashlib.sha1(jaxpr_str.encode()).hexdigest()[:16]
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception as e:  # noqa: BLE001 — cache must not break steps
+            log.debug("program-cache trace failed for '%s': %r", key, e)
+            return jitted, None, False
+        from siddhi_tpu.observability.telemetry import global_registry
+
+        tel = global_registry()
+        with self._lock:
+            self._seq += 1
+            for entry in self._by_fp.get(fp, ()):
+                if entry.matches(family, extra, platform, donated,
+                                 jaxpr_str, consts, out_norm):
+                    entry.refs[owner] = entry.refs.get(owner, 0) + 1
+                    entry.keys.add(key)
+                    entry.hits += 1
+                    entry.seq = self._seq
+                    tel.count("program_cache.hits")
+                    return entry.jitted, traced, True
+            tel.count("program_cache.misses")
+            if max_entries is not None and max_entries >= 0:
+                # a cap of zero caches nothing (every step compiles
+                # privately); entries never evict a live-ref program
+                if self._size_locked() >= max_entries:
+                    self._evict_unreferenced_locked(
+                        tel, down_to=max_entries - 1)
+                if self._size_locked() >= max_entries:
+                    # full of live programs: compile privately, uncached
+                    return jitted, traced, False
+            entry = CacheEntry(fp, family, extra, platform, donated,
+                               jaxpr_str, consts, out_norm, jitted)
+            entry.refs[owner] = 1
+            entry.keys.add(key)
+            entry.seq = self._seq
+            self._by_fp.setdefault(fp, []).append(entry)
+            self._ensure_gauge_locked(tel)
+        return jitted, traced, False
+
+    # ---------------------------------------------------------- lifecycle
+
+    def release_owner(self, owner) -> int:
+        """Drop every ref the owner token holds; entries reaching
+        refcount zero are evicted (freed) immediately. Identity-pinned:
+        a token that never acquired is a no-op, so an OLD runtime's
+        shutdown cannot touch a survivor's programs. Returns the number
+        of entries evicted."""
+        from siddhi_tpu.observability.telemetry import global_registry
+
+        tel = global_registry()
+        evicted = 0
+        with self._lock:
+            for fp in list(self._by_fp):
+                kept = []
+                for entry in self._by_fp[fp]:
+                    entry.refs.pop(owner, None)
+                    if entry.refs:
+                        kept.append(entry)
+                    else:
+                        evicted += 1
+                        tel.count("program_cache.evictions")
+                if kept:
+                    self._by_fp[fp] = kept
+                else:
+                    del self._by_fp[fp]
+        return evicted
+
+    def _evict_unreferenced_locked(self, tel, down_to: int) -> None:
+        """Evict zero-ref entries oldest-first until the cache holds at
+        most ``down_to`` entries (cap enforcement; live-ref entries are
+        never evicted by the cap)."""
+        dead = [e for entries in self._by_fp.values()
+                for e in entries if not e.refs]
+        dead.sort(key=lambda e: e.seq)
+        for entry in dead:
+            if self._size_locked() <= down_to:
+                break
+            bucket = self._by_fp.get(entry.fingerprint, [])
+            if entry in bucket:
+                bucket.remove(entry)
+                if not bucket:
+                    del self._by_fp[entry.fingerprint]
+                tel.count("program_cache.evictions")
+
+    def _size_locked(self) -> int:
+        return sum(len(v) for v in self._by_fp.values())
+
+    def size(self) -> int:
+        with self._lock:
+            return self._size_locked()
+
+    def _ensure_gauge_locked(self, tel) -> None:
+        if not self._gauge_on:
+            tel.gauge("program_cache.size", self.size)
+            self._gauge_on = True
+
+    def drain(self) -> int:
+        """Evict everything and unregister the size gauge (R3 pairing:
+        the gauge dies with the cache, not with the process). Tooling /
+        test hook — live apps re-register on their next compile."""
+        from siddhi_tpu.observability.telemetry import global_registry
+
+        tel = global_registry()
+        with self._lock:
+            n = self._size_locked()
+            for _ in range(n):
+                tel.count("program_cache.evictions")
+            self._by_fp.clear()
+            if self._gauge_on:
+                tel.remove_gauge("program_cache.size")
+                self._gauge_on = False
+        return n
+
+    # ------------------------------------------------------------ reading
+
+    def snapshot(self) -> dict:
+        """The ``GET /programs`` cache section: every live entry with
+        its sharers, plus the counter roll-up."""
+        from siddhi_tpu.observability.telemetry import global_registry
+
+        with self._lock:
+            entries = [e for v in self._by_fp.values() for e in v]
+            rows = [{
+                "fingerprint": e.fingerprint,
+                "family": e.family,
+                "platform": e.platform,
+                "keys": sorted(e.keys),
+                "shared_by": e.shared_by(),
+                "refcount": e.refcount(),
+                "hits": e.hits,
+            } for e in sorted(entries, key=lambda e: (-e.hits,
+                                                      e.fingerprint))]
+        counters = global_registry().snapshot().get("counters", {})
+        return {
+            "entries": rows,
+            "size": len(rows),
+            "hits": counters.get("program_cache.hits", 0),
+            "misses": counters.get("program_cache.misses", 0),
+            "evictions": counters.get("program_cache.evictions", 0),
+        }
+
+
+def enabled_for(app_context) -> bool:
+    """Does this app participate? The per-app typed knob when a context
+    is bound; the env process default otherwise."""
+    if app_context is not None:
+        return bool(getattr(app_context, "program_cache", True))
+    from siddhi_tpu.core.util.knobs import env_knob
+
+    return bool(env_knob("SIDDHI_TPU_PROGRAM_CACHE", "bool", True))
+
+
+def max_entries_for(app_context) -> int:
+    if app_context is not None:
+        return int(getattr(app_context, "program_cache_max", 256))
+    from siddhi_tpu.core.util.knobs import env_knob
+
+    return int(env_knob("SIDDHI_TPU_PROGRAM_CACHE_MAX", "int", 256))
+
+
+_CACHE = ProgramCache()
+
+
+def cache() -> ProgramCache:
+    return _CACHE

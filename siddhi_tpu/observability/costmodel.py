@@ -90,6 +90,7 @@ class ProgramRecord:
     code_bytes: int = 0
     compile_ms: float = 0.0     # the AOT capture compile (not the jit's)
     captures: int = 1           # how many times this key re-captured
+    shared: bool = False        # analysis reused from an equal program
     error: Optional[str] = None
     extra: Dict[str, float] = field(default_factory=dict)
 
@@ -108,20 +109,40 @@ class CostRegistry:
 
     # ------------------------------------------------------------ capture
 
-    def capture(self, key: str, jitted, args) -> Optional[ProgramRecord]:
+    def capture(self, key: str, jitted, args, traced=None,
+                shared: bool = False) -> Optional[ProgramRecord]:
         """Fingerprint + cost/memory analysis for one jitted callable
         about to run its first call. Never raises: a capture failure
         (non-jit callable, backend without analysis support) records the
-        error and the engine runs on."""
+        error and the engine runs on.
+
+        ``traced`` reuses an AOT trace the program cache already made
+        (one trace per first call, not two). ``shared=True`` means the
+        callable is a program-cache HIT: the analysis is copied from an
+        already-captured equal-fingerprint record instead of paying —
+        and being double-counted as — a second AOT compile; only when
+        no donor record exists (the donor app compiled with profiling
+        off) does the capture fall through to a real AOT compile."""
         rec: Optional[ProgramRecord] = None
         try:
-            trace = getattr(jitted, "trace", None)
-            if trace is None:
-                return None         # not a jax.jit callable
-            traced = trace(*args)
+            if traced is None:
+                trace = getattr(jitted, "trace", None)
+                if trace is None:
+                    return None     # not a jax.jit callable
+                traced = trace(*args)
             fp = hashlib.sha1(
                 str(traced.jaxpr).encode()).hexdigest()[:16]
             rec = ProgramRecord(key=key, fingerprint=fp)
+            if shared:
+                donor = self._donor(fp, key)
+                if donor is not None:
+                    for metric in self._GAUGE_METRICS:
+                        setattr(rec, metric, getattr(donor, metric))
+                    rec.platform = donor.platform
+                    rec.compile_ms = 0.0    # no AOT compile happened
+                    rec.shared = True
+                    self._store(rec)
+                    return rec
             t0 = time.perf_counter()
             compiled = traced.lower().compile()
             rec.compile_ms = (time.perf_counter() - t0) * 1000.0
@@ -150,13 +171,26 @@ class CostRegistry:
             if rec is None:
                 return None
             rec.error = repr(e)
+        self._store(rec)
+        return rec
+
+    def _donor(self, fp: str, key: str) -> Optional[ProgramRecord]:
+        """A clean already-captured record of the same fingerprint under
+        a DIFFERENT key — the analysis source for a shared capture."""
         with self._lock:
-            prev = self._programs.get(key)
+            for rec in self._programs.values():
+                if (rec.fingerprint == fp and rec.key != key
+                        and rec.error is None):
+                    return rec
+        return None
+
+    def _store(self, rec: ProgramRecord) -> None:
+        with self._lock:
+            prev = self._programs.get(rec.key)
             if prev is not None:
                 rec.captures = prev.captures + 1
-            self._programs[key] = rec
+            self._programs[rec.key] = rec
         self._register_gauges(rec)
-        return rec
 
     def _register_gauges(self, rec: ProgramRecord) -> None:
         from siddhi_tpu.observability.telemetry import global_registry

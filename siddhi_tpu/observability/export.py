@@ -58,6 +58,11 @@ TELEMETRY_PREFIXES = (
                      # (observability/journey.py -> siddhi_stage_*)
     "jitcost",       # compiled-program cost gauges
                      # (observability/costmodel.py -> siddhi_jit_cost_*)
+    "program_cache", # process-global compiled-program cache: hit/miss/
+                     # eviction counters + live-entry size gauge
+                     # (core/util/program_cache.py ->
+                     # siddhi_program_cache_*; the size gauge is removed
+                     # at cache drain)
     "scrape",        # /metrics self-timing (siddhi_scrape_ms)
     "device",        # device-instrument slots riding the meta vector
                      # (observability/instruments.py -> siddhi_device_*)
@@ -279,6 +284,28 @@ _AUTOPILOT_COUNTER_FAMILY = {
     "autopilot.freezes": ("siddhi_autopilot_freezes_total",
                           "autopilot ticks skipped by compile-storm "
                           "backoff (jit compiles still climbing)"),
+}
+# process-global compiled-program cache (core/util/program_cache.py):
+# counters on the process registry; hits are first-call executable
+# shares (a hit is a compile that did NOT happen). The size family is
+# public: tools/fleet_soak.py greps the exposition for it (R3 keeps the
+# literal declared HERE only).
+PROGRAM_CACHE_SIZE_FAMILY = "siddhi_program_cache_size"
+_PROGRAM_CACHE_COUNTER_FAMILY = {
+    "program_cache.hits": (
+        "siddhi_program_cache_hits_total",
+        "first-call program-cache hits: an equal compiled program "
+        "(jaxpr + consts + output tree + backend/sharding witness) was "
+        "shared instead of compiled"),
+    "program_cache.misses": (
+        "siddhi_program_cache_misses_total",
+        "first-call program-cache misses: no equal program was live, "
+        "this jit compiled and registered as the shared executable"),
+    "program_cache.evictions": (
+        "siddhi_program_cache_evictions_total",
+        "program-cache entries evicted (refcount zero at owner "
+        "release, LRU zero-ref at the program_cache_max cap, or a "
+        "drain)"),
 }
 _SERVING_COUNTER_FAMILY = {
     "serving.queries": ("siddhi_serving_queries_total",
@@ -548,6 +575,11 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
                     fams.add("siddhi_autopilot_mode", "gauge",
                              "closed-loop controller mode per app "
                              "(0=off, 1=dry_run, 2=on)", base, v)
+                elif name == "program_cache.size":
+                    fams.add(PROGRAM_CACHE_SIZE_FAMILY, "gauge",
+                             "live entries in the process-global "
+                             "compiled-program cache (distinct shared "
+                             "executables)", base, v)
                 elif name == "cluster.workers.live":
                     fams.add("siddhi_cluster_workers_live", "gauge",
                              "worker processes with a live attached link "
@@ -636,6 +668,8 @@ def _add_telemetry(fams: _Families, tel_snapshot: dict, app: str):
             fam = _INGEST_COUNTER_FAMILY.get(name)
         if fam is None:
             fam = _AUTOPILOT_COUNTER_FAMILY.get(name)
+        if fam is None:
+            fam = _PROGRAM_CACHE_COUNTER_FAMILY.get(name)
         if fam is not None:
             fams.add(fam[0], "counter", fam[1], base, v)
             continue

@@ -46,12 +46,24 @@ class InstrumentedJit:
     per-key budget — or any miss after
     ``analysis.sanitize.freeze_compiles()`` — raises ``RecompileError``
     naming the jit key, so a recompile-per-batch shape instability
-    fails a test instead of melting p99 in production."""
+    fails a test instead of melting p99 in production.
+
+    When the call site declares a step ``family``, the first call also
+    consults the process-global compiled-program cache
+    (``core/util/program_cache.py``): an equal program already compiled
+    by ANY app swaps in as this wrapper's callable — recorded as a
+    cache HIT, not a compile — while a miss registers this wrapper's
+    jit as the shared executable. ``family=None`` (the default) opts a
+    wrapper out: sharding on the jit wrapper is invisible in the traced
+    program, so only call sites that declare their construction family
+    may share."""
 
     __slots__ = ("_fn", "_key", "_telemetry", "_compiled", "_sanitize",
-                 "_cache_size", "_compiles")
+                 "_cache_size", "_compiles", "_family", "_cache_extra",
+                 "_shared")
 
-    def __init__(self, fn: Callable, key: str, telemetry: "TelemetryRegistry"):
+    def __init__(self, fn: Callable, key: str, telemetry: "TelemetryRegistry",
+                 family: str = None, cache_extra: str = ""):
         from siddhi_tpu.analysis import sanitize
 
         self._fn = fn
@@ -61,29 +73,61 @@ class InstrumentedJit:
         self._sanitize = sanitize.enabled()
         self._cache_size = 0
         self._compiles = 0
+        self._family = family
+        self._cache_extra = cache_extra
+        self._shared = False    # dispatching through a shared executable
 
     def __call__(self, *args):
         if self._compiled and not self._sanitize:
             return self._fn(*args)
         from siddhi_tpu.observability.tracing import span
 
+        hit = False
         if not self._compiled:
             from siddhi_tpu.observability import costmodel
 
+            traced = None
+            if self._family is not None:
+                from siddhi_tpu.core.util import program_cache
+
+                ctx = getattr(self._telemetry, "app_context", None)
+                if program_cache.enabled_for(ctx):
+                    fn, traced, hit = program_cache.cache().attach(
+                        self._key, self._family, self._fn, args,
+                        owner=self._telemetry, extra=self._cache_extra,
+                        max_entries=program_cache.max_entries_for(ctx))
+                    if hit:
+                        self._fn = fn
+                        self._shared = True
+                        # recompile-watchdog baseline: the shared
+                        # wrapper already holds its sharers' compiled
+                        # shapes — only growth from HERE is a compile
+                        # chargeable to this key
+                        try:
+                            self._cache_size = int(self._fn._cache_size())
+                        except Exception:  # noqa: BLE001 — introspection
+                            pass
             if costmodel.enabled():
                 # cost-registry capture (fingerprint + cost/memory
                 # analysis) runs BEFORE the first call: the step jits
                 # donate their state argument, and tracing after the
-                # call would read deleted buffers
-                costmodel.registry().capture(self._key, self._fn, args)
+                # call would read deleted buffers. The program-cache
+                # trace is reused, and a shared hit reuses the donor's
+                # analysis instead of a second AOT compile.
+                costmodel.registry().capture(self._key, self._fn, args,
+                                             traced=traced, shared=hit)
         t0 = time.perf_counter()
         with span("jit", key=self._key):
             out = self._fn(*args)
         first = not self._compiled
         self._compiled = True
         if first:
-            self._telemetry.record_jit(
-                self._key, wall_ms=(time.perf_counter() - t0) * 1000.0)
+            if hit:
+                # shared executable, no compile happened for this key
+                self._telemetry.record_jit(self._key, hit=True)
+            else:
+                self._telemetry.record_jit(
+                    self._key, wall_ms=(time.perf_counter() - t0) * 1000.0)
         if self._sanitize:
             self._watch_recompiles(first,
                                    (time.perf_counter() - t0) * 1000.0)
@@ -190,10 +234,16 @@ class TelemetryRegistry:
                 rec["compiles"] += 1
                 rec["compile_ms"] += float(wall_ms)
 
-    def instrument_jit(self, fn: Callable, key: str) -> InstrumentedJit:
+    def instrument_jit(self, fn: Callable, key: str,
+                       family: str = None,
+                       cache_extra: str = "") -> InstrumentedJit:
         """Wrap a freshly-built jitted callable so its first call is
-        recorded as a compile event."""
-        return InstrumentedJit(fn, key, self)
+        recorded as a compile event. ``family`` (a step-builder tag,
+        e.g. ``"query_step"``) opts the wrapper into the process-global
+        compiled-program cache; ``cache_extra`` carries any
+        sharding/mesh witness the traced program cannot see."""
+        return InstrumentedJit(fn, key, self, family=family,
+                               cache_extra=cache_extra)
 
     # ------------------------------------------------------------ reading
 

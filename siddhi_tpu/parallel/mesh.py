@@ -167,8 +167,12 @@ def shard_query_step(runtime, mesh: Mesh, donate: bool = True):
     # /metrics (siddhi_jit_compiles_total) before they show up as p99
     tel = getattr(runtime.app_context, "telemetry", None)
     if tel is not None:
+        # cache_extra: in_shardings/out_shardings live on the jit
+        # wrapper, invisible in the traced program — the mesh string is
+        # the witness that keeps distinct layouts from aliasing
         jitted = tel.instrument_jit(
-            jitted, f"query.{runtime.name}.sharded_step")
+            jitted, f"query.{runtime.name}.sharded_step",
+            family="gspmd_replicated_batch", cache_extra=str(mesh))
     # hand the runtime the sharded timeline so junction-fed batches
     # (QueryRuntime.process_batch) and direct jitted() callers share state;
     # remember the mesh so capacity growth re-establishes the sharding
@@ -339,6 +343,11 @@ def shard_keyed_query_step(runtime, mesh: Mesh, rows_per_shard: int):
         check_rep=False,
     )
     jitted = jax.jit(sharded, donate_argnums=(0,))
+    tel = getattr(runtime.app_context, "telemetry", None)
+    if tel is not None:
+        jitted = tel.instrument_jit(
+            jitted, f"query.{runtime.name}.shard_map_step",
+            family="shard_map_routed", cache_extra=str(mesh))
     state = jax.device_put(global_state, jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec), st_specs))
     return jitted, state
@@ -1096,7 +1105,10 @@ def _finish_routed_install(runtime, layout: RouteLayout, jitted,
         f".{side_key}" if side_key else "")
     tel = getattr(runtime.app_context, "telemetry", None)
     if tel is not None:
-        jitted = tel.instrument_jit(jitted, key)
+        jitted = tel.instrument_jit(
+            jitted, key,
+            family="device_routed" + (f".{side_key}" if side_key else ""),
+            cache_extra=str(layout.mesh))
 
     def step3(state, cols, now):
         return jitted(state, cols, layout.device_luts(), now)

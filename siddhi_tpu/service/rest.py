@@ -48,7 +48,9 @@ Critical-path profiler (``observability/journey.py`` + ``costmodel.py``):
   service/queueing report naming the bottleneck stage (rendered by
   ``tools/critical_path.py``)
 - ``GET  /programs``                   — compiled-program cost registry
-  (cost/memory analysis + jaxpr-fingerprint duplicate clusters)
+  (cost/memory analysis + jaxpr-fingerprint duplicate clusters) plus the
+  ``cache`` block: live program-cache entries with sharing apps,
+  refcounts and hit counts (``core/util/program_cache.py``)
 - ``GET  /autopilot[/{app}]``          — closed-loop controller report:
   actuator table, per-app mode/freeze state, bounded decision log
   (``siddhi_tpu/autopilot/``; 404 for apps not under autopilot control)
@@ -203,12 +205,16 @@ class SiddhiRestService:
             h._send(200, self._rt(parts[1]).statistics())
             return
         if parts == ["programs"]:
-            # compiled-program cost registry (observability/costmodel.py):
-            # every captured program with fingerprint-duplicate clusters —
-            # the before-picture for a process-wide compiled-program cache
+            # compiled-program cost registry (observability/costmodel.py)
+            # plus the live process-global compiled-program cache
+            # (core/util/program_cache.py): which executables are shared,
+            # by whom, refcounts and first-call hit totals
+            from siddhi_tpu.core.util import program_cache
             from siddhi_tpu.observability import costmodel
 
-            h._send(200, costmodel.registry().snapshot())
+            payload = costmodel.registry().snapshot()
+            payload["cache"] = program_cache.cache().snapshot()
+            h._send(200, payload)
             return
         if (len(parts) in (2, 3) and parts[0] == "profile"
                 and parts[1] == "critical_path"):

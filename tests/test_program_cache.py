@@ -1,0 +1,240 @@
+"""Process-global compiled-program cache (core/util/program_cache.py).
+
+Round-15 tentpole: identical step programs compile ONCE per process and
+share the immutable executable across SiddhiManager apps, while per-app
+state pytrees stay private (donation is per-caller). These tests pin the
+lifecycle edges the refcounting must survive:
+
+- two identical apps -> one compile, hit accounting on the second app
+- shared executable, private state: windowed outputs diverge per app,
+  and cross-app snapshot/restore never aliases state
+- blue/green replace: the replacement runtime hits the warm cache, and
+  the OLD runtime's shutdown must not evict the survivor's program
+  (owner tokens are identity-pinned, not name-keyed)
+- refcount-zero eviction returns the size gauge to baseline
+- `siddhi_tpu.program_cache: off` restores fully private compiles
+"""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.stream.output.stream_callback import StreamCallback
+from siddhi_tpu.core.util import program_cache
+from siddhi_tpu.core.util.config import InMemoryConfigManager
+from siddhi_tpu.observability.export import prometheus_text
+
+
+class Collect(StreamCallback):
+    def __init__(self):
+        self.rows = []
+
+    def receive(self, events):
+        self.rows.extend(tuple(e.data) for e in events)
+
+
+FILTER_APP = """
+@app:name('{name}')
+define stream S (sym string, price float, vol long);
+@info(name = 'q1')
+from S[price > 10.0]
+select sym, price * 2.0 as dbl, vol
+insert into Out;
+"""
+
+WINDOW_APP = """
+@app:name('{name}')
+define stream S (sym string, price float, vol long);
+@info(name = 'q1')
+from S#window.length(3)
+select sum(vol) as total
+insert into Out;
+"""
+
+
+def _deploy(manager, sql, name):
+    rt = manager.create_siddhi_app_runtime(sql.format(name=name))
+    cb = Collect()
+    rt.add_callback("Out", cb)
+    rt.start()
+    return rt, cb
+
+
+def _feed(rt, rows):
+    h = rt.get_input_handler("S")
+    for i, row in enumerate(rows):
+        h.send(100 + i, list(row))
+
+
+def _entry_for(key):
+    for e in program_cache.cache().snapshot()["entries"]:
+        if key in e["keys"]:
+            return e
+    return None
+
+
+def test_two_identical_apps_share_one_compile():
+    program_cache.cache().drain()
+    m = SiddhiManager()
+    rt1, cb1 = _deploy(m, FILTER_APP, "pc_a1")
+    rt2, cb2 = _deploy(m, FILTER_APP, "pc_a2")
+    rows = [("x", 12.5, 3), ("y", 5.0, 1), ("z", 99.0, 7)]
+    _feed(rt1, rows)
+    _feed(rt2, rows)
+
+    # bit-identical outputs through the SHARED executable
+    assert cb1.rows == cb2.rows == [("x", 25.0, 3), ("z", 198.0, 7)]
+
+    snap = program_cache.cache().snapshot()
+    assert snap["size"] == 1
+    entry = snap["entries"][0]
+    assert entry["family"] == "query_step"
+    assert entry["refcount"] == 2
+    assert sorted(entry["shared_by"]) == ["pc_a1", "pc_a2"]
+    assert entry["hits"] == 1
+
+    # satellite 1: the second app's first call is a HIT, not a compile —
+    # and batch-level hit accounting keeps counting on the shared fn
+    j1 = rt1.app_context.telemetry.snapshot()["jit"]["query.q1.step"]
+    j2 = rt2.app_context.telemetry.snapshot()["jit"]["query.q1.step"]
+    assert j1["compiles"] == 1
+    assert j2["compiles"] == 0 and j2["compile_ms"] == 0.0
+    assert j2["hits"] >= 1
+
+    # metrics surface: cache families render from the process registry
+    text = prometheus_text(m)
+    assert "siddhi_program_cache_hits_total" in text
+    assert "siddhi_program_cache_misses_total" in text
+    assert "siddhi_program_cache_size" in text
+    m.shutdown()
+
+
+def test_shared_program_private_state_and_snapshot_restore():
+    program_cache.cache().drain()
+    m = SiddhiManager()
+    rt1, cb1 = _deploy(m, WINDOW_APP, "pc_w1")
+    rt2, cb2 = _deploy(m, WINDOW_APP, "pc_w2")
+
+    # DIFFERENT event streams -> windows must not alias
+    _feed(rt1, [("a", 1.0, 1), ("a", 1.0, 2)])
+    _feed(rt2, [("b", 1.0, 10)])
+    # the attach happens at each step's FIRST call, so the shared entry
+    # exists only now that both apps have run a batch
+    assert program_cache.cache().snapshot()["size"] >= 1
+    assert [r[0] for r in cb1.rows] == [1, 3]
+    assert [r[0] for r in cb2.rows] == [10]
+
+    # cross-app snapshot/restore: rolling rt1 back must not disturb rt2
+    snap1 = rt1.snapshot()
+    _feed(rt1, [("a", 1.0, 4)])
+    _feed(rt2, [("b", 1.0, 20)])
+    assert [r[0] for r in cb1.rows] == [1, 3, 7]
+    rt1.restore(snap1)
+    _feed(rt1, [("a", 1.0, 4)])
+    # replay after restore reproduces the same fold...
+    assert [r[0] for r in cb1.rows] == [1, 3, 7, 7]
+    # ...and rt2's window only ever saw rt2's events
+    _feed(rt2, [("b", 1.0, 30)])
+    assert [r[0] for r in cb2.rows] == [10, 30, 60]
+    m.shutdown()
+
+
+def test_blue_green_replace_hits_warm_cache_and_survives_old_shutdown():
+    program_cache.cache().drain()
+    m_old = SiddhiManager()
+    rt_old, cb_old = _deploy(m_old, FILTER_APP, "pc_bg")
+    _feed(rt_old, [("x", 12.5, 3)])
+    assert _entry_for("query.q1.step")["refcount"] == 1
+
+    # green runtime: same name, fresh manager — must ATTACH, not compile
+    m_new = SiddhiManager()
+    rt_new, cb_new = _deploy(m_new, FILTER_APP, "pc_bg")
+    _feed(rt_new, [("x", 12.5, 3)])
+    entry = _entry_for("query.q1.step")
+    assert entry["refcount"] == 2
+    j_new = rt_new.app_context.telemetry.snapshot()["jit"]["query.q1.step"]
+    assert j_new["compiles"] == 0
+
+    # blue retires: identity-pinned owners mean the old runtime's
+    # shutdown can only drop ITS ref — the survivor's program stays
+    m_old.shutdown()
+    entry = _entry_for("query.q1.step")
+    assert entry is not None and entry["refcount"] == 1
+    assert entry["shared_by"] == ["pc_bg"]
+
+    # and the survivor keeps producing identical results afterwards
+    _feed(rt_new, [("z", 99.0, 7)])
+    assert cb_new.rows == [("x", 25.0, 3), ("z", 198.0, 7)]
+    m_new.shutdown()
+
+
+def test_eviction_at_refcount_zero_returns_size_to_baseline():
+    program_cache.cache().drain()
+    before = program_cache.cache().snapshot()
+    assert before["size"] == 0
+    m = SiddhiManager()
+    rt1, _ = _deploy(m, FILTER_APP, "pc_e1")
+    rt2, _ = _deploy(m, FILTER_APP, "pc_e2")
+    _feed(rt1, [("x", 12.5, 3)])
+    _feed(rt2, [("x", 12.5, 3)])
+    assert program_cache.cache().snapshot()["size"] == 1
+    ev0 = program_cache.cache().snapshot()["evictions"]
+
+    rt1.shutdown()
+    mid = program_cache.cache().snapshot()
+    assert mid["size"] == 1           # rt2 still holds a ref
+    assert mid["evictions"] == ev0
+    rt2.shutdown()
+    after = program_cache.cache().snapshot()
+    assert after["size"] == 0         # size gauge back to baseline
+    assert after["evictions"] == ev0 + 1
+    m.shutdown()
+
+
+def test_knob_off_restores_private_compiles():
+    program_cache.cache().drain()
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.program_cache": "0"}))
+    rt1, cb1 = _deploy(m, FILTER_APP, "pc_off1")
+    rt2, cb2 = _deploy(m, FILTER_APP, "pc_off2")
+    _feed(rt1, [("x", 12.5, 3)])
+    _feed(rt2, [("x", 12.5, 3)])
+    assert cb1.rows == cb2.rows == [("x", 25.0, 3)]
+    # nothing cached, both apps compiled privately
+    assert program_cache.cache().snapshot()["size"] == 0
+    j1 = rt1.app_context.telemetry.snapshot()["jit"]["query.q1.step"]
+    j2 = rt2.app_context.telemetry.snapshot()["jit"]["query.q1.step"]
+    assert j1["compiles"] == 1 and j2["compiles"] == 1
+    m.shutdown()
+
+
+def test_family_tag_inventory_matches_call_sites():
+    """analysis/step_registry.py declares which ``family=`` tag every
+    step builder passes to ``instrument_jit``; the tag is part of the
+    cache key, so a renamed/dropped tag MUST show up here. Each
+    declared family must appear at an instrument_jit call site in its
+    named module (literal or as an f-string/concatenation prefix)."""
+    import importlib
+    import inspect
+
+    from siddhi_tpu.analysis import step_registry
+
+    declared = {f for fams in step_registry.PROGRAM_CACHE_FAMILIES.values()
+                for f in fams}
+    assert declared == set(step_registry.PROGRAM_CACHE_FAMILY_SITES)
+    for fam, module in step_registry.PROGRAM_CACHE_FAMILY_SITES.items():
+        src = inspect.getsource(importlib.import_module(module))
+        assert (f'family="{fam}' in src or f'family=f"{fam}' in src), (
+            f"family tag '{fam}' not found at an instrument_jit call "
+            f"site in {module} — update PROGRAM_CACHE_FAMILY_SITES")
+
+
+def test_max_entries_cap_degrades_to_uncached():
+    program_cache.cache().drain()
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(
+        {"siddhi_tpu.program_cache_max": "0"}))
+    rt1, cb1 = _deploy(m, FILTER_APP, "pc_cap1")
+    _feed(rt1, [("x", 12.5, 3)])
+    # cap of zero: the program runs fine but is never cached
+    assert cb1.rows == [("x", 25.0, 3)]
+    assert program_cache.cache().snapshot()["size"] == 0
+    m.shutdown()

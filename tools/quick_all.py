@@ -35,6 +35,10 @@ CHECKS = {
     # must exactly equal the single-process run (ISSUE 17)
     "cluster": ("quick_cluster_check.py", 300, (), {}),
     "hlo": ("hlo_audit.py", 300, (), {}),
+    # process-global compiled-program cache (core/util/program_cache.py):
+    # two identical apps -> one compile + bit-identical outputs, warm
+    # blue/green attach with identity-pinned eviction, knob-off control
+    "programs": ("quick_programs_check.py", 300, (), {}),
     # critical-path profiler: bit-identity with FULL profiling on
     # (journeys + cost capture + tracer + detail stats) + report sanity
     "obs": ("quick_obs_check.py", 300, (), {}),
